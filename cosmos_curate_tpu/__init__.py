@@ -1,0 +1,16 @@
+"""cosmos-curate-tpu: a TPU-native video curation framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of
+nvidia-cosmos/cosmos-curate (reference at /root/reference): a streaming,
+auto-scaled, multi-stage pipeline system that ingests raw video, shot-detects
+and splits it into clips, transcodes on CPU, filters, embeds, captions with
+vision-language models, semantically deduplicates, and shards webdatasets.
+
+Design stance (see SURVEY.md §7): the pipeline *shape* (streaming stages,
+worker pools, object-store refs) is device-agnostic and kept; every
+CUDA-touching leaf is replaced with a JAX/TPU equivalent. Model parallelism is
+pjit/shard_map over a `jax.sharding.Mesh` (ICI within a slice, DCN across
+slices) instead of NCCL; video decode/encode stays CPU-side.
+"""
+
+__version__ = "0.1.0"

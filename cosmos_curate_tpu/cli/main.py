@@ -1,0 +1,81 @@
+"""`cosmos-curate-tpu` CLI root.
+
+Equivalent of the reference's typer app (cosmos_curate/client/cli.py:25-39)
+built on argparse (typer is not in this image). Sub-apps register themselves
+here as they are built: local (run pipelines), view (clip viewer), slurm,
+serve (job service).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from cosmos_curate_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cosmos-curate-tpu",
+        description="TPU-native video curation pipelines",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command", metavar="command")
+
+    info = sub.add_parser("info", help="show environment and device info")
+    info.set_defaults(func=_cmd_info)
+
+    # Lazy registration of heavier sub-apps to keep `--help` fast.
+    try:
+        from cosmos_curate_tpu.cli import local_cli
+
+        local_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from cosmos_curate_tpu.cli import serve_cli
+
+        serve_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from cosmos_curate_tpu.cli import view_cli
+
+        view_cli.register(sub)
+    except ImportError:
+        pass
+    try:
+        from cosmos_curate_tpu.cli import slurm_cli
+
+        slurm_cli.register(sub)
+    except ImportError:
+        pass
+    return parser
+
+
+def _cmd_info(args: argparse.Namespace) -> int:
+    import platform
+
+    print(f"cosmos-curate-tpu {__version__}")
+    print(f"python {platform.python_version()} on {platform.system().lower()}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        print(f"jax {jax.__version__}: {len(devs)} device(s), platform={devs[0].platform}")
+    except Exception as e:  # device discovery can fail off-TPU; still report
+        print(f"jax unavailable: {e}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "command", None):
+        parser.print_help()
+        return 2
+    return int(args.func(args) or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""Core pipeline contracts: tasks, stages, models, runners.
+
+TPU-equivalent of the reference's core/interfaces/ layer
+(cosmos_curate/core/interfaces/*.py) plus the engine-facing surface the
+reference imports from cosmos-xenna (SURVEY.md §1).
+"""
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.pipeline import (
+    ExecutionMode,
+    PipelineConfig,
+    PipelineSpec,
+    StreamingSpec,
+    run_pipeline,
+)
+from cosmos_curate_tpu.core.runner import RunnerInterface, SequentialRunner
+from cosmos_curate_tpu.core.stage import (
+    NodeInfo,
+    Resources,
+    Stage,
+    StageSpec,
+    WorkerMetadata,
+)
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+__all__ = [
+    "ExecutionMode",
+    "ModelInterface",
+    "NodeInfo",
+    "PipelineConfig",
+    "PipelineSpec",
+    "PipelineTask",
+    "Resources",
+    "RunnerInterface",
+    "SequentialRunner",
+    "Stage",
+    "StageSpec",
+    "StreamingSpec",
+    "WorkerMetadata",
+    "run_pipeline",
+]

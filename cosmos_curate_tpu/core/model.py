@@ -1,0 +1,31 @@
+"""Model interface: the contract between stages and the models they drive.
+
+Equivalent of the reference's ``ModelInterface``
+(cosmos_curate/core/interfaces/model_interface.py:20-54). The engine uses
+``model_id_names`` to pre-stage weights on every node before workers start
+(model/model_utils.py:139 in the reference); ``setup()`` runs inside the
+worker and must leave the model ready for inference (for JAX models: params
+loaded on device, forward jitted or ready to jit).
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class ModelInterface(abc.ABC):
+    """Base class for all models driven by pipeline stages."""
+
+    @property
+    def env_name(self) -> str:
+        """Advisory execution-environment tag (see core.stage docstring)."""
+        return "default"
+
+    @property
+    @abc.abstractmethod
+    def model_id_names(self) -> list[str]:
+        """Weight-registry ids this model needs staged locally."""
+
+    @abc.abstractmethod
+    def setup(self) -> None:
+        """Load weights and build the inference callable (inside a worker)."""

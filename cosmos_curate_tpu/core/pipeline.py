@@ -1,0 +1,100 @@
+"""Pipeline spec, config, and the blocking ``run_pipeline`` entry point.
+
+Equivalent surface of the reference's ``run_pipeline``/``PipelineSpec``/
+``PipelineConfig``/``StreamingSpecificSpec``
+(cosmos_curate/core/interfaces/pipeline_interface.py:281-329,
+runner_interface.py:92-170).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Sequence
+
+from cosmos_curate_tpu.core.stage import Stage, StageSpec, fill_default_lifetimes
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+if TYPE_CHECKING:
+    from cosmos_curate_tpu.core.runner import RunnerInterface
+
+
+class ExecutionMode(enum.Enum):
+    """STREAMING keeps every stage's pool live simultaneously (requires the
+    summed TPU request to fit the cluster); BATCH runs stage-by-stage,
+    letting one stage use the whole cluster at a time
+    (pipeline_interface.py:155-164 in the reference)."""
+
+    STREAMING = "streaming"
+    BATCH = "batch"
+
+
+@dataclass
+class StreamingSpec:
+    """Autoscaler / backpressure tuning for STREAMING mode.
+
+    Defaults mirror the reference's ``StreamingSpecificSpec``
+    (runner_interface.py:92-101): 180 s autoscale cadence, per-stage input
+    queues bounded at ``max(lower_bound, multiplier × pool size)``.
+    """
+
+    autoscale_interval_s: float = 180.0
+    speed_estimation_window_s: float = 180.0
+    max_queued_multiplier: float = 1.5
+    max_queued_lower_bound: int = 16
+    # Object-store budget for in-flight payloads, as a fraction of host RAM.
+    object_store_fraction: float = 0.3
+
+
+@dataclass
+class PipelineConfig:
+    execution_mode: ExecutionMode = ExecutionMode.STREAMING
+    streaming: StreamingSpec = field(default_factory=StreamingSpec)
+    enable_work_stealing: bool = True
+    return_last_stage_outputs: bool = True
+    log_verbosity: int = 1
+    # Total resources; None = discover from the local host.
+    num_cpus: float | None = None
+    num_tpu_chips: int | None = None
+
+
+@dataclass
+class PipelineSpec:
+    input_data: list[PipelineTask]
+    stages: list[StageSpec]
+    config: PipelineConfig = field(default_factory=PipelineConfig)
+
+
+def _normalize_stages(
+    stages: Sequence[Stage | StageSpec],
+) -> list[StageSpec]:
+    out: list[StageSpec] = []
+    for s in stages:
+        spec = s if isinstance(s, StageSpec) else StageSpec(stage=s)
+        out.append(fill_default_lifetimes(spec))
+    return out
+
+
+def run_pipeline(
+    input_tasks: Sequence[PipelineTask],
+    stages: Sequence[Stage | StageSpec],
+    config: PipelineConfig | None = None,
+    runner: "RunnerInterface | None" = None,
+) -> list[PipelineTask] | None:
+    """Run ``input_tasks`` through ``stages``; blocks until done.
+
+    ``runner`` is the testability seam (the reference's single most important
+    one, SURVEY.md §4): tests inject a ``SequentialRunner`` to execute every
+    stage in-process with zero infrastructure; production uses the streaming
+    engine runner.
+    """
+    from cosmos_curate_tpu.core.runner import default_runner
+
+    config = config or PipelineConfig()
+    spec = PipelineSpec(
+        input_data=list(input_tasks),
+        stages=_normalize_stages(stages),
+        config=config,
+    )
+    active = runner if runner is not None else default_runner()
+    return active.run(spec)

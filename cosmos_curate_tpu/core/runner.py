@@ -1,0 +1,105 @@
+"""Runner abstraction + the in-process SequentialRunner.
+
+Equivalent of the reference's ``RunnerInterface``/``XennaRunner``
+(cosmos_curate/core/interfaces/runner_interface.py:37-183) and its test
+``SequentialRunner`` (tests/utils/sequential_runner.py:27-69) — promoted here
+to a first-class citizen because it is also the right way to run small local
+jobs on a single host without the streaming engine.
+"""
+
+from __future__ import annotations
+
+import abc
+import time
+
+from cosmos_curate_tpu.core.pipeline import PipelineSpec
+from cosmos_curate_tpu.core.stage import NodeInfo, WorkerMetadata
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class RunnerInterface(abc.ABC):
+    """Executes a ``PipelineSpec``; returns last-stage outputs (or None)."""
+
+    @abc.abstractmethod
+    def run(self, spec: PipelineSpec) -> list[PipelineTask] | None: ...
+
+
+class SequentialRunner(RunnerInterface):
+    """Run every stage in-process, stage by stage, no parallelism.
+
+    Exact lifecycle per stage: ``setup_on_node`` → ``setup`` →
+    ``process_data`` over batches → ``destroy``. Honors ``batch_size`` and
+    dynamic chunking (a stage may emit more or fewer tasks than it
+    received). This is both the test harness and the minimal local runner.
+    """
+
+    def __init__(self, *, raise_on_error: bool = True) -> None:
+        self.raise_on_error = raise_on_error
+
+    def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        node = NodeInfo(node_id="local")
+        tasks: list[PipelineTask] = list(spec.input_data)
+        for stage_spec in spec.stages:
+            stage = stage_spec.stage
+            meta = WorkerMetadata(
+                worker_id=f"{stage.name}-seq-0",
+                stage_name=stage.name,
+                node=node,
+                allocation=stage.resources,
+            )
+            t0 = time.monotonic()
+            stage.setup_on_node(node, meta)
+            stage.setup(meta)
+            out: list[PipelineTask] = []
+            bs = max(1, stage.batch_size)
+            try:
+                for i in range(0, len(tasks), bs):
+                    batch = tasks[i : i + bs]
+                    for attempt in range(max(1, stage_spec.num_run_attempts)):
+                        try:
+                            result = stage.process_data(batch)
+                            break
+                        except Exception:
+                            if attempt + 1 >= max(1, stage_spec.num_run_attempts):
+                                if self.raise_on_error:
+                                    raise
+                                logger.exception(
+                                    "stage %s failed on batch %d; dropping", stage.name, i
+                                )
+                                result = None
+                    if result is None:
+                        continue
+                    if not isinstance(result, list):
+                        raise TypeError(
+                            f"stage {stage.name}.process_data must return "
+                            f"list[PipelineTask] or None, got {type(result).__name__}"
+                        )
+                    out.extend(result)
+            finally:
+                stage.destroy()
+            logger.info(
+                "stage %s: %d -> %d tasks in %.2fs",
+                stage.name,
+                len(tasks),
+                len(out),
+                time.monotonic() - t0,
+            )
+            tasks = out
+        return tasks if spec.config.return_last_stage_outputs else None
+
+
+def default_runner() -> RunnerInterface:
+    """The production runner: streaming engine if usable, else sequential."""
+    try:
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+    except ImportError as e:
+        # Only the engine itself being absent may degrade; a broken engine
+        # module must surface, not silently fall back to 1/N throughput.
+        if e.name is None or not e.name.startswith("cosmos_curate_tpu.engine"):
+            raise
+        logger.warning("streaming engine unavailable; using SequentialRunner")
+        return SequentialRunner()
+    return StreamingRunner()

@@ -1,0 +1,174 @@
+"""Stage contracts: resources, lifecycle hooks, per-stage scheduling knobs.
+
+Equivalent surface of the reference's ``CuratorStage``/``CuratorStageSpec``/
+``Resources`` (cosmos_curate/core/interfaces/stage_interface.py) and the
+cosmos-xenna ``Stage``/``StageSpec`` they wrap (SURVEY.md §1).
+
+TPU-first deltas from the reference:
+
+- ``Resources.tpus`` counts *chips of the local TPU host* instead of
+  fractional CUDA devices. Fractional-GPU packing (0.25 GPU/worker) has no TPU
+  analogue; its equivalent here is batch aggregation — one engine worker per
+  host owns all local chips via a mesh (``entire_tpu_host=True``) and is fed
+  by many CPU prep workers. The autoscaler treats ``tpu`` as a resource type
+  alongside ``cpu`` (SURVEY.md §2.7).
+- No conda/pixi multi-env machinery: the TPU stack collapses to one process
+  image, so ``env_name`` is advisory metadata only (kept so pipelines can
+  still declare isolation intent; the engine may map it to separate worker
+  process pools).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field, replace
+from typing import Generic, TypeVar
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+T = TypeVar("T", bound=PipelineTask)
+V = TypeVar("V", bound=PipelineTask)
+
+
+@dataclass(frozen=True)
+class Resources:
+    """Per-worker resource request.
+
+    ``cpus`` may be fractional (IO-bound stages request e.g. 0.25 so many
+    workers pack onto one core). ``tpus`` is in chips; ``entire_tpu_host``
+    claims every chip on whichever host the worker lands on (the worker then
+    builds a local ``Mesh`` over them).
+    """
+
+    cpus: float = 1.0
+    tpus: float = 0.0
+    entire_tpu_host: bool = False
+    memory_gb: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.cpus < 0 or self.tpus < 0 or self.memory_gb < 0:
+            raise ValueError(f"negative resource request: {self}")
+
+    @property
+    def uses_tpu(self) -> bool:
+        return self.tpus > 0 or self.entire_tpu_host
+
+
+@dataclass(frozen=True)
+class NodeInfo:
+    """Identity of the host a worker is placed on."""
+
+    node_id: str = "local"
+    num_cpus: float = 1.0
+    num_tpu_chips: int = 0
+
+
+@dataclass(frozen=True)
+class WorkerMetadata:
+    """Identity + allocation of one worker within a stage pool."""
+
+    worker_id: str = "worker-0"
+    stage_name: str = ""
+    node: NodeInfo = field(default_factory=NodeInfo)
+    allocation: Resources = field(default_factory=Resources)
+    # Chip indices on the local host assigned to this worker (empty for CPU
+    # stages; all local chips when entire_tpu_host).
+    tpu_chip_ids: tuple[int, ...] = ()
+
+
+class Stage(Generic[T, V], abc.ABC):
+    """A pipeline stage: a stateful worker template.
+
+    Lifecycle inside each worker (SURVEY.md §3.2):
+      ``setup_on_node`` (once per host) → ``setup`` (once per worker) →
+      ``process_data`` repeatedly (the hot loop) → ``destroy``.
+    """
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0)
+
+    @property
+    def model(self) -> ModelInterface | None:
+        """Model this stage drives; engine pre-stages weights per node."""
+        return None
+
+    @property
+    def env_name(self) -> str:
+        """Advisory execution-environment tag (see module docstring)."""
+        return "default"
+
+    @property
+    def batch_size(self) -> int:
+        """How many tasks ``process_data`` receives per call."""
+        return 1
+
+    def setup_on_node(self, node: NodeInfo, worker: WorkerMetadata) -> None:
+        """Once per host before any worker setup (e.g. weight download)."""
+
+    def setup(self, worker: WorkerMetadata) -> None:
+        """Once per worker (load model, open handles)."""
+        model = self.model
+        if model is not None:
+            model.setup()
+
+    @abc.abstractmethod
+    def process_data(self, tasks: list[T]) -> list[V] | None:
+        """Process a batch of tasks; may emit a different number of tasks
+        than received (dynamic chunking). ``None`` drops the batch."""
+
+    def destroy(self) -> None:
+        """Worker teardown (flush artifacts, free device memory)."""
+
+
+@dataclass
+class StageSpec(Generic[T, V]):
+    """A stage plus its scheduling knobs.
+
+    Mirrors the reference's ``CuratorStageSpec``/xenna ``StageSpec``
+    (stage_interface.py:191-214): worker-count bounds, retries, over-
+    provisioning, and scheduled worker recycling (the leak guard for
+    long-running accelerator workers, pipeline_interface.py:187-219).
+    """
+
+    stage: Stage[T, V]
+    num_workers: int | None = None  # fixed pool size; None = autoscale
+    num_workers_per_node: int | None = None
+    min_workers: int = 1
+    max_workers: int | None = None
+    num_run_attempts: int = 1
+    over_provision_factor: float | None = None
+    # None = unset (heuristic defaults applied); 0 = never recycle.
+    worker_max_lifetime_m: int | None = None
+    worker_restart_interval_m: int = 1
+    # Fraction of inputs to record for offline replay (0 disables).
+    stage_save_sample_rate: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return self.stage.name
+
+
+def fill_default_lifetimes(spec: StageSpec) -> StageSpec:
+    """Apply the reference's worker-lifetime heuristics
+    (pipeline_interface.py:187-219): TPU stages recycle at 120 min, CPU
+    stages at 60 min, IO stages (<1 CPU, no TPU) never. An explicit
+    ``worker_max_lifetime_m`` (including 0 = never) is preserved; the
+    caller's spec is not mutated."""
+    if spec.worker_max_lifetime_m is not None:
+        return spec
+    res = spec.stage.resources
+    if res.uses_tpu:
+        lifetime, interval = 120, 5
+    elif res.cpus >= 1:
+        lifetime, interval = 60, 1
+    else:  # IO stage — never recycle.
+        lifetime, interval = 0, spec.worker_restart_interval_m
+    return replace(
+        spec, worker_max_lifetime_m=lifetime, worker_restart_interval_m=interval
+    )

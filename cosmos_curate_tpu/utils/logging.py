@@ -1,0 +1,43 @@
+"""Logging setup (reference uses loguru; we use stdlib logging with the same
+one-line-per-event spirit, configured once per process)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure_root() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("CURATE_LOG_LEVEL", "INFO").upper()
+    if level not in logging.getLevelNamesMapping():
+        print(
+            f"cosmos_curate_tpu: unknown CURATE_LOG_LEVEL={level!r}; using INFO",
+            file=sys.stderr,
+        )
+        level = "INFO"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s.%(msecs)03d | %(levelname)-7s | %(name)s:%(lineno)d - %(message)s",
+            datefmt="%H:%M:%S",
+        )
+    )
+    root = logging.getLogger("cosmos_curate_tpu")
+    root.setLevel(level)
+    if not root.handlers:
+        root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("cosmos_curate_tpu"):
+        name = f"cosmos_curate_tpu.{name}"
+    return logging.getLogger(name)

@@ -1,0 +1,46 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding (mesh/pjit/shard_map/collectives) is exercised without TPU
+hardware, mirroring how the driver dry-runs ``dryrun_multichip``."""
+
+import os
+import sys
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# A pytest plugin may have imported jax already; that is fine as long as the
+# backend has not been initialized yet (JAX reads the env at backend init).
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh():
+    """An 8-device mesh shaped (data=2, model=4) for sharding tests."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    return Mesh(devs, axis_names=("data", "model"))
+
+
+@pytest.fixture(scope="session")
+def tmp_media_dir(tmp_path_factory):
+    """Session-scoped dir of tiny synthetic mp4 fixtures (built on demand by
+    tests.fixtures.media)."""
+    return tmp_path_factory.mktemp("media")

@@ -1,0 +1,168 @@
+"""Core contract tests: tasks, stages, resources, SequentialRunner."""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core import (
+    PipelineConfig,
+    PipelineTask,
+    Resources,
+    SequentialRunner,
+    Stage,
+    StageSpec,
+    run_pipeline,
+)
+from cosmos_curate_tpu.core.stage import fill_default_lifetimes
+
+
+@dataclass
+class NumTask(PipelineTask):
+    value: int = 0
+    payload: bytes = b""
+    arr: np.ndarray | None = None
+
+
+class AddOne(Stage):
+    def process_data(self, tasks):
+        return [NumTask(value=t.value + 1) for t in tasks]
+
+
+class Doubler(Stage):
+    """Dynamic chunking: 1 task in -> 2 tasks out."""
+
+    def process_data(self, tasks):
+        out = []
+        for t in tasks:
+            out.append(NumTask(value=t.value))
+            out.append(NumTask(value=t.value))
+        return out
+
+
+class DropOdd(Stage):
+    def process_data(self, tasks):
+        kept = [t for t in tasks if t.value % 2 == 0]
+        return kept or None
+
+
+class Flaky(Stage):
+    def __init__(self, fail_times: int):
+        self.remaining = fail_times
+
+    def process_data(self, tasks):
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise RuntimeError("transient")
+        return tasks
+
+
+class LifecycleProbe(Stage):
+    def __init__(self):
+        self.events = []
+
+    @property
+    def batch_size(self):
+        return 3
+
+    def setup_on_node(self, node, worker):
+        self.events.append("node")
+
+    def setup(self, worker):
+        self.events.append("setup")
+
+    def process_data(self, tasks):
+        self.events.append(f"process:{len(tasks)}")
+        return tasks
+
+    def destroy(self):
+        self.events.append("destroy")
+
+
+def test_sequential_pipeline_end_to_end():
+    tasks = [NumTask(value=i) for i in range(5)]
+    out = run_pipeline(tasks, [AddOne(), AddOne()], runner=SequentialRunner())
+    assert [t.value for t in out] == [2, 3, 4, 5, 6]
+
+
+def test_dynamic_chunking_and_drop():
+    tasks = [NumTask(value=i) for i in range(4)]
+    out = run_pipeline(tasks, [Doubler(), DropOdd()], runner=SequentialRunner())
+    assert [t.value for t in out] == [0, 0, 2, 2]
+
+
+def test_drop_all_returns_empty():
+    out = run_pipeline([NumTask(value=1)], [DropOdd()], runner=SequentialRunner())
+    assert out == []
+
+
+def test_retry_semantics():
+    stage = Flaky(fail_times=2)
+    spec = StageSpec(stage=stage, num_run_attempts=3)
+    out = run_pipeline([NumTask(value=7)], [spec], runner=SequentialRunner())
+    assert [t.value for t in out] == [7]
+
+    stage2 = Flaky(fail_times=2)
+    with pytest.raises(RuntimeError):
+        run_pipeline(
+            [NumTask(value=7)],
+            [StageSpec(stage=stage2, num_run_attempts=1)],
+            runner=SequentialRunner(),
+        )
+
+
+def test_retry_exhaustion_drops_batch_when_not_raising():
+    stage = Flaky(fail_times=99)
+    spec = StageSpec(stage=stage, num_run_attempts=2)
+    out = run_pipeline(
+        [NumTask(value=7)], [spec], runner=SequentialRunner(raise_on_error=False)
+    )
+    assert out == []
+
+
+def test_lifecycle_order_and_batching():
+    probe = LifecycleProbe()
+    run_pipeline(
+        [NumTask(value=i) for i in range(7)], [probe], runner=SequentialRunner()
+    )
+    assert probe.events == ["node", "setup", "process:3", "process:3", "process:1", "destroy"]
+
+
+def test_get_major_size_counts_payloads():
+    t = NumTask(value=1, payload=b"x" * 1000, arr=np.zeros((10, 10), np.float32))
+    size = t.get_major_size()
+    assert size >= 1000 + 400
+
+
+def test_resources_validation():
+    with pytest.raises(ValueError):
+        Resources(cpus=-1)
+    assert Resources(tpus=4).uses_tpu
+    assert Resources(entire_tpu_host=True).uses_tpu
+    assert not Resources(cpus=2).uses_tpu
+
+
+def test_lifetime_heuristics():
+    class TpuStage(AddOne):
+        @property
+        def resources(self):
+            return Resources(cpus=1, tpus=4)
+
+    class IoStage(AddOne):
+        @property
+        def resources(self):
+            return Resources(cpus=0.25)
+
+    tpu = fill_default_lifetimes(StageSpec(stage=TpuStage()))
+    assert (tpu.worker_max_lifetime_m, tpu.worker_restart_interval_m) == (120, 5)
+    cpu = fill_default_lifetimes(StageSpec(stage=AddOne()))
+    assert (cpu.worker_max_lifetime_m, cpu.worker_restart_interval_m) == (60, 1)
+    io = fill_default_lifetimes(StageSpec(stage=IoStage()))
+    assert io.worker_max_lifetime_m == 0
+
+
+def test_config_defaults_mirror_reference():
+    cfg = PipelineConfig()
+    assert cfg.streaming.autoscale_interval_s == 180.0
+    assert cfg.streaming.max_queued_lower_bound == 16
+    assert cfg.streaming.max_queued_multiplier == 1.5

@@ -1,0 +1,35 @@
+"""Sanity: the test environment exposes 8 virtual CPU devices for sharding
+tests (conftest forces --xla_force_host_platform_device_count=8)."""
+
+
+def test_eight_cpu_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8
+    assert devs[0].platform == "cpu"
+
+
+def test_cpu_mesh_fixture(cpu_mesh):
+    assert cpu_mesh.axis_names == ("data", "model")
+    assert cpu_mesh.devices.shape == (2, 4)
+
+
+def test_psum_over_mesh(cpu_mesh):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jnp.arange(16.0).reshape(8, 2)
+    xs = jax.device_put(x, NamedSharding(cpu_mesh, P(("data", "model"), None)))
+
+    def f(v):
+        return jax.lax.psum(v.sum(), axis_name=("data", "model"))
+
+    out = jax.jit(
+        jax.shard_map(
+            f, mesh=cpu_mesh, in_specs=P(("data", "model"), None), out_specs=P()
+        )
+    )(xs)
+    np.testing.assert_allclose(np.asarray(out), x.sum())
