@@ -1,0 +1,21 @@
+from cosmos_curate_tpu.data.lazy import LazyData
+from cosmos_curate_tpu.data.model import (
+    Clip,
+    ClipStats,
+    ShardPipeTask,
+    SplitPipeTask,
+    Video,
+    VideoMetadata,
+    Window,
+)
+
+__all__ = [
+    "Clip",
+    "ClipStats",
+    "LazyData",
+    "ShardPipeTask",
+    "SplitPipeTask",
+    "Video",
+    "VideoMetadata",
+    "Window",
+]

@@ -1,0 +1,208 @@
+"""Uniform storage abstraction over local disk and object stores.
+
+Equivalent capability of the reference's storage layer
+(cosmos_curate/core/utils/storage/storage_client.py:39-288,
+storage_utils.py:39-1170): one path model covering local paths and
+``s3://`` / ``gs://`` / ``az://`` URLs, a `StorageClient` per backend, and
+module-level convenience helpers that dispatch on the path.
+
+Cloud backends are **gated**: boto3 / google-cloud-storage are not in this
+image, so `S3StorageClient` / `GcsStorageClient` raise a clear error at
+construction unless their SDK is importable. The interface (and all callers)
+are written against `StorageClient`, so enabling a backend is dependency-only.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import queue
+import shutil
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_REMOTE_SCHEMES = ("s3://", "gs://", "az://")
+
+
+def is_remote_path(path: str | os.PathLike[str]) -> bool:
+    return str(path).startswith(_REMOTE_SCHEMES)
+
+
+@dataclass(frozen=True)
+class ObjectInfo:
+    path: str
+    size: int
+
+
+class StorageClient(abc.ABC):
+    """Backend-agnostic byte-level storage operations."""
+
+    @abc.abstractmethod
+    def read_bytes(self, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_bytes(self, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, path: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_files(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None, recursive: bool = True
+    ) -> Iterator[ObjectInfo]: ...
+
+    def list_relative(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None
+    ) -> list[str]:
+        """Paths under ``prefix`` relative to it (reference
+        ``get_files_relative``)."""
+        base = prefix.rstrip("/") + "/"
+        out = []
+        for info in self.list_files(prefix, suffixes=suffixes):
+            p = info.path
+            out.append(p[len(base):] if p.startswith(base) else p)
+        return out
+
+
+class LocalStorageClient(StorageClient):
+    def read_bytes(self, path: str) -> bytes:
+        return Path(path).read_bytes()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_name(p.name + ".tmp")
+        tmp.write_bytes(data)
+        tmp.replace(p)  # atomic on POSIX
+
+    def exists(self, path: str) -> bool:
+        return Path(path).exists()
+
+    def delete(self, path: str) -> None:
+        p = Path(path)
+        if p.is_dir():
+            shutil.rmtree(p)
+        elif p.exists():
+            p.unlink()
+
+    def list_files(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None, recursive: bool = True
+    ) -> Iterator[ObjectInfo]:
+        base = Path(prefix)
+        if base.is_file():
+            yield ObjectInfo(str(base), base.stat().st_size)
+            return
+        if not base.exists():
+            return
+        pattern = "**/*" if recursive else "*"
+        for p in sorted(base.glob(pattern)):
+            if p.is_file() and (suffixes is None or p.suffix.lower() in suffixes):
+                yield ObjectInfo(str(p), p.stat().st_size)
+
+
+class _GatedClient(StorageClient):
+    """Raises a clear error for backends whose SDK is absent."""
+
+    scheme = ""
+    sdk = ""
+
+    def __init__(self) -> None:
+        raise RuntimeError(
+            f"{self.scheme} storage requires the {self.sdk} SDK, which is not "
+            f"installed in this image; stage data locally or install it"
+        )
+
+    def read_bytes(self, path): ...  # pragma: no cover
+    def write_bytes(self, path, data): ...  # pragma: no cover
+    def exists(self, path): ...  # pragma: no cover
+    def delete(self, path): ...  # pragma: no cover
+    def list_files(self, prefix, *, suffixes=None, recursive=True): ...  # pragma: no cover
+
+
+def _make_s3_client() -> StorageClient:
+    try:
+        import boto3  # noqa: F401
+    except ImportError:
+        class S3Gated(_GatedClient):
+            scheme, sdk = "s3://", "boto3"
+
+        return S3Gated()
+    from cosmos_curate_tpu.storage.s3 import S3StorageClient
+
+    return S3StorageClient()
+
+
+def _make_gcs_client() -> StorageClient:
+    try:
+        import google.cloud.storage  # noqa: F401
+    except ImportError:
+        class GcsGated(_GatedClient):
+            scheme, sdk = "gs://", "google-cloud-storage"
+
+        return GcsGated()
+    from cosmos_curate_tpu.storage.gcs import GcsStorageClient
+
+    return GcsStorageClient()
+
+
+_LOCAL = LocalStorageClient()
+
+
+def get_storage_client(path: str | os.PathLike[str]) -> StorageClient:
+    s = str(path)
+    if s.startswith("s3://"):
+        return _make_s3_client()
+    if s.startswith("gs://"):
+        return _make_gcs_client()
+    if s.startswith("az://"):
+        raise RuntimeError("az:// storage not supported in this build")
+    return _LOCAL
+
+
+def read_bytes(path: str | os.PathLike[str]) -> bytes:
+    return get_storage_client(path).read_bytes(str(path))
+
+
+def write_bytes(path: str | os.PathLike[str], data: bytes) -> None:
+    get_storage_client(path).write_bytes(str(path), data)
+
+
+class BackgroundUploader:
+    """Queue writes to a background thread so the hot loop never blocks on
+    storage (reference ``BackgroundUploader``, storage_client.py)."""
+
+    def __init__(self, max_queue: int = 64) -> None:
+        self._q: queue.Queue[tuple[str, bytes] | None] = queue.Queue(maxsize=max_queue)
+        self._errors: list[tuple[str, Exception]] = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            path, data = item
+            try:
+                write_bytes(path, data)
+            except Exception as e:
+                logger.exception("background upload failed: %s", path)
+                self._errors.append((path, e))
+
+    def submit(self, path: str, data: bytes) -> None:
+        self._q.put((path, data))
+
+    def close(self) -> list[tuple[str, Exception]]:
+        """Drain, stop, and return any failures."""
+        self._q.put(None)
+        self._thread.join()
+        return self._errors

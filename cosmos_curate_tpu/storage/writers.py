@@ -1,0 +1,78 @@
+"""Typed output writers over the storage abstraction.
+
+Equivalent capability of the reference's writer helpers
+(cosmos_curate/core/utils/storage/writer_utils.py:62-370): json / jsonl /
+text / csv / parquet / pickle, all routed through ``write_bytes`` so they work
+against any backend and inherit atomic local writes.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import pickle
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from cosmos_curate_tpu.storage.client import write_bytes
+
+
+class _NumpyJSONEncoder(json.JSONEncoder):
+    def default(self, o: Any) -> Any:
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if hasattr(o, "hex") and hasattr(o, "int"):  # uuid.UUID
+            return str(o)
+        return super().default(o)
+
+
+def write_json(path: str, obj: Any, *, indent: int | None = 2) -> None:
+    write_bytes(path, json.dumps(obj, indent=indent, cls=_NumpyJSONEncoder).encode())
+
+
+def write_jsonl(path: str, rows: Iterable[Mapping[str, Any]]) -> None:
+    buf = io.StringIO()
+    for row in rows:
+        buf.write(json.dumps(row, cls=_NumpyJSONEncoder))
+        buf.write("\n")
+    write_bytes(path, buf.getvalue().encode())
+
+
+def write_text(path: str, text: str) -> None:
+    write_bytes(path, text.encode())
+
+
+def write_csv(path: str, rows: Iterable[Mapping[str, Any]], fieldnames: list[str]) -> None:
+    buf = io.StringIO()
+    writer = csv.DictWriter(buf, fieldnames=fieldnames)
+    writer.writeheader()
+    for row in rows:
+        writer.writerow(row)
+    write_bytes(path, buf.getvalue().encode())
+
+
+def write_pickle(path: str, obj: Any) -> None:
+    write_bytes(path, pickle.dumps(obj, protocol=5))
+
+
+def write_parquet(path: str, columns: Mapping[str, Any]) -> None:
+    """Columnar write via pyarrow (available in this image)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table(dict(columns))
+    sink = io.BytesIO()
+    pq.write_table(table, sink)
+    write_bytes(path, sink.getvalue())
+
+
+def write_npy(path: str, arr: np.ndarray) -> None:
+    sink = io.BytesIO()
+    np.save(sink, arr)
+    write_bytes(path, sink.getvalue())

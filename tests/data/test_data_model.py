@@ -1,0 +1,90 @@
+import pickle
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.data import Clip, ClipStats, LazyData, SplitPipeTask, Video, VideoMetadata, Window
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, deterministic_id
+
+
+def test_deterministic_ids_stable_and_distinct():
+    a = deterministic_id("video.mp4", "0.0-5.0")
+    b = deterministic_id("video.mp4", "0.0-5.0")
+    c = deterministic_id("video.mp4", "5.0-10.0")
+    assert a == b
+    assert a != c
+
+
+def test_clip_size_accounting_and_release():
+    clip = Clip(
+        source_video="v.mp4",
+        span=(0.0, 5.0),
+        encoded_data=b"x" * 10_000,
+        extracted_frames={"fps-1": np.zeros((5, 8, 8, 3), np.uint8)},
+    )
+    assert clip.get_major_size() >= 10_000 + 5 * 8 * 8 * 3
+    assert clip.duration_s == 5.0
+    clip.release_frames()
+    assert clip.extracted_frames == {}
+
+
+def test_split_task_weight_and_fraction():
+    video = Video(metadata=VideoMetadata(width=64, height=48, fps=24, num_frames=7200, duration_s=300.0))
+    video.num_clip_chunks = 4
+    t = SplitPipeTask(video=video)
+    assert t.weight == 5.0  # 300s / 60
+    assert t.fraction == 0.25
+
+
+def test_clip_stats_combine():
+    a = ClipStats(num_clips=3, total_clip_duration_s=10.0, max_clip_duration_s=4.0)
+    b = ClipStats(num_clips=2, total_clip_duration_s=6.0, max_clip_duration_s=5.0, num_with_captions=2)
+    a.combine(b)
+    assert a.num_clips == 5
+    assert a.total_clip_duration_s == 16.0
+    assert a.max_clip_duration_s == 5.0
+    assert a.num_with_captions == 2
+
+
+def test_window_release():
+    w = Window(start_frame=0, end_frame=256, mp4_bytes=b"z", frames=np.zeros((2, 2, 2, 3), np.uint8))
+    assert w.num_frames == 256
+    w.release_payloads()
+    assert w.mp4_bytes is None and w.frames is None
+
+
+def test_frame_extraction_signature_key():
+    assert FrameExtractionSignature("fps", 2.0).key() == "fps-2"
+
+
+class TestLazyData:
+    def test_inline_roundtrip(self):
+        ld = LazyData(value=b"payload")
+        assert ld.is_inline and not ld.is_stored
+        assert ld.get() == b"payload"
+        ld2 = pickle.loads(pickle.dumps(ld))
+        assert ld2.get() == b"payload"
+
+    def test_store_and_reload(self, tmp_path):
+        ld = LazyData(value=b"big" * 100)
+        p = str(tmp_path / "blob.bin")
+        ld.store(p)
+        assert ld.is_stored and not ld.is_inline
+        # pickled form carries only the path
+        ld2 = pickle.loads(pickle.dumps(ld))
+        assert not ld2.is_inline
+        assert ld2.get() == b"big" * 100
+
+    def test_cleared_raises(self):
+        ld = LazyData(value=b"x")
+        ld.clear()
+        with pytest.raises(RuntimeError):
+            ld.get()
+
+    def test_requires_value_or_path(self):
+        with pytest.raises(ValueError):
+            LazyData()
+
+    def test_nbytes(self):
+        assert LazyData(value=b"abc").nbytes() == 3
+        assert LazyData(value=np.zeros(4, np.float64)).nbytes() == 32

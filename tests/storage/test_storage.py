@@ -1,0 +1,91 @@
+import json
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.storage import (
+    get_storage_client,
+    is_remote_path,
+    read_bytes,
+    write_bytes,
+)
+from cosmos_curate_tpu.storage.client import BackgroundUploader, LocalStorageClient
+from cosmos_curate_tpu.storage import writers
+
+
+def test_path_model():
+    assert is_remote_path("s3://bucket/key")
+    assert is_remote_path("gs://bucket/key")
+    assert not is_remote_path("/data/x.mp4")
+    assert isinstance(get_storage_client("/tmp/x"), LocalStorageClient)
+
+
+def test_gated_s3_backend_raises_clearly():
+    with pytest.raises(RuntimeError, match="boto3"):
+        get_storage_client("s3://bucket/key")
+
+
+def test_local_roundtrip_and_atomicity(tmp_path):
+    p = tmp_path / "a" / "b" / "f.bin"  # parents auto-created
+    write_bytes(str(p), b"hello")
+    assert read_bytes(str(p)) == b"hello"
+    assert not p.with_name("f.bin.tmp").exists()
+
+
+def test_list_files_and_relative(tmp_path):
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "a.mp4").write_bytes(b"1")
+    (tmp_path / "b.txt").write_bytes(b"22")
+    (tmp_path / "sub" / "c.mp4").write_bytes(b"333")
+    client = LocalStorageClient()
+    mp4s = list(client.list_files(str(tmp_path), suffixes=(".mp4",)))
+    assert [i.path.split("/")[-1] for i in mp4s] == ["a.mp4", "c.mp4"]
+    assert mp4s[1].size == 3
+    rel = client.list_relative(str(tmp_path), suffixes=(".mp4",))
+    assert rel == ["a.mp4", "sub/c.mp4"]
+    shallow = list(client.list_files(str(tmp_path), recursive=False))
+    assert len(shallow) == 2
+
+
+def test_delete(tmp_path):
+    client = LocalStorageClient()
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"1")
+    client.delete(str(f))
+    assert not f.exists()
+    d = tmp_path / "dir"
+    (d / "nested").mkdir(parents=True)
+    client.delete(str(d))
+    assert not d.exists()
+
+
+def test_background_uploader(tmp_path):
+    up = BackgroundUploader()
+    for i in range(10):
+        up.submit(str(tmp_path / f"f{i}.bin"), bytes([i]))
+    errors = up.close()
+    assert errors == []
+    assert read_bytes(str(tmp_path / "f7.bin")) == b"\x07"
+
+
+def test_writers(tmp_path):
+    writers.write_json(str(tmp_path / "o.json"), {"a": np.int64(3), "b": np.float32(0.5)})
+    assert json.loads(read_bytes(str(tmp_path / "o.json"))) == {"a": 3, "b": 0.5}
+
+    writers.write_jsonl(str(tmp_path / "o.jsonl"), [{"i": i} for i in range(3)])
+    lines = read_bytes(str(tmp_path / "o.jsonl")).decode().splitlines()
+    assert [json.loads(line)["i"] for line in lines] == [0, 1, 2]
+
+    writers.write_csv(str(tmp_path / "o.csv"), [{"x": 1, "y": 2}], ["x", "y"])
+    assert read_bytes(str(tmp_path / "o.csv")).decode().splitlines()[1] == "1,2"
+
+    writers.write_parquet(str(tmp_path / "o.parquet"), {"ids": [1, 2], "vals": [0.1, 0.2]})
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(str(tmp_path / "o.parquet"))
+    assert table.column("ids").to_pylist() == [1, 2]
+
+    writers.write_npy(str(tmp_path / "o.npy"), np.arange(5))
+    import io
+
+    assert np.array_equal(np.load(io.BytesIO(read_bytes(str(tmp_path / "o.npy")))), np.arange(5))
