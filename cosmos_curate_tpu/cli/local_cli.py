@@ -1,0 +1,76 @@
+"""`cosmos-curate-tpu local …` — run pipelines on this host.
+
+Equivalent of the reference's local CLI + pipeline entry
+(cosmos_curate/client/local_cli/, pipelines/video/run_pipeline.py:51-101),
+with the same dual invocation: flags or a YAML/JSON config file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    local = sub.add_parser("local", help="run pipelines on this host")
+    lsub = local.add_subparsers(dest="subcommand", metavar="pipeline")
+
+    hello = lsub.add_parser("hello", help="hello-world example pipeline")
+    hello.set_defaults(func=_cmd_hello)
+
+    split = lsub.add_parser("split", help="split-annotate videos into curated clips")
+    split.add_argument("--input-path", required=False, default="", help="videos dir or config file")
+    split.add_argument("--output-path", default="")
+    split.add_argument("--config", default="", help="YAML/JSON config (alternative to flags)")
+    split.add_argument("--limit", type=int, default=0)
+    split.add_argument("--splitting-algorithm", choices=["fixed-stride", "transnetv2"], default="fixed-stride")
+    split.add_argument("--fixed-stride-len-s", type=float, default=10.0)
+    split.add_argument("--min-clip-len-s", type=float, default=2.0)
+    split.add_argument("--motion-filter", choices=["disable", "score-only", "enable"], default="disable")
+    split.add_argument("--aesthetic-threshold", type=float, default=None)
+    split.add_argument("--embedding-model", choices=["", "clip", "video"], default="")
+    split.add_argument("--captioning", action="store_true")
+    split.add_argument("--clip-chunk-size", type=int, default=64)
+    split.add_argument("--sequential", action="store_true", help="run in-process (no engine)")
+    split.set_defaults(func=_cmd_split)
+
+    local.set_defaults(func=lambda args: (local.print_help(), 2)[1])
+
+
+def _cmd_hello(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.pipelines.examples.hello_world import run_hello_world
+
+    for task in run_hello_world():
+        print(f"{task.text!r} score={task.score:.4f} device={task.device}")
+    return 0
+
+
+def _cmd_split(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+    if args.config:
+        from cosmos_curate_tpu.utils.config import load_pipeline_config
+
+        pargs = load_pipeline_config(args.config, SplitPipelineArgs)
+    else:
+        if not args.input_path or not args.output_path:
+            print("error: --input-path and --output-path (or --config) are required")
+            return 2
+        pargs = SplitPipelineArgs(
+            input_path=args.input_path,
+            output_path=args.output_path,
+            limit=args.limit,
+            splitting_algorithm=args.splitting_algorithm,
+            fixed_stride_len_s=args.fixed_stride_len_s,
+            min_clip_len_s=args.min_clip_len_s,
+            motion_filter=args.motion_filter,
+            aesthetic_threshold=args.aesthetic_threshold,
+            embedding_model=args.embedding_model,
+            captioning=args.captioning,
+            clip_chunk_size=args.clip_chunk_size,
+        )
+    runner = SequentialRunner() if args.sequential else None
+    summary = run_split(pargs, runner=runner)
+    print(json.dumps(summary, indent=2))
+    return 0

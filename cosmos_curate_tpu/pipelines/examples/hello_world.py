@@ -1,0 +1,88 @@
+"""Hello-world pipeline: the smallest end-to-end example.
+
+Equivalent of the reference's hello-world example
+(cosmos_curate/pipelines/examples/hello_world_pipeline.py): a CPU stage
+uppercases text, then a tiny JAX model stage (GPT2-class scoring is the
+reference's demo; ours runs a jitted token-sum "model" so the example works
+on any device including a real TPU) annotates each task.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.runner import RunnerInterface, SequentialRunner
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+
+@dataclass
+class HelloTask(PipelineTask):
+    text: str = ""
+    score: float | None = None
+    device: str = ""
+
+
+class UppercaseStage(Stage[HelloTask, HelloTask]):
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    def process_data(self, tasks: list[HelloTask]) -> list[HelloTask]:
+        for t in tasks:
+            t.text = t.text.upper()
+        return tasks
+
+
+class JaxScoreStage(Stage[HelloTask, HelloTask]):
+    """Scores text with a jitted device computation (demo of the device
+    boundary: host bytes -> device array -> jit -> host scalar)."""
+
+    def __init__(self) -> None:
+        self._fn = None
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    @property
+    def batch_size(self) -> int:
+        return 8
+
+    def setup(self, worker) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def score(tokens):
+            return jnp.tanh(tokens.astype(jnp.float32) / 128.0).mean(axis=-1)
+
+        self._fn = score
+        self._device = jax.devices()[0].platform
+
+    def process_data(self, tasks: list[HelloTask]) -> list[HelloTask]:
+        import numpy as np
+
+        batch = np.zeros((len(tasks), 64), np.uint8)
+        for i, t in enumerate(tasks):
+            raw = t.text.encode()[:64]
+            batch[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+        scores = np.asarray(self._fn(batch))
+        for t, s in zip(tasks, scores):
+            t.score = float(s)
+            t.device = self._device
+        return tasks
+
+
+def run_hello_world(
+    texts: list[str] | None = None, runner: RunnerInterface | None = None
+) -> list[HelloTask]:
+    texts = texts or [f"hello world {i}" for i in range(10)]
+    tasks = [HelloTask(text=t) for t in texts]
+    out = run_pipeline(
+        tasks,
+        [UppercaseStage(), JaxScoreStage()],
+        runner=runner or SequentialRunner(),
+    )
+    return out or []

@@ -1,0 +1,43 @@
+"""Video download + probe stage.
+
+Equivalent capability of the reference's ``VideoDownloader``
+(cosmos_curate/pipelines/video/read_write/download_stages.py:44): fetch raw
+bytes from any storage backend, probe metadata, record per-item errors on the
+task instead of raising (containment model, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.storage.client import read_bytes
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.decode import extract_video_metadata
+
+logger = get_logger(__name__)
+
+
+class VideoDownloadStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """IO stage: fractional CPU so many workers overlap network latency."""
+
+    def __init__(self, *, probe_metadata: bool = True) -> None:
+        self.probe_metadata = probe_metadata
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        for task in tasks:
+            video = task.video
+            try:
+                video.raw_bytes = read_bytes(video.path)
+                if self.probe_metadata:
+                    video.metadata = extract_video_metadata(video.raw_bytes)
+                    video.metadata.size_bytes = len(video.raw_bytes)
+                    if not video.metadata.is_valid:
+                        video.errors["download"] = "invalid or empty video stream"
+            except Exception as e:
+                logger.warning("failed to fetch %s: %s", video.path, e)
+                video.errors["download"] = str(e)
+        return tasks

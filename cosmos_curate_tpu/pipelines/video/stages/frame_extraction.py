@@ -1,0 +1,55 @@
+"""Clip frame-extraction stage: the CPU prep that feeds every TPU stage.
+
+Equivalent capability of the reference's ``ClipFrameExtractionStage``
+(cosmos_curate/pipelines/video/clipping/clip_frame_extraction_stages.py:43):
+decode each clip's mp4 once per ``FrameExtractionSignature`` and cache the
+frames on the clip so downstream device stages (embedding, aesthetics,
+captioning prep) reuse them. The TPU-first reason this stage exists apart
+from the model stages: decode is CPU-bound and autoscales independently of
+chip-bound inference (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.decode import extract_frames_at_fps
+
+logger = get_logger(__name__)
+
+
+class ClipFrameExtractionStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        signatures: tuple[FrameExtractionSignature, ...] = (FrameExtractionSignature("fps", 2.0),),
+        resize_hw: tuple[int, int] | None = None,
+        num_cpus: float = 3.0,
+    ) -> None:
+        self.signatures = signatures
+        self.resize_hw = resize_hw
+        self.num_cpus = num_cpus
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=self.num_cpus)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        for task in tasks:
+            for clip in task.video.clips:
+                if clip.encoded_data is None:
+                    continue
+                for sig in self.signatures:
+                    try:
+                        frames = extract_frames_at_fps(
+                            clip.encoded_data, target_fps=sig.target_fps, resize_hw=self.resize_hw
+                        )
+                        if frames.size == 0:
+                            clip.errors[f"frames-{sig.key()}"] = "no frames decoded"
+                            continue
+                        clip.extracted_frames[sig.key()] = frames
+                    except Exception as e:
+                        logger.warning("frame extraction failed for %s: %s", clip.uuid, e)
+                        clip.errors[f"frames-{sig.key()}"] = str(e)
+        return tasks

@@ -1,0 +1,153 @@
+"""Clip writer stage: persists pipeline output in the curated layout.
+
+Equivalent capability of the reference's ``ClipWriterStage``
+(cosmos_curate/pipelines/video/read_write/metadata_writer_stage.py:66) and
+output layout (docs/curator/reference/VIDEO_PIPELINES.md:56-91):
+
+    <output>/clips/<clip-uuid>.mp4           transcoded clip
+    <output>/previews/<clip-uuid>.webp       preview (when produced)
+    <output>/metas/v0/<clip-uuid>.json       clip metadata + captions + scores
+    <output>/embeddings/<model>/<chunk>.parquet   clip embeddings
+    <output>/processed_videos/<video-id>.json     resume record
+
+Writing the resume record **last** is the crash-safety contract: a video is
+only skipped on re-run if all its chunks finished writing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import defaultdict
+
+import numpy as np
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import Clip, ClipStats, SplitPipeTask
+from cosmos_curate_tpu.storage.client import write_bytes
+from cosmos_curate_tpu.storage.writers import write_json, write_parquet
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def video_record_id(path: str) -> str:
+    return hashlib.sha256(path.encode()).hexdigest()[:24]
+
+
+def _clip_meta(clip: Clip) -> dict:
+    return {
+        "uuid": str(clip.uuid),
+        "source_video": clip.source_video,
+        "span_start": clip.span[0],
+        "span_end": clip.span[1],
+        "duration_s": clip.duration_s,
+        "codec": clip.encoding_codec,
+        "motion_score_global": clip.motion_score_global,
+        "motion_score_per_patch_min": clip.motion_score_per_patch_min,
+        "aesthetic_score": clip.aesthetic_score,
+        "artificial_text_score": clip.artificial_text_score,
+        "semantic_pass": clip.semantic_pass,
+        "filtered_by": clip.filtered_by,
+        "embedding_models": sorted(clip.embeddings),
+        "windows": [
+            {
+                "start_frame": w.start_frame,
+                "end_frame": w.end_frame,
+                "captions": w.caption,
+                "enhanced_captions": w.enhanced_caption,
+            }
+            for w in clip.windows
+        ],
+        "errors": clip.errors,
+    }
+
+
+class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(self, output_path: str, *, write_embeddings: bool = True, write_previews: bool = True) -> None:
+        self.output_path = output_path.rstrip("/")
+        self.write_embeddings = write_embeddings
+        self.write_previews = write_previews
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        for task in tasks:
+            video = task.video
+            stats = ClipStats()
+            embedding_rows: dict[str, list[tuple[str, np.ndarray]]] = defaultdict(list)
+            for clip in video.clips:
+                self._write_clip(clip, stats, embedding_rows)
+            for clip in video.filtered_clips:
+                stats.num_clips += 1
+                self._count_filtered(clip, stats)
+                write_json(f"{self.output_path}/metas/filtered/{clip.uuid}.json", _clip_meta(clip))
+            if self.write_embeddings:
+                chunk_tag = f"{video_record_id(video.path)}-{video.clip_chunk_index:05d}"
+                for model, rows in embedding_rows.items():
+                    write_parquet(
+                        f"{self.output_path}/embeddings/{model}/{chunk_tag}.parquet",
+                        {
+                            "clip_uuid": [r[0] for r in rows],
+                            "embedding": [r[1].astype(np.float32).tolist() for r in rows],
+                        },
+                    )
+            self._write_resume_record(task)
+            # Free payloads: downstream (engine) only needs stats/metadata.
+            for clip in video.clips:
+                clip.encoded_data = None
+                clip.release_frames()
+                for w in clip.windows:
+                    w.release_payloads()
+            task.stage_perf["clips_written"] = stats.num_clips
+            task.stats = stats
+        return tasks
+
+    def _write_clip(self, clip: Clip, stats: ClipStats, embedding_rows) -> None:
+        stats.num_clips += 1
+        stats.total_clip_duration_s += clip.duration_s
+        stats.max_clip_duration_s = max(stats.max_clip_duration_s, clip.duration_s)
+        if clip.encoded_data:
+            write_bytes(f"{self.output_path}/clips/{clip.uuid}.mp4", clip.encoded_data)
+            stats.num_transcoded += 1
+        if clip.webp_preview and self.write_previews:
+            write_bytes(f"{self.output_path}/previews/{clip.uuid}.webp", clip.webp_preview)
+            stats.num_with_webp += 1
+        for model, emb in clip.embeddings.items():
+            embedding_rows[model].append((str(clip.uuid), emb))
+        if clip.embeddings:
+            stats.num_with_embeddings += 1
+        if any(w.caption for w in clip.windows):
+            stats.num_with_captions += 1
+        write_json(f"{self.output_path}/metas/v0/{clip.uuid}.json", _clip_meta(clip))
+
+    @staticmethod
+    def _count_filtered(clip: Clip, stats: ClipStats) -> None:
+        key = clip.filtered_by
+        if key == "motion":
+            stats.num_filtered_by_motion += 1
+        elif key == "aesthetic":
+            stats.num_filtered_by_aesthetic += 1
+        elif key == "text":
+            stats.num_filtered_by_text += 1
+        elif key == "semantic":
+            stats.num_filtered_by_semantic += 1
+
+    def _write_resume_record(self, task: SplitPipeTask) -> None:
+        # One record per chunk (chunks of a video may be written by different
+        # workers on different nodes); a video counts as processed when the
+        # number of chunk records matches num_chunks (input_discovery checks).
+        video = task.video
+        vid = video_record_id(video.path)
+        write_json(
+            f"{self.output_path}/processed_videos/{vid}/chunk-{video.clip_chunk_index:05d}.json",
+            {
+                "path": video.path,
+                "chunk_index": video.clip_chunk_index,
+                "num_chunks": video.num_clip_chunks,
+                "num_clips_total": video.num_total_clips,
+                "duration_s": video.metadata.duration_s,
+                "errors": video.errors,
+            },
+        )

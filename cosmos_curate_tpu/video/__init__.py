@@ -1,0 +1,24 @@
+import os as _os
+
+# Silence FFmpeg's logger before cv2 loads it (AV_LOG_QUIET=-8); the encoder
+# preference probe intentionally trips unavailable codecs.
+_os.environ.setdefault("OPENCV_FFMPEG_LOGLEVEL", "-8")
+
+from cosmos_curate_tpu.video.decode import (
+    decode_frames,
+    extract_frames_at_fps,
+    extract_video_metadata,
+)
+from cosmos_curate_tpu.video.encode import encode_frames, transcode_clip
+from cosmos_curate_tpu.video.splitter import fixed_stride_spans
+from cosmos_curate_tpu.video.windowing import compute_windows
+
+__all__ = [
+    "compute_windows",
+    "decode_frames",
+    "encode_frames",
+    "extract_frames_at_fps",
+    "extract_video_metadata",
+    "fixed_stride_spans",
+    "transcode_clip",
+]

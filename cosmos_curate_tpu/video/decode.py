@@ -1,0 +1,178 @@
+"""CPU video decode: metadata probing and frame extraction.
+
+Equivalent capability of the reference's decoder layer
+(cosmos_curate/pipelines/video/utils/decoder_utils.py:
+``extract_video_metadata``:120, ``decode_video_cpu``:505,
+``extract_frames``:611) built on OpenCV's FFmpeg backend instead of PyAV
+(not in this image). Decode is deliberately CPU-side — there is no TPU video
+engine (SURVEY.md §2.7), so throughput comes from many decode workers feeding
+batched device stages.
+
+All entry points accept either a path or encoded ``bytes`` (served through a
+memfd so nothing touches disk).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import cv2
+import numpy as np
+
+from cosmos_curate_tpu.data.model import VideoMetadata
+from cosmos_curate_tpu.utils.memfd import buffer_as_path
+
+
+@contextlib.contextmanager
+def _open_capture(source: str | bytes) -> Iterator[cv2.VideoCapture]:
+    with contextlib.ExitStack() as stack:
+        if isinstance(source, (bytes, bytearray, memoryview)):
+            path = stack.enter_context(buffer_as_path(bytes(source)))
+        else:
+            path = str(source)
+        cap = cv2.VideoCapture(path)
+        try:
+            if not cap.isOpened():
+                raise ValueError(f"could not open video source ({len(source) if isinstance(source, (bytes, bytearray)) else path})")
+            yield cap
+        finally:
+            cap.release()
+
+
+def extract_video_metadata(source: str | bytes) -> VideoMetadata:
+    """Probe width/height/fps/frame-count/duration."""
+    size = len(source) if isinstance(source, (bytes, bytearray)) else 0
+    with _open_capture(source) as cap:
+        fps = float(cap.get(cv2.CAP_PROP_FPS)) or 0.0
+        n = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
+        fourcc = int(cap.get(cv2.CAP_PROP_FOURCC))
+        codec = "".join(chr((fourcc >> (8 * i)) & 0xFF) for i in range(4)).strip("\x00 ")
+        return VideoMetadata(
+            width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
+            height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
+            fps=fps,
+            num_frames=n,
+            duration_s=(n / fps) if fps > 0 else 0.0,
+            codec=codec,
+            size_bytes=size,
+        )
+
+
+def decode_frames(
+    source: str | bytes,
+    *,
+    start_frame: int = 0,
+    num_frames: int | None = None,
+    stride: int = 1,
+    resize_hw: tuple[int, int] | None = None,
+) -> np.ndarray:
+    """Decode frames to RGB uint8 ``[T, H, W, 3]``.
+
+    Sequential read with frame skipping (seek via CAP_PROP_POS_FRAMES is
+    unreliable across codecs, so we always roll forward).
+    """
+    frames: list[np.ndarray] = []
+    with _open_capture(source) as cap:
+        idx = 0
+        wanted = start_frame
+        while True:
+            ok = cap.grab()
+            if not ok:
+                break
+            if idx == wanted:
+                ok, bgr = cap.retrieve()
+                if not ok:
+                    break
+                if resize_hw is not None:
+                    bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
+                frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+                if num_frames is not None and len(frames) >= num_frames:
+                    break
+                wanted += stride
+            idx += 1
+    if not frames:
+        return np.zeros((0, 0, 0, 3), np.uint8)
+    return np.stack(frames)
+
+
+def decode_frame_ids(
+    source: str | bytes,
+    frame_ids: list[int],
+    *,
+    resize_hw: tuple[int, int] | None = None,
+) -> np.ndarray:
+    """Decode an explicit sorted list of frame indices (reference
+    ``decode_video_cpu_frame_ids``:389)."""
+    targets = sorted(set(frame_ids))
+    out: dict[int, np.ndarray] = {}
+    with _open_capture(source) as cap:
+        idx = 0
+        ti = 0
+        while ti < len(targets):
+            ok = cap.grab()
+            if not ok:
+                break
+            if idx == targets[ti]:
+                ok, bgr = cap.retrieve()
+                if not ok:
+                    break
+                if resize_hw is not None:
+                    bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
+                out[idx] = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+                ti += 1
+            idx += 1
+    if not out:
+        return np.zeros((0, 0, 0, 3), np.uint8)
+    return np.stack([out[i] for i in targets if i in out])
+
+
+def extract_frames_at_fps(
+    source: str | bytes,
+    *,
+    target_fps: float = 1.0,
+    resize_hw: tuple[int, int] | None = None,
+) -> np.ndarray:
+    """Uniformly sample frames at ``target_fps`` (the frame-extraction stage's
+    core op, clip_frame_extraction_stages.py:43 in the reference).
+
+    Single decoder open: the source fps is read off the already-open capture
+    (a second probe open would double the memfd copies on the hot CPU path).
+    """
+    frames: list[np.ndarray] = []
+    try:
+        with _open_capture(source) as cap:
+            fps = float(cap.get(cv2.CAP_PROP_FPS))
+            if fps <= 0:
+                return np.zeros((0, 0, 0, 3), np.uint8)
+            stride = max(1, round(fps / target_fps))
+            idx = 0
+            wanted = 0
+            while True:
+                ok = cap.grab()
+                if not ok:
+                    break
+                if idx == wanted:
+                    ok, bgr = cap.retrieve()
+                    if not ok:
+                        break
+                    if resize_hw is not None:
+                        bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
+                    frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+                    wanted += stride
+                idx += 1
+    except ValueError:
+        return np.zeros((0, 0, 0, 3), np.uint8)
+    if not frames:
+        return np.zeros((0, 0, 0, 3), np.uint8)
+    return np.stack(frames)
+
+
+def get_frame_timestamps(source: str | bytes) -> np.ndarray:
+    """Per-frame presentation timestamps in seconds (reference
+    ``get_video_timestamps``:230). Constant-rate assumption when the
+    container lacks per-frame PTS."""
+    meta = extract_video_metadata(source)
+    if meta.fps <= 0:
+        return np.zeros(0, np.float64)
+    return np.arange(meta.num_frames, dtype=np.float64) / meta.fps

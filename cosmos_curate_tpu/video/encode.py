@@ -1,0 +1,117 @@
+"""CPU video encode / per-clip transcode.
+
+Equivalent capability of the reference's ``ClipTranscodingStage`` encode core
+(cosmos_curate/pipelines/video/clipping/clip_extraction_stages.py:167):
+extract a clip's span from the source and re-encode it as a standalone mp4.
+Uses cv2's FFmpeg writer; codec is negotiated from a preference list because
+encoder availability differs per image (h264 is absent here; mp4v works).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import cv2
+import numpy as np
+
+from cosmos_curate_tpu.video.decode import _open_capture
+
+_CODEC_PREFERENCE = ("avc1", "mp4v")
+_negotiated: str | None = None
+
+
+def _pick_codec() -> str:
+    global _negotiated
+    if _negotiated is not None:
+        return _negotiated
+    try:
+        prev = cv2.utils.logging.getLogLevel()
+        cv2.utils.logging.setLogLevel(cv2.utils.logging.LOG_LEVEL_SILENT)
+    except AttributeError:
+        prev = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".mp4") as f:
+            for cc in _CODEC_PREFERENCE:
+                w = cv2.VideoWriter(f.name, cv2.VideoWriter_fourcc(*cc), 24.0, (16, 16))
+                ok = w.isOpened()
+                w.release()
+                if ok:
+                    _negotiated = cc
+                    return cc
+    finally:
+        if prev is not None:
+            cv2.utils.logging.setLogLevel(prev)
+    raise RuntimeError("no usable mp4 encoder in cv2 build")
+
+
+def encode_frames(frames: np.ndarray, fps: float) -> bytes:
+    """Encode RGB uint8 ``[T, H, W, 3]`` frames into an mp4 container."""
+    if frames.ndim != 4 or frames.shape[-1] != 3:
+        raise ValueError(f"expected [T,H,W,3] RGB frames, got {frames.shape}")
+    codec = _pick_codec()
+    t, h, w, _ = frames.shape
+    # cv2's writer requires a real file path (no memfd: it re-opens by name).
+    fd, path = tempfile.mkstemp(suffix=".mp4")
+    os.close(fd)
+    try:
+        writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+        if not writer.isOpened():
+            raise RuntimeError(f"encoder {codec} failed to open for {w}x{h}@{fps}")
+        for i in range(t):
+            writer.write(cv2.cvtColor(frames[i], cv2.COLOR_RGB2BGR))
+        writer.release()
+        with open(path, "rb") as f:
+            return f.read()
+    finally:
+        os.unlink(path)
+
+
+def transcode_clip(
+    source: str | bytes,
+    span_s: tuple[float, float],
+    *,
+    resize_hw: tuple[int, int] | None = None,
+) -> tuple[bytes, str]:
+    """Cut ``span_s`` (seconds) out of ``source`` and re-encode standalone.
+
+    Returns (mp4 bytes, codec fourcc). Decode and encode stream frame-by-
+    frame so a 5-hour source never fully materializes.
+    """
+    codec = _pick_codec()
+    with _open_capture(source) as cap:
+        fps = float(cap.get(cv2.CAP_PROP_FPS)) or 24.0
+        start_f = int(span_s[0] * fps)
+        end_f = int(span_s[1] * fps)
+        fd, path = tempfile.mkstemp(suffix=".mp4")
+        os.close(fd)
+        writer = None
+        try:
+            idx = 0
+            while idx < end_f:
+                ok = cap.grab()
+                if not ok:
+                    break
+                if idx >= start_f:
+                    ok, bgr = cap.retrieve()
+                    if not ok:
+                        break
+                    if resize_hw is not None:
+                        bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
+                    if writer is None:
+                        h, w = bgr.shape[:2]
+                        writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+                        if not writer.isOpened():
+                            raise RuntimeError(f"encoder {codec} failed to open")
+                    writer.write(bgr)
+                idx += 1
+            if writer is None:
+                return b"", codec
+            writer.release()
+            writer = None
+            with open(path, "rb") as f:
+                return f.read(), codec
+        finally:
+            if writer is not None:
+                writer.release()
+            os.unlink(path)

@@ -1,0 +1,87 @@
+"""Span math for clip extraction.
+
+Equivalent capability of the reference's ``FixedStrideExtractorStage`` span
+logic (cosmos_curate/pipelines/video/clipping/clip_extraction_stages.py:664
+and :554 uuid chains) plus the scene-span filtering/cropping applied after
+shot detection (transnetv2_extraction_stages.py:264-365).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.data.model import Clip, deterministic_id
+
+
+def fixed_stride_spans(
+    duration_s: float,
+    *,
+    clip_len_s: float = 10.0,
+    stride_s: float | None = None,
+    min_clip_len_s: float = 2.0,
+) -> list[tuple[float, float]]:
+    """Fixed-duration spans over ``[0, duration_s)``; the last partial span is
+    kept only if at least ``min_clip_len_s`` long."""
+    if duration_s <= 0 or clip_len_s <= 0:
+        return []
+    stride = stride_s if stride_s is not None else clip_len_s
+    if stride <= 0:
+        raise ValueError("stride must be positive")
+    spans = []
+    t = 0.0
+    while t < duration_s:
+        end = min(t + clip_len_s, duration_s)
+        if end - t >= min_clip_len_s:
+            spans.append((t, end))
+        t += stride
+    return spans
+
+
+def scene_spans_from_predictions(
+    predictions: np.ndarray,
+    fps: float,
+    *,
+    threshold: float = 0.4,
+    min_scene_len_s: float = 2.0,
+    max_scene_len_s: float = 60.0,
+    crop_s: float = 0.0,
+) -> list[tuple[float, float]]:
+    """Turn per-frame shot-transition probabilities into scene spans.
+
+    - frames with probability ≥ threshold are cut points;
+    - scenes shorter than ``min_scene_len_s`` are dropped;
+    - scenes longer than ``max_scene_len_s`` are split into max-length pieces;
+    - ``crop_s`` is trimmed off both ends (transition blur guard).
+    Mirrors the reference's post-processing semantics
+    (transnetv2_extraction_stages.py:264-365).
+    """
+    if predictions.size == 0 or fps <= 0:
+        return []
+    cuts = np.flatnonzero(predictions >= threshold)
+    boundaries = [0, *(int(c) + 1 for c in cuts), int(predictions.size)]
+    spans: list[tuple[float, float]] = []
+    for a, b in zip(boundaries[:-1], boundaries[1:]):
+        if b <= a:
+            continue
+        start, end = a / fps + crop_s, b / fps - crop_s
+        if end - start < min_scene_len_s:
+            continue
+        while end - start > max_scene_len_s:
+            spans.append((start, start + max_scene_len_s))
+            start += max_scene_len_s
+        if end - start >= min_scene_len_s:
+            spans.append((start, end))
+    return spans
+
+
+def make_clips(source_video: str, spans: list[tuple[float, float]]) -> list[Clip]:
+    """Build ``Clip`` objects with deterministic uuid5 ids so re-runs and
+    resume produce identical identities."""
+    return [
+        Clip(
+            uuid=deterministic_id(source_video, f"{s:.6f}-{e:.6f}"),
+            source_video=source_video,
+            span=(s, e),
+        )
+        for s, e in spans
+    ]

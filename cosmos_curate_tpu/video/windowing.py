@@ -1,0 +1,66 @@
+"""Caption-window math: the data-layer answer to long context.
+
+Equivalent capability of the reference's ``compute_windows``
+(cosmos_curate/pipelines/video/utils/windowing_utils.py:53-89): a clip's
+frames are cut into fixed windows (default 256 frames); a trailing remainder
+shorter than ``remainder_threshold`` merges into the previous window instead
+of forming a runt. This is how the system scales sequence length without
+in-model attention sharding (SURVEY.md §5); in-model long context is handled
+separately by ring attention (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+
+def compute_windows(
+    num_frames: int,
+    *,
+    window_len: int = 256,
+    remainder_threshold: int = 128,
+) -> list[tuple[int, int]]:
+    """Return [start, end) frame windows covering ``num_frames``.
+
+    The final window absorbs a short remainder (< threshold); a remainder
+    ≥ threshold becomes its own window.
+    """
+    if num_frames <= 0 or window_len <= 0:
+        return []
+    if remainder_threshold > window_len:
+        raise ValueError("remainder_threshold must be <= window_len")
+    windows = []
+    start = 0
+    while start + window_len <= num_frames:
+        windows.append((start, start + window_len))
+        start += window_len
+    rem = num_frames - start
+    if rem > 0:
+        if windows and rem < remainder_threshold:
+            windows[-1] = (windows[-1][0], num_frames)
+        else:
+            windows.append((start, num_frames))
+    return windows
+
+
+def overlapping_windows(
+    num_frames: int,
+    *,
+    window_len: int = 128,
+    overlap: int = 64,
+) -> list[tuple[int, int]]:
+    """Overlapped windows for super-resolution-style blending (reference
+    SR path: 128-frame windows, 64-frame overlap,
+    inference_seedvr2_window.py:483-530)."""
+    if num_frames <= 0:
+        return []
+    if overlap >= window_len:
+        raise ValueError("overlap must be < window_len")
+    step = window_len - overlap
+    windows = []
+    start = 0
+    while True:
+        end = min(start + window_len, num_frames)
+        windows.append((start, end))
+        if end >= num_frames:
+            break
+        start += step
+    return windows

@@ -1,0 +1,55 @@
+"""Synthetic media fixtures (the image has no stock test videos; the
+reference ships tiny real mp4s — we generate equivalents with cv2)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import cv2
+import numpy as np
+
+SCENE_COLORS = [(255, 40, 40), (40, 255, 40), (40, 40, 255), (240, 240, 40)]
+
+
+def make_scene_video(
+    path: str | Path,
+    *,
+    scene_len_frames: int = 24,
+    num_scenes: int = 3,
+    fps: float = 24.0,
+    size_wh: tuple[int, int] = (96, 64),
+    moving_box: bool = True,
+) -> str:
+    """A video of ``num_scenes`` solid-color scenes with hard cuts at known
+    frame boundaries; optionally a small moving box for nonzero motion."""
+    w, h = size_wh
+    writer = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    assert writer.isOpened()
+    rng = np.random.default_rng(0)
+    for s in range(num_scenes):
+        color = SCENE_COLORS[s % len(SCENE_COLORS)]
+        bgr = color[::-1]  # cv2 writes BGR; colors are declared as RGB
+        for f in range(scene_len_frames):
+            frame = np.zeros((h, w, 3), np.uint8)
+            frame[:] = bgr
+            if moving_box:
+                x = (f * 3) % max(1, w - 16)
+                y = (s * 7 + f) % max(1, h - 16)
+                frame[y : y + 12, x : x + 12] = 255 - np.array(bgr, np.uint8)
+            # slight noise so encoders don't collapse frames entirely
+            noise = rng.integers(0, 6, (h, w, 3), np.uint8)
+            frame = cv2.add(frame, noise)
+            writer.write(frame)
+    writer.release()
+    return str(path)
+
+
+def make_static_video(path: str | Path, *, num_frames: int = 24, fps: float = 24.0) -> str:
+    """A single static gray scene (zero motion, no cuts)."""
+    w, h = 64, 48
+    writer = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h))
+    frame = np.full((h, w, 3), 128, np.uint8)
+    for _ in range(num_frames):
+        writer.write(frame)
+    writer.release()
+    return str(path)

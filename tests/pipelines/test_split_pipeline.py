@@ -1,0 +1,121 @@
+"""End-to-end split pipeline tests (SequentialRunner, synthetic media)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.pipelines.video.input_discovery import discover_split_tasks
+from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+from cosmos_curate_tpu.pipelines.video.stages.clip_extraction import chunk_split_task
+from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video, VideoMetadata
+from tests.fixtures.media import make_scene_video
+
+
+@pytest.fixture(scope="module")
+def input_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("videos")
+    for i in range(3):
+        make_scene_video(d / f"video_{i}.mp4", scene_len_frames=24, num_scenes=2)
+    (d / "not_a_video.txt").write_text("x")
+    return d
+
+
+def test_split_end_to_end(input_dir, tmp_path):
+    out_dir = tmp_path / "out"
+    args = SplitPipelineArgs(
+        input_path=str(input_dir),
+        output_path=str(out_dir),
+        fixed_stride_len_s=1.0,
+        min_clip_len_s=0.5,
+    )
+    summary = run_split(args, runner=SequentialRunner())
+    assert summary["num_videos"] == 3
+    assert summary["num_clips"] == 6  # 2s each at 1s stride
+    assert summary["num_transcoded"] == 6
+
+    clips = list((out_dir / "clips").glob("*.mp4"))
+    metas = list((out_dir / "metas" / "v0").glob("*.json"))
+    assert len(clips) == 6
+    assert len(metas) == 6
+    meta = json.loads(metas[0].read_text())
+    assert meta["duration_s"] == pytest.approx(1.0)
+    assert meta["codec"] in ("avc1", "mp4v")
+    assert (out_dir / "summary.json").exists()
+
+    # resume: re-run discovers nothing new
+    tasks = discover_split_tasks(str(input_dir), str(out_dir))
+    assert tasks == []
+
+
+def test_resume_partial(input_dir, tmp_path):
+    out_dir = tmp_path / "out2"
+    args = SplitPipelineArgs(
+        input_path=str(input_dir), output_path=str(out_dir),
+        fixed_stride_len_s=1.0, min_clip_len_s=0.5, limit=2,
+    )
+    run_split(args, runner=SequentialRunner())
+    remaining = discover_split_tasks(str(input_dir), str(out_dir))
+    assert len(remaining) == 1
+
+
+def test_bad_video_contained(tmp_path):
+    vids = tmp_path / "in"
+    vids.mkdir()
+    make_scene_video(vids / "good.mp4", scene_len_frames=24, num_scenes=1)
+    (vids / "broken.mp4").write_bytes(b"garbage garbage garbage")
+    out_dir = tmp_path / "out"
+    summary = run_split(
+        SplitPipelineArgs(
+            input_path=str(vids), output_path=str(out_dir),
+            fixed_stride_len_s=1.0, min_clip_len_s=0.5,
+        ),
+        runner=SequentialRunner(),
+    )
+    # bad video recorded as error, good one fully processed
+    assert summary["num_videos"] == 2
+    assert summary["num_errors"] >= 1
+    assert summary["num_transcoded"] == 1
+
+
+def test_chunking_fractions():
+    video = Video(path="v.mp4", clips=[Clip() for _ in range(10)])
+    video.num_total_clips = 10
+    chunks = chunk_split_task(SplitPipeTask(video=video), chunk_size=4)
+    assert [len(c.video.clips) for c in chunks] == [4, 4, 2]
+    assert sum(c.fraction for c in chunks) == pytest.approx(1.0)
+    assert {c.video.clip_chunk_index for c in chunks} == {0, 1, 2}
+
+
+def test_config_file_mode(tmp_path, input_dir):
+    cfg = tmp_path / "split.json"
+    cfg.write_text(json.dumps({
+        "input_path": str(input_dir),
+        "output_path": str(tmp_path / "out"),
+        "fixed_stride_len_s": 1.0,
+        "extract_fps": [1.0],
+    }))
+    from cosmos_curate_tpu.utils.config import load_pipeline_config
+
+    args = load_pipeline_config(str(cfg), SplitPipelineArgs)
+    assert args.extract_fps == (1.0,)
+    assert args.fixed_stride_len_s == 1.0
+
+
+def test_config_rejects_unknown_keys(tmp_path):
+    cfg = tmp_path / "bad.json"
+    cfg.write_text(json.dumps({"inptu_path": "/x"}))
+    from cosmos_curate_tpu.utils.config import load_pipeline_config
+
+    with pytest.raises(ValueError, match="inptu_path"):
+        load_pipeline_config(str(cfg), SplitPipelineArgs)
+
+
+def test_hello_world_pipeline():
+    from cosmos_curate_tpu.pipelines.examples.hello_world import run_hello_world
+
+    out = run_hello_world(["abc", "def"])
+    assert [t.text for t in out] == ["ABC", "DEF"]
+    assert all(t.score is not None for t in out)
+    assert out[0].device in ("cpu", "tpu")

@@ -1,0 +1,180 @@
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.video import (
+    compute_windows,
+    decode_frames,
+    encode_frames,
+    extract_frames_at_fps,
+    extract_video_metadata,
+    fixed_stride_spans,
+    transcode_clip,
+)
+from cosmos_curate_tpu.video.decode import decode_frame_ids, get_frame_timestamps
+from cosmos_curate_tpu.video.splitter import make_clips, scene_spans_from_predictions
+from cosmos_curate_tpu.video.windowing import overlapping_windows
+from tests.fixtures.media import make_scene_video, make_static_video
+
+
+@pytest.fixture(scope="module")
+def scene_video(tmp_path_factory):
+    p = tmp_path_factory.mktemp("vid") / "scenes.mp4"
+    return make_scene_video(p, scene_len_frames=24, num_scenes=3, fps=24.0)
+
+
+def test_metadata_probe(scene_video):
+    meta = extract_video_metadata(scene_video)
+    assert meta.is_valid
+    assert (meta.width, meta.height) == (96, 64)
+    assert meta.fps == 24.0
+    assert meta.num_frames == 72
+    assert meta.duration_s == pytest.approx(3.0)
+
+
+def test_metadata_from_bytes(scene_video):
+    data = open(scene_video, "rb").read()
+    meta = extract_video_metadata(data)
+    assert meta.num_frames == 72
+    assert meta.size_bytes == len(data)
+
+
+def test_metadata_invalid_bytes():
+    with pytest.raises(ValueError):
+        extract_video_metadata(b"not a video")
+
+
+def test_decode_all_and_strided(scene_video):
+    frames = decode_frames(scene_video)
+    assert frames.shape == (72, 64, 96, 3)
+    assert frames.dtype == np.uint8
+    strided = decode_frames(scene_video, stride=8)
+    assert strided.shape[0] == 9
+    np.testing.assert_array_equal(strided[0], frames[0])
+
+
+def test_decode_window_and_resize(scene_video):
+    win = decode_frames(scene_video, start_frame=10, num_frames=5, resize_hw=(32, 48))
+    assert win.shape == (5, 32, 48, 3)
+
+
+def test_decode_frame_ids(scene_video):
+    all_frames = decode_frames(scene_video)
+    picked = decode_frame_ids(scene_video, [0, 30, 71])
+    assert picked.shape[0] == 3
+    np.testing.assert_array_equal(picked[1], all_frames[30])
+
+
+def test_extract_fps_sampling(scene_video):
+    frames = extract_frames_at_fps(scene_video, target_fps=2.0)
+    assert frames.shape[0] == 6  # 3s at 2fps
+
+
+def test_scene_colors_visible(scene_video):
+    frames = decode_frames(scene_video)
+    # scene 0 is red-ish, scene 1 green-ish, scene 2 blue-ish (mean over frame)
+    means = frames.reshape(72, -1, 3).mean(axis=1)
+    assert means[5].argmax() == 0
+    assert means[30].argmax() == 1
+    assert means[60].argmax() == 2
+
+
+def test_timestamps(scene_video):
+    ts = get_frame_timestamps(scene_video)
+    assert ts.shape == (72,)
+    assert ts[24] == pytest.approx(1.0)
+
+
+def test_encode_roundtrip():
+    frames = np.zeros((12, 48, 64, 3), np.uint8)
+    frames[:, :, :, 1] = 200
+    data = encode_frames(frames, fps=12.0)
+    assert len(data) > 100
+    meta = extract_video_metadata(data)
+    assert meta.num_frames == 12
+    decoded = decode_frames(data)
+    assert abs(int(decoded[0, 10, 10, 1]) - 200) < 30  # lossy but close
+
+
+def test_encode_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        encode_frames(np.zeros((4, 8, 8), np.uint8), fps=10)
+
+
+def test_transcode_clip(scene_video):
+    data, codec = transcode_clip(scene_video, (1.0, 2.0))
+    assert codec in ("avc1", "mp4v")
+    meta = extract_video_metadata(data)
+    assert meta.num_frames == 24  # 1s at 24fps
+    # content should be scene 1 (green-ish)
+    frames = decode_frames(data)
+    assert frames.reshape(meta.num_frames, -1, 3).mean(axis=(0, 1)).argmax() == 1
+
+
+def test_transcode_out_of_range_returns_empty(scene_video):
+    data, _ = transcode_clip(scene_video, (100.0, 110.0))
+    assert data == b""
+
+
+class TestSpanMath:
+    def test_fixed_stride_exact(self):
+        assert fixed_stride_spans(30.0, clip_len_s=10.0) == [(0.0, 10.0), (10.0, 20.0), (20.0, 30.0)]
+
+    def test_fixed_stride_remainder_kept_and_dropped(self):
+        spans = fixed_stride_spans(25.0, clip_len_s=10.0, min_clip_len_s=2.0)
+        assert spans[-1] == (20.0, 25.0)
+        spans = fixed_stride_spans(21.0, clip_len_s=10.0, min_clip_len_s=2.0)
+        assert spans[-1] == (10.0, 20.0)
+
+    def test_overlapping_stride(self):
+        spans = fixed_stride_spans(20.0, clip_len_s=10.0, stride_s=5.0)
+        assert spans == [(0.0, 10.0), (5.0, 15.0), (10.0, 20.0), (15.0, 20.0)]
+
+    def test_empty(self):
+        assert fixed_stride_spans(0.0) == []
+
+    def test_scene_spans_basic(self):
+        preds = np.zeros(72)
+        preds[23] = 0.9  # cut after frame 23
+        preds[47] = 0.9
+        spans = scene_spans_from_predictions(preds, fps=24.0, min_scene_len_s=0.5)
+        assert spans == [(0.0, 1.0), (1.0, 2.0), (2.0, 3.0)]
+
+    def test_scene_spans_min_max(self):
+        preds = np.zeros(24 * 100)
+        spans = scene_spans_from_predictions(preds, fps=24.0, max_scene_len_s=30.0)
+        assert spans == [(0.0, 30.0), (30.0, 60.0), (60.0, 90.0), (90.0, 100.0)]
+
+    def test_make_clips_deterministic(self):
+        a = make_clips("v.mp4", [(0.0, 5.0)])
+        b = make_clips("v.mp4", [(0.0, 5.0)])
+        assert a[0].uuid == b[0].uuid
+
+
+class TestWindowing:
+    def test_exact_multiple(self):
+        assert compute_windows(512) == [(0, 256), (256, 512)]
+
+    def test_short_remainder_merges(self):
+        assert compute_windows(300) == [(0, 300)]
+
+    def test_long_remainder_standalone(self):
+        assert compute_windows(256 + 128) == [(0, 256), (256, 384)]
+
+    def test_short_clip_single_window(self):
+        assert compute_windows(100) == [(0, 100)]
+
+    def test_zero(self):
+        assert compute_windows(0) == []
+
+    def test_overlapping(self):
+        spans = overlapping_windows(300, window_len=128, overlap=64)
+        assert spans[0] == (0, 128)
+        assert spans[1] == (64, 192)
+        assert spans[-1][1] == 300
+
+
+def test_static_video_fixture(tmp_path):
+    p = make_static_video(tmp_path / "static.mp4")
+    frames = decode_frames(p)
+    assert frames.shape[0] == 24
+    assert int(frames.std()) <= 1
