@@ -1,0 +1,129 @@
+"""Benchmark harness: split+annotate throughput on this host's TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Mirrors the reference's canonical benchmark shape
+(benchmarks/split_pipeline/invoke.json + benchmarks/summary.py in
+/root/reference): a fixed corpus of videos through download → fixed-stride
+split → transcode → frame-extract → TPU video embedding → write, measuring
+end-to-end clips/sec (model compile excluded via warmup; fixture synthesis
+excluded). ``vs_baseline`` compares against the recorded value in
+BENCH_REF.json (first recorded round = 1.0); the reference repo publishes no
+absolute numbers to compare against directly (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+NUM_VIDEOS = int(os.environ.get("BENCH_NUM_VIDEOS", "8"))
+SCENE_FRAMES = 48
+NUM_SCENES = 2  # 4 s per video at 24 fps
+STRIDE_S = 1.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_corpus(root: Path) -> Path:
+    import cv2
+    import numpy as np
+
+    vids = root / "videos"
+    vids.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(NUM_VIDEOS):
+        path = vids / f"bench_{i}.mp4"
+        w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (320, 240))
+        for s in range(NUM_SCENES):
+            base = rng.integers(0, 255, 3)
+            for f in range(SCENE_FRAMES):
+                frame = np.full((240, 320, 3), base, np.uint8)
+                x = (f * 7 + i * 13) % 280
+                frame[60:120, x : x + 40] = 255 - base
+                w.write(frame)
+        w.release()
+    return vids
+
+
+def main() -> int:
+    import numpy as np
+
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_BASE, VideoEmbedder
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+    log(f"bench: synthesizing {NUM_VIDEOS} videos")
+    tmp = Path(tempfile.mkdtemp(prefix="curate_bench_"))
+    vids = make_corpus(tmp)
+
+    # Warm up the embedder compile outside the timed window (all power-of-2
+    # batch shapes the run will hit).
+    log("bench: warming up embedder compiles")
+    warm = VideoEmbedder(VIDEO_EMBED_BASE)
+    warm.setup()
+    expected_clips_per_video = int(NUM_SCENES * SCENE_FRAMES / 24.0 / STRIDE_S)
+    from cosmos_curate_tpu.models.batching import next_pow2
+
+    for b in {next_pow2(expected_clips_per_video), next_pow2(max(1, expected_clips_per_video - 1))}:
+        warm.encode_clips(
+            np.zeros((b, VIDEO_EMBED_BASE.num_frames, 224, 224, 3), np.uint8)
+        )
+    del warm
+
+    args = SplitPipelineArgs(
+        input_path=str(vids),
+        output_path=str(tmp / "out"),
+        fixed_stride_len_s=STRIDE_S,
+        min_clip_len_s=0.5,
+        extract_fps=(8.0,),
+        extract_resize_hw=(224, 224),
+        embedding_model="video",
+    )
+    log("bench: running split+annotate")
+    t0 = time.monotonic()
+    summary = run_split(args, runner=SequentialRunner())
+    elapsed = time.monotonic() - t0
+
+    clips = summary["num_clips"]
+    embedded = summary["num_with_embeddings"]
+    value = clips / elapsed if elapsed > 0 else 0.0
+    log(
+        f"bench: {clips} clips ({embedded} embedded) in {elapsed:.1f}s; "
+        f"video_hours_per_day_per_chip={summary['video_hours_per_day_per_chip']:.1f}"
+    )
+
+    ref_path = REPO / "BENCH_REF.json"
+    vs = 1.0
+    if ref_path.exists():
+        try:
+            ref = json.loads(ref_path.read_text())
+            if ref.get("value"):
+                vs = value / float(ref["value"])
+        except Exception as e:
+            log(f"bench: unreadable BENCH_REF.json: {e}")
+    print(
+        json.dumps(
+            {
+                "metric": "clips_per_sec_split_annotate",
+                "value": round(value, 3),
+                "unit": "clips/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""Batch-shape discipline for XLA: pad ragged host batches to a small set of
+static sizes.
+
+Everything under jit is compiled per shape (SURVEY/XLA semantics); clip
+counts vary per task, so without padding every distinct batch size costs a
+~20-40 s TPU compile. Padding to the next power of two bounds the number of
+compiled programs at log2(max_batch) while wasting <2x FLOPs worst-case —
+on the MXU that trade is strongly right.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def next_pow2(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def pad_batch(x: np.ndarray, *, max_pad_to: int = 4096) -> tuple[np.ndarray, int]:
+    """Pad x's leading dim to the next power of two (repeating the last row,
+    so padded rows stay in-distribution). Returns (padded, original_n)."""
+    n = x.shape[0]
+    if n == 0:
+        return x, 0
+    target = min(next_pow2(n), max_pad_to)
+    if target <= n:
+        return x, n
+    reps = np.repeat(x[-1:], target - n, axis=0)
+    return np.concatenate([x, reps], axis=0), n

@@ -1,0 +1,154 @@
+"""CLIP-style image embedder + aesthetic head.
+
+Equivalent capability of the reference's CLIP / aesthetics models
+(cosmos_curate/models/clip.py:36-118 — openai/clip-vit-large-patch14
+normalized image embeddings; models/aesthetics.py:30-155 — linear MLP over
+CLIP embeddings). Our own Flax ViT backbone (models/vit.py) with L2-
+normalized projection; the aesthetic scorer composes the two exactly like
+the reference's ``CLIPAestheticScorer`` (models/clip_aesthetics.py:27).
+
+TPU-first: preprocessing (resize + normalize) runs on-device inside the same
+jit as the forward pass, so the host→device transfer is raw uint8 frames.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.models.batching import pad_batch
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_L_14, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
+
+_CONFIGS: dict[str, ViTConfig] = {
+    "clip-vit-l14-tpu": VIT_L_14,
+    "clip-vit-b16-tpu": VIT_B_16,
+    "clip-vit-tiny-test": VIT_TINY_TEST,
+}
+
+
+class AestheticMLP(nn.Module):
+    """Score head over image embeddings (reference: ttj/sac-logos-ava1
+    linear-MSE MLP, models/aesthetics.py:30)."""
+
+    hidden: tuple[int, ...] = (1024, 128, 64, 16)
+
+    @nn.compact
+    def __call__(self, emb):
+        x = emb.astype(jnp.float32)
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc{i}")(x))
+        return nn.Dense(1, name="out")(x)[..., 0]
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_embed(cfg: ViTConfig):
+    """Compiled embed shared across instances (see embedder._jitted_apply)."""
+    model = ViT(cfg)
+    size = cfg.image_size
+
+    @jax.jit
+    def embed(params, frames_u8):
+        pixels = preprocess_frames(frames_u8, image_size=size)
+        pooled, _ = model.apply(params, pixels)
+        pooled = pooled.astype(jnp.float32)
+        return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+    return embed
+
+
+class CLIPImageEmbeddings(ModelInterface):
+    """Batched image -> normalized embedding on the local device/mesh."""
+
+    def __init__(self, variant: str = "clip-vit-b16-tpu") -> None:
+        if variant not in _CONFIGS:
+            raise ValueError(f"unknown CLIP variant {variant!r}; have {sorted(_CONFIGS)}")
+        self.variant = variant
+        self.cfg = _CONFIGS[variant]
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.variant]
+
+    @property
+    def embedding_dim(self) -> int:
+        return self.cfg.projection_dim
+
+    def setup(self) -> None:
+        model = ViT(self.cfg)
+        size = self.cfg.image_size
+
+        def init(seed: int):
+            dummy = jnp.zeros((1, size, size, 3), jnp.uint8)
+            return model.init(jax.random.PRNGKey(seed), preprocess_frames(dummy, image_size=size))
+
+        self._params = registry.load_params(self.variant, init)
+        self._apply = _jitted_embed(self.cfg)
+
+    def encode_frames(self, frames_u8: np.ndarray) -> np.ndarray:
+        """uint8 [N, H, W, 3] -> float32 [N, P] L2-normalized.
+
+        Batches are padded to power-of-two sizes so XLA compiles a handful
+        of shapes instead of one per distinct clip count."""
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        padded, n = pad_batch(frames_u8)
+        return np.asarray(self._apply(self._params, padded))[:n]
+
+
+class AestheticScorer(ModelInterface):
+    """Embeddings -> scalar score (compose with CLIPImageEmbeddings)."""
+
+    MODEL_ID = "aesthetics-mlp-tpu"
+
+    def __init__(self, embedding_dim: int = 512) -> None:
+        self.embedding_dim = embedding_dim
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    def setup(self) -> None:
+        model = AestheticMLP()
+
+        def init(seed: int):
+            return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, self.embedding_dim)))
+
+        self._params = registry.load_params(self.MODEL_ID, init)
+        self._apply = jax.jit(model.apply)
+
+    def score(self, embeddings: np.ndarray) -> np.ndarray:
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        padded, n = pad_batch(embeddings)
+        return np.asarray(self._apply(self._params, padded))[:n]
+
+
+class CLIPAestheticScorer(ModelInterface):
+    """Fused frames -> aesthetic score (reference clip_aesthetics.py:27)."""
+
+    def __init__(self, variant: str = "clip-vit-b16-tpu") -> None:
+        self.clip = CLIPImageEmbeddings(variant)
+        self.head = AestheticScorer(self.clip.embedding_dim)
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return self.clip.model_id_names + self.head.model_id_names
+
+    def setup(self) -> None:
+        self.clip.setup()
+        self.head.setup()
+
+    def score_frames(self, frames_u8: np.ndarray) -> np.ndarray:
+        emb = self.clip.encode_frames(frames_u8)
+        return self.head.score(emb)

@@ -1,0 +1,131 @@
+"""Video embedder: frame features + temporal transformer -> one embedding.
+
+Equivalent capability of the reference's video embedders (InternVideo2
+cosmos_curate/models/internvideo2_mm.py:334, Cosmos-Embed1
+models/cosmos_embed1.py:42 — 256/512/768-d video embeddings used for
+semantic dedup and search). Our own architecture, TPU-first: a (shared) ViT
+encodes N sampled frames in one batched pass, a small temporal transformer
+with a learned query token pools them into a single L2-normalized vector.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.models.layers import TransformerBlock
+from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
+
+
+@dataclass(frozen=True)
+class VideoEmbedConfig:
+    vit: ViTConfig = VIT_B_16
+    temporal_layers: int = 4
+    temporal_heads: int = 8
+    num_frames: int = 8
+    output_dim: int = 768
+
+
+VIDEO_EMBED_BASE = VideoEmbedConfig()
+VIDEO_EMBED_TINY_TEST = VideoEmbedConfig(
+    vit=VIT_TINY_TEST, temporal_layers=1, temporal_heads=2, num_frames=4, output_dim=32
+)
+
+
+class TemporalPooler(nn.Module):
+    cfg: VideoEmbedConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, frame_feats):
+        """frame_feats: [B, T, D] -> [B, output_dim]."""
+        b, t, d = frame_feats.shape
+        query = self.param("query", nn.initializers.normal(0.02), (1, 1, d), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(query.astype(self.dtype), (b, 1, d)), frame_feats.astype(self.dtype)],
+            axis=1,
+        )
+        pos = self.param(
+            "time_embed", nn.initializers.normal(0.02), (1, self.cfg.num_frames + 1, d), jnp.float32
+        )
+        x = x + pos[:, : t + 1].astype(self.dtype)
+        head_dim = d // self.cfg.temporal_heads
+        for i in range(self.cfg.temporal_layers):
+            x = TransformerBlock(self.cfg.temporal_heads, head_dim, dtype=self.dtype, name=f"t{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x[:, 0])
+        return nn.Dense(self.cfg.output_dim, param_dtype=jnp.float32, name="proj")(x)
+
+
+class VideoEmbedModel(nn.Module):
+    cfg: VideoEmbedConfig
+
+    @nn.compact
+    def __call__(self, frames_u8):
+        """frames_u8: uint8 [B, T, H, W, 3] -> [B, output_dim] normalized."""
+        b, t = frames_u8.shape[:2]
+        pixels = preprocess_frames(frames_u8, image_size=self.cfg.vit.image_size)
+        pooled, _ = ViT(self.cfg.vit, name="vit")(pixels.reshape(b * t, *pixels.shape[2:]))
+        feats = pooled.reshape(b, t, -1)
+        emb = TemporalPooler(self.cfg, name="pooler")(feats).astype(jnp.float32)
+        return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_apply(cfg: VideoEmbedConfig):
+    """Compiled apply shared across instances of the same config — jit
+    caches are per function object, so per-instance jits would recompile
+    (and defeat warmup) every time a stage constructs its own model."""
+    model = VideoEmbedModel(cfg)
+    return jax.jit(model.apply)
+
+
+class VideoEmbedder(ModelInterface):
+    MODEL_ID = "video-embed-tpu"
+
+    def __init__(self, cfg: VideoEmbedConfig = VIDEO_EMBED_BASE) -> None:
+        self.cfg = cfg
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    @property
+    def embedding_dim(self) -> int:
+        return self.cfg.output_dim
+
+    def setup(self) -> None:
+        model = VideoEmbedModel(self.cfg)
+
+        def init(seed: int):
+            s = self.cfg.vit.image_size
+            dummy = jnp.zeros((1, self.cfg.num_frames, s, s, 3), jnp.uint8)
+            return model.init(jax.random.PRNGKey(seed), dummy)
+
+        self._params = registry.load_params(self.MODEL_ID, init)
+        self._apply = _jitted_apply(self.cfg)
+
+    def sample_frame_indices(self, total: int) -> np.ndarray:
+        """Uniform temporal sampling to cfg.num_frames indices."""
+        n = self.cfg.num_frames
+        if total <= 0:
+            return np.zeros(0, np.int64)
+        return np.linspace(0, max(total - 1, 0), n).round().astype(np.int64)
+
+    def encode_clips(self, clips_frames: np.ndarray) -> np.ndarray:
+        """uint8 [B, T, H, W, 3] -> float32 [B, output_dim] normalized.
+        Batch padded to power-of-two sizes (bounded compile count)."""
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        from cosmos_curate_tpu.models.batching import pad_batch
+
+        padded, n = pad_batch(clips_frames)
+        return np.asarray(self._apply(self._params, padded))[:n]

@@ -1,0 +1,108 @@
+"""Model-weights registry and staging.
+
+Equivalent capability of the reference's weights management
+(cosmos_curate/configs/all_models.json registry + core/utils/model/
+model_utils.py:56-778 download/staging flow): a registry of model ids with
+their local weight locations, a per-node staging hook, and loading that is
+explicit about provenance.
+
+In this image there is no network egress and no pretrained cache, so
+``load_params`` falls back to **seeded random initialization** with a
+prominent warning when no weights are staged — architecture, sharding and
+throughput are exercised identically; real deployments drop orbax
+checkpoints into ``$CURATE_MODEL_WEIGHTS_DIR/<model-id>/``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+WEIGHTS_DIR_ENV = "CURATE_MODEL_WEIGHTS_DIR"
+
+
+@dataclass(frozen=True)
+class ModelEntry:
+    model_id: str
+    description: str = ""
+
+
+_REGISTRY: dict[str, ModelEntry] = {}
+
+
+def register_model(model_id: str, description: str = "") -> None:
+    _REGISTRY[model_id] = ModelEntry(model_id, description)
+
+
+def registered_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+for _mid, _desc in [
+    ("transnetv2-tpu", "shot transition detector (Flax DDCNN)"),
+    ("clip-vit-l14-tpu", "CLIP ViT-L/14 image embedder (Flax)"),
+    ("clip-vit-b16-tpu", "CLIP ViT-B/16 image embedder (Flax)"),
+    ("aesthetics-mlp-tpu", "aesthetic score head over CLIP embeddings"),
+    ("video-embed-tpu", "temporal-transformer video embedder"),
+    ("caption-vlm-tpu", "vision-language captioning model (Flax)"),
+    ("t5-encoder-tpu", "text encoder for caption embeddings"),
+]:
+    register_model(_mid, _desc)
+
+
+def weights_root() -> Path:
+    return Path(os.environ.get(WEIGHTS_DIR_ENV, "/tmp/curate_model_weights"))
+
+
+def local_dir_for(model_id: str) -> Path:
+    return weights_root() / model_id
+
+
+def stage_weights_on_node(model_ids: list[str]) -> None:
+    """Per-node staging hook (reference: one Ray task per node copies weights
+    to local SSD, model_utils.py:139). Local build: ensure dirs exist."""
+    for mid in model_ids:
+        local_dir_for(mid).mkdir(parents=True, exist_ok=True)
+
+
+def load_params(
+    model_id: str,
+    init_fn: Callable[[int], Any],
+    *,
+    seed: int = 0,
+) -> Any:
+    """Load staged weights for ``model_id`` if present, else fall back to
+    ``init_fn(seed)`` (random init) with a warning.
+
+    Format: flax msgpack (``flax.serialization``) — synchronous and
+    self-contained; the tree structure comes from ``init_fn``."""
+    ckpt = local_dir_for(model_id) / "params.msgpack"
+    if ckpt.exists():
+        import flax.serialization
+
+        logger.info("loading %s weights from %s", model_id, ckpt)
+        template = init_fn(seed)
+        return flax.serialization.from_bytes(template, ckpt.read_bytes())
+    logger.warning(
+        "no staged weights for %s under %s — using seeded random init "
+        "(stage a params.msgpack there for real inference)",
+        model_id,
+        ckpt,
+    )
+    return init_fn(seed)
+
+
+def save_params(model_id: str, params: Any) -> Path:
+    """Write staged weights into the registry location."""
+    import flax.serialization
+
+    ckpt = local_dir_for(model_id) / "params.msgpack"
+    ckpt.parent.mkdir(parents=True, exist_ok=True)
+    ckpt.write_bytes(flax.serialization.to_bytes(params))
+    return ckpt

@@ -1,0 +1,146 @@
+"""Shot-transition detector: dilated 3D-CNN over frame windows.
+
+Equivalent capability of the reference's TransNetV2
+(cosmos_curate/models/transnetv2.py:39-580, a torch DDCNN): per-frame shot
+transition probabilities over ~100-frame sliding windows on 48x27 inputs.
+This is our own Flax implementation of the DDCNN idea (Soucek & Lokoc,
+TransNet V2, public architecture): blocks of parallel 3D convs with
+exponential temporal dilations, spatial pooling between stages, per-frame
+head.
+
+TPU-first: the whole sliding-window batch is one conv3d-heavy jit (convs map
+to MXU); windows are batched, not looped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+INPUT_H, INPUT_W = 27, 48
+WINDOW = 100
+STRIDE = 50  # middle-half evaluation like the published model
+
+
+@dataclass(frozen=True)
+class TransNetConfig:
+    filters: tuple[int, ...] = (16, 32, 64)
+    dilations: tuple[int, ...] = (1, 2, 4, 8)
+    head_dim: int = 128
+
+
+TRANSNET_TINY_TEST = TransNetConfig(filters=(4,), dilations=(1, 2), head_dim=16)
+
+
+class DDCNNBlock(nn.Module):
+    """Parallel temporal-dilated 3D convs, concatenated."""
+
+    filters: int
+    dilations: tuple[int, ...]
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        outs = [
+            nn.Conv(
+                self.filters,
+                kernel_size=(3, 3, 3),
+                kernel_dilation=(d, 1, 1),
+                padding="SAME",
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=f"conv_d{d}",
+            )(x)
+            for d in self.dilations
+        ]
+        return nn.relu(jnp.concatenate(outs, axis=-1))
+
+
+class TransNet(nn.Module):
+    cfg: TransNetConfig = TransNetConfig()
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, frames):
+        """frames: uint8 [B, T, 27, 48, 3] -> logits [B, T]."""
+        x = frames.astype(self.dtype) / 255.0
+        for i, f in enumerate(self.cfg.filters):
+            x = DDCNNBlock(f, self.cfg.dilations, dtype=self.dtype, name=f"dd{i}a")(x)
+            x = DDCNNBlock(f, self.cfg.dilations, dtype=self.dtype, name=f"dd{i}b")(x)
+            x = nn.avg_pool(x, (1, 2, 2), strides=(1, 2, 2))
+        # per-frame spatial pooling -> [B, T, C]
+        x = x.mean(axis=(2, 3))
+        x = nn.relu(
+            nn.Dense(self.cfg.head_dim, dtype=self.dtype, param_dtype=jnp.float32, name="fc")(x)
+        )
+        logits = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32, name="head")(x)
+        return logits[..., 0]
+
+
+class TransNetV2TPU(ModelInterface):
+    """ModelInterface wrapper: windowed inference over arbitrary-length
+    videos, returning per-frame transition probabilities."""
+
+    MODEL_ID = "transnetv2-tpu"
+
+    def __init__(self, batch_windows: int = 8, cfg: TransNetConfig = TransNetConfig()) -> None:
+        self.batch_windows = batch_windows
+        self.cfg = cfg
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    def setup(self) -> None:
+        model = TransNet(self.cfg)
+
+        def init(seed: int):
+            dummy = jnp.zeros((1, WINDOW, INPUT_H, INPUT_W, 3), jnp.uint8)
+            return model.init(jax.random.PRNGKey(seed), dummy)
+
+        self._params = registry.load_params(self.MODEL_ID, init)
+        self._apply = jax.jit(lambda p, x: jax.nn.sigmoid(model.apply(p, x)))
+
+    def predict_transitions(self, frames: np.ndarray) -> np.ndarray:
+        """frames: uint8 [T, H, W, 3] (any H/W; resized on host) -> [T]
+        per-frame transition probabilities, overlap-averaged over windows."""
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        t = frames.shape[0]
+        if t == 0:
+            return np.zeros(0, np.float32)
+        import cv2
+
+        small = np.stack(
+            [cv2.resize(f, (INPUT_W, INPUT_H), interpolation=cv2.INTER_AREA) for f in frames]
+        )
+        # window starts at STRIDE spacing, padded at the tail
+        starts = list(range(0, max(1, t - WINDOW + STRIDE), STRIDE))
+        windows = np.zeros((len(starts), WINDOW, INPUT_H, INPUT_W, 3), np.uint8)
+        for i, s in enumerate(starts):
+            chunk = small[s : s + WINDOW]
+            windows[i, : len(chunk)] = chunk
+            if len(chunk) < WINDOW:  # pad by repeating last frame
+                windows[i, len(chunk):] = chunk[-1]
+        probs_sum = np.zeros(t, np.float64)
+        probs_cnt = np.zeros(t, np.float64)
+        for i in range(0, len(starts), self.batch_windows):
+            batch = windows[i : i + self.batch_windows]
+            out = np.asarray(self._apply(self._params, batch))
+            for j, s in enumerate(starts[i : i + self.batch_windows]):
+                end = min(s + WINDOW, t)
+                probs_sum[s:end] += out[j, : end - s]
+                probs_cnt[s:end] += 1
+        return (probs_sum / np.maximum(probs_cnt, 1)).astype(np.float32)

@@ -1,0 +1,96 @@
+"""Vision Transformer backbone (shared by CLIP-style image embedding and the
+video embedder).
+
+Equivalent capability of the reference's CLIP vision tower usage
+(cosmos_curate/models/clip.py:36-118 drives HF transformers' CLIP on CUDA);
+this is our own Flax implementation, TPU-first: patchify as a single conv
+(maps to MXU), bf16 compute, TP head sharding from models/layers.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.models.layers import TransformerBlock, dense
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    width: int = 1024
+    layers: int = 24
+    heads: int = 16
+    projection_dim: int = 768
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_L_14 = ViTConfig()
+VIT_B_16 = ViTConfig(patch_size=16, width=768, layers=12, heads=12, projection_dim=512)
+VIT_TINY_TEST = ViTConfig(image_size=32, patch_size=8, width=64, layers=2, heads=4, projection_dim=32)
+
+
+class ViT(nn.Module):
+    """Image encoder: pixels [B, H, W, 3] float in [-1, 1] -> (pooled [B, P],
+    tokens [B, N, W])."""
+
+    cfg: ViTConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, pixels):
+        cfg = self.cfg
+        x = nn.Conv(
+            cfg.width,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="patch_embed",
+        )(pixels.astype(self.dtype))
+        b, gh, gw, w = x.shape
+        x = x.reshape(b, gh * gw, w)
+        cls = self.param("cls", nn.initializers.normal(0.02), (1, 1, w), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls.astype(self.dtype), (b, 1, w)), x], axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, cfg.num_patches + 1, w), jnp.float32
+        )
+        x = x + pos.astype(self.dtype)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_pre")(x)
+        for i in range(cfg.layers):
+            x = TransformerBlock(
+                cfg.heads, cfg.head_dim, dtype=self.dtype, name=f"block_{i}"
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_post")(x)
+        pooled = dense(cfg.projection_dim, None, name="proj", use_bias=False, dtype=self.dtype)(
+            x[:, 0]
+        )
+        return pooled, x
+
+
+def preprocess_frames(frames, *, image_size: int):
+    """uint8 [..., H, W, 3] -> float [-1, 1] resized to (image_size,
+    image_size) with jax.image (device-side; avoids a CPU resize pass)."""
+    import jax
+
+    x = frames.astype(jnp.float32) / 127.5 - 1.0
+    if x.shape[-3] != image_size or x.shape[-2] != image_size:
+        batch_dims = x.shape[:-3]
+        x = x.reshape((-1, *x.shape[-3:]))
+        x = jax.image.resize(
+            x, (x.shape[0], image_size, image_size, 3), method="bilinear"
+        )
+        x = x.reshape((*batch_dims, image_size, image_size, 3))
+    return x

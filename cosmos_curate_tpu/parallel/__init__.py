@@ -1,0 +1,21 @@
+from cosmos_curate_tpu.parallel.mesh import (
+    MeshSpec,
+    best_effort_mesh,
+    local_mesh,
+)
+from cosmos_curate_tpu.parallel.sharding import (
+    batch_sharding,
+    named_sharding,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshSpec",
+    "batch_sharding",
+    "best_effort_mesh",
+    "local_mesh",
+    "named_sharding",
+    "replicated",
+    "shard_batch",
+]

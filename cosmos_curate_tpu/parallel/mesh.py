@@ -1,0 +1,76 @@
+"""Mesh construction: the TPU-native answer to the reference's NCCL planes.
+
+The reference has three communication planes (SURVEY.md §5): Ray object store
+between stages, NCCL for dedup k-means, vLLM-internal NCCL for TP. Here every
+collective plane is a `jax.sharding.Mesh`: XLA emits ICI collectives within a
+slice and DCN collectives across slices — no NCCL anywhere.
+
+Axis convention (scaling-book style):
+  ``dcn``   — across hosts/slices (data-parallel only; rides DCN)
+  ``data``  — batch shards within a slice
+  ``model`` — tensor-parallel shards (rides ICI)
+  ``seq``   — sequence/context-parallel shards for ring attention
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 axes absorb remaining devices (like reshape)."""
+
+    dcn: int = 1
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        return ("dcn", "data", "model", "seq")
+
+
+def local_mesh(axis_names: tuple[str, ...] = ("data", "model"), shape: tuple[int, ...] | None = None):
+    """Mesh over this process's local devices (the ``entire_tpu_host`` worker
+    claim). Default: all chips on one ``model`` axis when shape is None and
+    one axis name given, else data×model split with model = all chips."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.local_devices()
+    n = len(devices)
+    if shape is None:
+        if len(axis_names) == 1:
+            shape = (n,)
+        elif len(axis_names) == 2:
+            shape = (1, n)
+        else:
+            raise ValueError("provide an explicit shape for >2 axes")
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} local devices")
+    return Mesh(np.array(devices).reshape(shape), axis_names=axis_names)
+
+
+def best_effort_mesh(spec: MeshSpec | None = None):
+    """Build the full (dcn, data, model, seq) mesh over all visible devices,
+    resolving -1 axes. Single-host single-chip degenerates to (1,1,1,1)."""
+    import jax
+    from jax.sharding import Mesh
+
+    spec = spec or MeshSpec()
+    devices = jax.devices()
+    n = len(devices)
+    dims = [spec.dcn, spec.data, spec.model, spec.seq]
+    n_fixed = int(np.prod([d for d in dims if d > 0]))
+    n_free = sum(1 for d in dims if d <= 0)
+    if n_free > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if n_free == 1:
+        if n % n_fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {dims}")
+        dims = [d if d > 0 else n // n_fixed for d in dims]
+    if int(np.prod(dims)) != n:
+        raise ValueError(f"mesh {dims} != {n} devices")
+    return Mesh(np.array(devices).reshape(dims), axis_names=spec.axis_names())

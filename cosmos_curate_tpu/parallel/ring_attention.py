@@ -1,0 +1,113 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+First-class long-context support (the reference only windows at the data
+layer; in-model long context lives inside vLLM — SURVEY.md §5). Here a
+sequence is sharded across the ``seq`` mesh axis; each device holds one Q/K/V
+chunk and K/V chunks rotate around the ring via ``lax.ppermute`` while a
+numerically-stable online softmax accumulates output — compute overlaps the
+ICI transfer and full attention is recovered exactly (Liu et al., Ring
+Attention with Blockwise Transformers, 2023 — public technique).
+
+Pure-XLA implementation (collectives emitted by the compiler); drop-in
+upgrade path to a Pallas per-step kernel via the same chunk interface.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_softmax_step(o, m, l, s, v_cur):
+    """Fold one score block into the running (output, max, normalizer)."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp with per-row rescale of previous accumulation
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur
+    ).astype(o.dtype)
+    return o_new, m_new, l_new
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Runs inside shard_map. q/k/v: [B, H, S_local, D] per device."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q_f32 = q.astype(jnp.float32) * sm_scale
+    o = jnp.zeros((b, h, s_q, d), jnp.float32)
+    m = jnp.full((b, h, s_q), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_q), jnp.float32)
+
+    q_pos = my_idx * s_q + jnp.arange(s_q)  # global positions of local queries
+
+    def body(carry, step):
+        o, m, l, k_cur, v_cur = carry
+        # After `step` rotations each device holds the chunk originally owned
+        # by (my_idx - step) mod N.
+        k_chunk_idx = (my_idx - step) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_f32, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = k_chunk_idx * s_k + jnp.arange(s_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        o, m, l = _online_softmax_step(o, m, l, s, v_cur)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(axis_size))
+    # Fully-masked rows (can't happen for causal with aligned chunks, but
+    # guard against l == 0 for safety).
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "seq",
+    causal: bool = False,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Exact attention with sequence sharded over ``mesh`` axis ``seq_axis``.
+
+    Inputs are global-view arrays ``[B, H, S, D]``; S must divide evenly by
+    the axis extent. Use inside ``jax.jit`` with sharded operands — the
+    shard_map keeps each device's chunk local and only K/V ring-hops travel.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(
+        _ring_attention_sharded, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def attention_reference(q, k, v, *, causal: bool = False, sm_scale: float | None = None):
+    """Single-device exact attention used for parity tests."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * sm_scale, k.astype(jnp.float32))
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,60 @@
+"""Sharding helpers: NamedSharding construction and host→device batch placement.
+
+The scaling-book recipe: pick a mesh, annotate shardings on the big tensors,
+let XLA insert collectives. These helpers keep annotations terse at stage
+call sites, and centralize the host→device transfer (the critical data path
+feeding chips from CPU prep stages, SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def named_sharding(mesh, *spec_axes: str | tuple[str, ...] | None):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec_axes))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh, batch_axes: str | tuple[str, ...] = ("dcn", "data")):
+    """Sharding for a [B, ...] batch: leading dim over the data axes."""
+    axes = tuple(a for a in (batch_axes if isinstance(batch_axes, tuple) else (batch_axes,)) if a in mesh.axis_names)
+    return named_sharding(mesh, axes if axes else None)
+
+
+def shard_batch(mesh, tree: Any, batch_axes: str | tuple[str, ...] = ("dcn", "data")):
+    """Device-put a host pytree of [B, ...] numpy arrays, batch-sharded.
+
+    Pads the batch up to a multiple of the data-axis extent (model code must
+    mask or slice off padding; returned pad counts say how much was added).
+    """
+    import jax
+
+    sharding = batch_sharding(mesh, batch_axes)
+    n_shards = int(np.prod([mesh.shape[a] for a in _axes_tuple(batch_axes) if a in mesh.axis_names])) or 1
+
+    def _pad(x):
+        b = x.shape[0]
+        rem = (-b) % n_shards
+        if rem:
+            pad = np.zeros((rem, *x.shape[1:]), x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        return x
+
+    padded = jax.tree.map(_pad, tree)
+    first = jax.tree.leaves(tree)[0]
+    pad_count = (-first.shape[0]) % n_shards
+    return jax.device_put(padded, sharding), pad_count
+
+
+def _axes_tuple(batch_axes) -> tuple[str, ...]:
+    return batch_axes if isinstance(batch_axes, tuple) else (batch_axes,)

@@ -1,0 +1,55 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence exchange.
+
+The complementary strategy to ring attention (DeepSpeed-Ulysses, public
+technique): sequence-sharded activations are all-to-all'd so each device
+holds *all* tokens for a subset of heads, runs dense local attention, and
+all-to-all's back. One collective round instead of N ring hops — wins when
+heads ≥ devices and the full sequence fits per-device; ring attention wins
+for extreme lengths. Both ride ICI via XLA collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+def _ulysses_sharded(q, k, v, *, axis_name: str, causal: bool, sm_scale: float | None):
+    from cosmos_curate_tpu.parallel.ring_attention import attention_reference
+
+    # [B, H, S_local, D] -> [B, H_local, S, D]: scatter heads, gather sequence
+    def seq_to_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    seq_axis: str = "seq",
+    causal: bool = False,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Exact attention over sequence-sharded ``[B, H, S, D]`` inputs; the
+    head count must divide the ``seq_axis`` extent."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n:
+        raise ValueError(f"heads ({q.shape[1]}) must divide by mesh axis {seq_axis}={n}")
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(_ulysses_sharded, axis_name=seq_axis, causal=causal, sm_scale=sm_scale)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

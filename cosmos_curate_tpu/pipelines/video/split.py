@@ -45,12 +45,13 @@ class SplitPipelineArgs:
     # transcode
     transcode_cpus: int = 4
     clip_chunk_size: int = 64
-    # frame extraction
+    # frame extraction (uniform size so model stages can stack across clips)
     extract_fps: tuple[float, ...] = (2.0,)
+    extract_resize_hw: tuple[int, int] = (224, 224)
     # model stages (enabled as they come online)
     motion_filter: str = "disable"  # disable | score-only | enable
     motion_global_threshold: float = 0.00098
-    motion_patch_threshold: float = 0.000001
+    motion_patch_threshold: float = 0.0  # see motion_filter.py: opt-in criterion
     aesthetic_threshold: float | None = None
     embedding_model: str = ""  # "" | "clip" | "video"
     captioning: bool = False
@@ -97,17 +98,21 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         )
     stages.append(
         ClipFrameExtractionStage(
-            signatures=tuple(FrameExtractionSignature("fps", f) for f in args.extract_fps)
+            signatures=tuple(FrameExtractionSignature("fps", f) for f in args.extract_fps),
+            resize_hw=args.extract_resize_hw,
         )
     )
+    primary_sig = FrameExtractionSignature("fps", args.extract_fps[0])
     if args.aesthetic_threshold is not None:
         from cosmos_curate_tpu.pipelines.video.stages.aesthetic_filter import AestheticFilterStage
 
-        stages.append(AestheticFilterStage(threshold=args.aesthetic_threshold))
+        stages.append(
+            AestheticFilterStage(threshold=args.aesthetic_threshold, extraction=primary_sig)
+        )
     if args.embedding_model:
         from cosmos_curate_tpu.pipelines.video.stages.embedding import ClipEmbeddingStage
 
-        stages.append(ClipEmbeddingStage(variant=args.embedding_model))
+        stages.append(ClipEmbeddingStage(variant=args.embedding_model, extraction=primary_sig))
     if args.captioning:
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (
             CaptionPrepStage,

@@ -1,0 +1,78 @@
+"""Aesthetic filter stage: CLIP + MLP scoring, threshold filter.
+
+Equivalent capability of the reference's ``AestheticFilterStage``
+(cosmos_curate/pipelines/video/filtering/aesthetics/
+aesthetic_filter_stages.py:41). The batch across *all clips in the task* is
+scored in one device call — the TPU-first replacement for fractional-GPU
+packing (SURVEY.md §7): aggregate batches, not fractional devices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
+from cosmos_curate_tpu.models.clip import CLIPAestheticScorer
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class AestheticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        threshold: float = 3.5,
+        reduction: str = "min",  # min over frames (strict) or "mean"
+        clip_variant: str = "clip-vit-b16-tpu",
+        extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+        score_only: bool = False,
+    ) -> None:
+        self.threshold = threshold
+        self.reduction = reduction
+        self.extraction = extraction
+        self.score_only = score_only
+        self._scorer = CLIPAestheticScorer(clip_variant)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._scorer
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        key = self.extraction.key()
+        for task in tasks:
+            video = task.video
+            # Gather all frames of all clips into one device batch.
+            spans: list[tuple[int, int]] = []
+            stacks: list[np.ndarray] = []
+            offset = 0
+            for clip in video.clips:
+                frames = clip.extracted_frames.get(key)
+                n = 0 if frames is None else frames.shape[0]
+                spans.append((offset, offset + n))
+                if n:
+                    stacks.append(frames)
+                offset += n
+            if offset == 0:
+                continue
+            scores = self._scorer.score_frames(np.concatenate(stacks))
+            kept = []
+            for clip, (a, b) in zip(video.clips, spans):
+                if a == b:
+                    kept.append(clip)
+                    continue
+                s = scores[a:b]
+                clip.aesthetic_score = float(s.min() if self.reduction == "min" else s.mean())
+                if self.score_only or clip.aesthetic_score >= self.threshold:
+                    kept.append(clip)
+                else:
+                    clip.filtered_by = "aesthetic"
+                    video.filtered_clips.append(clip)
+            video.clips = kept
+        return tasks

@@ -16,7 +16,7 @@ from dataclasses import replace
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask, Video
 from cosmos_curate_tpu.utils.logging import get_logger
-from cosmos_curate_tpu.video.encode import transcode_clip
+from cosmos_curate_tpu.video.encode import transcode_clips
 from cosmos_curate_tpu.video.splitter import fixed_stride_spans, make_clips
 
 logger = get_logger(__name__)
@@ -66,36 +66,38 @@ class ClipTranscodingStage(Stage[SplitPipeTask, SplitPipeTask]):
         return Resources(cpus=float(self.num_threads))
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        # One sequential decode pass per video (transcode_clips decodes each
+        # source frame exactly once, feeding all spans); videos in the batch
+        # fan across the thread pool — that is what num_threads CPUs buys.
+        with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+            list(pool.map(self._transcode_video, tasks))
         out: list[SplitPipeTask] = []
         for task in tasks:
-            video = task.video
-            src = video.raw_bytes if video.raw_bytes is not None else video.path
-            # One decoder per thread, clips fanned across them — this is why
-            # the stage reserves num_threads CPUs (reference runs batched
-            # ffmpeg with 1 thread/clip the same way).
-            with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-                futures = {
-                    pool.submit(
-                        transcode_clip, src, clip.span, resize_hw=self.resize_hw
-                    ): clip
-                    for clip in video.clips
-                }
-                for fut, clip in futures.items():
-                    try:
-                        data, codec = fut.result()
-                        if not data:
-                            clip.errors["transcode"] = "empty output"
-                            continue
-                        clip.encoded_data = data
-                        clip.encoding_codec = codec
-                    except Exception as e:
-                        logger.warning(
-                            "transcode failed for %s span %s: %s", video.path, clip.span, e
-                        )
-                        clip.errors["transcode"] = str(e)
-            video.release_raw()
             out.extend(chunk_split_task(task, self.chunk_size))
         return out
+
+    def _transcode_video(self, task: SplitPipeTask) -> None:
+        video = task.video
+        if not video.clips:
+            video.release_raw()
+            return
+        src = video.raw_bytes if video.raw_bytes is not None else video.path
+        try:
+            results = transcode_clips(
+                src, [c.span for c in video.clips], resize_hw=self.resize_hw
+            )
+            for clip, (data, codec) in zip(video.clips, results):
+                if not data:
+                    clip.errors["transcode"] = "empty output"
+                    continue
+                clip.encoded_data = data
+                clip.encoding_codec = codec
+        except Exception as e:
+            logger.warning("transcode failed for %s: %s", video.path, e)
+            for clip in video.clips:
+                if clip.encoded_data is None:
+                    clip.errors["transcode"] = str(e)
+        video.release_raw()
 
 
 def chunk_split_task(task: SplitPipeTask, chunk_size: int) -> list[SplitPipeTask]:

@@ -1,0 +1,108 @@
+"""Embedding stages: per-clip video embeddings on the TPU.
+
+Equivalent capability of the reference's embedding stages
+(cosmos_curate/pipelines/video/embedding/internvideo2_stages.py:43/187,
+cosmos_embed1_stages.py:43/190 — a CPU frame-prep stage feeding a device
+embed stage). The same deliberate CPU/device split: frame prep happens in
+``ClipFrameExtractionStage``; this stage batches all clips in a task into
+one fixed-shape device call.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
+from cosmos_curate_tpu.models.clip import CLIPImageEmbeddings
+from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_BASE, VideoEmbedConfig, VideoEmbedder
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ClipEmbeddingStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """variant="video": temporal-transformer video embedding;
+    variant="clip": mean of normalized CLIP frame embeddings."""
+
+    def __init__(
+        self,
+        *,
+        variant: str = "video",
+        video_cfg: VideoEmbedConfig = VIDEO_EMBED_BASE,
+        clip_variant: str = "clip-vit-b16-tpu",
+        extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+    ) -> None:
+        if variant not in ("video", "clip"):
+            raise ValueError(f"unknown embedding variant {variant!r}")
+        self.variant = variant
+        self.extraction = extraction
+        self._model: ModelInterface
+        if variant == "video":
+            self._model = VideoEmbedder(video_cfg)
+        else:
+            self._model = CLIPImageEmbeddings(clip_variant)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    @property
+    def model_name(self) -> str:
+        return self._model.model_id_names[0]
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        key = self.extraction.key()
+        for task in tasks:
+            video = task.video
+            if self.variant == "video":
+                self._embed_video(video, key)
+            else:
+                self._embed_clip_mean(video, key)
+        return tasks
+
+    def _embed_video(self, video, key: str) -> None:
+        model: VideoEmbedder = self._model  # type: ignore[assignment]
+        batch = []
+        targets = []
+        t = model.cfg.num_frames
+        for clip in video.clips:
+            frames = clip.extracted_frames.get(key)
+            if frames is None or frames.shape[0] == 0:
+                continue
+            idx = model.sample_frame_indices(frames.shape[0])
+            batch.append(frames[idx])
+            targets.append(clip)
+        if not batch:
+            return
+        # uniform spatial size enforced by stacking; prep stage resizes.
+        embs = model.encode_clips(np.stack(batch))
+        for clip, emb in zip(targets, embs):
+            clip.embeddings[self.model_name] = emb
+
+    def _embed_clip_mean(self, video, key: str) -> None:
+        model: CLIPImageEmbeddings = self._model  # type: ignore[assignment]
+        spans = []
+        stacks = []
+        offset = 0
+        for clip in video.clips:
+            frames = clip.extracted_frames.get(key)
+            n = 0 if frames is None else frames.shape[0]
+            spans.append((offset, offset + n))
+            if n:
+                stacks.append(frames)
+            offset += n
+        if offset == 0:
+            return
+        embs = model.encode_frames(np.concatenate(stacks))
+        for clip, (a, b) in zip(video.clips, spans):
+            if a == b:
+                continue
+            mean = embs[a:b].mean(axis=0)
+            mean /= np.linalg.norm(mean) + 1e-8
+            clip.embeddings[self.model_name] = mean.astype(np.float32)

@@ -1,0 +1,109 @@
+"""Motion filter stage: drop (or score) near-static clips.
+
+Equivalent capability of the reference's motion filtering
+(cosmos_curate/pipelines/video/filtering/motion/motion_filter_stages.py:40,
+motion_vector_backend.py — codec motion vectors → global-mean and
+per-patch-min scores). cv2 exposes no codec motion vectors, so the TPU-first
+replacement computes the same two statistics from low-fps frame differences
+**on device in one jit**: normalized mean |Δframe| globally, and the minimum
+over 8×8 spatial patches (catches clips where only a corner moves). Same
+semantics (score-only vs filter; two thresholds), different estimator.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.models.batching import pad_batch
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.decode import extract_frames_at_fps
+
+logger = get_logger(__name__)
+
+_PATCH_GRID = 8
+
+
+@jax.jit
+def _motion_scores(frames_u8, n_valid):
+    """[T_pad, H, W, 3] uint8 (first n_valid real) -> (global, patch_min).
+
+    T is padded to a power of two by the caller so XLA compiles O(log T)
+    programs instead of one per distinct clip length (the same shape
+    discipline as models/batching.py); padded diffs are masked out.
+    """
+    x = frames_u8.astype(jnp.float32) / 255.0
+    gray = x.mean(axis=-1)
+    diff = jnp.abs(gray[1:] - gray[:-1])  # [T_pad-1, H, W]
+    t, h, w = diff.shape
+    valid = (jnp.arange(t) < (n_valid - 1)).astype(jnp.float32)  # [T_pad-1]
+    n = jnp.maximum(n_valid - 1, 1).astype(jnp.float32)
+    global_score = (diff.mean(axis=(1, 2)) * valid).sum() / n
+    ph, pw = h // _PATCH_GRID, w // _PATCH_GRID
+    patches = diff[:, : ph * _PATCH_GRID, : pw * _PATCH_GRID].reshape(
+        t, _PATCH_GRID, ph, _PATCH_GRID, pw
+    )
+    per_patch = (patches.mean(axis=(2, 4)) * valid[:, None, None]).sum(axis=0) / n
+    return global_score, per_patch.min()
+
+
+class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        score_only: bool = False,
+        global_threshold: float = 0.00098,
+        # The reference's 1e-6 default is tuned for codec motion vectors;
+        # our frame-diff estimator yields exact-zero patches on smooth
+        # encodes, so the patch criterion defaults OFF (0.0) and is opt-in.
+        per_patch_threshold: float = 0.0,
+        sample_fps: float = 4.0,
+        decode_resize_hw: tuple[int, int] = (128, 128),
+    ) -> None:
+        self.score_only = score_only
+        self.global_threshold = global_threshold
+        self.per_patch_threshold = per_patch_threshold
+        self.sample_fps = sample_fps
+        self.decode_resize_hw = decode_resize_hw
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=0.5 if not self.score_only else 0.25)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        for task in tasks:
+            video = task.video
+            kept = []
+            for clip in video.clips:
+                if clip.encoded_data is None:
+                    kept.append(clip)
+                    continue
+                try:
+                    frames = extract_frames_at_fps(
+                        clip.encoded_data, target_fps=self.sample_fps, resize_hw=self.decode_resize_hw
+                    )
+                    if frames.shape[0] < 2:
+                        kept.append(clip)
+                        continue
+                    padded, n = pad_batch(frames)
+                    g, p = _motion_scores(padded, n)
+                    clip.motion_score_global = float(g)
+                    clip.motion_score_per_patch_min = float(p)
+                except Exception as e:
+                    logger.warning("motion scoring failed for %s: %s", clip.uuid, e)
+                    clip.errors["motion"] = str(e)
+                    kept.append(clip)
+                    continue
+                if self.score_only or (
+                    clip.motion_score_global >= self.global_threshold
+                    and clip.motion_score_per_patch_min >= self.per_patch_threshold
+                ):
+                    kept.append(clip)
+                else:
+                    clip.filtered_by = "motion"
+                    video.filtered_clips.append(clip)
+            video.clips = kept
+        return tasks

@@ -1,0 +1,74 @@
+"""Shot-detection clip-extraction stage (TransNetV2 on TPU).
+
+Equivalent capability of the reference's ``TransNetV2ClipExtractionStage``
+(cosmos_curate/pipelines/video/clipping/transnetv2_extraction_stages.py:39):
+decode frames, run the shot detector, convert per-frame transition
+probabilities into filtered/cropped scene spans, emit Clips.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.models.transnetv2 import TransNetV2TPU
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.decode import decode_frames
+from cosmos_curate_tpu.video.splitter import make_clips, scene_spans_from_predictions
+
+logger = get_logger(__name__)
+
+
+class TransNetV2ClipExtractionStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        threshold: float = 0.4,
+        min_clip_len_s: float = 2.0,
+        max_clip_len_s: float = 60.0,
+        crop_s: float = 0.0,
+        decode_resize_hw: tuple[int, int] = (27, 48),
+        model: TransNetV2TPU | None = None,
+    ) -> None:
+        self.threshold = threshold
+        self.min_clip_len_s = min_clip_len_s
+        self.max_clip_len_s = max_clip_len_s
+        self.crop_s = crop_s
+        self.decode_resize_hw = decode_resize_hw
+        self._model = model if model is not None else TransNetV2TPU()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        for task in tasks:
+            video = task.video
+            if video.errors:
+                continue
+            src = video.raw_bytes if video.raw_bytes is not None else video.path
+            try:
+                # decode directly at the model's input resolution
+                frames = decode_frames(src, resize_hw=self.decode_resize_hw)
+                if frames.shape[0] == 0:
+                    video.errors["shot_detection"] = "no frames decoded"
+                    continue
+                probs = self._model.predict_transitions(frames)
+                spans = scene_spans_from_predictions(
+                    probs,
+                    fps=video.metadata.fps,
+                    threshold=self.threshold,
+                    min_scene_len_s=self.min_clip_len_s,
+                    max_scene_len_s=self.max_clip_len_s,
+                    crop_s=self.crop_s,
+                )
+                video.clips = make_clips(video.path, spans)
+                video.num_total_clips = len(video.clips)
+            except Exception as e:
+                logger.warning("shot detection failed for %s: %s", video.path, e)
+                video.errors["shot_detection"] = str(e)
+        return tasks

@@ -94,9 +94,12 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
                         },
                     )
             self._write_resume_record(task)
-            # Free payloads: downstream (engine) only needs stats/metadata.
-            for clip in video.clips:
+            # Free payloads (kept AND filtered clips): downstream only needs
+            # stats/metadata, and filtered clips otherwise pin their mp4 +
+            # frame arrays for the rest of the run.
+            for clip in (*video.clips, *video.filtered_clips):
                 clip.encoded_data = None
+                clip.webp_preview = None
                 clip.release_frames()
                 for w in clip.windows:
                     w.release_payloads()

@@ -36,7 +36,9 @@ def build_summary(
             total_video_duration_s += t.video.metadata.duration_s
             # Video-level errors are copied into every chunk; count them once.
             num_errors += len(t.video.errors)
-        num_errors += sum(len(c.errors) for c in t.video.clips)
+        num_errors += sum(
+            len(c.errors) for c in (*t.video.clips, *t.video.filtered_clips)
+        )
     video_hours = total_video_duration_s / 3600.0
     run_days = pipeline_run_time_s / 86400.0 if pipeline_run_time_s > 0 else 0.0
     per_chip = (video_hours / run_days / num_chips) if run_days > 0 and num_chips else 0.0

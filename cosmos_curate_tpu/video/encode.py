@@ -73,45 +73,107 @@ def transcode_clip(
     *,
     resize_hw: tuple[int, int] | None = None,
 ) -> tuple[bytes, str]:
-    """Cut ``span_s`` (seconds) out of ``source`` and re-encode standalone.
+    """Cut one ``span_s`` (seconds) out of ``source``; see
+    ``transcode_clips`` for the multi-span single-pass API."""
+    results = transcode_clips(source, [span_s], resize_hw=resize_hw)
+    return results[0]
 
-    Returns (mp4 bytes, codec fourcc). Decode and encode stream frame-by-
-    frame so a 5-hour source never fully materializes.
+
+class _ClipWriter:
+    """One open encoder + temp file for a span being cut."""
+
+    def __init__(self, start_f: int, end_f: int):
+        self.start_f = start_f
+        self.end_f = end_f
+        self.path: str | None = None
+        self.writer: cv2.VideoWriter | None = None
+
+    def open(self, codec: str, fps: float, w: int, h: int) -> None:
+        fd, self.path = tempfile.mkstemp(suffix=".mp4")
+        os.close(fd)
+        self.writer = cv2.VideoWriter(self.path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+        if not self.writer.isOpened():
+            raise RuntimeError(f"encoder {codec} failed to open for {w}x{h}@{fps}")
+
+    def finish(self) -> bytes:
+        data = b""
+        if self.writer is not None:
+            self.writer.release()
+            self.writer = None
+        if self.path is not None:
+            with open(self.path, "rb") as f:
+                data = f.read()
+            os.unlink(self.path)
+            self.path = None
+        return data
+
+    def abort(self) -> None:
+        if self.writer is not None:
+            self.writer.release()
+            self.writer = None
+        if self.path is not None:
+            os.unlink(self.path)
+            self.path = None
+
+
+def transcode_clips(
+    source: str | bytes,
+    spans_s: list[tuple[float, float]],
+    *,
+    resize_hw: tuple[int, int] | None = None,
+) -> list[tuple[bytes, str]]:
+    """Cut every span of ``source`` in ONE sequential decode pass.
+
+    The naive per-clip approach decodes frames 0..end for each clip —
+    quadratic in clip count for a long video (360 clips of a 1-hour video =
+    ~180x redundant decode). Here the source is opened once, each frame is
+    decoded once, and every encoder whose span covers it receives it
+    (overlapping spans supported). Returns (mp4_bytes, codec) per span, in
+    input order; spans past end-of-stream yield empty bytes.
     """
     codec = _pick_codec()
+    if not spans_s:
+        return []
     with _open_capture(source) as cap:
         fps = float(cap.get(cv2.CAP_PROP_FPS)) or 24.0
-        start_f = int(span_s[0] * fps)
-        end_f = int(span_s[1] * fps)
-        fd, path = tempfile.mkstemp(suffix=".mp4")
-        os.close(fd)
-        writer = None
+        clips = [_ClipWriter(int(a * fps), int(b * fps)) for a, b in spans_s]
+        # sorted view by start frame for an O(1) active set sweep
+        pending = sorted(range(len(clips)), key=lambda i: clips[i].start_f)
+        active: list[int] = []
+        results: list[bytes] = [b""] * len(clips)
+        max_end = max(c.end_f for c in clips)
+        p = 0
+        idx = 0
         try:
-            idx = 0
-            while idx < end_f:
+            while idx < max_end:
                 ok = cap.grab()
                 if not ok:
                     break
-                if idx >= start_f:
+                while p < len(pending) and clips[pending[p]].start_f <= idx:
+                    active.append(pending[p])
+                    p += 1
+                done = [i for i in active if clips[i].end_f <= idx]
+                for i in done:
+                    results[i] = clips[i].finish()
+                    active.remove(i)
+                if active:
                     ok, bgr = cap.retrieve()
                     if not ok:
                         break
                     if resize_hw is not None:
-                        bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
-                    if writer is None:
-                        h, w = bgr.shape[:2]
-                        writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
-                        if not writer.isOpened():
-                            raise RuntimeError(f"encoder {codec} failed to open")
-                    writer.write(bgr)
+                        bgr = cv2.resize(
+                            bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA
+                        )
+                    h, w = bgr.shape[:2]
+                    for i in active:
+                        c = clips[i]
+                        if c.writer is None:
+                            c.open(codec, fps, w, h)
+                        c.writer.write(bgr)
                 idx += 1
-            if writer is None:
-                return b"", codec
-            writer.release()
-            writer = None
-            with open(path, "rb") as f:
-                return f.read(), codec
+            for i in active:
+                results[i] = clips[i].finish()
         finally:
-            if writer is not None:
-                writer.release()
-            os.unlink(path)
+            for c in clips:
+                c.abort()
+        return [(r, codec) for r in results]

@@ -1,0 +1,125 @@
+"""Model architecture tests (tiny configs, CPU, random weights)."""
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.models.clip import AestheticScorer, CLIPAestheticScorer, CLIPImageEmbeddings
+from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_TINY_TEST, VideoEmbedder
+from cosmos_curate_tpu.models.transnetv2 import TransNetV2TPU
+from cosmos_curate_tpu.models import registry
+
+
+@pytest.fixture(autouse=True)
+def _weights_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(registry.WEIGHTS_DIR_ENV, str(tmp_path / "weights"))
+
+
+class TestTransNetV2:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = TransNetV2TPU(batch_windows=2)
+        m.setup()
+        return m
+
+    def test_predictions_shape_and_range(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (130, 27, 48, 3), np.uint8)
+        probs = model.predict_transitions(frames)
+        assert probs.shape == (130,)
+        assert np.all((probs >= 0) & (probs <= 1))
+
+    def test_short_video(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (10, 27, 48, 3), np.uint8)
+        probs = model.predict_transitions(frames)
+        assert probs.shape == (10,)
+
+    def test_empty(self, model):
+        assert model.predict_transitions(np.zeros((0, 27, 48, 3), np.uint8)).shape == (0,)
+
+    def test_resizes_arbitrary_input(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (20, 64, 96, 3), np.uint8)
+        assert model.predict_transitions(frames).shape == (20,)
+
+    def test_requires_setup(self):
+        with pytest.raises(RuntimeError):
+            TransNetV2TPU().predict_transitions(np.zeros((5, 27, 48, 3), np.uint8))
+
+
+class TestCLIP:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = CLIPImageEmbeddings("clip-vit-tiny-test")
+        m.setup()
+        return m
+
+    def test_normalized_embeddings(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (6, 32, 32, 3), np.uint8)
+        emb = model.encode_frames(frames)
+        assert emb.shape == (6, 32)
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, atol=1e-5)
+
+    def test_resize_on_device(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (2, 64, 80, 3), np.uint8)
+        assert model.encode_frames(frames).shape == (2, 32)
+
+    def test_deterministic(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (2, 32, 32, 3), np.uint8)
+        np.testing.assert_array_equal(model.encode_frames(frames), model.encode_frames(frames))
+
+    def test_unknown_variant_rejected(self):
+        with pytest.raises(ValueError):
+            CLIPImageEmbeddings("clip-nope")
+
+
+class TestAesthetics:
+    def test_score_shape(self):
+        m = AestheticScorer(embedding_dim=32)
+        m.setup()
+        scores = m.score(np.random.default_rng(0).standard_normal((5, 32)).astype(np.float32))
+        assert scores.shape == (5,)
+
+    def test_fused_scorer(self):
+        m = CLIPAestheticScorer("clip-vit-tiny-test")
+        m.setup()
+        frames = np.random.default_rng(0).integers(0, 255, (4, 32, 32, 3), np.uint8)
+        assert m.score_frames(frames).shape == (4,)
+
+
+class TestVideoEmbedder:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = VideoEmbedder(VIDEO_EMBED_TINY_TEST)
+        m.setup()
+        return m
+
+    def test_encode_clips(self, model):
+        clips = np.random.default_rng(0).integers(0, 255, (3, 4, 32, 32, 3), np.uint8)
+        emb = model.encode_clips(clips)
+        assert emb.shape == (3, 32)
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, atol=1e-5)
+
+    def test_frame_sampling(self, model):
+        idx = model.sample_frame_indices(100)
+        assert idx.shape == (4,)
+        assert idx[0] == 0 and idx[-1] == 99
+
+    def test_distinct_inputs_distinct_embeddings(self, model):
+        a = np.zeros((1, 4, 32, 32, 3), np.uint8)
+        b = np.full((1, 4, 32, 32, 3), 255, np.uint8)
+        ea, eb = model.encode_clips(a)[0], model.encode_clips(b)[0]
+        assert not np.allclose(ea, eb)
+
+
+class TestRegistry:
+    def test_registered_models(self):
+        ids = registry.registered_models()
+        assert "transnetv2-tpu" in ids
+        assert "clip-vit-l14-tpu" in ids
+
+    def test_checkpoint_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(registry.WEIGHTS_DIR_ENV, str(tmp_path))
+        import jax.numpy as jnp
+
+        params = {"w": jnp.arange(4.0), "b": jnp.ones(2)}
+        registry.save_params("aesthetics-mlp-tpu", params)
+        loaded = registry.load_params("aesthetics-mlp-tpu", lambda seed: {"w": jnp.zeros(4), "b": jnp.zeros(2)})
+        np.testing.assert_array_equal(np.asarray(loaded["w"]), np.arange(4.0))

@@ -1,0 +1,107 @@
+"""Parallelism tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cosmos_curate_tpu.parallel import MeshSpec, best_effort_mesh, local_mesh, shard_batch
+from cosmos_curate_tpu.parallel.ring_attention import attention_reference, ring_attention
+from cosmos_curate_tpu.parallel.ulysses import ulysses_attention
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    devs = np.array(jax.devices()).reshape(1, 1, 1, 8)
+    return Mesh(devs, axis_names=("dcn", "data", "model", "seq"))
+
+
+class TestMesh:
+    def test_best_effort_default(self):
+        mesh = best_effort_mesh()
+        assert mesh.shape["data"] == 8
+        assert mesh.shape["model"] == 1
+
+    def test_best_effort_model_axis(self):
+        mesh = best_effort_mesh(MeshSpec(data=2, model=4, seq=1))
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["model"] == 4
+
+    def test_best_effort_rejects_bad_shape(self):
+        with pytest.raises(ValueError):
+            best_effort_mesh(MeshSpec(dcn=3, data=3, model=1, seq=1))
+        with pytest.raises(ValueError):
+            best_effort_mesh(MeshSpec(dcn=-1, data=-1))
+
+    def test_local_mesh(self):
+        mesh = local_mesh(("model",))
+        assert mesh.shape["model"] == 8
+
+
+class TestShardBatch:
+    def test_even_batch(self):
+        mesh = best_effort_mesh()
+        x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+        sharded, pad = shard_batch(mesh, x)
+        assert pad == 0
+        assert sharded.shape == (16, 3)
+        np.testing.assert_array_equal(np.asarray(sharded), x)
+
+    def test_ragged_batch_padded(self):
+        mesh = best_effort_mesh()
+        x = np.ones((5, 4), np.float32)
+        sharded, pad = shard_batch(mesh, x)
+        assert pad == 3
+        assert sharded.shape == (8, 4)
+        np.testing.assert_array_equal(np.asarray(sharded)[5:], 0)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, seq_mesh, causal):
+        rng = np.random.default_rng(1)
+        b, h, s, d = 2, 4, 64, 16  # s sharded 8-way -> 8 tokens/device
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        ref = attention_reference(q, k, v, causal=causal)
+        spec = NamedSharding(seq_mesh, P(None, None, "seq", None))
+        qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+        out = jax.jit(
+            lambda a, b_, c: ring_attention(a, b_, c, seq_mesh, causal=causal)
+        )(qs, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_bf16(self, seq_mesh):
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((1, 2, 32, 8)), jnp.bfloat16)
+        ref = attention_reference(q, k, v)
+        out = ring_attention(q, k, v, seq_mesh)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+        )
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, seq_mesh, causal):
+        rng = np.random.default_rng(3)
+        b, h, s, d = 2, 8, 64, 16  # h=8 divides seq axis (8)
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        ref = attention_reference(q, k, v, causal=causal)
+        out = jax.jit(
+            lambda a, b_, c: ulysses_attention(a, b_, c, seq_mesh, causal=causal)
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+    def test_rejects_indivisible_heads(self, seq_mesh):
+        q = jnp.zeros((1, 3, 16, 8))
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(q, q, q, seq_mesh)
