@@ -1,0 +1,25 @@
+"""The streaming execution engine: the TPU-native equivalent of cosmos-xenna.
+
+The reference delegates execution to cosmos-xenna over Ray actor pools
+(SURVEY.md §1). Neither is available here, so this package implements the
+same execution semantics from scratch:
+
+- one worker pool per stage, autoscaled by measured throughput
+- a shared-memory object store moving payloads between processes without
+  re-serialization of large buffers (plasma-lite, PEP-574 zero-copy)
+- a central orchestration loop that moves *refs*, never data
+- backpressure: per-stage input queues bounded at max(16, 1.5 x pool size)
+- dynamic chunking (a stage may emit any number of tasks)
+- STREAMING (all stages live) and BATCH (stage-by-stage) modes
+- worker recycling, per-stage retries, prometheus `pipeline_*` gauges
+
+Device ownership (TPU-first): chips belong to ONE process per host — the
+engine process — so stages with TPU resources execute on an in-process
+executor there, while CPU stages fan out to spawned worker processes pinned
+to JAX_PLATFORMS=cpu. This replaces the reference's fractional-GPU actor
+packing with batch aggregation into the chip-owning process (SURVEY.md §7).
+"""
+
+from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+__all__ = ["StreamingRunner"]

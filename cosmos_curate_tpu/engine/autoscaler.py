@@ -1,0 +1,96 @@
+"""Throughput-based autoscaler.
+
+Equivalent capability of xenna's autoscaler (reference
+docs/curator/reference/ARCHITECTURE.md:83-93): measure per-worker throughput
+per stage, then solve for the worker allocation that maximizes *balanced*
+pipeline throughput under the CPU/TPU budget.
+
+Solver: water-filling. The pipeline rate is min over stages of
+(workers_i x rate_i); repeatedly grant a worker to the stage with the lowest
+projected stage rate until the budget is exhausted. Stages without
+throughput samples yet get their minimum and first claim on resources.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cosmos_curate_tpu.core.stage import StageSpec
+
+
+@dataclass
+class StageScaleState:
+    spec: StageSpec
+    current_workers: int
+    throughput_per_worker: float | None  # batches/s; None = unknown yet
+    queued: int
+
+
+@dataclass(frozen=True)
+class Budget:
+    cpus: float
+    tpus: float
+
+
+def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
+    """Target worker counts per stage (same order as input)."""
+    n = len(stages)
+    alloc = [0] * n
+    cpu_left = budget.cpus
+    tpu_left = budget.tpus
+
+    def cost(i: int) -> tuple[float, float]:
+        r = stages[i].spec.stage.resources
+        tpus = r.tpus if not r.entire_tpu_host else budget.tpus
+        return (r.cpus, tpus)
+
+    def fits(i: int) -> bool:
+        c, t = cost(i)
+        return c <= cpu_left + 1e-9 and t <= tpu_left + 1e-9
+
+    def grant(i: int) -> None:
+        nonlocal cpu_left, tpu_left
+        c, t = cost(i)
+        alloc[i] += 1
+        cpu_left -= c
+        tpu_left -= t
+
+    # 1. minimum viable allocation: every stage gets >= min_workers (>=1)
+    #    even if that oversubscribes the host — a pipeline where some stage
+    #    has zero workers can never finish. Only *additional* workers
+    #    respect the budget.
+    for i, st in enumerate(stages):
+        want = max(1, st.spec.min_workers)
+        if st.spec.num_workers is not None:
+            want = st.spec.num_workers
+        if st.spec.stage.resources.uses_tpu:
+            want = 1  # one in-process worker per TPU stage (see engine/pool.py)
+        grant(i)  # unconditional first worker
+        for _ in range(want - 1):
+            if fits(i):
+                grant(i)
+
+    # 2. water-fill the bottleneck with the remaining budget
+    while True:
+        best = None
+        best_rate = None
+        for i, st in enumerate(stages):
+            if st.spec.num_workers is not None:  # fixed-size pool
+                continue
+            cap = st.spec.max_workers
+            if cap is not None and alloc[i] >= cap:
+                continue
+            if not fits(i):
+                continue
+            # TPU in-process pools don't scale by worker count
+            if st.spec.stage.resources.uses_tpu and alloc[i] >= 1:
+                continue
+            rate = st.throughput_per_worker
+            projected = (rate if rate is not None else 1.0) * alloc[i]
+            if best_rate is None or projected < best_rate:
+                best_rate = projected
+                best = i
+        if best is None:
+            break
+        grant(best)
+    return alloc

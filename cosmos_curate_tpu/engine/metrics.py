@@ -1,0 +1,76 @@
+"""Prometheus gauges for the engine.
+
+Equivalent of xenna's runtime gauges (reference
+docs/curator/guides/OBSERVABILITY.md:286-330, ``ray_pipeline_*``): same
+panel semantics under a ``pipeline_*`` prefix so the reference's Grafana
+dashboard ports with a find/replace. No-op when prometheus_client is absent
+or the exporter port is disabled.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+_SINGLETON: "EngineMetrics | None" = None
+
+
+def get_metrics(port: int | None = None) -> "EngineMetrics":
+    """Process-wide singleton: prometheus collectors register globally, so a
+    second EngineMetrics in the same process would collide."""
+    global _SINGLETON
+    if _SINGLETON is None:
+        _SINGLETON = EngineMetrics(port)
+    return _SINGLETON
+
+
+class EngineMetrics:
+    def __init__(self, port: int | None = None) -> None:
+        self.enabled = False
+        try:
+            from prometheus_client import Counter, Gauge, start_http_server
+        except ImportError:
+            return
+        labels = ["stage"]
+        self.actor_count = Gauge("pipeline_actor_count", "workers per stage", labels + ["state"])
+        self.input_queue_size = Gauge("pipeline_input_queue_size", "queued tasks", labels)
+        self.process_time_total = Counter(
+            "pipeline_stage_process_time_total", "sum of process seconds", labels
+        )
+        self.deserialize_time_total = Counter(
+            "pipeline_stage_deserialize_time_total", "sum of deserialize seconds", labels
+        )
+        self.tasks_total = Counter("pipeline_tasks_processed_total", "tasks out", labels)
+        self.errors_total = Counter("pipeline_task_errors_total", "batch errors", labels)
+        self.store_bytes = Gauge("pipeline_object_store_bytes", "object store usage", [])
+        if port is not None:
+            try:
+                start_http_server(port)
+                logger.info("prometheus metrics on :%d", port)
+            except OSError as e:
+                logger.warning("metrics server failed to start: %s", e)
+        self.enabled = True
+
+    def observe_result(self, stage: str, process_s: float, deser_s: float, n_out: int) -> None:
+        if not self.enabled:
+            return
+        self.process_time_total.labels(stage).inc(process_s)
+        self.deserialize_time_total.labels(stage).inc(deser_s)
+        self.tasks_total.labels(stage).inc(n_out)
+
+    def observe_error(self, stage: str) -> None:
+        if self.enabled:
+            self.errors_total.labels(stage).inc()
+
+    def set_pool_state(self, stage: str, ready: int, pending: int, queued: int) -> None:
+        if not self.enabled:
+            return
+        self.actor_count.labels(stage, "ready").set(ready)
+        self.actor_count.labels(stage, "pending").set(pending)
+        self.input_queue_size.labels(stage).set(queued)
+
+    def set_store_bytes(self, used: int) -> None:
+        if self.enabled:
+            self.store_bytes.set(used)

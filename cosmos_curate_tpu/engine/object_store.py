@@ -1,0 +1,186 @@
+"""Shared-memory object store (plasma-lite).
+
+Equivalent capability of the Ray object store the reference rides
+(ARCHITECTURE.md:29-32 in /root/reference/docs — refs move centrally, data
+stays put): objects are pickled with protocol 5, large buffers (numpy
+arrays, bytes) land in one POSIX shared-memory segment per object, and only
+a small ``ObjectRef`` travels through queues. A consumer process maps the
+segment and reconstructs the object with zero-copy views for numpy arrays.
+
+Ownership: the creating side holds the segment; the engine coordinator
+tracks refcounts and unlinks when every consumer is done. Capacity is
+budgeted; ``put`` blocks (backpressure) when the store is full.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import threading
+import uuid
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_HEADER = 8  # u64 pickle-bytes length prefix
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """48-byte-ish handle that travels through control queues."""
+
+    shm_name: str
+    total_size: int
+    num_buffers: int
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.shm_name}, {self.total_size}B)"
+
+
+def put(obj, *, prefix: str | None = None) -> ObjectRef:
+    """Serialize ``obj`` into a fresh shm segment; returns its ref.
+
+    Segment names embed the *coordinator's* pid (``cur<pid>-<hex>``) so the
+    janitor can reclaim segments after a whole pipeline dies. Workers inherit
+    the coordinator pid via ``CURATE_STORE_OWNER`` — segments must NOT carry
+    the worker's own pid, because recycled/crashed workers leave live data
+    behind that downstream stages still consume."""
+    if prefix is None:
+        prefix = f"cur{os.environ.get('CURATE_STORE_OWNER', os.getpid())}"
+    buffers: list[pickle.PickleBuffer] = []
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    sizes = [len(v) for v in views]
+    # layout: [u64 len(payload)][payload][u64 nbuf][u64 size]*nbuf [buffers...]
+    meta = len(sizes).to_bytes(8, "little") + b"".join(s.to_bytes(8, "little") for s in sizes)
+    total = _HEADER + len(payload) + len(meta) + sum(sizes)
+    name = f"{prefix}-{uuid.uuid4().hex[:16]}"
+    seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 16))
+    try:
+        mv = seg.buf
+        try:
+            mv[:_HEADER] = len(payload).to_bytes(8, "little")
+            off = _HEADER
+            mv[off : off + len(payload)] = payload
+            off += len(payload)
+            mv[off : off + len(meta)] = meta
+            off += len(meta)
+            for v in views:
+                n = v.nbytes
+                mv[off : off + n] = v.cast("B") if v.ndim != 1 or v.format != "B" else v
+                off += n
+        finally:
+            del mv  # release exported pointer before close
+    finally:
+        for b in buffers:
+            b.release()
+        seg.close()
+    return ObjectRef(shm_name=name, total_size=total, num_buffers=len(sizes))
+
+
+def get(ref: ObjectRef):
+    """Reconstruct the object (one copy out of shm, so the segment can be
+    freed immediately and consumers own their data)."""
+    seg = shared_memory.SharedMemory(name=ref.shm_name)
+    try:
+        mv = seg.buf
+        try:
+            plen = int.from_bytes(mv[:_HEADER], "little")
+            off = _HEADER
+            payload = bytes(mv[off : off + plen])
+            off += plen
+            nbuf = int.from_bytes(mv[off : off + 8], "little")
+            off += 8
+            sizes = [
+                int.from_bytes(mv[off + 8 * i : off + 8 * (i + 1)], "little")
+                for i in range(nbuf)
+            ]
+            off += 8 * nbuf
+            bufs = []
+            for s in sizes:
+                bufs.append(bytes(mv[off : off + s]))
+                off += s
+            return pickle.loads(payload, buffers=bufs)
+        finally:
+            del mv
+    finally:
+        seg.close()
+
+
+def delete(ref: ObjectRef) -> None:
+    try:
+        seg = shared_memory.SharedMemory(name=ref.shm_name)
+        seg.close()
+        seg.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def cleanup_stale_segments(shm_dir: str = "/dev/shm") -> int:
+    """Unlink ``cur<pid>-*`` segments whose creating process is gone —
+    crashed or killed runs must not leak shared memory forever. Returns the
+    number reclaimed. Safe against concurrent live pipelines: only segments
+    of dead pids are touched."""
+    n = 0
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0
+    for name in names:
+        m = re.fullmatch(r"cur(\d+)-[0-9a-f]+", name)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            continue  # someone else's pid namespace; leave it
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+            n += 1
+        except OSError:
+            pass
+    if n:
+        logger.info("reclaimed %d stale object-store segments", n)
+    return n
+
+
+class StoreBudget:
+    """Coordinator-side capacity accounting with blocking backpressure."""
+
+    def __init__(self, capacity_bytes: int) -> None:
+        self.capacity = capacity_bytes
+        self._used = 0
+        self._live: dict[str, int] = {}
+        self._cv = threading.Condition()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    def account(self, ref: ObjectRef) -> None:
+        """Unconditionally account an object that already exists (stage
+        outputs): accounting must never lose track of live segments, so
+        this can push ``used`` above capacity — ``has_headroom`` then gates
+        new admissions (input seeding) until consumers release."""
+        with self._cv:
+            self._live[ref.shm_name] = ref.total_size
+            self._used += ref.total_size
+
+    def has_headroom(self) -> bool:
+        with self._cv:
+            return self._used < self.capacity or not self._live
+
+    def release(self, ref: ObjectRef) -> None:
+        with self._cv:
+            size = self._live.pop(ref.shm_name, 0)
+            self._used -= size
+            self._cv.notify_all()
+        delete(ref)

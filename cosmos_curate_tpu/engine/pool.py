@@ -1,0 +1,285 @@
+"""Per-stage worker pools.
+
+Two pool kinds behind one interface:
+
+- ``ProcessPool`` — CPU stages: spawned worker processes (engine/worker.py)
+  with per-worker control queues and a pool-shared result queue.
+- ``InProcessPool`` — TPU stages: a thread inside the engine process, which
+  is the sole owner of the host's chips (package docstring). One worker —
+  batch aggregation, not device sharing, is how TPU stages scale per host.
+
+Both consume/produce ``ObjectRef``s so the orchestration loop has a single
+data path.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+
+import cloudpickle
+
+from cosmos_curate_tpu.core.stage import NodeInfo, StageSpec, WorkerMetadata
+from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.engine.worker import (
+    ProcessMsg,
+    ReadyMsg,
+    ResultMsg,
+    SetupMsg,
+    ShutdownMsg,
+    worker_main,
+)
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MP = mp.get_context("spawn")
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    in_q: object
+    proc: object | None = None  # mp.Process for ProcessPool
+    ready: bool = False
+    busy_batch: int | None = None
+    started_at: float = field(default_factory=time.monotonic)
+    batches_done: int = 0
+    recycle_requested: bool = False
+
+
+class BasePool:
+    """Shared bookkeeping for both pool kinds.
+
+    ``pool_id`` (the stage index) namespaces worker ids — the same stage
+    class may appear at several pipeline positions, and result routing is
+    by worker id, so ids must be unique across pools."""
+
+    def __init__(self, spec: StageSpec, node: NodeInfo, pool_id: int = 0) -> None:
+        self.spec = spec
+        self.pool_id = pool_id
+        self.stage = spec.stage
+        self.node = node
+        self.workers: dict[str, WorkerHandle] = {}
+        self._next_id = 0
+        # recent (finish_time, process_time_s) samples for the autoscaler
+        self.samples: list[tuple[float, float]] = []
+        # workers told to shut down, awaiting reap (never blocks the loop)
+        self.draining: list[tuple[WorkerHandle, float]] = []
+        # workers that died before ever becoming ready (setup-crash guard)
+        self.setup_deaths: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def idle_workers(self) -> list[WorkerHandle]:
+        return [w for w in self.workers.values() if w.ready and w.busy_batch is None]
+
+    def num_workers(self) -> int:
+        return len(self.workers)
+
+    def record_sample(self, process_time_s: float) -> None:
+        now = time.monotonic()
+        self.samples.append((now, process_time_s))
+        cutoff = now - 600.0
+        while self.samples and self.samples[0][0] < cutoff:
+            self.samples.pop(0)
+
+    def throughput_per_worker(self, window_s: float) -> float | None:
+        """Batches/sec one worker achieves, from recent samples."""
+        now = time.monotonic()
+        recent = [p for (t, p) in self.samples if t >= now - window_s]
+        if not recent:
+            return None
+        mean_t = sum(recent) / len(recent)
+        return 1.0 / mean_t if mean_t > 0 else None
+
+    def lifetime_expired(self, w: WorkerHandle) -> bool:
+        lim = self.spec.worker_max_lifetime_m or 0
+        return lim > 0 and (time.monotonic() - w.started_at) > lim * 60
+
+    # subclass API
+    def start_worker(self) -> WorkerHandle:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def stop_worker(self, w: WorkerHandle) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def submit(self, w: WorkerHandle, batch_id: int, refs: list) -> None:
+        w.busy_batch = batch_id
+        w.in_q.put(ProcessMsg(batch_id=batch_id, refs=refs))
+
+    def reap_draining(self, *, force_after_s: float = 5.0) -> None:
+        """Non-blocking cleanup of workers previously told to stop."""
+        still = []
+        now = time.monotonic()
+        for w, since in self.draining:
+            proc = w.proc
+            if proc is None or not proc.is_alive():
+                if proc is not None:
+                    proc.join(timeout=0)
+                continue
+            if now - since > force_after_s:
+                proc.terminate()
+                continue
+            still.append((w, since))
+        self.draining = still
+
+    def shutdown(self) -> None:
+        for w in list(self.workers.values()):
+            self.stop_worker(w)
+        # final shutdown may block briefly; not on the orchestration path
+        deadline = time.monotonic() + 5.0
+        for w, _ in self.draining:
+            proc = w.proc
+            if proc is None:
+                continue
+            proc.join(timeout=max(0.0, deadline - time.monotonic()))
+            if proc.is_alive():
+                proc.terminate()
+        self.draining.clear()
+
+
+class ProcessPool(BasePool):
+    def __init__(self, spec: StageSpec, node: NodeInfo, results_q, pool_id: int = 0) -> None:
+        super().__init__(spec, node, pool_id)
+        self.results_q = results_q  # mp queue shared by all pools' processes
+        self._stage_pickle = cloudpickle.dumps(spec.stage)
+
+    def start_worker(self) -> WorkerHandle:
+        wid = f"s{self.pool_id}-{self.name}-p{self._next_id}"
+        self._next_id += 1
+        in_q = _MP.Queue()
+        import os
+
+        env = {
+            "JAX_PLATFORMS": "cpu",  # CPU workers must never claim the TPU
+            "CURATE_WORKER_ID": wid,
+            "OPENCV_FFMPEG_LOGLEVEL": "-8",
+            # segments a worker creates are owned by this coordinator process
+            # (see object_store.put): recycled workers leave live data behind
+            "CURATE_STORE_OWNER": os.environ.get(
+                "CURATE_STORE_OWNER", str(os.getpid())
+            ),
+        }
+        proc = _MP.Process(
+            target=worker_main, args=(in_q, self.results_q, env), daemon=True, name=wid
+        )
+        proc.start()
+        meta = WorkerMetadata(
+            worker_id=wid, stage_name=self.name, node=self.node, allocation=self.stage.resources
+        )
+        in_q.put(SetupMsg(self._stage_pickle, cloudpickle.dumps(meta)))
+        handle = WorkerHandle(worker_id=wid, in_q=in_q, proc=proc)
+        self.workers[wid] = handle
+        return handle
+
+    def stop_worker(self, w: WorkerHandle) -> None:
+        """Request shutdown; never blocks (reap_draining finishes the job)."""
+        try:
+            w.in_q.put(ShutdownMsg())
+        except Exception:
+            pass
+        self.workers.pop(w.worker_id, None)
+        if w.proc is not None:
+            self.draining.append((w, time.monotonic()))
+
+
+class InProcessPool(BasePool):
+    """TPU stages: worker threads in the engine process (chip owner)."""
+
+    def __init__(
+        self, spec: StageSpec, node: NodeInfo, results_q: queue.Queue, pool_id: int = 0
+    ) -> None:
+        super().__init__(spec, node, pool_id)
+        self.results_q = results_q
+        self._lock = threading.Lock()  # device stages run one batch at a time
+
+    def start_worker(self) -> WorkerHandle:
+        if self.workers:
+            # One in-process worker per TPU stage: threads would share the
+            # same stage instance (double setup, destroy-while-in-use).
+            raise RuntimeError(
+                f"TPU stage {self.name} supports exactly one in-process "
+                f"worker; scale by batch aggregation, not worker count"
+            )
+        wid = f"s{self.pool_id}-{self.name}-t{self._next_id}"
+        self._next_id += 1
+        in_q: queue.Queue = queue.Queue()
+        handle = WorkerHandle(worker_id=wid, in_q=in_q)
+        self.workers[wid] = handle
+        threading.Thread(
+            target=self._thread_main, args=(handle,), daemon=True, name=wid
+        ).start()
+        return handle
+
+    def _thread_main(self, handle: WorkerHandle) -> None:
+        stage = self.stage
+        meta = WorkerMetadata(
+            worker_id=handle.worker_id,
+            stage_name=self.name,
+            node=self.node,
+            allocation=stage.resources,
+        )
+        try:
+            with self._lock:
+                stage.setup_on_node(self.node, meta)
+                stage.setup(meta)
+            self.results_q.put(ReadyMsg(worker_id=handle.worker_id))
+        except Exception:
+            self.results_q.put(
+                ReadyMsg(worker_id=handle.worker_id, error=traceback.format_exc())
+            )
+            return
+        while True:
+            msg = handle.in_q.get()
+            if isinstance(msg, ShutdownMsg):
+                break
+            t0 = time.monotonic()
+            try:
+                tasks = [object_store.get(r) for r in msg.refs]
+                dt = time.monotonic() - t0
+                with self._lock:
+                    result = stage.process_data(tasks)
+                if result is not None and not isinstance(result, list):
+                    raise TypeError(
+                        f"stage {self.name}.process_data must return list or None"
+                    )
+                out_refs = [object_store.put(t) for t in (result or [])]
+                self.results_q.put(
+                    ResultMsg(
+                        msg.batch_id,
+                        out_refs=out_refs,
+                        process_time_s=time.monotonic() - t0 - dt,
+                        deserialize_time_s=dt,
+                        worker_id=handle.worker_id,
+                    )
+                )
+            except Exception:
+                self.results_q.put(
+                    ResultMsg(
+                        msg.batch_id,
+                        error=traceback.format_exc(),
+                        process_time_s=time.monotonic() - t0,
+                        worker_id=handle.worker_id,
+                    )
+                )
+        try:
+            stage.destroy()
+        except Exception:
+            pass
+
+    def stop_worker(self, w: WorkerHandle) -> None:
+        w.in_q.put(ShutdownMsg())
+        self.workers.pop(w.worker_id, None)
+
+
+def make_pool(spec: StageSpec, node: NodeInfo, mp_results_q, thread_results_q, pool_id: int = 0):
+    if spec.stage.resources.uses_tpu:
+        return InProcessPool(spec, node, thread_results_q, pool_id)
+    return ProcessPool(spec, node, mp_results_q, pool_id)

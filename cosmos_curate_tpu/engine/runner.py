@@ -1,0 +1,387 @@
+"""StreamingRunner: the central orchestration loop.
+
+Equivalent capability of xenna's engine (reference ARCHITECTURE.md:20-110):
+refs — never payloads — move between per-stage pools; input queues are
+bounded (backpressure, max(lower_bound, multiplier x pool size)); stages may
+emit any number of tasks (dynamic chunking); batches retry per
+``num_run_attempts``; dead workers are detected and their batch re-queued;
+workers recycle after ``worker_max_lifetime_m``; a throughput autoscaler
+re-plans pool sizes on a cadence. STREAMING keeps all pools live; BATCH
+runs stage-by-stage, letting each use the whole budget.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from cosmos_curate_tpu.core.pipeline import ExecutionMode, PipelineSpec
+from cosmos_curate_tpu.core.runner import RunnerInterface
+from cosmos_curate_tpu.core.stage import NodeInfo, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.engine.autoscaler import Budget, StageScaleState, plan_allocation
+from cosmos_curate_tpu.engine.metrics import get_metrics
+from cosmos_curate_tpu.engine.pool import BasePool, ProcessPool, make_pool
+from cosmos_curate_tpu.engine.worker import ReadyMsg, ResultMsg
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _Batch:
+    batch_id: int
+    stage_idx: int
+    refs: list[object_store.ObjectRef]
+    attempts: int = 0
+
+
+@dataclass
+class _StageState:
+    spec: StageSpec
+    pool: BasePool
+    in_queue: deque = field(default_factory=deque)  # ObjectRefs of tasks
+    retry_queue: deque = field(default_factory=deque)  # _Batch objects
+    dispatched: int = 0
+    completed: int = 0
+    errored_batches: int = 0
+
+    def queue_limit(self, lower: int, mult: float) -> int:
+        return max(lower, int(mult * max(1, self.pool.num_workers())))
+
+
+class StreamingRunner(RunnerInterface):
+    def __init__(self, *, metrics_port: int | None = None, poll_interval_s: float = 0.02) -> None:
+        self.metrics = get_metrics(metrics_port)
+        self.poll_interval_s = poll_interval_s
+
+    # ------------------------------------------------------------------
+    def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        if not spec.stages:
+            return list(spec.input_data) if spec.config.return_last_stage_outputs else None
+        if spec.config.execution_mode is ExecutionMode.BATCH:
+            return self._run_batch(spec)
+        return self._run_streaming(spec, spec.stages)
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        """Stage-by-stage: each stage is a one-stage streaming run.
+
+        Intermediate stages must always return their outputs (they feed the
+        next stage) regardless of ``return_last_stage_outputs``, which only
+        governs the final stage."""
+        from dataclasses import replace as dc_replace
+
+        tasks: list[PipelineTask] = list(spec.input_data)
+        inner_cfg = dc_replace(spec.config, return_last_stage_outputs=True)
+        for i, stage_spec in enumerate(spec.stages):
+            last = i == len(spec.stages) - 1
+            cfg = spec.config if last else inner_cfg
+            sub = PipelineSpec(input_data=tasks, stages=[stage_spec], config=cfg)
+            tasks = self._run_streaming(sub, [stage_spec]) or []
+        return tasks if spec.config.return_last_stage_outputs else None
+
+    # ------------------------------------------------------------------
+    def _run_streaming(
+        self, spec: PipelineSpec, stage_specs: list[StageSpec]
+    ) -> list[PipelineTask] | None:
+        cfg = spec.config
+        object_store.cleanup_stale_segments()
+        node = NodeInfo(
+            node_id="local",
+            num_cpus=cfg.num_cpus or float(os.cpu_count() or 1),
+            num_tpu_chips=self._discover_tpus(cfg, stage_specs),
+        )
+        budget = Budget(cpus=node.num_cpus, tpus=float(node.num_tpu_chips))
+        mp_results: mp.Queue = mp.get_context("spawn").Queue()
+        thread_results: queue.Queue = queue.Queue()
+        states = [
+            _StageState(spec=s, pool=make_pool(s, node, mp_results, thread_results, pool_id=i))
+            for i, s in enumerate(stage_specs)
+        ]
+        store = object_store.StoreBudget(
+            capacity_bytes=int(_host_memory_bytes() * cfg.streaming.object_store_fraction)
+        )
+        # Segments created by this run (and its workers) carry this pid.
+        os.environ.setdefault("CURATE_STORE_OWNER", str(os.getpid()))
+
+        # Inputs are seeded lazily inside the loop, gated on store headroom
+        # and the first stage's queue bound — a huge input list must not
+        # fill /dev/shm upfront.
+        pending_inputs = iter(spec.input_data)
+        inputs_exhausted = not spec.input_data
+
+        # initial allocation
+        self._apply_allocation(states, budget, cfg)
+
+        batches: dict[int, _Batch] = {}
+        next_batch_id = 0
+        outputs: list[object_store.ObjectRef] = []
+        last_autoscale = time.monotonic()
+        pending_setup_errors: list[str] = []
+
+        try:
+            while True:
+                progressed = False
+                # 0. seed more inputs while the store has headroom
+                if not inputs_exhausted:
+                    limit0 = states[0].queue_limit(
+                        cfg.streaming.max_queued_lower_bound,
+                        cfg.streaming.max_queued_multiplier,
+                    )
+                    while len(states[0].in_queue) < limit0 and store.has_headroom():
+                        task = next(pending_inputs, None)
+                        if task is None:
+                            inputs_exhausted = True
+                            break
+                        ref = object_store.put(task)
+                        store.account(ref)
+                        states[0].in_queue.append(ref)
+                        progressed = True
+                # 1. drain results
+                for msg in self._drain(mp_results, thread_results):
+                    progressed = True
+                    if isinstance(msg, ReadyMsg):
+                        self._on_ready(states, msg, pending_setup_errors)
+                        continue
+                    self._on_result(states, batches, msg, outputs, store, cfg)
+                if pending_setup_errors:
+                    raise RuntimeError(
+                        "stage worker setup failed:\n" + "\n".join(pending_setup_errors)
+                    )
+                # 2. detect dead workers; reap draining ones (non-blocking)
+                progressed |= self._reap_dead_workers(states, batches, store)
+                for st in states:
+                    if isinstance(st.pool, ProcessPool):
+                        st.pool.reap_draining()
+                # 3. dispatch
+                for i, st in enumerate(states):
+                    limit_next = (
+                        states[i + 1].queue_limit(
+                            cfg.streaming.max_queued_lower_bound, cfg.streaming.max_queued_multiplier
+                        )
+                        if i + 1 < len(states)
+                        else None
+                    )
+                    if limit_next is not None and len(states[i + 1].in_queue) >= limit_next:
+                        continue  # backpressure: downstream full
+                    bs = max(1, st.spec.stage.batch_size)
+                    for w in st.pool.idle_workers():
+                        if st.pool.lifetime_expired(w) and w.busy_batch is None:
+                            st.pool.stop_worker(w)
+                            st.pool.start_worker()
+                            continue
+                        if st.retry_queue:  # failed batches keep their identity
+                            batch = st.retry_queue.popleft()
+                        elif st.in_queue:
+                            refs = [
+                                st.in_queue.popleft()
+                                for _ in range(min(bs, len(st.in_queue)))
+                            ]
+                            batch = _Batch(next_batch_id, i, refs)
+                            next_batch_id += 1
+                        else:
+                            break
+                        batches[batch.batch_id] = batch
+                        st.pool.submit(w, batch.batch_id, batch.refs)
+                        st.dispatched += 1
+                        progressed = True
+                # 4. autoscale
+                now = time.monotonic()
+                if now - last_autoscale >= cfg.streaming.autoscale_interval_s:
+                    self._apply_allocation(states, budget, cfg)
+                    last_autoscale = now
+                # 5. metrics + completion
+                for st in states:
+                    ready = len([w for w in st.pool.workers.values() if w.ready])
+                    pending = st.pool.num_workers() - ready
+                    self.metrics.set_pool_state(st.spec.name, ready, pending, len(st.in_queue))
+                self.metrics.set_store_bytes(store.used)
+                if (
+                    inputs_exhausted
+                    and not batches
+                    and all(not st.in_queue and not st.retry_queue for st in states)
+                ):
+                    break
+                if not progressed:
+                    time.sleep(self.poll_interval_s)
+            # materialize outputs
+            if cfg.return_last_stage_outputs:
+                result = [object_store.get(r) for r in outputs]
+            else:
+                result = None
+            return result
+        finally:
+            for r in outputs:
+                store.release(r)
+            for batch in batches.values():  # in-flight on exception exit
+                for r in batch.refs:
+                    store.release(r)
+            for st in states:
+                for r in st.in_queue:
+                    store.release(r)
+                for batch in st.retry_queue:
+                    for r in batch.refs:
+                        store.release(r)
+                st.pool.shutdown()
+
+    # ------------------------------------------------------------------
+    def _on_ready(self, states, msg: ReadyMsg, errors: list[str]) -> None:
+        for st in states:
+            w = st.pool.workers.get(msg.worker_id)
+            if w is None:
+                continue
+            if msg.error:
+                errors.append(f"[{st.spec.name}/{msg.worker_id}] {msg.error}")
+            else:
+                w.ready = True
+            return
+
+    def _on_result(self, states, batches, msg: ResultMsg, outputs, store, cfg) -> None:
+        batch = batches.pop(msg.batch_id, None)
+        if batch is None:
+            # Late result for a batch the reaper already requeued (worker
+            # sent the result then died). At-least-once semantics: the rerun
+            # wins; this result's outputs must not leak.
+            for r in msg.out_refs:
+                object_store.delete(r)
+            return
+        st = states[batch.stage_idx]
+        w = st.pool.workers.get(msg.worker_id)
+        if w is not None:
+            w.busy_batch = None
+            w.batches_done += 1
+        if msg.error is not None:
+            self.metrics.observe_error(st.spec.name)
+            batch.attempts += 1
+            if batch.attempts < max(1, st.spec.num_run_attempts):
+                logger.warning(
+                    "stage %s batch %d failed (attempt %d), retrying:\n%s",
+                    st.spec.name, batch.batch_id, batch.attempts, _tail(msg.error),
+                )
+                st.retry_queue.append(batch)
+            else:
+                logger.error(
+                    "stage %s batch %d failed permanently, dropping %d tasks:\n%s",
+                    st.spec.name, batch.batch_id, len(batch.refs), _tail(msg.error),
+                )
+                st.errored_batches += 1
+                for r in batch.refs:
+                    store.release(r)
+            return
+        st.completed += 1
+        st.pool.record_sample(msg.process_time_s)
+        self.metrics.observe_result(
+            st.spec.name, msg.process_time_s, msg.deserialize_time_s, len(msg.out_refs)
+        )
+        for r in batch.refs:
+            store.release(r)
+        nxt = batch.stage_idx + 1
+        for r in msg.out_refs:
+            store.account(r)  # queue bounds + input gating provide backpressure
+            if nxt < len(states):
+                states[nxt].in_queue.append(r)
+            else:
+                outputs.append(r)
+
+    _MAX_SETUP_DEATHS = 3
+
+    def _reap_dead_workers(self, states, batches, store) -> bool:
+        progressed = False
+        for st in states:
+            if not isinstance(st.pool, ProcessPool):
+                continue
+            for w in list(st.pool.workers.values()):
+                proc = w.proc
+                if proc is not None and not proc.is_alive():
+                    logger.warning("worker %s died (exit %s)", w.worker_id, proc.exitcode)
+                    st.pool.workers.pop(w.worker_id, None)
+                    if not w.ready:
+                        # died before ReadyMsg: likely a setup crash. A cap
+                        # prevents an infinite respawn loop when setup is
+                        # deterministically broken (e.g. OOM loading weights).
+                        st.pool.setup_deaths += 1
+                        if st.pool.setup_deaths >= self._MAX_SETUP_DEATHS:
+                            raise RuntimeError(
+                                f"stage {st.spec.name}: {st.pool.setup_deaths} workers "
+                                f"died during setup (last exit {proc.exitcode}); "
+                                f"aborting pipeline"
+                            )
+                    if w.busy_batch is not None and w.busy_batch in batches:
+                        batch = batches.pop(w.busy_batch)
+                        batch.attempts += 1
+                        if batch.attempts < max(1, st.spec.num_run_attempts):
+                            st.retry_queue.append(batch)
+                        else:
+                            st.errored_batches += 1
+                            for r in batch.refs:
+                                store.release(r)
+                    st.pool.start_worker()
+                    progressed = True
+        return progressed
+
+    def _apply_allocation(self, states, budget: Budget, cfg) -> None:
+        scale_states = [
+            StageScaleState(
+                spec=st.spec,
+                current_workers=st.pool.num_workers(),
+                throughput_per_worker=st.pool.throughput_per_worker(
+                    cfg.streaming.speed_estimation_window_s
+                ),
+                queued=len(st.in_queue),
+            )
+            for st in states
+        ]
+        targets = plan_allocation(scale_states, budget)
+        for st, target in zip(states, targets):
+            cur = st.pool.num_workers()
+            for _ in range(max(0, target - cur)):
+                st.pool.start_worker()
+            if target < cur:
+                # scale down idle workers only
+                for w in st.pool.idle_workers()[: cur - target]:
+                    st.pool.stop_worker(w)
+
+    @staticmethod
+    def _drain(mp_q, t_q) -> list:
+        out = []
+        for q_ in (mp_q, t_q):
+            while True:
+                try:
+                    out.append(q_.get_nowait())
+                except queue.Empty:
+                    break
+                except Exception:
+                    break
+        return out
+
+    @staticmethod
+    def _discover_tpus(cfg, stage_specs: list[StageSpec]) -> int:
+        if cfg.num_tpu_chips is not None:
+            return cfg.num_tpu_chips
+        if not any(s.stage.resources.uses_tpu for s in stage_specs):
+            return 0
+        try:
+            import jax
+
+            return max(1, len([d for d in jax.devices() if d.platform == "tpu"]))
+        except Exception:
+            return 1
+
+
+def _host_memory_bytes() -> int:
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().total)
+    except Exception:
+        return 8 << 30
+
+
+def _tail(s: str, n: int = 2000) -> str:
+    return s if len(s) <= n else "…" + s[-n:]

@@ -1,0 +1,148 @@
+"""Stage worker process: the engine's unit of execution.
+
+Mirrors the reference's per-actor lifecycle (SURVEY.md §3.2): setup_on_node →
+setup → process_data loop → destroy, with the 3-step mini-pipeline (fetch
+ref → deserialize → process) hiding data-movement latency behind compute
+(ARCHITECTURE.md:70-77) via a prefetch thread.
+
+Workers are spawned (never forked — a forked JAX/TPU runtime is undefined)
+and CPU workers pin ``JAX_PLATFORMS=cpu`` so they can never grab the host's
+TPU chips, which belong exclusively to the engine process.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any
+
+import cloudpickle
+
+from cosmos_curate_tpu.engine import object_store
+
+
+@dataclass
+class SetupMsg:
+    stage_pickle: bytes
+    worker_meta_pickle: bytes
+
+
+@dataclass
+class ProcessMsg:
+    batch_id: int
+    refs: list[object_store.ObjectRef]
+
+
+@dataclass
+class ShutdownMsg:
+    pass
+
+
+@dataclass
+class ResultMsg:
+    batch_id: int
+    out_refs: list[object_store.ObjectRef] = field(default_factory=list)
+    error: str | None = None
+    process_time_s: float = 0.0
+    deserialize_time_s: float = 0.0
+    worker_id: str = ""
+
+
+@dataclass
+class ReadyMsg:
+    worker_id: str
+    error: str | None = None
+
+
+def worker_main(in_q, out_q, env: dict[str, str]) -> None:
+    """Entry point of a spawned worker process."""
+    os.environ.update(env)
+    stage = None
+    meta = None
+    worker_id = env.get("CURATE_WORKER_ID", "worker-?")
+    # prefetch pipeline: control msgs -> deserialized batches
+    fetched: queue.Queue[tuple[ProcessMsg, list[Any] | None, str | None, float]] = queue.Queue(
+        maxsize=2
+    )
+    stop = threading.Event()
+
+    def fetcher() -> None:
+        while not stop.is_set():
+            try:
+                msg = in_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if isinstance(msg, ShutdownMsg):
+                fetched.put((msg, None, None, 0.0))  # type: ignore[arg-type]
+                return
+            if isinstance(msg, SetupMsg):
+                fetched.put((msg, None, None, 0.0))  # type: ignore[arg-type]
+                continue
+            t0 = time.monotonic()
+            try:
+                tasks = [object_store.get(r) for r in msg.refs]
+                fetched.put((msg, tasks, None, time.monotonic() - t0))
+            except Exception:
+                fetched.put((msg, None, traceback.format_exc(), time.monotonic() - t0))
+
+    threading.Thread(target=fetcher, daemon=True).start()
+
+    try:
+        while True:
+            msg, tasks, fetch_err, dt = fetched.get()
+            if isinstance(msg, ShutdownMsg):
+                break
+            if isinstance(msg, SetupMsg):
+                try:
+                    stage = cloudpickle.loads(msg.stage_pickle)
+                    meta = cloudpickle.loads(msg.worker_meta_pickle)
+                    stage.setup_on_node(meta.node, meta)
+                    stage.setup(meta)
+                    out_q.put(ReadyMsg(worker_id=worker_id))
+                except Exception:
+                    out_q.put(ReadyMsg(worker_id=worker_id, error=traceback.format_exc()))
+                continue
+            # ProcessMsg
+            if fetch_err is not None:
+                out_q.put(
+                    ResultMsg(msg.batch_id, error=fetch_err, worker_id=worker_id)
+                )
+                continue
+            t0 = time.monotonic()
+            try:
+                result = stage.process_data(tasks)
+                if result is not None and not isinstance(result, list):
+                    raise TypeError(
+                        f"stage {type(stage).__name__}.process_data must return "
+                        f"list or None, got {type(result).__name__}"
+                    )
+                out_refs = [object_store.put(t) for t in (result or [])]
+                out_q.put(
+                    ResultMsg(
+                        msg.batch_id,
+                        out_refs=out_refs,
+                        process_time_s=time.monotonic() - t0,
+                        deserialize_time_s=dt,
+                        worker_id=worker_id,
+                    )
+                )
+            except Exception:
+                out_q.put(
+                    ResultMsg(
+                        msg.batch_id,
+                        error=traceback.format_exc(),
+                        process_time_s=time.monotonic() - t0,
+                        worker_id=worker_id,
+                    )
+                )
+    finally:
+        stop.set()
+        if stage is not None:
+            try:
+                stage.destroy()
+            except Exception:
+                pass

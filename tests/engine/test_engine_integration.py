@@ -1,0 +1,101 @@
+"""Engine integration: TPU-resource (in-process) stages and the full split
+pipeline through the StreamingRunner."""
+
+from dataclasses import dataclass
+
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, StreamingSpec, run_pipeline
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.engine.runner import StreamingRunner
+from tests.fixtures.media import make_scene_video
+
+
+@dataclass
+class Num(PipelineTask):
+    value: int = 0
+
+
+class CpuDouble(Stage):
+    @property
+    def resources(self):
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks):
+        return [Num(value=t.value * 2) for t in tasks]
+
+
+class DeviceStage(Stage):
+    """Claims a TPU -> must run in-process (thread) in the engine."""
+
+    def __init__(self):
+        self.setup_pid = None
+
+    @property
+    def resources(self):
+        return Resources(cpus=1.0, tpus=1.0)
+
+    @property
+    def batch_size(self):
+        return 4
+
+    def setup(self, worker):
+        import os
+
+        self.setup_pid = os.getpid()
+
+    def process_data(self, tasks):
+        import os
+
+        assert os.getpid() == self.setup_pid  # same process as setup
+        import jax.numpy as jnp
+
+        vals = jnp.asarray([t.value for t in tasks])
+        out = (vals + 100).tolist()
+        return [Num(value=int(v)) for v in out]
+
+
+def cfg():
+    return PipelineConfig(
+        streaming=StreamingSpec(autoscale_interval_s=3600.0, max_queued_lower_bound=4)
+    )
+
+
+@pytest.mark.slow
+def test_device_stage_runs_in_engine_process():
+    import os
+
+    stage = DeviceStage()
+    out = run_pipeline(
+        [Num(value=i) for i in range(6)],
+        [StageSpec(CpuDouble(), num_workers=1), StageSpec(stage, num_workers=1)],
+        config=cfg(),
+        runner=StreamingRunner(),
+    )
+    assert sorted(t.value for t in out) == [100, 102, 104, 106, 108, 110]
+    # the device stage ran in THIS process (the chip owner), not a worker
+    assert stage.setup_pid == os.getpid()
+
+
+@pytest.mark.slow
+def test_split_pipeline_on_streaming_engine(tmp_path):
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+    vids = tmp_path / "in"
+    vids.mkdir()
+    for i in range(2):
+        make_scene_video(vids / f"v{i}.mp4", scene_len_frames=24, num_scenes=2)
+    args = SplitPipelineArgs(
+        input_path=str(vids),
+        output_path=str(tmp_path / "out"),
+        fixed_stride_len_s=1.0,
+        min_clip_len_s=0.5,
+        extract_fps=(4.0,),
+        extract_resize_hw=(32, 32),
+    )
+    summary = run_split(args, runner=StreamingRunner(), config=cfg())
+    assert summary["num_videos"] == 2
+    assert summary["num_clips"] == 4
+    assert summary["num_transcoded"] == 4
+    assert (tmp_path / "out" / "summary.json").exists()

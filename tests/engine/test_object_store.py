@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+
+
+def test_roundtrip_simple():
+    ref = object_store.put({"a": 1, "b": "text"})
+    try:
+        assert object_store.get(ref) == {"a": 1, "b": "text"}
+    finally:
+        object_store.delete(ref)
+
+
+def test_roundtrip_numpy_zero_copy_layout():
+    arr = np.arange(1000, dtype=np.float32).reshape(10, 100)
+    ref = object_store.put({"x": arr})
+    try:
+        out = object_store.get(ref)
+        np.testing.assert_array_equal(out["x"], arr)
+        # buffer travelled out-of-band, so total size ~ payload + array bytes
+        assert ref.total_size >= arr.nbytes
+        assert ref.num_buffers >= 1
+    finally:
+        object_store.delete(ref)
+
+
+def test_roundtrip_pipeline_task():
+    task = SplitPipeTask(
+        video=Video(
+            path="v.mp4",
+            raw_bytes=b"\x00" * 5000,
+            clips=[Clip(source_video="v.mp4", span=(0.0, 5.0), encoded_data=b"z" * 100)],
+        )
+    )
+    ref = object_store.put(task)
+    try:
+        out = object_store.get(ref)
+        assert out.video.path == "v.mp4"
+        assert out.video.raw_bytes == b"\x00" * 5000
+        assert out.video.clips[0].encoded_data == b"z" * 100
+    finally:
+        object_store.delete(ref)
+
+
+def test_delete_idempotent():
+    ref = object_store.put([1, 2, 3])
+    object_store.delete(ref)
+    object_store.delete(ref)  # no raise
+    with pytest.raises(FileNotFoundError):
+        object_store.get(ref)
+
+
+def test_budget_accounting_and_headroom():
+    budget = object_store.StoreBudget(capacity_bytes=7_000)
+    r1 = object_store.put(b"x" * 4000)
+    r2 = object_store.put(b"y" * 4000)
+    try:
+        assert budget.has_headroom()
+        budget.account(r1)
+        assert budget.has_headroom()  # ~4k < 7k
+        budget.account(r2)
+        assert not budget.has_headroom()  # ~8k > 7k
+        used_before = budget.used
+        budget.release(r1)
+        assert budget.used < used_before
+        assert budget.has_headroom()
+    finally:
+        budget.release(r2)
+
+
+def test_budget_headroom_when_empty_even_if_tiny_capacity():
+    budget = object_store.StoreBudget(capacity_bytes=10)
+    assert budget.has_headroom()  # empty store always admits one object
+    big = object_store.put(b"x" * 1000)
+    try:
+        budget.account(big)  # unconditional accounting may exceed capacity
+        assert budget.used > 10
+        assert not budget.has_headroom()
+    finally:
+        budget.release(big)
+        assert budget.used == 0
