@@ -1,0 +1,35 @@
+"""Tokenizer for the caption engine.
+
+No pretrained tokenizer assets exist in this image (zero egress), so the
+default is a byte-level tokenizer (ids 0-255 = raw bytes + special tokens) —
+hermetic, reversible, and vocab-compatible with the bundled VLM configs.
+Real deployments plug an HF tokenizer through the same interface (the
+engine only calls ``encode``/``decode``/special-token properties).
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    PAD = 256
+    BOS = 257
+    EOS = 258
+    IMAGE = 259  # placeholder id marking where vision tokens splice in
+
+    vocab_size = 512  # padded to an MXU-friendly size
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] if add_bos else []) + ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_id(self) -> int:
+        return self.EOS
+
+    @property
+    def pad_id(self) -> int:
+        return self.PAD

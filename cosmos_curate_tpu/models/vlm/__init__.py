@@ -1,0 +1,12 @@
+from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, VLM_BASE, VLM_TINY_TEST
+from cosmos_curate_tpu.models.vlm.engine import CaptionEngine, CaptionRequest, SamplingConfig
+
+__all__ = [
+    "VLM",
+    "VLMConfig",
+    "VLM_BASE",
+    "VLM_TINY_TEST",
+    "CaptionEngine",
+    "CaptionRequest",
+    "SamplingConfig",
+]

@@ -1,0 +1,300 @@
+"""Continuous-batching caption engine.
+
+Equivalent capability of the reference's vLLM engine driver
+(cosmos_curate/models/vllm_interface.py:390-703 — ``add_request``/``step``
+in-flight batching with two-stage caption refinement; async variant
+vllm_async_stage.py). TPU-first re-design:
+
+- **slot-based KV cache**: a static ``[L, max_batch, max_seq, Hkv, Dh]``
+  cache; a request claims a free slot, prefills at a power-of-two bucket
+  length, then joins the batched one-token decode step. All jitted programs
+  have static shapes — XLA compiles O(log max_seq) prefill buckets plus one
+  decode program, nothing per-request.
+- **continuous batching**: slots join/leave between decode steps; the decode
+  step always runs the full slot batch with an active mask (idle rows write
+  into masked cache cells — dead work, bounded by max_batch, in exchange
+  for zero recompiles).
+- **tokens/s** is tracked per engine — THE caption-throughput metric
+  (reference docs/curator/design/SPEED_OF_LIGHT.md).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.models.batching import next_pow2
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, init_cache
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class SamplingConfig:
+    max_new_tokens: int = 256
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclass
+class CaptionRequest:
+    request_id: str
+    prompt_ids: list[int]
+    frames: np.ndarray | None = None  # uint8 [N, H, W, 3]
+    sampling: SamplingConfig = field(default_factory=SamplingConfig)
+    # called with the finished text; may return a follow-up request
+    # (two-stage caption refinement, reference vllm_interface.py:543)
+    on_complete: Callable[[str], "CaptionRequest | None"] | None = None
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class _Slot:
+    request: CaptionRequest
+    position: int  # next cache position to write (== current length)
+    generated: list[int] = field(default_factory=list)
+
+
+@dataclass
+class CaptionResult:
+    request_id: str
+    text: str
+    num_prompt_tokens: int
+    num_output_tokens: int
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class CaptionEngine:
+    def __init__(
+        self,
+        cfg: VLMConfig,
+        *,
+        max_batch: int = 8,
+        params: Any = None,
+        tokenizer: ByteTokenizer | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.model = VLM(cfg)
+        self.params = params
+        self.waiting: list[CaptionRequest] = []
+        self.slots: dict[int, _Slot] = {}
+        self.completed: list[CaptionResult] = []
+        self._decode_tokens = 0
+        self._decode_time = 0.0
+        self._built = False
+
+    # -- setup ----------------------------------------------------------
+    def setup(self, seed: int = 0) -> None:
+        cfg = self.cfg
+        if self.params is None:
+            size = cfg.vision.image_size
+            frames = jnp.zeros((1, 1, size, size, 3), jnp.uint8)
+            ids = jnp.zeros((1, 4), jnp.int32)
+            ck, cv = init_cache(cfg, 1)
+            self.params = self.model.init(
+                jax.random.PRNGKey(seed),
+                frames,
+                ids,
+                ck,
+                cv,
+                method=self.model.init_everything,
+            )
+        self.cache_k, self.cache_v = init_cache(cfg, self.max_batch)
+
+        model = self.model
+
+        @jax.jit
+        def encode_images(params, frames_u8):
+            return model.apply(params, frames_u8, method=model.encode_images)
+
+        @jax.jit
+        def embed_tokens(params, ids):
+            return model.apply(params, ids, method=model.embed_tokens)
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, cache_k, cache_v, embeds, slot, t_valid):
+            """embeds: [1, Tb, D] (bucket-padded); writes slot's cache rows
+            [0, Tb) and returns logits at the last valid position."""
+            ck = jax.lax.dynamic_slice_in_dim(cache_k, slot, 1, axis=1)
+            cv = jax.lax.dynamic_slice_in_dim(cache_v, slot, 1, axis=1)
+            t = embeds.shape[1]
+            positions = jnp.arange(t, dtype=jnp.int32)[None]
+            logits, nk, nv = model.apply(
+                params,
+                embeds,
+                ck,
+                cv,
+                positions,
+                jnp.zeros((1,), jnp.int32),
+                jnp.full((1,), t_valid, jnp.int32),
+            )
+            cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, nk, slot, axis=1)
+            cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, nv, slot, axis=1)
+            last = jnp.take_along_axis(
+                logits, (t_valid - 1)[None, None, None].astype(jnp.int32), axis=1
+            )[0, 0]
+            return last, cache_k, cache_v
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_step(params, cache_k, cache_v, tokens, positions):
+            """tokens/positions: [max_batch]; one token for every slot."""
+            embeds = model.apply(params, tokens[:, None], method=model.embed_tokens)
+            logits, ck, cv = model.apply(
+                params,
+                embeds,
+                cache_k,
+                cache_v,
+                positions[:, None],
+                positions,
+                positions + 1,
+            )
+            return logits[:, 0], ck, cv
+
+        def sample(logits, sampling: SamplingConfig, step_key):
+            if sampling.temperature <= 0.0:
+                return int(jnp.argmax(logits))
+            scaled = logits / sampling.temperature
+            if sampling.top_k > 0:
+                top = jnp.sort(scaled)[-sampling.top_k]
+                scaled = jnp.where(scaled < top, -jnp.inf, scaled)
+            return int(jax.random.categorical(step_key, scaled))
+
+        self._encode_images = encode_images
+        self._embed_tokens = embed_tokens
+        self._prefill = prefill
+        self._decode = decode_step
+        self._sample = sample
+        self._key = jax.random.PRNGKey(seed)
+        self._built = True
+
+    # -- public API -----------------------------------------------------
+    def add_request(self, request: CaptionRequest) -> None:
+        budget = self.cfg.max_seq - request.sampling.max_new_tokens - 1
+        if budget <= 0:
+            raise ValueError(
+                f"max_new_tokens={request.sampling.max_new_tokens} leaves no "
+                f"prompt budget in max_seq={self.cfg.max_seq}"
+            )
+        self.waiting.append(request)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.slots)
+
+    def run_until_complete(self) -> list[CaptionResult]:
+        while self.has_work():
+            self.step()
+        done, self.completed = self.completed, []
+        return done
+
+    @property
+    def tokens_per_second(self) -> float:
+        return self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0
+
+    # -- engine internals ----------------------------------------------
+    def step(self) -> None:
+        """Admit waiting requests into free slots, then one decode step."""
+        if not self._built:
+            raise RuntimeError("call setup() first")
+        self._admit()
+        if self.slots:
+            self._decode_once()
+
+    def _admit(self) -> None:
+        free = [i for i in range(self.max_batch) if i not in self.slots]
+        while free and self.waiting:
+            slot_idx = free.pop(0)
+            req = self.waiting.pop(0)
+            try:
+                self._prefill_request(slot_idx, req)
+            except Exception:
+                logger.exception("prefill failed for %s; dropping", req.request_id)
+
+    def _prefill_request(self, slot_idx: int, req: CaptionRequest) -> None:
+        parts = []
+        if req.frames is not None:
+            vis = self._encode_images(self.params, jnp.asarray(req.frames)[None])
+            parts.append(vis[0])
+        ids = jnp.asarray(req.prompt_ids, jnp.int32)
+        parts.append(self._embed_tokens(self.params, ids[None])[0])
+        embeds = jnp.concatenate(parts, axis=0)
+        t_valid = embeds.shape[0]
+        budget = self.cfg.max_seq - req.sampling.max_new_tokens - 1
+        if t_valid > budget:
+            # keep the tail (task instructions usually come last)
+            embeds = embeds[-budget:]
+            t_valid = budget
+        bucket = min(next_pow2(t_valid), self.cfg.max_seq)
+        if bucket > t_valid:
+            pad = jnp.zeros((bucket - t_valid, embeds.shape[-1]), embeds.dtype)
+            embeds = jnp.concatenate([embeds, pad], axis=0)
+        logits, self.cache_k, self.cache_v = self._prefill(
+            self.params,
+            self.cache_k,
+            self.cache_v,
+            embeds[None],
+            slot_idx,
+            jnp.asarray(t_valid, jnp.int32),
+        )
+        self._key, sub = jax.random.split(self._key)
+        first = self._sample(logits, req.sampling, sub)
+        slot = _Slot(request=req, position=t_valid, generated=[first])
+        self.slots[slot_idx] = slot
+        self._maybe_finish(slot_idx, slot)
+
+    def _decode_once(self) -> None:
+        tokens = np.full(self.max_batch, self.tokenizer.pad_id, np.int32)
+        positions = np.zeros(self.max_batch, np.int32)
+        for i, slot in self.slots.items():
+            tokens[i] = slot.generated[-1]
+            positions[i] = slot.position
+        t0 = time.monotonic()
+        logits, self.cache_k, self.cache_v = self._decode(
+            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens), jnp.asarray(positions)
+        )
+        logits.block_until_ready()
+        self._decode_time += time.monotonic() - t0
+        self._decode_tokens += len(self.slots)
+        for i in list(self.slots):
+            slot = self.slots[i]
+            self._key, sub = jax.random.split(self._key)
+            nxt = self._sample(logits[i], slot.request.sampling, sub)
+            slot.generated.append(nxt)
+            slot.position += 1
+            self._maybe_finish(i, slot)
+
+    def _maybe_finish(self, slot_idx: int, slot: _Slot) -> None:
+        req = slot.request
+        done = (
+            slot.generated[-1] == self.tokenizer.eos_id
+            or len(slot.generated) >= req.sampling.max_new_tokens
+            or slot.position + 1 >= self.cfg.max_seq
+        )
+        if not done:
+            return
+        del self.slots[slot_idx]
+        out_ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
+        text = self.tokenizer.decode(out_ids)
+        result = CaptionResult(
+            request_id=req.request_id,
+            text=text,
+            num_prompt_tokens=len(req.prompt_ids),
+            num_output_tokens=len(slot.generated),
+            metadata=req.metadata,
+        )
+        if req.on_complete is not None:
+            follow_up = req.on_complete(text)
+            if follow_up is not None:
+                self.waiting.append(follow_up)
+                return  # result superseded by the refinement pass
+        self.completed.append(result)

@@ -1,0 +1,223 @@
+"""CurateVLM: the vision-language captioning model.
+
+Equivalent capability of the reference's vLLM-served VLM captioners
+(cosmos_curate/models/vllm_qwen.py, vllm_interface.py — Qwen-VL-class
+models behind the plugin ABC). This is our own Flax architecture, TPU-first:
+
+- vision tower = the shared ViT backbone (models/vit.py), whose patch
+  tokens are projected into the LM embedding space (one image/frame-group →
+  ``vision_tokens`` embeddings);
+- language model = decoder-only transformer with RoPE and grouped-query
+  attention, TP-sharded via the Megatron-style annotations in
+  models/layers.py (replaces vLLM's NCCL TP with pjit sharding);
+- inference is cache-centric: ``apply`` consumes and returns a static-shape
+  slot-based KV cache ``[L, B, S, Hkv, Dh]``, so prefill (T=bucket) and
+  decode (T=1) are the same compiled family of programs. No dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.models.layers import MODEL_AXIS, dense
+from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
+
+
+@dataclass(frozen=True)
+class VLMConfig:
+    vocab: int = 512
+    dim: int = 1024
+    n_layers: int = 12
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    head_dim: int = 64
+    hidden_mult: float = 4.0
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    vision: ViTConfig = VIT_B_16
+    vision_tokens: int = 64  # LM embeddings per image after pooling
+
+
+VLM_BASE = VLMConfig()
+VLM_TINY_TEST = VLMConfig(
+    vocab=512,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    max_seq=128,
+    vision=VIT_TINY_TEST,
+    vision_tokens=8,
+)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """x: [B, T, H, D]; positions: [B, T] absolute positions."""
+    freqs = rope_frequencies(x.shape[-1], theta)  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (normed * scale).astype(x.dtype)
+
+
+class DecoderLayer(nn.Module):
+    cfg: VLMConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, positions, write_index, kv_len):
+        """One decoder layer with slot KV cache.
+
+        x: [B, T, D]; cache_k/v: [B, S, Hkv, Dh]; positions: [B, T];
+        write_index: [B] offset where this chunk's K/V land; kv_len: [B]
+        valid cache length AFTER writing (= write_index + T for active rows).
+        Returns (y, new_cache_k, new_cache_v).
+        """
+        cfg = self.cfg
+        b, t, _ = x.shape
+        s = cache_k.shape[1]
+        h, hk, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+        y = RMSNorm(name="ln1")(x)
+        q = dense(h * dh, "out", name="q", use_bias=False, dtype=self.dtype)(y)
+        k = dense(hk * dh, "out", name="k", use_bias=False, dtype=self.dtype)(y)
+        v = dense(hk * dh, "out", name="v", use_bias=False, dtype=self.dtype)(y)
+        q = apply_rope(q.reshape(b, t, h, dh), positions, cfg.rope_theta)
+        k = apply_rope(k.reshape(b, t, hk, dh), positions, cfg.rope_theta)
+        v = v.reshape(b, t, hk, dh)
+
+        # scatter this chunk into the cache at each row's write_index
+        def write_row(cache, chunk, idx):
+            return jax.lax.dynamic_update_slice(cache, chunk, (idx, 0, 0))
+
+        new_k = jax.vmap(write_row)(cache_k, k.astype(cache_k.dtype), write_index)
+        new_v = jax.vmap(write_row)(cache_v, v.astype(cache_v.dtype), write_index)
+
+        # GQA attention of q against the whole (masked) cache
+        group = h // hk
+        kk = jnp.repeat(new_k, group, axis=2)  # [B, S, H, Dh]
+        vv = jnp.repeat(new_v, group, axis=2)
+        logits = jnp.einsum(
+            "bthd,bshd->bhts", (q * (dh**-0.5)).astype(jnp.float32), kk.astype(jnp.float32)
+        )
+        k_pos = jnp.arange(s)[None, None, None, :]  # cache slot index
+        causal = k_pos <= positions[:, None, :, None]  # key pos <= query pos
+        written = k_pos < kv_len[:, None, None, None]
+        logits = jnp.where(causal & written, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs.astype(self.dtype), vv)
+        attn = attn.reshape(b, t, h * dh)
+        x = x + dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(attn)
+
+        y = RMSNorm(name="ln2")(x)
+        up = dense(int(cfg.dim * cfg.hidden_mult), "out", name="up", use_bias=False, dtype=self.dtype)(y)
+        gate = dense(int(cfg.dim * cfg.hidden_mult), "out", name="gate", use_bias=False, dtype=self.dtype)(y)
+        down = dense(cfg.dim, "in", name="down", use_bias=False, dtype=self.dtype)(
+            nn.silu(gate) * up
+        )
+        return x + down, new_k, new_v
+
+
+class VLM(nn.Module):
+    cfg: VLMConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self) -> None:
+        cfg = self.cfg
+        self.embed = nn.Embed(
+            cfg.vocab,
+            cfg.dim,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_partitioning(nn.initializers.normal(0.02), (None, MODEL_AXIS)),
+        )
+        self.layers = [DecoderLayer(cfg, dtype=self.dtype, name=f"layer_{i}") for i in range(cfg.n_layers)]
+        self.ln_f = RMSNorm(name="ln_f")
+        self.vision_tower = ViT(cfg.vision, dtype=self.dtype, name="vision")
+        self.projector = nn.Sequential(
+            [
+                dense(cfg.dim * 2, None, use_bias=True, dtype=self.dtype),
+                nn.gelu,
+                dense(cfg.dim, None, use_bias=True, dtype=self.dtype),
+            ],
+            name="projector",
+        )
+
+    def encode_images(self, frames_u8):
+        """uint8 [B, N, Hp, Wp, 3] -> [B, vision_tokens, dim] LM embeddings.
+
+        N frames are encoded by the ViT; their patch tokens are mean-pooled
+        over frames, then strided down to ``vision_tokens`` and projected.
+        """
+        cfg = self.cfg
+        b, n = frames_u8.shape[:2]
+        pixels = preprocess_frames(frames_u8, image_size=cfg.vision.image_size)
+        _, tokens = self.vision_tower(pixels.reshape((b * n, *pixels.shape[2:])))
+        tokens = tokens[:, 1:]  # drop cls
+        tokens = tokens.reshape(b, n, tokens.shape[1], tokens.shape[2]).mean(axis=1)
+        # stride-pool the patch grid down to vision_tokens
+        stride = max(1, tokens.shape[1] // cfg.vision_tokens)
+        tokens = tokens[:, :: stride][:, : cfg.vision_tokens]
+        return self.projector(tokens)
+
+    def embed_tokens(self, token_ids):
+        return self.embed(token_ids)
+
+    def init_everything(self, frames_u8, token_ids, cache_k, cache_v):
+        """Init-only method touching every submodule (flax only creates
+        params for modules traced during init)."""
+        vis = self.encode_images(frames_u8)
+        txt = self.embed_tokens(token_ids)
+        embeds = jnp.concatenate([vis, txt], axis=1)
+        t = embeds.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(t), (embeds.shape[0], t))
+        return self(
+            embeds,
+            cache_k,
+            cache_v,
+            positions,
+            jnp.zeros((embeds.shape[0],), jnp.int32),
+            jnp.full((embeds.shape[0],), t, jnp.int32),
+        )
+
+    def __call__(self, embeds, cache_k, cache_v, positions, write_index, kv_len):
+        """Forward over input *embeddings* (text and vision already spliced).
+
+        embeds: [B, T, D]; cache_k/v: [L, B, S, Hkv, Dh].
+        Returns (logits [B, T, vocab], new_cache_k, new_cache_v).
+        """
+        x = embeds.astype(self.dtype)
+        new_ks, new_vs = [], []
+        for i, layer in enumerate(self.layers):
+            x, nk, nv = layer(x, cache_k[i], cache_v[i], positions, write_index, kv_len)
+            new_ks.append(nk)
+            new_vs.append(nv)
+        x = self.ln_f(x)
+        logits = self.embed.attend(x.astype(jnp.float32))
+        return logits, jnp.stack(new_ks), jnp.stack(new_vs)
+
+
+def init_cache(cfg: VLMConfig, batch: int, dtype=jnp.bfloat16):
+    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,187 @@
+"""Captioning stages: CPU prep + TPU engine stage.
+
+Equivalent capability of the reference's captioning path
+(cosmos_curate/pipelines/video/captioning/vllm_caption_stage.py:244/413 —
+``VllmPrepStage`` windows + model inputs on CPU, ``VllmCaptionStage`` runs
+the engine with in-flight batching and two-stage refinement). Same deliberate
+CPU/device split here: the prep stage computes caption windows
+(windowing_utils ``compute_windows`` semantics) and samples window frames;
+the caption stage owns one ``CaptionEngine`` (the chip owner's in-process
+pool) and streams every window of every clip through continuous batching.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask, Window
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.models.prompts import REFINEMENT_PROMPT, get_caption_prompt
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm import (
+    CaptionEngine,
+    CaptionRequest,
+    SamplingConfig,
+    VLM_BASE,
+    VLMConfig,
+)
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.windowing import compute_windows
+
+logger = get_logger(__name__)
+
+
+class CaptionPrepStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """CPU prep: cut clips into caption windows and attach window frames."""
+
+    def __init__(
+        self,
+        *,
+        window_len: int = 256,
+        remainder_threshold: int = 128,
+        frames_per_window: int = 8,
+        extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+    ) -> None:
+        self.window_len = window_len
+        self.remainder_threshold = remainder_threshold
+        self.frames_per_window = frames_per_window
+        self.extraction = extraction
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=3.0)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        key = self.extraction.key()
+        for task in tasks:
+            for clip in task.video.clips:
+                frames = clip.extracted_frames.get(key)
+                if frames is None or frames.shape[0] == 0:
+                    continue
+                # windows are defined over source frames; map to extracted
+                # frame indices proportionally
+                src_frames = max(
+                    1, int(clip.duration_s * task.video.metadata.fps)
+                )
+                spans = compute_windows(
+                    src_frames,
+                    window_len=self.window_len,
+                    remainder_threshold=self.remainder_threshold,
+                )
+                n_ext = frames.shape[0]
+                clip.windows = []
+                for a, b in spans:
+                    ea = int(a / src_frames * n_ext)
+                    eb = max(ea + 1, int(b / src_frames * n_ext))
+                    idx = np.linspace(ea, min(eb, n_ext) - 1, self.frames_per_window)
+                    win = Window(start_frame=a, end_frame=b)
+                    win.frames = frames[idx.round().astype(int)]
+                    clip.windows.append(win)
+        return tasks
+
+
+class _CaptionVLM(ModelInterface):
+    MODEL_ID = "caption-vlm-tpu"
+
+    def __init__(self, cfg: VLMConfig, max_batch: int) -> None:
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.engine: CaptionEngine | None = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    def setup(self) -> None:
+        engine = CaptionEngine(self.cfg, max_batch=self.max_batch)
+        engine.setup()
+
+        def init(seed: int):
+            return engine.params
+
+        engine.params = registry.load_params(self.MODEL_ID, init)
+        self.engine = engine
+
+
+class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """TPU stage: continuous-batching captioning of every clip window."""
+
+    def __init__(
+        self,
+        *,
+        prompt_variant: str = "default",
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        max_new_tokens: int = 128,
+        refine: bool = False,
+    ) -> None:
+        self.prompt_variant = prompt_variant
+        self.prompt_text = get_caption_prompt(prompt_variant)
+        self.max_new_tokens = max_new_tokens
+        self.refine = refine
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = ByteTokenizer()
+        self._refined_ids: set[str] = set()  # stage-2 bookkeeping (not user data)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        windows: dict[str, Window] = {}
+        for t_i, task in enumerate(tasks):
+            for clip in task.video.clips:
+                for w_i, win in enumerate(clip.windows):
+                    if win.frames is None:
+                        continue
+                    rid = f"{clip.uuid}-{w_i}"
+                    windows[rid] = win
+                    engine.add_request(self._make_request(rid, win))
+        if not windows:
+            return tasks
+        results = engine.run_until_complete()
+        for res in results:
+            win = windows.get(res.request_id)
+            if win is None:
+                continue
+            win.caption[self.prompt_variant] = res.text
+        logger.info(
+            "captioned %d windows at %.1f output tok/s",
+            len(results),
+            engine.tokens_per_second,
+        )
+        for task in tasks:
+            task.stage_perf["caption_tokens_per_s"] = engine.tokens_per_second
+        return tasks
+
+    def _make_request(self, rid: str, win: Window) -> CaptionRequest:
+        prompt_ids = self.tokenizer.encode(self.prompt_text)
+        sampling = SamplingConfig(max_new_tokens=self.max_new_tokens)
+        on_complete = None
+        if self.refine:
+            def on_complete(text: str, _rid=rid, _win=win) -> CaptionRequest | None:
+                if _rid in self._refined_ids:
+                    return None
+                self._refined_ids.add(_rid)
+                return CaptionRequest(
+                    request_id=_rid,
+                    prompt_ids=self.tokenizer.encode(REFINEMENT_PROMPT + text),
+                    frames=_win.frames,
+                    sampling=sampling,
+                    on_complete=on_complete,
+                )
+        return CaptionRequest(
+            request_id=rid,
+            prompt_ids=prompt_ids,
+            frames=win.frames,
+            sampling=sampling,
+            on_complete=on_complete,
+        )

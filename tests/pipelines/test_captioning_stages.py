@@ -1,0 +1,67 @@
+"""Caption prep + caption stage integration (tiny VLM, synthetic media)."""
+
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.data.model import FrameExtractionSignature
+from cosmos_curate_tpu.models.vlm import VLM_TINY_TEST
+from cosmos_curate_tpu.pipelines.video.input_discovery import discover_split_tasks
+from cosmos_curate_tpu.pipelines.video.stages.captioning import CaptionPrepStage, CaptionStage
+from cosmos_curate_tpu.pipelines.video.stages.clip_extraction import (
+    ClipTranscodingStage,
+    FixedStrideExtractorStage,
+)
+from cosmos_curate_tpu.pipelines.video.stages.download import VideoDownloadStage
+from cosmos_curate_tpu.pipelines.video.stages.frame_extraction import ClipFrameExtractionStage
+from cosmos_curate_tpu.pipelines.video.stages.writer import ClipWriterStage
+from tests.fixtures.media import make_scene_video
+
+
+@pytest.fixture(scope="module")
+def captioned_output(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cap")
+    vids = d / "in"
+    vids.mkdir()
+    make_scene_video(vids / "v0.mp4", scene_len_frames=48, num_scenes=1)
+    sig = FrameExtractionSignature("fps", 4.0)
+    out = d / "out"
+    stages = [
+        VideoDownloadStage(),
+        FixedStrideExtractorStage(clip_len_s=1.0, min_clip_len_s=0.5),
+        ClipTranscodingStage(num_threads=2),
+        ClipFrameExtractionStage(signatures=(sig,), resize_hw=(32, 32)),
+        CaptionPrepStage(window_len=24, remainder_threshold=12, frames_per_window=2, extraction=sig),
+        CaptionStage(cfg=VLM_TINY_TEST, max_batch=4, max_new_tokens=6),
+        ClipWriterStage(str(out)),
+    ]
+    tasks = discover_split_tasks(str(vids))
+    done = run_pipeline(tasks, stages, runner=SequentialRunner())
+    return out, done
+
+
+def test_windows_created_and_captioned(captioned_output):
+    out, done = captioned_output
+    clips = [c for t in done for c in t.video.clips]
+    assert len(clips) == 2  # 2s video, 1s stride
+    for clip in clips:
+        assert clip.windows, "prep stage must create windows"
+        for win in clip.windows:
+            assert "default" in win.caption
+            assert isinstance(win.caption["default"], str)
+
+
+def test_caption_metadata_written(captioned_output):
+    out, done = captioned_output
+    import json
+
+    metas = [json.loads(p.read_text()) for p in (out / "metas" / "v0").glob("*.json")]
+    assert metas
+    for m in metas:
+        assert m["windows"], "windows must be serialized"
+        assert all("default" in w["captions"] for w in m["windows"])
+
+
+def test_tokens_per_second_recorded(captioned_output):
+    _, done = captioned_output
+    assert all(t.stage_perf.get("caption_tokens_per_s", 0) > 0 for t in done)
