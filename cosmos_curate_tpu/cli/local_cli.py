@@ -32,7 +32,26 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--captioning", action="store_true")
     split.add_argument("--clip-chunk-size", type=int, default=64)
     split.add_argument("--sequential", action="store_true", help="run in-process (no engine)")
+    split.add_argument("--profile-cpu", action="store_true")
+    split.add_argument("--profile-memory", action="store_true")
+    split.add_argument("--tracing", action="store_true")
+    split.add_argument("--stage-save-rate", type=float, default=0.0)
     split.set_defaults(func=_cmd_split)
+
+    dedup = lsub.add_parser("dedup", help="semantic dedup over clip embeddings")
+    dedup.add_argument("--input-path", required=True, help="split output root")
+    dedup.add_argument("--output-path", default="")
+    dedup.add_argument("--embedding-model", default="")
+    dedup.add_argument("--eps", type=float, default=0.07)
+    dedup.add_argument("--n-clusters", type=int, default=0)
+    dedup.set_defaults(func=_cmd_dedup)
+
+    shard = lsub.add_parser("shard", help="pack curated clips into webdataset tars")
+    shard.add_argument("--input-path", required=True, help="split output root")
+    shard.add_argument("--output-path", required=True)
+    shard.add_argument("--dedup-csv", default="")
+    shard.add_argument("--max-samples-per-shard", type=int, default=512)
+    shard.set_defaults(func=_cmd_shard)
 
     local.set_defaults(func=lambda args: (local.print_help(), 2)[1])
 
@@ -42,6 +61,37 @@ def _cmd_hello(args: argparse.Namespace) -> int:
 
     for task in run_hello_world():
         print(f"{task.text!r} score={task.score:.4f} device={task.device}")
+    return 0
+
+
+def _cmd_dedup(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+    summary = run_dedup(
+        DedupPipelineArgs(
+            input_path=args.input_path,
+            output_path=args.output_path,
+            embedding_model=args.embedding_model,
+            eps=args.eps,
+            n_clusters=args.n_clusters,
+        )
+    )
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def _cmd_shard(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.pipelines.video.shard import ShardPipelineArgs, run_shard
+
+    summary = run_shard(
+        ShardPipelineArgs(
+            input_path=args.input_path,
+            output_path=args.output_path,
+            dedup_csv=args.dedup_csv,
+            max_samples_per_shard=args.max_samples_per_shard,
+        )
+    )
+    print(json.dumps(summary, indent=2))
     return 0
 
 
@@ -69,6 +119,10 @@ def _cmd_split(args: argparse.Namespace) -> int:
             embedding_model=args.embedding_model,
             captioning=args.captioning,
             clip_chunk_size=args.clip_chunk_size,
+            profile_cpu=args.profile_cpu,
+            profile_memory=args.profile_memory,
+            tracing=args.tracing,
+            stage_save_rate=args.stage_save_rate,
         )
     runner = SequentialRunner() if args.sequential else None
     summary = run_split(pargs, runner=runner)

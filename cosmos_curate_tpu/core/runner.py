@@ -51,8 +51,11 @@ class SequentialRunner(RunnerInterface):
                 allocation=stage.resources,
             )
             t0 = time.monotonic()
-            stage.setup_on_node(node, meta)
-            stage.setup(meta)
+            from cosmos_curate_tpu.observability.tracing import traced_span
+
+            with traced_span(f"stage.{stage.name}.setup"):
+                stage.setup_on_node(node, meta)
+                stage.setup(meta)
             out: list[PipelineTask] = []
             bs = max(1, stage.batch_size)
             try:
@@ -60,7 +63,10 @@ class SequentialRunner(RunnerInterface):
                     batch = tasks[i : i + bs]
                     for attempt in range(max(1, stage_spec.num_run_attempts)):
                         try:
-                            result = stage.process_data(batch)
+                            with traced_span(
+                                f"stage.{stage.name}.process", batch_size=len(batch)
+                            ):
+                                result = stage.process_data(batch)
                             break
                         except Exception:
                             if attempt + 1 >= max(1, stage_spec.num_run_attempts):

@@ -87,7 +87,9 @@ class Stage(Generic[T, V], abc.ABC):
 
     @property
     def name(self) -> str:
-        return type(self).__name__
+        # observability wrappers subclass dynamically and stash the original
+        # name here so logs/metrics/artifacts keep the user-visible name
+        return getattr(self, "_display_name", type(self).__name__)
 
     @property
     def resources(self) -> Resources:

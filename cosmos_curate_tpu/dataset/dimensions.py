@@ -1,0 +1,53 @@
+"""Dataset dimension bucketing.
+
+Equivalent capability of the reference's dimensions module
+(cosmos_curate/core/utils/dataset/dimensions.py — 514 LoC bucketing by
+resolution / aspect ratio / frame window for webdataset sharding). Clips are
+grouped into buckets so every sample in a shard has compatible tensor
+shapes for training.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_ASPECT_BUCKETS: list[tuple[str, float]] = [
+    ("16-9", 16 / 9),
+    ("4-3", 4 / 3),
+    ("1-1", 1.0),
+    ("3-4", 3 / 4),
+    ("9-16", 9 / 16),
+]
+
+_RES_BUCKETS: list[tuple[str, int]] = [  # by min(height, width)
+    ("2160p", 2160),
+    ("1080p", 1080),
+    ("720p", 720),
+    ("480p", 480),
+    ("360p", 360),
+    ("0p", 0),
+]
+
+_FRAME_WINDOWS: list[int] = [256, 128, 64, 32, 16, 0]
+
+
+@dataclass(frozen=True)
+class DimensionBucket:
+    aspect: str
+    resolution: str
+    frame_window: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.aspect}_{self.resolution}_w{self.frame_window}"
+
+
+def bucket_for(width: int, height: int, num_frames: int) -> DimensionBucket:
+    if width <= 0 or height <= 0:
+        return DimensionBucket("1-1", "0p", 0)
+    ratio = width / height
+    aspect = min(_ASPECT_BUCKETS, key=lambda b: abs(b[1] - ratio))[0]
+    short = min(width, height)
+    resolution = next(name for name, px in _RES_BUCKETS if short >= px)
+    window = next(w for w in _FRAME_WINDOWS if num_frames >= w)
+    return DimensionBucket(aspect, resolution, window)

@@ -167,6 +167,10 @@ class ProcessPool(BasePool):
                 "CURATE_STORE_OWNER", str(os.getpid())
             ),
         }
+        from cosmos_curate_tpu.observability.tracing import tracing_enabled
+
+        if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
+            env["CURATE_TRACING"] = "1"
         proc = _MP.Process(
             target=worker_main, args=(in_q, self.results_q, env), daemon=True, name=wid
         )

@@ -61,6 +61,9 @@ class ReadyMsg:
 def worker_main(in_q, out_q, env: dict[str, str]) -> None:
     """Entry point of a spawned worker process."""
     os.environ.update(env)
+    from cosmos_curate_tpu.observability.tracing import setup_tracing_from_env, traced_span
+
+    setup_tracing_from_env()
     stage = None
     meta = None
     worker_id = env.get("CURATE_WORKER_ID", "worker-?")
@@ -114,7 +117,10 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
                 continue
             t0 = time.monotonic()
             try:
-                result = stage.process_data(tasks)
+                with traced_span(
+                    f"stage.{type(stage).__name__}.process", batch_size=len(tasks)
+                ):
+                    result = stage.process_data(tasks)
                 if result is not None and not isinstance(result, list):
                     raise TypeError(
                         f"stage {type(stage).__name__}.process_data must return "

@@ -1,0 +1,116 @@
+"""Per-stage profiling with zero stage-code changes.
+
+Equivalent capability of the reference's profiling layer
+(cosmos_curate/core/utils/infra/profiling.py — CPU/memory/GPU backends
+injected by dynamic subclassing via ``profiling_wrapper``:1129 and driven by
+``profiling_scope``:1301). Backends here: cProfile (stdlib; pyinstrument is
+not in this image) for CPU, tracemalloc for memory, and ``jax.profiler``
+traces for device stages (the TPU answer to torch.profiler). Artifacts land
+under ``<output>/profile/{cpu,memory,device}/``.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import tracemalloc
+from dataclasses import dataclass, field
+from typing import Any
+
+from cosmos_curate_tpu.core.stage import Stage
+from cosmos_curate_tpu.storage.client import write_bytes
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class ProfilingConfig:
+    cpu: bool = False
+    memory: bool = False
+    device: bool = False  # jax.profiler trace around process_data
+    output_path: str = "/tmp/curate_profile"
+    top_n: int = 50
+
+
+def profiling_wrapper(stage: Stage, config: ProfilingConfig) -> Stage:
+    """Wrap a stage instance so its hot methods are profiled; the stage's
+    class is subclassed dynamically (the reference's trick) so isinstance
+    checks and all behavior survive."""
+    cls = type(stage)
+
+    class ProfiledStage(cls):  # type: ignore[misc, valid-type]
+        def process_data(self, tasks):  # noqa: D102
+            return _profiled_call(self, cls.process_data, config, tasks)
+
+        def destroy(self):  # noqa: D102
+            _flush_profiles(self, config)
+            cls.destroy(self)
+
+    # stage.name resolves _display_name set by any earlier wrapper, so the
+    # user-visible stage name survives stacked dynamic subclassing
+    display = stage.name
+    stage.__class__ = ProfiledStage
+    stage._profile_state = _ProfileState()  # type: ignore[attr-defined]
+    stage._profile_name = display  # type: ignore[attr-defined]
+    stage._display_name = display  # type: ignore[attr-defined]
+    return stage
+
+
+@dataclass
+class _ProfileState:
+    profiler: cProfile.Profile | None = None
+    calls: int = 0
+    mem_snapshots: list[str] = field(default_factory=list)
+
+
+def _profiled_call(stage: Any, fn, config: ProfilingConfig, tasks):
+    state: _ProfileState = stage._profile_state
+    state.calls += 1
+    ctx_device = None
+    if config.device:
+        import jax
+
+        trace_dir = f"{config.output_path}/device/{stage._profile_name}"
+        os.makedirs(trace_dir, exist_ok=True)
+        ctx_device = jax.profiler.trace(trace_dir)
+        ctx_device.__enter__()
+    if config.memory and not tracemalloc.is_tracing():
+        tracemalloc.start()
+    if config.cpu:
+        if state.profiler is None:
+            state.profiler = cProfile.Profile()
+        state.profiler.enable()
+    try:
+        return fn(stage, tasks)
+    finally:
+        if config.cpu and state.profiler is not None:
+            state.profiler.disable()
+        if config.memory:
+            current, peak = tracemalloc.get_traced_memory()
+            state.mem_snapshots.append(f"call {state.calls}: current={current} peak={peak}")
+            tracemalloc.reset_peak()
+        if ctx_device is not None:
+            ctx_device.__exit__(None, None, None)
+
+
+def _flush_profiles(stage: Any, config: ProfilingConfig) -> None:
+    state: _ProfileState = getattr(stage, "_profile_state", None)
+    if state is None:
+        return
+    name = getattr(stage, "_profile_name", type(stage).__name__)
+    pid = os.getpid()
+    if config.cpu and state.profiler is not None:
+        buf = io.StringIO()
+        pstats.Stats(state.profiler, stream=buf).sort_stats("cumulative").print_stats(
+            config.top_n
+        )
+        write_bytes(f"{config.output_path}/cpu/{name}-{pid}.txt", buf.getvalue().encode())
+    if config.memory and state.mem_snapshots:
+        write_bytes(
+            f"{config.output_path}/memory/{name}-{pid}.txt",
+            "\n".join(state.mem_snapshots).encode(),
+        )
+    logger.info("profiling artifacts flushed for %s (%d calls)", name, state.calls)

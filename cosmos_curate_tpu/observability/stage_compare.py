@@ -1,0 +1,122 @@
+"""Golden-output comparison: recursive diff of task trees with tolerances.
+
+Equivalent capability of the reference's stage compare harness
+(cosmos_curate/core/utils/misc/stage_compare.py:40-376 — comparator
+registry, recursive attrs/array diff with atol, ``CompareReport``,
+pass-rate threshold; used by ``--stage-compare`` for golden regression
+runs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any
+
+import numpy as np
+
+
+@dataclass
+class Mismatch:
+    path: str
+    reason: str
+
+
+@dataclass
+class CompareReport:
+    total: int = 0
+    passed: int = 0
+    mismatches: list[Mismatch] = field(default_factory=list)
+
+    @property
+    def pass_rate(self) -> float:
+        return self.passed / self.total if self.total else 1.0
+
+    def ok(self, threshold: float = 1.0) -> bool:
+        return self.pass_rate >= threshold
+
+    def summary(self) -> str:
+        lines = [f"compare: {self.passed}/{self.total} passed ({self.pass_rate:.1%})"]
+        lines += [f"  {m.path}: {m.reason}" for m in self.mismatches[:20]]
+        if len(self.mismatches) > 20:
+            lines.append(f"  … {len(self.mismatches) - 20} more")
+        return "\n".join(lines)
+
+
+def _diff(a: Any, b: Any, path: str, out: list[Mismatch], atol: float, ignore: set[str]) -> None:
+    if type(a) is not type(b) and not (
+        isinstance(a, (int, float)) and isinstance(b, (int, float))
+    ):
+        out.append(Mismatch(path, f"type {type(a).__name__} != {type(b).__name__}"))
+        return
+    if isinstance(a, np.ndarray):
+        if a.shape != b.shape:
+            out.append(Mismatch(path, f"shape {a.shape} != {b.shape}"))
+        elif a.dtype.kind in "fc" or b.dtype.kind in "fc":
+            if not np.allclose(a, b, atol=atol, equal_nan=True):
+                out.append(Mismatch(path, f"max |Δ| {np.abs(a - b).max():.3e} > atol {atol}"))
+        elif not np.array_equal(a, b):
+            out.append(Mismatch(path, "arrays differ"))
+        return
+    if isinstance(a, float):
+        if abs(a - b) > atol and not (np.isnan(a) and np.isnan(b)):
+            out.append(Mismatch(path, f"{a} != {b} (atol {atol})"))
+        return
+    if isinstance(a, (int, str, bytes, bool, type(None))):
+        if a != b:
+            out.append(Mismatch(path, f"{a!r} != {b!r}"))
+        return
+    if isinstance(a, dict):
+        for k in sorted(set(a) | set(b), key=str):
+            if str(k) in ignore:
+                continue
+            if k not in a or k not in b:
+                out.append(Mismatch(f"{path}.{k}", "missing on one side"))
+            else:
+                _diff(a[k], b[k], f"{path}.{k}", out, atol, ignore)
+        return
+    if isinstance(a, (list, tuple)):
+        if len(a) != len(b):
+            out.append(Mismatch(path, f"length {len(a)} != {len(b)}"))
+            return
+        for i, (x, y) in enumerate(zip(a, b)):
+            _diff(x, y, f"{path}[{i}]", out, atol, ignore)
+        return
+    if is_dataclass(a):
+        for f in fields(a):
+            if f.name in ignore:
+                continue
+            _diff(getattr(a, f.name), getattr(b, f.name), f"{path}.{f.name}", out, atol, ignore)
+        return
+    if hasattr(a, "__dict__"):
+        _diff(vars(a), vars(b), path, out, atol, ignore)
+        return
+    if a != b:
+        out.append(Mismatch(path, f"{a!r} != {b!r}"))
+
+
+def compare_tasks(
+    actual: list,
+    golden: list,
+    *,
+    atol: float = 1e-5,
+    ignore_fields: tuple[str, ...] = ("stage_perf",),
+) -> CompareReport:
+    """Compare two task lists item-by-item; an item passes if it produced
+    zero mismatches."""
+    report = CompareReport()
+    if len(actual) != len(golden):
+        report.total = max(len(actual), len(golden))
+        report.mismatches.append(
+            Mismatch("$", f"task count {len(actual)} != {len(golden)}")
+        )
+        return report
+    ignore = set(ignore_fields)
+    for i, (a, g) in enumerate(zip(actual, golden)):
+        found: list[Mismatch] = []
+        _diff(a, g, f"task[{i}]", found, atol, ignore)
+        report.total += 1
+        if found:
+            report.mismatches.extend(found)
+        else:
+            report.passed += 1
+    return report

@@ -1,0 +1,89 @@
+"""Stage save / replay: record real per-stage inputs, re-run one stage
+offline.
+
+Equivalent capability of the reference's stage replay tooling
+(cosmos_curate/core/utils/misc/stage_replay.py — ``StageSaveConfig``:303,
+pickle task serializer:182, ``run_stage_replay``:639, ``stage_save_wrapper``
+:710; workflow doc docs/curator/guides/STAGE_REPLAY.md): debugging a stage
+against production data without re-running the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import pickle
+import random
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from cosmos_curate_tpu.core.stage import NodeInfo, Stage, WorkerMetadata
+from cosmos_curate_tpu.storage.client import write_bytes
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class StageSaveConfig:
+    output_path: str
+    sample_rate: float = 0.1  # fraction of process_data batches recorded
+    stages: tuple[str, ...] = ()  # () = all stages
+    seed: int = 0
+
+
+def stage_save_wrapper(stage: Stage, config: StageSaveConfig) -> Stage:
+    """Dynamic subclass recording sampled ``process_data`` inputs."""
+    if config.stages and stage.name not in config.stages:
+        return stage
+    cls = type(stage)
+    rng = random.Random(config.seed)
+    display = stage.name
+
+    class SavingStage(cls):  # type: ignore[misc, valid-type]
+        def process_data(self, tasks):
+            if rng.random() < config.sample_rate:
+                stamp = time.time_ns()
+                path = (
+                    f"{config.output_path.rstrip('/')}/stage_inputs/"
+                    f"{display}/batch-{stamp}.pkl"
+                )
+                try:
+                    write_bytes(path, pickle.dumps(tasks, protocol=5))
+                except Exception:
+                    logger.exception("stage-save failed for %s", display)
+            return cls.process_data(self, tasks)
+
+    stage.__class__ = SavingStage
+    stage._display_name = display  # type: ignore[attr-defined]
+    return stage
+
+
+def load_saved_batches(saved_root: str, stage_name: str) -> list[list]:
+    root = Path(saved_root) / "stage_inputs" / stage_name
+    batches = []
+    for p in sorted(root.glob("batch-*.pkl")):
+        batches.append(pickle.loads(p.read_bytes()))
+    return batches
+
+
+def run_stage_replay(stage: Stage, saved_root: str) -> list[list]:
+    """Run one stage directly over its recorded inputs (DirectExecutor
+    semantics: setup -> process each batch -> destroy). Returns the list of
+    output batches."""
+    batches = load_saved_batches(saved_root, stage.name)
+    if not batches:
+        raise FileNotFoundError(
+            f"no saved batches for stage {stage.name} under {saved_root}"
+        )
+    node = NodeInfo(node_id="replay")
+    meta = WorkerMetadata(worker_id="replay-0", stage_name=stage.name, node=node)
+    stage.setup_on_node(node, meta)
+    stage.setup(meta)
+    outputs = []
+    try:
+        for batch in batches:
+            outputs.append(stage.process_data(batch))
+    finally:
+        stage.destroy()
+    logger.info("replayed %d batches through %s", len(batches), stage.name)
+    return outputs

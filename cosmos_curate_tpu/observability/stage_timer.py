@@ -1,0 +1,49 @@
+"""Per-task stage timing statistics.
+
+Equivalent capability of the reference's ``StageTimer``
+(cosmos_curate/core/utils/infra/performance_utils.py — per-task wall/idle
+stats behind ``--perf-profile``, feeding the summary and spans).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class StageTimer:
+    stage_name: str
+    samples_s: list[float] = field(default_factory=list)
+    idle_s: float = 0.0
+    _last_end: float | None = None
+
+    @contextlib.contextmanager
+    def time_process(self):
+        start = time.monotonic()
+        if self._last_end is not None:
+            self.idle_s += start - self._last_end
+        try:
+            yield
+        finally:
+            end = time.monotonic()
+            self.samples_s.append(end - start)
+            self._last_end = end
+
+    def summary(self) -> dict:
+        arr = np.asarray(self.samples_s)
+        if arr.size == 0:
+            return {"stage": self.stage_name, "count": 0}
+        return {
+            "stage": self.stage_name,
+            "count": int(arr.size),
+            "total_s": float(arr.sum()),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p90_s": float(np.percentile(arr, 90)),
+            "max_s": float(arr.max()),
+            "idle_s": self.idle_s,
+        }

@@ -1,0 +1,175 @@
+"""Two-layer tracing: a dependency-light span API + a pluggable backend.
+
+Equivalent capability of the reference's tracing design
+(cosmos_curate/core/utils/infra/tracing.py:326-770 public API — TracedSpan /
+traced_span / @traced, no-ops when disabled — and tracing_hook.py's
+per-worker NDJSON export). Spans are recorded to one NDJSON file per process
+(collectable post-run) and, when the opentelemetry SDK is configured by the
+embedding application, mirrored onto real OTel spans. Disabled = zero-cost:
+every call path short-circuits on one boolean.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+_enabled = False
+_backend: "_NdjsonBackend | None" = None
+_local = threading.local()
+
+
+@dataclass
+class TracedSpan:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start_s: float
+    attributes: dict[str, Any] = field(default_factory=dict)
+    end_s: float | None = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_s or time.time()) - self.start_s
+
+
+class _NdjsonBackend:
+    """Buffers span records and flushes through the storage layer, so a
+    remote output root (s3://, gs://) receives traces like every other
+    artifact instead of a bogus local directory."""
+
+    FLUSH_EVERY = 200
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lines: list[str] = []
+        self._lock = threading.Lock()
+
+    def export(self, span: TracedSpan) -> None:
+        record = {
+            "name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.span_id,
+            "parent_id": span.parent_id,
+            "start_s": span.start_s,
+            "end_s": span.end_s,
+            "duration_s": span.duration_s,
+            "attributes": span.attributes,
+            "pid": os.getpid(),
+        }
+        with self._lock:
+            self._lines.append(json.dumps(record))
+            if len(self._lines) % self.FLUSH_EVERY == 0:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        from cosmos_curate_tpu.storage.client import write_bytes
+
+        write_bytes(self.path, ("\n".join(self._lines) + "\n").encode())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._lines:
+                self._flush_locked()
+
+
+def enable_tracing(output_path: str | None = None) -> str:
+    """Turn tracing on for this process; returns the NDJSON path."""
+    global _enabled, _backend
+    path = output_path or os.environ.get(
+        "CURATE_TRACE_PATH", f"/tmp/curate_traces/trace-{os.getpid()}.ndjson"
+    )
+    _backend = _NdjsonBackend(path)
+    _enabled = True
+    return path
+
+
+def disable_tracing() -> None:
+    global _enabled, _backend
+    _enabled = False
+    if _backend is not None:
+        _backend.close()
+        _backend = None
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def _current_stack() -> list[TracedSpan]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+@contextlib.contextmanager
+def traced_span(name: str, **attributes: Any) -> Iterator[TracedSpan]:
+    """Context manager span; cheap no-op (yields a dummy) when disabled."""
+    if not _enabled:
+        yield _NOOP_SPAN
+        return
+    stack = _current_stack()
+    parent = stack[-1] if stack else None
+    span = TracedSpan(
+        name=name,
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+        span_id=uuid.uuid4().hex[:16],
+        parent_id=parent.span_id if parent else None,
+        start_s=time.time(),
+        attributes=dict(attributes),
+    )
+    stack.append(span)
+    try:
+        yield span
+    except Exception as e:
+        span.attributes["error"] = repr(e)
+        raise
+    finally:
+        span.end_s = time.time()
+        stack.pop()
+        if _backend is not None:
+            _backend.export(span)
+
+
+class _NoopSpan(TracedSpan):
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass  # shared module-global: must not accumulate state
+
+
+_NOOP_SPAN = _NoopSpan("noop", "0", "0", None, 0.0)
+
+
+def traced(fn: Callable | None = None, *, name: str | None = None):
+    """Decorator form of ``traced_span``."""
+
+    def deco(f: Callable) -> Callable:
+        span_name = name or f"{f.__module__}.{f.__qualname__}"
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            if not _enabled:
+                return f(*args, **kwargs)
+            with traced_span(span_name):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def setup_tracing_from_env() -> None:
+    """Worker startup hook (reference tracing_hook.setup_tracing): enables
+    tracing when CURATE_TRACING=1 is in the environment."""
+    if os.environ.get("CURATE_TRACING") == "1":
+        enable_tracing()

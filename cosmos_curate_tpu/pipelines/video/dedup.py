@@ -1,0 +1,108 @@
+"""Semantic dedup pipeline: embeddings parquet → pruned clip set.
+
+Equivalent capability of the reference's dedup pipeline
+(cosmos_curate/pipelines/video/dedup_pipeline.py + dedup/: RAFT/NCCL actor
+pool + cuML k-means + per-cluster pruning; output layout
+docs/curator/reference/VIDEO_PIPELINES.md:196-206). Here the collective
+plane is the JAX mesh (dedup/kmeans.py); this module is the IO + orchestration:
+read every embeddings parquet under the split output, run semantic_dedup,
+write ``dedup/dedup_summary_<eps>.csv`` plus kept/removed id lists.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from cosmos_curate_tpu.dedup.kmeans import semantic_dedup
+from cosmos_curate_tpu.storage.client import get_storage_client, read_bytes
+from cosmos_curate_tpu.storage.writers import write_csv, write_json
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class DedupPipelineArgs:
+    input_path: str = ""  # split output root (with embeddings/<model>/)
+    output_path: str = ""  # defaults to <input>/dedup
+    embedding_model: str = ""  # "" = first found
+    eps: float = 0.07
+    n_clusters: int = 0  # 0 = sqrt(N)
+    max_iters: int = 20
+    use_mesh: bool = True
+
+
+def load_embeddings(input_path: str, model: str = "") -> tuple[list[str], np.ndarray, str]:
+    """Read all per-chunk embedding parquets under the split output."""
+    import pyarrow.parquet as pq
+
+    client = get_storage_client(input_path)
+    root = f"{input_path.rstrip('/')}/embeddings"
+    files = list(client.list_files(root, suffixes=(".parquet",)))
+    if model:
+        files = [f for f in files if f"/embeddings/{model}/" in f.path]
+    if not files:
+        raise FileNotFoundError(f"no embedding parquets under {root}")
+    found_model = files[0].path.rsplit("/embeddings/", 1)[1].split("/", 1)[0]
+    # one embedding space only: mixing models would compare incompatible
+    # vectors (or crash on dim mismatch)
+    files = [f for f in files if f"/embeddings/{found_model}/" in f.path]
+    ids: list[str] = []
+    vecs: list[np.ndarray] = []
+    for f in files:
+        table = pq.read_table(io.BytesIO(read_bytes(f.path)))
+        ids.extend(table.column("clip_uuid").to_pylist())
+        vecs.extend(np.asarray(v, np.float32) for v in table.column("embedding").to_pylist())
+    return ids, np.stack(vecs), found_model
+
+
+def run_dedup(args: DedupPipelineArgs) -> dict:
+    t0 = time.monotonic()
+    out = (args.output_path or f"{args.input_path.rstrip('/')}/dedup").rstrip("/")
+    ids, embeddings, model = load_embeddings(args.input_path, args.embedding_model)
+    logger.info("dedup: %d embeddings (%s, dim %d)", len(ids), model, embeddings.shape[1])
+    mesh = None
+    if args.use_mesh:
+        try:
+            from cosmos_curate_tpu.parallel.mesh import best_effort_mesh
+
+            mesh = best_effort_mesh()
+        except Exception as e:
+            logger.warning("no mesh available (%s); single-device kmeans", e)
+    result = semantic_dedup(
+        embeddings,
+        ids,
+        n_clusters=args.n_clusters or None,
+        eps=args.eps,
+        iters=args.max_iters,
+        mesh=mesh,
+    )
+    rows = [
+        {
+            "clip_uuid": cid,
+            "action": "removed",
+            "duplicate_of": result["duplicate_of"].get(cid, ""),
+        }
+        for cid in result["removed"]
+    ] + [{"clip_uuid": cid, "action": "kept", "duplicate_of": ""} for cid in result["kept"]]
+    write_csv(
+        f"{out}/dedup_summary_{args.eps:g}.csv", rows, ["clip_uuid", "action", "duplicate_of"]
+    )
+    summary = {
+        "embedding_model": model,
+        "eps": args.eps,
+        "num_embeddings": len(ids),
+        "num_kept": len(result["kept"]),
+        "num_removed": len(result["removed"]),
+        "elapsed_s": time.monotonic() - t0,
+    }
+    write_json(f"{out}/summary.json", summary)
+    logger.info(
+        "dedup done: kept %d / removed %d in %.1fs",
+        summary["num_kept"], summary["num_removed"], summary["elapsed_s"],
+    )
+    return summary

@@ -60,6 +60,11 @@ class SplitPipelineArgs:
     # execution
     num_chips: int = 0  # 0 = discover
     perf_profile: bool = False
+    profile_cpu: bool = False
+    profile_memory: bool = False
+    tracing: bool = False
+    stage_save_rate: float = 0.0  # sampled process_data input recording
+    stage_save_stages: tuple[str, ...] = ()
     extra_stages: list[Stage | StageSpec] = field(default_factory=list)
 
 
@@ -119,7 +124,9 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
             CaptionStage,
         )
 
-        stages.append(CaptionPrepStage(window_len=args.caption_window_len))
+        stages.append(
+            CaptionPrepStage(window_len=args.caption_window_len, extraction=primary_sig)
+        )
         stages.append(CaptionStage(prompt_variant=args.caption_prompt_variant))
     stages.extend(args.extra_stages)
     stages.append(ClipWriterStage(args.output_path))
@@ -134,9 +141,20 @@ def run_split(
 ) -> dict:
     """Build inputs (with resume), run, write summary.json; returns summary."""
     t0 = time.monotonic()
-    tasks = discover_split_tasks(args.input_path, args.output_path, limit=args.limit)
-    stages = assemble_stages(args)
-    out = run_pipeline(tasks, stages, config=config, runner=runner) or []
+    if args.tracing:
+        from cosmos_curate_tpu.observability.tracing import enable_tracing
+
+        enable_tracing(f"{args.output_path.rstrip('/')}/profile/traces/driver.ndjson")
+    try:
+        tasks = discover_split_tasks(args.input_path, args.output_path, limit=args.limit)
+        stages = assemble_stages(args)
+        stages = _apply_observability_wrappers(stages, args)
+        out = run_pipeline(tasks, stages, config=config, runner=runner) or []
+    finally:
+        if args.tracing:
+            from cosmos_curate_tpu.observability.tracing import disable_tracing
+
+            disable_tracing()  # flushes buffered spans through storage
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
     summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)
@@ -146,6 +164,41 @@ def run_split(
         summary["num_videos"], summary["num_clips"], elapsed,
     )
     return summary
+
+
+def _apply_observability_wrappers(
+    stages: list[Stage | StageSpec], args: SplitPipelineArgs
+) -> list[Stage | StageSpec]:
+    """Inject stage-save and profiling wrappers (dynamic subclassing — the
+    reference's zero-stage-code-change approach, profiling.py:1129)."""
+    out_root = args.output_path.rstrip("/")
+    if args.stage_save_rate > 0:
+        from cosmos_curate_tpu.observability.stage_replay import (
+            StageSaveConfig,
+            stage_save_wrapper,
+        )
+
+        cfg = StageSaveConfig(
+            output_path=f"{out_root}/stage_save",
+            sample_rate=args.stage_save_rate,
+            stages=args.stage_save_stages,
+        )
+        for s in stages:  # wrappers mutate the stage instance in place
+            stage_save_wrapper(s.stage if isinstance(s, StageSpec) else s, cfg)
+    if args.profile_cpu or args.profile_memory:
+        from cosmos_curate_tpu.observability.profiling import (
+            ProfilingConfig,
+            profiling_wrapper,
+        )
+
+        cfg = ProfilingConfig(
+            cpu=args.profile_cpu,
+            memory=args.profile_memory,
+            output_path=f"{out_root}/profile",
+        )
+        for s in stages:
+            profiling_wrapper(s.stage if isinstance(s, StageSpec) else s, cfg)
+    return stages
 
 
 def _discover_num_chips() -> int:

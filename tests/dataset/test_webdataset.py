@@ -1,0 +1,58 @@
+import json
+
+import numpy as np
+
+from cosmos_curate_tpu.dataset.dimensions import bucket_for
+from cosmos_curate_tpu.dataset.webdataset import (
+    ShardWriter,
+    encode_sample_parts,
+    iter_tar_samples,
+)
+
+
+class TestDimensions:
+    def test_standard_buckets(self):
+        b = bucket_for(1920, 1080, 300)
+        assert b.key == "16-9_1080p_w256"
+        b = bucket_for(640, 480, 100)
+        assert b.key == "4-3_480p_w64"
+        b = bucket_for(1080, 1920, 20)
+        assert b.aspect == "9-16"
+        assert b.frame_window == 16
+
+    def test_degenerate(self):
+        assert bucket_for(0, 0, 0).key == "1-1_0p_w0"
+
+
+class TestShardWriter:
+    def test_samples_roundtrip(self, tmp_path):
+        writer = ShardWriter(str(tmp_path / "b"), max_samples_per_shard=2)
+        for i in range(5):
+            writer.add_sample(
+                f"clip{i}",
+                encode_sample_parts(
+                    mp4=b"\x00" * 10,
+                    meta={"i": i},
+                    arrays={"embedding": np.arange(4, dtype=np.float32)},
+                    text=f"caption {i}",
+                ),
+            )
+        shards = writer.close()
+        assert len(shards) == 3  # 2+2+1
+        data = open(shards[0], "rb").read()
+        samples = list(iter_tar_samples(data))
+        assert len(samples) == 2
+        key, parts = samples[0]
+        assert key == "clip0"
+        assert parts["mp4"] == b"\x00" * 10
+        assert json.loads(parts["json"]) == {"i": 0}
+        assert parts["txt"] == b"caption 0"
+        import io
+
+        np.testing.assert_array_equal(
+            np.load(io.BytesIO(parts["embedding.npy"])), np.arange(4, dtype=np.float32)
+        )
+
+    def test_empty_writer_no_shards(self, tmp_path):
+        writer = ShardWriter(str(tmp_path / "b"))
+        assert writer.close() == []

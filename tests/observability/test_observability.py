@@ -1,0 +1,139 @@
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.stage import Stage
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.observability import tracing
+from cosmos_curate_tpu.observability.profiling import ProfilingConfig, profiling_wrapper
+from cosmos_curate_tpu.observability.stage_compare import compare_tasks
+from cosmos_curate_tpu.observability.stage_replay import (
+    StageSaveConfig,
+    load_saved_batches,
+    run_stage_replay,
+    stage_save_wrapper,
+)
+from cosmos_curate_tpu.observability.stage_timer import StageTimer
+
+
+@dataclass
+class Tok(PipelineTask):
+    value: int = 0
+    arr: np.ndarray = field(default_factory=lambda: np.zeros(3, np.float32))
+
+
+class Work(Stage):
+    def process_data(self, tasks):
+        return [Tok(value=t.value + 1, arr=t.arr + 1) for t in tasks]
+
+
+class TestTracing:
+    def test_noop_when_disabled(self):
+        assert not tracing.tracing_enabled()
+        with tracing.traced_span("x") as span:
+            pass  # must not record anywhere
+        assert span.name == "noop"
+
+    def test_spans_exported_with_hierarchy(self, tmp_path):
+        path = tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        try:
+            with tracing.traced_span("parent", video="v.mp4"):
+                with tracing.traced_span("child"):
+                    pass
+        finally:
+            tracing.disable_tracing()
+        records = [json.loads(line) for line in open(path)]
+        assert [r["name"] for r in records] == ["child", "parent"]
+        child, parent = records
+        assert child["parent_id"] == parent["span_id"]
+        assert child["trace_id"] == parent["trace_id"]
+        assert parent["attributes"]["video"] == "v.mp4"
+
+    def test_traced_decorator_and_error_capture(self, tmp_path):
+        path = tracing.enable_tracing(str(tmp_path / "t2.ndjson"))
+
+        @tracing.traced
+        def boom():
+            raise ValueError("nope")
+
+        try:
+            with pytest.raises(ValueError):
+                boom()
+        finally:
+            tracing.disable_tracing()
+        rec = json.loads(open(path).readline())
+        assert "ValueError" in rec["attributes"]["error"]
+
+
+class TestProfiling:
+    def test_cpu_profile_artifact(self, tmp_path):
+        stage = profiling_wrapper(
+            Work(), ProfilingConfig(cpu=True, output_path=str(tmp_path))
+        )
+        out = run_pipeline([Tok(value=1)], [stage], runner=SequentialRunner())
+        assert out[0].value == 2  # behavior preserved
+        artifacts = list((tmp_path / "cpu").glob("Work-*.txt"))
+        assert len(artifacts) == 1
+        assert "process_data" in artifacts[0].read_text()
+
+    def test_memory_profile_artifact(self, tmp_path):
+        stage = profiling_wrapper(
+            Work(), ProfilingConfig(memory=True, output_path=str(tmp_path))
+        )
+        run_pipeline([Tok(value=1)], [stage], runner=SequentialRunner())
+        artifacts = list((tmp_path / "memory").glob("Work-*.txt"))
+        assert artifacts and "peak=" in artifacts[0].read_text()
+
+
+class TestStageTimer:
+    def test_stats(self):
+        timer = StageTimer("s")
+        for _ in range(3):
+            with timer.time_process():
+                pass
+        s = timer.summary()
+        assert s["count"] == 3
+        assert s["p50_s"] >= 0
+        assert timer.idle_s >= 0
+
+    def test_empty(self):
+        assert StageTimer("s").summary() == {"stage": "s", "count": 0}
+
+
+class TestReplayCompare:
+    def test_save_replay_compare_roundtrip(self, tmp_path):
+        stage = stage_save_wrapper(
+            Work(), StageSaveConfig(output_path=str(tmp_path), sample_rate=1.0)
+        )
+        original = run_pipeline(
+            [Tok(value=i) for i in range(4)], [stage], runner=SequentialRunner()
+        )
+        batches = load_saved_batches(str(tmp_path), "Work")
+        assert len(batches) == 4  # batch_size 1
+        replayed = [t for batch in run_stage_replay(Work(), str(tmp_path)) for t in batch]
+        report = compare_tasks(replayed, original)
+        assert report.ok()
+
+    def test_compare_detects_drift(self):
+        a = [Tok(value=1, arr=np.ones(3, np.float32))]
+        b = [Tok(value=1, arr=np.ones(3, np.float32) + 0.5)]
+        report = compare_tasks(a, b, atol=1e-3)
+        assert not report.ok()
+        assert "arr" in report.mismatches[0].path
+        # larger atol passes
+        assert compare_tasks(a, b, atol=1.0).ok()
+
+    def test_compare_count_mismatch(self):
+        report = compare_tasks([Tok()], [])
+        assert not report.ok()
+
+    def test_sample_rate_zero_records_nothing(self, tmp_path):
+        stage = stage_save_wrapper(
+            Work(), StageSaveConfig(output_path=str(tmp_path), sample_rate=0.0)
+        )
+        run_pipeline([Tok(value=1)], [stage], runner=SequentialRunner())
+        assert not (tmp_path / "stage_inputs").exists()

@@ -1,0 +1,95 @@
+"""End-to-end curation: split+embed → dedup → shard (the reference's e2e
+flow, .gitlab/scripts/slurm_end_to_end.sh, hermetic and in-process)."""
+
+import json
+
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_TINY_TEST
+from cosmos_curate_tpu.data.model import FrameExtractionSignature
+from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+from cosmos_curate_tpu.pipelines.video.shard import ShardPipelineArgs, run_shard
+from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+from cosmos_curate_tpu.pipelines.video.stages.embedding import ClipEmbeddingStage
+from tests.fixtures.media import make_scene_video
+
+
+@pytest.fixture(scope="module")
+def curated(tmp_path_factory):
+    root = tmp_path_factory.mktemp("e2e")
+    vids = root / "in"
+    vids.mkdir()
+    # v0 and v1 are identical -> their clips should dedup against each other
+    make_scene_video(vids / "v0.mp4", scene_len_frames=24, num_scenes=2)
+    make_scene_video(vids / "v1.mp4", scene_len_frames=24, num_scenes=2)
+    make_scene_video(vids / "v2.mp4", scene_len_frames=24, num_scenes=2, moving_box=False)
+    sig = FrameExtractionSignature("fps", 4.0)
+    split_out = root / "split"
+    split_summary = run_split(
+        SplitPipelineArgs(
+            input_path=str(vids),
+            output_path=str(split_out),
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            extract_fps=(4.0,),
+            extract_resize_hw=(32, 32),
+            extra_stages=[
+                ClipEmbeddingStage(variant="video", video_cfg=VIDEO_EMBED_TINY_TEST, extraction=sig)
+            ],
+        ),
+        runner=SequentialRunner(),
+    )
+    dedup_summary = run_dedup(
+        DedupPipelineArgs(input_path=str(split_out), eps=0.001, n_clusters=2, use_mesh=True)
+    )
+    shard_out = root / "shards"
+    shard_summary = run_shard(
+        ShardPipelineArgs(
+            input_path=str(split_out),
+            output_path=str(shard_out),
+            dedup_csv=str(split_out / "dedup" / "dedup_summary_0.001.csv"),
+        )
+    )
+    return split_out, shard_out, split_summary, dedup_summary, shard_summary
+
+
+def test_split_produced_embeddings(curated):
+    _, _, split_summary, _, _ = curated
+    assert split_summary["num_clips"] == 6
+    assert split_summary["num_with_embeddings"] == 6
+
+
+def test_dedup_removed_duplicate_videos_clips(curated):
+    _, _, _, dedup_summary, _ = curated
+    assert dedup_summary["num_embeddings"] == 6
+    # v0 and v1 are pixel-identical: at least their 2x2 clips collapse
+    assert dedup_summary["num_removed"] >= 2
+    assert dedup_summary["num_kept"] + dedup_summary["num_removed"] == 6
+
+
+def test_shards_respect_dedup(curated):
+    split_out, shard_out, _, dedup_summary, shard_summary = curated
+    assert shard_summary["num_samples"] == dedup_summary["num_kept"]
+    assert shard_summary["num_skipped_by_dedup"] == dedup_summary["num_removed"]
+    index = json.loads((shard_out / "index.json").read_text())
+    assert index["num_samples"] == shard_summary["num_samples"]
+    # every listed shard exists
+    for bucket in index["buckets"].values():
+        for shard in bucket["shards"]:
+            import pathlib
+
+            assert pathlib.Path(shard).exists()
+
+
+def test_shard_contents_complete(curated):
+    _, shard_out, _, _, shard_summary = curated
+    from cosmos_curate_tpu.dataset.webdataset import iter_tar_samples
+
+    total = 0
+    for tar_path in shard_out.rglob("*.tar"):
+        for key, parts in iter_tar_samples(tar_path.read_bytes()):
+            assert "mp4" in parts and "json" in parts
+            assert "embedding.npy" in parts
+            total += 1
+    assert total == shard_summary["num_samples"]
