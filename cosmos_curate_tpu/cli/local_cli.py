@@ -30,6 +30,11 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--aesthetic-threshold", type=float, default=None)
     split.add_argument("--embedding-model", choices=["", "clip", "video"], default="")
     split.add_argument("--captioning", action="store_true")
+    split.add_argument("--enhance-captions", action="store_true")
+    split.add_argument("--t5-embeddings", action="store_true")
+    split.add_argument("--previews", action="store_true")
+    split.add_argument("--text-filter", choices=["disable", "score-only", "enable"], default="disable")
+    split.add_argument("--semantic-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--clip-chunk-size", type=int, default=64)
     split.add_argument("--sequential", action="store_true", help="run in-process (no engine)")
     split.add_argument("--profile-cpu", action="store_true")
@@ -118,6 +123,11 @@ def _cmd_split(args: argparse.Namespace) -> int:
             aesthetic_threshold=args.aesthetic_threshold,
             embedding_model=args.embedding_model,
             captioning=args.captioning,
+            enhance_captions=args.enhance_captions,
+            t5_embeddings=args.t5_embeddings,
+            previews=args.previews,
+            text_filter=args.text_filter,
+            semantic_filter=args.semantic_filter,
             clip_chunk_size=args.clip_chunk_size,
             profile_cpu=args.profile_cpu,
             profile_memory=args.profile_memory,

@@ -1,0 +1,20 @@
+"""`cosmos-curate-tpu serve` — run the job service."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    serve = sub.add_parser("serve", help="run the HTTP job service")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=8080)
+    serve.add_argument("--work-root", default="/tmp/curate_service")
+    serve.set_defaults(func=_cmd_serve)
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.service.app import serve
+
+    serve(host=args.host, port=args.port, work_root=args.work_root)
+    return 0

@@ -1,0 +1,96 @@
+"""`cosmos-curate-tpu slurm` — generate/submit sbatch scripts for TPU pods.
+
+Equivalent capability of the reference's slurm CLI
+(cosmos_curate/client/slurm_cli/slurm.py + scripts/onto_slurm.py — node 0
+runs the driver, others join the cluster). TPU-flavored: every node runs the
+same program under `jax.distributed` (SPMD), with node 0 also running the
+pipeline driver; coordinator discovery via the Slurm nodelist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+from pathlib import Path
+
+_SBATCH_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={job_name}
+#SBATCH --nodes={nodes}
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task={cpus_per_task}
+#SBATCH --time={time_limit}
+#SBATCH --output={log_dir}/%x-%j.out
+{extra_directives}
+set -euo pipefail
+
+# coordinator = first node in the allocation (jax.distributed convention);
+# CURATE_NODE_RANK is resolved per task by srun via SLURM_NODEID.
+COORD=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
+export CURATE_COORDINATOR_ADDRESS="$COORD:{coordinator_port}"
+export CURATE_NUM_NODES="$SLURM_JOB_NUM_NODES"
+{env_exports}
+
+# srun exports the environment; no nested shell, so arbitrary quoting in
+# the command survives verbatim. Node rank is read from SLURM_NODEID by
+# cosmos_curate_tpu.parallel.distributed in each task.
+srun --kill-on-bad-exit=1 {python} -m cosmos_curate_tpu.cli.main {command}
+"""
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    slurm = sub.add_parser("slurm", help="generate/submit sbatch for a TPU pod")
+    slurm.add_argument("--job-name", default="cosmos-curate-tpu")
+    slurm.add_argument("--nodes", type=int, default=1)
+    slurm.add_argument("--cpus-per-task", type=int, default=96)
+    slurm.add_argument("--time-limit", default="04:00:00")
+    slurm.add_argument("--log-dir", default="slurm_logs")
+    slurm.add_argument("--partition", default="")
+    slurm.add_argument("--account", default="")
+    slurm.add_argument("--coordinator-port", type=int, default=8476)
+    slurm.add_argument("--env", action="append", default=[], metavar="K=V")
+    slurm.add_argument("--output", default="", help="write script here instead of submitting")
+    slurm.add_argument("--submit", action="store_true", help="sbatch the generated script")
+    slurm.add_argument("command", nargs=argparse.REMAINDER, help="cosmos-curate-tpu subcommand to run")
+    slurm.set_defaults(func=_cmd_slurm)
+
+
+def _cmd_slurm(args: argparse.Namespace) -> int:
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("error: pass the pipeline command after '--', e.g. "
+              "slurm --nodes 4 -- local split --config run.yaml")
+        return 2
+    extra = []
+    if args.partition:
+        extra.append(f"#SBATCH --partition={args.partition}")
+    if args.account:
+        extra.append(f"#SBATCH --account={args.account}")
+    env_exports = "\n".join(f"export {shlex.quote(e)}" for e in args.env)
+    script = _SBATCH_TEMPLATE.format(
+        job_name=args.job_name,
+        nodes=args.nodes,
+        cpus_per_task=args.cpus_per_task,
+        time_limit=args.time_limit,
+        log_dir=args.log_dir,
+        extra_directives="\n".join(extra),
+        coordinator_port=args.coordinator_port,
+        env_exports=env_exports,
+        python="python",
+        command=" ".join(shlex.quote(c) for c in command),
+    )
+    if args.output:
+        Path(args.output).write_text(script)
+        print(f"wrote {args.output}")
+    else:
+        print(script)
+    if args.submit:
+        target = args.output or "/tmp/cosmos_curate_tpu.sbatch"
+        if not args.output:
+            Path(target).write_text(script)
+        result = subprocess.run(["sbatch", target], capture_output=True, text=True)
+        print(result.stdout or result.stderr)
+        return result.returncode
+    return 0

@@ -1,0 +1,117 @@
+"""Text encoder for caption embeddings.
+
+Equivalent capability of the reference's T5 encoder
+(cosmos_curate/models/t5_encoder.py:80 — google-t5/t5-11b encodes captions
+into per-token embeddings packaged as ``EncodedSample`` for webdataset /
+cosmos-predict training). Our own Flax encoder-only transformer (byte-level
+tokens, learned positions); the interface — captions in, padded per-token
+embeddings + mask out — matches what the dataset writers consume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.models.batching import pad_batch
+from cosmos_curate_tpu.models.layers import TransformerBlock
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab: int = 512
+    dim: int = 512
+    layers: int = 8
+    heads: int = 8
+    max_len: int = 512
+
+
+T5_BASE = T5Config()
+T5_TINY_TEST = T5Config(dim=32, layers=1, heads=2, max_len=64)
+
+
+@dataclass
+class EncodedSample:
+    """Per-caption encoding (reference t5_encoder.py:56)."""
+
+    text: str
+    tokens: np.ndarray  # int32 [T]
+    embedding: np.ndarray  # float32 [T, dim]
+    mask: np.ndarray  # bool [T]
+
+
+class TextEncoder(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab, cfg.dim, param_dtype=jnp.float32, dtype=jnp.bfloat16)(ids)
+        pos = self.param("pos", nn.initializers.normal(0.02), (1, cfg.max_len, cfg.dim), jnp.float32)
+        x = x + pos[:, : ids.shape[1]].astype(x.dtype)
+        attn_mask = (mask[:, None, None, :] & mask[:, None, :, None])
+        for i in range(cfg.layers):
+            x = TransformerBlock(cfg.heads, cfg.dim // cfg.heads, name=f"b{i}")(x, attn_mask)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+class T5EncoderTPU(ModelInterface):
+    MODEL_ID = "t5-encoder-tpu"
+
+    def __init__(self, cfg: T5Config = T5_BASE) -> None:
+        self.cfg = cfg
+        self.tokenizer = ByteTokenizer()
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    def setup(self) -> None:
+        model = TextEncoder(self.cfg)
+
+        def init(seed: int):
+            ids = jnp.zeros((1, 8), jnp.int32)
+            return model.init(jax.random.PRNGKey(seed), ids, jnp.ones((1, 8), bool))
+
+        self._params = registry.load_params(self.MODEL_ID, init)
+        self._apply = jax.jit(model.apply)
+
+    def encode(self, texts: list[str]) -> list[EncodedSample]:
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        if not texts:
+            return []
+        tok = self.tokenizer
+        encoded = [tok.encode(t)[: self.cfg.max_len] for t in texts]
+        max_t = max(len(e) for e in encoded)
+        # pad T to pow2 and B to pow2 — static shapes for XLA
+        from cosmos_curate_tpu.models.batching import next_pow2
+
+        t_pad = min(next_pow2(max_t), self.cfg.max_len)
+        ids = np.full((len(texts), t_pad), tok.pad_id, np.int32)
+        mask = np.zeros((len(texts), t_pad), bool)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e
+            mask[i, : len(e)] = True
+        ids_p, n = pad_batch(ids)
+        mask_p, _ = pad_batch(mask)
+        emb = np.asarray(self._apply(self._params, ids_p, mask_p))[:n]
+        return [
+            EncodedSample(
+                text=texts[i],
+                tokens=ids[i][mask[i]],
+                embedding=emb[i][mask[i]],
+                mask=mask[i],
+            )
+            for i in range(n)
+        ]

@@ -1,0 +1,77 @@
+"""Multi-host bootstrap: the distributed communication backend.
+
+Equivalent capability of the reference's collective bootstrap
+(dedup/raft_actor.py:84-131 — NCCL unique-id broadcast over a Ray actor
+pool) re-designed for TPU: one call to ``jax.distributed.initialize`` per
+host turns N hosts x M chips into one device world; every collective after
+that is emitted by XLA over ICI (intra-slice) / DCN (inter-slice). No NCCL,
+no unique-id plumbing — the coordinator address is the only configuration.
+
+Environment contract (set by the slurm CLI, k8s chart, or the operator):
+  CURATE_COORDINATOR_ADDRESS  host:port of node rank 0
+  CURATE_NUM_NODES            total hosts
+  CURATE_NODE_RANK            this host's rank
+"""
+
+from __future__ import annotations
+
+import os
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Join the multi-host world when the env contract is present.
+
+    Idempotent; returns True when running multi-host. Single-host runs
+    (no env) are untouched — the same pipeline code works in both modes.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    addr = os.environ.get("CURATE_COORDINATOR_ADDRESS")
+    num = int(os.environ.get("CURATE_NUM_NODES", "1"))
+    if not addr or num <= 1:
+        return False
+    rank = int(
+        os.environ.get("CURATE_NODE_RANK", os.environ.get("SLURM_NODEID", "0"))
+    )
+    import jax
+
+    logger.info("joining distributed world: %s rank %d/%d", addr, rank, num)
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=rank
+    )
+    _initialized = True
+    return True
+
+
+def node_rank_and_count() -> tuple[int, int]:
+    rank = int(
+        os.environ.get("CURATE_NODE_RANK", os.environ.get("SLURM_NODEID", "0"))
+    )
+    num = int(os.environ.get("CURATE_NUM_NODES", "1"))
+    return rank, max(1, num)
+
+
+def partition_tasks_for_node(tasks: list) -> list:
+    """Deterministic task partition across nodes (host-level data
+    parallelism): node i takes every num_nodes-th task. Single-node runs
+    return the list unchanged."""
+    rank, num = node_rank_and_count()
+    if num <= 1:
+        return tasks
+    return tasks[rank::num]
+
+
+def global_mesh_spec():
+    """MeshSpec with the dcn axis sized to the host count (data-parallel
+    across hosts, model/seq within a slice — the scaling-book default)."""
+    from cosmos_curate_tpu.parallel.mesh import MeshSpec
+
+    num = int(os.environ.get("CURATE_NUM_NODES", "1"))
+    return MeshSpec(dcn=max(1, num), data=-1, model=1, seq=1)

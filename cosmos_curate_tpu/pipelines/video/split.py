@@ -53,10 +53,17 @@ class SplitPipelineArgs:
     motion_global_threshold: float = 0.00098
     motion_patch_threshold: float = 0.0  # see motion_filter.py: opt-in criterion
     aesthetic_threshold: float | None = None
+    text_filter: str = "disable"  # disable | score-only | enable
+    text_filter_threshold: float = 0.5
+    semantic_filter: str = "disable"  # disable | score-only | enable
+    semantic_filter_prompt: str = "default"
     embedding_model: str = ""  # "" | "clip" | "video"
     captioning: bool = False
     caption_window_len: int = 256
     caption_prompt_variant: str = "default"
+    enhance_captions: bool = False
+    t5_embeddings: bool = False
+    previews: bool = False
     # execution
     num_chips: int = 0  # 0 = discover
     perf_profile: bool = False
@@ -114,6 +121,28 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         stages.append(
             AestheticFilterStage(threshold=args.aesthetic_threshold, extraction=primary_sig)
         )
+    if args.text_filter != "disable":
+        from cosmos_curate_tpu.pipelines.video.stages.artificial_text_filter import (
+            ArtificialTextFilterStage,
+        )
+
+        stages.append(
+            ArtificialTextFilterStage(
+                threshold=args.text_filter_threshold,
+                score_only=args.text_filter == "score-only",
+                extraction=primary_sig,
+            )
+        )
+    if args.semantic_filter != "disable":
+        from cosmos_curate_tpu.pipelines.video.stages.semantic_filter import SemanticFilterStage
+
+        stages.append(
+            SemanticFilterStage(
+                prompt_variant=args.semantic_filter_prompt,
+                score_only=args.semantic_filter == "score-only",
+                extraction=primary_sig,
+            )
+        )
     if args.embedding_model:
         from cosmos_curate_tpu.pipelines.video.stages.embedding import ClipEmbeddingStage
 
@@ -128,6 +157,20 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
             CaptionPrepStage(window_len=args.caption_window_len, extraction=primary_sig)
         )
         stages.append(CaptionStage(prompt_variant=args.caption_prompt_variant))
+    if args.enhance_captions:
+        from cosmos_curate_tpu.pipelines.video.stages.enhance_caption import EnhanceCaptionStage
+
+        stages.append(EnhanceCaptionStage(prompt_variant=args.caption_prompt_variant))
+    if args.t5_embeddings:
+        from cosmos_curate_tpu.pipelines.video.stages.caption_embedding import (
+            CaptionEmbeddingStage,
+        )
+
+        stages.append(CaptionEmbeddingStage(prompt_variant=args.caption_prompt_variant))
+    if args.previews:
+        from cosmos_curate_tpu.pipelines.video.stages.preview import PreviewStage
+
+        stages.append(PreviewStage(extraction=primary_sig))
     stages.extend(args.extra_stages)
     stages.append(ClipWriterStage(args.output_path))
     return stages
@@ -145,8 +188,17 @@ def run_split(
         from cosmos_curate_tpu.observability.tracing import enable_tracing
 
         enable_tracing(f"{args.output_path.rstrip('/')}/profile/traces/driver.ndjson")
+    from cosmos_curate_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+        partition_tasks_for_node,
+    )
+
+    maybe_initialize_distributed()
     try:
         tasks = discover_split_tasks(args.input_path, args.output_path, limit=args.limit)
+        # multi-node: each node takes a disjoint task slice (host-level data
+        # parallelism; resume records keep re-runs consistent)
+        tasks = partition_tasks_for_node(tasks)
         stages = assemble_stages(args)
         stages = _apply_observability_wrappers(stages, args)
         out = run_pipeline(tasks, stages, config=config, runner=runner) or []
@@ -158,7 +210,11 @@ def run_split(
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
     summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)
-    write_summary(f"{args.output_path.rstrip('/')}/summary.json", summary)
+    from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
+
+    rank, _ = node_rank_and_count()
+    name = "summary.json" if rank == 0 else f"summary-node{rank}.json"
+    write_summary(f"{args.output_path.rstrip('/')}/{name}", summary)
     logger.info(
         "split done: %d videos, %d clips, %.1fs",
         summary["num_videos"], summary["num_clips"], elapsed,

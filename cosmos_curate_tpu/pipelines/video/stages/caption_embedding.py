@@ -1,0 +1,44 @@
+"""Caption-embedding stage (T5 over window captions).
+
+Equivalent capability of the reference's ``_T5Stage``
+(cosmos_curate/pipelines/video/captioning/captioning_stages.py:33 — T5-XXL
+caption embeddings attached to windows for the cosmos-predict dataset).
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.models.t5 import T5_BASE, T5Config, T5EncoderTPU
+
+
+class CaptionEmbeddingStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(self, *, cfg: T5Config = T5_BASE, prompt_variant: str = "default") -> None:
+        self.prompt_variant = prompt_variant
+        self._model = T5EncoderTPU(cfg)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        windows = []
+        texts = []
+        for task in tasks:
+            for clip in task.video.clips:
+                for win in clip.windows:
+                    text = win.caption.get(self.prompt_variant) or next(
+                        (v for v in win.caption.values() if v), ""
+                    )
+                    if text:
+                        windows.append(win)
+                        texts.append(text)
+        if texts:
+            for win, sample in zip(windows, self._model.encode(texts)):
+                win.t5_embedding = sample.embedding
+        return tasks

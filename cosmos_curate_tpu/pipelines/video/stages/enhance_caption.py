@@ -1,0 +1,66 @@
+"""Caption-enhancement stage: LM rewrite of existing captions.
+
+Equivalent capability of the reference's ``EnhanceCaptionStage``
+(cosmos_curate/pipelines/video/captioning/captioning_stages.py:189 — ChatLM
+/ OpenAI caption rewriting). Reuses the caption engine text-only (no vision
+prefill), so one model deployment serves both passes.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.models.prompts import ENHANCE_PROMPT
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
+from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
+
+
+class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        prompt_variant: str = "default",
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        max_new_tokens: int = 128,
+    ) -> None:
+        self.prompt_variant = prompt_variant
+        self.max_new_tokens = max_new_tokens
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = ByteTokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        windows = {}
+        for task in tasks:
+            for clip in task.video.clips:
+                for i, win in enumerate(clip.windows):
+                    text = win.caption.get(self.prompt_variant, "")
+                    if not text:
+                        continue
+                    rid = f"{clip.uuid}-{i}"
+                    windows[rid] = win
+                    engine.add_request(
+                        CaptionRequest(
+                            request_id=rid,
+                            prompt_ids=self.tokenizer.encode(ENHANCE_PROMPT + text),
+                            sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
+                        )
+                    )
+        if windows:
+            for res in engine.run_until_complete():
+                win = windows.get(res.request_id)
+                if win is not None:
+                    win.enhanced_caption[self.prompt_variant] = res.text
+        return tasks

@@ -1,0 +1,70 @@
+"""Preview stage: webp thumbnails per clip.
+
+Equivalent capability of the reference's ``PreviewStage``
+(cosmos_curate/pipelines/video/preview/preview_stages.py:32 — webp preview
+per caption window). Animated webp from the extracted frames via PIL.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PreviewStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        max_frames: int = 8,
+        target_width: int = 320,
+        fps: int = 4,
+        extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+    ) -> None:
+        self.max_frames = max_frames
+        self.target_width = target_width
+        self.fps = fps
+        self.extraction = extraction
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        from PIL import Image
+
+        key = self.extraction.key()
+        for task in tasks:
+            for clip in task.video.clips:
+                frames = clip.extracted_frames.get(key)
+                if frames is None or frames.shape[0] == 0:
+                    continue
+                idx = np.linspace(0, frames.shape[0] - 1, min(self.max_frames, frames.shape[0]))
+                images = []
+                for i in idx.round().astype(int):
+                    img = Image.fromarray(frames[i])
+                    if img.width > self.target_width:
+                        h = int(img.height * self.target_width / img.width)
+                        img = img.resize((self.target_width, h))
+                    images.append(img)
+                buf = io.BytesIO()
+                try:
+                    images[0].save(
+                        buf,
+                        format="WEBP",
+                        save_all=len(images) > 1,
+                        append_images=images[1:],
+                        duration=int(1000 / self.fps),
+                        loop=0,
+                    )
+                    clip.webp_preview = buf.getvalue()
+                except Exception as e:
+                    logger.warning("preview failed for %s: %s", clip.uuid, e)
+                    clip.errors["preview"] = str(e)
+        return tasks

@@ -1,0 +1,101 @@
+"""VLM semantic filter / classifier stages.
+
+Equivalent capability of the reference's semantic filtering
+(cosmos_curate/pipelines/video/filtering/aesthetics/semantic_filter_stages.py
+:34/185 — ``VllmFilteringStage`` yes/no gate and ``VllmVideoClassifierStage``
+type classifier, served by vLLM or API backends). Here both run on the
+caption engine: a prompt per clip (first-window frames), the decoded answer
+parsed as yes/no or as a class label.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
+from cosmos_curate_tpu.models.prompts import SEMANTIC_FILTER_PROMPTS
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
+from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
+
+
+def parse_yes_no(text: str) -> bool | None:
+    t = text.strip().lower()
+    if t.startswith("yes"):
+        return True
+    if t.startswith("no"):
+        return False
+    return None
+
+
+class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """Drops clips the VLM answers 'no' for (or scores-only)."""
+
+    def __init__(
+        self,
+        *,
+        prompt_variant: str = "default",
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        score_only: bool = False,
+        keep_on_unparseable: bool = True,
+        num_frames: int = 4,
+        extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+    ) -> None:
+        self.prompt = SEMANTIC_FILTER_PROMPTS[prompt_variant]
+        self.score_only = score_only
+        self.keep_on_unparseable = keep_on_unparseable
+        self.num_frames = num_frames
+        self.extraction = extraction
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = ByteTokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        key = self.extraction.key()
+        targets = {}
+        for task in tasks:
+            for clip in task.video.clips:
+                frames = clip.extracted_frames.get(key)
+                if frames is None or frames.shape[0] == 0:
+                    continue
+                idx = np.linspace(0, frames.shape[0] - 1, self.num_frames).round().astype(int)
+                targets[str(clip.uuid)] = clip
+                engine.add_request(
+                    CaptionRequest(
+                        request_id=str(clip.uuid),
+                        prompt_ids=self.tokenizer.encode(self.prompt),
+                        frames=frames[idx],
+                        sampling=SamplingConfig(max_new_tokens=8),
+                    )
+                )
+        if not targets:
+            return tasks
+        verdicts = {r.request_id: parse_yes_no(r.text) for r in engine.run_until_complete()}
+        for task in tasks:
+            kept = []
+            for clip in task.video.clips:
+                if str(clip.uuid) not in targets:
+                    kept.append(clip)  # never evaluated (no frames): keep
+                    continue
+                verdict = verdicts.get(str(clip.uuid))
+                clip.semantic_pass = verdict
+                keep = verdict if verdict is not None else self.keep_on_unparseable
+                if self.score_only or keep:
+                    kept.append(clip)
+                else:
+                    clip.filtered_by = "semantic"
+                    task.video.filtered_clips.append(clip)
+            task.video.clips = kept
+        return tasks

@@ -55,6 +55,7 @@ def _clip_meta(clip: Clip) -> dict:
                 "end_frame": w.end_frame,
                 "captions": w.caption,
                 "enhanced_captions": w.enhanced_caption,
+                "has_t5_embedding": w.t5_embedding is not None,
             }
             for w in clip.windows
         ],
@@ -103,6 +104,7 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
                 clip.release_frames()
                 for w in clip.windows:
                     w.release_payloads()
+                    w.t5_embedding = None  # persisted above
             task.stage_perf["clips_written"] = stats.num_clips
             task.stats = stats
         return tasks
@@ -123,6 +125,19 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             stats.num_with_embeddings += 1
         if any(w.caption for w in clip.windows):
             stats.num_with_captions += 1
+        t5 = {
+            f"window_{i}": w.t5_embedding
+            for i, w in enumerate(clip.windows)
+            if w.t5_embedding is not None
+        }
+        if t5:
+            import io as io_mod
+
+            import numpy as np_mod
+
+            sink = io_mod.BytesIO()
+            np_mod.savez(sink, **t5)
+            write_bytes(f"{self.output_path}/t5_embeddings/{clip.uuid}.npz", sink.getvalue())
         write_json(f"{self.output_path}/metas/v0/{clip.uuid}.json", _clip_meta(clip))
 
     @staticmethod

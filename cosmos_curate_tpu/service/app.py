@@ -1,0 +1,209 @@
+"""Job service: pipelines behind an async HTTP API.
+
+Equivalent capability of the reference's NVCF service wrapper
+(cosmos_curate/core/cf/nvcf_main.py:548-600 — FastAPI app with /health,
+/v1/logs, /v1/progress, invoke/terminate, a one-pipeline-at-a-time lock
+middleware:373, and request/progress/done files:102-223). Built on aiohttp
+(fastapi is not in this image; the HTTP surface is identical):
+
+  GET  /health                liveness + current job state
+  POST /v1/invoke             {"pipeline": "split"|"dedup"|"shard", "args": {...}}
+  GET  /v1/progress/{job_id}  job state + summary when done
+  GET  /v1/logs/{job_id}      captured job log tail
+  POST /v1/terminate/{job_id} best-effort cancel
+
+One pipeline runs at a time (the lock); jobs execute in a subprocess so a
+crashing pipeline never takes the service down, and termination is a clean
+process kill.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from aiohttp import web
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_PIPELINES = {"split", "dedup", "shard"}
+
+
+@dataclass
+class Job:
+    job_id: str
+    pipeline: str
+    args: dict
+    work_dir: Path
+    proc: subprocess.Popen | None = None
+    state: str = "pending"  # pending | running | done | failed | terminated
+    started_s: float = field(default_factory=time.time)
+    finished_s: float | None = None
+
+    @property
+    def log_path(self) -> Path:
+        return self.work_dir / "job.log"
+
+    @property
+    def summary_path(self) -> Path:
+        return self.work_dir / "summary.json"
+
+
+class ServiceState:
+    def __init__(self, work_root: str) -> None:
+        self.work_root = Path(work_root)
+        self.work_root.mkdir(parents=True, exist_ok=True)
+        self.jobs: dict[str, Job] = {}
+        # Single-event-loop invariant: invoke() has no await between the
+        # active_job() check and job registration, so no lock is needed;
+        # adding an await there requires adding one.
+        self.watchers: set[asyncio.Task] = set()  # strong refs (GC guard)
+
+    def active_job(self) -> Job | None:
+        for job in self.jobs.values():
+            if job.state in ("pending", "running"):
+                return job
+        return None
+
+
+def _runner_code(pipeline: str, args: dict, summary_path: str) -> str:
+    """Child-process program: run the pipeline, write summary.json."""
+    payload = json.dumps({"pipeline": pipeline, "args": args, "summary": summary_path})
+    return (
+        "import json, sys\n"
+        f"spec = json.loads({payload!r})\n"
+        "from cosmos_curate_tpu.pipelines.video import split as split_mod\n"
+        "from cosmos_curate_tpu.pipelines.video import dedup as dedup_mod\n"
+        "from cosmos_curate_tpu.pipelines.video import shard as shard_mod\n"
+        "if spec['pipeline'] == 'split':\n"
+        "    s = split_mod.run_split(split_mod.SplitPipelineArgs(**spec['args']))\n"
+        "elif spec['pipeline'] == 'dedup':\n"
+        "    s = dedup_mod.run_dedup(dedup_mod.DedupPipelineArgs(**spec['args']))\n"
+        "else:\n"
+        "    s = shard_mod.run_shard(shard_mod.ShardPipelineArgs(**spec['args']))\n"
+        "json.dump(s, open(spec['summary'], 'w'))\n"
+    )
+
+
+async def _watch_job(state: ServiceState, job: Job) -> None:
+    loop = asyncio.get_running_loop()
+    rc = await loop.run_in_executor(None, job.proc.wait)
+    job.finished_s = time.time()
+    if job.state == "terminated":
+        return
+    job.state = "done" if rc == 0 and job.summary_path.exists() else "failed"
+    logger.info("job %s finished: %s (rc=%s)", job.job_id, job.state, rc)
+
+
+def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
+    state = ServiceState(work_root)
+    app = web.Application()
+    app["state"] = state
+
+    async def health(request: web.Request) -> web.Response:
+        active = state.active_job()
+        return web.json_response(
+            {
+                "status": "ok",
+                "active_job": active.job_id if active else None,
+                "num_jobs": len(state.jobs),
+            }
+        )
+
+    async def invoke(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        pipeline = body.get("pipeline")
+        args = body.get("args", {})
+        if pipeline not in _PIPELINES:
+            return web.json_response(
+                {"error": f"pipeline must be one of {sorted(_PIPELINES)}"}, status=400
+            )
+        if not isinstance(args, dict):
+            return web.json_response({"error": "args must be an object"}, status=400)
+        if state.active_job() is not None:
+            return web.json_response(
+                {"error": "a pipeline is already running", "active_job": state.active_job().job_id},
+                status=409,
+            )
+        job_id = uuid.uuid4().hex[:12]
+        work_dir = state.work_root / job_id
+        work_dir.mkdir(parents=True)
+        job = Job(job_id=job_id, pipeline=pipeline, args=args, work_dir=work_dir)
+        log_f = open(job.log_path, "wb")
+        try:
+            job.proc = subprocess.Popen(
+                [sys.executable, "-c", _runner_code(pipeline, args, str(job.summary_path))],
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                cwd=str(Path(__file__).resolve().parents[2]),
+            )
+        except Exception as e:
+            job.state = "failed"
+            state.jobs[job_id] = job
+            return web.json_response({"error": str(e), "job_id": job_id}, status=500)
+        finally:
+            log_f.close()  # child holds its own fd; parent must not leak one per job
+        job.state = "running"
+        state.jobs[job_id] = job
+        task = asyncio.create_task(_watch_job(state, job))
+        state.watchers.add(task)  # event loop holds only weak refs
+        task.add_done_callback(state.watchers.discard)
+        return web.json_response({"job_id": job_id, "state": job.state})
+
+    def _get_job(request: web.Request) -> Job | None:
+        return state.jobs.get(request.match_info["job_id"])
+
+    async def progress(request: web.Request) -> web.Response:
+        job = _get_job(request)
+        if job is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        out = {
+            "job_id": job.job_id,
+            "pipeline": job.pipeline,
+            "state": job.state,
+            "elapsed_s": (job.finished_s or time.time()) - job.started_s,
+        }
+        if job.state == "done":
+            out["summary"] = json.loads(job.summary_path.read_text())
+        return web.json_response(out)
+
+    async def logs(request: web.Request) -> web.Response:
+        job = _get_job(request)
+        if job is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        tail = int(request.query.get("tail", "200"))
+        lines: list[str] = []
+        if job.log_path.exists():
+            lines = job.log_path.read_text(errors="replace").splitlines()[-tail:]
+        return web.json_response({"job_id": job.job_id, "lines": lines})
+
+    async def terminate(request: web.Request) -> web.Response:
+        job = _get_job(request)
+        if job is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        if job.proc is not None and job.proc.poll() is None:
+            job.state = "terminated"
+            job.proc.terminate()
+        return web.json_response({"job_id": job.job_id, "state": job.state})
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/v1/invoke", invoke)
+    app.router.add_get("/v1/progress/{job_id}", progress)
+    app.router.add_get("/v1/logs/{job_id}", logs)
+    app.router.add_post("/v1/terminate/{job_id}", terminate)
+    return app
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080, work_root: str = "/tmp/curate_service") -> None:
+    web.run_app(build_app(work_root), host=host, port=port)

@@ -1,0 +1,124 @@
+"""Tests for the remaining model stages: T5 embedding, semantic filter,
+preview, artificial-text filter, enhance caption."""
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.data.model import (
+    Clip,
+    FrameExtractionSignature,
+    SplitPipeTask,
+    Video,
+    Window,
+)
+from cosmos_curate_tpu.models.t5 import T5_TINY_TEST, T5EncoderTPU
+from cosmos_curate_tpu.models.vlm import VLM_TINY_TEST
+from cosmos_curate_tpu.pipelines.video.stages.artificial_text_filter import (
+    ArtificialTextFilterStage,
+)
+from cosmos_curate_tpu.pipelines.video.stages.caption_embedding import CaptionEmbeddingStage
+from cosmos_curate_tpu.pipelines.video.stages.preview import PreviewStage
+from cosmos_curate_tpu.pipelines.video.stages.semantic_filter import (
+    SemanticFilterStage,
+    parse_yes_no,
+)
+
+SIG = FrameExtractionSignature("fps", 2.0)
+
+
+def _task_with_clips(n=2, frames=True, caption=""):
+    video = Video(path="v.mp4")
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        clip = Clip(source_video="v.mp4", span=(float(i), float(i + 1)))
+        if frames:
+            clip.extracted_frames[SIG.key()] = rng.integers(0, 255, (4, 32, 32, 3), np.uint8)
+        if caption:
+            clip.windows = [Window(start_frame=0, end_frame=4, caption={"default": caption})]
+        video.clips.append(clip)
+    return SplitPipeTask(video=video)
+
+
+class TestT5:
+    def test_encode_samples(self):
+        enc = T5EncoderTPU(T5_TINY_TEST)
+        enc.setup()
+        samples = enc.encode(["a cat", "a much longer caption about a dog"])
+        assert len(samples) == 2
+        assert samples[0].embedding.shape[0] == samples[0].tokens.shape[0]
+        assert samples[0].embedding.shape[1] == 32
+        assert samples[1].tokens.shape[0] > samples[0].tokens.shape[0]
+
+    def test_empty(self):
+        enc = T5EncoderTPU(T5_TINY_TEST)
+        enc.setup()
+        assert enc.encode([]) == []
+
+    def test_stage_attaches_embeddings(self):
+        task = _task_with_clips(caption="hello scene")
+        stage = CaptionEmbeddingStage(cfg=T5_TINY_TEST)
+        out = run_pipeline([task], [stage], runner=SequentialRunner())
+        for clip in out[0].video.clips:
+            assert clip.windows[0].t5_embedding is not None
+
+
+class TestSemanticFilter:
+    def test_parse(self):
+        assert parse_yes_no("Yes, clearly") is True
+        assert parse_yes_no(" no") is False
+        assert parse_yes_no("dunno") is None
+
+    def test_score_only_keeps_all(self):
+        stage = SemanticFilterStage(cfg=VLM_TINY_TEST, score_only=True, extraction=SIG)
+        out = run_pipeline([_task_with_clips()], [stage], runner=SequentialRunner())
+        assert len(out[0].video.clips) == 2
+        # verdicts recorded (None allowed for random weights)
+        for clip in out[0].video.clips:
+            assert hasattr(clip, "semantic_pass")
+
+    def test_unparseable_keep_policy(self):
+        # random weights rarely emit yes/no; keep_on_unparseable=False drops
+        stage = SemanticFilterStage(
+            cfg=VLM_TINY_TEST, keep_on_unparseable=False, extraction=SIG
+        )
+        out = run_pipeline([_task_with_clips()], [stage], runner=SequentialRunner())
+        total = len(out[0].video.clips) + len(out[0].video.filtered_clips)
+        assert total == 2
+
+
+class TestPreview:
+    def test_webp_generated(self):
+        stage = PreviewStage(extraction=SIG)
+        out = run_pipeline([_task_with_clips()], [stage], runner=SequentialRunner())
+        for clip in out[0].video.clips:
+            assert clip.webp_preview is not None
+            assert clip.webp_preview[:4] == b"RIFF"
+
+
+class TestArtificialText:
+    def _frames_with_text_bands(self):
+        f = np.full((4, 64, 64, 3), 30, np.uint8)
+        # dense alternating vertical strokes in the bottom band (subtitle-like)
+        f[:, 52:62, ::2] = 255
+        return f
+
+    def test_text_scores_higher_than_clean(self):
+        rng = np.random.default_rng(0)
+        task = _task_with_clips(n=2)
+        task.video.clips[0].extracted_frames[SIG.key()] = self._frames_with_text_bands()
+        clean = np.full((4, 64, 64, 3), 128, np.uint8)
+        task.video.clips[1].extracted_frames[SIG.key()] = clean
+        stage = ArtificialTextFilterStage(score_only=True, extraction=SIG)
+        out = run_pipeline([task], [stage], runner=SequentialRunner())
+        scores = [c.artificial_text_score for c in out[0].video.clips]
+        assert scores[0] > scores[1]
+
+    def test_filtering(self):
+        task = _task_with_clips(n=1)
+        task.video.clips[0].extracted_frames[SIG.key()] = self._frames_with_text_bands()
+        stage = ArtificialTextFilterStage(threshold=0.1, extraction=SIG)
+        out = run_pipeline([task], [stage], runner=SequentialRunner())
+        assert out[0].video.clips == []
+        assert out[0].video.filtered_clips[0].filtered_by == "text"
