@@ -41,6 +41,34 @@ class ObjectRef:
         return f"ObjectRef({self.shm_name}, {self.total_size}B)"
 
 
+def _native_put(name: str, payload: bytes, views: list, sizes: list[int], total: int) -> bool:
+    """Single-pass native framing (cosmos_curate_tpu/native); False = fall
+    back to the Python path. numpy wraps each buffer to get a stable
+    pointer without copying (works for read-only buffers too)."""
+    from cosmos_curate_tpu.native import load_native
+
+    lib = load_native()
+    if lib is None:
+        return False
+    import ctypes
+
+    import numpy as _np
+
+    n = len(views)
+    ptrs = (ctypes.c_void_p * max(1, n))()
+    szs = (ctypes.c_uint64 * max(1, n))()
+    arrs = []  # keep alive until the call returns
+    for i, v in enumerate(views):
+        a = _np.frombuffer(v.cast("B"), _np.uint8)
+        arrs.append(a)
+        ptrs[i] = a.ctypes.data
+        szs[i] = a.nbytes
+    rc = lib.cn_put(
+        f"/{name}".encode(), payload, len(payload), ptrs, szs, n, total
+    )
+    return rc == 0
+
+
 def put(obj, *, prefix: str | None = None) -> ObjectRef:
     """Serialize ``obj`` into a fresh shm segment; returns its ref.
 
@@ -59,7 +87,22 @@ def put(obj, *, prefix: str | None = None) -> ObjectRef:
     meta = len(sizes).to_bytes(8, "little") + b"".join(s.to_bytes(8, "little") for s in sizes)
     total = _HEADER + len(payload) + len(meta) + sum(sizes)
     name = f"{prefix}-{uuid.uuid4().hex[:16]}"
+    if _native_put(name, payload, views, sizes, max(total, 16)):
+        for b in buffers:
+            b.release()
+        return ObjectRef(shm_name=name, total_size=total, num_buffers=len(sizes))
     seg = shared_memory.SharedMemory(name=name, create=True, size=max(total, 16))
+    # CPython's resource tracker registers every segment and unlinks the
+    # "leaks" when *this* process exits — but ownership here is the
+    # coordinator's (a recycled worker must not destroy segments downstream
+    # stages still consume). Deletion is handled by StoreBudget.release and
+    # the stale-segment janitor instead.
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
     try:
         mv = seg.buf
         try:
@@ -82,40 +125,51 @@ def put(obj, *, prefix: str | None = None) -> ObjectRef:
     return ObjectRef(shm_name=name, total_size=total, num_buffers=len(sizes))
 
 
+_SHM_DIR = "/dev/shm"
+_COPY_THRESHOLD = 1 << 20  # buffers below 1 MiB are copied out of the view
+
+
 def get(ref: ObjectRef):
-    """Reconstruct the object (one copy out of shm, so the segment can be
-    freed immediately and consumers own their data)."""
-    seg = shared_memory.SharedMemory(name=ref.shm_name)
+    """Reconstruct the object: ONE read of the whole segment, then zero-copy
+    memoryview slices feed pickle's out-of-band buffers (numpy arrays view
+    the read buffer directly).
+
+    Reads the segment file directly — attaching via
+    ``multiprocessing.shared_memory`` would register it with this process's
+    resource tracker, which unlinks registered segments at process exit and
+    would destroy data other processes still need (worker recycling).
+    """
+    path = os.path.join(_SHM_DIR, ref.shm_name)
     try:
-        mv = seg.buf
-        try:
-            plen = int.from_bytes(mv[:_HEADER], "little")
-            off = _HEADER
-            payload = bytes(mv[off : off + plen])
-            off += plen
-            nbuf = int.from_bytes(mv[off : off + 8], "little")
-            off += 8
-            sizes = [
-                int.from_bytes(mv[off + 8 * i : off + 8 * (i + 1)], "little")
-                for i in range(nbuf)
-            ]
-            off += 8 * nbuf
-            bufs = []
-            for s in sizes:
-                bufs.append(bytes(mv[off : off + s]))
-                off += s
-            return pickle.loads(payload, buffers=bufs)
-        finally:
-            del mv
-    finally:
-        seg.close()
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError as e:
+        raise FileNotFoundError(f"object store segment {ref.shm_name} missing") from e
+    mv = memoryview(data)
+    plen = int.from_bytes(mv[:_HEADER], "little")
+    off = _HEADER
+    payload = mv[off : off + plen]
+    off += plen
+    nbuf = int.from_bytes(mv[off : off + 8], "little")
+    off += 8
+    sizes = [
+        int.from_bytes(mv[off + 8 * i : off + 8 * (i + 1)], "little") for i in range(nbuf)
+    ]
+    off += 8 * nbuf
+    # Small buffers are copied out: a kept small array must not pin the
+    # whole segment bytes via its memoryview. Large buffers stay views —
+    # they dominate the segment anyway, so pinning costs ~nothing.
+    bufs = []
+    for s in sizes:
+        chunk = mv[off : off + s]
+        bufs.append(bytes(chunk) if s < _COPY_THRESHOLD else chunk)
+        off += s
+    return pickle.loads(payload, buffers=bufs)
 
 
 def delete(ref: ObjectRef) -> None:
     try:
-        seg = shared_memory.SharedMemory(name=ref.shm_name)
-        seg.close()
-        seg.unlink()
+        os.unlink(os.path.join(_SHM_DIR, ref.shm_name))
     except FileNotFoundError:
         pass
 

@@ -1,0 +1,80 @@
+"""ctypes bindings for the native runtime library (curate_native.cpp).
+
+Compiled on demand with g++ (cached beside the source; rebuilt when the
+source changes). Absent a toolchain, callers fall back to the pure-Python
+paths — the native library is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SRC = Path(__file__).parent / "curate_native.cpp"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build_dir() -> Path:
+    # Per-user, mode-0700 directory: a predictable world-writable path would
+    # let another local user plant a .so that we dlopen.
+    default = f"/tmp/curate_native-{os.getuid()}"
+    d = Path(os.environ.get("CURATE_NATIVE_BUILD_DIR", default))
+    d.mkdir(parents=True, exist_ok=True, mode=0o700)
+    st = d.stat()
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        raise RuntimeError(
+            f"native build dir {d} is not exclusively owned by this user "
+            f"(uid {st.st_uid}, mode {oct(st.st_mode)}); refusing to load"
+        )
+    return d
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Compile (if needed) and load the native library; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            src = _SRC.read_bytes()
+            tag = hashlib.sha256(src).hexdigest()[:16]
+            so = _build_dir() / f"libcurate_native-{tag}.so"
+            if not so.exists():
+                # build to a process-unique temp then atomically rename, so
+                # concurrent workers can't observe a half-written .so
+                tmp = so.with_name(f"{so.stem}.{os.getpid()}.tmp.so")
+                cmd = [
+                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    "-o", str(tmp), str(_SRC), "-lrt",
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                tmp.replace(so)
+                logger.info("built native library %s", so.name)
+            lib = ctypes.CDLL(str(so))
+            lib.cn_put.restype = ctypes.c_int
+            lib.cn_put.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+                ctypes.c_uint64,
+            ]
+            _lib = lib
+        except Exception as e:
+            logger.warning("native library unavailable (%s); using Python path", e)
+            _load_failed = True
+    return _lib
