@@ -1,0 +1,111 @@
+"""Canonical split-pipeline benchmark harness.
+
+Equivalent capability of the reference's benchmark harness
+(benchmarks/split_pipeline/nvcf_split_benchmark.py + benchmarks/summary.py in
+/root/reference): run the canonical split configuration (shot detection,
+motion score-only, embeddings — invoke.json's shape) over a corpus, retry
+transient failures, and report the headline ``video_hours_per_day_per_chip``
+plus the summary-count invariants the reference's tests check.
+
+Usage:
+  python -m benchmarks.split_benchmark --input-path DIR [--output-path DIR]
+  python -m benchmarks.split_benchmark --synthetic 16   # generate corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def make_synthetic_corpus(root: Path, n: int, *, seconds: float = 8.0) -> Path:
+    import cv2
+    import numpy as np
+
+    vids = root / "videos"
+    vids.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(0)
+    fps, w, h = 24.0, 320, 240
+    for i in range(n):
+        writer = cv2.VideoWriter(
+            str(vids / f"bench_{i:04d}.mp4"), cv2.VideoWriter_fourcc(*"mp4v"), fps, (w, h)
+        )
+        scene_len = int(fps * seconds / 2)
+        for s in range(2):
+            base = rng.integers(0, 255, 3)
+            for f in range(scene_len):
+                frame = np.full((h, w, 3), base, np.uint8)
+                x = (f * 5 + i * 17) % (w - 40)
+                frame[80:140, x : x + 40] = 255 - base
+                writer.write(frame)
+        writer.release()
+    return vids
+
+
+def run_benchmark(args: argparse.Namespace) -> dict:
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+    from cosmos_curate_tpu.utils.retry import retry
+
+    out_root = Path(args.output_path or tempfile.mkdtemp(prefix="curate_bench_"))
+    if args.synthetic:
+        input_path = str(make_synthetic_corpus(out_root, args.synthetic))
+    else:
+        input_path = args.input_path
+    pargs = SplitPipelineArgs(
+        input_path=input_path,
+        output_path=str(out_root / "out"),
+        limit=args.limit,
+        splitting_algorithm=args.splitting_algorithm,
+        motion_filter="score-only" if args.motion else "disable",
+        embedding_model=args.embedding_model,
+        extract_fps=(2.0,),
+    )
+
+    @retry(attempts=args.attempts, backoff_s=2.0)
+    def attempt():
+        return run_split(pargs, runner=SequentialRunner() if args.sequential else None)
+
+    t0 = time.monotonic()
+    summary = attempt()
+    wall = time.monotonic() - t0
+    # summary-count invariants (reference test_nvcf_split_benchmark.py)
+    assert summary["num_clips"] >= summary["num_transcoded"] >= 0
+    assert summary["num_with_embeddings"] <= summary["num_clips"]
+    result = {
+        "video_hours_per_day_per_chip": summary["video_hours_per_day_per_chip"],
+        "clips_per_sec": summary["num_clips"] / wall if wall else 0.0,
+        "wall_s": wall,
+        **{k: summary[k] for k in ("num_videos", "num_clips", "num_transcoded", "num_with_embeddings", "num_errors")},
+    }
+    print(json.dumps(result, indent=2))
+    return result
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--input-path", default="")
+    p.add_argument("--output-path", default="")
+    p.add_argument("--synthetic", type=int, default=0, help="generate N synthetic videos")
+    p.add_argument("--limit", type=int, default=0)
+    p.add_argument("--splitting-algorithm", default="fixed-stride")
+    p.add_argument("--motion", action="store_true")
+    p.add_argument("--embedding-model", default="video")
+    p.add_argument("--attempts", type=int, default=3)
+    p.add_argument("--sequential", action="store_true")
+    args = p.parse_args()
+    if not args.input_path and not args.synthetic:
+        p.error("--input-path or --synthetic required")
+    run_benchmark(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,26 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--stage-save-rate", type=float, default=0.0)
     split.set_defaults(func=_cmd_split)
 
+    av = lsub.add_parser("av", help="multi-camera AV pipelines")
+    av.add_argument("subcommand2", choices=["ingest", "split", "caption", "shard"], metavar="step")
+    av.add_argument("--input-path", required=True)
+    av.add_argument("--output-path", required=True)
+    av.add_argument("--db-path", default="")
+    av.add_argument("--clip-len-s", type=float, default=10.0)
+    av.add_argument("--min-clip-len-s", type=float, default=None)
+    av.add_argument("--limit", type=int, default=0)
+    av.add_argument("--sequential", action="store_true")
+    av.set_defaults(func=_cmd_av)
+
+    image = lsub.add_parser("image-annotate", help="curate still images")
+    image.add_argument("--input-path", required=True)
+    image.add_argument("--output-path", required=True)
+    image.add_argument("--limit", type=int, default=0)
+    image.add_argument("--aesthetic-threshold", type=float, default=None)
+    image.add_argument("--captioning", action="store_true")
+    image.add_argument("--sequential", action="store_true")
+    image.set_defaults(func=_cmd_image)
+
     dedup = lsub.add_parser("dedup", help="semantic dedup over clip embeddings")
     dedup.add_argument("--input-path", required=True, help="split output root")
     dedup.add_argument("--output-path", default="")
@@ -66,6 +86,51 @@ def _cmd_hello(args: argparse.Namespace) -> int:
 
     for task in run_hello_world():
         print(f"{task.text!r} score={task.score:.4f} device={task.device}")
+    return 0
+
+
+def _cmd_av(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.av import pipeline as av
+
+    pargs = av.AVPipelineArgs(
+        input_path=args.input_path,
+        output_path=args.output_path,
+        db_path=args.db_path,
+        clip_len_s=args.clip_len_s,
+        min_clip_len_s=args.min_clip_len_s,
+        limit=args.limit,
+    )
+    step = args.subcommand2
+    if step == "ingest":
+        summary = av.run_av_ingest(pargs)
+    elif step == "split":
+        summary = av.run_av_split(
+            pargs, runner=SequentialRunner() if args.sequential else None
+        )
+    elif step == "caption":
+        summary = av.run_av_caption(pargs)
+    else:
+        summary = av.run_av_shard(pargs)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def _cmd_image(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.image.annotate import ImagePipelineArgs, run_image_annotate
+
+    summary = run_image_annotate(
+        ImagePipelineArgs(
+            input_path=args.input_path,
+            output_path=args.output_path,
+            limit=args.limit,
+            aesthetic_threshold=args.aesthetic_threshold,
+            captioning=args.captioning,
+        ),
+        runner=SequentialRunner() if args.sequential else None,
+    )
+    print(json.dumps(summary, indent=2))
     return 0
 
 

@@ -1,0 +1,199 @@
+"""AV (autonomous-vehicle) multi-camera pipeline: ingest → split → caption
+→ shard.
+
+Equivalent capability of the reference's AV pipelines
+(cosmos_curate/pipelines/av/run_pipeline.py — the same four subcommands over
+multi-camera capture sessions, with clip state in Postgres and AV-specific
+captioning/packaging stages). Sessions are groups of synchronized camera
+files named ``<session>_<camera>.mp4``; clip state lives in the AVStateDB
+(sqlite locally, same schema as a Postgres deployment); splitting and
+captioning reuse the video stages with the "av" prompt variant.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+from pathlib import PurePath
+
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.runner import RunnerInterface
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask, Video
+from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB, ClipRow
+from cosmos_curate_tpu.storage.client import get_storage_client
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SESSION_RE = re.compile(r"^(?P<session>.+?)_(?P<camera>[A-Za-z0-9\-]+)$")
+
+
+@dataclass
+class AVPipelineArgs:
+    input_path: str = ""
+    output_path: str = ""
+    db_path: str = ""  # default <output>/av_state.sqlite
+    clip_len_s: float = 10.0
+    min_clip_len_s: float | None = None  # default: min(2.0, clip_len_s)
+    caption_prompt_variant: str = "av"
+    limit: int = 0
+
+    @property
+    def resolved_db(self) -> str:
+        return self.db_path or f"{self.output_path.rstrip('/')}/av_state.sqlite"
+
+
+def discover_sessions(input_path: str) -> dict[str, dict[str, str]]:
+    """session_id -> {camera: path} from <session>_<camera>.mp4 names."""
+    client = get_storage_client(input_path)
+    sessions: dict[str, dict[str, str]] = defaultdict(dict)
+    for info in client.list_files(input_path, suffixes=(".mp4", ".mov", ".mkv")):
+        stem = PurePath(info.path).stem
+        m = _SESSION_RE.match(stem)
+        if not m:
+            logger.warning("skipping %s: name not <session>_<camera>", info.path)
+            continue
+        sessions[m.group("session")][m.group("camera")] = info.path
+    return dict(sessions)
+
+
+def run_av_ingest(args: AVPipelineArgs) -> dict:
+    sessions = discover_sessions(args.input_path)
+    db = AVStateDB(args.resolved_db)
+    try:
+        for sid, cams in sessions.items():
+            db.upsert_session(sid, len(cams))
+        return {"num_sessions": len(sessions), "db": args.resolved_db}
+    finally:
+        db.close()
+
+
+def run_av_split(args: AVPipelineArgs, *, runner: RunnerInterface | None = None) -> dict:
+    from cosmos_curate_tpu.pipelines.video.stages.clip_extraction import (
+        ClipTranscodingStage,
+        FixedStrideExtractorStage,
+    )
+    from cosmos_curate_tpu.pipelines.video.stages.download import VideoDownloadStage
+    from cosmos_curate_tpu.pipelines.video.stages.frame_extraction import (
+        ClipFrameExtractionStage,
+    )
+    from cosmos_curate_tpu.pipelines.video.stages.writer import ClipWriterStage
+
+    t0 = time.monotonic()
+    sessions = discover_sessions(args.input_path)
+    db = AVStateDB(args.resolved_db)
+    try:
+        tasks = []
+        cam_of_path: dict[str, tuple[str, str]] = {}
+        processed_sids: set[str] = set()
+        for sid, cams in sorted(sessions.items()):
+            for cam, path in sorted(cams.items()):
+                tasks.append(SplitPipeTask(video=Video(path=path)))
+                cam_of_path[path] = (sid, cam)
+            processed_sids.add(sid)
+            if args.limit and len(tasks) >= args.limit:
+                break
+        min_len = (
+            args.min_clip_len_s
+            if args.min_clip_len_s is not None
+            else min(2.0, args.clip_len_s)
+        )
+        stages = [
+            VideoDownloadStage(),
+            FixedStrideExtractorStage(clip_len_s=args.clip_len_s, min_clip_len_s=min_len),
+            ClipTranscodingStage(),
+            ClipFrameExtractionStage(
+                signatures=(FrameExtractionSignature("fps", 2.0),), resize_hw=(224, 224)
+            ),
+            ClipWriterStage(args.output_path),
+        ]
+        out = run_pipeline(tasks, stages, runner=runner) or []
+        rows = []
+        for task in out:
+            sid, cam = cam_of_path.get(task.video.path, ("unknown", "unknown"))
+            for clip in task.video.clips:
+                rows.append(
+                    ClipRow(
+                        clip_uuid=str(clip.uuid),
+                        session_id=sid,
+                        camera=cam,
+                        span_start=clip.span[0],
+                        span_end=clip.span[1],
+                    )
+                )
+        db.add_clips(rows)
+        for sid in processed_sids:  # only sessions actually processed
+            db.set_session_state(sid, "split")
+        return {
+            "num_sessions": len(processed_sids),
+            "num_clips": len(rows),
+            "elapsed_s": time.monotonic() - t0,
+        }
+    finally:
+        db.close()
+
+
+def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
+    """Caption split clips (state 'split') with the AV prompt; store in db."""
+    from cosmos_curate_tpu.models.prompts import get_caption_prompt
+    from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+    from cosmos_curate_tpu.models.vlm import CaptionEngine, CaptionRequest, SamplingConfig, VLM_BASE
+    from cosmos_curate_tpu.storage.client import read_bytes
+    from cosmos_curate_tpu.video.decode import extract_frames_at_fps
+
+    t0 = time.monotonic()
+    db = AVStateDB(args.resolved_db)
+    tok = ByteTokenizer()
+    prompt = get_caption_prompt(args.caption_prompt_variant)
+    try:
+        todo = db.clips(state="split")
+        if args.limit:
+            todo = todo[: args.limit]
+        # gather work BEFORE building the engine: a no-op resume run must
+        # not pay the full model load
+        pending: list[tuple[str, "np.ndarray"]] = []
+        for row in todo:
+            clip_path = f"{args.output_path.rstrip('/')}/clips/{row.clip_uuid}.mp4"
+            try:
+                frames = extract_frames_at_fps(read_bytes(clip_path), target_fps=1.0, resize_hw=(224, 224))
+            except FileNotFoundError:
+                continue
+            if frames.shape[0] == 0:
+                continue
+            pending.append((row.clip_uuid, frames[:8]))
+        if not pending:
+            return {"num_captioned": 0, "tokens_per_s": 0.0, "elapsed_s": time.monotonic() - t0}
+        if engine is None:
+            engine = CaptionEngine(VLM_BASE, max_batch=8)
+            engine.setup()
+        for cid, frames in pending:
+            engine.add_request(
+                CaptionRequest(
+                    request_id=cid,
+                    prompt_ids=tok.encode(prompt),
+                    frames=frames,
+                    sampling=SamplingConfig(max_new_tokens=96),
+                )
+            )
+        for res in engine.run_until_complete():
+            db.set_caption(res.request_id, res.text)
+        return {
+            "num_captioned": len(pending),
+            "tokens_per_s": engine.tokens_per_second,
+            "elapsed_s": time.monotonic() - t0,
+        }
+    finally:
+        db.close()
+
+
+def run_av_shard(args: AVPipelineArgs) -> dict:
+    from cosmos_curate_tpu.pipelines.video.shard import ShardPipelineArgs, run_shard
+
+    return run_shard(
+        ShardPipelineArgs(
+            input_path=args.output_path,
+            output_path=f"{args.output_path.rstrip('/')}/shards",
+        )
+    )

@@ -1,0 +1,140 @@
+"""AV pipeline state database.
+
+Equivalent capability of the reference's Postgres clip-state layer
+(cosmos_curate/pipelines/av/utils/postgres_schema.py + core/utils/db/ —
+``PostgresDB``, ``DbRetrier``; core/managers/postgres_cli.py): sessions and
+clips move through ingest → split → caption states with retried writes.
+Backed by sqlite (stdlib, serverless) — the schema and the retry wrapper
+carry over to a Postgres driver unchanged when one is available.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS sessions (
+    session_id TEXT PRIMARY KEY,
+    num_cameras INTEGER NOT NULL,
+    state TEXT NOT NULL DEFAULT 'ingested',
+    created_s REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS clips (
+    clip_uuid TEXT PRIMARY KEY,
+    session_id TEXT NOT NULL,
+    camera TEXT NOT NULL,
+    span_start REAL NOT NULL,
+    span_end REAL NOT NULL,
+    state TEXT NOT NULL DEFAULT 'split',
+    caption TEXT DEFAULT '',
+    FOREIGN KEY (session_id) REFERENCES sessions (session_id)
+);
+CREATE INDEX IF NOT EXISTS idx_clips_session ON clips (session_id);
+CREATE INDEX IF NOT EXISTS idx_clips_state ON clips (state);
+"""
+
+
+def _db_retry(fn):
+    """Retried execution for transient lock/busy failures (reference
+    DbRetrier, db/database_utils.py:28) — the shared retry helper with
+    sqlite's transient exception."""
+    from cosmos_curate_tpu.utils.retry import retry
+
+    return retry(attempts=5, backoff_s=0.2, exceptions=(sqlite3.OperationalError,))(fn)()
+
+
+@dataclass
+class ClipRow:
+    clip_uuid: str
+    session_id: str
+    camera: str
+    span_start: float
+    span_end: float
+    state: str = "split"
+    caption: str = ""
+
+
+class AVStateDB:
+    def __init__(self, path: str) -> None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(path, timeout=10.0)
+        self._conn.executescript(_SCHEMA)
+
+    def upsert_session(self, session_id: str, num_cameras: int) -> None:
+        def op():
+            with self._conn:
+                self._conn.execute(
+                    "INSERT INTO sessions (session_id, num_cameras, created_s) "
+                    "VALUES (?, ?, ?) ON CONFLICT(session_id) DO UPDATE SET "
+                    "num_cameras = excluded.num_cameras",
+                    (session_id, num_cameras, time.time()),
+                )
+        _db_retry(op)
+
+    def set_session_state(self, session_id: str, state: str) -> None:
+        def op():
+            with self._conn:
+                self._conn.execute(
+                    "UPDATE sessions SET state = ? WHERE session_id = ?", (state, session_id)
+                )
+        _db_retry(op)
+
+    def sessions(self, state: str | None = None) -> list[tuple[str, int, str]]:
+        q = "SELECT session_id, num_cameras, state FROM sessions"
+        args: tuple = ()
+        if state:
+            q += " WHERE state = ?"
+            args = (state,)
+        return list(self._conn.execute(q, args))
+
+    def add_clips(self, rows: list[ClipRow]) -> None:
+        # Re-splitting produces the same deterministic clip ids; an existing
+        # row's state/caption must survive (a second 'av split' run must not
+        # wipe captions) — only identity fields update on conflict.
+        def op():
+            with self._conn:
+                self._conn.executemany(
+                    "INSERT INTO clips "
+                    "(clip_uuid, session_id, camera, span_start, span_end, state, caption) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(clip_uuid) DO UPDATE SET "
+                    "session_id = excluded.session_id, camera = excluded.camera, "
+                    "span_start = excluded.span_start, span_end = excluded.span_end",
+                    [
+                        (r.clip_uuid, r.session_id, r.camera, r.span_start, r.span_end, r.state, r.caption)
+                        for r in rows
+                    ],
+                )
+        _db_retry(op)
+
+    def clips(self, *, session_id: str | None = None, state: str | None = None) -> list[ClipRow]:
+        q = "SELECT clip_uuid, session_id, camera, span_start, span_end, state, caption FROM clips"
+        conds, args = [], []
+        if session_id:
+            conds.append("session_id = ?")
+            args.append(session_id)
+        if state:
+            conds.append("state = ?")
+            args.append(state)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        return [ClipRow(*row) for row in self._conn.execute(q, args)]
+
+    def set_caption(self, clip_uuid: str, caption: str) -> None:
+        def op():
+            with self._conn:
+                self._conn.execute(
+                    "UPDATE clips SET caption = ?, state = 'captioned' WHERE clip_uuid = ?",
+                    (caption, clip_uuid),
+                )
+        _db_retry(op)
+
+    def close(self) -> None:
+        self._conn.close()

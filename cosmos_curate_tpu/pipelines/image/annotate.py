@@ -1,0 +1,298 @@
+"""Image annotate pipeline: curation for still images.
+
+Equivalent capability of the reference's image pipeline
+(cosmos_curate/pipelines/image/: run_pipeline.py → annotate_pipeline.py,
+stages image_embedding_stages.py:45-286, filter_stages.py:54/137,
+image_vllm_stages.py:330/418, ImageWriterStage): load → embed (CLIP) →
+aesthetic filter → [semantic filter] → [caption] → write, on the same
+CuratorStage machinery as the video pipelines — the stages below run
+unchanged on the SequentialRunner or the streaming engine.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, run_pipeline
+from cosmos_curate_tpu.core.runner import RunnerInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.models.clip import AestheticScorer, CLIPImageEmbeddings
+from cosmos_curate_tpu.models.prompts import get_caption_prompt
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
+from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
+from cosmos_curate_tpu.storage.client import get_storage_client, read_bytes, write_bytes
+from cosmos_curate_tpu.storage.writers import write_json, write_parquet
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.utils.summary import write_summary
+
+logger = get_logger(__name__)
+
+IMAGE_SUFFIXES = (".jpg", ".jpeg", ".png", ".webp", ".bmp")
+
+
+@dataclass
+class ImageTask(PipelineTask):
+    path: str = ""
+    raw_bytes: bytes | None = None
+    pixels: np.ndarray | None = None  # uint8 [H, W, 3] RGB
+    width: int = 0
+    height: int = 0
+    embedding: np.ndarray | None = None
+    aesthetic_score: float | None = None
+    caption: str = ""
+    filtered_by: str = ""
+    errors: dict[str, str] = field(default_factory=dict)
+
+
+class ImageLoadStage(Stage[ImageTask, ImageTask]):
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        import cv2
+
+        for t in tasks:
+            try:
+                t.raw_bytes = read_bytes(t.path)
+                bgr = cv2.imdecode(np.frombuffer(t.raw_bytes, np.uint8), cv2.IMREAD_COLOR)
+                if bgr is None:
+                    t.errors["load"] = "undecodable image"
+                    continue
+                t.pixels = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+                t.height, t.width = t.pixels.shape[:2]
+            except Exception as e:
+                t.errors["load"] = str(e)
+        return tasks
+
+
+class ImageEmbeddingStage(Stage[ImageTask, ImageTask]):
+    def __init__(self, *, clip_variant: str = "clip-vit-b16-tpu", resize_hw=(224, 224)) -> None:
+        self._model = CLIPImageEmbeddings(clip_variant)
+        self.resize_hw = resize_hw
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    @property
+    def batch_size(self) -> int:
+        return 32
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        import cv2
+
+        live = [t for t in tasks if t.pixels is not None]
+        if live:
+            batch = np.stack(
+                [cv2.resize(t.pixels, self.resize_hw[::-1], interpolation=cv2.INTER_AREA) for t in live]
+            )
+            embs = self._model.encode_frames(batch)
+            for t, e in zip(live, embs):
+                t.embedding = e
+        return tasks
+
+
+class ImageAestheticFilterStage(Stage[ImageTask, ImageTask]):
+    def __init__(self, *, threshold: float = 3.5, score_only: bool = False, embedding_dim: int = 512) -> None:
+        self.threshold = threshold
+        self.score_only = score_only
+        self._model = AestheticScorer(embedding_dim)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5, tpus=0.25)
+
+    @property
+    def batch_size(self) -> int:
+        return 32
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        live = [t for t in tasks if t.embedding is not None]
+        if live:
+            scores = self._model.score(np.stack([t.embedding for t in live]))
+            for t, s in zip(live, scores):
+                t.aesthetic_score = float(s)
+                if not self.score_only and t.aesthetic_score < self.threshold:
+                    t.filtered_by = "aesthetic"
+        return tasks
+
+
+class ImageCaptionStage(Stage[ImageTask, ImageTask]):
+    def __init__(
+        self,
+        *,
+        prompt_variant: str = "short",
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        max_new_tokens: int = 64,
+    ) -> None:
+        self.prompt_text = get_caption_prompt(prompt_variant)
+        self.max_new_tokens = max_new_tokens
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = ByteTokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        engine = self._model.engine
+        assert engine is not None
+        targets = {}
+        for t in tasks:
+            if t.pixels is None or t.filtered_by:
+                continue
+            targets[t.path] = t
+            engine.add_request(
+                CaptionRequest(
+                    request_id=t.path,
+                    prompt_ids=self.tokenizer.encode(self.prompt_text),
+                    frames=t.pixels[None],
+                    sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
+                )
+            )
+        if targets:
+            for res in engine.run_until_complete():
+                if res.request_id in targets:
+                    targets[res.request_id].caption = res.text
+        return tasks
+
+
+class ImageWriterStage(Stage[ImageTask, ImageTask]):
+    def __init__(self, output_path: str) -> None:
+        self.output_path = output_path.rstrip("/")
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        import hashlib
+
+        rows = []
+        for t in tasks:
+            iid = hashlib.sha256(t.path.encode()).hexdigest()[:24]
+            meta = {
+                "id": iid,
+                "path": t.path,
+                "width": t.width,
+                "height": t.height,
+                "aesthetic_score": t.aesthetic_score,
+                "caption": t.caption,
+                "filtered_by": t.filtered_by,
+                "errors": t.errors,
+            }
+            write_json(f"{self.output_path}/metas/{iid}.json", meta)
+            if not t.filtered_by and t.raw_bytes and not t.errors:
+                write_bytes(
+                    f"{self.output_path}/images/{iid}{_suffix(t.path)}", t.raw_bytes
+                )
+            if t.embedding is not None:
+                rows.append((iid, t.embedding))
+            if not t.errors:
+                # resume record certifies completed processing; errored
+                # images (possibly transient IO) must be retried on re-run
+                write_json(f"{self.output_path}/processed_images/{iid}.json", {"path": t.path})
+            t.raw_bytes = None
+            t.pixels = None
+        if rows:
+            import uuid as uuid_mod
+
+            write_parquet(
+                f"{self.output_path}/embeddings/clip/{uuid_mod.uuid4().hex[:12]}.parquet",
+                {
+                    "image_id": [r[0] for r in rows],
+                    "embedding": [r[1].astype(np.float32).tolist() for r in rows],
+                },
+            )
+        return tasks
+
+
+def _suffix(path: str) -> str:
+    from pathlib import PurePath
+
+    return PurePath(path).suffix.lower() or ".jpg"
+
+
+@dataclass
+class ImagePipelineArgs:
+    input_path: str = ""
+    output_path: str = ""
+    limit: int = 0
+    aesthetic_threshold: float | None = None
+    captioning: bool = False
+    caption_prompt_variant: str = "short"
+
+
+def discover_image_tasks(input_path: str, output_path: str | None = None, *, limit: int = 0):
+    import hashlib
+    import json as json_mod
+
+    client = get_storage_client(input_path)
+    done: set[str] = set()
+    if output_path:
+        prefix = f"{output_path.rstrip('/')}/processed_images"
+        for info in client.list_files(prefix, suffixes=(".json",)):
+            try:
+                done.add(json_mod.loads(read_bytes(info.path))["path"])
+            except Exception:
+                pass
+    tasks = []
+    for info in client.list_files(input_path, suffixes=IMAGE_SUFFIXES):
+        if info.path in done:
+            continue
+        tasks.append(ImageTask(path=info.path))
+        if limit and len(tasks) >= limit:
+            break
+    logger.info("discovered %d images under %s (%d done)", len(tasks), input_path, len(done))
+    return tasks
+
+
+def run_image_annotate(
+    args: ImagePipelineArgs,
+    *,
+    runner: RunnerInterface | None = None,
+    config: PipelineConfig | None = None,
+    extra_stages: list[Stage] | None = None,
+) -> dict:
+    t0 = time.monotonic()
+    tasks = discover_image_tasks(args.input_path, args.output_path, limit=args.limit)
+    stages: list[Stage] = [ImageLoadStage(), ImageEmbeddingStage()]
+    if args.aesthetic_threshold is not None:
+        stages.append(ImageAestheticFilterStage(threshold=args.aesthetic_threshold))
+    if args.captioning:
+        stages.append(ImageCaptionStage(prompt_variant=args.caption_prompt_variant))
+    stages.extend(extra_stages or [])
+    stages.append(ImageWriterStage(args.output_path))
+    out = run_pipeline(tasks, stages, config=config, runner=runner) or []
+    elapsed = time.monotonic() - t0
+    summary = {
+        "num_images": len(out),
+        "num_embedded": sum(1 for t in out if t.embedding is not None),
+        "num_filtered": sum(1 for t in out if t.filtered_by),
+        "num_captioned": sum(1 for t in out if t.caption),
+        "num_errors": sum(len(t.errors) for t in out),
+        "pipeline_run_time_s": elapsed,
+    }
+    write_summary(f"{args.output_path.rstrip('/')}/summary.json", summary)
+    logger.info("image annotate done: %s", summary)
+    return summary

@@ -47,6 +47,13 @@ class FixedStrideExtractorStage(Stage[SplitPipeTask, SplitPipeTask]):
                 stride_s=self.stride_s,
                 min_clip_len_s=self.min_clip_len_s,
             )
+            if not spans and video.metadata.duration_s > 0:
+                logger.warning(
+                    "%s (%.1fs) produced 0 clips: clip_len_s=%.1f with "
+                    "min_clip_len_s=%.1f filters everything",
+                    video.path, video.metadata.duration_s,
+                    self.clip_len_s, self.min_clip_len_s,
+                )
             video.clips = make_clips(video.path, spans)
             video.num_total_clips = len(video.clips)
         return tasks

@@ -1,0 +1,169 @@
+"""AV pipeline, state DB, SR stage, and sensors library tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.pipelines.av.pipeline import (
+    AVPipelineArgs,
+    discover_sessions,
+    run_av_ingest,
+    run_av_split,
+)
+from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB, ClipRow
+from cosmos_curate_tpu.sensors.alignment import align, nearest, sampling_grid
+from cosmos_curate_tpu.sensors.data import (
+    CameraExtrinsics,
+    CameraIntrinsics,
+    load_session_jsonl,
+)
+from tests.fixtures.media import make_scene_video
+
+
+@pytest.fixture(scope="module")
+def av_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("av")
+    for cam in ("front", "rear"):
+        make_scene_video(d / f"drive001_{cam}.mp4", scene_len_frames=24, num_scenes=2)
+    make_scene_video(d / f"drive002_front.mp4", scene_len_frames=24, num_scenes=1)
+    return d
+
+
+class TestAVPipeline:
+    def test_discover_sessions(self, av_dir):
+        sessions = discover_sessions(str(av_dir))
+        assert set(sessions) == {"drive001", "drive002"}
+        assert set(sessions["drive001"]) == {"front", "rear"}
+
+    def test_ingest_and_split(self, av_dir, tmp_path):
+        args = AVPipelineArgs(
+            input_path=str(av_dir),
+            output_path=str(tmp_path / "out"),
+            clip_len_s=1.0,
+            min_clip_len_s=0.5,
+        )
+        ingest = run_av_ingest(args)
+        assert ingest["num_sessions"] == 2
+        split = run_av_split(args, runner=SequentialRunner())
+        assert split["num_clips"] == 5  # 2+2 for drive001 (2s each), 1 for drive002 (1s)
+        db = AVStateDB(args.resolved_db)
+        try:
+            rows = db.clips(session_id="drive001")
+            assert len(rows) == 4
+            assert {r.camera for r in rows} == {"front", "rear"}
+            assert db.sessions(state="split")
+        finally:
+            db.close()
+
+
+class TestStateDB:
+    def test_clip_states_and_captions(self, tmp_path):
+        db = AVStateDB(str(tmp_path / "s.sqlite"))
+        try:
+            db.upsert_session("s1", 2)
+            db.add_clips([ClipRow("c1", "s1", "front", 0.0, 5.0)])
+            db.set_caption("c1", "a road")
+            rows = db.clips(state="captioned")
+            assert rows[0].caption == "a road"
+        finally:
+            db.close()
+
+
+class TestSuperResolution:
+    def test_upscale_and_blend(self):
+        from cosmos_curate_tpu.models.super_resolution import (
+            SR_TINY_TEST,
+            SuperResolutionModel,
+        )
+        from cosmos_curate_tpu.pipelines.video.stages.super_resolution import blend_windows
+
+        m = SuperResolutionModel(SR_TINY_TEST)
+        m.setup()
+        frames = np.random.default_rng(0).integers(0, 255, (6, 16, 16, 3), np.uint8)
+        up = m.upscale_window(frames)
+        assert up.shape == (6, 32, 32, 3)
+        # blending overlapping windows reconstructs full length
+        blended = blend_windows([(0, 4, up[:4]), (2, 6, up[2:])], 6)
+        assert blended.shape == (6, 32, 32, 3)
+        # non-overlap regions must be exact
+        np.testing.assert_array_equal(blended[0], up[0])
+        np.testing.assert_array_equal(blended[5], up[5])
+
+    def test_sr_stage_end_to_end(self, tmp_path):
+        from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+        from cosmos_curate_tpu.models.super_resolution import SR_TINY_TEST, SRConfig
+        from cosmos_curate_tpu.pipelines.video.stages.super_resolution import (
+            SuperResolutionStage,
+        )
+        from cosmos_curate_tpu.video.decode import extract_video_metadata
+        from cosmos_curate_tpu.video.encode import encode_frames
+
+        frames = np.random.default_rng(0).integers(0, 255, (12, 16, 16, 3), np.uint8)
+        clip = Clip(encoded_data=encode_frames(frames, fps=12.0))
+        task = SplitPipeTask(video=Video(path="v.mp4", clips=[clip]))
+        stage = SuperResolutionStage(cfg=SR_TINY_TEST, window_len=8, overlap=4)
+        from cosmos_curate_tpu.core.pipeline import run_pipeline
+
+        out = run_pipeline([task], [stage], runner=SequentialRunner())
+        meta = extract_video_metadata(out[0].video.clips[0].encoded_data)
+        assert (meta.width, meta.height) == (32, 32)
+
+
+class TestSensors:
+    def _session_file(self, tmp_path):
+        records = []
+        for cam in ("front", "rear"):
+            for i in range(20):
+                records.append(
+                    {
+                        "type": "camera_frame",
+                        "camera": cam,
+                        "video_path": f"{cam}.mp4",
+                        "frame_index": i,
+                        "timestamp_s": i * 0.1 + (0.01 if cam == "rear" else 0.0),
+                    }
+                )
+        for i in range(10):
+            records.append(
+                {"type": "gps", "timestamp_s": i * 0.2, "latitude": 37.0 + i * 1e-5,
+                 "longitude": -122.0, "altitude_m": 10.0, "speed_mps": 5.0}
+            )
+        records.append(
+            {"type": "intrinsics", "camera": "front", "fx": 1000, "fy": 1000,
+             "cx": 960, "cy": 540, "width": 1920, "height": 1080}
+        )
+        records.append(
+            {"type": "extrinsics", "camera": "front",
+             "rotation": [1, 0, 0, 0], "translation": [1.5, 0, 1.2]}
+        )
+        p = tmp_path / "session01.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in records))
+        return p
+
+    def test_load_and_align(self, tmp_path):
+        session = load_session_jsonl(self._session_file(tmp_path))
+        assert set(session.cameras) == {"front", "rear"}
+        assert session.intrinsics["front"].matrix()[0, 0] == 1000
+        assert session.extrinsics["front"].matrix()[2, 3] == 1.2
+        frames = align(session, rate_hz=5.0, tolerance_s=0.06)
+        assert frames
+        for f in frames:
+            assert set(f.cameras) == {"front", "rear"}
+            assert abs(f.cameras["front"].timestamp_s - f.timestamp_s) <= 0.06
+        assert any(f.gps is not None for f in frames)
+
+    def test_alignment_drops_out_of_tolerance(self, tmp_path):
+        session = load_session_jsonl(self._session_file(tmp_path))
+        # rear offset is 0.01s; a 1ms tolerance excludes it everywhere except
+        # exact overlaps -> no aligned frames with both cameras
+        frames = align(session, rate_hz=5.0, tolerance_s=0.001)
+        assert frames == []
+
+    def test_nearest_and_grid(self, tmp_path):
+        assert nearest([0.0, 1.0, 2.0], 1.4) == 1
+        assert nearest([0.0, 1.0, 2.0], 1.6) == 2
+        session = load_session_jsonl(self._session_file(tmp_path))
+        grid = sampling_grid(session, rate_hz=10.0)
+        assert grid[0] >= 0.01  # starts at the latest first-frame
