@@ -28,7 +28,11 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--min-clip-len-s", type=float, default=2.0)
     split.add_argument("--motion-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--aesthetic-threshold", type=float, default=None)
-    split.add_argument("--embedding-model", choices=["", "clip", "video"], default="")
+    split.add_argument(
+        "--embedding-model",
+        choices=["", "clip", "video", "video-512", "video-256"],
+        default="",
+    )
     split.add_argument("--captioning", action="store_true")
     split.add_argument("--enhance-captions", action="store_true")
     split.add_argument("--t5-embeddings", action="store_true")

@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
         slurm_cli.register(sub)
     except ImportError:
         pass
+    try:
+        from cosmos_curate_tpu.cli import models_cli
+
+        models_cli.register(sub)
+    except ImportError:
+        pass
     return parser
 
 

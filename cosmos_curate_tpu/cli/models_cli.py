@@ -1,0 +1,147 @@
+"""`cosmos-curate-tpu models` — weights registry management.
+
+Equivalent capability of the reference's model manager CLI
+(cosmos_curate/core/managers/model_cli.py — in-container weight download /
+listing; weights flow HF → cloud cache → per-node dir, model_utils.py):
+list registered models, show staging status, stage a checkpoint file into
+the registry location, and export a randomly-initialized checkpoint (useful
+for smoke tests and as a template for converters).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+from pathlib import Path
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    models = sub.add_parser("models", help="model weights registry")
+    msub = models.add_subparsers(dest="subcommand", metavar="action")
+
+    ls = msub.add_parser("list", help="registered models + staging status")
+    ls.set_defaults(func=_cmd_list)
+
+    stage = msub.add_parser("stage", help="copy a params.msgpack into the registry")
+    stage.add_argument("model_id")
+    stage.add_argument("checkpoint", help="path to a flax msgpack checkpoint")
+    stage.set_defaults(func=_cmd_stage)
+
+    init = msub.add_parser("init-random", help="write a seeded random checkpoint")
+    init.add_argument("model_id")
+    init.add_argument("--seed", type=int, default=0)
+    init.set_defaults(func=_cmd_init_random)
+
+    models.set_defaults(func=lambda args: (models.print_help(), 2)[1])
+
+
+def _cmd_list(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.models import registry
+
+    for mid in registry.registered_models():
+        ckpt = registry.local_dir_for(mid) / "params.msgpack"
+        status = f"staged ({ckpt.stat().st_size >> 20} MiB)" if ckpt.exists() else "not staged"
+        print(f"{mid:28s} {status}")
+    print(f"\nweights root: {registry.weights_root()}")
+    return 0
+
+
+def _cmd_stage(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.models import registry
+
+    if args.model_id not in registry.registered_models():
+        print(f"error: unknown model id {args.model_id!r}; see `models list`")
+        return 2
+    src = Path(args.checkpoint)
+    if not src.is_file():
+        print(f"error: {src} not found")
+        return 2
+    dst = registry.local_dir_for(args.model_id) / "params.msgpack"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copyfile(src, dst)
+    print(f"staged {src} -> {dst}")
+    return 0
+
+
+def _cmd_init_random(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.models import registry
+
+    builders = _init_builders()
+    builder = builders.get(args.model_id)
+    if builder is None:
+        print(
+            f"error: no random-init builder for {args.model_id!r}; "
+            f"have {sorted(builders)}"
+        )
+        return 2
+    params = builder(args.seed)
+    path = registry.save_params(args.model_id, params)
+    print(f"wrote {path}")
+    return 0
+
+
+def _init_builders():
+    """model_id -> (seed -> params): RAW ``model.init`` with the given seed,
+    never through the registry (which would reload staged weights and
+    ignore the seed)."""
+    import jax
+    import jax.numpy as jnp
+
+    def transnet(seed):
+        from cosmos_curate_tpu.models.transnetv2 import INPUT_H, INPUT_W, WINDOW, TransNet
+
+        return TransNet().init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, WINDOW, INPUT_H, INPUT_W, 3), jnp.uint8)
+        )
+
+    def clip_b16(seed):
+        from cosmos_curate_tpu.models.vit import VIT_B_16, ViT, preprocess_frames
+
+        dummy = jnp.zeros((1, VIT_B_16.image_size, VIT_B_16.image_size, 3), jnp.uint8)
+        return ViT(VIT_B_16).init(
+            jax.random.PRNGKey(seed), preprocess_frames(dummy, image_size=VIT_B_16.image_size)
+        )
+
+    def aesthetics(seed):
+        from cosmos_curate_tpu.models.clip import AestheticMLP
+
+        return AestheticMLP().init(jax.random.PRNGKey(seed), jnp.zeros((1, 512)))
+
+    def video_embed(seed):
+        from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_BASE, VideoEmbedModel
+
+        s = VIDEO_EMBED_BASE.vit.image_size
+        dummy = jnp.zeros((1, VIDEO_EMBED_BASE.num_frames, s, s, 3), jnp.uint8)
+        return VideoEmbedModel(VIDEO_EMBED_BASE).init(jax.random.PRNGKey(seed), dummy)
+
+    def caption_vlm(seed):
+        from cosmos_curate_tpu.models.vlm import VLM, VLM_BASE
+        from cosmos_curate_tpu.models.vlm.model import init_cache
+
+        model = VLM(VLM_BASE)
+        size = VLM_BASE.vision.image_size
+        ck, cv = init_cache(VLM_BASE, 1)
+        return model.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, 1, size, size, 3), jnp.uint8),
+            jnp.zeros((1, 4), jnp.int32),
+            ck,
+            cv,
+            method=model.init_everything,
+        )
+
+    def t5(seed):
+        from cosmos_curate_tpu.models.t5 import T5_BASE, TextEncoder
+
+        return TextEncoder(T5_BASE).init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), bool)
+        )
+
+    return {
+        "transnetv2-tpu": transnet,
+        "clip-vit-b16-tpu": clip_b16,
+        "aesthetics-mlp-tpu": aesthetics,
+        "video-embed-tpu": video_embed,
+        "caption-vlm-tpu": caption_vlm,
+        "t5-encoder-tpu": t5,
+    }

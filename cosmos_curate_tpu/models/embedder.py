@@ -34,9 +34,25 @@ class VideoEmbedConfig:
 
 
 VIDEO_EMBED_BASE = VideoEmbedConfig()
+# The reference ships two embedder families (InternVideo2 512-d,
+# Cosmos-Embed1 256/768-d, SURVEY.md §2.3); these configs cover the same
+# output spaces under one architecture.
+VIDEO_EMBED_512 = VideoEmbedConfig(output_dim=512)
+VIDEO_EMBED_256 = VideoEmbedConfig(temporal_layers=2, output_dim=256)
 VIDEO_EMBED_TINY_TEST = VideoEmbedConfig(
     vit=VIT_TINY_TEST, temporal_layers=1, temporal_heads=2, num_frames=4, output_dim=32
 )
+
+# variant name -> (config, registry model id): each output space has its own
+# weights slot — a 768-d checkpoint cannot serve the 512/256-d variants.
+VIDEO_EMBED_VARIANTS = {
+    "video": (VIDEO_EMBED_BASE, "video-embed-tpu"),
+    "video-512": (VIDEO_EMBED_512, "video-embed-512-tpu"),
+    "video-256": (VIDEO_EMBED_256, "video-embed-256-tpu"),
+}
+
+registry.register_model("video-embed-512-tpu", "512-d temporal-transformer video embedder")
+registry.register_model("video-embed-256-tpu", "256-d temporal-transformer video embedder")
 
 
 class TemporalPooler(nn.Module):
@@ -89,14 +105,17 @@ def _jitted_apply(cfg: VideoEmbedConfig):
 class VideoEmbedder(ModelInterface):
     MODEL_ID = "video-embed-tpu"
 
-    def __init__(self, cfg: VideoEmbedConfig = VIDEO_EMBED_BASE) -> None:
+    def __init__(
+        self, cfg: VideoEmbedConfig = VIDEO_EMBED_BASE, *, model_id: str | None = None
+    ) -> None:
         self.cfg = cfg
+        self.model_id = model_id or self.MODEL_ID
         self._apply = None
         self._params = None
 
     @property
     def model_id_names(self) -> list[str]:
-        return [self.MODEL_ID]
+        return [self.model_id]
 
     @property
     def embedding_dim(self) -> int:
@@ -110,7 +129,7 @@ class VideoEmbedder(ModelInterface):
             dummy = jnp.zeros((1, self.cfg.num_frames, s, s, 3), jnp.uint8)
             return model.init(jax.random.PRNGKey(seed), dummy)
 
-        self._params = registry.load_params(self.MODEL_ID, init)
+        self._params = registry.load_params(self.model_id, init)
         self._apply = _jitted_apply(self.cfg)
 
     def sample_frame_indices(self, total: int) -> np.ndarray:

@@ -148,7 +148,11 @@ class CaptionEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_step(params, cache_k, cache_v, tokens, positions):
-            """tokens/positions: [max_batch]; one token for every slot."""
+            """tokens/positions: [max_batch]; one token for every slot.
+
+            Greedy argmax happens ON DEVICE for the whole batch — per-slot
+            host argmaxes were the decode loop's bottleneck (one device
+            sync per slot per token)."""
             embeds = model.apply(params, tokens[:, None], method=model.embed_tokens)
             logits, ck, cv = model.apply(
                 params,
@@ -159,23 +163,30 @@ class CaptionEngine:
                 positions,
                 positions + 1,
             )
-            return logits[:, 0], ck, cv
+            step_logits = logits[:, 0]
+            greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+            return greedy, step_logits, ck, cv
 
-        def sample(logits, sampling: SamplingConfig, step_key):
-            if sampling.temperature <= 0.0:
-                return int(jnp.argmax(logits))
-            scaled = logits / sampling.temperature
-            if sampling.top_k > 0:
-                top = jnp.sort(scaled)[-sampling.top_k]
-                scaled = jnp.where(scaled < top, -jnp.inf, scaled)
-            return int(jax.random.categorical(step_key, scaled))
+        host_rng = np.random.default_rng(seed)
+
+        def sample_host(logits_row: np.ndarray, sampling: SamplingConfig):
+            """Non-greedy sampling, entirely on host (no device round-trips
+            — they were the per-slot-per-token cost this path removes)."""
+            scaled = logits_row.astype(np.float64) / sampling.temperature
+            k = min(sampling.top_k, scaled.shape[-1])  # out-of-range = no filter
+            if 0 < k < scaled.shape[-1]:
+                kth = np.partition(scaled, -k)[-k]
+                scaled = np.where(scaled < kth, -np.inf, scaled)
+            scaled -= scaled.max()
+            probs = np.exp(scaled)
+            probs /= probs.sum()
+            return int(host_rng.choice(len(probs), p=probs))
 
         self._encode_images = encode_images
         self._embed_tokens = embed_tokens
         self._prefill = prefill
         self._decode = decode_step
-        self._sample = sample
-        self._key = jax.random.PRNGKey(seed)
+        self._sample_host = sample_host
         self._built = True
 
     # -- public API -----------------------------------------------------
@@ -246,8 +257,11 @@ class CaptionEngine:
             slot_idx,
             jnp.asarray(t_valid, jnp.int32),
         )
-        self._key, sub = jax.random.split(self._key)
-        first = self._sample(logits, req.sampling, sub)
+        logits_np = np.asarray(logits)
+        if req.sampling.temperature <= 0.0:
+            first = int(logits_np.argmax())
+        else:
+            first = self._sample_host(logits_np, req.sampling)
         slot = _Slot(request=req, position=t_valid, generated=[first])
         self.slots[slot_idx] = slot
         self._maybe_finish(slot_idx, slot)
@@ -259,16 +273,22 @@ class CaptionEngine:
             tokens[i] = slot.generated[-1]
             positions[i] = slot.position
         t0 = time.monotonic()
-        logits, self.cache_k, self.cache_v = self._decode(
+        greedy, logits, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v, jnp.asarray(tokens), jnp.asarray(positions)
         )
-        logits.block_until_ready()
+        greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
         self._decode_time += time.monotonic() - t0
         self._decode_tokens += len(self.slots)
+        needs_sampling = any(
+            s.request.sampling.temperature > 0.0 for s in self.slots.values()
+        )
+        logits_np = np.asarray(logits) if needs_sampling else None
         for i in list(self.slots):
             slot = self.slots[i]
-            self._key, sub = jax.random.split(self._key)
-            nxt = self._sample(logits[i], slot.request.sampling, sub)
+            if slot.request.sampling.temperature <= 0.0:
+                nxt = int(greedy_np[i])
+            else:
+                nxt = self._sample_host(logits_np[i], slot.request.sampling)
             slot.generated.append(nxt)
             slot.position += 1
             self._maybe_finish(i, slot)

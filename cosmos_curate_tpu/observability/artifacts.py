@@ -1,0 +1,61 @@
+"""Post-run artifact collection.
+
+Equivalent capability of the reference's artifact transport
+(cosmos_curate/core/utils/artifacts/ — ``RayFileTransport`` fan-in +
+``ArtifactDelivery`` 3-phase staging/collect/upload, ARCHITECTURE.md:138-171):
+profiling and trace artifacts produced by worker processes land in
+node-local staging dirs; after the run they are swept into the run's output
+prefix through the storage layer (local or remote). Multi-node runs sweep
+per node — every node pushes its own staging dir to the shared prefix, so
+no cross-node fan-in channel is needed (object storage is the rendezvous).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from cosmos_curate_tpu.storage.client import write_bytes
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+def collect_artifacts(
+    output_path: str,
+    *,
+    staging_dirs: tuple[str, ...] | None = None,
+    node_tag: str | None = None,
+    cleanup: bool = True,
+) -> int:
+    """Sweep staged artifacts into ``<output>/profile/collected/<node>/``.
+
+    Returns the number of files collected. Local-path outputs get real file
+    copies; remote outputs (s3://, gs://) upload through the storage layer.
+    """
+    if staging_dirs is None:
+        # this run's worker trace staging only (per-run dir: concurrent
+        # pipelines must not sweep each other's files)
+        from cosmos_curate_tpu.observability.tracing import default_staging_dir
+
+        staging_dirs = (default_staging_dir(),)
+    tag = node_tag or os.environ.get("CURATE_NODE_RANK", "0")
+    dest_root = f"{output_path.rstrip('/')}/profile/collected/node{tag}"
+    n = 0
+    for staging in staging_dirs:
+        root = Path(staging)
+        if not root.is_dir():
+            continue
+        for f in sorted(root.rglob("*")):
+            if not f.is_file():
+                continue
+            rel = f.relative_to(root)
+            try:
+                write_bytes(f"{dest_root}/{root.name}/{rel}", f.read_bytes())
+                n += 1
+                if cleanup:
+                    f.unlink()
+            except Exception as e:
+                logger.warning("artifact collection failed for %s: %s", f, e)
+    if n:
+        logger.info("collected %d artifacts into %s", n, dest_root)
+    return n

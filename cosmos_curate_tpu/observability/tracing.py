@@ -84,11 +84,19 @@ class _NdjsonBackend:
                 self._flush_locked()
 
 
+def default_staging_dir() -> str:
+    """Per-run staging dir: concurrent pipelines on one host must not sweep
+    each other's artifacts. The run id is the coordinator pid, which the
+    engine already propagates to workers as CURATE_STORE_OWNER."""
+    run = os.environ.get("CURATE_STORE_OWNER", str(os.getpid()))
+    return os.environ.get("CURATE_TRACE_DIR", f"/tmp/curate_traces/run-{run}")
+
+
 def enable_tracing(output_path: str | None = None) -> str:
     """Turn tracing on for this process; returns the NDJSON path."""
     global _enabled, _backend
     path = output_path or os.environ.get(
-        "CURATE_TRACE_PATH", f"/tmp/curate_traces/trace-{os.getpid()}.ndjson"
+        "CURATE_TRACE_PATH", f"{default_staging_dir()}/trace-{os.getpid()}.ndjson"
     )
     _backend = _NdjsonBackend(path)
     _enabled = True

@@ -207,6 +207,10 @@ def run_split(
             from cosmos_curate_tpu.observability.tracing import disable_tracing
 
             disable_tracing()  # flushes buffered spans through storage
+        if args.tracing or args.profile_cpu or args.profile_memory:
+            from cosmos_curate_tpu.observability.artifacts import collect_artifacts
+
+            collect_artifacts(args.output_path)
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
     summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)
@@ -258,9 +262,24 @@ def _apply_observability_wrappers(
 
 
 def _discover_num_chips() -> int:
-    try:
-        import jax
+    """TPU chip count for the summary metric. Device discovery can BLOCK
+    indefinitely when the TPU tunnel is unhealthy, so it runs under a
+    timeout — a metric denominator must never hang the pipeline."""
+    import threading
 
-        return max(1, len([d for d in jax.devices() if d.platform == "tpu"]))
-    except Exception:
-        return 1
+    result: list[int] = []
+
+    def query() -> None:
+        try:
+            import jax
+
+            result.append(max(1, len([d for d in jax.devices() if d.platform == "tpu"])))
+        except Exception:
+            result.append(1)
+
+    # daemon thread: a hung device query must block neither the pipeline
+    # nor interpreter shutdown
+    t = threading.Thread(target=query, daemon=True)
+    t.start()
+    t.join(timeout=20.0)
+    return result[0] if result else 1

@@ -30,19 +30,27 @@ class ClipEmbeddingStage(Stage[SplitPipeTask, SplitPipeTask]):
         self,
         *,
         variant: str = "video",
-        video_cfg: VideoEmbedConfig = VIDEO_EMBED_BASE,
+        video_cfg: VideoEmbedConfig | None = None,
         clip_variant: str = "clip-vit-b16-tpu",
         extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
     ) -> None:
-        if variant not in ("video", "clip"):
-            raise ValueError(f"unknown embedding variant {variant!r}")
-        self.variant = variant
+        from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_VARIANTS
+
+        if variant != "clip" and variant not in VIDEO_EMBED_VARIANTS:
+            raise ValueError(
+                f"unknown embedding variant {variant!r}; have "
+                f"{['clip', *VIDEO_EMBED_VARIANTS]}"
+            )
+        self.variant = "clip" if variant == "clip" else "video"
         self.extraction = extraction
         self._model: ModelInterface
-        if variant == "video":
+        if variant == "clip":
+            self._model = CLIPImageEmbeddings(clip_variant)
+        elif video_cfg is not None:
             self._model = VideoEmbedder(video_cfg)
         else:
-            self._model = CLIPImageEmbeddings(clip_variant)
+            cfg, model_id = VIDEO_EMBED_VARIANTS[variant]
+            self._model = VideoEmbedder(cfg, model_id=model_id)
 
     @property
     def model(self) -> ModelInterface:

@@ -1,0 +1,35 @@
+import pytest
+
+from cosmos_curate_tpu.observability.artifacts import collect_artifacts
+from cosmos_curate_tpu.pipelines.examples.chunking_demo import run_chunking_demo
+
+
+def test_collect_artifacts(tmp_path):
+    staging = tmp_path / "stage"
+    (staging / "traces").mkdir(parents=True)
+    (staging / "traces" / "t1.ndjson").write_text('{"a":1}\n')
+    (staging / "cpu.txt").write_text("profile")
+    out = tmp_path / "run"
+    n = collect_artifacts(str(out), staging_dirs=(str(staging),), node_tag="7")
+    assert n == 2
+    collected = list((out / "profile" / "collected" / "node7").rglob("*.ndjson"))
+    assert len(collected) == 1
+    # staging cleaned up
+    assert not list(staging.rglob("*.ndjson"))
+
+
+def test_collect_missing_staging_ok(tmp_path):
+    assert collect_artifacts(str(tmp_path), staging_dirs=("/nope/xyz",)) == 0
+
+
+def test_chunking_demo():
+    out = run_chunking_demo(num_inputs=2)
+    # 100 items / 16 per chunk = 7 chunks per input
+    assert len(out) == 14
+    fractions = {}
+    for t in out:
+        fractions.setdefault(t.name, 0.0)
+        fractions[t.name] += t.fraction
+        assert t.payload[0] == sum(range(t.chunk_index * 16, min((t.chunk_index + 1) * 16, 100)))
+    for total in fractions.values():
+        assert total == pytest.approx(1.0)

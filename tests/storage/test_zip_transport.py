@@ -1,0 +1,43 @@
+import pytest
+
+from cosmos_curate_tpu.storage.zip_transport import (
+    download_and_extract,
+    zip_and_upload_directory,
+    zip_directory,
+)
+
+
+def test_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("alpha")
+    (src / "sub" / "b.bin").write_bytes(b"\x00\x01")
+    dest_zip = tmp_path / "out.zip"
+    size = zip_and_upload_directory(src, str(dest_zip))
+    assert size > 0 and dest_zip.exists()
+    out = tmp_path / "extract"
+    files = download_and_extract(str(dest_zip), out)
+    assert len(files) == 2
+    assert (out / "a.txt").read_text() == "alpha"
+    assert (out / "sub" / "b.bin").read_bytes() == b"\x00\x01"
+
+
+def test_deterministic(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "x.txt").write_text("x")
+    assert zip_directory(src) == zip_directory(src)
+
+
+def test_zip_slip_rejected(tmp_path):
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("../evil.txt", "pwn")
+    evil = tmp_path / "evil.zip"
+    evil.write_bytes(buf.getvalue())
+    with pytest.raises(ValueError, match="escapes"):
+        download_and_extract(str(evil), tmp_path / "out")
+    assert not (tmp_path / "evil.txt").exists()
