@@ -56,7 +56,34 @@ def make_corpus(root: Path) -> Path:
     return vids
 
 
+def ensure_live_backend() -> None:
+    """The TPU tunnel can wedge (observed: a dead relay makes ANY jax import
+    block for minutes). Probe device init in a subprocess with a timeout;
+    if it fails, re-exec on pure CPU so the bench always reports a number
+    (flagged on stderr) instead of hanging the driver."""
+    import subprocess
+
+    if os.environ.get("BENCH_BACKEND_CHECKED"):
+        return
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=150,
+        )
+        alive = r.returncode == 0
+    except subprocess.TimeoutExpired:
+        alive = False
+    if not alive:
+        log("bench: TPU backend unavailable; re-executing on CPU (result is NOT a TPU number)")
+        env = {**os.environ, "BENCH_BACKEND_CHECKED": "1", "JAX_PLATFORMS": "cpu"}
+        env["PYTHONPATH"] = str(REPO)  # drop the axon plugin path
+        os.execve(sys.executable, [sys.executable, str(Path(__file__).resolve())], env)
+    os.environ["BENCH_BACKEND_CHECKED"] = "1"
+
+
 def main() -> int:
+    ensure_live_backend()
     import numpy as np
 
     from cosmos_curate_tpu.core.runner import SequentialRunner
@@ -112,16 +139,19 @@ def main() -> int:
                 vs = value / float(ref["value"])
         except Exception as e:
             log(f"bench: unreadable BENCH_REF.json: {e}")
-    print(
-        json.dumps(
-            {
-                "metric": "clips_per_sec_split_annotate",
-                "value": round(value, 3),
-                "unit": "clips/s",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    import jax
+
+    backend = jax.devices()[0].platform
+    record = {
+        "metric": "clips_per_sec_split_annotate",
+        "value": round(value, 3),
+        "unit": "clips/s",
+        "vs_baseline": round(vs, 3),
+    }
+    if backend != "tpu":
+        # degraded run (dead TPU tunnel fallback) must be machine-detectable
+        record["backend"] = backend
+    print(json.dumps(record))
     return 0
 
 

@@ -1,0 +1,55 @@
+"""GCS backend (requires google-cloud-storage; constructed only when
+importable — see storage/client.py gating).
+
+The TPU-native twin of the reference's cloud backends (its S3/Azure pair,
+cosmos_curate/core/utils/storage/{s3,azure}_client.py): on GCP TPU fleets
+the object store is typically GCS.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+
+
+def _split(path: str) -> tuple[str, str]:
+    rest = path[len("gs://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key
+
+
+class GcsStorageClient(StorageClient):
+    def __init__(self, **client_kwargs) -> None:
+        from google.cloud import storage
+
+        self._client = storage.Client(**client_kwargs)
+
+    def read_bytes(self, path: str) -> bytes:
+        bucket, key = _split(path)
+        return self._client.bucket(bucket).blob(key).download_as_bytes()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        bucket, key = _split(path)
+        self._client.bucket(bucket).blob(key).upload_from_string(
+            data, content_type="application/octet-stream"
+        )
+
+    def exists(self, path: str) -> bool:
+        bucket, key = _split(path)
+        return self._client.bucket(bucket).blob(key).exists()
+
+    def delete(self, path: str) -> None:
+        bucket, key = _split(path)
+        blob = self._client.bucket(bucket).blob(key)
+        if blob.exists():
+            blob.delete()
+
+    def list_files(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None, recursive: bool = True
+    ) -> Iterator[ObjectInfo]:
+        bucket, key = _split(prefix)
+        for blob in self._client.list_blobs(bucket, prefix=key):
+            p = f"gs://{bucket}/{blob.name}"
+            if suffixes is None or p.lower().endswith(suffixes):
+                yield ObjectInfo(p, blob.size or 0)

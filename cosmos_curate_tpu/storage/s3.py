@@ -23,7 +23,14 @@ class S3StorageClient(StorageClient):
     def __init__(self, **session_kwargs) -> None:
         import boto3
 
-        self._s3 = boto3.session.Session(**session_kwargs).client("s3")
+        if not session_kwargs:
+            from cosmos_curate_tpu.utils.user_config import s3_session_kwargs
+
+            session_kwargs = s3_session_kwargs()
+        endpoint = session_kwargs.pop("endpoint_url", None)
+        self._s3 = boto3.session.Session(**session_kwargs).client(
+            "s3", endpoint_url=endpoint
+        )
 
     def read_bytes(self, path: str) -> bytes:
         bucket, key = _split(path)

@@ -1,0 +1,40 @@
+"""File-based inter-process lock.
+
+Equivalent capability of the reference's file lock
+(cosmos_curate/core/utils/misc/file_lock.py): serialize cross-process
+critical sections (weight staging, native-lib builds) via flock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import time
+from pathlib import Path
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def file_lock(path: str | Path, *, timeout_s: float = 60.0) -> Iterator[None]:
+    """Exclusive flock on ``path`` (created if absent); raises TimeoutError
+    if not acquired within ``timeout_s``."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    fd = os.open(p, os.O_CREAT | os.O_RDWR, 0o600)
+    deadline = time.monotonic() + timeout_s
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except BlockingIOError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"could not acquire lock {p} within {timeout_s}s")
+                time.sleep(0.05)
+        yield
+    finally:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)

@@ -1,0 +1,66 @@
+"""User configuration file: credentials and defaults.
+
+Equivalent capability of the reference's config system
+(cosmos_curate/core/utils/config/config.py:81 — ``ConfigFileData`` from
+``~/.config/cosmos_curate/config.yaml`` holding API/storage credentials;
+deployment context via env vars, environment.py:15-63).
+
+File: ``~/.config/cosmos_curate_tpu/config.yaml`` (override with
+``CURATE_CONFIG_PATH``). Recognized sections::
+
+    s3:        {access_key_id, secret_access_key, region, endpoint_url}
+    gcs:       {project, credentials_file}
+    huggingface: {token}
+    weights:   {prefix}     # remote weight cache (MODEL_WEIGHTS_PREFIX equiv)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from pathlib import Path
+from typing import Any
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_PATH = "~/.config/cosmos_curate_tpu/config.yaml"
+
+
+@functools.lru_cache(maxsize=1)
+def load_user_config() -> dict[str, Any]:
+    path = Path(os.environ.get("CURATE_CONFIG_PATH", DEFAULT_PATH)).expanduser()
+    if not path.exists():
+        return {}
+    import yaml
+
+    try:
+        data = yaml.safe_load(path.read_text()) or {}
+        if not isinstance(data, dict):
+            raise ValueError("config root must be a mapping")
+        return data
+    except Exception as e:
+        logger.warning("unreadable user config %s: %s", path, e)
+        return {}
+
+
+def get_section(name: str) -> dict[str, Any]:
+    section = load_user_config().get(name, {})
+    return section if isinstance(section, dict) else {}
+
+
+def s3_session_kwargs() -> dict[str, Any]:
+    """boto3 session/client kwargs from the config (env vars still win —
+    boto3's own chain applies when this is empty)."""
+    s3 = get_section("s3")
+    out: dict[str, Any] = {}
+    if s3.get("access_key_id"):
+        out["aws_access_key_id"] = s3["access_key_id"]
+    if s3.get("secret_access_key"):
+        out["aws_secret_access_key"] = s3["secret_access_key"]
+    if s3.get("region"):
+        out["region_name"] = s3["region"]
+    if s3.get("endpoint_url"):
+        out["endpoint_url"] = s3["endpoint_url"]
+    return out

@@ -1,0 +1,108 @@
+"""Utility coverage: file lock, user config, retry."""
+
+import multiprocessing as mp
+import time
+
+import pytest
+
+from cosmos_curate_tpu.utils.file_lock import file_lock
+from cosmos_curate_tpu.utils.retry import retry
+from cosmos_curate_tpu.utils import user_config
+
+
+def _hold_lock(path, started, release):
+    from cosmos_curate_tpu.utils.file_lock import file_lock as fl
+
+    with fl(path):
+        started.set()
+        release.wait(10)
+
+
+class TestFileLock:
+    def test_exclusion_across_processes(self, tmp_path):
+        lock_path = str(tmp_path / "l.lock")
+        hold = _hold_lock
+        ctx = mp.get_context("spawn")
+        started, release = ctx.Event(), ctx.Event()
+        p = ctx.Process(target=hold, args=(lock_path, started, release))
+        p.start()
+        try:
+            assert started.wait(30)
+            with pytest.raises(TimeoutError):
+                with file_lock(lock_path, timeout_s=0.3):
+                    pass
+            release.set()
+            p.join(10)
+            with file_lock(lock_path, timeout_s=5.0):
+                pass  # acquired after release
+        finally:
+            release.set()
+            p.join(5)
+            if p.is_alive():
+                p.terminate()
+
+    def test_reentrant_sequential(self, tmp_path):
+        path = str(tmp_path / "l2.lock")
+        for _ in range(3):
+            with file_lock(path, timeout_s=1.0):
+                pass
+
+
+class TestUserConfig:
+    def test_missing_file_empty(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CURATE_CONFIG_PATH", str(tmp_path / "nope.yaml"))
+        user_config.load_user_config.cache_clear()
+        assert user_config.load_user_config() == {}
+        assert user_config.s3_session_kwargs() == {}
+
+    def test_s3_section(self, tmp_path, monkeypatch):
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("s3:\n  access_key_id: AK\n  secret_access_key: SK\n  region: us-west-2\n")
+        monkeypatch.setenv("CURATE_CONFIG_PATH", str(cfg))
+        user_config.load_user_config.cache_clear()
+        kw = user_config.s3_session_kwargs()
+        assert kw == {
+            "aws_access_key_id": "AK",
+            "aws_secret_access_key": "SK",
+            "region_name": "us-west-2",
+        }
+        user_config.load_user_config.cache_clear()
+
+    def test_malformed_yaml_warns_empty(self, tmp_path, monkeypatch):
+        cfg = tmp_path / "bad.yaml"
+        cfg.write_text("- just\n- a list\n")
+        monkeypatch.setenv("CURATE_CONFIG_PATH", str(cfg))
+        user_config.load_user_config.cache_clear()
+        assert user_config.load_user_config() == {}
+        user_config.load_user_config.cache_clear()
+
+
+class TestRetry:
+    def test_succeeds_after_failures(self):
+        calls = []
+
+        @retry(attempts=3, backoff_s=0.01)
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        assert flaky() == "ok"
+        assert len(calls) == 3
+
+    def test_raises_after_exhaustion(self):
+        @retry(attempts=2, backoff_s=0.01)
+        def dead():
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            dead()
+
+    def test_exception_filter(self):
+        @retry(attempts=3, backoff_s=0.01, exceptions=(KeyError,))
+        def wrong_kind():
+            raise ValueError("not retried")
+
+        with pytest.raises(ValueError):
+            wrong_kind()
