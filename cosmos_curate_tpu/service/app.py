@@ -197,7 +197,23 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
             job.proc.terminate()
         return web.json_response({"job_id": job.job_id, "state": job.state})
 
+    async def models(request: web.Request) -> web.Response:
+        """Weights-registry status (reference nvcf_model_manager equivalent:
+        core/cf/nvcf_model_manager.py — which models a deployment has
+        staged)."""
+        from cosmos_curate_tpu.models import registry
+
+        out = {}
+        for mid in registry.registered_models():
+            ckpt = registry.local_dir_for(mid) / "params.msgpack"
+            out[mid] = {
+                "staged": ckpt.exists(),
+                "size_bytes": ckpt.stat().st_size if ckpt.exists() else 0,
+            }
+        return web.json_response({"weights_root": str(registry.weights_root()), "models": out})
+
     app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/invoke", invoke)
     app.router.add_get("/v1/progress/{job_id}", progress)
     app.router.add_get("/v1/logs/{job_id}", logs)

@@ -1,0 +1,64 @@
+"""Tensor-parallel sharding annotations: every weight matrix that should
+shard over the 'model' axis actually carries the annotation, and VLM params
+place onto a model-parallel mesh."""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cosmos_curate_tpu.models.vlm import VLM, VLM_TINY_TEST
+from cosmos_curate_tpu.models.vlm.model import init_cache
+
+
+@pytest.fixture(scope="module")
+def vlm_params():
+    model = VLM(VLM_TINY_TEST)
+    size = VLM_TINY_TEST.vision.image_size
+    ck, cv = init_cache(VLM_TINY_TEST, 1)
+    return model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 1, size, size, 3), jnp.uint8),
+        jnp.zeros((1, 4), jnp.int32),
+        ck,
+        cv,
+        method=model.init_everything,
+    )
+
+
+def test_annotations_follow_megatron_recipe(vlm_params):
+    specs = nn.get_partition_spec(vlm_params)
+    flat = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    by_path = {jax.tree_util.keystr(k): v for k, v in flat}
+    # QKV/up/gate shard output features; attention-out/down shard input
+    q = next(v for k, v in by_path.items() if "layer_0" in k and "['q']['kernel']" in k)
+    o = next(v for k, v in by_path.items() if "layer_0" in k and "['o']['kernel']" in k)
+    up = next(v for k, v in by_path.items() if "layer_0" in k and "['up']['kernel']" in k)
+    down = next(v for k, v in by_path.items() if "layer_0" in k and "['down']['kernel']" in k)
+    assert q == P(None, "model")
+    assert up == P(None, "model")
+    assert o == P("model", None)
+    assert down == P("model", None)
+
+
+def test_params_place_on_model_parallel_mesh(vlm_params):
+    devs = np.array(jax.devices()[:2]).reshape(1, 2)
+    mesh = Mesh(devs, axis_names=("data", "model"))
+    specs = nn.get_partition_spec(vlm_params)
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.unbox(vlm_params),
+        specs,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned),
+    )
+    # a model-sharded kernel is split over 2 devices
+    kernel = placed["params"]["layer_0"]["q"]["kernel"]
+    assert len(kernel.sharding.device_set) == 2
+    # and the sharded dim halves per shard
+    shard_shape = kernel.sharding.shard_shape(kernel.shape)
+    assert shard_shape[1] == kernel.shape[1] // 2
