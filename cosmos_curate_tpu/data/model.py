@@ -144,6 +144,10 @@ class Clip:
     semantic_pass: bool | None = None
     windows: list[Window] = field(default_factory=list)
     webp_preview: bytes | None = None
+    # object tracks: list of tracks, each a list of per-frame dicts
+    # ({frame, x, y, w, h, score}); produced by the tracking stage
+    tracks: list[list[dict]] = field(default_factory=list)
+    annotated_mp4: bytes | None = None
     filtered_by: str = ""  # which filter removed this clip ("" = kept)
     errors: dict[str, str] = field(default_factory=dict)
 

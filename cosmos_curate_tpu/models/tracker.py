@@ -1,0 +1,145 @@
+"""Promptable object tracking on TPU.
+
+Equivalent capability of the reference's SAM3 tracking integration
+(cosmos_curate/models/sam3.py:41 + pipelines/video/tracking/ — promptable
+object tracking producing per-frame boxes/instances and annotated mp4s).
+Own TPU-first design rather than a SAM port: normalized cross-correlation
+template tracking where the WHOLE clip is tracked in one jitted
+``lax.scan`` over frames — the per-frame correlation is a conv on the MXU,
+there is no per-frame Python, and the search is windowed around the last
+position with an EMA-updated template (classic NCC/KCF-family technique,
+public). Quality is below a learned tracker; the pipeline surface (prompt
+box in, per-frame boxes out) is the same, and a learned model can drop in
+behind the identical stage interface.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TrackerConfig:
+    template_size: int = 32  # template patch edge (resized)
+    search_radius: int = 24  # pixels around last center searched
+    ema: float = 0.1  # template update rate
+    work_size: int = 128  # frames resized to work_size x work_size
+
+
+def _to_gray(frames_u8):
+    return frames_u8.astype(jnp.float32).mean(axis=-1) / 255.0
+
+
+def _normalize(patch):
+    mu = patch.mean()
+    sd = jnp.sqrt(jnp.maximum(patch.var(), 1e-8))
+    return (patch - mu) / sd
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "ts"))
+def _track_scan(frames_u8, box0, cfg: TrackerConfig, ts: int):
+    """frames_u8: [T, S, S, 3] (work size); box0: [4] (cx, cy, w, h) in
+    work coords; ts: template edge (static, derived from the prompt box so
+    small objects get small templates). Returns centers [T,2], scores [T]."""
+    gray = _to_gray(frames_u8)  # [T, S, S]
+    s = gray.shape[1]
+    r = min(cfg.search_radius, (s - ts) // 2)
+
+    cx0, cy0 = box0[0], box0[1]
+
+    def crop(img, cx, cy, size):
+        x0 = jnp.clip(cx - size // 2, 0, s - size).astype(jnp.int32)
+        y0 = jnp.clip(cy - size // 2, 0, s - size).astype(jnp.int32)
+        return jax.lax.dynamic_slice(img, (y0, x0), (size, size)), x0, y0
+
+    template0, tx0, ty0 = crop(gray[0], cx0, cy0, ts)
+    template0 = _normalize(template0)
+    # crop() clamps at image edges, so the template's center can differ from
+    # the prompted center; the target sits at this constant offset from
+    # every matched template center
+    delta = jnp.stack(
+        [cx0 - (tx0 + ts // 2), cy0 - (ty0 + ts // 2)]
+    ).astype(jnp.float32)
+
+    search_size = ts + 2 * r
+
+    def step(carry, frame):
+        template, cx, cy = carry
+        window, wx0, wy0 = crop(frame, cx, cy, search_size)
+        window = _normalize(window)
+        # NCC via conv: correlate template over the search window (MXU path)
+        corr = jax.lax.conv_general_dilated(
+            window[None, None],
+            template[None, None],
+            window_strides=(1, 1),
+            padding="VALID",
+        )[0, 0]  # [2r+1, 2r+1]
+        idx = jnp.argmax(corr)
+        dy, dx = jnp.unravel_index(idx, corr.shape)
+        score = corr.reshape(-1)[idx] / (ts * ts)
+        ncx = wx0 + dx + ts // 2
+        ncy = wy0 + dy + ts // 2
+        new_patch, _, _ = crop(frame, ncx, ncy, ts)
+        new_template = _normalize(
+            (1.0 - cfg.ema) * template + cfg.ema * _normalize(new_patch)
+        )
+        return (new_template, ncx, ncy), (jnp.stack([ncx, ncy]), score)
+
+    (_, _, _), (centers, scores) = jax.lax.scan(
+        step, (template0, cx0.astype(jnp.int32), cy0.astype(jnp.int32)), gray
+    )
+    return centers.astype(jnp.float32) + delta[None, :], scores
+
+
+class TemplateTracker:
+    """Track a prompted box through a clip; host-facing wrapper."""
+
+    def __init__(self, cfg: TrackerConfig = TrackerConfig()) -> None:
+        self.cfg = cfg
+
+    def track(
+        self, frames: np.ndarray, box_xywh: tuple[float, float, float, float]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """frames: uint8 [T, H, W, 3]; box: (x, y, w, h) in pixels of the
+        FIRST frame. Returns (boxes [T, 4] xywh in original coords,
+        scores [T])."""
+        import cv2
+
+        t, h, w = frames.shape[:3]
+        size = self.cfg.work_size
+        small = np.stack(
+            [cv2.resize(f, (size, size), interpolation=cv2.INTER_AREA) for f in frames]
+        )
+        sx, sy = size / w, size / h
+        x, y, bw, bh = box_xywh
+        box0 = jnp.asarray(
+            [(x + bw / 2) * sx, (y + bh / 2) * sy, bw * sx, bh * sy], jnp.float32
+        )
+        # template edge = 2x the scaled prompt extent (context margin: an
+        # exact-extent template over a uniform object has ~zero variance and
+        # NCC degenerates), pow2 so few template sizes compile
+        extent = max(8.0, 2.0 * max(bw * sx, bh * sy))
+        ts = min(1 << int(np.ceil(np.log2(extent))), size // 2)
+        # pad T to a pow2 bucket: per-clip frame counts must not each cost
+        # an XLA compile (padded tail repeats the last frame, sliced off)
+        from cosmos_curate_tpu.models.batching import pad_batch
+
+        padded, _ = pad_batch(small)
+        centers, scores = _track_scan(padded, box0, self.cfg, ts)
+        centers = np.asarray(centers, np.float32)[:t]
+        scores = np.asarray(scores)[:t]
+        boxes = np.stack(
+            [
+                centers[:, 0] / sx - bw / 2,
+                centers[:, 1] / sy - bh / 2,
+                np.full(t, bw, np.float32),
+                np.full(t, bh, np.float32),
+            ],
+            axis=1,
+        )
+        return boxes, np.asarray(scores)

@@ -64,6 +64,8 @@ class SplitPipelineArgs:
     enhance_captions: bool = False
     t5_embeddings: bool = False
     previews: bool = False
+    tracking: bool = False
+    tracking_annotated: bool = False
     # execution
     num_chips: int = 0  # 0 = discover
     perf_profile: bool = False
@@ -171,6 +173,10 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         from cosmos_curate_tpu.pipelines.video.stages.preview import PreviewStage
 
         stages.append(PreviewStage(extraction=primary_sig))
+    if args.tracking:
+        from cosmos_curate_tpu.pipelines.video.stages.tracking import TrackingStage
+
+        stages.append(TrackingStage(write_annotated=args.tracking_annotated))
     stages.extend(args.extra_stages)
     stages.append(ClipWriterStage(args.output_path))
     return stages

@@ -49,6 +49,7 @@ def _clip_meta(clip: Clip) -> dict:
         "semantic_pass": clip.semantic_pass,
         "filtered_by": clip.filtered_by,
         "embedding_models": sorted(clip.embeddings),
+        "tracks": clip.tracks,
         "windows": [
             {
                 "start_frame": w.start_frame,
@@ -101,6 +102,7 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             for clip in (*video.clips, *video.filtered_clips):
                 clip.encoded_data = None
                 clip.webp_preview = None
+                clip.annotated_mp4 = None
                 clip.release_frames()
                 for w in clip.windows:
                     w.release_payloads()
@@ -119,6 +121,10 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
         if clip.webp_preview and self.write_previews:
             write_bytes(f"{self.output_path}/previews/{clip.uuid}.webp", clip.webp_preview)
             stats.num_with_webp += 1
+        if clip.annotated_mp4:
+            write_bytes(
+                f"{self.output_path}/tracking/{clip.uuid}.mp4", clip.annotated_mp4
+            )
         for model, emb in clip.embeddings.items():
             embedding_rows[model].append((str(clip.uuid), emb))
         if clip.embeddings:
