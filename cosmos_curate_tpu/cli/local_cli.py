@@ -39,6 +39,7 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--previews", action="store_true")
     split.add_argument("--tracking", action="store_true")
     split.add_argument("--tracking-annotated", action="store_true")
+    split.add_argument("--per-event-captions", action="store_true")
     split.add_argument("--text-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--semantic-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--clip-chunk-size", type=int, default=64)
@@ -199,6 +200,7 @@ def _cmd_split(args: argparse.Namespace) -> int:
             previews=args.previews,
             tracking=args.tracking or args.tracking_annotated,  # annotated implies tracking
             tracking_annotated=args.tracking_annotated,
+            per_event_captions=args.per_event_captions,
             text_filter=args.text_filter,
             semantic_filter=args.semantic_filter,
             clip_chunk_size=args.clip_chunk_size,

@@ -147,6 +147,7 @@ class Clip:
     # object tracks: list of tracks, each a list of per-frame dicts
     # ({frame, x, y, w, h, score}); produced by the tracking stage
     tracks: list[list[dict]] = field(default_factory=list)
+    event_captions: list[str] = field(default_factory=list)  # parallel to tracks
     annotated_mp4: bytes | None = None
     filtered_by: str = ""  # which filter removed this clip ("" = kept)
     errors: dict[str, str] = field(default_factory=dict)

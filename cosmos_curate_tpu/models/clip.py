@@ -26,9 +26,15 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
 from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_L_14, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
 
+import dataclasses
+
+# The clip-vit-* registry slots hold OpenAI-CLIP-converted checkpoints
+# (models/convert_hf.py), so their configs MUST carry CLIP's activation and
+# layer-norm eps — a staged real checkpoint under plain gelu/1e-6 would run
+# silently wrong.
 _CONFIGS: dict[str, ViTConfig] = {
-    "clip-vit-l14-tpu": VIT_L_14,
-    "clip-vit-b16-tpu": VIT_B_16,
+    "clip-vit-l14-tpu": dataclasses.replace(VIT_L_14, act="quick_gelu", ln_eps=1e-5),
+    "clip-vit-b16-tpu": dataclasses.replace(VIT_B_16, act="quick_gelu", ln_eps=1e-5),
     "clip-vit-tiny-test": VIT_TINY_TEST,
 }
 

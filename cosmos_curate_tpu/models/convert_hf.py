@@ -1,0 +1,96 @@
+"""HF checkpoint converters: real pretrained weights → our Flax layouts.
+
+Equivalent capability of the reference's weight flow (HF → cloud cache →
+local dir, cosmos_curate/core/utils/model/model_utils.py:596-700): where the
+reference loads HF checkpoints directly into torch modules, our models are
+independent Flax architectures, so conversion is an explicit weight-layout
+mapping. ``convert_clip_vision`` covers CLIP-family vision towers
+(openai/clip-vit-*-patch*); converted checkpoints are staged via
+``models/registry.py::save_params`` and the matching ``ViTConfig`` must use
+``act="quick_gelu", ln_eps=1e-5``.
+
+Architecture parity is proven by test (tests/models/test_convert_hf.py): a
+randomly initialized HF CLIP vision model and our ViT with converted
+weights produce matching embeddings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.models.vit import ViTConfig
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def clip_vision_config(hf_config) -> ViTConfig:
+    """ViTConfig matching an HF CLIPVisionConfig; fails fast on shapes or
+    activations our ViT cannot represent (silent mismatch would surface as
+    a confusing flax shape error — or worse, wrong numerics — at load)."""
+    if hf_config.intermediate_size != 4 * hf_config.hidden_size:
+        raise ValueError(
+            f"unsupported MLP ratio: intermediate {hf_config.intermediate_size} "
+            f"!= 4 x hidden {hf_config.hidden_size}"
+        )
+    if hf_config.hidden_act not in ("gelu", "quick_gelu"):
+        raise ValueError(f"unsupported activation {hf_config.hidden_act!r}")
+    return ViTConfig(
+        image_size=hf_config.image_size,
+        patch_size=hf_config.patch_size,
+        width=hf_config.hidden_size,
+        layers=hf_config.num_hidden_layers,
+        heads=hf_config.num_attention_heads,
+        projection_dim=hf_config.projection_dim,
+        act=hf_config.hidden_act,
+        ln_eps=hf_config.layer_norm_eps,
+    )
+
+
+def _t(w) -> np.ndarray:
+    return np.asarray(w.detach().cpu().numpy() if hasattr(w, "detach") else w)
+
+
+def convert_clip_vision(hf_model) -> dict:
+    """transformers CLIPVisionModelWithProjection → our ViT params tree."""
+    sd = {k: _t(v) for k, v in hf_model.state_dict().items()}
+    v = "vision_model."
+    params: dict = {}
+    # patchify conv: torch [out, in, kh, kw] -> flax [kh, kw, in, out]
+    params["patch_embed"] = {
+        "kernel": sd[f"{v}embeddings.patch_embedding.weight"].transpose(2, 3, 1, 0)
+    }
+    params["cls"] = sd[f"{v}embeddings.class_embedding"][None, None, :]
+    params["pos_embed"] = sd[f"{v}embeddings.position_embedding.weight"][None]
+    params["ln_pre"] = {
+        "scale": sd[f"{v}pre_layrnorm.weight"],  # (sic — HF's own key name)
+        "bias": sd[f"{v}pre_layrnorm.bias"],
+    }
+    params["ln_post"] = {
+        "scale": sd[f"{v}post_layernorm.weight"],
+        "bias": sd[f"{v}post_layernorm.bias"],
+    }
+    n_layers = hf_model.config.num_hidden_layers
+    for i in range(n_layers):
+        e = f"{v}encoder.layers.{i}."
+
+        def lin(name):  # torch Linear [out, in] -> flax kernel [in, out]
+            return {
+                "kernel": sd[f"{e}{name}.weight"].T,
+                "bias": sd[f"{e}{name}.bias"],
+            }
+
+        params[f"block_{i}"] = {
+            "ln1": {"scale": sd[f"{e}layer_norm1.weight"], "bias": sd[f"{e}layer_norm1.bias"]},
+            "ln2": {"scale": sd[f"{e}layer_norm2.weight"], "bias": sd[f"{e}layer_norm2.bias"]},
+            "attn": {
+                "q": lin("self_attn.q_proj"),
+                "k": lin("self_attn.k_proj"),
+                "v": lin("self_attn.v_proj"),
+                "out": lin("self_attn.out_proj"),
+            },
+            "mlp": {"up": lin("mlp.fc1"), "down": lin("mlp.fc2")},
+        }
+    params["proj"] = {"kernel": sd["visual_projection.weight"].T}
+    logger.info("converted CLIP vision tower: %d layers", n_layers)
+    return {"params": params}

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
@@ -108,16 +109,24 @@ class Attention(nn.Module):
         return dense(x.shape[-1], "in", name="out", dtype=self.dtype)(out)
 
 
+def quick_gelu(x):
+    """OpenAI CLIP's activation: x * sigmoid(1.702 x)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+_ACTIVATIONS: dict[str, Callable] = {"gelu": nn.gelu, "quick_gelu": quick_gelu}
+
+
 class MlpBlock(nn.Module):
     hidden_mult: float = 4.0
     dtype: Dtype = jnp.bfloat16
-    act: Callable = nn.gelu
+    act: str = "gelu"
 
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
         h = dense(int(d * self.hidden_mult), "out", name="up", dtype=self.dtype)(x)
-        h = self.act(h)
+        h = _ACTIVATIONS[self.act](h)
         return dense(d, "in", name="down", dtype=self.dtype)(h)
 
 
@@ -127,13 +136,15 @@ class TransformerBlock(nn.Module):
     hidden_mult: float = 4.0
     dtype: Dtype = jnp.bfloat16
     causal: bool = False
+    act: str = "gelu"
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x, mask=None):
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        y = nn.LayerNorm(dtype=jnp.float32, epsilon=self.ln_eps, name="ln1")(x)
         x = x + Attention(
             self.num_heads, self.head_dim, dtype=self.dtype, causal=self.causal, name="attn"
         )(y, mask)
-        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        x = x + MlpBlock(self.hidden_mult, dtype=self.dtype, name="mlp")(y)
+        y = nn.LayerNorm(dtype=jnp.float32, epsilon=self.ln_eps, name="ln2")(x)
+        x = x + MlpBlock(self.hidden_mult, dtype=self.dtype, act=self.act, name="mlp")(y)
         return x

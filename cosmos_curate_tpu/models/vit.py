@@ -25,6 +25,10 @@ class ViTConfig:
     layers: int = 24
     heads: int = 16
     projection_dim: int = 768
+    # "gelu" | "quick_gelu" — OpenAI CLIP checkpoints use quick_gelu; set it
+    # when loading converted HF weights (models/convert_hf.py)
+    act: str = "gelu"
+    ln_eps: float = 1e-6  # 1e-5 for HF-converted checkpoints
 
     @property
     def head_dim(self) -> int:
@@ -68,12 +72,17 @@ class ViT(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (1, cfg.num_patches + 1, w), jnp.float32
         )
         x = x + pos.astype(self.dtype)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_pre")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_pre")(x)
         for i in range(cfg.layers):
             x = TransformerBlock(
-                cfg.heads, cfg.head_dim, dtype=self.dtype, name=f"block_{i}"
+                cfg.heads,
+                cfg.head_dim,
+                dtype=self.dtype,
+                act=cfg.act,
+                ln_eps=cfg.ln_eps,
+                name=f"block_{i}",
             )(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_post")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_post")(x)
         pooled = dense(cfg.projection_dim, None, name="proj", use_bias=False, dtype=self.dtype)(
             x[:, 0]
         )

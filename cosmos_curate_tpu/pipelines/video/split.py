@@ -66,6 +66,7 @@ class SplitPipelineArgs:
     previews: bool = False
     tracking: bool = False
     tracking_annotated: bool = False
+    per_event_captions: bool = False  # implies tracking
     # execution
     num_chips: int = 0  # 0 = discover
     perf_profile: bool = False
@@ -173,10 +174,16 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         from cosmos_curate_tpu.pipelines.video.stages.preview import PreviewStage
 
         stages.append(PreviewStage(extraction=primary_sig))
-    if args.tracking:
+    if args.tracking or args.per_event_captions:
         from cosmos_curate_tpu.pipelines.video.stages.tracking import TrackingStage
 
         stages.append(TrackingStage(write_annotated=args.tracking_annotated))
+    if args.per_event_captions:
+        from cosmos_curate_tpu.pipelines.video.stages.per_event_caption import (
+            PerEventCaptionStage,
+        )
+
+        stages.append(PerEventCaptionStage())
     stages.extend(args.extra_stages)
     stages.append(ClipWriterStage(args.output_path))
     return stages

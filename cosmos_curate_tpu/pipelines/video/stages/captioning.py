@@ -82,6 +82,12 @@ class CaptionPrepStage(Stage[SplitPipeTask, SplitPipeTask]):
         return tasks
 
 
+# One engine per (config, batch) per process: several caption-family stages
+# (captioning, enhancement, semantic filter, per-event) in one pipeline must
+# share weights + KV cache instead of loading the VLM repeatedly.
+_ENGINES: dict[tuple, CaptionEngine] = {}
+
+
 class _CaptionVLM(ModelInterface):
     MODEL_ID = "caption-vlm-tpu"
 
@@ -95,13 +101,17 @@ class _CaptionVLM(ModelInterface):
         return [self.MODEL_ID]
 
     def setup(self) -> None:
-        engine = CaptionEngine(self.cfg, max_batch=self.max_batch)
-        engine.setup()
+        key = (self.cfg, self.max_batch)
+        engine = _ENGINES.get(key)
+        if engine is None:
+            engine = CaptionEngine(self.cfg, max_batch=self.max_batch)
+            engine.setup()
 
-        def init(seed: int):
-            return engine.params
+            def init(seed: int):
+                return engine.params
 
-        engine.params = registry.load_params(self.MODEL_ID, init)
+            engine.params = registry.load_params(self.MODEL_ID, init)
+            _ENGINES[key] = engine
         self.engine = engine
 
 

@@ -1,0 +1,125 @@
+"""Per-event captioning: describe each tracked object, not the whole clip.
+
+Equivalent capability of the reference's ``PerEventCaptionStage``
+(cosmos_curate/pipelines/video/captioning/per_event_caption_stage.py:156 —
+VLM captioning over SAM3 tracking outputs). Consumes ``Clip.tracks`` from
+the tracking stage: the tracked region is cropped (with margin) across
+sampled frames and captioned through the shared engine; results land in
+``Clip.event_captions`` parallel to ``tracks``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
+from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
+from cosmos_curate_tpu.utils.logging import get_logger
+from cosmos_curate_tpu.video.decode import decode_frames
+
+logger = get_logger(__name__)
+
+EVENT_PROMPT = "Describe the object in this video and what it is doing."
+
+
+def crop_track(
+    frames: np.ndarray,
+    track: list[dict],
+    *,
+    num_frames: int = 4,
+    margin: float = 0.5,
+    out_size: int = 224,
+) -> np.ndarray:
+    """Crop the tracked box (with margin) at uniformly sampled track points,
+    resized to a FIXED ``out_size`` on host — variable crop shapes would
+    recompile the jitted vision encoder once per distinct box size."""
+    import cv2
+
+    t, h, w = frames.shape[:3]
+    idx = np.linspace(0, len(track) - 1, num_frames).round().astype(int)
+    bw = max(p["w"] for p in track)
+    bh = max(p["h"] for p in track)
+    cw = max(8, min(w, int(bw * (1 + 2 * margin))))
+    ch = max(8, min(h, int(bh * (1 + 2 * margin))))
+    out = np.zeros((num_frames, out_size, out_size, 3), np.uint8)
+    for n, i in enumerate(idx):
+        p = track[i]
+        fi = min(int(p["frame"]), t - 1)
+        cx, cy = p["x"] + p["w"] / 2, p["y"] + p["h"] / 2
+        x0 = int(np.clip(cx - cw / 2, 0, w - cw))
+        y0 = int(np.clip(cy - ch / 2, 0, h - ch))
+        crop = frames[fi, y0 : y0 + ch, x0 : x0 + cw]
+        out[n] = cv2.resize(crop, (out_size, out_size), interpolation=cv2.INTER_AREA)
+    return out
+
+
+class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
+    def __init__(
+        self,
+        *,
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        max_new_tokens: int = 64,
+        frames_per_event: int = 4,
+    ) -> None:
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.max_new_tokens = max_new_tokens
+        self.frames_per_event = frames_per_event
+        self.tokenizer = ByteTokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        targets: dict[str, tuple] = {}
+        for task in tasks:
+            for clip in task.video.clips:
+                if not clip.tracks or clip.encoded_data is None:
+                    continue
+                try:
+                    frames = decode_frames(clip.encoded_data)
+                except Exception as e:
+                    clip.errors["per_event_caption"] = str(e)
+                    continue
+                if frames.shape[0] == 0:
+                    continue
+                # parallel-array contract: same length as tracks even when
+                # some requests fail
+                clip.event_captions = [""] * len(clip.tracks)
+                for k, track in enumerate(clip.tracks):
+                    rid = f"{clip.uuid}-ev{k}"
+                    crops = crop_track(
+                        frames,
+                        track,
+                        num_frames=self.frames_per_event,
+                        out_size=self._model.cfg.vision.image_size,
+                    )
+                    targets[rid] = (clip, k)
+                    engine.add_request(
+                        CaptionRequest(
+                            request_id=rid,
+                            prompt_ids=self.tokenizer.encode(EVENT_PROMPT),
+                            frames=crops,
+                            sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
+                        )
+                    )
+        if not targets:
+            return tasks
+        for res in engine.run_until_complete():
+            hit = targets.get(res.request_id)
+            if hit is None:
+                continue
+            clip, k = hit
+            clip.event_captions[k] = res.text
+        return tasks

@@ -50,6 +50,7 @@ def _clip_meta(clip: Clip) -> dict:
         "filtered_by": clip.filtered_by,
         "embedding_models": sorted(clip.embeddings),
         "tracks": clip.tracks,
+        "event_captions": clip.event_captions,
         "windows": [
             {
                 "start_frame": w.start_frame,

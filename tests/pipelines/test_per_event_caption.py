@@ -1,0 +1,41 @@
+import numpy as np
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+from cosmos_curate_tpu.models.vlm import VLM_TINY_TEST
+from cosmos_curate_tpu.pipelines.video.stages.per_event_caption import (
+    PerEventCaptionStage,
+    crop_track,
+)
+from cosmos_curate_tpu.pipelines.video.stages.tracking import TrackingStage
+from cosmos_curate_tpu.video.encode import encode_frames
+from tests.pipelines.test_tracking import _moving_box_frames
+
+
+def test_crop_track_geometry():
+    frames = np.zeros((10, 100, 200, 3), np.uint8)
+    frames[:, 40:60, 80:120] = 255
+    track = [{"frame": i, "x": 80.0, "y": 40.0, "w": 40.0, "h": 20.0, "score": 1.0} for i in range(10)]
+    crops = crop_track(frames, track, num_frames=3, margin=0.5)
+    assert crops.shape[0] == 3
+    # the object (white) dominates the crop center
+    assert crops[0][crops.shape[1] // 2, crops.shape[2] // 2].max() == 255
+
+
+def test_track_then_event_caption():
+    frames, *_ = _moving_box_frames(t=12)
+    clip = Clip(encoded_data=encode_frames(frames, fps=12.0))
+    task = SplitPipeTask(video=Video(path="v.mp4", clips=[clip]))
+    out = run_pipeline(
+        [task],
+        [
+            TrackingStage(),
+            PerEventCaptionStage(cfg=VLM_TINY_TEST, max_batch=2, max_new_tokens=6),
+        ],
+        runner=SequentialRunner(),
+    )
+    c = out[0].video.clips[0]
+    assert len(c.tracks) == 1
+    assert len(c.event_captions) == 1
+    assert isinstance(c.event_captions[0], str)
