@@ -117,9 +117,16 @@ def main() -> int:
         extract_resize_hw=(224, 224),
         embedding_model="video",
     )
-    log("bench: running split+annotate")
+    # The streaming engine wins when decode can fan out across cores; on a
+    # 1-2 core box its worker-spawn overhead dominates, so fall back to the
+    # in-process runner there. BENCH_RUNNER=sequential|engine overrides.
+    choice = os.environ.get("BENCH_RUNNER", "auto")
+    cores = os.cpu_count() or 1
+    use_engine = choice == "engine" or (choice == "auto" and cores >= 4)
+    runner = None if use_engine else SequentialRunner()
+    log(f"bench: running split+annotate ({'engine' if use_engine else 'sequential'}, {cores} cores)")
     t0 = time.monotonic()
-    summary = run_split(args, runner=SequentialRunner())
+    summary = run_split(args, runner=runner)
     elapsed = time.monotonic() - t0
 
     clips = summary["num_clips"]
