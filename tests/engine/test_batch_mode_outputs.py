@@ -1,0 +1,65 @@
+"""Regression: BATCH mode must feed intermediate outputs forward even when
+return_last_stage_outputs=False (side-effecting final stages relied on it)."""
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import (
+    ExecutionMode,
+    PipelineConfig,
+    StreamingSpec,
+    run_pipeline,
+)
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+
+@dataclass
+class Item(PipelineTask):
+    value: int = 0
+
+
+class Inc(Stage):
+    @property
+    def resources(self):
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks):
+        return [Item(value=t.value + 1) for t in tasks]
+
+
+class WriteOut(Stage):
+    """Side-effecting terminal stage (stand-in for ClipWriterStage)."""
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+
+    @property
+    def resources(self):
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks):
+        for t in tasks:
+            Path(self.out_dir, f"v{t.value}.txt").write_text(str(t.value))
+        return tasks
+
+
+@pytest.mark.slow
+def test_batch_mode_without_returned_outputs_still_writes(tmp_path):
+    cfg = PipelineConfig(
+        execution_mode=ExecutionMode.BATCH,
+        return_last_stage_outputs=False,
+        streaming=StreamingSpec(autoscale_interval_s=3600.0, max_queued_lower_bound=4),
+    )
+    out = run_pipeline(
+        [Item(value=i) for i in range(3)],
+        [StageSpec(Inc(), num_workers=1), StageSpec(WriteOut(str(tmp_path)), num_workers=1)],
+        config=cfg,
+        runner=StreamingRunner(),
+    )
+    assert out is None  # flag honored for the caller
+    written = sorted(p.name for p in tmp_path.glob("v*.txt"))
+    assert written == ["v1.txt", "v2.txt", "v3.txt"]  # side effects happened
