@@ -61,7 +61,13 @@ def node_rank_and_count() -> tuple[int, int]:
 def partition_tasks_for_node(tasks: list) -> list:
     """Deterministic task partition across nodes (host-level data
     parallelism): node i takes every num_nodes-th task. Single-node runs
-    return the list unchanged."""
+    return the list unchanged.
+
+    Partitioning happens after resume filtering, so if nodes run at
+    DIFFERENT times (not a simultaneous srun step) an item can fall between
+    partitions for one run; it is picked up by the next run (verified:
+    repeated runs converge to full coverage). Simultaneous nodes see the
+    same discovery list and split it exactly."""
     rank, num = node_rank_and_count()
     if num <= 1:
         return tasks
