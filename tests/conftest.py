@@ -5,8 +5,10 @@ hardware, mirroring how the driver dry-runs ``dryrun_multichip``."""
 import os
 import sys
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax is imported anywhere. Forced (not setdefault): the
+# outer environment may carry JAX_PLATFORMS pointing at hardware plugins
+# that are absent or unhealthy under pytest.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
