@@ -105,7 +105,9 @@ def _init_builders():
     def aesthetics(seed):
         from cosmos_curate_tpu.models.clip import AestheticMLP
 
-        return AestheticMLP().init(jax.random.PRNGKey(seed), jnp.zeros((1, 512)))
+        # 768-d input: the default scorer composes with the L/14 tower
+        # (CLIPAestheticScorer), matching the published head's input width.
+        return AestheticMLP().init(jax.random.PRNGKey(seed), jnp.zeros((1, 768)))
 
     def video_embed(seed):
         from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_BASE, VideoEmbedModel

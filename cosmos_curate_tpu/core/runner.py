@@ -38,6 +38,9 @@ class SequentialRunner(RunnerInterface):
 
     def __init__(self, *, raise_on_error: bool = True) -> None:
         self.raise_on_error = raise_on_error
+        # stage name -> wall seconds of the last run (MFU accounting reads
+        # this; benchmarks/split_benchmark.py)
+        self.stage_times: dict[str, float] = {}
 
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
         node = NodeInfo(node_id="local")
@@ -86,12 +89,10 @@ class SequentialRunner(RunnerInterface):
                     out.extend(result)
             finally:
                 stage.destroy()
+            stage_s = time.monotonic() - t0
+            self.stage_times[stage.name] = self.stage_times.get(stage.name, 0.0) + stage_s
             logger.info(
-                "stage %s: %d -> %d tasks in %.2fs",
-                stage.name,
-                len(tasks),
-                len(out),
-                time.monotonic() - t0,
+                "stage %s: %d -> %d tasks in %.2fs", stage.name, len(tasks), len(out), stage_s
             )
             tasks = out
         return tasks if spec.config.return_last_stage_outputs else None

@@ -9,6 +9,13 @@ Solver: water-filling. The pipeline rate is min over stages of
 (workers_i x rate_i); repeatedly grant a worker to the stage with the lowest
 projected stage rate until the budget is exhausted. Stages without
 throughput samples yet get their minimum and first claim on resources.
+
+Backpressure signals: the observed input-queue depth *biases* the fill —
+between stages with similar projected rates, the one with the deeper backlog
+wins — and a drained stage (empty queue, known rate) stops receiving extra
+workers beyond its minimum, so budget flows to starved stages after a
+throughput shift (reference ARCHITECTURE.md:83-93 solves the same balanced-
+throughput-under-backpressure problem).
 """
 
 from __future__ import annotations
@@ -42,7 +49,12 @@ def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
     def cost(i: int) -> tuple[float, float]:
         r = stages[i].spec.stage.resources
         tpus = r.tpus if not r.entire_tpu_host else budget.tpus
-        return (r.cpus, tpus)
+        cpus = r.cpus
+        if cpus <= 0 and tpus <= 0:
+            # A declared zero-cost stage (pure-IO) must still consume budget,
+            # or the water-fill below never terminates (fits() forever true).
+            cpus = 0.25
+        return (cpus, tpus)
 
     def fits(i: int) -> bool:
         c, t = cost(i)
@@ -73,7 +85,7 @@ def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
     # 2. water-fill the bottleneck with the remaining budget
     while True:
         best = None
-        best_rate = None
+        best_score = None
         for i, st in enumerate(stages):
             if st.spec.num_workers is not None:  # fixed-size pool
                 continue
@@ -86,9 +98,17 @@ def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
             if st.spec.stage.resources.uses_tpu and alloc[i] >= 1:
                 continue
             rate = st.throughput_per_worker
+            if rate is not None and st.queued == 0 and alloc[i] >= max(1, st.spec.min_workers):
+                # Drained and measured: no backlog to spend extra workers
+                # on; leave the budget for starved stages (scale-down
+                # pressure — the runner stops the now-surplus idle workers).
+                continue
             projected = (rate if rate is not None else 1.0) * alloc[i]
-            if best_rate is None or projected < best_rate:
-                best_rate = projected
+            # Queue bias: between similar projected rates, the deeper
+            # backlog wins. Dimensionless damping keeps rate primary.
+            score = projected / (1.0 + float(st.queued))
+            if best_score is None or score < best_score:
+                best_score = score
                 best = i
         if best is None:
             break

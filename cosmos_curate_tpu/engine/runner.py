@@ -59,6 +59,9 @@ class StreamingRunner(RunnerInterface):
     def __init__(self, *, metrics_port: int | None = None, poll_interval_s: float = 0.02) -> None:
         self.metrics = get_metrics(metrics_port)
         self.poll_interval_s = poll_interval_s
+        # stage name -> summed worker busy seconds (MFU accounting; the
+        # sequential runner exposes the same attribute with wall time)
+        self.stage_times: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
@@ -121,7 +124,7 @@ class StreamingRunner(RunnerInterface):
 
         batches: dict[int, _Batch] = {}
         next_batch_id = 0
-        outputs: list[object_store.ObjectRef] = []
+        outputs: list[PipelineTask] = []  # final-stage results, already materialized
         last_autoscale = time.monotonic()
         pending_setup_errors: list[str] = []
 
@@ -210,15 +213,8 @@ class StreamingRunner(RunnerInterface):
                     break
                 if not progressed:
                     time.sleep(self.poll_interval_s)
-            # materialize outputs
-            if cfg.return_last_stage_outputs:
-                result = [object_store.get(r) for r in outputs]
-            else:
-                result = None
-            return result
+            return outputs if cfg.return_last_stage_outputs else None
         finally:
-            for r in outputs:
-                store.release(r)
             for batch in batches.values():  # in-flight on exception exit
                 for r in batch.refs:
                     store.release(r)
@@ -276,6 +272,9 @@ class StreamingRunner(RunnerInterface):
             return
         st.completed += 1
         st.pool.record_sample(msg.process_time_s)
+        self.stage_times[st.spec.name] = (
+            self.stage_times.get(st.spec.name, 0.0) + msg.process_time_s
+        )
         self.metrics.observe_result(
             st.spec.name, msg.process_time_s, msg.deserialize_time_s, len(msg.out_refs)
         )
@@ -283,11 +282,18 @@ class StreamingRunner(RunnerInterface):
             store.release(r)
         nxt = batch.stage_idx + 1
         for r in msg.out_refs:
-            store.account(r)  # queue bounds + input gating provide backpressure
             if nxt < len(states):
+                store.account(r)  # queue bounds + input gating provide backpressure
                 states[nxt].in_queue.append(r)
             else:
-                outputs.append(r)
+                # Final-stage outputs must NOT enter the admission ledger:
+                # they are only freed at run end, so accounting them would
+                # eventually pin ``used`` above capacity, halt input seeding,
+                # and livelock the completion condition. Materialize now (if
+                # the caller wants them) and free the segment immediately.
+                if cfg.return_last_stage_outputs:
+                    outputs.append(object_store.get(r))
+                object_store.delete(r)
 
     _MAX_SETUP_DEATHS = 3
 

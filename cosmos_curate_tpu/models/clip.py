@@ -33,15 +33,22 @@ import dataclasses
 # layer-norm eps — a staged real checkpoint under plain gelu/1e-6 would run
 # silently wrong.
 _CONFIGS: dict[str, ViTConfig] = {
-    "clip-vit-l14-tpu": dataclasses.replace(VIT_L_14, act="quick_gelu", ln_eps=1e-5),
-    "clip-vit-b16-tpu": dataclasses.replace(VIT_B_16, act="quick_gelu", ln_eps=1e-5),
+    "clip-vit-l14-tpu": dataclasses.replace(
+        VIT_L_14, act="quick_gelu", ln_eps=1e-5, preprocess="clip"
+    ),
+    "clip-vit-b16-tpu": dataclasses.replace(
+        VIT_B_16, act="quick_gelu", ln_eps=1e-5, preprocess="clip"
+    ),
     "clip-vit-tiny-test": VIT_TINY_TEST,
 }
 
 
 class AestheticMLP(nn.Module):
-    """Score head over image embeddings (reference: ttj/sac-logos-ava1
-    linear-MSE MLP, models/aesthetics.py:30)."""
+    """Score head over image embeddings (reference: ttj/sac-logos-ava1-l14-
+    linearMSE, models/aesthetics.py:44-53). The checkpoint is a pure Linear
+    stack — Linear(768,1024)->...->Linear(16,1) with Dropout between (a
+    no-op at inference) and NO activations; adding ReLUs would make staged
+    real weights score incorrectly."""
 
     hidden: tuple[int, ...] = (1024, 128, 64, 16)
 
@@ -49,7 +56,7 @@ class AestheticMLP(nn.Module):
     def __call__(self, emb):
         x = emb.astype(jnp.float32)
         for i, h in enumerate(self.hidden):
-            x = nn.relu(nn.Dense(h, name=f"fc{i}")(x))
+            x = nn.Dense(h, name=f"fc{i}")(x)
         return nn.Dense(1, name="out")(x)[..., 0]
 
 
@@ -61,7 +68,7 @@ def _jitted_embed(cfg: ViTConfig):
 
     @jax.jit
     def embed(params, frames_u8):
-        pixels = preprocess_frames(frames_u8, image_size=size)
+        pixels = preprocess_frames(frames_u8, image_size=size, mode=cfg.preprocess)
         pooled, _ = model.apply(params, pixels)
         pooled = pooled.astype(jnp.float32)
         return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
@@ -94,7 +101,10 @@ class CLIPImageEmbeddings(ModelInterface):
 
         def init(seed: int):
             dummy = jnp.zeros((1, size, size, 3), jnp.uint8)
-            return model.init(jax.random.PRNGKey(seed), preprocess_frames(dummy, image_size=size))
+            return model.init(
+                jax.random.PRNGKey(seed),
+                preprocess_frames(dummy, image_size=size, mode=self.cfg.preprocess),
+            )
 
         self._params = registry.load_params(self.variant, init)
         self._apply = _jitted_embed(self.cfg)
@@ -141,9 +151,12 @@ class AestheticScorer(ModelInterface):
 
 
 class CLIPAestheticScorer(ModelInterface):
-    """Fused frames -> aesthetic score (reference clip_aesthetics.py:27)."""
+    """Fused frames -> aesthetic score (reference clip_aesthetics.py:27).
 
-    def __init__(self, variant: str = "clip-vit-b16-tpu") -> None:
+    Defaults to the L/14 tower: the reference aesthetic head is trained on
+    768-d CLIP-L embeddings (models/aesthetics.py:69)."""
+
+    def __init__(self, variant: str = "clip-vit-l14-tpu") -> None:
         self.clip = CLIPImageEmbeddings(variant)
         self.head = AestheticScorer(self.clip.embedding_dim)
 

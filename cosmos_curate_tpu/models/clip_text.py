@@ -1,0 +1,141 @@
+"""CLIP text tower: causal transformer + EOT pooling + projection.
+
+Equivalent capability of the reference's CLIP text encoding path
+(cosmos_curate/models/clip.py drives HF transformers CLIP; the text tower
+embeds queries/prompts into the shared image-text space). Our own Flax
+implementation over the shared ``TransformerBlock``; weights convert from
+HF ``CLIPTextModelWithProjection`` via ``models/convert_hf.convert_clip_text``
+with an exact parity test (tests/models/test_convert_hf.py).
+
+TPU-first: token + position embedding and the causal stack run in one jit;
+batches pad to power-of-two lengths (static shapes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.models.layers import TransformerBlock, dense
+
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab: int = 49408
+    width: int = 512
+    layers: int = 12
+    heads: int = 8
+    max_len: int = 77
+    projection_dim: int = 512
+    act: str = "quick_gelu"
+    ln_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.heads
+
+
+CLIP_TEXT_B = CLIPTextConfig()
+CLIP_TEXT_L = CLIPTextConfig(width=768, layers=12, heads=12, projection_dim=768)
+CLIP_TEXT_TINY_TEST = CLIPTextConfig(
+    vocab=64, width=32, layers=2, heads=2, max_len=16, projection_dim=16
+)
+
+
+class CLIPTextEncoder(nn.Module):
+    """ids [B, T] -> (pooled [B, P], tokens [B, T, W]).
+
+    Pooling follows CLIP: the feature at the EOT position, taken as
+    ``ids.argmax(-1)`` — the EOT token has the highest id in CLIP's BPE
+    vocab, so callers must append it (HF uses the same argmax rule)."""
+
+    cfg: CLIPTextConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab, cfg.width, param_dtype=jnp.float32, dtype=self.dtype, name="tok_embed"
+        )(ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.01), (1, cfg.max_len, cfg.width), jnp.float32
+        )
+        x = x + pos[:, : ids.shape[1]].astype(self.dtype)
+        for i in range(cfg.layers):
+            x = TransformerBlock(
+                cfg.heads,
+                cfg.head_dim,
+                dtype=self.dtype,
+                causal=True,
+                act=cfg.act,
+                ln_eps=cfg.ln_eps,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, epsilon=cfg.ln_eps, name="ln_final")(x)
+        eot = jnp.argmax(ids, axis=-1)
+        pooled = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        pooled = dense(
+            cfg.projection_dim, None, name="proj", use_bias=False, dtype=self.dtype
+        )(pooled)
+        return pooled.astype(jnp.float32), x
+
+
+class CLIPTextEmbeddings(ModelInterface):
+    """Batched token ids -> L2-normalized text embeddings."""
+
+    _CONFIGS = {
+        "clip-text-b-tpu": CLIP_TEXT_B,
+        "clip-text-l-tpu": CLIP_TEXT_L,
+        "clip-text-tiny-test": CLIP_TEXT_TINY_TEST,
+    }
+
+    def __init__(self, variant: str = "clip-text-b-tpu") -> None:
+        if variant not in self._CONFIGS:
+            raise ValueError(f"unknown variant {variant!r}; have {sorted(self._CONFIGS)}")
+        self.variant = variant
+        self.cfg = self._CONFIGS[variant]
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.variant]
+
+    @property
+    def embedding_dim(self) -> int:
+        return self.cfg.projection_dim
+
+    def setup(self) -> None:
+        model = CLIPTextEncoder(self.cfg)
+
+        def init(seed: int):
+            return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32))
+
+        self._params = registry.load_params(self.variant, init)
+
+        @jax.jit
+        def embed(params, ids):
+            pooled, _ = model.apply(params, ids)
+            return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+        self._apply = embed
+
+    def encode_ids(self, ids: np.ndarray) -> np.ndarray:
+        """int32 [N, T] (EOT appended, pad after) -> float32 [N, P]."""
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        from cosmos_curate_tpu.models.batching import pad_batch
+
+        padded, n = pad_batch(np.asarray(ids, np.int32))
+        return np.asarray(self._apply(self._params, padded))[:n]
+
+
+registry.register_model("clip-text-b-tpu", "CLIP text tower, ViT-B width (Flax)")
+registry.register_model("clip-text-l-tpu", "CLIP text tower, ViT-L width (Flax)")

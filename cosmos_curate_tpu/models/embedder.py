@@ -86,7 +86,9 @@ class VideoEmbedModel(nn.Module):
     def __call__(self, frames_u8):
         """frames_u8: uint8 [B, T, H, W, 3] -> [B, output_dim] normalized."""
         b, t = frames_u8.shape[:2]
-        pixels = preprocess_frames(frames_u8, image_size=self.cfg.vit.image_size)
+        pixels = preprocess_frames(
+            frames_u8, image_size=self.cfg.vit.image_size, mode=self.cfg.vit.preprocess
+        )
         pooled, _ = ViT(self.cfg.vit, name="vit")(pixels.reshape(b * t, *pixels.shape[2:]))
         feats = pooled.reshape(b, t, -1)
         emb = TemporalPooler(self.cfg, name="pooler")(feats).astype(jnp.float32)

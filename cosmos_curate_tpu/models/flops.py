@@ -1,0 +1,90 @@
+"""Analytic FLOPs accounting for MFU reporting.
+
+Equivalent capability of the reference's speed-of-light perf method
+(docs/curator/design/SPEED_OF_LIGHT.md:22-81 — tokens/s and pipeline
+efficiency vs hardware peak), translated to TPU: every model family gets an
+analytic forward-FLOPs formula, and ``mfu(flops, seconds)`` divides the
+achieved rate by the chip's bf16 peak. The formulas count matmul FLOPs only
+(2·M·N·K per GEMM) — elementwise/normalization work is bandwidth-, not
+FLOP-bound on TPU and is excluded, matching standard MFU conventions.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def transformer_layer_flops(tokens: int, width: int, *, mlp_ratio: int = 4) -> float:
+    """One pre-LN transformer block forward: QKVO projections + attention
+    score/value matmuls + 2-layer MLP."""
+    proj = 8.0 * tokens * width * width  # 4 projections, 2·T·W·W each
+    attn = 4.0 * tokens * tokens * width  # QK^T and attn·V
+    mlp = 2.0 * 2.0 * tokens * width * (mlp_ratio * width)
+    return proj + attn + mlp
+
+
+def vit_forward_flops(cfg) -> float:
+    """One image through models/vit.ViT (patch conv + blocks + projection)."""
+    n = cfg.num_patches + 1  # + cls token
+    patch = 2.0 * cfg.num_patches * (cfg.patch_size * cfg.patch_size * 3) * cfg.width
+    blocks = cfg.layers * transformer_layer_flops(n, cfg.width)
+    proj = 2.0 * cfg.width * cfg.projection_dim
+    return patch + blocks + proj
+
+
+def video_embed_forward_flops(cfg) -> float:
+    """One clip through models/embedder.VideoEmbedModel."""
+    frames = cfg.num_frames * vit_forward_flops(cfg.vit)
+    t = cfg.num_frames + 1  # + query token
+    d = cfg.vit.projection_dim
+    temporal = cfg.temporal_layers * transformer_layer_flops(t, d)
+    out = 2.0 * d * cfg.output_dim
+    return frames + temporal + out
+
+
+def vlm_decode_flops_per_token(cfg) -> float:
+    """One decode step for one sequence through models/vlm.VLM's LM stack.
+
+    Decode attention reads the whole KV cache: score/value matmuls are
+    T_cache·W each rather than T².  Uses max_seq as the cache bound (upper
+    estimate)."""
+    w = cfg.width
+    proj = 8.0 * w * w
+    attn = 4.0 * cfg.max_seq * w
+    mlp = 4.0 * w * (4 * w)
+    head = 2.0 * w * cfg.vocab_size
+    return cfg.layers * (proj + attn + mlp) + head
+
+
+# bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
+_TPU_PEAK = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+DEFAULT_PEAK = _TPU_PEAK["v5e"]
+
+
+def chip_peak_flops() -> float:
+    """Best-effort peak for the attached chip; BENCH_PEAK_FLOPS overrides."""
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+        for name, peak in _TPU_PEAK.items():
+            if name in kind:
+                return peak
+    except Exception:
+        pass
+    return DEFAULT_PEAK
+
+
+def mfu(total_flops: float, seconds: float, *, peak: float | None = None) -> float:
+    """Model FLOPs utilization: achieved FLOPs/s over chip peak."""
+    if seconds <= 0:
+        return 0.0
+    return (total_flops / seconds) / (peak or chip_peak_flops())

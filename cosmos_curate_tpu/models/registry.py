@@ -60,8 +60,22 @@ def weights_root() -> Path:
     return Path(os.environ.get(WEIGHTS_DIR_ENV, "/tmp/curate_model_weights"))
 
 
+# Weights committed with the framework itself (e.g. the synthetically
+# trained TransNetV2 checkpoint) — searched after the staging dir so a
+# staged real checkpoint always wins.
+REPO_WEIGHTS_DIR = Path(__file__).resolve().parent.parent.parent / "weights"
+
+
 def local_dir_for(model_id: str) -> Path:
     return weights_root() / model_id
+
+
+def find_checkpoint(model_id: str) -> Path | None:
+    for root in (weights_root(), REPO_WEIGHTS_DIR):
+        ckpt = root / model_id / "params.msgpack"
+        if ckpt.exists():
+            return ckpt
+    return None
 
 
 def stage_weights_on_node(model_ids: list[str]) -> None:
@@ -82,8 +96,8 @@ def load_params(
 
     Format: flax msgpack (``flax.serialization``) — synchronous and
     self-contained; the tree structure comes from ``init_fn``."""
-    ckpt = local_dir_for(model_id) / "params.msgpack"
-    if ckpt.exists():
+    ckpt = find_checkpoint(model_id)
+    if ckpt is not None:
         import flax.serialization
 
         logger.info("loading %s weights from %s", model_id, ckpt)
@@ -93,7 +107,7 @@ def load_params(
         "no staged weights for %s under %s — using seeded random init "
         "(stage a params.msgpack there for real inference)",
         model_id,
-        ckpt,
+        local_dir_for(model_id) / "params.msgpack",
     )
     return init_fn(seed)
 

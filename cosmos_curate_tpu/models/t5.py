@@ -1,11 +1,16 @@
-"""Text encoder for caption embeddings.
+"""T5 text encoder for caption embeddings.
 
 Equivalent capability of the reference's T5 encoder
 (cosmos_curate/models/t5_encoder.py:80 — google-t5/t5-11b encodes captions
 into per-token embeddings packaged as ``EncodedSample`` for webdataset /
-cosmos-predict training). Our own Flax encoder-only transformer (byte-level
-tokens, learned positions); the interface — captions in, padded per-token
-embeddings + mask out — matches what the dataset writers consume.
+cosmos-predict training). This is a faithful T5 encoder stack (public
+architecture: RMS layer norm, relative-position-bucket attention bias shared
+across layers, unscaled attention, bias-free projections), so real HF T5
+checkpoints convert exactly — ``models/convert_hf.convert_t5_encoder`` with
+a parity test (tests/models/test_convert_hf.py).
+
+TPU-first: one jitted forward over power-of-two padded batches; weight
+matrices carry Megatron TP annotations via ``models/layers.dense``.
 """
 
 from __future__ import annotations
@@ -20,21 +25,33 @@ import numpy as np
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
 from cosmos_curate_tpu.models.batching import pad_batch
-from cosmos_curate_tpu.models.layers import TransformerBlock
+from cosmos_curate_tpu.models.layers import dense
 from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
 
 
 @dataclass(frozen=True)
 class T5Config:
-    vocab: int = 512
+    vocab: int = 512  # byte-level default; converted checkpoints use 32128
     dim: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
     layers: int = 8
     heads: int = 8
     max_len: int = 512
+    num_buckets: int = 32
+    max_distance: int = 128
+    act: str = "relu"  # "relu" (t5 v1.0) | "gated-gelu" (v1.1 / flan)
+    ln_eps: float = 1e-6
 
 
 T5_BASE = T5Config()
-T5_TINY_TEST = T5Config(dim=32, layers=1, heads=2, max_len=64)
+# Real HF checkpoint shapes (google-t5/t5-small). To serve a converted
+# checkpoint, construct ``T5EncoderTPU(T5_SMALL, tokenizer=...)`` with a
+# SentencePiece-compatible tokenizer — the default ByteTokenizer's ids do
+# NOT correspond to T5's vocabulary, and the default T5_BASE tree will not
+# structure-match a converted t5-small msgpack.
+T5_SMALL = T5Config(vocab=32128, dim=512, d_kv=64, d_ff=2048, layers=6, heads=8)
+T5_TINY_TEST = T5Config(vocab=512, dim=32, d_kv=16, d_ff=64, layers=1, heads=2, max_len=64)
 
 
 @dataclass
@@ -47,28 +64,147 @@ class EncodedSample:
     mask: np.ndarray  # bool [T]
 
 
-class TextEncoder(nn.Module):
+class T5LayerNorm(nn.Module):
+    """RMS norm, weight-only, computed in f32 (T5 convention)."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        return (w * x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+
+
+def t5_relative_position_bucket(
+    relative_position, *, num_buckets: int = 32, max_distance: int = 128
+):
+    """Bidirectional T5 bucketing of (key_pos - query_pos) distances
+    (public algorithm, T5 paper / HF modeling_t5)."""
+    nb = num_buckets // 2
+    buckets = jnp.where(relative_position > 0, nb, 0)
+    rel = jnp.abs(relative_position)
+    max_exact = nb // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / float(np.log(max_distance / max_exact))
+        * (nb - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    return buckets + jnp.where(is_small, rel, large)
+
+
+class T5RelativeBias(nn.Module):
     cfg: T5Config
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int):
+        """-> [1, heads, q_len, k_len] attention bias."""
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(0.02),
+            (self.cfg.num_buckets, self.cfg.heads),
+            jnp.float32,
+        )
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = t5_relative_position_bucket(
+            mem - ctx,
+            num_buckets=self.cfg.num_buckets,
+            max_distance=self.cfg.max_distance,
+        )
+        return table[buckets].transpose(2, 0, 1)[None]
+
+
+class T5Attention(nn.Module):
+    """T5 self-attention: no QK scaling, no biases, additive position bias."""
+
+    cfg: T5Config
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.cfg
+        inner = cfg.heads * cfg.d_kv
+        b, s, _ = x.shape
+        q = dense(inner, "out", name="q", use_bias=False, dtype=self.dtype)(x)
+        k = dense(inner, "out", name="k", use_bias=False, dtype=self.dtype)(x)
+        v = dense(inner, "out", name="v", use_bias=False, dtype=self.dtype)(x)
+        q = q.reshape(b, s, cfg.heads, cfg.d_kv)
+        k = k.reshape(b, s, cfg.heads, cfg.d_kv)
+        v = v.reshape(b, s, cfg.heads, cfg.d_kv)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) + bias
+        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, inner)
+        return dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(out)
+
+
+class T5FF(nn.Module):
+    cfg: T5Config
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        if cfg.act == "gated-gelu":
+            g = nn.gelu(
+                dense(cfg.d_ff, "out", name="wi_0", use_bias=False, dtype=self.dtype)(x),
+                approximate=False,
+            )
+            h = g * dense(cfg.d_ff, "out", name="wi_1", use_bias=False, dtype=self.dtype)(x)
+        else:
+            h = nn.relu(
+                dense(cfg.d_ff, "out", name="wi", use_bias=False, dtype=self.dtype)(x)
+            )
+        return dense(cfg.dim, "in", name="wo", use_bias=False, dtype=self.dtype)(h)
+
+
+class T5EncoderBlock(nn.Module):
+    cfg: T5Config
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, bias):
+        y = T5LayerNorm(eps=self.cfg.ln_eps, name="ln1")(x)
+        x = x + T5Attention(self.cfg, dtype=self.dtype, name="attn")(y, bias)
+        y = T5LayerNorm(eps=self.cfg.ln_eps, name="ln2")(x)
+        x = x + T5FF(self.cfg, dtype=self.dtype, name="mlp")(y)
+        return x
+
+
+class T5Encoder(nn.Module):
+    """ids [B, T], mask [B, T] bool -> per-token embeddings [B, T, dim]."""
+
+    cfg: T5Config
+    dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, ids, mask):
         cfg = self.cfg
-        x = nn.Embed(cfg.vocab, cfg.dim, param_dtype=jnp.float32, dtype=jnp.bfloat16)(ids)
-        pos = self.param("pos", nn.initializers.normal(0.02), (1, cfg.max_len, cfg.dim), jnp.float32)
-        x = x + pos[:, : ids.shape[1]].astype(x.dtype)
-        attn_mask = (mask[:, None, None, :] & mask[:, None, :, None])
+        x = nn.Embed(
+            cfg.vocab, cfg.dim, param_dtype=jnp.float32, dtype=self.dtype, name="shared"
+        )(ids)
+        s = ids.shape[1]
+        bias = T5RelativeBias(cfg, name="rel_bias")(s, s)
+        # key-side padding mask, additive (HF's extended attention mask)
+        bias = bias + jnp.where(mask[:, None, None, :], 0.0, -1e9)
         for i in range(cfg.layers):
-            x = TransformerBlock(cfg.heads, cfg.dim // cfg.heads, name=f"b{i}")(x, attn_mask)
-        x = nn.LayerNorm(dtype=jnp.float32)(x)
+            x = T5EncoderBlock(cfg, dtype=self.dtype, name=f"block_{i}")(x, bias)
+        x = T5LayerNorm(eps=cfg.ln_eps, name="ln_final")(x)
         return x.astype(jnp.float32)
+
+
+# Backwards-compatible alias (the pre-T5-parity encoder class name).
+TextEncoder = T5Encoder
 
 
 class T5EncoderTPU(ModelInterface):
     MODEL_ID = "t5-encoder-tpu"
 
-    def __init__(self, cfg: T5Config = T5_BASE) -> None:
+    def __init__(self, cfg: T5Config = T5_BASE, *, tokenizer=None) -> None:
         self.cfg = cfg
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
         self._apply = None
         self._params = None
 
@@ -77,7 +213,7 @@ class T5EncoderTPU(ModelInterface):
         return [self.MODEL_ID]
 
     def setup(self) -> None:
-        model = TextEncoder(self.cfg)
+        model = T5Encoder(self.cfg)
 
         def init(seed: int):
             ids = jnp.zeros((1, 8), jnp.int32)

@@ -1,0 +1,166 @@
+"""Synthetic-data training for the TransNet shot detector.
+
+The reference ships pretrained TransNetV2 weights
+(cosmos_curate/models/transnetv2.py:530 loads the published checkpoint); this
+image has no network egress, so functional shot detection comes from
+training our own Flax DDCNN (models/transnetv2.py) on synthesized
+scene-cut clips: random per-scene texture generators (solid drift, panning
+gradients, moving shapes, noise) concatenated with hard cuts, labels 1 at
+transition frames. The trained checkpoint is staged through the registry
+(committed under ``weights/transnetv2-tpu/`` so every run loads it); staging
+a converted real checkpoint under $CURATE_MODEL_WEIGHTS_DIR still wins.
+
+TPU-first: one jitted train step (conv3d-heavy → MXU); data synthesis on
+host numpy, overlapped only trivially (the model is small).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.models.transnetv2 import INPUT_H, INPUT_W, TransNet, TransNetConfig
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _scene(rng: np.random.Generator, t: int, h: int, w: int) -> np.ndarray:
+    """One synthetic scene: [t, h, w, 3] uint8 with temporal coherence."""
+    kind = rng.integers(0, 4)
+    base = rng.integers(0, 256, 3).astype(np.float32)
+    out = np.empty((t, h, w, 3), np.float32)
+    if kind == 0:  # solid color with brightness drift
+        drift = rng.uniform(-1.5, 1.5)
+        for i in range(t):
+            out[i] = np.clip(base + drift * i, 0, 255)
+    elif kind == 1:  # panning linear gradient
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        angle = rng.uniform(0, 2 * np.pi)
+        grad = np.cos(angle) * xx / w + np.sin(angle) * yy / h
+        speed = rng.uniform(-0.05, 0.05)
+        for i in range(t):
+            g = (grad + speed * i) % 1.0
+            out[i] = base * 0.4 + g[..., None] * rng.uniform(80, 175)
+    elif kind == 2:  # moving rectangle on solid background
+        fg = rng.integers(0, 256, 3).astype(np.float32)
+        rw, rh = int(rng.integers(w // 6, w // 2)), int(rng.integers(h // 6, h // 2))
+        x0, y0 = rng.integers(0, w - rw), rng.integers(0, h - rh)
+        vx, vy = rng.uniform(-2, 2, 2)
+        for i in range(t):
+            out[i] = base
+            x = int(np.clip(x0 + vx * i, 0, w - rw))
+            y = int(np.clip(y0 + vy * i, 0, h - rh))
+            out[i, y : y + rh, x : x + rw] = fg
+    else:  # static texture + small per-frame noise
+        tex = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+        for i in range(t):
+            out[i] = np.clip(tex + rng.normal(0, 4, (h, w, 3)), 0, 255)
+    return out.astype(np.uint8)
+
+
+def synthesize_batch(
+    rng: np.random.Generator, batch: int, t: int, *, h: int = INPUT_H, w: int = INPUT_W
+) -> tuple[np.ndarray, np.ndarray]:
+    """-> (frames uint8 [B, T, h, w, 3], labels float32 [B, T]).
+
+    Label 1 marks the first frame of each new scene (the transition frame,
+    matching the published TransNetV2 target definition)."""
+    frames = np.empty((batch, t, h, w, 3), np.uint8)
+    labels = np.zeros((batch, t), np.float32)
+    for b in range(batch):
+        pos = 0
+        while pos < t:
+            scene_len = int(rng.integers(max(4, t // 8), max(8, t // 2)))
+            end = min(pos + scene_len, t)
+            frames[b, pos:end] = _scene(rng, end - pos, h, w)
+            if pos > 0:
+                labels[b, pos] = 1.0
+            pos = end
+    return frames, labels
+
+
+def train(
+    cfg: TransNetConfig = TransNetConfig(),
+    *,
+    steps: int = 600,
+    batch: int = 8,
+    window: int = 48,
+    lr: float = 1e-3,
+    pos_weight: float = 8.0,
+    seed: int = 0,
+    log_every: int = 100,
+):
+    """Train on synthetic cuts; returns (params, final_loss)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    model = TransNet(cfg)
+    rng = np.random.default_rng(seed)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, window, INPUT_H, INPUT_W, 3), jnp.uint8)
+    )
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, frames, labels):
+        def loss_fn(p):
+            logits = model.apply(p, frames)
+            per = optax.sigmoid_binary_cross_entropy(logits, labels)
+            weight = 1.0 + (pos_weight - 1.0) * labels
+            return (per * weight).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        frames, labels = synthesize_batch(rng, batch, window)
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(frames), jnp.asarray(labels))
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("transnet train step %d/%d loss %.4f", i + 1, steps, float(loss))
+    return params, float(loss) if loss is not None else float("nan")
+
+
+def train_and_stage(
+    cfg: TransNetConfig = TransNetConfig(),
+    *,
+    model_id: str = "transnetv2-tpu",
+    out_dir: str | None = None,
+    **train_kw,
+):
+    """Train and write params.msgpack into the registry location (or
+    ``out_dir`` — e.g. the repo's committed ``weights/`` tree)."""
+    import flax.serialization
+
+    from cosmos_curate_tpu.models import registry
+
+    params, loss = train(cfg, **train_kw)
+    if out_dir is not None:
+        from pathlib import Path
+
+        ckpt = Path(out_dir) / model_id / "params.msgpack"
+        ckpt.parent.mkdir(parents=True, exist_ok=True)
+        ckpt.write_bytes(flax.serialization.to_bytes(params))
+    else:
+        ckpt = registry.save_params(model_id, params)
+    logger.info("staged %s (final loss %.4f) at %s", model_id, loss, ckpt)
+    return ckpt, loss
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Train TransNet on synthetic scene cuts")
+    ap.add_argument("--steps", type=int, default=600)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--window", type=int, default=48)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None, help="e.g. <repo>/weights to commit the result")
+    a = ap.parse_args()
+    train_and_stage(
+        steps=a.steps, batch=a.batch, window=a.window, lr=a.lr, seed=a.seed, out_dir=a.out_dir
+    )

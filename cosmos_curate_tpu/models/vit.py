@@ -29,6 +29,10 @@ class ViTConfig:
     # when loading converted HF weights (models/convert_hf.py)
     act: str = "gelu"
     ln_eps: float = 1e-6  # 1e-5 for HF-converted checkpoints
+    # "simple" ([-1,1], full-image bilinear) | "clip" (CLIP mean/std,
+    # bicubic shortest-side + center crop — what converted CLIP checkpoints
+    # were trained with; reference cosmos_curate/models/clip.py:48-62)
+    preprocess: str = "simple"
 
     @property
     def head_dim(self) -> int:
@@ -89,12 +93,42 @@ class ViT(nn.Module):
         return pooled, x
 
 
-def preprocess_frames(frames, *, image_size: int):
-    """uint8 [..., H, W, 3] -> float [-1, 1] resized to (image_size,
-    image_size) with jax.image (device-side; avoids a CPU resize pass)."""
+# OpenAI CLIP training normalization (HF CLIPImageProcessor defaults).
+CLIP_IMAGE_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_IMAGE_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def preprocess_frames(frames, *, image_size: int, mode: str = "simple"):
+    """uint8 [..., H, W, 3] -> float model input, entirely device-side.
+
+    ``mode="simple"``: scale to [-1, 1] + full-image bilinear resize (the
+    from-scratch models' convention). ``mode="clip"``: CLIP's pipeline —
+    bicubic shortest-side resize, center crop, scale to [0, 1], per-channel
+    mean/std normalization — required for converted CLIP checkpoints
+    (reference cosmos_curate/models/clip.py:48-62). All shape math is static
+    at trace time, so both modes stay inside one jitted program.
+    """
     import jax
 
-    x = frames.astype(jnp.float32) / 127.5 - 1.0
+    x = frames.astype(jnp.float32)
+    if mode == "clip":
+        h, w = x.shape[-3], x.shape[-2]
+        batch_dims = x.shape[:-3]
+        x = x.reshape((-1, h, w, 3))
+        if (h, w) != (image_size, image_size):
+            scale = image_size / min(h, w)
+            nh = max(image_size, int(round(h * scale)))
+            nw = max(image_size, int(round(w * scale)))
+            x = jax.image.resize(x, (x.shape[0], nh, nw, 3), method="bicubic")
+            top = (nh - image_size) // 2
+            left = (nw - image_size) // 2
+            x = x[:, top : top + image_size, left : left + image_size, :]
+        x = x / 255.0
+        x = (x - jnp.asarray(CLIP_IMAGE_MEAN)) / jnp.asarray(CLIP_IMAGE_STD)
+        return x.reshape((*batch_dims, image_size, image_size, 3))
+    if mode != "simple":
+        raise ValueError(f"unknown preprocess mode {mode!r}")
+    x = x / 127.5 - 1.0
     if x.shape[-3] != image_size or x.shape[-2] != image_size:
         batch_dims = x.shape[:-3]
         x = x.reshape((-1, *x.shape[-3:]))

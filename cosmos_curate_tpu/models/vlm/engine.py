@@ -20,6 +20,7 @@ vllm_async_stage.py). TPU-first re-design:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -55,6 +56,9 @@ class CaptionRequest:
     # (two-stage caption refinement, reference vllm_interface.py:543)
     on_complete: Callable[[str], "CaptionRequest | None"] | None = None
     metadata: dict[str, Any] = field(default_factory=dict)
+    # set by add_request: which caller's run_until_complete owns this request
+    # (several caption-family stages share one engine; see run_until_complete)
+    owner: Any = None
 
 
 @dataclass
@@ -71,6 +75,7 @@ class CaptionResult:
     num_prompt_tokens: int
     num_output_tokens: int
     metadata: dict[str, Any] = field(default_factory=dict)
+    owner: Any = None
 
 
 class CaptionEngine:
@@ -93,6 +98,13 @@ class CaptionEngine:
         self._decode_tokens = 0
         self._decode_time = 0.0
         self._built = False
+        # One engine is shared by every caption-family stage in a pipeline
+        # (weights + KV cache are too big to duplicate). Stages run in
+        # separate pool threads, and the jitted prefill/decode donate the
+        # cache buffers — concurrent steps would be use-after-donate. This
+        # lock serializes all engine mutation; completions are owner-tagged
+        # so one stage's run cannot steal another stage's results.
+        self._lock = threading.RLock()
 
     # -- setup ----------------------------------------------------------
     def setup(self, seed: int = 0) -> None:
@@ -190,23 +202,48 @@ class CaptionEngine:
         self._built = True
 
     # -- public API -----------------------------------------------------
-    def add_request(self, request: CaptionRequest) -> None:
+    def add_request(self, request: CaptionRequest, owner: Any = None) -> None:
         budget = self.cfg.max_seq - request.sampling.max_new_tokens - 1
         if budget <= 0:
             raise ValueError(
                 f"max_new_tokens={request.sampling.max_new_tokens} leaves no "
                 f"prompt budget in max_seq={self.cfg.max_seq}"
             )
-        self.waiting.append(request)
+        if request.owner is None:
+            request.owner = owner if owner is not None else threading.get_ident()
+        with self._lock:
+            self.waiting.append(request)
 
-    def has_work(self) -> bool:
-        return bool(self.waiting or self.slots)
+    def has_work(self, owner: Any = None) -> bool:
+        with self._lock:
+            if owner is None:
+                return bool(self.waiting or self.slots)
+            return any(r.owner == owner for r in self.waiting) or any(
+                s.request.owner == owner for s in self.slots.values()
+            )
 
-    def run_until_complete(self) -> list[CaptionResult]:
-        while self.has_work():
-            self.step()
-        done, self.completed = self.completed, []
-        return done
+    def run_until_complete(self, owner: Any = None) -> list[CaptionResult]:
+        """Drive the engine until this caller's requests are done.
+
+        ``owner`` defaults to the calling thread's ident — the same default
+        ``add_request`` tags requests with — so the existing
+        add-then-run-in-one-thread usage is unchanged. Requests queued by
+        other owners still ride along in the continuous batch (free
+        throughput), but their completions stay queued for *their*
+        ``run_until_complete``.
+        """
+        if owner is None:
+            owner = threading.get_ident()
+        while True:
+            # Lock per step, not across the drain: another stage's
+            # add_request must be able to slip in between decode steps so
+            # its requests actually join the continuous batch.
+            with self._lock:
+                if not self.has_work(owner):
+                    mine = [r for r in self.completed if r.owner == owner]
+                    self.completed = [r for r in self.completed if r.owner != owner]
+                    return mine
+                self.step()
 
     @property
     def tokens_per_second(self) -> float:
@@ -217,9 +254,10 @@ class CaptionEngine:
         """Admit waiting requests into free slots, then one decode step."""
         if not self._built:
             raise RuntimeError("call setup() first")
-        self._admit()
-        if self.slots:
-            self._decode_once()
+        with self._lock:
+            self._admit()
+            if self.slots:
+                self._decode_once()
 
     def _admit(self) -> None:
         free = [i for i in range(self.max_batch) if i not in self.slots]
@@ -311,10 +349,13 @@ class CaptionEngine:
             num_prompt_tokens=len(req.prompt_ids),
             num_output_tokens=len(slot.generated),
             metadata=req.metadata,
+            owner=req.owner,
         )
         if req.on_complete is not None:
             follow_up = req.on_complete(text)
             if follow_up is not None:
+                if follow_up.owner is None:
+                    follow_up.owner = req.owner
                 self.waiting.append(follow_up)
                 return  # result superseded by the refinement pass
         self.completed.append(result)

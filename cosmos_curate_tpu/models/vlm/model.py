@@ -172,7 +172,9 @@ class VLM(nn.Module):
         """
         cfg = self.cfg
         b, n = frames_u8.shape[:2]
-        pixels = preprocess_frames(frames_u8, image_size=cfg.vision.image_size)
+        pixels = preprocess_frames(
+            frames_u8, image_size=cfg.vision.image_size, mode=cfg.vision.preprocess
+        )
         _, tokens = self.vision_tower(pixels.reshape((b * n, *pixels.shape[2:])))
         tokens = tokens[:, 1:]  # drop cls
         tokens = tokens.reshape(b, n, tokens.shape[1], tokens.shape[2]).mean(axis=1)

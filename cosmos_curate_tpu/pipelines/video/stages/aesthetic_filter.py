@@ -26,7 +26,7 @@ class AestheticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         *,
         threshold: float = 3.5,
         reduction: str = "min",  # min over frames (strict) or "mean"
-        clip_variant: str = "clip-vit-b16-tpu",
+        clip_variant: str = "clip-vit-l14-tpu",
         extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
         score_only: bool = False,
     ) -> None:

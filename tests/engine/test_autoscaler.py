@@ -1,0 +1,109 @@
+"""Autoscaler planner unit tests: queue-aware water-fill, termination.
+
+Reference capability: xenna's allocator solves balanced pipeline throughput
+under backpressure signals (docs/curator/reference/ARCHITECTURE.md:83-93).
+"""
+
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.engine.autoscaler import Budget, StageScaleState, plan_allocation
+
+
+class _Stage(Stage):
+    def __init__(self, name: str, resources: Resources) -> None:
+        self._name = name
+        self._resources = resources
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def resources(self) -> Resources:
+        return self._resources
+
+    def process_data(self, tasks):
+        return tasks
+
+
+def _state(
+    name: str,
+    *,
+    cpus: float = 1.0,
+    tpus: float = 0.0,
+    rate: float | None = None,
+    queued: int = 0,
+    workers: int = 1,
+    **spec_kw,
+) -> StageScaleState:
+    spec = StageSpec(stage=_Stage(name, Resources(cpus=cpus, tpus=tpus)), **spec_kw)
+    return StageScaleState(
+        spec=spec, current_workers=workers, throughput_per_worker=rate, queued=queued
+    )
+
+
+class TestPlanAllocation:
+    def test_bottleneck_gets_extra_workers(self):
+        stages = [
+            _state("fast", rate=10.0, queued=2),
+            _state("slow", rate=1.0, queued=2),
+        ]
+        alloc = plan_allocation(stages, Budget(cpus=8, tpus=0))
+        assert alloc[1] > alloc[0]
+        assert sum(alloc) <= 8
+
+    def test_zero_cost_stage_terminates(self):
+        # Regression: Resources(cpus=0) made fits() always true and the fill never
+        # terminated. Epsilon cost bounds the grants.
+        stages = [_state("io", cpus=0.0, rate=None, queued=100)]
+        alloc = plan_allocation(stages, Budget(cpus=4, tpus=0))
+        assert 1 <= alloc[0] <= 17  # 1 unconditional + 4/0.25 epsilon grants
+
+    def test_queue_bias_moves_workers_to_starved_stage(self):
+        # Equal measured rates: the stage with the deep backlog should win
+        # the extra budget.
+        stages = [
+            _state("drained", rate=2.0, queued=0),
+            _state("starved", rate=2.0, queued=50),
+        ]
+        alloc = plan_allocation(stages, Budget(cpus=6, tpus=0))
+        assert alloc[1] > alloc[0]
+
+    def test_throughput_shift_rebalances(self):
+        # Round 1: B is the bottleneck (slow, deep queue) -> B gets budget.
+        before = plan_allocation(
+            [
+                _state("A", rate=8.0, queued=0),
+                _state("B", rate=1.0, queued=40),
+            ],
+            Budget(cpus=8, tpus=0),
+        )
+        # Round 2 (simulated shift): B drained and fast, A now backlogged.
+        after = plan_allocation(
+            [
+                _state("A", rate=1.0, queued=40),
+                _state("B", rate=8.0, queued=0),
+            ],
+            Budget(cpus=8, tpus=0),
+        )
+        assert before[1] > before[0]
+        assert after[0] > after[1]
+        assert after[1] == 1  # drained stage shrinks to its minimum
+
+    def test_drained_stage_keeps_minimum(self):
+        stages = [_state("only", rate=5.0, queued=0, min_workers=2)]
+        alloc = plan_allocation(stages, Budget(cpus=8, tpus=0))
+        assert alloc[0] == 2
+
+    def test_unknown_rate_still_scales_on_backlog(self):
+        # No throughput sample yet: the drained-stage shrink must not apply.
+        stages = [_state("new", rate=None, queued=0)]
+        alloc = plan_allocation(stages, Budget(cpus=3, tpus=0))
+        assert alloc[0] == 3
+
+    def test_fixed_pool_not_scaled(self):
+        stages = [
+            _state("fixed", rate=0.1, queued=99, num_workers=2),
+            _state("auto", rate=5.0, queued=1),
+        ]
+        alloc = plan_allocation(stages, Budget(cpus=8, tpus=0))
+        assert alloc[0] == 2

@@ -65,3 +65,205 @@ def test_outputs_match(hf_and_ours):
     np.testing.assert_allclose(
         np.asarray(ours_tokens), hf_tokens, atol=2e-4, rtol=1e-3
     )
+
+
+def test_uint8_full_preprocessing_parity(hf_and_ours):
+    """From raw uint8 frames through EACH side's full preprocessing +
+    forward: catches normalization/resize mismatches the pre-normalized
+    parity test cannot (CLIP mean/std, bicubic shortest-side + center
+    crop)."""
+    import torch
+    import torch.nn.functional as F
+
+    hf, model, params = hf_and_ours
+    import dataclasses
+
+    import jax
+
+    from cosmos_curate_tpu.models.vit import (
+        CLIP_IMAGE_MEAN,
+        CLIP_IMAGE_STD,
+        preprocess_frames,
+    )
+
+    cfg = dataclasses.replace(model.cfg, preprocess="clip")
+    size = cfg.image_size  # 32; frames arrive larger and non-square
+    # smooth gradient image: resampler implementations (PIL/torch/jax)
+    # agree closely away from high-frequency content
+    h, w = 48, 40
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img = np.stack([yy / h, xx / w, (yy + xx) / (h + w)], axis=-1)
+    frames = (img * 255).astype(np.uint8)[None]
+
+    ours_pixels = np.asarray(preprocess_frames(jax.numpy.asarray(frames), image_size=size, mode="clip"))
+
+    # reference pipeline in torch: bicubic shortest-side + center crop +
+    # [0,1] scale + CLIP mean/std (what HF CLIPImageProcessor does)
+    t = torch.from_numpy(frames.astype(np.float32).transpose(0, 3, 1, 2))
+    scale = size / min(h, w)
+    nh, nw = max(size, round(h * scale)), max(size, round(w * scale))
+    t = F.interpolate(t, size=(nh, nw), mode="bicubic", antialias=True, align_corners=False)
+    top, left = (nh - size) // 2, (nw - size) // 2
+    t = t[:, :, top : top + size, left : left + size] / 255.0
+    mean = torch.tensor(CLIP_IMAGE_MEAN)[None, :, None, None]
+    std = torch.tensor(CLIP_IMAGE_STD)[None, :, None, None]
+    ref_pixels = ((t - mean) / std).numpy().transpose(0, 2, 3, 1)
+
+    # pixel-level: same normalization, near-identical resampling
+    assert np.abs(ours_pixels - ref_pixels).mean() < 5e-3
+    assert np.abs(ours_pixels - ref_pixels).max() < 0.15
+
+    # end-to-end: our uint8 path vs HF fed the reference-preprocessed pixels
+    ours_pooled, _ = model.apply(params, jax.numpy.asarray(ours_pixels))
+    with torch.no_grad():
+        hf_out = hf(pixel_values=torch.from_numpy(ref_pixels.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(
+        np.asarray(ours_pooled), hf_out.image_embeds.numpy(), atol=5e-2, rtol=5e-2
+    )
+
+
+class TestClipText:
+    @pytest.fixture(scope="class")
+    def text_pair(self):
+        import torch
+
+        from cosmos_curate_tpu.models.clip_text import CLIPTextEncoder
+        from cosmos_curate_tpu.models.convert_hf import clip_text_config, convert_clip_text
+
+        cfg = transformers.CLIPTextConfig(
+            vocab_size=64,
+            hidden_size=32,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=2,
+            max_position_embeddings=16,
+            projection_dim=16,
+            hidden_act="quick_gelu",
+            # selects HF's argmax-EOT pooling path — identical to ours (and
+            # to real-checkpoint behavior, where the appended EOT token is
+            # the vocabulary's highest id)
+            eos_token_id=2,
+        )
+        torch.manual_seed(1)
+        hf = transformers.CLIPTextModelWithProjection(cfg).eval()
+        ours_cfg = clip_text_config(cfg)
+        params = convert_clip_text(hf)
+        model = CLIPTextEncoder(ours_cfg, dtype=jnp.float32)
+        return hf, model, params
+
+    def test_outputs_match(self, text_pair):
+        import torch
+
+        hf, model, params = text_pair
+        rng = np.random.default_rng(1)
+        # ids in [3, 60); the max id in each row is the pooling position
+        # under CLIP's argmax-EOT rule on both sides
+        ids = rng.integers(3, 60, (2, 12)).astype(np.int32)
+        with torch.no_grad():
+            hf_out = hf(input_ids=torch.from_numpy(ids.astype(np.int64)))
+        pooled, tokens = model.apply(params, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(tokens), hf_out.last_hidden_state.numpy(), atol=2e-4, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(pooled), hf_out.text_embeds.numpy(), atol=2e-4, rtol=1e-3
+        )
+
+
+class TestAestheticHead:
+    def test_outputs_match(self):
+        import torch
+        import torch.nn as nn
+
+        from cosmos_curate_tpu.models.clip import AestheticMLP
+        from cosmos_curate_tpu.models.convert_hf import convert_aesthetic_head
+
+        # replica of the published sac-logos-ava1-l14-linearMSE layout
+        # (reference models/aesthetics.py:44-53)
+        torch.manual_seed(2)
+        ref = nn.Sequential(
+            nn.Linear(768, 1024),
+            nn.Dropout(0.2),
+            nn.Linear(1024, 128),
+            nn.Dropout(0.2),
+            nn.Linear(128, 64),
+            nn.Dropout(0.1),
+            nn.Linear(64, 16),
+            nn.Linear(16, 1),
+        ).eval()
+        params = convert_aesthetic_head(ref.state_dict())
+        emb = np.random.default_rng(2).standard_normal((4, 768)).astype(np.float32)
+        with torch.no_grad():
+            want = ref(torch.from_numpy(emb)).numpy()[:, 0]
+        got = np.asarray(AestheticMLP().apply(params, jnp.asarray(emb)))
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    def test_layers_prefix_accepted(self):
+        import torch
+
+        from cosmos_curate_tpu.models.convert_hf import convert_aesthetic_head
+
+        sd = {}
+        dims = [(768, 1024), (1024, 128), (128, 64), (64, 16), (16, 1)]
+        for idx, (i, o) in zip((0, 2, 4, 6, 7), dims):
+            sd[f"layers.{idx}.weight"] = torch.zeros(o, i)
+            sd[f"layers.{idx}.bias"] = torch.zeros(o)
+        params = convert_aesthetic_head(sd)
+        assert params["params"]["out"]["kernel"].shape == (16, 1)
+
+
+class TestT5:
+    @pytest.fixture(scope="class")
+    def t5_pair(self):
+        import torch
+
+        from cosmos_curate_tpu.models.convert_hf import convert_t5_encoder, t5_encoder_config
+        from cosmos_curate_tpu.models.t5 import T5Encoder
+
+        cfg = transformers.T5Config(
+            vocab_size=100,
+            d_model=32,
+            d_kv=16,
+            d_ff=64,
+            num_layers=2,
+            num_heads=2,
+            relative_attention_num_buckets=8,
+            relative_attention_max_distance=32,
+            dropout_rate=0.0,
+        )
+        torch.manual_seed(3)
+        hf = transformers.T5EncoderModel(cfg).eval()
+        ours_cfg = t5_encoder_config(cfg)
+        params = convert_t5_encoder(hf)
+        model = T5Encoder(ours_cfg, dtype=jnp.float32)
+        return hf, model, params
+
+    def test_config_mapping(self, t5_pair):
+        hf, model, _ = t5_pair
+        assert model.cfg.act == "relu"
+        assert model.cfg.d_kv == 16
+        assert model.cfg.num_buckets == 8
+
+    def test_outputs_match(self, t5_pair):
+        import torch
+
+        hf, model, params = t5_pair
+        rng = np.random.default_rng(3)
+        ids = rng.integers(1, 100, (2, 10)).astype(np.int32)
+        mask = np.ones((2, 10), bool)
+        mask[1, 7:] = False  # exercise key-side padding masking
+        with torch.no_grad():
+            hf_out = hf(
+                input_ids=torch.from_numpy(ids.astype(np.int64)),
+                attention_mask=torch.from_numpy(mask.astype(np.int64)),
+            )
+        ours = np.asarray(model.apply(params, jnp.asarray(ids), jnp.asarray(mask)))
+        want = hf_out.last_hidden_state.numpy()
+        # compare only unpadded positions (padded queries are undefined)
+        np.testing.assert_allclose(ours[mask], want[mask], atol=3e-4, rtol=1e-3)
+
+    def test_gated_act_config(self):
+        from cosmos_curate_tpu.models.convert_hf import t5_encoder_config
+
+        cfg = transformers.T5Config(feed_forward_proj="gated-gelu")
+        assert t5_encoder_config(cfg).act == "gated-gelu"

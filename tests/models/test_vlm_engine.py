@@ -111,6 +111,40 @@ class TestEngine:
         with pytest.raises(RuntimeError):
             eng.step()
 
+    def test_shared_engine_owner_isolation(self, engine):
+        """Two stages sharing one engine from different threads must each get
+        exactly their own completions (regression: swap-stealing
+        self.completed dropped the other stage's captions)."""
+        import threading
+
+        results: dict[str, list] = {}
+
+        def stage(name: str, n: int) -> None:
+            for i in range(n):
+                engine.add_request(_req(f"{name}-{i}", text=f"{name} {i}", max_new=4))
+            results[name] = engine.run_until_complete()
+
+        threads = [
+            threading.Thread(target=stage, args=("sa", 5)),
+            threading.Thread(target=stage, args=("sb", 3)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(r.request_id for r in results["sa"]) == [f"sa-{i}" for i in range(5)]
+        assert sorted(r.request_id for r in results["sb"]) == [f"sb-{i}" for i in range(3)]
+        assert not engine.completed and not engine.slots and not engine.waiting
+
+    def test_owner_tag_explicit(self, engine):
+        """Explicit owner tags route completions regardless of thread."""
+        engine.add_request(_req("oa"), owner="A")
+        engine.add_request(_req("ob"), owner="B")
+        got_a = engine.run_until_complete(owner="A")
+        assert [r.request_id for r in got_a] == ["oa"]
+        got_b = engine.run_until_complete(owner="B")
+        assert [r.request_id for r in got_b] == ["ob"]
+
 
 class TestModelInternals:
     def test_prefill_decode_cache_consistency(self, engine):
