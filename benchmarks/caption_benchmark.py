@@ -1,0 +1,103 @@
+"""Caption-engine throughput benchmark: output tokens/s + decode MFU.
+
+Equivalent capability of the reference's speed-of-light caption accounting
+(docs/curator/design/SPEED_OF_LIGHT.md:22-81 — output tok/s is THE caption
+metric; efficiency = achieved/peak). Runs the continuous-batching engine on
+a fixed multimodal workload and prints one JSON line:
+
+  {"metric": "caption_output_tokens_per_sec", "value": N, "unit": "tok/s",
+   "decode_mfu": M, "prefill_s": P, ...}
+
+Usage:
+  python -m benchmarks.caption_benchmark [--requests 16] [--max-new 64]
+                                         [--config base|tiny] [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--config", choices=("base", "tiny"), default="base")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--frames", type=int, default=4)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from cosmos_curate_tpu.models.flops import chip_peak_flops, mfu, vlm_decode_flops_per_token
+    from cosmos_curate_tpu.models.prompts import get_caption_prompt
+    from cosmos_curate_tpu.models.vlm import (
+        CaptionEngine,
+        CaptionRequest,
+        SamplingConfig,
+        VLM_BASE,
+        VLM_TINY_TEST,
+    )
+
+    cfg = VLM_BASE if args.config == "base" else VLM_TINY_TEST
+    engine = CaptionEngine(cfg, max_batch=args.batch)
+    engine.setup()
+    tok = engine.tokenizer
+    prompt_ids = tok.encode(get_caption_prompt("default"))
+    rng = np.random.default_rng(0)
+    size = cfg.vision.image_size
+
+    def make_request(rid: str) -> CaptionRequest:
+        return CaptionRequest(
+            request_id=rid,
+            prompt_ids=list(prompt_ids),
+            frames=rng.integers(0, 255, (args.frames, size, size, 3), dtype=np.uint8),
+            sampling=SamplingConfig(max_new_tokens=args.max_new),
+        )
+
+    # warmup: compile prefill buckets + decode program outside the window
+    engine.add_request(make_request("warmup"))
+    engine.run_until_complete()
+    engine._decode_tokens = 0
+    engine._decode_time = 0.0
+
+    t0 = time.monotonic()
+    for i in range(args.requests):
+        engine.add_request(make_request(f"r{i}"))
+    results = engine.run_until_complete()
+    elapsed = time.monotonic() - t0
+
+    out_tokens = sum(r.num_output_tokens for r in results)
+    decode_tok_s = engine.tokens_per_second
+    end_to_end_tok_s = out_tokens / elapsed if elapsed > 0 else 0.0
+    decode_flops = vlm_decode_flops_per_token(cfg)
+
+    import jax
+
+    record = {
+        "metric": "caption_output_tokens_per_sec",
+        "value": round(end_to_end_tok_s, 2),
+        "unit": "tok/s",
+        "decode_tokens_per_sec": round(decode_tok_s, 2),
+        "decode_mfu": round(mfu(decode_flops * engine._decode_tokens, engine._decode_time), 5)
+        if engine._decode_time > 0
+        else 0.0,
+        "requests": len(results),
+        "output_tokens": out_tokens,
+        "elapsed_s": round(elapsed, 2),
+        "peak_flops": chip_peak_flops(),
+        "backend": jax.devices()[0].platform,
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,7 +51,16 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.set_defaults(func=_cmd_split)
 
     av = lsub.add_parser("av", help="multi-camera AV pipelines")
-    av.add_argument("subcommand2", choices=["ingest", "split", "caption", "shard"], metavar="step")
+    av.add_argument(
+        "subcommand2",
+        choices=["ingest", "split", "caption", "package", "shard"],
+        metavar="step",
+    )
+    av.add_argument(
+        "--caption-variants",
+        default="av",
+        help="comma-separated prompt variants; first is the primary caption",
+    )
     av.add_argument("--input-path", required=True)
     av.add_argument("--output-path", required=True)
     av.add_argument("--db-path", default="")
@@ -85,7 +94,27 @@ def register(sub: argparse._SubParsersAction) -> None:
     shard.add_argument("--max-samples-per-shard", type=int, default=512)
     shard.set_defaults(func=_cmd_shard)
 
+    merge = lsub.add_parser(
+        "merge-summaries",
+        help="combine per-node summary-node*.json into one summary-merged.json",
+    )
+    merge.add_argument("--output-path", required=True, help="pipeline output root")
+    merge.set_defaults(func=_cmd_merge_summaries)
+
     local.set_defaults(func=lambda args: (local.print_help(), 2)[1])
+
+
+def _cmd_merge_summaries(args: argparse.Namespace) -> int:
+    import json
+
+    from cosmos_curate_tpu.utils.summary import merge_node_summaries
+
+    merged = merge_node_summaries(args.output_path)
+    if merged is None:
+        print(f"no summaries found under {args.output_path}")
+        return 1
+    print(json.dumps(merged, indent=2))
+    return 0
 
 
 def _cmd_hello(args: argparse.Namespace) -> int:
@@ -100,12 +129,15 @@ def _cmd_av(args: argparse.Namespace) -> int:
     from cosmos_curate_tpu.core.runner import SequentialRunner
     from cosmos_curate_tpu.pipelines.av import pipeline as av
 
+    variants = [v.strip() for v in args.caption_variants.split(",") if v.strip()]
     pargs = av.AVPipelineArgs(
         input_path=args.input_path,
         output_path=args.output_path,
         db_path=args.db_path,
         clip_len_s=args.clip_len_s,
         min_clip_len_s=args.min_clip_len_s,
+        caption_prompt_variant=variants[0] if variants else "av",
+        extra_caption_variants=tuple(variants[1:]),
         limit=args.limit,
     )
     step = args.subcommand2
@@ -117,6 +149,8 @@ def _cmd_av(args: argparse.Namespace) -> int:
         )
     elif step == "caption":
         summary = av.run_av_caption(pargs)
+    elif step == "package":
+        summary = av.run_av_package(pargs)
     else:
         summary = av.run_av_shard(pargs)
     print(json.dumps(summary, indent=2))

@@ -35,7 +35,7 @@ export CURATE_NUM_NODES="$SLURM_JOB_NUM_NODES"
 # the command survives verbatim. Node rank is read from SLURM_NODEID by
 # cosmos_curate_tpu.parallel.distributed in each task.
 srun --kill-on-bad-exit=1 {python} -m cosmos_curate_tpu.cli.main {command}
-"""
+{merge_step}"""
 
 
 def register(sub: argparse._SubParsersAction) -> None:
@@ -49,6 +49,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     slurm.add_argument("--account", default="")
     slurm.add_argument("--coordinator-port", type=int, default=8476)
     slurm.add_argument("--env", action="append", default=[], metavar="K=V")
+    slurm.add_argument(
+        "--merge-output",
+        default="",
+        metavar="PATH",
+        help="after all nodes finish, merge per-node summaries under PATH "
+        "into summary-merged.json (runs once, on the batch host)",
+    )
     slurm.add_argument("--output", default="", help="write script here instead of submitting")
     slurm.add_argument("--submit", action="store_true", help="sbatch the generated script")
     slurm.add_argument("command", nargs=argparse.REMAINDER, help="cosmos-curate-tpu subcommand to run")
@@ -69,7 +76,15 @@ def _cmd_slurm(args: argparse.Namespace) -> int:
     if args.account:
         extra.append(f"#SBATCH --account={args.account}")
     env_exports = "\n".join(f"export {shlex.quote(e)}" for e in args.env)
+    merge_step = ""
+    if args.merge_output:
+        merge_step = (
+            "\n# all partitions done: fold per-node summaries into one\n"
+            f"python -m cosmos_curate_tpu.cli.main local merge-summaries "
+            f"--output-path {shlex.quote(args.merge_output)}\n"
+        )
     script = _SBATCH_TEMPLATE.format(
+        merge_step=merge_step,
         job_name=args.job_name,
         nodes=args.nodes,
         cpus_per_task=args.cpus_per_task,

@@ -1,53 +1,158 @@
-"""Dataset dimension bucketing.
+"""Dataset dimension bucketing and path codecs.
 
 Equivalent capability of the reference's dimensions module
-(cosmos_curate/core/utils/dataset/dimensions.py — 514 LoC bucketing by
-resolution / aspect ratio / frame window for webdataset sharding). Clips are
-grouped into buckets so every sample in a shard has compatible tensor
-shapes for training.
+(cosmos_curate/core/utils/dataset/dimensions.py — even-rounded resize math,
+aspect/resolution/duration range bins with contiguity validation, and
+bucket <-> dataset-path string codecs used to lay out webdataset shards).
+Own design: one generic contiguous ``RangeBins`` primitive instead of three
+hand-rolled bin-spec classes, and a dataclass bucket whose ``key``/``path``
+round-trip through a single regex.
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
+from typing import Generic, Sequence, TypeVar
 
-_ASPECT_BUCKETS: list[tuple[str, float]] = [
-    ("16-9", 16 / 9),
-    ("4-3", 4 / 3),
-    ("1-1", 1.0),
-    ("3-4", 3 / 4),
-    ("9-16", 9 / 16),
-]
+T = TypeVar("T")
 
-_RES_BUCKETS: list[tuple[str, int]] = [  # by min(height, width)
-    ("2160p", 2160),
-    ("1080p", 1080),
-    ("720p", 720),
-    ("480p", 480),
-    ("360p", 360),
-    ("0p", 0),
-]
 
-_FRAME_WINDOWS: list[int] = [256, 128, 64, 32, 16, 0]
+def round_to_even(n: float) -> int:
+    """Nearest even integer (video codecs require even dimensions). Ties
+    round UP, matching the reference (_round_to_nearest_even keeps the floor
+    only when strictly closer)."""
+    base = int(n) // 2 * 2
+    return base if n - base < (base + 2) - n else base + 2
+
+
+@dataclass(frozen=True)
+class Dimensions:
+    """Width/height pair with the resize math model stages share."""
+
+    width: int
+    height: int
+
+    @property
+    def w_by_h(self) -> float:
+        return self.width / self.height
+
+    def resize_by_shortest_side(self, short: int) -> "Dimensions":
+        """Scale so min(w, h) == short, the long side rounded to even."""
+        if short % 2:
+            raise ValueError(f"target short side must be even, got {short}")
+        if self.height <= self.width:
+            return Dimensions(round_to_even(short / self.height * self.width), short)
+        return Dimensions(short, round_to_even(short / self.width * self.height))
+
+
+class RangeBins(Generic[T]):
+    """Contiguous half-open value ranges mapping to labels.
+
+    The single primitive behind aspect-ratio, resolution, and duration
+    binning; construction validates contiguity so dataset layouts can't
+    silently develop gaps. ``closed="right"`` means ``(lo, hi]`` (the
+    reference's aspect-bin convention); ``closed="left"`` means
+    ``[lo, hi)`` (floor semantics — a 400px-short video is 360p-class)."""
+
+    def __init__(self, edges: Sequence[float], labels: Sequence[T], *, closed: str = "right"):
+        if len(edges) != len(labels) + 1:
+            raise ValueError(f"{len(labels)} bins need {len(labels) + 1} edges")
+        for a, b in zip(edges, edges[1:]):
+            if not a < b:
+                raise ValueError(f"bin edges must increase: {a} !< {b}")
+        if closed not in ("left", "right"):
+            raise ValueError(f"closed must be 'left' or 'right', got {closed!r}")
+        self.edges = list(edges)
+        self.labels = list(labels)
+        self.closed = closed
+
+    def find(self, value: float) -> T | None:
+        for lo, hi, label in zip(self.edges, self.edges[1:], self.labels):
+            hit = lo <= value < hi if self.closed == "left" else lo < value <= hi
+            if hit:
+                return label
+        return None
+
+
+# Standard bins: the dataset layouts the reference's standard image/video
+# datasets use (dimensions.py:212-318,390-470).
+ASPECT_BINS: RangeBins[tuple[int, int]] = RangeBins(
+    [0.0, 0.65, 0.88, 1.16, 1.55, 10.0],
+    [(9, 16), (3, 4), (1, 1), (4, 3), (16, 9)],
+)
+RESOLUTION_BINS: RangeBins[str] = RangeBins(
+    [0, 360, 480, 720, 1080, 2160, float("inf")],
+    ["0p", "360p", "480p", "720p", "1080p", "2160p"],
+    closed="left",
+)
+DURATION_BINS: RangeBins[str] = RangeBins(
+    [0.0, 2.0, 5.0, 10.0, 30.0, 60.0, float("inf")],
+    ["0-2s", "2-5s", "5-10s", "10-30s", "30-60s", "60s-"],
+)
+FRAME_WINDOWS: list[int] = [256, 128, 64, 32, 16, 0]
 
 
 @dataclass(frozen=True)
 class DimensionBucket:
-    aspect: str
-    resolution: str
+    """One shard-compatible group: aspect x resolution x frame window,
+    optionally a duration band."""
+
+    aspect: str  # "16-9"
+    resolution: str  # "720p"
     frame_window: int
+    duration: str | None = None
 
     @property
     def key(self) -> str:
-        return f"{self.aspect}_{self.resolution}_w{self.frame_window}"
+        base = f"{self.aspect}_{self.resolution}_w{self.frame_window}"
+        return f"{base}_d{self.duration}" if self.duration else base
+
+    # -- dataset path codec (reference to_path_string/from_path_string) ---
+    @property
+    def path(self) -> str:
+        parts = [
+            f"resolution_{self.resolution}",
+            f"aspect_ratio_{self.aspect.replace('-', '_')}",
+            f"frames_{self.frame_window}",
+        ]
+        if self.duration:
+            parts.append(f"duration_{self.duration}")
+        return "/".join(parts)
+
+    _PATH_RE = re.compile(
+        r"resolution_(?P<res>[0-9]+p)/aspect_ratio_(?P<aw>\d+)_(?P<ah>\d+)"
+        r"/frames_(?P<fw>\d+)(?:/duration_(?P<dur>[^/]+))?"
+    )
+
+    @classmethod
+    def from_path(cls, path: str) -> "DimensionBucket":
+        m = cls._PATH_RE.search(path)
+        if m is None:
+            raise ValueError(f"not a dimension path: {path!r}")
+        return cls(
+            aspect=f"{m.group('aw')}-{m.group('ah')}",
+            resolution=m.group("res"),
+            frame_window=int(m.group("fw")),
+            duration=m.group("dur"),
+        )
 
 
-def bucket_for(width: int, height: int, num_frames: int) -> DimensionBucket:
+def bucket_for(
+    width: int,
+    height: int,
+    num_frames: int,
+    *,
+    duration_s: float | None = None,
+) -> DimensionBucket:
+    """Classify a clip into its shard bucket. Out-of-range (degenerate)
+    inputs land in the smallest bucket rather than raising — a single bad
+    probe must not kill a sharding run."""
     if width <= 0 or height <= 0:
         return DimensionBucket("1-1", "0p", 0)
-    ratio = width / height
-    aspect = min(_ASPECT_BUCKETS, key=lambda b: abs(b[1] - ratio))[0]
-    short = min(width, height)
-    resolution = next(name for name, px in _RES_BUCKETS if short >= px)
-    window = next(w for w in _FRAME_WINDOWS if num_frames >= w)
-    return DimensionBucket(aspect, resolution, window)
+    ar = ASPECT_BINS.find(width / height) or (16, 9)
+    aspect = f"{ar[0]}-{ar[1]}"
+    resolution = RESOLUTION_BINS.find(min(width, height)) or "0p"
+    window = next(w for w in FRAME_WINDOWS if num_frames >= w)
+    duration = DURATION_BINS.find(duration_s) if duration_s is not None else None
+    return DimensionBucket(aspect, resolution, window, duration)

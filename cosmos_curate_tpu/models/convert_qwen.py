@@ -1,0 +1,139 @@
+"""Qwen2(-VL) checkpoint converter for the CurateVLM LM stack.
+
+Equivalent capability of the reference's Qwen-family caption models, which
+vLLM loads directly (cosmos_curate/models/vllm_qwen.py:122-260). Our
+``VLM_QWEN2_2B`` config matches Qwen2-VL-2B-Instruct's language model
+tensor-for-tensor (GQA, SwiGLU, q/k/v biases, tied embeddings, RMS norm,
+rope 1e6), so this converter maps every LM tensor name exactly — numeric
+parity is proven against a randomly initialized HF Qwen2 in
+tests/models/test_convert_qwen.py.
+
+The Qwen2-VL *vision* encoder (``visual.*`` tensors) is architecturally
+different (3D-conv patchify, windowed attention, m-rope); our ViT vision
+tower is retained instead, and ``convert_qwen2_lm`` reports those tensors as
+intentionally unmapped rather than silently dropping them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _t(w) -> np.ndarray:
+    return np.asarray(w.detach().cpu().numpy() if hasattr(w, "detach") else w)
+
+
+@dataclass
+class ConversionReport:
+    mapped: list[str] = field(default_factory=list)
+    vision_skipped: list[str] = field(default_factory=list)
+    unmapped: list[str] = field(default_factory=list)
+
+
+def qwen2_lm_config(hf_config, **overrides):
+    """Our VLMConfig from an HF Qwen2(-VL) text config."""
+    from cosmos_curate_tpu.models.vlm.model import VLMConfig
+
+    head_dim = getattr(hf_config, "head_dim", None) or (
+        hf_config.hidden_size // hf_config.num_attention_heads
+    )
+    kw = dict(
+        vocab=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        head_dim=head_dim,
+        hidden_mult=hf_config.intermediate_size / hf_config.hidden_size,
+        rope_theta=hf_config.rope_theta,
+        qkv_bias=True,
+    )
+    kw.update(overrides)
+    return VLMConfig(**kw)
+
+
+def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]:
+    """HF Qwen2(-VL) state dict → our VLM LM params subtree + report.
+
+    Accepts both bare Qwen2 (``model.``) and Qwen2-VL (``model.`` +
+    ``visual.``) layouts. Returns params covering embed / layer_i / ln_f;
+    merge into a full init tree with ``merge_lm_params``.
+    """
+    sd = {k: v for k, v in state_dict.items()}
+    report = ConversionReport()
+
+    def take(name: str) -> np.ndarray:
+        report.mapped.append(name)
+        return _t(sd[name])
+
+    # Qwen2-VL-2B prefixes text tensors with "model."; some exports use
+    # "model.language_model." — probe which exists.
+    prefix = "model."
+    if f"{prefix}embed_tokens.weight" not in sd:
+        for cand in ("model.language_model.", "language_model.model.", ""):
+            if f"{cand}embed_tokens.weight" in sd:
+                prefix = cand
+                break
+    params: dict = {"embed": {"embedding": take(f"{prefix}embed_tokens.weight")}}
+    for i in range(n_layers):
+        e = f"{prefix}layers.{i}."
+
+        def lin(name: str, bias: bool) -> dict:
+            d = {"kernel": take(f"{e}{name}.weight").T}
+            if bias:
+                d["bias"] = take(f"{e}{name}.bias")
+            return d
+
+        params[f"layer_{i}"] = {
+            "ln1": {"scale": take(f"{e}input_layernorm.weight")},
+            "ln2": {"scale": take(f"{e}post_attention_layernorm.weight")},
+            "q": lin("self_attn.q_proj", True),
+            "k": lin("self_attn.k_proj", True),
+            "v": lin("self_attn.v_proj", True),
+            "o": lin("self_attn.o_proj", False),
+            "gate": lin("mlp.gate_proj", False),
+            "up": lin("mlp.up_proj", False),
+            "down": lin("mlp.down_proj", False),
+        }
+    params["ln_f"] = {"scale": take(f"{prefix}norm.weight")}
+
+    mapped = set(report.mapped)
+    for k in sd:
+        if k in mapped:
+            continue
+        if k.startswith(("visual.", "model.visual.")):
+            report.vision_skipped.append(k)
+        elif k == "lm_head.weight":
+            # tied-embedding checkpoints may still serialize the head; our
+            # logits use embed.attend, so a TIED head is already covered.
+            head, emb = _t(sd[k]), params["embed"]["embedding"]
+            if head.shape == emb.shape and np.array_equal(head, emb):
+                report.mapped.append(k)
+            else:
+                report.unmapped.append(k)
+        else:
+            report.unmapped.append(k)
+    logger.info(
+        "converted Qwen2 LM: %d tensors mapped, %d vision skipped, %d unmapped",
+        len(report.mapped),
+        len(report.vision_skipped),
+        len(report.unmapped),
+    )
+    return {"params": params}, report
+
+
+def merge_lm_params(init_tree: dict, lm_params: dict) -> dict:
+    """Overlay converted LM params onto a full init tree (vision tower +
+    projector keep their existing — e.g. self-trained — values)."""
+    import flax
+
+    merged = flax.core.unfreeze(init_tree)
+    for key, val in lm_params["params"].items():
+        merged["params"][key] = val
+    return merged

@@ -43,17 +43,18 @@ def video_embed_forward_flops(cfg) -> float:
 
 
 def vlm_decode_flops_per_token(cfg) -> float:
-    """One decode step for one sequence through models/vlm.VLM's LM stack.
-
-    Decode attention reads the whole KV cache: score/value matmuls are
-    T_cache·W each rather than T².  Uses max_seq as the cache bound (upper
-    estimate)."""
-    w = cfg.width
-    proj = 8.0 * w * w
-    attn = 4.0 * cfg.max_seq * w
-    mlp = 4.0 * w * (4 * w)
-    head = 2.0 * w * cfg.vocab_size
-    return cfg.layers * (proj + attn + mlp) + head
+    """One decode step for one sequence through models/vlm.VLM's LM stack
+    (GQA + SwiGLU + tied head). Decode attention reads the whole KV cache:
+    score/value matmuls scale with max_seq (upper estimate)."""
+    d = cfg.dim
+    q_inner = cfg.n_heads * cfg.head_dim
+    kv_inner = cfg.n_kv_heads * cfg.head_dim
+    proj = 2.0 * d * q_inner + 2.0 * 2.0 * d * kv_inner + 2.0 * q_inner * d
+    attn = 2.0 * 2.0 * cfg.max_seq * q_inner
+    ff = int(d * cfg.hidden_mult)
+    mlp = 3.0 * 2.0 * d * ff  # gate + up + down
+    head = 2.0 * d * cfg.vocab
+    return cfg.n_layers * (proj + attn + mlp) + head
 
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).

@@ -102,7 +102,15 @@ def load_params(
 
         logger.info("loading %s weights from %s", model_id, ckpt)
         template = init_fn(seed)
-        return flax.serialization.from_bytes(template, ckpt.read_bytes())
+        try:
+            return flax.serialization.from_bytes(template, ckpt.read_bytes())
+        except (ValueError, KeyError, TypeError) as e:
+            # a checkpoint staged for different model shapes (e.g. an old
+            # config) must not hard-crash the pipeline at stage setup
+            logger.error(
+                "staged weights at %s do not match %s's current architecture "
+                "(%s); falling back to random init", ckpt, model_id, e,
+            )
     logger.warning(
         "no staged weights for %s under %s — using seeded random init "
         "(stage a params.msgpack there for real inference)",

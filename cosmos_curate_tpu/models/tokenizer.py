@@ -1,13 +1,23 @@
-"""Tokenizer for the caption engine.
+"""Tokenizers for the caption engine.
 
-No pretrained tokenizer assets exist in this image (zero egress), so the
-default is a byte-level tokenizer (ids 0-255 = raw bytes + special tokens) —
-hermetic, reversible, and vocab-compatible with the bundled VLM configs.
-Real deployments plug an HF tokenizer through the same interface (the
-engine only calls ``encode``/``decode``/special-token properties).
+Two implementations behind one interface (the engine only calls
+``encode``/``decode``/``eos_id``/``pad_id``/``vocab_size``):
+
+- ``ByteTokenizer``: ids 0-255 = raw bytes + special tokens — hermetic,
+  reversible, always available (no assets).
+- ``BPETokenizer``: self-contained byte-level BPE (reference capability:
+  the caption models' BPE tokenizers loaded via HF processors,
+  cosmos_curate/models/vllm_plugin.py:47). Train it on a corpus, save/load
+  its own JSON, or load pretrained GPT-2-format ``vocab.json``+``merges.txt``
+  (the file format Qwen2/GPT-2-family checkpoints ship) — no ``tokenizers``
+  library needed, so real checkpoints' tokenizers work in this image.
 """
 
 from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
 
 
 class ByteTokenizer:
@@ -33,3 +43,214 @@ class ByteTokenizer:
     @property
     def pad_id(self) -> int:
         return self.PAD
+
+
+# GPT-2's printable-unicode byte encoding (public algorithm): every byte maps
+# to a visible character so vocab/merges files stay text. Needed to read
+# pretrained GPT-2-format tokenizer files.
+def _gpt2_byte_encoder() -> dict[int, str]:
+    bs = list(range(ord("!"), ord("~") + 1)) + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+# Simplified GPT-2-style pretokenizer: contractions, letter runs, digit
+# runs, other-symbol runs, whitespace runs (kept with the following word).
+_PRETOKEN_RE = re.compile(
+    r"'(?:[sdmt]|ll|ve|re)| ?[^\W\d_]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+"
+)
+
+
+class BPETokenizer:
+    """Byte-level BPE over the shared special-token layout.
+
+    ids 0-255 are raw bytes (so any input is encodable), specials sit at
+    256-259 (same slots as ``ByteTokenizer`` — engine configs need no
+    change), merged tokens start at 260.
+    """
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+    IMAGE = 259
+    _FIRST_MERGE = 260
+
+    def __init__(self, merges: list[tuple[int, int]] | None = None, vocab_size: int | None = None):
+        self.merges: list[tuple[int, int]] = list(merges or [])
+        self._ranks: dict[tuple[int, int], int] = {m: i for i, m in enumerate(self.merges)}
+        self._token_bytes: list[bytes] = [bytes([i]) for i in range(256)] + [b""] * 4
+        for a, b in self.merges:
+            self._token_bytes.append(self._token_bytes[a] + self._token_bytes[b])
+        self.vocab_size = vocab_size or max(512, self._FIRST_MERGE + len(self.merges))
+
+    # -- core -----------------------------------------------------------
+    def _apply_merges(self, ids: list[int]) -> list[int]:
+        """Greedy lowest-rank-first merging (standard BPE apply)."""
+        if len(ids) < 2:
+            return ids
+        while True:
+            best_rank = None
+            best_i = -1
+            for i in range(len(ids) - 1):
+                r = self._ranks.get((ids[i], ids[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_rank is None:
+                return ids
+            ids = ids[:best_i] + [self._FIRST_MERGE + best_rank] + ids[best_i + 2 :]
+
+    def encode(self, text: str, *, add_bos: bool = True) -> list[int]:
+        out = [self.BOS] if add_bos else []
+        for piece in _PRETOKEN_RE.findall(text):
+            out.extend(self._apply_merges(list(piece.encode("utf-8"))))
+        return out
+
+    def decode(self, ids: list[int]) -> str:
+        data = b"".join(
+            self._token_bytes[i] for i in ids if i < len(self._token_bytes) and i not in (
+                self.PAD, self.BOS, self.EOS, self.IMAGE
+            )
+        )
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_id(self) -> int:
+        return self.EOS
+
+    @property
+    def pad_id(self) -> int:
+        return self.PAD
+
+    # -- training -------------------------------------------------------
+    @classmethod
+    def train(cls, corpus: list[str], vocab_size: int = 512) -> "BPETokenizer":
+        """Classic BPE: repeatedly merge the most frequent adjacent pair
+        within pretokenized pieces until ``vocab_size`` is reached."""
+        from collections import Counter
+
+        pieces: Counter[tuple[int, ...]] = Counter()
+        for text in corpus:
+            for piece in _PRETOKEN_RE.findall(text):
+                pieces[tuple(piece.encode("utf-8"))] += 1
+        merges: list[tuple[int, int]] = []
+        next_id = cls._FIRST_MERGE
+        words = dict(pieces)
+        while next_id < vocab_size:
+            pair_counts: Counter[tuple[int, int]] = Counter()
+            for word, freq in words.items():
+                for i in range(len(word) - 1):
+                    pair_counts[(word[i], word[i + 1])] += freq
+            if not pair_counts:
+                break
+            (a, b), freq = pair_counts.most_common(1)[0]
+            if freq < 2:
+                break
+            merges.append((a, b))
+            new_words = {}
+            for word, f in words.items():
+                out = []
+                i = 0
+                while i < len(word):
+                    if i + 1 < len(word) and word[i] == a and word[i + 1] == b:
+                        out.append(next_id)
+                        i += 2
+                    else:
+                        out.append(word[i])
+                        i += 1
+                new_words[tuple(out)] = new_words.get(tuple(out), 0) + f
+            words = new_words
+            next_id += 1
+        return cls(merges, vocab_size=vocab_size)
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path: str | Path) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(
+            json.dumps({"version": 1, "vocab_size": self.vocab_size, "merges": self.merges})
+        )
+
+    @classmethod
+    def load(cls, path: str | Path) -> "BPETokenizer":
+        data = json.loads(Path(path).read_text())
+        return cls([tuple(m) for m in data["merges"]], vocab_size=data["vocab_size"])
+
+    @classmethod
+    def from_gpt2_files(cls, vocab_json: str | Path, merges_txt: str | Path) -> "BPETokenizer":
+        """Load a pretrained GPT-2-format tokenizer (Qwen2/GPT-2 family ship
+        ``vocab.json`` + ``merges.txt``). Token ids are remapped into our
+        layout: the base alphabet collapses to raw bytes 0-255; each merge
+        becomes one new id in file order, so text round-trips exactly (ids
+        differ from HF's — use this tokenizer end-to-end, not mixed)."""
+        decoder = {v: k for k, v in _gpt2_byte_encoder().items()}
+
+        def to_bytes(token: str) -> bytes:
+            return bytes(decoder[ch] for ch in token)
+
+        bytes_to_id: dict[bytes, int] = {bytes([i]): i for i in range(256)}
+        merges: list[tuple[int, int]] = []
+        next_id = cls._FIRST_MERGE
+        for line in Path(merges_txt).read_text().splitlines():
+            if not line or line.startswith("#version"):
+                continue
+            left, _, right = line.partition(" ")
+            lb, rb = to_bytes(left), to_bytes(right)
+            if lb not in bytes_to_id or rb not in bytes_to_id:
+                continue  # merge over a token we never formed (defensive)
+            merges.append((bytes_to_id[lb], bytes_to_id[rb]))
+            bytes_to_id[lb + rb] = next_id
+            next_id += 1
+        n_vocab = len(json.loads(Path(vocab_json).read_text()))
+        return cls(merges, vocab_size=max(n_vocab + 4, next_id))
+
+
+def default_caption_tokenizer():
+    """The tokenizer caption-family stages use: a staged/committed trained
+    BPE when present (word-level tokens, ~3-4x fewer decode steps), else the
+    hermetic byte tokenizer. Both share the special-token layout, so the
+    bundled VLM configs (vocab 512) serve either."""
+    from cosmos_curate_tpu.models.registry import REPO_WEIGHTS_DIR, weights_root
+
+    for root in (weights_root(), REPO_WEIGHTS_DIR):
+        p = root / "caption-tokenizer" / "bpe.json"
+        if p.exists():
+            return BPETokenizer.load(p)
+    return ByteTokenizer()
+
+
+def train_caption_tokenizer(out_path: str | Path, *, vocab_size: int = 512) -> "BPETokenizer":
+    """Train the caption BPE on the prompt library + a caption-style corpus
+    (the text distribution the engine actually decodes)."""
+    from cosmos_curate_tpu.models import prompts
+
+    corpus = list(prompts.CAPTION_PROMPTS.values())
+    corpus.extend(prompts.SEMANTIC_FILTER_PROMPTS.values())
+    corpus.extend([prompts.REFINEMENT_PROMPT, prompts.ENHANCE_PROMPT])
+    subjects = ["car", "person", "dog", "truck", "cyclist", "bus", "crowd", "robot arm"]
+    scenes = ["a city street", "a highway", "a warehouse", "a park", "an intersection",
+              "a parking lot", "a kitchen", "a factory floor"]
+    actions = ["driving", "walking", "turning left", "stopping", "accelerating",
+               "crossing", "picking up an object", "waiting"]
+    for s in subjects:
+        for sc in scenes:
+            for a in actions:
+                corpus.append(f"The video shows a {s} {a} in {sc}.")
+    tok = BPETokenizer.train(corpus, vocab_size=vocab_size)
+    tok.save(out_path)
+    return tok
+
+
+if __name__ == "__main__":
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "weights/caption-tokenizer/bpe.json"
+    t = train_caption_tokenizer(out)
+    sample = "The video shows a red car driving in a city street."
+    print(f"trained BPE: {len(t.merges)} merges -> {out}")
+    print(f"sample: {len(t.encode(sample))} tokens vs {len(sample)+1} byte tokens")

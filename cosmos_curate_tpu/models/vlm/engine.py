@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cosmos_curate_tpu.models.batching import next_pow2
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import ByteTokenizer, default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, init_cache
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -89,7 +89,7 @@ class CaptionEngine:
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
-        self.tokenizer = tokenizer or ByteTokenizer()
+        self.tokenizer = tokenizer or default_caption_tokenizer()
         self.model = VLM(cfg)
         self.params = params
         self.waiting: list[CaptionRequest] = []
@@ -135,27 +135,31 @@ class CaptionEngine:
             return model.apply(params, ids, method=model.embed_tokens)
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, cache_k, cache_v, embeds, slot, t_valid):
-            """embeds: [1, Tb, D] (bucket-padded); writes slot's cache rows
-            [0, Tb) and returns logits at the last valid position."""
-            ck = jax.lax.dynamic_slice_in_dim(cache_k, slot, 1, axis=1)
-            cv = jax.lax.dynamic_slice_in_dim(cache_v, slot, 1, axis=1)
-            t = embeds.shape[1]
-            positions = jnp.arange(t, dtype=jnp.int32)[None]
+        def prefill_batch(params, cache_k, cache_v, embeds, slots, t_valid):
+            """Batched bucket prefill (replaces the round-1 one-request-at-a-
+            time admission — the reference leans on vLLM's batched prefill,
+            vllm_interface.py:543). embeds: [N, Tb, D] (bucket-padded);
+            slots/t_valid: [N]. Writes every request's cache rows in one
+            program and returns each row's logits at its last valid
+            position: [N, V]."""
+            ck = cache_k[:, slots]  # [L, N, S, Hkv, Dh]
+            cv = cache_v[:, slots]
+            n, t, _ = embeds.shape
+            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (n, t))
             logits, nk, nv = model.apply(
                 params,
                 embeds,
                 ck,
                 cv,
                 positions,
-                jnp.zeros((1,), jnp.int32),
-                jnp.full((1,), t_valid, jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                t_valid,
             )
-            cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, nk, slot, axis=1)
-            cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, nv, slot, axis=1)
+            cache_k = cache_k.at[:, slots].set(nk)
+            cache_v = cache_v.at[:, slots].set(nv)
             last = jnp.take_along_axis(
-                logits, (t_valid - 1)[None, None, None].astype(jnp.int32), axis=1
-            )[0, 0]
+                logits, (t_valid - 1)[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
             return last, cache_k, cache_v
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -196,7 +200,7 @@ class CaptionEngine:
 
         self._encode_images = encode_images
         self._embed_tokens = embed_tokens
-        self._prefill = prefill
+        self._prefill_batch = prefill_batch
         self._decode = decode_step
         self._sample_host = sample_host
         self._built = True
@@ -261,15 +265,45 @@ class CaptionEngine:
 
     def _admit(self) -> None:
         free = [i for i in range(self.max_batch) if i not in self.slots]
+        prepared: list[tuple[int, CaptionRequest, Any, int]] = []
         while free and self.waiting:
             slot_idx = free.pop(0)
             req = self.waiting.pop(0)
             try:
-                self._prefill_request(slot_idx, req)
+                embeds, t_valid = self._prepare_embeds(req)
             except Exception:
-                logger.exception("prefill failed for %s; dropping", req.request_id)
+                logger.exception("prefill prep failed for %s; dropping", req.request_id)
+                continue
+            prepared.append((slot_idx, req, embeds, t_valid))
+        # group by prefill bucket; each group runs ONE batched prefill
+        groups: dict[int, list[tuple[int, CaptionRequest, Any, int]]] = {}
+        for item in prepared:
+            bucket = min(next_pow2(item[3]), self.cfg.max_seq)
+            groups.setdefault(bucket, []).append(item)
+        for bucket, items in sorted(groups.items()):
+            try:
+                self._prefill_group(bucket, items)
+            except Exception:
+                if len(items) == 1:
+                    logger.exception(
+                        "prefill failed for %s; dropping", items[0][1].request_id
+                    )
+                    continue
+                # isolate the offender: retry each request as its own group
+                logger.exception(
+                    "batched prefill failed for %d requests; retrying singly",
+                    len(items),
+                )
+                for item in items:
+                    try:
+                        self._prefill_group(bucket, [item])
+                    except Exception:
+                        logger.exception(
+                            "prefill failed for %s; dropping", item[1].request_id
+                        )
 
-    def _prefill_request(self, slot_idx: int, req: CaptionRequest) -> None:
+    def _prepare_embeds(self, req: CaptionRequest):
+        """Vision encode + token embed for one request -> ([T, D], t_valid)."""
         parts = []
         if req.frames is not None:
             vis = self._encode_images(self.params, jnp.asarray(req.frames)[None])
@@ -283,26 +317,46 @@ class CaptionEngine:
             # keep the tail (task instructions usually come last)
             embeds = embeds[-budget:]
             t_valid = budget
-        bucket = min(next_pow2(t_valid), self.cfg.max_seq)
-        if bucket > t_valid:
-            pad = jnp.zeros((bucket - t_valid, embeds.shape[-1]), embeds.dtype)
-            embeds = jnp.concatenate([embeds, pad], axis=0)
-        logits, self.cache_k, self.cache_v = self._prefill(
+        return embeds, t_valid
+
+    def _prefill_group(self, bucket: int, items: list) -> None:
+        """One batched prefill for all requests sharing a length bucket.
+
+        The row count is padded to a power of two by duplicating row 0
+        (same slot + same content → the duplicate scatter writes identical
+        values), so compiled program count stays O(log max_batch x
+        log max_seq)."""
+        n = len(items)
+        n_pad = min(next_pow2(n), self.max_batch)
+        dim = items[0][2].shape[-1]
+        embeds = np.zeros((n_pad, bucket, dim), np.float32)
+        slots_arr = np.zeros(n_pad, np.int32)
+        t_valids = np.ones(n_pad, np.int32)
+        for j, (slot_idx, _req, emb, t_valid) in enumerate(items):
+            embeds[j, :t_valid] = np.asarray(emb, np.float32)[:t_valid]
+            slots_arr[j] = slot_idx
+            t_valids[j] = t_valid
+        for j in range(n, n_pad):  # duplicate row 0 into padding
+            embeds[j] = embeds[0]
+            slots_arr[j] = slots_arr[0]
+            t_valids[j] = t_valids[0]
+        logits, self.cache_k, self.cache_v = self._prefill_batch(
             self.params,
             self.cache_k,
             self.cache_v,
-            embeds[None],
-            slot_idx,
-            jnp.asarray(t_valid, jnp.int32),
+            jnp.asarray(embeds),
+            jnp.asarray(slots_arr),
+            jnp.asarray(t_valids),
         )
-        logits_np = np.asarray(logits)
-        if req.sampling.temperature <= 0.0:
-            first = int(logits_np.argmax())
-        else:
-            first = self._sample_host(logits_np, req.sampling)
-        slot = _Slot(request=req, position=t_valid, generated=[first])
-        self.slots[slot_idx] = slot
-        self._maybe_finish(slot_idx, slot)
+        logits_np = np.asarray(logits)  # one host sync for the whole group
+        for j, (slot_idx, req, _emb, t_valid) in enumerate(items):
+            if req.sampling.temperature <= 0.0:
+                first = int(logits_np[j].argmax())
+            else:
+                first = self._sample_host(logits_np[j], req.sampling)
+            slot = _Slot(request=req, position=t_valid, generated=[first])
+            self.slots[slot_idx] = slot
+            self._maybe_finish(slot_idx, slot)
 
     def _decode_once(self) -> None:
         tokens = np.full(self.max_batch, self.tokenizer.pad_id, np.int32)

@@ -39,11 +39,33 @@ class VLMConfig:
     hidden_mult: float = 4.0
     max_seq: int = 1024
     rope_theta: float = 10000.0
+    # Qwen2-family checkpoints put biases on q/k/v (not o); ours default off.
+    qkv_bias: bool = False
     vision: ViTConfig = VIT_B_16
     vision_tokens: int = 64  # LM embeddings per image after pooling
 
 
 VLM_BASE = VLMConfig()
+# Qwen2-VL-2B-class shapes (reference serves Qwen2/2.5-VL via vLLM,
+# cosmos_curate/models/vllm_qwen.py:122-260): the LM stack matches
+# Qwen2-VL-2B-Instruct tensor-for-tensor (GQA 12/2 heads, SwiGLU 8960,
+# tied embeddings, rope 1e6) so convert_qwen.convert_qwen2_lm can load the
+# real checkpoint; the vision tower stays our ViT (Qwen's windowed vision
+# encoder is architecturally different — documented in convert_qwen.py).
+VLM_QWEN2_2B = VLMConfig(
+    vocab=151936,
+    dim=1536,
+    n_layers=28,
+    n_heads=12,
+    n_kv_heads=2,
+    head_dim=128,
+    hidden_mult=8960 / 1536,
+    max_seq=4096,
+    rope_theta=1_000_000.0,
+    qkv_bias=True,
+    vision=VIT_B_16,
+    vision_tokens=64,
+)
 VLM_TINY_TEST = VLMConfig(
     vocab=512,
     dim=64,
@@ -70,6 +92,18 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndar
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
+
+
+def _use_flash_decode(cache_len: int) -> bool:
+    """Gate for the Pallas decode kernel: on by default on TPU for caches
+    where streaming pays off; CURATE_FLASH_DECODE=1/0 forces (tests use 1
+    with the interpreter off-TPU)."""
+    import os
+
+    env = os.environ.get("CURATE_FLASH_DECODE")
+    if env is not None:
+        return env == "1"
+    return jax.devices()[0].platform == "tpu" and cache_len >= 512
 
 
 class RMSNorm(nn.Module):
@@ -100,9 +134,9 @@ class DecoderLayer(nn.Module):
         h, hk, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
         y = RMSNorm(name="ln1")(x)
-        q = dense(h * dh, "out", name="q", use_bias=False, dtype=self.dtype)(y)
-        k = dense(hk * dh, "out", name="k", use_bias=False, dtype=self.dtype)(y)
-        v = dense(hk * dh, "out", name="v", use_bias=False, dtype=self.dtype)(y)
+        q = dense(h * dh, "out", name="q", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
+        k = dense(hk * dh, "out", name="k", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
+        v = dense(hk * dh, "out", name="v", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
         q = apply_rope(q.reshape(b, t, h, dh), positions, cfg.rope_theta)
         k = apply_rope(k.reshape(b, t, hk, dh), positions, cfg.rope_theta)
         v = v.reshape(b, t, hk, dh)
@@ -114,19 +148,30 @@ class DecoderLayer(nn.Module):
         new_k = jax.vmap(write_row)(cache_k, k.astype(cache_k.dtype), write_index)
         new_v = jax.vmap(write_row)(cache_v, v.astype(cache_v.dtype), write_index)
 
-        # GQA attention of q against the whole (masked) cache
+        # GQA attention of q against the whole (masked) cache. Heads stay
+        # grouped ([B, T, Hkv, G, Dh] vs the KV's [B, S, Hkv, Dh]) — no
+        # jnp.repeat materialization, so HBM traffic is the true KV size
+        # (the decode step is KV-bandwidth-bound; for 12/2 GQA a repeat
+        # would read 6x the bytes).
         group = h // hk
-        kk = jnp.repeat(new_k, group, axis=2)  # [B, S, H, Dh]
-        vv = jnp.repeat(new_v, group, axis=2)
-        logits = jnp.einsum(
-            "bthd,bshd->bhts", (q * (dh**-0.5)).astype(jnp.float32), kk.astype(jnp.float32)
-        )
-        k_pos = jnp.arange(s)[None, None, None, :]  # cache slot index
-        causal = k_pos <= positions[:, None, :, None]  # key pos <= query pos
-        written = k_pos < kv_len[:, None, None, None]
-        logits = jnp.where(causal & written, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhts,bshd->bthd", probs.astype(self.dtype), vv)
+        if t == 1 and _use_flash_decode(s):
+            from cosmos_curate_tpu.ops.decode_attention import decode_attention
+
+            out = decode_attention(
+                q[:, 0].reshape(b, hk, group, dh), new_k, new_v, kv_len
+            )
+            attn = out.astype(self.dtype)[:, None]  # [B, 1, Hkv, G, Dh]
+        else:
+            qg = (q * (dh**-0.5)).reshape(b, t, hk, group, dh)
+            logits = jnp.einsum(
+                "btkgd,bskd->bkgts", qg.astype(jnp.float32), new_k.astype(jnp.float32)
+            )
+            k_pos = jnp.arange(s)[None, None, None, None, :]  # cache slot index
+            causal = k_pos <= positions[:, None, None, :, None]  # key pos <= query pos
+            written = k_pos < kv_len[:, None, None, None, None]
+            logits = jnp.where(causal & written, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            attn = jnp.einsum("bkgts,bskd->btkgd", probs.astype(self.dtype), new_v)
         attn = attn.reshape(b, t, h * dh)
         x = x + dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(attn)
 

@@ -78,3 +78,54 @@ def load_native() -> ctypes.CDLL | None:
             logger.warning("native library unavailable (%s); using Python path", e)
             _load_failed = True
     return _lib
+
+
+_H264_SRC = Path(__file__).parent / "h264_encoder.c"
+_h264_lock = threading.Lock()
+_h264_lib: ctypes.CDLL | None = None
+_h264_failed = False
+
+
+def load_h264() -> ctypes.CDLL | None:
+    """Compile (if needed) and load the H264 encoder binding; None when the
+    toolchain or the ffmpeg dev libraries are absent (callers fall back to
+    cv2's negotiated codec)."""
+    global _h264_lib, _h264_failed
+    if _h264_lib is not None or _h264_failed:
+        return _h264_lib
+    with _h264_lock:
+        if _h264_lib is not None or _h264_failed:
+            return _h264_lib
+        try:
+            src = _H264_SRC.read_bytes()
+            tag = hashlib.sha256(src).hexdigest()[:16]
+            so = _build_dir() / f"libcurate_h264-{tag}.so"
+            if not so.exists():
+                tmp = so.with_name(f"{so.stem}.{os.getpid()}.tmp.so")
+                cmd = [
+                    "gcc", "-O2", "-shared", "-fPIC",
+                    "-o", str(tmp), str(_H264_SRC),
+                    "-lavformat", "-lavcodec", "-lswscale", "-lavutil",
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                tmp.replace(so)
+                logger.info("built H264 encoder library %s", so.name)
+            lib = ctypes.CDLL(str(so))
+            lib.curate_h264_open.restype = ctypes.c_void_p
+            lib.curate_h264_open.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_double,
+                ctypes.c_int,
+                ctypes.c_char_p,
+            ]
+            lib.curate_h264_write.restype = ctypes.c_int
+            lib.curate_h264_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.curate_h264_close.restype = ctypes.c_int
+            lib.curate_h264_close.argtypes = [ctypes.c_void_p]
+            _h264_lib = lib
+        except Exception as e:
+            logger.warning("H264 encoder unavailable (%s); falling back to cv2", e)
+            _h264_failed = True
+    return _h264_lib

@@ -38,6 +38,9 @@ class AVPipelineArgs:
     clip_len_s: float = 10.0
     min_clip_len_s: float | None = None  # default: min(2.0, clip_len_s)
     caption_prompt_variant: str = "av"
+    # extra prompt variants captioned per clip (reference AV clips carry one
+    # caption per variant, captioning_stages.py:156)
+    extra_caption_variants: tuple[str, ...] = ()
     limit: int = 0
 
     @property
@@ -138,15 +141,16 @@ def run_av_split(args: AVPipelineArgs, *, runner: RunnerInterface | None = None)
 def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
     """Caption split clips (state 'split') with the AV prompt; store in db."""
     from cosmos_curate_tpu.models.prompts import get_caption_prompt
-    from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+    from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
     from cosmos_curate_tpu.models.vlm import CaptionEngine, CaptionRequest, SamplingConfig, VLM_BASE
     from cosmos_curate_tpu.storage.client import read_bytes
     from cosmos_curate_tpu.video.decode import extract_frames_at_fps
 
     t0 = time.monotonic()
     db = AVStateDB(args.resolved_db)
-    tok = ByteTokenizer()
-    prompt = get_caption_prompt(args.caption_prompt_variant)
+    tok = default_caption_tokenizer()
+    variants = [args.caption_prompt_variant, *args.extra_caption_variants]
+    prompts = {v: get_caption_prompt(v) for v in variants}
     try:
         todo = db.clips(state="split")
         if args.limit:
@@ -169,21 +173,88 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
             engine = CaptionEngine(VLM_BASE, max_batch=8)
             engine.setup()
         for cid, frames in pending:
-            engine.add_request(
-                CaptionRequest(
-                    request_id=cid,
-                    prompt_ids=tok.encode(prompt),
-                    frames=frames,
-                    sampling=SamplingConfig(max_new_tokens=96),
+            for variant in variants:
+                engine.add_request(
+                    CaptionRequest(
+                        request_id=f"{cid}::{variant}",
+                        prompt_ids=tok.encode(prompts[variant]),
+                        frames=frames,
+                        sampling=SamplingConfig(max_new_tokens=96),
+                    )
                 )
-            )
         for res in engine.run_until_complete():
-            db.set_caption(res.request_id, res.text)
+            cid, _, variant = res.request_id.rpartition("::")
+            # the primary variant lands in the clips table as "default"
+            db.set_caption(cid, res.text, "default" if variant == variants[0] else variant)
         return {
             "num_captioned": len(pending),
+            "num_variants": len(variants),
             "tokens_per_s": engine.tokens_per_second,
             "elapsed_s": time.monotonic() - t0,
         }
+    finally:
+        db.close()
+
+
+def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
+    """Package captioned clips into a training-dataset layout.
+
+    Equivalent capability of the reference's cosmos-predict2 dataset writer
+    (pipelines/av/writers/cosmos_predict2_writer_stage.py:288-555): per-camera
+    directories holding the clip video, the caption text, and the caption's
+    T5 per-token embedding; clip state advances to 'packaged', and sessions
+    whose clips are all packaged advance too.
+    """
+    import numpy as np
+
+    from cosmos_curate_tpu.models.t5 import T5_BASE, T5EncoderTPU
+    from cosmos_curate_tpu.storage.client import read_bytes
+
+    t0 = time.monotonic()
+    root = args.output_path.rstrip("/")
+    if "://" in root:
+        # clips are read through the URL-aware storage client, but the
+        # dataset layout is written with local paths — a remote output root
+        # would silently land in a local "s3:/..." directory.
+        raise ValueError(
+            f"av package writes the dataset locally; output_path {root!r} "
+            "must be a local directory (sync to object storage afterwards)"
+        )
+    db = AVStateDB(args.resolved_db)
+    try:
+        todo = db.clips(state="captioned")
+        if args.limit:
+            todo = todo[: args.limit]
+        if not todo:
+            return {"num_packaged": 0, "elapsed_s": time.monotonic() - t0}
+        if encoder is None:
+            encoder = T5EncoderTPU(T5_BASE)
+            encoder.setup()
+        from pathlib import Path
+
+        packaged = 0
+        texts = [r.caption for r in todo]
+        encoded = encoder.encode(texts)
+        for row, enc in zip(todo, encoded):
+            try:
+                clip_bytes = read_bytes(f"{root}/clips/{row.clip_uuid}.mp4")
+            except FileNotFoundError:
+                logger.warning("clip %s missing on disk; skipping", row.clip_uuid)
+                continue
+            cam_dir = Path(root) / "dataset" / row.camera
+            for sub in ("videos", "captions", "t5"):
+                (cam_dir / sub).mkdir(parents=True, exist_ok=True)
+            (cam_dir / "videos" / f"{row.clip_uuid}.mp4").write_bytes(clip_bytes)
+            (cam_dir / "captions" / f"{row.clip_uuid}.txt").write_text(row.caption)
+            np.save(cam_dir / "t5" / f"{row.clip_uuid}.npy", enc.embedding)
+            db.set_clip_state(row.clip_uuid, "packaged")
+            packaged += 1
+        # sessions whose clips are all packaged advance
+        for sid, _, _state in db.sessions():
+            states = {c.state for c in db.clips(session_id=sid)}
+            if states and states <= {"packaged"}:
+                db.set_session_state(sid, "packaged")
+        return {"num_packaged": packaged, "elapsed_s": time.monotonic() - t0}
     finally:
         db.close()
 

@@ -38,6 +38,12 @@ CREATE TABLE IF NOT EXISTS clips (
 );
 CREATE INDEX IF NOT EXISTS idx_clips_session ON clips (session_id);
 CREATE INDEX IF NOT EXISTS idx_clips_state ON clips (state);
+CREATE TABLE IF NOT EXISTS clip_captions (
+    clip_uuid TEXT NOT NULL,
+    variant TEXT NOT NULL,
+    caption TEXT NOT NULL,
+    PRIMARY KEY (clip_uuid, variant)
+);
 """
 
 
@@ -127,12 +133,41 @@ class AVStateDB:
             q += " WHERE " + " AND ".join(conds)
         return [ClipRow(*row) for row in self._conn.execute(q, args)]
 
-    def set_caption(self, clip_uuid: str, caption: str) -> None:
+    def set_caption(self, clip_uuid: str, caption: str, variant: str = "default") -> None:
+        """Store one prompt-variant's caption (reference AV clips carry a
+        caption per prompt variant, captioning_stages.py:156). The default
+        variant also fills the clips.caption column and advances state."""
         def op():
             with self._conn:
                 self._conn.execute(
-                    "UPDATE clips SET caption = ?, state = 'captioned' WHERE clip_uuid = ?",
-                    (caption, clip_uuid),
+                    "INSERT INTO clip_captions (clip_uuid, variant, caption) "
+                    "VALUES (?, ?, ?) ON CONFLICT(clip_uuid, variant) "
+                    "DO UPDATE SET caption = excluded.caption",
+                    (clip_uuid, variant, caption),
+                )
+                # Only the default variant advances state: 'captioned' must
+                # guarantee a non-empty clips.caption (packaging reads it),
+                # even if an extra variant finished while the primary failed.
+                if variant == "default":
+                    self._conn.execute(
+                        "UPDATE clips SET caption = ?, state = 'captioned' WHERE clip_uuid = ?",
+                        (caption, clip_uuid),
+                    )
+        _db_retry(op)
+
+    def variant_captions(self, clip_uuid: str) -> dict[str, str]:
+        return dict(
+            self._conn.execute(
+                "SELECT variant, caption FROM clip_captions WHERE clip_uuid = ?",
+                (clip_uuid,),
+            )
+        )
+
+    def set_clip_state(self, clip_uuid: str, state: str) -> None:
+        def op():
+            with self._conn:
+                self._conn.execute(
+                    "UPDATE clips SET state = ? WHERE clip_uuid = ?", (state, clip_uuid)
                 )
         _db_retry(op)
 

@@ -23,7 +23,7 @@ from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.core.tasks import PipelineTask
 from cosmos_curate_tpu.models.clip import AestheticScorer, CLIPImageEmbeddings
 from cosmos_curate_tpu.models.prompts import get_caption_prompt
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 from cosmos_curate_tpu.storage.client import get_storage_client, read_bytes, write_bytes
@@ -144,7 +144,7 @@ class ImageCaptionStage(Stage[ImageTask, ImageTask]):
         self.prompt_text = get_caption_prompt(prompt_variant)
         self.max_new_tokens = max_new_tokens
         self._model = _CaptionVLM(cfg, max_batch)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:

@@ -50,7 +50,8 @@ class SplitPipelineArgs:
     extract_resize_hw: tuple[int, int] = (224, 224)
     # model stages (enabled as they come online)
     motion_filter: str = "disable"  # disable | score-only | enable
-    motion_global_threshold: float = 0.00098
+    # calibrated for the frame-diff estimator (see stages/motion_filter.py)
+    motion_global_threshold: float = 0.004
     motion_patch_threshold: float = 0.0  # see motion_filter.py: opt-in criterion
     aesthetic_threshold: float | None = None
     text_filter: str = "disable"  # disable | score-only | enable

@@ -19,7 +19,7 @@ from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask, Window
 from cosmos_curate_tpu.models import registry
 from cosmos_curate_tpu.models.prompts import REFINEMENT_PROMPT, get_caption_prompt
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import (
     CaptionEngine,
     CaptionRequest,
@@ -132,7 +132,7 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.max_new_tokens = max_new_tokens
         self.refine = refine
         self._model = _CaptionVLM(cfg, max_batch)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = default_caption_tokenizer()
         self._refined_ids: set[str] = set()  # stage-2 bookkeeping (not user data)
 
     @property

@@ -12,7 +12,7 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask
 from cosmos_curate_tpu.models.prompts import ENHANCE_PROMPT
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 
@@ -29,7 +29,7 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.prompt_variant = prompt_variant
         self.max_new_tokens = max_new_tokens
         self._model = _CaptionVLM(cfg, max_batch)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:

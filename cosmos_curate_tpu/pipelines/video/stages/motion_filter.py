@@ -55,7 +55,15 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self,
         *,
         score_only: bool = False,
-        global_threshold: float = 0.00098,
+        # Calibrated for THIS estimator on synthetic static/panning/jittery
+        # fixtures through a real encode-decode roundtrip
+        # (benchmarks/motion_calibration.py): static clips score exactly 0
+        # (codecs skip-block static content), the weakest real motion ~0.06;
+        # 0.004 sits an order of magnitude below real motion and still
+        # catches small-area motion (a 40x40 box on 240x320 scores ~0.01).
+        # The reference's 0.00098 default is on its motion-vector scale and
+        # does NOT transfer (motion_filter_stages.py:40).
+        global_threshold: float = 0.004,
         # The reference's 1e-6 default is tuned for codec motion vectors;
         # our frame-diff estimator yields exact-zero patches on smooth
         # encodes, so the patch criterion defaults OFF (0.0) and is opt-in.

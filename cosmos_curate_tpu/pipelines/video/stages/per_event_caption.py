@@ -15,7 +15,7 @@ import numpy as np
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 from cosmos_curate_tpu.utils.logging import get_logger
@@ -69,7 +69,7 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self._model = _CaptionVLM(cfg, max_batch)
         self.max_new_tokens = max_new_tokens
         self.frames_per_event = frames_per_event
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:

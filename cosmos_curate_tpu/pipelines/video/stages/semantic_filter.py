@@ -16,7 +16,7 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
 from cosmos_curate_tpu.models.prompts import SEMANTIC_FILTER_PROMPTS
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 
@@ -50,7 +50,7 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.num_frames = num_frames
         self.extraction = extraction
         self._model = _CaptionVLM(cfg, max_batch)
-        self.tokenizer = ByteTokenizer()
+        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:

@@ -45,17 +45,38 @@ def _pick_codec() -> str:
     raise RuntimeError("no usable mp4 encoder in cv2 build")
 
 
+def negotiated_codec() -> str:
+    """The codec clips will actually be written with: native H264 when the
+    binding is live (reference guarantees H264 output,
+    clip_extraction_stages.py:167), else cv2's negotiated fallback."""
+    from cosmos_curate_tpu.video.h264 import h264_available
+
+    return "avc1" if h264_available() else _pick_codec()
+
+
+def make_writer(path: str, fps: float, w: int, h: int):
+    """(writer, codec) — writer has the cv2.VideoWriter call surface."""
+    from cosmos_curate_tpu.video.h264 import NativeH264Writer, h264_available
+
+    if h264_available():
+        writer = NativeH264Writer(path, fps, (w, h))
+        if writer.isOpened():
+            return writer, "avc1"
+    codec = _pick_codec()
+    writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+    return writer, codec
+
+
 def encode_frames(frames: np.ndarray, fps: float) -> bytes:
     """Encode RGB uint8 ``[T, H, W, 3]`` frames into an mp4 container."""
     if frames.ndim != 4 or frames.shape[-1] != 3:
         raise ValueError(f"expected [T,H,W,3] RGB frames, got {frames.shape}")
-    codec = _pick_codec()
     t, h, w, _ = frames.shape
-    # cv2's writer requires a real file path (no memfd: it re-opens by name).
+    # the writers require a real file path (no memfd: re-opened by name).
     fd, path = tempfile.mkstemp(suffix=".mp4")
     os.close(fd)
     try:
-        writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+        writer, codec = make_writer(path, fps, w, h)
         if not writer.isOpened():
             raise RuntimeError(f"encoder {codec} failed to open for {w}x{h}@{fps}")
         for i in range(t):
@@ -88,12 +109,13 @@ class _ClipWriter:
         self.path: str | None = None
         self.writer: cv2.VideoWriter | None = None
 
-    def open(self, codec: str, fps: float, w: int, h: int) -> None:
+    def open(self, fps: float, w: int, h: int) -> str:
         fd, self.path = tempfile.mkstemp(suffix=".mp4")
         os.close(fd)
-        self.writer = cv2.VideoWriter(self.path, cv2.VideoWriter_fourcc(*codec), fps, (w, h))
+        self.writer, codec = make_writer(self.path, fps, w, h)
         if not self.writer.isOpened():
             raise RuntimeError(f"encoder {codec} failed to open for {w}x{h}@{fps}")
+        return codec
 
     def finish(self) -> bytes:
         data = b""
@@ -131,7 +153,7 @@ def transcode_clips(
     (overlapping spans supported). Returns (mp4_bytes, codec) per span, in
     input order; spans past end-of-stream yield empty bytes.
     """
-    codec = _pick_codec()
+    codec = negotiated_codec()
     if not spans_s:
         return []
     with _open_capture(source) as cap:
@@ -168,7 +190,7 @@ def transcode_clips(
                     for i in active:
                         c = clips[i]
                         if c.writer is None:
-                            c.open(codec, fps, w, h)
+                            codec = c.open(fps, w, h)
                         c.writer.write(bgr)
                 idx += 1
             for i in active:

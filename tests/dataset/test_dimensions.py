@@ -1,0 +1,92 @@
+"""Dimension bucketing/codec tests (reference dimensions.py behaviors)."""
+
+import pytest
+
+from cosmos_curate_tpu.dataset.dimensions import (
+    ASPECT_BINS,
+    DURATION_BINS,
+    DimensionBucket,
+    Dimensions,
+    RangeBins,
+    RESOLUTION_BINS,
+    bucket_for,
+    round_to_even,
+)
+
+
+class TestEvenRounding:
+    def test_even_passthrough(self):
+        assert round_to_even(8) == 8
+
+    @pytest.mark.parametrize("n,want", [(7, 8), (9, 10), (9.5, 10), (1, 2), (3.9, 4), (6.1, 6)])
+    def test_rounds_to_nearest_even_ties_up(self, n, want):
+        assert round_to_even(n) == want
+
+
+class TestDimensions:
+    def test_resize_by_shortest_side_landscape(self):
+        d = Dimensions(1920, 1080).resize_by_shortest_side(720)
+        assert d == Dimensions(1280, 720)
+
+    def test_resize_by_shortest_side_portrait_even(self):
+        d = Dimensions(1080, 1921).resize_by_shortest_side(360)
+        assert d.width == 360
+        assert d.height % 2 == 0  # even-rounded long side
+
+    def test_resize_rejects_odd_target(self):
+        with pytest.raises(ValueError):
+            Dimensions(100, 100).resize_by_shortest_side(75)
+
+
+class TestRangeBins:
+    def test_contiguity_enforced(self):
+        with pytest.raises(ValueError):
+            RangeBins([0, 2, 2, 5], ["a", "b", "c"])
+
+    def test_edge_label_mismatch(self):
+        with pytest.raises(ValueError):
+            RangeBins([0, 1], ["a", "b"])
+
+    def test_left_vs_right_closed(self):
+        left = RangeBins([0, 10, 20], ["lo", "hi"], closed="left")
+        right = RangeBins([0, 10, 20], ["lo", "hi"], closed="right")
+        assert left.find(10) == "hi" and right.find(10) == "lo"
+
+    def test_out_of_range_none(self):
+        assert RangeBins([0, 1], ["a"]).find(5) is None
+
+
+class TestStandardBins:
+    def test_aspect_standard_dataset_bins(self):
+        assert ASPECT_BINS.find(16 / 9) == (16, 9)
+        assert ASPECT_BINS.find(9 / 16) == (9, 16)
+        assert ASPECT_BINS.find(1.0) == (1, 1)
+
+    def test_resolution_floor_semantics(self):
+        assert RESOLUTION_BINS.find(400) == "360p"  # 400-short is 360p-class
+        assert RESOLUTION_BINS.find(480) == "480p"
+        assert RESOLUTION_BINS.find(2160) == "2160p"
+
+    def test_duration_bands(self):
+        assert DURATION_BINS.find(1.5) == "0-2s"
+        assert DURATION_BINS.find(45.0) == "30-60s"
+        assert DURATION_BINS.find(1e6) == "60s-"
+
+
+class TestBucketCodec:
+    def test_path_roundtrip(self):
+        b = bucket_for(1920, 1080, 300, duration_s=12.0)
+        assert b.aspect == "16-9" and b.resolution == "1080p"
+        assert b.duration == "10-30s"
+        assert DimensionBucket.from_path(b.path) == b
+
+    def test_path_roundtrip_no_duration(self):
+        b = bucket_for(640, 480, 100)
+        assert DimensionBucket.from_path("prefix/" + b.path) == b
+
+    def test_from_path_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            DimensionBucket.from_path("resolution_abc/nope")
+
+    def test_degenerate_input_smallest_bucket(self):
+        assert bucket_for(0, 0, 0).key == "1-1_0p_w0"

@@ -1,0 +1,167 @@
+"""Qwen2 LM conversion parity + BPE tokenizer tests.
+
+The HF model is randomly initialized from config (no downloads): numeric
+agreement proves the architecture + conversion are exact, so loading a real
+Qwen2-VL-2B checkpoint is the same code path with real weights.
+"""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.models.tokenizer import BPETokenizer, ByteTokenizer
+
+
+class TestQwen2Parity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import torch
+
+        from cosmos_curate_tpu.models.convert_qwen import convert_qwen2_lm, qwen2_lm_config
+        from cosmos_curate_tpu.models.vlm.model import VLM, init_cache
+        from cosmos_curate_tpu.models.vit import VIT_TINY_TEST
+
+        cfg = transformers.Qwen2Config(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=64,
+            rope_theta=10000.0,
+            tie_word_embeddings=True,
+            attention_dropout=0.0,
+        )
+        torch.manual_seed(7)
+        hf = transformers.Qwen2ForCausalLM(cfg).eval()
+        ours_cfg = qwen2_lm_config(cfg, max_seq=32, vision=VIT_TINY_TEST, vision_tokens=4)
+        lm_params, report = convert_qwen2_lm(hf.state_dict(), cfg.num_hidden_layers)
+        model = VLM(ours_cfg, dtype=jnp.float32)
+        return hf, model, ours_cfg, lm_params, report
+
+    def test_every_lm_tensor_mapped(self, pair):
+        hf, _, _, _, report = pair
+        assert not report.unmapped, report.unmapped
+        assert set(report.mapped) >= {
+            k for k in hf.state_dict() if not k.startswith("visual.")
+        }
+
+    def test_logits_match(self, pair):
+        import jax
+        import torch
+
+        hf, model, cfg, lm_params, _ = pair
+        from cosmos_curate_tpu.models.convert_qwen import merge_lm_params
+        from cosmos_curate_tpu.models.vlm.model import init_cache
+
+        ids = np.random.default_rng(7).integers(0, 128, (2, 9)).astype(np.int32)
+        ck, cv = init_cache(cfg, 2, dtype=jnp.float32)
+        size = cfg.vision.image_size
+        init_tree = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 1, size, size, 3), jnp.uint8),
+            jnp.asarray(ids),
+            ck,
+            cv,
+            method=model.init_everything,
+        )
+        params = merge_lm_params(init_tree, lm_params)
+
+        embeds = model.apply(params, jnp.asarray(ids), method=model.embed_tokens)
+        t = ids.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(t), (2, t))
+        logits, _, _ = model.apply(
+            params,
+            embeds,
+            ck,
+            cv,
+            positions,
+            jnp.zeros((2,), jnp.int32),
+            jnp.full((2,), t, jnp.int32),
+        )
+        with torch.no_grad():
+            want = hf(input_ids=torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+        np.testing.assert_allclose(np.asarray(logits), want, atol=3e-4, rtol=1e-3)
+
+    def test_qwen2_2b_config_shapes(self):
+        """The flagship convertible config matches Qwen2-VL-2B's published
+        LM dimensions (vllm_qwen.py's served family)."""
+        from cosmos_curate_tpu.models.vlm.model import VLM_QWEN2_2B as c
+
+        assert (c.vocab, c.dim, c.n_layers) == (151936, 1536, 28)
+        assert (c.n_heads, c.n_kv_heads, c.head_dim) == (12, 2, 128)
+        assert int(c.dim * c.hidden_mult) == 8960
+        assert c.qkv_bias and c.rope_theta == 1_000_000.0
+
+
+class TestBPETokenizer:
+    CORPUS = [
+        "a video of a red car driving down the road",
+        "a video of a blue car parked near the road",
+        "the camera pans across a city street at night",
+        "a person walking a dog in the park",
+        "the red car turns left at the intersection",
+    ] * 4
+
+    def test_train_and_roundtrip(self):
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=400)
+        assert len(tok.merges) > 20
+        for text in ("a red car on the road", "unseen words tokenize too: zxqj!"):
+            ids = tok.encode(text)
+            assert ids[0] == tok.BOS
+            assert tok.decode(ids) == text
+
+    def test_compresses_vs_bytes(self):
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=450)
+        byte = ByteTokenizer()
+        text = "a video of a red car driving down the road"
+        assert len(tok.encode(text)) < 0.6 * len(byte.encode(text))
+
+    def test_special_token_layout_compatible(self):
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=300)
+        byte = ByteTokenizer()
+        assert (tok.pad_id, tok.eos_id, tok.BOS, tok.IMAGE) == (
+            byte.pad_id,
+            byte.eos_id,
+            byte.BOS,
+            byte.IMAGE,
+        )
+
+    def test_save_load(self, tmp_path):
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=350)
+        path = tmp_path / "bpe.json"
+        tok.save(path)
+        tok2 = BPETokenizer.load(path)
+        text = "the camera pans across"
+        assert tok.encode(text) == tok2.encode(text)
+        assert tok2.vocab_size == tok.vocab_size
+
+    def test_gpt2_format_files(self, tmp_path):
+        """Round-trips text through a GPT-2-format vocab/merges pair (the
+        file format Qwen2/GPT-2 checkpoints ship)."""
+        import json
+
+        from cosmos_curate_tpu.models.tokenizer import _gpt2_byte_encoder
+
+        enc = _gpt2_byte_encoder()
+
+        def to_str(b: bytes) -> str:
+            return "".join(enc[x] for x in b)
+
+        merges = [(b"t", b"h"), (b"th", b"e"), (b" ", b"the")]
+        (tmp_path / "merges.txt").write_text(
+            "#version: 0.2\n" + "\n".join(f"{to_str(a)} {to_str(b)}" for a, b in merges)
+        )
+        vocab = {to_str(bytes([i])): i for i in range(256)}
+        vocab.update({to_str(a + b): 256 + i for i, (a, b) in enumerate(merges)})
+        (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+        tok = BPETokenizer.from_gpt2_files(tmp_path / "vocab.json", tmp_path / "merges.txt")
+        text = "the theme of the day"
+        ids = tok.encode(text, add_bos=False)
+        assert tok.decode(ids) == text
+        # " the" merged into one token wherever it appears mid-text
+        assert sum(1 for i in ids if tok._token_bytes[i] == b" the") == 2

@@ -70,6 +70,80 @@ class TestStateDB:
         finally:
             db.close()
 
+    def test_variant_captions(self, tmp_path):
+        db = AVStateDB(str(tmp_path / "v.sqlite"))
+        try:
+            db.upsert_session("s1", 1)
+            db.add_clips([ClipRow("c1", "s1", "front", 0.0, 5.0)])
+            db.set_caption("c1", "main caption")  # default variant
+            db.set_caption("c1", "short one", "short")
+            assert db.variant_captions("c1") == {
+                "default": "main caption",
+                "short": "short one",
+            }
+            assert db.clips(state="captioned")[0].caption == "main caption"
+        finally:
+            db.close()
+
+
+class TestAVCaptionAndPackage:
+    def test_caption_variants_and_package(self, av_dir, tmp_path):
+        """split → multi-variant caption (tiny VLM) → predict2-style
+        packaging with caption text + T5 embedding per camera dir."""
+        import numpy as np
+
+        from cosmos_curate_tpu.models.t5 import T5_TINY_TEST, T5EncoderTPU
+        from cosmos_curate_tpu.models.vlm import CaptionEngine, VLM_TINY_TEST
+        from cosmos_curate_tpu.pipelines.av.pipeline import (
+            run_av_caption,
+            run_av_package,
+        )
+
+        args = AVPipelineArgs(
+            input_path=str(av_dir),
+            output_path=str(tmp_path / "out"),
+            clip_len_s=2.0,
+            min_clip_len_s=0.5,
+            caption_prompt_variant="av",
+            extra_caption_variants=("short",),
+            limit=2,
+        )
+        run_av_ingest(args)
+        run_av_split(args, runner=SequentialRunner())
+        engine = CaptionEngine(VLM_TINY_TEST, max_batch=4)
+        engine.setup()
+        cap = run_av_caption(args, engine=engine)
+        assert cap["num_captioned"] >= 1
+        assert cap["num_variants"] == 2
+
+        db = AVStateDB(args.resolved_db)
+        try:
+            row = db.clips(state="captioned")[0]
+            vc = db.variant_captions(row.clip_uuid)
+            assert set(vc) == {"default", "short"}
+        finally:
+            db.close()
+
+        enc = T5EncoderTPU(T5_TINY_TEST)
+        enc.setup()
+        pkg = run_av_package(args, encoder=enc)
+        assert pkg["num_packaged"] >= 1
+        root = tmp_path / "out" / "dataset"
+        cams = list(root.iterdir())
+        assert cams
+        vids = list((cams[0] / "videos").glob("*.mp4"))
+        assert vids
+        uuid = vids[0].stem
+        assert (cams[0] / "captions" / f"{uuid}.txt").read_text()
+        emb = np.load(cams[0] / "t5" / f"{uuid}.npy")
+        assert emb.ndim == 2 and emb.shape[1] == T5_TINY_TEST.dim
+
+        db = AVStateDB(args.resolved_db)
+        try:
+            assert db.clips(state="packaged")
+        finally:
+            db.close()
+
 
 class TestSuperResolution:
     def test_upscale_and_blend(self):

@@ -1,0 +1,31 @@
+"""Pin the motion-filter threshold calibration: static and moving fixture
+classes must separate cleanly around the shipped default."""
+
+from benchmarks.motion_calibration import (
+    MOVING_KINDS,
+    STATIC_KINDS,
+    make_fixture,
+    score_fixture,
+)
+from cosmos_curate_tpu.pipelines.video.stages.motion_filter import MotionFilterStage
+
+
+def test_default_threshold_separates_fixture_classes():
+    threshold = MotionFilterStage().global_threshold
+    # small fixtures keep this fast; the full sweep lives in
+    # benchmarks/motion_calibration.py
+    static_scores = [
+        score_fixture(make_fixture(k, 0, h=120, w=160, t=24))[0] for k in STATIC_KINDS
+    ]
+    moving_scores = [
+        score_fixture(make_fixture(k, 0, h=120, w=160, t=24))[0] for k in MOVING_KINDS
+    ]
+    assert max(static_scores) < threshold, (static_scores, threshold)
+    assert min(moving_scores) > threshold, (moving_scores, threshold)
+    # full-frame motion must clear the default with a wide margin; the
+    # corner-box (small-area motion) case sits near the boundary by design
+    full_frame = [
+        score_fixture(make_fixture(k, 1, h=120, w=160, t=24))[0]
+        for k in ("pan", "slow_pan", "jitter")
+    ]
+    assert min(full_frame) > 10 * threshold

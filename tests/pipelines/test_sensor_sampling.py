@@ -1,0 +1,153 @@
+"""Sensor sampling grid / policy / camera-sensor tests.
+
+Mirrors the reference's sampling behaviors (core/sensors/sampling/grid.py
+boundary contract; sampler.py decode-once counts; camera_sensor.py windowed
+batches)."""
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.sensors.camera_sensor import CameraSensor
+from cosmos_curate_tpu.sensors.data import CameraFrameRef, SensorSession
+from cosmos_curate_tpu.sensors.sampling import (
+    NS,
+    SamplingGrid,
+    SamplingPolicy,
+    SamplingSpec,
+    SamplingWindow,
+    find_closest_indices,
+    make_ts_grid,
+    sample_window_indices,
+)
+
+
+class TestMakeTsGrid:
+    def test_includes_start_and_bound_semantics(self):
+        start, excl, ts = make_ts_grid(0, end_ns=NS, sample_rate_hz=4.0)
+        assert start == 0 and ts[0] == 0
+        assert ts[-1] <= NS < excl
+        assert np.all(np.diff(ts) > 0)
+        assert not ts.flags.writeable
+
+    def test_exclusive_end_preserved_exactly(self):
+        _, excl, ts = make_ts_grid(0, sample_rate_hz=4.0, exclusive_end_ns=NS)
+        assert excl == NS
+        assert ts[-1] < NS
+
+    def test_uneven_interval_end_reachable(self):
+        # 0.3s at 4 Hz: 0, .25 — end 0.3 must stay below the exclusive bound
+        _, excl, ts = make_ts_grid(0, end_ns=int(0.3 * NS), sample_rate_hz=4.0)
+        assert ts[-1] <= 0.3 * NS < excl
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            make_ts_grid(0, end_ns=NS, sample_rate_hz=0)
+        with pytest.raises(ValueError):
+            make_ts_grid(0, end_ns=NS, sample_rate_hz=2.0, exclusive_end_ns=NS)
+        with pytest.raises(ValueError):
+            make_ts_grid(0, sample_rate_hz=2.0)
+        with pytest.raises(ValueError):
+            make_ts_grid(NS, end_ns=0, sample_rate_hz=2.0)
+
+
+class TestSamplingGridWindows:
+    def test_windows_cover_grid_half_open(self):
+        grid = SamplingGrid.from_rate(0, sample_rate_hz=10.0, end_ns=NS, window_size=4)
+        windows = list(grid)
+        assert len(windows) == len(grid)
+        total = sum(len(w) for w in windows)
+        assert total == len(grid.timestamps_ns)
+        # every window's exclusive end equals the next window's first ts
+        for a, b in zip(windows, windows[1:]):
+            assert a.exclusive_end_ns == b.timestamps_ns[0]
+        assert windows[-1].exclusive_end_ns == grid.exclusive_end_ns
+
+
+class TestSampler:
+    def test_find_closest(self):
+        canonical = np.array([0, 100, 200, 300], np.int64)
+        grid = np.array([10, 149, 151, 290], np.int64)
+        assert find_closest_indices(canonical, grid).tolist() == [0, 1, 2, 3]
+
+    def test_counts_decode_once(self):
+        canonical = np.array([0, 1000], np.int64)
+        w = SamplingWindow(np.array([0, 10, 20, 990], np.int64), 2000)
+        idx, counts = sample_window_indices(canonical, w)
+        assert idx.tolist() == [0, 1]
+        assert counts.tolist() == [3, 1]
+
+    def test_policy_tolerance_drops_far_points(self):
+        canonical = np.array([0, 1000], np.int64)
+        w = SamplingWindow(np.array([0, 400, 990], np.int64), 2000)
+        idx, counts = sample_window_indices(
+            canonical, w, policy=SamplingPolicy(tolerance_ns=50)
+        )
+        assert idx.tolist() == [0, 1]
+        assert counts.tolist() == [1, 1]  # the 400 point matched nothing
+
+    def test_zero_tolerance_means_exact(self):
+        canonical = np.array([100], np.int64)
+        w = SamplingWindow(np.array([99, 100], np.int64), 200)
+        idx, counts = sample_window_indices(
+            canonical, w, policy=SamplingPolicy(tolerance_ns=0)
+        )
+        assert idx.tolist() == [0] and counts.tolist() == [1]
+
+
+class TestCameraSensor:
+    @pytest.fixture()
+    def sensor(self, tmp_path):
+        from tests.fixtures.media import make_scene_video
+
+        path = make_scene_video(tmp_path / "cam.mp4", num_scenes=2, scene_len_frames=12)
+        refs = [
+            CameraFrameRef("front", str(path), i, i / 24.0) for i in range(24)
+        ]
+        return CameraSensor("front", refs)
+
+    def test_index_properties(self, sensor):
+        assert sensor.start_ns == 0
+        assert sensor.end_ns == round(23 / 24.0 * NS)
+        assert sensor.max_gap_ns == pytest.approx(NS / 24, rel=1e-6)
+
+    def test_sample_batches_align_with_windows(self, sensor):
+        grid = SamplingGrid.from_rate(
+            sensor.start_ns,
+            sample_rate_hz=8.0,
+            end_ns=sensor.end_ns,
+            window_size=4,
+        )
+        spec = SamplingSpec(grid, SamplingPolicy(tolerance_ns=NS // 10))
+        batches = list(sensor.sample(spec))
+        assert len(batches) == len(grid)
+        n = sum(len(b) for b in batches)
+        assert n == len(grid.timestamps_ns)  # every grid point matched
+        for b in batches:
+            if len(b):
+                assert b.frames.shape[0] == len(b)
+                assert b.frames.dtype == np.uint8
+                # chosen sensor timestamps are within tolerance of the grid
+                assert np.all(
+                    np.abs(b.sensor_timestamps_ns - b.align_timestamps_ns) <= NS // 10
+                )
+
+    def test_empty_window_yields_empty_batch(self, sensor):
+        # grid far past the video: batches exist, all empty
+        grid = SamplingGrid.from_rate(
+            10 * NS, sample_rate_hz=4.0, end_ns=11 * NS, window_size=8
+        )
+        spec = SamplingSpec(grid, SamplingPolicy(tolerance_ns=NS // 100))
+        batches = list(sensor.sample(spec))
+        assert len(batches) == len(grid)
+        assert all(len(b) == 0 for b in batches)
+
+    def test_from_session(self, tmp_path):
+        from tests.fixtures.media import make_scene_video
+
+        path = make_scene_video(tmp_path / "c.mp4", num_scenes=1, scene_len_frames=8)
+        session = SensorSession(session_id="s")
+        session.cameras["left"] = [
+            CameraFrameRef("left", str(path), i, i / 24.0) for i in range(8)
+        ]
+        s = CameraSensor.from_session(session, "left")
+        assert s.camera == "left" and len(s.timestamps_ns) == 8
