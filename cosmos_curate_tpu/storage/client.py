@@ -110,16 +110,13 @@ class LocalStorageClient(StorageClient):
 
 
 class _GatedClient(StorageClient):
-    """Raises a clear error for backends whose SDK is absent."""
+    """Raises a clear error for backends that can't be constructed here."""
 
     scheme = ""
-    sdk = ""
+    reason = ""
 
     def __init__(self) -> None:
-        raise RuntimeError(
-            f"{self.scheme} storage requires the {self.sdk} SDK, which is not "
-            f"installed in this image; stage data locally or install it"
-        )
+        raise RuntimeError(f"{self.scheme} storage unavailable: {self.reason}")
 
     def read_bytes(self, path): ...  # pragma: no cover
     def write_bytes(self, path, data): ...  # pragma: no cover
@@ -132,10 +129,17 @@ def _make_s3_client() -> StorageClient:
     try:
         import boto3  # noqa: F401
     except ImportError:
-        class S3Gated(_GatedClient):
-            scheme, sdk = "s3://", "boto3"
+        # SDK-free REST backend (SigV4 over urllib) — constructible whenever
+        # credentials are configured, so s3:// works in the zero-SDK image.
+        from cosmos_curate_tpu.storage.s3_rest import S3RestClient
 
-        return S3Gated()
+        try:
+            return S3RestClient()
+        except RuntimeError as e:
+            class S3Gated(_GatedClient):
+                scheme, reason = "s3://", f"{e} (installing boto3 also works)"
+
+            return S3Gated()
     from cosmos_curate_tpu.storage.s3 import S3StorageClient
 
     return S3StorageClient()
@@ -145,10 +149,15 @@ def _make_gcs_client() -> StorageClient:
     try:
         import google.cloud.storage  # noqa: F401
     except ImportError:
-        class GcsGated(_GatedClient):
-            scheme, sdk = "gs://", "google-cloud-storage"
+        from cosmos_curate_tpu.storage.gcs_rest import GcsRestClient
 
-        return GcsGated()
+        try:
+            return GcsRestClient()
+        except RuntimeError as e:
+            class GcsGated(_GatedClient):
+                scheme, reason = "gs://", f"{e} (installing google-cloud-storage also works)"
+
+            return GcsGated()
     from cosmos_curate_tpu.storage.gcs import GcsStorageClient
 
     return GcsStorageClient()

@@ -1,0 +1,151 @@
+"""SDK-free GCS backend over the JSON API (stdlib urllib).
+
+Twin of storage/gcs.py without the google-cloud-storage dependency, so the
+``gs://`` scheme works — and is testable against an in-process fake server
+(tests/storage/fake_gcs.py) — in the zero-SDK image. Auth is a bearer token
+(``GCS_OAUTH_TOKEN`` env or ``gcs.oauth_token`` config); the standard
+``STORAGE_EMULATOR_HOST`` convention selects an unauthenticated emulator
+endpoint, matching the public GCS client libraries' behavior.
+
+Reference capability: cosmos_curate/core/utils/storage/* cloud backends.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterator
+
+from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+
+_RETRIES = 4
+
+
+class GcsError(RuntimeError):
+    def __init__(self, status: int, body: str, context: str) -> None:
+        super().__init__(f"GCS {context} failed: HTTP {status}: {body[:500]}")
+        self.status = status
+
+
+def _split(path: str) -> tuple[str, str]:
+    rest = path[len("gs://"):]
+    bucket, _, key = rest.partition("/")
+    return bucket, key
+
+
+class GcsRestClient(StorageClient):
+    def __init__(self, *, host: str | None = None, token: str | None = None) -> None:
+        from cosmos_curate_tpu.utils.user_config import get_section
+
+        cfg = get_section("gcs")
+        self._host = (
+            host
+            or os.environ.get("STORAGE_EMULATOR_HOST")
+            or "https://storage.googleapis.com"
+        ).rstrip("/")
+        if not self._host.startswith("http"):
+            self._host = f"http://{self._host}"
+        self._token = token or os.environ.get("GCS_OAUTH_TOKEN") or cfg.get("oauth_token") or ""
+        emulator = "STORAGE_EMULATOR_HOST" in os.environ or host is not None
+        if not self._token and not emulator:
+            raise RuntimeError(
+                "gs:// access needs an OAuth token (GCS_OAUTH_TOKEN / gcs.oauth_token) "
+                "or STORAGE_EMULATOR_HOST"
+            )
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        *,
+        data: bytes = b"",
+        content_type: str = "application/octet-stream",
+        context: str = "",
+    ) -> tuple[int, bytes]:
+        last: Exception | None = None
+        for attempt in range(_RETRIES):
+            req = urllib.request.Request(url, data=data or None, method=method)
+            if self._token:
+                req.add_header("authorization", f"Bearer {self._token}")
+            if data:
+                req.add_header("content-type", content_type)
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                if e.code in (429, 500, 502, 503, 504) and attempt + 1 < _RETRIES:
+                    last = e
+                else:
+                    return e.code, body
+            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+                if attempt + 1 == _RETRIES:
+                    raise
+                last = e
+            time.sleep(min(2.0**attempt * 0.2, 5.0))
+        raise RuntimeError(f"GCS {context or method} exhausted retries: {last}")
+
+    def _obj_url(self, bucket: str, key: str, **params: str) -> str:
+        enc = urllib.parse.quote(key, safe="")
+        qs = urllib.parse.urlencode(params)
+        return f"{self._host}/storage/v1/b/{bucket}/o/{enc}" + (f"?{qs}" if qs else "")
+
+    def read_bytes(self, path: str) -> bytes:
+        bucket, key = _split(path)
+        status, body = self._request(
+            "GET", self._obj_url(bucket, key, alt="media"), context=f"get {path}"
+        )
+        if status != 200:
+            raise GcsError(status, body.decode(errors="replace"), f"get {path}")
+        return body
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        bucket, key = _split(path)
+        url = (
+            f"{self._host}/upload/storage/v1/b/{bucket}/o?"
+            + urllib.parse.urlencode({"uploadType": "media", "name": key})
+        )
+        status, body = self._request("POST", url, data=data, context=f"put {path}")
+        if status != 200:
+            raise GcsError(status, body.decode(errors="replace"), f"put {path}")
+
+    def exists(self, path: str) -> bool:
+        bucket, key = _split(path)
+        status, _ = self._request("GET", self._obj_url(bucket, key), context=f"stat {path}")
+        return status == 200
+
+    def delete(self, path: str) -> None:
+        bucket, key = _split(path)
+        status, body = self._request(
+            "DELETE", self._obj_url(bucket, key), context=f"delete {path}"
+        )
+        if status not in (200, 204, 404):
+            raise GcsError(status, body.decode(errors="replace"), f"delete {path}")
+
+    def list_files(
+        self, prefix: str, *, suffixes: tuple[str, ...] | None = None, recursive: bool = True
+    ) -> Iterator[ObjectInfo]:
+        bucket, key = _split(prefix)
+        token = ""
+        while True:
+            params = {"prefix": key, "maxResults": "1000"}
+            if not recursive:
+                params["delimiter"] = "/"
+            if token:
+                params["pageToken"] = token
+            url = f"{self._host}/storage/v1/b/{bucket}/o?" + urllib.parse.urlencode(params)
+            status, body = self._request("GET", url, context=f"list {prefix}")
+            if status != 200:
+                raise GcsError(status, body.decode(errors="replace"), f"list {prefix}")
+            payload = json.loads(body or b"{}")
+            for item in payload.get("items", []):
+                p = f"gs://{bucket}/{item['name']}"
+                if suffixes is None or p.lower().endswith(suffixes):
+                    yield ObjectInfo(p, int(item.get("size", 0)))
+            token = payload.get("nextPageToken", "")
+            if not token:
+                return

@@ -1,0 +1,134 @@
+"""In-process fake GCS JSON-API server for exercising GcsRestClient."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeGcsState:
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.lock = threading.Lock()
+
+
+def _handler(state: FakeGcsState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def _reply(self, status: int, body: bytes = b"") -> None:
+            self.send_response(status)
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            u = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(u.query)
+            # The official SDK downloads via /download/storage/v1/...
+            path = u.path
+            if path.startswith("/download/"):
+                path = path[len("/download"):]
+            parts = path.split("/")
+            # /storage/v1/b/{bucket}/o[/{object}]
+            if len(parts) >= 6 and parts[5] == "o" and len(parts) == 6:
+                bucket = parts[4]
+                prefix = q.get("prefix", [""])[0]
+                max_results = int(q.get("maxResults", ["1000"])[0])
+                token = q.get("pageToken", [""])[0]
+                delimiter = q.get("delimiter", [""])[0]
+                with state.lock:
+                    keys = sorted(
+                        k for (b, k) in state.objects if b == bucket and k.startswith(prefix)
+                    )
+                if delimiter:
+                    keys = [k for k in keys if delimiter not in k[len(prefix):]]
+                if token:
+                    keys = [k for k in keys if k > token]
+                page, rest = keys[:max_results], keys[max_results:]
+                payload = {
+                    "items": [
+                        {"name": k, "size": str(len(state.objects[(bucket, k)]))} for k in page
+                    ]
+                }
+                if rest:
+                    payload["nextPageToken"] = page[-1]
+                self._reply(200, json.dumps(payload).encode())
+                return
+            if len(parts) >= 7 and parts[5] == "o":
+                bucket = parts[4]
+                key = urllib.parse.unquote(parts[6])
+                with state.lock:
+                    data = state.objects.get((bucket, key))
+                if data is None:
+                    self._reply(404, b'{"error": {"code": 404}}')
+                elif q.get("alt", [""])[0] == "media":
+                    self._reply(200, data)
+                else:
+                    self._reply(
+                        200, json.dumps({"name": key, "size": str(len(data))}).encode()
+                    )
+                return
+            self._reply(400, b"bad path")
+
+        def do_POST(self) -> None:  # noqa: N802
+            u = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(u.query)
+            parts = u.path.split("/")
+            # /upload/storage/v1/b/{bucket}/o
+            if len(parts) >= 7 and parts[1] == "upload":
+                bucket = parts[5]
+                name = q.get("name", [""])[0]
+                length = int(self.headers.get("content-length", "0"))
+                data = self.rfile.read(length)
+                ctype = self.headers.get("content-type", "")
+                if q.get("uploadType", [""])[0] == "multipart" and "boundary=" in ctype:
+                    # multipart/related: part 1 = metadata JSON, part 2 = media
+                    boundary = ctype.split("boundary=", 1)[1].strip('"').encode()
+                    chunks = data.split(b"--" + boundary)
+                    media_parts = [c for c in chunks[1:-1] if c.strip()]
+                    meta_raw = media_parts[0].split(b"\r\n\r\n", 1)[1].rstrip(b"\r\n")
+                    name = json.loads(meta_raw).get("name", name)
+                    data = media_parts[1].split(b"\r\n\r\n", 1)[1].rstrip(b"\r\n")
+                with state.lock:
+                    state.objects[(bucket, name)] = data
+                self._reply(200, json.dumps({"name": name, "size": str(len(data))}).encode())
+                return
+            self._reply(400, b"bad upload path")
+
+        def do_DELETE(self) -> None:  # noqa: N802
+            parts = urllib.parse.urlparse(self.path).path.split("/")
+            if len(parts) >= 7 and parts[5] == "o":
+                bucket = parts[4]
+                key = urllib.parse.unquote(parts[6])
+                with state.lock:
+                    existed = state.objects.pop((bucket, key), None) is not None
+                self._reply(204 if existed else 404)
+                return
+            self._reply(400)
+
+    return Handler
+
+
+class FakeGcsServer:
+    def __init__(self) -> None:
+        self.state = FakeGcsState()
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _handler(self.state))
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "FakeGcsServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,186 @@
+"""In-process fake S3 server (moto-style) for exercising the REST backend.
+
+Speaks just enough of the S3 REST dialect for S3RestClient: path-style
+GET/PUT/HEAD/DELETE, ranged GET, ListObjectsV2 with continuation tokens, and
+the multipart-upload handshake. Objects live in a dict; no auth validation
+beyond requiring an Authorization header (the client must sign).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeS3State:
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.uploads: dict[str, dict[int, bytes]] = {}
+        self.upload_keys: dict[str, tuple[str, str]] = {}
+        self.next_upload = 0
+        self.lock = threading.Lock()
+        self.fail_next = 0  # respond 503 to this many requests (retry testing)
+
+
+def _handler(state: FakeS3State):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def _split(self) -> tuple[str, str, dict[str, list[str]]]:
+            u = urllib.parse.urlparse(self.path)
+            parts = u.path.lstrip("/").split("/", 1)
+            bucket = parts[0]
+            key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+            return bucket, key, urllib.parse.parse_qs(u.query, keep_blank_values=True)
+
+        def _maybe_fail(self) -> bool:
+            with state.lock:
+                if state.fail_next > 0:
+                    state.fail_next -= 1
+                    self.send_response(503)
+                    self.end_headers()
+                    self.wfile.write(b"slow down")
+                    return True
+            return False
+
+        def _reply(self, status: int, body: bytes = b"", headers: dict | None = None) -> None:
+            self.send_response(status)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            if self._maybe_fail():
+                return
+            bucket, key, q = self._split()
+            if "list-type" in q or not key:
+                self._list(bucket, q)
+                return
+            with state.lock:
+                data = state.objects.get((bucket, key))
+            if data is None:
+                self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
+                return
+            rng = self.headers.get("range", "")
+            if rng.startswith("bytes="):
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                start = int(start_s)
+                end = min(int(end_s), len(data) - 1) if end_s else len(data) - 1
+                self._reply(206, data[start : end + 1])
+                return
+            self._reply(200, data)
+
+        def _list(self, bucket: str, q: dict[str, list[str]]) -> None:
+            prefix = q.get("prefix", [""])[0]
+            max_keys = int(q.get("max-keys", ["1000"])[0])
+            token = q.get("continuation-token", [""])[0]
+            delimiter = q.get("delimiter", [""])[0]
+            with state.lock:
+                keys = sorted(k for (b, k) in state.objects if b == bucket and k.startswith(prefix))
+            if delimiter:
+                keys = [k for k in keys if delimiter not in k[len(prefix):]]
+            if token:
+                keys = [k for k in keys if k > token]
+            page, rest = keys[:max_keys], keys[max_keys:]
+            contents = "".join(
+                f"<Contents><Key>{k}</Key><Size>{len(state.objects[(bucket, k)])}</Size></Contents>"
+                for k in page
+            )
+            truncated = "true" if rest else "false"
+            next_tok = (
+                f"<NextContinuationToken>{page[-1]}</NextContinuationToken>" if rest else ""
+            )
+            body = (
+                f'<?xml version="1.0"?><ListBucketResult>'
+                f"<IsTruncated>{truncated}</IsTruncated>{next_tok}{contents}"
+                f"</ListBucketResult>"
+            ).encode()
+            self._reply(200, body)
+
+        def do_HEAD(self) -> None:  # noqa: N802
+            bucket, key, _ = self._split()
+            with state.lock:
+                data = state.objects.get((bucket, key))
+            if data is None:
+                self._reply(404)
+            else:
+                self._reply(200, data)
+
+        def do_PUT(self) -> None:  # noqa: N802
+            if self._maybe_fail():
+                return
+            bucket, key, q = self._split()
+            length = int(self.headers.get("content-length", "0"))
+            data = self.rfile.read(length)
+            if "partNumber" in q:
+                upload_id = q["uploadId"][0]
+                part = int(q["partNumber"][0])
+                with state.lock:
+                    state.uploads.setdefault(upload_id, {})[part] = data
+                self._reply(200, headers={"ETag": f'"part-{part}"'})
+                return
+            with state.lock:
+                state.objects[(bucket, key)] = data
+            self._reply(200)
+
+        def do_DELETE(self) -> None:  # noqa: N802
+            bucket, key, q = self._split()
+            with state.lock:
+                if "uploadId" in q:
+                    state.uploads.pop(q["uploadId"][0], None)
+                else:
+                    state.objects.pop((bucket, key), None)
+            self._reply(204)
+
+        def do_POST(self) -> None:  # noqa: N802
+            bucket, key, q = self._split()
+            if "uploads" in q:
+                with state.lock:
+                    state.next_upload += 1
+                    upload_id = f"up-{state.next_upload}"
+                    state.uploads[upload_id] = {}
+                    state.upload_keys[upload_id] = (bucket, key)
+                body = (
+                    f'<?xml version="1.0"?><InitiateMultipartUploadResult>'
+                    f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
+                ).encode()
+                self._reply(200, body)
+                return
+            if "uploadId" in q:
+                upload_id = q["uploadId"][0]
+                length = int(self.headers.get("content-length", "0"))
+                self.rfile.read(length)
+                with state.lock:
+                    parts = state.uploads.pop(upload_id, {})
+                    b, k = state.upload_keys.pop(upload_id, (bucket, key))
+                    state.objects[(b, k)] = b"".join(parts[n] for n in sorted(parts))
+                self._reply(200, b"<CompleteMultipartUploadResult/>")
+                return
+            self._reply(400, b"bad post")
+
+    return Handler
+
+
+class FakeS3Server:
+    def __init__(self) -> None:
+        self.state = FakeS3State()
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _handler(self.state))
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self) -> "FakeS3Server":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
