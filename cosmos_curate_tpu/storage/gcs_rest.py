@@ -115,8 +115,13 @@ class GcsRestClient(StorageClient):
 
     def exists(self, path: str) -> bool:
         bucket, key = _split(path)
-        status, _ = self._request("GET", self._obj_url(bucket, key), context=f"stat {path}")
-        return status == 200
+        status, body = self._request("GET", self._obj_url(bucket, key), context=f"stat {path}")
+        if status == 200:
+            return True
+        if status == 404:
+            return False
+        # auth failures / persistent outages must surface, not read as absent
+        raise GcsError(status, body.decode(errors="replace"), f"stat {path}")
 
     def delete(self, path: str) -> None:
         bucket, key = _split(path)

@@ -84,13 +84,16 @@ class S3RestClient(StorageClient):
     # -- wire helpers ------------------------------------------------------
 
     def _url_parts(self, bucket: str, key: str) -> tuple[str, str, str]:
-        """(scheme://netloc, host-header, uri-encoded path)."""
+        """(scheme, host, uri-encoded path) — path keeps any prefix carried
+        by a custom endpoint (e.g. MinIO behind a reverse-proxy path)."""
         enc_key = urllib.parse.quote(key, safe="/-_.~")
         if self._endpoint:
             u = urllib.parse.urlparse(self._endpoint)
-            return self._endpoint, u.netloc, f"/{bucket}/{enc_key}" if key else f"/{bucket}"
+            prefix = u.path.rstrip("/")
+            path = f"{prefix}/{bucket}/{enc_key}" if key else f"{prefix}/{bucket}"
+            return u.scheme, u.netloc, path
         host = f"{bucket}.s3.{self._region}.amazonaws.com"
-        return f"https://{host}", host, f"/{enc_key}"
+        return "https", host, f"/{enc_key}"
 
     def _request(
         self,
@@ -105,7 +108,7 @@ class S3RestClient(StorageClient):
         retryable: bool = True,
     ) -> tuple[int, bytes, dict[str, str]]:
         query = query or {}
-        base, host, url_path = self._url_parts(bucket, key)
+        scheme, host, url_path = self._url_parts(bucket, key)
         signed = sign_request(
             method=method,
             host=host,
@@ -116,8 +119,8 @@ class S3RestClient(StorageClient):
             creds=self._creds,
             region=self._region,
             )
-        qs = urllib.parse.urlencode(sorted(query.items()))
-        url = f"{base.split('://')[0]}://{host}{url_path}" + (f"?{qs}" if qs else "")
+        qs = urllib.parse.urlencode(sorted(query.items()), quote_via=urllib.parse.quote)
+        url = f"{scheme}://{host}{url_path}" + (f"?{qs}" if qs else "")
         last: Exception | None = None
         for attempt in range(_RETRIES):
             req = urllib.request.Request(url, data=data or None, method=method.upper())
@@ -174,7 +177,12 @@ class S3RestClient(StorageClient):
     def exists(self, path: str) -> bool:
         bucket, key = _split(path)
         status, _, _ = self._request("HEAD", bucket, key, context=f"head {path}")
-        return status == 200
+        if status == 200:
+            return True
+        if status == 404:
+            return False
+        # auth failures / persistent outages must surface, not read as absent
+        raise S3Error(status, "", f"head {path}")
 
     def size(self, path: str) -> int:
         bucket, key = _split(path)

@@ -2,15 +2,26 @@
 
 Speaks just enough of the S3 REST dialect for S3RestClient: path-style
 GET/PUT/HEAD/DELETE, ranged GET, ListObjectsV2 with continuation tokens, and
-the multipart-upload handshake. Objects live in a dict; no auth validation
-beyond requiring an Authorization header (the client must sign).
+the multipart-upload handshake. Objects live in a dict. SigV4 signatures are
+**re-computed and verified** against the known test secret, so a signing bug
+in storage/sigv4.py fails these tests instead of surfacing as a 403 against
+real AWS.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+TEST_ACCESS_KEY = "test-key"
+TEST_SECRET_KEY = "test-secret"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
 
 class FakeS3State:
@@ -21,6 +32,8 @@ class FakeS3State:
         self.next_upload = 0
         self.lock = threading.Lock()
         self.fail_next = 0  # respond 503 to this many requests (retry testing)
+        self.verify_signatures = True
+        self.auth_failures: list[str] = []
 
 
 def _handler(state: FakeS3State):
@@ -34,6 +47,73 @@ def _handler(state: FakeS3State):
             bucket = parts[0]
             key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
             return bucket, key, urllib.parse.parse_qs(u.query, keep_blank_values=True)
+
+        def _check_auth(self) -> bool:
+            """Re-compute the SigV4 signature with the known test secret and
+            compare to the client's Authorization header."""
+            if not state.verify_signatures:
+                return True
+            auth = self.headers.get("authorization", "")
+            try:
+                assert auth.startswith("AWS4-HMAC-SHA256 ")
+                fields = dict(
+                    part.strip().split("=", 1) for part in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+                )
+                cred = fields["Credential"].split("/")
+                access_key, datestamp, region, service = cred[0], cred[1], cred[2], cred[3]
+                assert access_key == TEST_ACCESS_KEY, f"unknown access key {access_key}"
+                signed_headers = fields["SignedHeaders"].split(";")
+                u = urllib.parse.urlparse(self.path)
+                pairs = sorted(
+                    (
+                        urllib.parse.quote(k, safe="-_.~"),
+                        urllib.parse.quote(v, safe="-_.~"),
+                    )
+                    for k, v in urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+                )
+                canonical_query = "&".join(f"{k}={v}" for k, v in pairs)
+                canonical_headers = "".join(
+                    f"{h}:{(self.headers.get(h) or '').strip()}\n" for h in signed_headers
+                )
+                payload_sha = self.headers.get("x-amz-content-sha256", "")
+                canonical_request = "\n".join(
+                    [
+                        self.command,
+                        u.path or "/",
+                        canonical_query,
+                        canonical_headers,
+                        ";".join(signed_headers),
+                        payload_sha,
+                    ]
+                )
+                amz_date = self.headers.get("x-amz-date", "")
+                scope = f"{datestamp}/{region}/{service}/aws4_request"
+                string_to_sign = "\n".join(
+                    [
+                        "AWS4-HMAC-SHA256",
+                        amz_date,
+                        scope,
+                        hashlib.sha256(canonical_request.encode()).hexdigest(),
+                    ]
+                )
+                key = _hmac(("AWS4" + TEST_SECRET_KEY).encode(), datestamp)
+                key = _hmac(key, region)
+                key = _hmac(key, service)
+                key = _hmac(key, "aws4_request")
+                expected = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+                assert hmac.compare_digest(expected, fields["Signature"]), (
+                    f"signature mismatch on {self.command} {self.path}"
+                )
+                return True
+            except (AssertionError, KeyError, IndexError) as e:
+                with state.lock:
+                    state.auth_failures.append(f"{self.command} {self.path}: {e}")
+                # drain the body so a mid-send client sees 403, not a reset
+                length = int(self.headers.get("content-length") or 0)
+                if length:
+                    self.rfile.read(length)
+                self._reply(403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>")
+                return False
 
         def _maybe_fail(self) -> bool:
             with state.lock:
@@ -55,6 +135,8 @@ def _handler(state: FakeS3State):
                 self.wfile.write(body)
 
         def do_GET(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
             if self._maybe_fail():
                 return
             bucket, key, q = self._split()
@@ -103,6 +185,8 @@ def _handler(state: FakeS3State):
             self._reply(200, body)
 
         def do_HEAD(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
             bucket, key, _ = self._split()
             with state.lock:
                 data = state.objects.get((bucket, key))
@@ -112,6 +196,8 @@ def _handler(state: FakeS3State):
                 self._reply(200, data)
 
         def do_PUT(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
             if self._maybe_fail():
                 return
             bucket, key, q = self._split()
@@ -129,6 +215,8 @@ def _handler(state: FakeS3State):
             self._reply(200)
 
         def do_DELETE(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
             bucket, key, q = self._split()
             with state.lock:
                 if "uploadId" in q:
@@ -138,6 +226,8 @@ def _handler(state: FakeS3State):
             self._reply(204)
 
         def do_POST(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
             bucket, key, q = self._split()
             if "uploads" in q:
                 with state.lock:

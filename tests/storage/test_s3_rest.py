@@ -87,8 +87,13 @@ def test_multipart_upload(client, server, monkeypatch):
 def test_storage_dispatch_uses_rest_fallback(server, monkeypatch):
     """get_storage_client('s3://...') must construct the REST client when
     boto3 is absent but credentials are configured."""
-    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "k")
-    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "s")
+    import sys
+
+    from tests.storage.fake_s3 import TEST_ACCESS_KEY, TEST_SECRET_KEY
+
+    monkeypatch.setitem(sys.modules, "boto3", None)  # simulate boto3 absence
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", TEST_ACCESS_KEY)
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", TEST_SECRET_KEY)
     monkeypatch.setenv("AWS_ENDPOINT_URL", server.endpoint)
     from cosmos_curate_tpu.storage import client as storage_client
 
@@ -96,6 +101,38 @@ def test_storage_dispatch_uses_rest_fallback(server, monkeypatch):
     assert isinstance(c, S3RestClient)
     c.write_bytes("s3://bkt/x", b"dispatch")
     assert storage_client.read_bytes("s3://bkt/x") == b"dispatch"
+
+
+def test_bad_secret_rejected(server):
+    """The fake re-computes SigV4 signatures, so a client signing with the
+    wrong secret must get 403 — proving the auth layer is actually checked."""
+    bad = S3RestClient(
+        access_key_id="test-key",
+        secret_access_key="WRONG",
+        region="us-east-1",
+        endpoint_url=server.endpoint,
+    )
+    with pytest.raises(S3Error) as ei:
+        bad.write_bytes("s3://bkt/x.bin", b"data")
+    assert ei.value.status == 403
+    # exists() must surface the auth failure, not read it as absence
+    with pytest.raises(S3Error) as ei2:
+        bad.exists("s3://bkt/x.bin")
+    assert ei2.value.status == 403
+    assert server.state.auth_failures
+
+
+def test_endpoint_path_prefix_preserved():
+    """A reverse-proxied endpoint like https://gw/minio must keep its path
+    prefix in both the signed and sent URL."""
+    c = S3RestClient(
+        access_key_id="k",
+        secret_access_key="s",
+        region="r",
+        endpoint_url="https://gw.example.com/minio",
+    )
+    scheme, host, path = c._url_parts("bkt", "a/b.txt")
+    assert (scheme, host, path) == ("https", "gw.example.com", "/minio/bkt/a/b.txt")
 
 
 def test_non_recursive_list(client):
