@@ -113,6 +113,17 @@ def _cmd_merge_summaries(args: argparse.Namespace) -> int:
     if merged is None:
         print(f"no summaries found under {args.output_path}")
         return 1
+    # this runs once per multi-node run, after all nodes finished — also the
+    # right moment for artifact delivery's driver phase (manifest merge,
+    # chunk verify/reassembly)
+    from cosmos_curate_tpu.observability.artifacts import finalize_delivery
+
+    report = finalize_delivery(args.output_path)
+    if report.files or report.errors:
+        print(
+            f"artifacts: {report.files} files from nodes {report.nodes}"
+            + (f"; ERRORS: {report.errors}" if report.errors else "")
+        )
     print(json.dumps(merged, indent=2))
     return 0
 

@@ -1,12 +1,14 @@
-"""Two-layer tracing: a dependency-light span API + a pluggable backend.
+"""Two-layer tracing: a dependency-light span API + pluggable backends.
 
 Equivalent capability of the reference's tracing design
 (cosmos_curate/core/utils/infra/tracing.py:326-770 public API — TracedSpan /
 traced_span / @traced, no-ops when disabled — and tracing_hook.py's
 per-worker NDJSON export). Spans are recorded to one NDJSON file per process
-(collectable post-run) and, when the opentelemetry SDK is configured by the
-embedding application, mirrored onto real OTel spans. Disabled = zero-cost:
-every call path short-circuits on one boolean.
+(collectable post-run) and, when an OTLP endpoint is configured
+(``OTEL_EXPORTER_OTLP_ENDPOINT`` / ``CURATE_OTLP_ENDPOINT``), exported to a
+real collector over OTLP/HTTP JSON — encoded directly against the public
+OTLP schema, no opentelemetry SDK needed. Disabled = zero-cost: every call
+path short-circuits on one boolean.
 """
 
 from __future__ import annotations
@@ -22,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
 _enabled = False
-_backend: "_NdjsonBackend | None" = None
+_backends: list = []
 _local = threading.local()
 
 
@@ -84,6 +86,149 @@ class _NdjsonBackend:
                 self._flush_locked()
 
 
+class _OtlpHttpBackend:
+    """OTLP/HTTP JSON trace exporter (opentelemetry-proto trace service
+    schema, JSON encoding) — POSTs span batches to ``{endpoint}/v1/traces``
+    with stdlib urllib; errors are logged once and never break the pipeline.
+    """
+
+    BATCH = 100
+    MAX_QUEUED_BATCHES = 8
+
+    def __init__(self, endpoint: str, service_name: str = "cosmos-curate-tpu") -> None:
+        self.url = endpoint.rstrip("/") + "/v1/traces"
+        self.service_name = service_name
+        self._spans: list[TracedSpan] = []
+        self._lock = threading.Lock()
+        self._warned = False
+        # posts happen on a background thread so a blackholed collector can
+        # never stall traced application threads; full queue = drop batch
+        import queue as queue_mod
+
+        self._q: "queue_mod.Queue" = queue_mod.Queue(maxsize=self.MAX_QUEUED_BATCHES)
+        self._sender = threading.Thread(target=self._send_loop, daemon=True)
+        self._sender.start()
+
+    def _send_loop(self) -> None:
+        while True:
+            batch = self._q.get()
+            if batch is None:
+                return
+            self._post(batch)
+
+    @staticmethod
+    def _attr(key: str, value: Any) -> dict[str, Any]:
+        if isinstance(value, bool):
+            v: dict[str, Any] = {"boolValue": value}
+        elif isinstance(value, int):
+            v = {"intValue": str(value)}
+        elif isinstance(value, float):
+            v = {"doubleValue": value}
+        else:
+            v = {"stringValue": str(value)}
+        return {"key": key, "value": v}
+
+    def _encode(self, spans: list[TracedSpan]) -> bytes:
+        otlp_spans = []
+        for s in spans:
+            rec = {
+                "traceId": s.trace_id.ljust(32, "0"),
+                "spanId": s.span_id,
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(int(s.start_s * 1e9)),
+                "endTimeUnixNano": str(int((s.end_s or s.start_s) * 1e9)),
+                "attributes": [self._attr(k, v) for k, v in s.attributes.items()],
+                "status": (
+                    {"code": 2, "message": str(s.attributes["error"])}
+                    if "error" in s.attributes
+                    else {"code": 1}
+                ),
+            }
+            if s.parent_id:
+                rec["parentSpanId"] = s.parent_id
+            otlp_spans.append(rec)
+        payload = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            self._attr("service.name", self.service_name),
+                            self._attr("process.pid", os.getpid()),
+                        ]
+                    },
+                    "scopeSpans": [
+                        {"scope": {"name": "cosmos_curate_tpu.tracing"}, "spans": otlp_spans}
+                    ],
+                }
+            ]
+        }
+        return json.dumps(payload).encode()
+
+    def export(self, span: TracedSpan) -> None:
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) >= self.BATCH:
+                batch, self._spans = self._spans, []
+            else:
+                return
+        try:
+            self._q.put_nowait(batch)
+        except Exception:
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "OTLP export queue full; dropping span batches (collector at %s "
+                    "unreachable or slow)", self.url,
+                )
+
+    def _post(self, batch: list[TracedSpan]) -> None:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url,
+            data=self._encode(batch),
+            headers={"content-type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "OTLP trace export to %s failing (%s); further errors suppressed",
+                    self.url,
+                    e,
+                )
+
+    def close(self) -> None:
+        with self._lock:
+            batch, self._spans = self._spans, []
+        if batch:
+            try:
+                self._q.put_nowait(batch)
+            except Exception:
+                pass
+        try:
+            self._q.put_nowait(None)
+        except Exception:
+            return  # queue jammed by a dead collector; daemon thread dies with us
+        self._sender.join(timeout=15)
+
+
+def otlp_endpoint_from_env() -> str | None:
+    return os.environ.get("CURATE_OTLP_ENDPOINT") or os.environ.get(
+        "OTEL_EXPORTER_OTLP_ENDPOINT"
+    )
+
+
 def default_staging_dir() -> str:
     """Per-run staging dir: concurrent pipelines on one host must not sweep
     each other's artifacts. The run id is the coordinator pid, which the
@@ -92,23 +237,31 @@ def default_staging_dir() -> str:
     return os.environ.get("CURATE_TRACE_DIR", f"/tmp/curate_traces/run-{run}")
 
 
-def enable_tracing(output_path: str | None = None) -> str:
-    """Turn tracing on for this process; returns the NDJSON path."""
-    global _enabled, _backend
+def enable_tracing(
+    output_path: str | None = None, *, otlp_endpoint: str | None = None
+) -> str:
+    """Turn tracing on for this process; returns the NDJSON path. An OTLP
+    collector endpoint (argument or env) adds a second export backend."""
+    global _enabled, _backends
     path = output_path or os.environ.get(
         "CURATE_TRACE_PATH", f"{default_staging_dir()}/trace-{os.getpid()}.ndjson"
     )
-    _backend = _NdjsonBackend(path)
+    for b in _backends:  # re-enable must not drop buffered spans
+        b.close()
+    _backends = [_NdjsonBackend(path)]
+    endpoint = otlp_endpoint or otlp_endpoint_from_env()
+    if endpoint:
+        _backends.append(_OtlpHttpBackend(endpoint))
     _enabled = True
     return path
 
 
 def disable_tracing() -> None:
-    global _enabled, _backend
+    global _enabled, _backends
     _enabled = False
-    if _backend is not None:
-        _backend.close()
-        _backend = None
+    for b in _backends:
+        b.close()
+    _backends = []
 
 
 def tracing_enabled() -> bool:
@@ -146,8 +299,8 @@ def traced_span(name: str, **attributes: Any) -> Iterator[TracedSpan]:
     finally:
         span.end_s = time.time()
         stack.pop()
-        if _backend is not None:
-            _backend.export(span)
+        for b in _backends:
+            b.export(span)
 
 
 class _NoopSpan(TracedSpan):

@@ -222,9 +222,19 @@ def run_split(
 
             disable_tracing()  # flushes buffered spans through storage
         if args.tracing or args.profile_cpu or args.profile_memory:
-            from cosmos_curate_tpu.observability.artifacts import collect_artifacts
+            from cosmos_curate_tpu.observability.artifacts import (
+                collect_artifacts,
+                finalize_delivery,
+            )
+            from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
 
             collect_artifacts(args.output_path)
+            rank, count = node_rank_and_count()
+            if count == 1:
+                # single node: this process is also the delivery driver.
+                # Multi-node runs finalize from the merge-summaries step
+                # (cli/local_cli.py), once every node has collected.
+                finalize_delivery(args.output_path)
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
     summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)

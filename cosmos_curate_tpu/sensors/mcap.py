@@ -379,6 +379,40 @@ class McapReader:
             elif op in (OP_DATA_END, OP_FOOTER):
                 return
 
+    def iter_message_times(self, topics: str | list[str] | None = None) -> Iterator[int]:
+        """Yield message log_times (file order, payloads discarded as they
+        stream) — the memory-safe way to build a timeline."""
+        if isinstance(topics, str):
+            topics = [topics]
+        summary = self.get_summary()
+        want = (
+            None
+            if topics is None
+            else {c.id for c in summary.channels.values() if c.topic in topics}
+        )
+        channels: dict[int, Channel] = dict(summary.channels)
+        for op, content, _ in self._iter_records(len(MAGIC)):
+            if op == OP_CHANNEL:
+                c = self._parse_channel(content)
+                channels[c.id] = c
+            elif op == OP_MESSAGE:
+                m = self._parse_message(content)
+                if want is None or m.channel_id in want:
+                    yield m.log_time
+            elif op == OP_CHUNK:
+                for iop, icontent in self._iter_chunk_records(content):
+                    if iop == OP_CHANNEL:
+                        c = self._parse_channel(icontent)
+                        channels[c.id] = c
+                    elif iop == OP_MESSAGE:
+                        cur = _Cursor(icontent)
+                        cid = cur.u16()
+                        if want is None or cid in want:
+                            cur.u32()  # sequence
+                            yield cur.u64()  # log_time (payload never sliced)
+            elif op in (OP_DATA_END, OP_FOOTER):
+                return
+
     def iter_messages(
         self,
         topics: str | list[str] | None = None,
@@ -653,7 +687,9 @@ def get_metadata_record(reader: McapReader, name: str) -> dict[str, str]:
 def load_timeline(reader: McapReader, topic: str):
     import numpy as np
 
-    times = [m.log_time for _, _, m in reader.iter_messages(topics=topic)]
+    # payload-free scan: a multi-GB capture must not be resident just to
+    # read its timestamps
+    times = sorted(reader.iter_message_times(topics=topic))
     if not times:
         raise McapError(f"no MCAP messages on topic {topic!r}")
     arr = np.array(times, dtype=np.int64)

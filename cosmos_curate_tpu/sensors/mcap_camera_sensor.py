@@ -12,7 +12,6 @@ video.
 
 from __future__ import annotations
 
-import io
 from pathlib import Path
 
 import numpy as np
@@ -61,14 +60,24 @@ class McapCameraSensor:
     def __init__(self, path: str | Path, topic: str = DEFAULT_TOPIC) -> None:
         self.path = Path(path)
         self.topic = topic
-        self._data = self.path.read_bytes()
-        self._reader = make_reader(io.BytesIO(self._data))
+        # seekable file handle, NOT read_bytes: a 10 GB capture must not be
+        # resident for the sensor's lifetime
+        self._reader = make_reader(open(self.path, "rb"))
         channel = channel_for_topic(self._reader.get_summary(), topic)
         if channel is None:
             raise McapError(f"MCAP file {path} has no channel for topic {topic!r}")
         self._channel = channel
         self.width, self.height = _rgb8_dims(channel)
         self._ts_ns = load_timeline(self._reader, topic)
+
+    def close(self) -> None:
+        self._reader._f.close()
+
+    def __enter__(self) -> "McapCameraSensor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     @property
     def video_metadata(self) -> dict[str, str]:
@@ -120,10 +129,18 @@ class McapCameraSensor:
             lo = int(self._ts_ns[sel[0]])
             hi = int(self._ts_ns[sel[-1]]) + 1
             times, payloads = self._frames_for_window(lo, hi)
-            by_time = {int(t): p for t, p in zip(times, payloads)}
+            # map timeline positions, not log_times: messages sharing one
+            # timestamp must keep their distinct payloads (fetch order and
+            # the timeline are both stable log_time sorts of file order)
+            first_pos = int(np.searchsorted(self._ts_ns, lo, side="left"))
+            if len(payloads) != int(np.searchsorted(self._ts_ns, hi, side="left")) - first_pos:
+                raise McapError(
+                    f"window fetch returned {len(payloads)} frames, timeline expects "
+                    f"{int(np.searchsorted(self._ts_ns, hi, side='left')) - first_pos}"
+                )
             frames = np.stack(
                 [
-                    np.frombuffer(by_time[int(self._ts_ns[i])], np.uint8).reshape(shape)
+                    np.frombuffer(payloads[i - first_pos], np.uint8).reshape(shape)
                     for i in sel
                 ]
             )

@@ -1,0 +1,139 @@
+"""Cross-node artifact collection/delivery (chunking, manifests, merge,
+failure isolation) — reference collector.py/delivery.py capability."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.observability.artifacts import (
+    ArtifactCollector,
+    collect_artifacts,
+    finalize_delivery,
+)
+
+
+def _stage(tmp_path: Path, node: str, files: dict[str, bytes]) -> Path:
+    d = tmp_path / f"staging_{node}" / "traces"
+    d.mkdir(parents=True)
+    for name, data in files.items():
+        p = d / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+    return d
+
+
+def test_two_node_collect_and_finalize(tmp_path):
+    out = tmp_path / "out"
+    s0 = _stage(tmp_path, "0", {"t0.ndjson": b"span0\n", "sub/prof.json": b"{}"})
+    s1 = _stage(tmp_path, "1", {"t1.ndjson": b"span1\n"})
+
+    r0 = ArtifactCollector(str(out), node_tag="0").collect((str(s0),))
+    r1 = ArtifactCollector(str(out), node_tag="1").collect((str(s1),))
+    assert (r0.files, r1.files) == (2, 1)
+    assert not r0.errors and not r1.errors
+    # staged files were cleaned up after successful collection
+    assert not list(s0.rglob("*.ndjson")) and not list(s1.rglob("*.ndjson"))
+
+    report = finalize_delivery(str(out), expected_nodes=["0", "1"])
+    assert report.ok
+    assert report.nodes == ["0", "1"]
+    assert report.files == 3
+    index = json.loads((out / "profile/collected/index.json").read_text())
+    assert index["nodes"] == ["0", "1"]
+    assert (out / "profile/collected/node0/traces/sub/prof.json").read_bytes() == b"{}"
+
+
+def test_chunked_transfer_and_reassembly(tmp_path):
+    out = tmp_path / "out"
+    big = bytes(range(256)) * 5000  # 1.28 MB
+    staging = _stage(tmp_path, "0", {"big.bin": big})
+    c = ArtifactCollector(str(out), node_tag="0", chunk_bytes=100_000)
+    res = c.collect((str(staging),))
+    assert res.files == 1 and res.bytes == len(big)
+    # chunk objects exist pre-finalize
+    chunks = list((out / "profile/collected/node0/traces").glob("big.bin.chunk*"))
+    assert len(chunks) == 13
+
+    report = finalize_delivery(str(out))
+    assert report.ok, report.errors
+    reassembled = out / "profile/collected/node0/traces/big.bin"
+    assert reassembled.read_bytes() == big
+    assert not list((out / "profile/collected/node0/traces").glob("*.chunk*"))
+
+
+def test_missing_chunk_detected(tmp_path):
+    out = tmp_path / "out"
+    staging = _stage(tmp_path, "0", {"big.bin": b"x" * 300_000})
+    ArtifactCollector(str(out), node_tag="0", chunk_bytes=100_000).collect((str(staging),))
+    (out / "profile/collected/node0/traces/big.bin.chunk00001").unlink()
+    report = finalize_delivery(str(out))
+    assert not report.ok
+    assert any("missing 1 chunks" in e for e in report.errors)
+
+
+def test_upload_failure_isolated_and_file_kept(tmp_path, monkeypatch):
+    out = tmp_path / "out"
+    staging = _stage(tmp_path, "0", {"ok.json": b"{}", "bad.json": b"boom"})
+
+    import cosmos_curate_tpu.observability.artifacts as artifacts_mod
+
+    real = artifacts_mod.write_bytes
+
+    def flaky(path, data):
+        if path.endswith("bad.json"):
+            raise OSError("injected upload failure")
+        real(path, data)
+
+    monkeypatch.setattr(artifacts_mod, "write_bytes", flaky)
+    res = ArtifactCollector(str(out), node_tag="0").collect((str(staging),))
+    assert res.errors and "bad.json" in res.errors[0]
+    # the failed file survives staging for a retry; the good one was cleaned
+    assert (staging / "bad.json").exists()
+    assert not (staging / "ok.json").exists()
+
+    monkeypatch.setattr(artifacts_mod, "write_bytes", real)
+    report = finalize_delivery(str(out), expected_nodes=["0"])
+    assert any("bad.json" in e for e in report.errors)
+
+
+def test_missing_node_reported(tmp_path):
+    out = tmp_path / "out"
+    staging = _stage(tmp_path, "0", {"a.json": b"{}"})
+    ArtifactCollector(str(out), node_tag="0").collect((str(staging),))
+    report = finalize_delivery(str(out), expected_nodes=["0", "1"])
+    assert report.missing_nodes == ["1"]
+    assert not report.ok
+
+
+def test_collect_to_remote_rendezvous(tmp_path, monkeypatch):
+    """Two nodes push to the same s3:// prefix (fake server); the driver
+    finalizes from storage alone — the true multi-node rendezvous path."""
+    from tests.storage.fake_s3 import TEST_ACCESS_KEY, TEST_SECRET_KEY, FakeS3Server
+
+    with FakeS3Server() as srv:
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", TEST_ACCESS_KEY)
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", TEST_SECRET_KEY)
+        monkeypatch.setenv("AWS_ENDPOINT_URL", srv.endpoint)
+        out = "s3://artifacts/run1"
+        s0 = _stage(tmp_path, "0", {"t0.ndjson": b"span0\n"})
+        s1 = _stage(tmp_path, "1", {"big.bin": b"z" * 250_000})
+        ArtifactCollector(out, node_tag="0").collect((str(s0),))
+        ArtifactCollector(out, node_tag="1", chunk_bytes=100_000).collect((str(s1),))
+
+        report = finalize_delivery(out, expected_nodes=["0", "1"])
+        assert report.ok, report.errors
+        assert report.files == 2
+        # remote destination: chunks stay chunked, manifest records the map
+        man = json.loads(
+            srv.state.objects[("artifacts", "run1/profile/collected/node1/_manifest.json")]
+        )
+        assert man["files"]["traces/big.bin"]["chunks"] == 3
+
+
+def test_legacy_wrapper(tmp_path):
+    out = tmp_path / "out"
+    staging = _stage(tmp_path, "0", {"x.json": b"1"})
+    assert collect_artifacts(str(out), staging_dirs=(str(staging),)) == 1

@@ -135,3 +135,34 @@ def test_mcap_camera_sensor(tmp_path):
     assert first.frames.dtype == np.uint8
     # ns grid at half rate must select every other source frame
     assert list(first.frame_indices[:3]) == [0, 2, 4]
+
+
+def test_duplicate_log_times_keep_distinct_payloads(tmp_path):
+    """Two frames sharing one log_time (burst capture) must both surface
+    with their own payloads, not collapse to one."""
+    import io as io_mod
+
+    from cosmos_curate_tpu.sensors.mcap_camera_sensor import McapCameraSensor
+    from cosmos_curate_tpu.sensors.sampling import SamplingGrid, SamplingSpec
+
+    buf = io_mod.BytesIO()
+    with McapWriter(buf) as w:
+        cid = w.register_channel("/camera/rgb", "rgb8", 0, {"width": "2", "height": "1"})
+        w.add_message(cid, 1_000, bytes([1] * 6))
+        w.add_message(cid, 1_000, bytes([2] * 6))  # same instant, burst pair
+        w.add_message(cid, 2_000, bytes([3] * 6))
+        w.add_metadata("cosmos_curate.video_metadata.v1", {"num_frames": "3"})
+    path = tmp_path / "burst.mcap"
+    path.write_bytes(buf.getvalue())
+
+    sensor = McapCameraSensor(path)
+    assert list(sensor.timestamps_ns) == [1_000, 1_000, 2_000]
+    spec = SamplingSpec(
+        grid=SamplingGrid.from_rate(
+            1_000, sample_rate_hz=1e9 / 500, exclusive_end_ns=2_001, window_size=8
+        )
+    )
+    (batch,) = list(sensor.sample(spec))
+    vals = sorted(batch.frames.reshape(len(batch), -1)[:, 0].tolist())
+    assert 1 in vals and 2 in vals  # both burst payloads present
+    sensor.close()
