@@ -1,0 +1,263 @@
+"""Learned overlay-text detection + recognition (OCR), TPU-first.
+
+Replaces the reference's PaddleOCR pairing
+(cosmos_curate/models/paddle_ocr.py:317-554 — a DB-style text detector and a
+CTC recognizer driving the artificial-text filter) with our own Flax models:
+
+- ``TextDetector`` — a small FCN over RGB frames producing a text-probability
+  heatmap at 1/4 resolution (DB-style shrunken-region target). Whole-batch
+  one-jit inference; boxes come from connected components on host.
+- ``TextRecognizer`` — a CRNN: conv feature pyramid collapsing height,
+  width-preserving sequence features, and per-timestep charset logits
+  decoded with greedy CTC.
+
+Both are trained on synthetically rendered text (models/ocr_train.py) since
+the image has zero egress; the checkpoint ships under ``weights/`` via the
+registry. Detection drives the artificial-text filter stage; recognition is
+exposed for OCR consumers (reference PaddleOCRModel.recognize parity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# CTC charset: blank=0, then printable chars OCR must distinguish
+CHARSET = " ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789.,:!?-'&%$#@()/"
+BLANK_ID = 0
+
+
+def char_to_id(c: str) -> int:
+    i = CHARSET.find(c)
+    return i + 1 if i >= 0 else CHARSET.find("?") + 1
+
+
+def encode_text(text: str) -> list[int]:
+    return [char_to_id(c) for c in text]
+
+
+def decode_ids(ids: list[int]) -> str:
+    return "".join(CHARSET[i - 1] for i in ids if 1 <= i <= len(CHARSET))
+
+
+@dataclass(frozen=True)
+class DetectorConfig:
+    height: int = 128
+    width: int = 224
+    base_filters: int = 16
+
+
+class TextDetector(nn.Module):
+    """FCN heatmap detector: uint8 [B, H, W, 3] -> logits [B, H/4, W/4]."""
+
+    cfg: DetectorConfig = DetectorConfig()
+
+    @nn.compact
+    def __call__(self, frames_u8: jax.Array) -> jax.Array:
+        f = self.cfg.base_filters
+        x = frames_u8.astype(jnp.float32) / 127.5 - 1.0
+        x = nn.Conv(f, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        x = nn.Conv(2 * f, (3, 3), strides=(2, 2))(x)
+        x = nn.relu(x)
+        # dilated context without further downsampling (text strokes are
+        # thin; receptive field matters more than depth)
+        x = nn.Conv(2 * f, (3, 3), kernel_dilation=(2, 2))(x)
+        x = nn.relu(x)
+        x = nn.Conv(2 * f, (3, 3), kernel_dilation=(4, 4))(x)
+        x = nn.relu(x)
+        x = nn.Conv(1, (1, 1))(x)
+        return x[..., 0]
+
+
+@dataclass(frozen=True)
+class RecognizerConfig:
+    height: int = 32
+    max_width: int = 160
+    base_filters: int = 24
+    hidden: int = 96
+
+    @property
+    def num_classes(self) -> int:
+        return len(CHARSET) + 1  # + blank
+
+    @property
+    def seq_len(self) -> int:
+        return self.max_width // 4
+
+
+class TextRecognizer(nn.Module):
+    """CRNN: uint8 crops [B, 32, W, 3] -> logits [B, W/4, num_classes]."""
+
+    cfg: RecognizerConfig = RecognizerConfig()
+
+    @nn.compact
+    def __call__(self, crops_u8: jax.Array) -> jax.Array:
+        f = self.cfg.base_filters
+        x = crops_u8.astype(jnp.float32) / 127.5 - 1.0
+        x = nn.Conv(f, (3, 3), strides=(2, 2))(x)  # H/2, W/2
+        x = nn.relu(x)
+        x = nn.Conv(2 * f, (3, 3), strides=(2, 2))(x)  # H/4, W/4
+        x = nn.relu(x)
+        x = nn.Conv(2 * f, (3, 3))(x)
+        x = nn.relu(x)
+        # collapse height into channels -> width-major sequence
+        b, h, w, c = x.shape
+        seq = x.transpose(0, 2, 1, 3).reshape(b, w, h * c)
+        seq = nn.Dense(self.cfg.hidden)(seq)
+        seq = nn.relu(seq)
+        # bidirectional context via two causal conv passes (cheap BiLSTM
+        # stand-in that stays a single fused program on the MXU)
+        fwd = nn.Conv(self.cfg.hidden, (5,), padding="SAME")(seq)
+        seq = nn.relu(fwd) + seq
+        return nn.Dense(self.cfg.num_classes)(seq)
+
+
+def greedy_ctc_decode(logits: np.ndarray) -> list[str]:
+    """[B, T, K] -> best-path decoded strings (collapse repeats, drop blank)."""
+    out = []
+    ids = np.asarray(logits).argmax(axis=-1)
+    for row in ids:
+        collapsed = []
+        prev = -1
+        for i in row:
+            if i != prev and i != BLANK_ID:
+                collapsed.append(int(i))
+            prev = i
+        out.append(decode_ids(collapsed))
+    return out
+
+
+@dataclass
+class TextBox:
+    x0: int
+    y0: int
+    x1: int
+    y1: int
+    score: float
+
+
+def heatmap_to_boxes(
+    prob: np.ndarray, *, threshold: float = 0.5, scale: int = 4, min_area: int = 6
+) -> list[TextBox]:
+    """Connected components over a thresholded heatmap -> frame-space boxes
+    (host-side; the heatmap is tiny). ``scale`` maps heatmap px -> frame px."""
+    import cv2
+
+    mask = (prob > threshold).astype(np.uint8)
+    n, labels, stats, _ = cv2.connectedComponentsWithStats(mask, connectivity=8)
+    boxes = []
+    for i in range(1, n):
+        x, y, w, h, area = stats[i]
+        if area < min_area:
+            continue
+        comp_scores = prob[labels == i]
+        boxes.append(
+            TextBox(
+                int(x * scale),
+                int(y * scale),
+                int((x + w) * scale),
+                int((y + h) * scale),
+                float(comp_scores.mean()),
+            )
+        )
+    return boxes
+
+
+class OcrModel:
+    """Detector + recognizer behind one interface (reference PaddleOCRModel
+    capability: detect boxes, recognize text, score overlay coverage)."""
+
+    def __init__(
+        self,
+        det_cfg: DetectorConfig = DetectorConfig(),
+        rec_cfg: RecognizerConfig = RecognizerConfig(),
+    ) -> None:
+        self.det_cfg = det_cfg
+        self.rec_cfg = rec_cfg
+        self.detector = TextDetector(det_cfg)
+        self.recognizer = TextRecognizer(rec_cfg)
+        self._det_params = None
+        self._rec_params = None
+        self._det_apply = None
+        self._rec_apply = None
+
+    def setup(self, *, require_weights: bool = False) -> None:
+        """``require_weights=True`` raises when trained checkpoints are
+        missing/mismatched — callers that would fail open on random logits
+        (the text filter) must use it."""
+        from cosmos_curate_tpu.models import registry
+
+        self._det_params = registry.load_params(
+            "ocr-detector-tpu",
+            lambda seed: self.detector.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, self.det_cfg.height, self.det_cfg.width, 3), jnp.uint8),
+            ),
+            require=require_weights,
+        )
+        self._rec_params = registry.load_params(
+            "ocr-recognizer-tpu",
+            lambda seed: self.recognizer.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1, self.rec_cfg.height, self.rec_cfg.max_width, 3), jnp.uint8),
+            ),
+            require=require_weights,
+        )
+        self._det_apply = jax.jit(self.detector.apply)
+        self._rec_apply = jax.jit(self.recognizer.apply)
+
+    def _resize(self, frames, hw: tuple[int, int]) -> np.ndarray:
+        """Accepts an array batch OR a list of differently-sized frames."""
+        import cv2
+
+        h, w = hw
+        return np.stack([cv2.resize(np.asarray(f), (w, h)) for f in frames])
+
+    def detect(self, frames: np.ndarray, *, threshold: float = 0.5) -> list[list[TextBox]]:
+        """uint8 [B, H, W, 3] -> per-frame text boxes in model input space."""
+        x = self._resize(frames, (self.det_cfg.height, self.det_cfg.width))
+        prob = jax.nn.sigmoid(self._det_apply(self._det_params, jnp.asarray(x)))
+        prob = np.asarray(prob)
+        return [heatmap_to_boxes(p, threshold=threshold) for p in prob]
+
+    def text_coverage(self, frames: np.ndarray, *, threshold: float = 0.5) -> float:
+        """Max fraction of frame area covered by detected text — the filter
+        stage's decision signal (reference uses box-area heuristics)."""
+        x = self._resize(frames, (self.det_cfg.height, self.det_cfg.width))
+        prob = jax.nn.sigmoid(self._det_apply(self._det_params, jnp.asarray(x)))
+        cover = (prob > threshold).mean(axis=(1, 2))
+        return float(np.asarray(cover).max())
+
+    def recognize(self, crops: np.ndarray) -> list[str]:
+        """uint8 [B, h, w, 3] text crops -> decoded strings."""
+        x = self._resize(crops, (self.rec_cfg.height, self.rec_cfg.max_width))
+        logits = self._rec_apply(self._rec_params, jnp.asarray(x))
+        return greedy_ctc_decode(np.asarray(logits))
+
+    def read(self, frame: np.ndarray, *, threshold: float = 0.5) -> list[tuple[TextBox, str]]:
+        """Full OCR on one frame: detect boxes, recognize each crop."""
+        (boxes,) = self.detect(frame[None], threshold=threshold)
+        if not boxes:
+            return []
+        # boxes are in detector input space; map back to the frame
+        fh, fw = frame.shape[:2]
+        sy = fh / self.det_cfg.height
+        sx = fw / self.det_cfg.width
+        crops = []
+        mapped = []
+        for b in boxes:
+            x0, y0 = max(0, int(b.x0 * sx)), max(0, int(b.y0 * sy))
+            x1, y1 = min(fw, int(b.x1 * sx)), min(fh, int(b.y1 * sy))
+            if x1 - x0 < 4 or y1 - y0 < 4:
+                continue
+            crops.append(frame[y0:y1, x0:x1])
+            mapped.append(TextBox(x0, y0, x1, y1, b.score))
+        if not crops:
+            return []
+        texts = self.recognize(crops)
+        return list(zip(mapped, texts))

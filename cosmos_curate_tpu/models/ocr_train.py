@@ -1,0 +1,264 @@
+"""Synthetic-data training for the OCR detector + recognizer.
+
+The reference ships PaddleOCR's pretrained det/rec checkpoints
+(cosmos_curate/models/paddle_ocr.py:317); this image has no egress, so the
+models in models/ocr.py train on text rendered with cv2's Hershey fonts over
+procedural backgrounds — the same no-egress pattern as
+models/transnet_train.py. Trained checkpoints are committed under
+``weights/ocr-{detector,recognizer}-tpu/`` via the registry; staging real
+converted checkpoints in $CURATE_MODEL_WEIGHTS_DIR still wins.
+
+TPU-first: one jitted train step per model; host-side data synthesis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.models.ocr import (
+    BLANK_ID,
+    CHARSET,
+    DetectorConfig,
+    RecognizerConfig,
+    TextDetector,
+    TextRecognizer,
+    encode_text,
+)
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_FONTS = (0, 1, 2, 3, 4, 6, 7)  # cv2 FONT_HERSHEY_* family
+
+
+def _background(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    import cv2
+
+    kind = rng.integers(0, 4)
+    if kind == 0:  # solid
+        img = np.full((h, w, 3), rng.integers(0, 256, 3), np.uint8)
+    elif kind == 1:  # linear gradient
+        a = rng.integers(0, 256, 3).astype(np.float32)
+        b = rng.integers(0, 256, 3).astype(np.float32)
+        t = np.linspace(0, 1, w)[None, :, None]
+        img = (a + (b - a) * t).astype(np.uint8)
+        img = np.broadcast_to(img, (h, w, 3)).copy()
+    elif kind == 2:  # random rectangles (scene-ish clutter)
+        img = np.full((h, w, 3), rng.integers(0, 256, 3), np.uint8)
+        for _ in range(rng.integers(2, 8)):
+            x0, y0 = rng.integers(0, w), rng.integers(0, h)
+            x1, y1 = rng.integers(0, w), rng.integers(0, h)
+            cv2.rectangle(
+                img,
+                (min(x0, x1), min(y0, y1)),
+                (max(x0, x1), max(y0, y1)),
+                tuple(int(v) for v in rng.integers(0, 256, 3)),
+                -1,
+            )
+    else:  # noise texture
+        img = rng.integers(0, 256, (h, w, 3), np.uint8)
+        import cv2 as _cv2
+
+        img = _cv2.GaussianBlur(img, (5, 5), 0)
+    return img
+
+
+def _rand_text(rng: np.random.Generator, max_len: int = 10) -> str:
+    n = int(rng.integers(1, max_len + 1))
+    chars = CHARSET[1:]  # skip leading space for cleaner CTC targets
+    return "".join(chars[rng.integers(0, len(chars))] for _ in range(n))
+
+
+def synthesize_detector_batch(
+    rng: np.random.Generator, batch: int, cfg: DetectorConfig
+) -> tuple[np.ndarray, np.ndarray]:
+    """-> (frames uint8 [B,H,W,3], target float32 [B,H/4,W/4])."""
+    import cv2
+
+    h, w = cfg.height, cfg.width
+    frames = np.empty((batch, h, w, 3), np.uint8)
+    targets = np.zeros((batch, h // 4, w // 4), np.float32)
+    for b in range(batch):
+        img = _background(rng, h, w)
+        if rng.random() < 0.75:  # text-bearing sample
+            for _ in range(int(rng.integers(1, 4))):
+                text = _rand_text(rng)
+                font = int(_FONTS[rng.integers(0, len(_FONTS))])
+                scale = float(rng.uniform(0.4, 1.0))
+                thick = int(rng.integers(1, 3))
+                (tw, th), _ = cv2.getTextSize(text, font, scale, thick)
+                if tw >= w - 4 or th >= h - 4:
+                    continue
+                x = int(rng.integers(2, max(3, w - tw - 2)))
+                y = int(rng.integers(th + 2, max(th + 3, h - 4)))
+                color = tuple(int(v) for v in rng.integers(0, 256, 3))
+                cv2.putText(img, text, (x, y), font, scale, color, thick, cv2.LINE_AA)
+                # shrunken box target at 1/4 resolution
+                sx0, sy0 = (x + tw // 10) // 4, (y - th + th // 10) // 4
+                sx1, sy1 = (x + tw - tw // 10) // 4, (y - th // 10) // 4
+                targets[b, max(0, sy0) : sy1 + 1, max(0, sx0) : sx1 + 1] = 1.0
+        frames[b] = img
+    return frames, targets
+
+
+def synthesize_recognizer_batch(
+    rng: np.random.Generator, batch: int, cfg: RecognizerConfig, max_len: int = 10
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (crops uint8 [B,32,W,3], labels int32 [B,max_len], label_pad [B,max_len])."""
+    import cv2
+
+    h, w = cfg.height, cfg.max_width
+    crops = np.empty((batch, h, w, 3), np.uint8)
+    labels = np.zeros((batch, max_len), np.int32)
+    pads = np.ones((batch, max_len), np.float32)
+    for b in range(batch):
+        img = _background(rng, h, w)
+        text = _rand_text(rng, max_len)
+        font = int(_FONTS[rng.integers(0, len(_FONTS))])
+        thick = int(rng.integers(1, 3))
+        # fit the text into the crop width
+        scale = 1.0
+        (tw, th), _ = cv2.getTextSize(text, font, scale, thick)
+        scale = min(0.9 * w / max(tw, 1), 0.7 * h / max(th, 1))
+        (tw, th), _ = cv2.getTextSize(text, font, scale, thick)
+        x = max(1, (w - tw) // 2 + int(rng.integers(-4, 5)))
+        y = min(h - 2, (h + th) // 2 + int(rng.integers(-2, 3)))
+        # ensure contrast against the local background
+        patch = img[max(0, y - th) : y + 2, x : x + tw + 1]
+        mean = patch.mean(axis=(0, 1)) if patch.size else np.array([128.0] * 3)
+        color = tuple(int(255 - v) if abs(v - 128) > 40 else (255 if v < 128 else 0) for v in mean)
+        cv2.putText(img, text, (x, y), font, scale, color, thick, cv2.LINE_AA)
+        crops[b] = img
+        ids = encode_text(text)
+        labels[b, : len(ids)] = ids
+        pads[b, : len(ids)] = 0.0
+    return crops, labels, pads
+
+
+def train_detector(
+    cfg: DetectorConfig = DetectorConfig(),
+    *,
+    steps: int = 500,
+    batch: int = 8,
+    lr: float = 1e-3,
+    pos_weight: float = 3.0,
+    seed: int = 0,
+    log_every: int = 100,
+):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    model = TextDetector(cfg)
+    rng = np.random.default_rng(seed)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, cfg.height, cfg.width, 3), jnp.uint8)
+    )
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, frames, targets):
+        def loss_fn(p):
+            logits = model.apply(p, frames)
+            per = optax.sigmoid_binary_cross_entropy(logits, targets)
+            weight = 1.0 + (pos_weight - 1.0) * targets
+            return (per * weight).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        frames, targets = synthesize_detector_batch(rng, batch, cfg)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(frames), jnp.asarray(targets)
+        )
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("ocr-det step %d/%d loss %.4f", i + 1, steps, float(loss))
+    return params, float(loss) if loss is not None else float("nan")
+
+
+def train_recognizer(
+    cfg: RecognizerConfig = RecognizerConfig(),
+    *,
+    steps: int = 1200,
+    batch: int = 16,
+    lr: float = 2e-3,
+    seed: int = 0,
+    log_every: int = 100,
+):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    model = TextRecognizer(cfg)
+    rng = np.random.default_rng(seed)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, cfg.height, cfg.max_width, 3), jnp.uint8)
+    )
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, crops, labels, label_pads):
+        def loss_fn(p):
+            logits = model.apply(p, crops)  # [B, T, K]
+            logit_pads = jnp.zeros(logits.shape[:2], jnp.float32)
+            return optax.ctc_loss(
+                logits, logit_pads, labels, label_pads, blank_id=BLANK_ID
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        crops, labels, pads = synthesize_recognizer_batch(rng, batch, cfg)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(crops), jnp.asarray(labels), jnp.asarray(pads)
+        )
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("ocr-rec step %d/%d loss %.4f", i + 1, steps, float(loss))
+    return params, float(loss) if loss is not None else float("nan")
+
+
+def train_and_stage(*, out_dir: str | None = None, det_kw=None, rec_kw=None):
+    import flax.serialization
+
+    from cosmos_curate_tpu.models import registry
+
+    results = {}
+    for model_id, trainer, kw in (
+        ("ocr-detector-tpu", train_detector, det_kw or {}),
+        ("ocr-recognizer-tpu", train_recognizer, rec_kw or {}),
+    ):
+        params, loss = trainer(**kw)
+        if out_dir is not None:
+            from pathlib import Path
+
+            ckpt = Path(out_dir) / model_id / "params.msgpack"
+            ckpt.parent.mkdir(parents=True, exist_ok=True)
+            ckpt.write_bytes(flax.serialization.to_bytes(params))
+        else:
+            ckpt = registry.save_params(model_id, params)
+        logger.info("staged %s (final loss %.4f) at %s", model_id, loss, ckpt)
+        results[model_id] = (ckpt, loss)
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Train OCR det/rec on synthetic text")
+    ap.add_argument("--det-steps", type=int, default=500)
+    ap.add_argument("--rec-steps", type=int, default=1200)
+    ap.add_argument("--out-dir", default=None, help="e.g. <repo>/weights to commit")
+    a = ap.parse_args()
+    train_and_stage(
+        out_dir=a.out_dir,
+        det_kw={"steps": a.det_steps},
+        rec_kw={"steps": a.rec_steps},
+    )

@@ -52,6 +52,9 @@ for _mid, _desc in [
     ("video-embed-tpu", "temporal-transformer video embedder"),
     ("caption-vlm-tpu", "vision-language captioning model (Flax)"),
     ("t5-encoder-tpu", "text encoder for caption embeddings"),
+    ("ocr-detector-tpu", "overlay-text region detector (Flax FCN)"),
+    ("ocr-recognizer-tpu", "text recognizer CRNN with CTC decoding"),
+    ("tracker-siamese-tpu", "learned single-object appearance tracker"),
 ]:
     register_model(_mid, _desc)
 
@@ -90,9 +93,14 @@ def load_params(
     init_fn: Callable[[int], Any],
     *,
     seed: int = 0,
+    require: bool = False,
 ) -> Any:
     """Load staged weights for ``model_id`` if present, else fall back to
     ``init_fn(seed)`` (random init) with a warning.
+
+    ``require=True`` raises instead of falling back — for callers whose
+    behavior would silently invert on random weights (e.g. a filter stage
+    that must NOT fail open to discarding every clip).
 
     Format: flax msgpack (``flax.serialization``) — synchronous and
     self-contained; the tree structure comes from ``init_fn``."""
@@ -105,12 +113,22 @@ def load_params(
         try:
             return flax.serialization.from_bytes(template, ckpt.read_bytes())
         except (ValueError, KeyError, TypeError) as e:
+            if require:
+                raise RuntimeError(
+                    f"staged weights at {ckpt} do not match {model_id}'s "
+                    f"current architecture: {e}"
+                ) from e
             # a checkpoint staged for different model shapes (e.g. an old
             # config) must not hard-crash the pipeline at stage setup
             logger.error(
                 "staged weights at %s do not match %s's current architecture "
                 "(%s); falling back to random init", ckpt, model_id, e,
             )
+    elif require:
+        raise RuntimeError(
+            f"no staged weights for {model_id} under "
+            f"{local_dir_for(model_id) / 'params.msgpack'}"
+        )
     logger.warning(
         "no staged weights for %s under %s — using seeded random init "
         "(stage a params.msgpack there for real inference)",

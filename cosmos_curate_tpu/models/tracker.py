@@ -96,6 +96,46 @@ def _track_scan(frames_u8, box0, cfg: TrackerConfig, ts: int):
     return centers.astype(jnp.float32) + delta[None, :], scores
 
 
+def host_track(
+    frames: np.ndarray,
+    box_xywh: tuple[float, float, float, float],
+    work_size: int,
+    scan_fn,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Shared host wrapper for whole-clip trackers: resize the clip to the
+    square work size, map the prompt box into work coordinates, pad T to a
+    pow2 bucket (per-clip frame counts must not each cost an XLA compile),
+    run ``scan_fn(padded_u8, box0_np) -> (centers, scores)``, and map the
+    track back to original pixels. Keeps the coordinate math in ONE place
+    for the NCC and siamese trackers."""
+    import cv2
+
+    t, h, w = frames.shape[:3]
+    small = np.stack(
+        [cv2.resize(f, (work_size, work_size), interpolation=cv2.INTER_AREA) for f in frames]
+    )
+    sx, sy = work_size / w, work_size / h
+    x, y, bw, bh = box_xywh
+    box0 = np.asarray(
+        [(x + bw / 2) * sx, (y + bh / 2) * sy, bw * sx, bh * sy], np.float32
+    )
+    from cosmos_curate_tpu.models.batching import pad_batch
+
+    padded, _ = pad_batch(small)
+    centers, scores = scan_fn(padded, box0)
+    centers = np.asarray(centers, np.float32)[:t]
+    boxes = np.stack(
+        [
+            centers[:, 0] / sx - bw / 2,
+            centers[:, 1] / sy - bh / 2,
+            np.full(t, bw, np.float32),
+            np.full(t, bh, np.float32),
+        ],
+        axis=1,
+    )
+    return boxes, np.asarray(scores)[:t]
+
+
 class TemplateTracker:
     """Track a prompted box through a clip; host-facing wrapper."""
 
@@ -108,38 +148,13 @@ class TemplateTracker:
         """frames: uint8 [T, H, W, 3]; box: (x, y, w, h) in pixels of the
         FIRST frame. Returns (boxes [T, 4] xywh in original coords,
         scores [T])."""
-        import cv2
 
-        t, h, w = frames.shape[:3]
-        size = self.cfg.work_size
-        small = np.stack(
-            [cv2.resize(f, (size, size), interpolation=cv2.INTER_AREA) for f in frames]
-        )
-        sx, sy = size / w, size / h
-        x, y, bw, bh = box_xywh
-        box0 = jnp.asarray(
-            [(x + bw / 2) * sx, (y + bh / 2) * sy, bw * sx, bh * sy], jnp.float32
-        )
-        # template edge = 2x the scaled prompt extent (context margin: an
-        # exact-extent template over a uniform object has ~zero variance and
-        # NCC degenerates), pow2 so few template sizes compile
-        extent = max(8.0, 2.0 * max(bw * sx, bh * sy))
-        ts = min(1 << int(np.ceil(np.log2(extent))), size // 2)
-        # pad T to a pow2 bucket: per-clip frame counts must not each cost
-        # an XLA compile (padded tail repeats the last frame, sliced off)
-        from cosmos_curate_tpu.models.batching import pad_batch
+        def scan(padded, box0):
+            # template edge = 2x the scaled prompt extent (context margin:
+            # an exact-extent template over a uniform object has ~zero
+            # variance and NCC degenerates), pow2 so few sizes compile
+            extent = max(8.0, 2.0 * float(max(box0[2], box0[3])))
+            ts = min(1 << int(np.ceil(np.log2(extent))), self.cfg.work_size // 2)
+            return _track_scan(padded, jnp.asarray(box0), self.cfg, ts)
 
-        padded, _ = pad_batch(small)
-        centers, scores = _track_scan(padded, box0, self.cfg, ts)
-        centers = np.asarray(centers, np.float32)[:t]
-        scores = np.asarray(scores)[:t]
-        boxes = np.stack(
-            [
-                centers[:, 0] / sx - bw / 2,
-                centers[:, 1] / sy - bh / 2,
-                np.full(t, bw, np.float32),
-                np.full(t, bh, np.float32),
-            ],
-            axis=1,
-        )
-        return boxes, np.asarray(scores)
+        return host_track(frames, box_xywh, self.cfg.work_size, scan)

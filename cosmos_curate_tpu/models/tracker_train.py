@@ -1,0 +1,193 @@
+"""Synthetic-data training for the siamese tracker embedding.
+
+Training pairs are (template patch, search window) crops from procedurally
+generated scenes: a textured target object moves over cluttered backgrounds
+with brightness/scale jitter and look-alike distractors; the label is the
+target's true offset inside the search window. Loss is cross-entropy over
+the correlation response map against a one-hot peak (SiamFC-style logistic
+variant, public technique). No egress needed — same pattern as
+models/transnet_train.py. Checkpoint ships under
+``weights/tracker-siamese-tpu/``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.models.tracker_learned import STRIDE, EmbedNet, SiameseConfig, _prep
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _texture(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    import cv2
+
+    base = rng.integers(0, 256, 3).astype(np.float32)
+    tex = np.clip(
+        base + rng.normal(0, rng.uniform(5, 40), (h, w, 3)), 0, 255
+    ).astype(np.uint8)
+    if rng.random() < 0.5:
+        tex = cv2.GaussianBlur(tex, (3, 3), 0)
+    return tex
+
+
+def _paste_object(
+    img: np.ndarray, rng: np.random.Generator, cx: int, cy: int, size: int
+) -> None:
+    """Textured ellipse/rect target centered at (cx, cy)."""
+    import cv2
+
+    h, w = img.shape[:2]
+    obj = _texture(rng, size, size)
+    mask = np.zeros((size, size), np.uint8)
+    if rng.random() < 0.5:
+        cv2.ellipse(mask, (size // 2, size // 2), (size // 2 - 1, size // 3), 0, 0, 360, 255, -1)
+    else:
+        cv2.rectangle(mask, (1, 1), (size - 2, size - 2), 255, -1)
+    x0, y0 = cx - size // 2, cy - size // 2
+    x1, y1 = x0 + size, y0 + size
+    sx0, sy0 = max(0, -x0), max(0, -y0)
+    x0, y0 = max(0, x0), max(0, y0)
+    x1, y1 = min(w, x1), min(h, y1)
+    if x1 <= x0 or y1 <= y0:
+        return
+    region = img[y0:y1, x0:x1]
+    m = mask[sy0 : sy0 + (y1 - y0), sx0 : sx0 + (x1 - x0), None] > 0
+    region[:] = np.where(m, obj[sy0 : sy0 + (y1 - y0), sx0 : sx0 + (x1 - x0)], region)
+
+
+def synthesize_pair_batch(
+    rng: np.random.Generator, batch: int, cfg: SiameseConfig
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """-> (templates [B,ts,ts,3], searches [B,ss,ss,3], target_yx [B,2] peak
+    coordinates in the response map)."""
+    ts, ss = cfg.template_size, cfg.search_size
+    resp_edge = (ss - ts) // STRIDE + 1
+    templates = np.empty((batch, ts, ts, 3), np.uint8)
+    searches = np.empty((batch, ss, ss, 3), np.uint8)
+    targets = np.empty((batch, 2), np.int32)
+    margin = ts // 2
+    for b in range(batch):
+        scene = _texture(rng, ss * 2, ss * 2)
+        # clutter + distractor of similar size
+        for _ in range(rng.integers(0, 4)):
+            _paste_object(
+                scene, rng,
+                int(rng.integers(0, ss * 2)), int(rng.integers(0, ss * 2)),
+                int(rng.integers(8, 24)),
+            )
+        obj_size = int(rng.integers(10, ts - 4))
+        # place target somewhere the search window can see
+        tcx = ss + int(rng.integers(-(ss // 2 - margin), ss // 2 - margin + 1))
+        tcy = ss + int(rng.integers(-(ss // 2 - margin), ss // 2 - margin + 1))
+        _paste_object(scene, rng, tcx, tcy, obj_size)
+        searches[b] = scene[ss - ss // 2 : ss + ss // 2, ss - ss // 2 : ss + ss // 2]
+
+        # template: crop around the true center with brightness jitter —
+        # the appearance-variation the tracker must be invariant to
+        patch = scene[tcy - ts // 2 : tcy + ts // 2, tcx - ts // 2 : tcx + ts // 2]
+        jitter = rng.uniform(0.8, 1.2)
+        templates[b] = np.clip(patch.astype(np.float32) * jitter, 0, 255).astype(np.uint8)
+
+        # response-map coordinates of the target inside the search window
+        off_x = tcx - (ss - ss // 2)  # target center in search-window pixels
+        off_y = tcy - (ss - ss // 2)
+        rx = int(np.clip(round((off_x - ts // 2) / STRIDE), 0, resp_edge - 1))
+        ry = int(np.clip(round((off_y - ts // 2) / STRIDE), 0, resp_edge - 1))
+        targets[b] = (ry, rx)
+    return templates, searches, targets
+
+
+def train(
+    cfg: SiameseConfig = SiameseConfig(),
+    *,
+    steps: int = 800,
+    batch: int = 16,
+    lr: float = 1e-3,
+    seed: int = 0,
+    log_every: int = 100,
+):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    net = EmbedNet(cfg.features)
+    rng = np.random.default_rng(seed)
+    params = net.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, cfg.template_size, cfg.template_size, 3))
+    )
+    opt = optax.adamw(lr)
+    opt_state = opt.init(params)
+    resp_edge = (cfg.search_size - cfg.template_size) // STRIDE + 1
+
+    @jax.jit
+    def step(params, opt_state, templates, searches, targets):
+        def loss_fn(p):
+            tfeat = net.apply(p, _prep(templates))  # [B, ht, wt, F]
+            sfeat = net.apply(p, _prep(searches))  # [B, hs, ws, F]
+
+            def one(tf, sf):
+                return jax.lax.conv_general_dilated(
+                    sf.transpose(2, 0, 1)[None],
+                    tf.transpose(2, 0, 1)[None].transpose(1, 0, 2, 3),
+                    window_strides=(1, 1),
+                    padding="VALID",
+                    feature_group_count=tf.shape[-1],
+                ).sum(axis=1)[0]
+
+            resp = jax.vmap(one)(tfeat, sfeat)  # [B, re, re]
+            logits = resp.reshape(resp.shape[0], -1) / (
+                tfeat.shape[1] * tfeat.shape[2] * tfeat.shape[3]
+            )
+            labels = targets[:, 0] * resp_edge + targets[:, 1]
+            return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        t, s, y = synthesize_pair_batch(rng, batch, cfg)
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(t), jnp.asarray(s), jnp.asarray(y)
+        )
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("tracker train step %d/%d loss %.4f", i + 1, steps, float(loss))
+    return params, float(loss) if loss is not None else float("nan")
+
+
+def train_and_stage(
+    cfg: SiameseConfig = SiameseConfig(),
+    *,
+    model_id: str = "tracker-siamese-tpu",
+    out_dir: str | None = None,
+    **train_kw,
+):
+    import flax.serialization
+
+    from cosmos_curate_tpu.models import registry
+
+    params, loss = train(cfg, **train_kw)
+    if out_dir is not None:
+        from pathlib import Path
+
+        ckpt = Path(out_dir) / model_id / "params.msgpack"
+        ckpt.parent.mkdir(parents=True, exist_ok=True)
+        ckpt.write_bytes(flax.serialization.to_bytes(params))
+    else:
+        ckpt = registry.save_params(model_id, params)
+    logger.info("staged %s (final loss %.4f) at %s", model_id, loss, ckpt)
+    return ckpt, loss
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Train the siamese tracker embedding")
+    ap.add_argument("--steps", type=int, default=800)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--out-dir", default=None)
+    a = ap.parse_args()
+    train_and_stage(steps=a.steps, batch=a.batch, out_dir=a.out_dir)

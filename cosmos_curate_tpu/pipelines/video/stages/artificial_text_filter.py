@@ -3,18 +3,23 @@
 Equivalent capability of the reference's artificial-text filter
 (cosmos_curate/pipelines/video/filtering/aesthetics/
 artificial_text_filter_stage.py:37 + models/paddle_ocr.py:317-554 —
-PaddleOCR overlay-text detection with corner heuristics). PaddleOCR has no
-TPU build; the detector here is a device-side *text-likeness* score computed
-in one jit: overlay text produces dense horizontal high-contrast strokes
-that persist across frames, so we measure temporal-stable horizontal
-gradient energy in the frame's border bands (title/subtitle/watermark
-regions). A full OCR model can be plugged through the same stage interface.
+PaddleOCR overlay-text detection with corner heuristics). Two detectors
+behind one stage:
+
+- **learned** (default when the ``ocr-detector-tpu`` checkpoint is staged):
+  the Flax FCN text detector from models/ocr.py — score is the max fraction
+  of frame area covered by detected text regions, the same box-area signal
+  the reference derives from PaddleOCR boxes.
+- **heuristic** (fallback, and ``mode="heuristic"``): a device-side
+  text-likeness score in one jit — temporal-stable horizontal-stroke energy
+  in the frame's border bands (title/subtitle/watermark regions).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
@@ -50,14 +55,56 @@ class ArtificialTextFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         threshold: float = 0.5,
         score_only: bool = False,
         extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
+        mode: str = "auto",  # auto | learned | heuristic
+        learned_threshold: float = 0.02,  # text-area fraction that flags a clip
     ) -> None:
+        if mode not in ("auto", "learned", "heuristic"):
+            raise ValueError(f"unknown text-filter mode {mode!r}")
         self.threshold = threshold
         self.score_only = score_only
         self.extraction = extraction
+        self.mode = mode
+        self.learned_threshold = learned_threshold
+        self._ocr = None
 
     @property
     def resources(self) -> Resources:
         return Resources(cpus=1.0, tpus=0.25)
+
+    def setup(self, worker=None) -> None:
+        if self.mode == "heuristic":
+            return
+        from cosmos_curate_tpu.models import registry
+
+        if self.mode == "learned" or registry.find_checkpoint("ocr-detector-tpu"):
+            from cosmos_curate_tpu.models.ocr import OcrModel
+
+            ocr = OcrModel()
+            try:
+                # random-init logits would fail OPEN (≈half the heatmap over
+                # threshold -> every clip filtered); never accept fallback
+                ocr.setup(require_weights=True)
+            except RuntimeError as e:
+                if self.mode == "learned":
+                    raise
+                logger.warning(
+                    "text filter: learned detector unavailable (%s); using heuristic", e
+                )
+                return
+            self._ocr = ocr
+        # auto with no staged checkpoint: stay on the heuristic path
+
+    def _score(self, frames) -> tuple[float, float]:
+        """-> (score, effective_threshold) under the active detector."""
+        if self._ocr is not None:
+            # fixed 4-frame sample: one batch shape -> one XLA compile
+            idx = np.linspace(0, len(frames) - 1, 4).astype(int)
+            return (
+                self._ocr.text_coverage(frames[idx]),
+                self.learned_threshold,
+            )
+        padded, n = pad_batch(frames)
+        return float(_text_likeness(padded, n)), self.threshold
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         key = self.extraction.key()
@@ -69,14 +116,13 @@ class ArtificialTextFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
                     kept.append(clip)
                     continue
                 try:
-                    padded, n = pad_batch(frames)
-                    clip.artificial_text_score = float(_text_likeness(padded, n))
+                    clip.artificial_text_score, threshold = self._score(frames)
                 except Exception as e:
                     logger.warning("text scoring failed for %s: %s", clip.uuid, e)
                     clip.errors["artificial_text"] = str(e)
                     kept.append(clip)
                     continue
-                if self.score_only or clip.artificial_text_score < self.threshold:
+                if self.score_only or clip.artificial_text_score < threshold:
                     kept.append(clip)
                 else:
                     clip.filtered_by = "text"

@@ -62,13 +62,46 @@ class TrackingStage(Stage[SplitPipeTask, SplitPipeTask]):
         cfg: TrackerConfig = TrackerConfig(),
         write_annotated: bool = False,
         min_score: float = 0.0,
+        mode: str = "auto",  # auto | learned | ncc
+        siamese_cfg=None,
+        learned_min_score: float = 0.0,
     ) -> None:
         """``min_score`` drops tracks whose mean correlation score (ts²-
         normalized NCC; ~[0.2, 1.2] for solid locks, near 0 for noise)
-        falls below it."""
+        falls below it. ``mode`` selects the tracker: the learned siamese
+        model (when its checkpoint is staged), the NCC baseline, or auto.
+        Siamese scores live on their own (learned-weight) scale, so the
+        learned tracker uses ``learned_min_score`` + ``siamese_cfg``, never
+        the NCC-calibrated knobs."""
+        if mode not in ("auto", "learned", "ncc"):
+            raise ValueError(f"unknown tracking mode {mode!r}")
+        self.mode = mode
         self._tracker = TemplateTracker(cfg)
         self.write_annotated = write_annotated
         self.min_score = min_score
+        self.learned_min_score = learned_min_score
+        self._siamese_cfg = siamese_cfg
+
+    def setup(self, worker=None) -> None:
+        if self.mode == "ncc":
+            return
+        from cosmos_curate_tpu.models import registry
+
+        if self.mode == "learned" or registry.find_checkpoint("tracker-siamese-tpu"):
+            from cosmos_curate_tpu.models.tracker_learned import SiameseConfig, SiameseTracker
+
+            tracker = SiameseTracker(self._siamese_cfg or SiameseConfig())
+            try:
+                tracker.setup(require_weights=True)
+            except RuntimeError as e:
+                if self.mode == "learned":
+                    raise
+                logger.warning(
+                    "tracking: learned tracker unavailable (%s); using NCC baseline", e
+                )
+                return
+            self._tracker = tracker
+            self.min_score = self.learned_min_score
 
     @property
     def resources(self) -> Resources:

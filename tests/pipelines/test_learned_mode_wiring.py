@@ -1,0 +1,105 @@
+"""Stage wiring for learned OCR/tracker modes: checkpoint auto-detection,
+fail-closed behavior on missing/mismatched weights, threshold switching."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.pipelines.video.stages.artificial_text_filter import (
+    ArtificialTextFilterStage,
+)
+from cosmos_curate_tpu.pipelines.video.stages.tracking import TrackingStage
+
+
+@pytest.fixture()
+def weights_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(registry.WEIGHTS_DIR_ENV, str(tmp_path / "w"))
+    # the committed repo weights must not leak into these tests
+    monkeypatch.setattr(registry, "REPO_WEIGHTS_DIR", tmp_path / "nonexistent")
+    return tmp_path / "w"
+
+
+def _stage_ocr_weights() -> None:
+    from cosmos_curate_tpu.models.ocr import (
+        DetectorConfig,
+        RecognizerConfig,
+        TextDetector,
+        TextRecognizer,
+    )
+
+    det = TextDetector(DetectorConfig())
+    rec = TextRecognizer(RecognizerConfig())
+    registry.save_params(
+        "ocr-detector-tpu",
+        det.init(jax.random.PRNGKey(0), jnp.zeros((1, 128, 224, 3), jnp.uint8)),
+    )
+    registry.save_params(
+        "ocr-recognizer-tpu",
+        rec.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 160, 3), jnp.uint8)),
+    )
+
+
+def test_auto_without_checkpoint_stays_heuristic(weights_dir):
+    stage = ArtificialTextFilterStage(mode="auto")
+    stage.setup()
+    assert stage._ocr is None
+
+
+def test_auto_with_checkpoint_goes_learned(weights_dir):
+    _stage_ocr_weights()
+    stage = ArtificialTextFilterStage(mode="auto")
+    stage.setup()
+    assert stage._ocr is not None
+    frames = np.zeros((6, 120, 160, 3), np.uint8)
+    score, threshold = stage._score(frames)
+    assert threshold == stage.learned_threshold  # learned scale, not heuristic's
+
+
+def test_learned_mode_without_weights_raises(weights_dir):
+    stage = ArtificialTextFilterStage(mode="learned")
+    with pytest.raises(RuntimeError):
+        stage.setup()
+
+
+def test_auto_with_mismatched_checkpoint_falls_back(weights_dir):
+    """A stale checkpoint from an old architecture must NOT fail open to
+    random-weight filtering — auto mode reverts to the heuristic."""
+    import flax.serialization
+
+    ckpt = weights_dir / "ocr-detector-tpu" / "params.msgpack"
+    ckpt.parent.mkdir(parents=True)
+    ckpt.write_bytes(flax.serialization.to_bytes({"params": {"bogus": jnp.zeros((3, 3))}}))
+    stage = ArtificialTextFilterStage(mode="auto")
+    stage.setup()
+    assert stage._ocr is None  # heuristic path
+
+
+def test_tracking_auto_swaps_and_rescales_threshold(weights_dir):
+    from cosmos_curate_tpu.models.tracker_learned import SiameseTracker
+
+    st = SiameseTracker()
+    registry.save_params(
+        "tracker-siamese-tpu",
+        st.net.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))),
+    )
+    stage = TrackingStage(mode="auto", min_score=0.2, learned_min_score=0.01)
+    stage.setup()
+    assert type(stage._tracker).__name__ == "SiameseTracker"
+    # NCC-calibrated min_score must have been replaced by the learned one
+    assert stage.min_score == 0.01
+
+
+def test_tracking_auto_without_weights_keeps_ncc(weights_dir):
+    stage = TrackingStage(mode="auto", min_score=0.2)
+    stage.setup()
+    assert type(stage._tracker).__name__ == "TemplateTracker"
+    assert stage.min_score == 0.2
+
+
+def test_tracking_learned_without_weights_raises(weights_dir):
+    with pytest.raises(RuntimeError):
+        TrackingStage(mode="learned").setup()
