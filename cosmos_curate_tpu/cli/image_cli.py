@@ -1,0 +1,294 @@
+"""Image-build and fleet-deploy CLI.
+
+Equivalent capability of the reference's packaging/deploy tooling
+(cosmos_curate/client/image_cli/image_app.py:30-242 — docker build/push with
+cache sources — and client/nvcf_cli/ — cloud function deployment). The TPU
+deployment target is Kubernetes/GKE, so deploy drives the Helm chart in
+deploy/helm/ instead of NVCF: ``deploy render`` expands the chart with a
+built-in renderer (covers this chart's template constructs; no helm binary
+needed), and ``deploy apply`` pipes the manifests to kubectl.
+
+docker/helm/kubectl are host tools: commands print exactly what they run,
+``--dry-run`` shows it without executing, and a missing binary is a clear
+error — not an import-time crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+DEFAULT_DOCKERFILE = REPO_ROOT / "deploy" / "Dockerfile"
+DEFAULT_CHART = REPO_ROOT / "deploy" / "helm" / "cosmos-curate-tpu"
+
+
+# ---------------------------------------------------------------------------
+# docker image build/push
+
+
+def _run_or_print(
+    cmd: list[str], *, dry_run: bool, tool: str, stdin: bytes | None = None
+) -> int:
+    print("+ " + " ".join(cmd))
+    if dry_run:
+        if stdin is not None:
+            print(stdin.decode())
+        return 0
+    if shutil.which(cmd[0]) is None:
+        print(f"error: {tool} not found on PATH — install it or use --dry-run", file=sys.stderr)
+        return 3
+    return subprocess.run(cmd, input=stdin).returncode
+
+
+def cmd_build(args: argparse.Namespace) -> int:
+    label = f"{args.image_name}:{args.image_tag}"
+    cmd = [
+        args.docker, "build",
+        "-f", str(args.dockerfile),
+        "-t", label,
+    ]
+    for c in args.cache_from or []:
+        cmd += ["--cache-from", c]
+    if args.cache_to:
+        cmd += ["--cache-to", args.cache_to]
+    if args.platform:
+        cmd += ["--platform", args.platform]
+    cmd.append(str(args.context))
+    rc = _run_or_print(cmd, dry_run=args.dry_run, tool="docker")
+    if rc == 0 and args.push:
+        rc = _run_or_print(
+            [args.docker, "push", label], dry_run=args.dry_run, tool="docker"
+        )
+    return rc
+
+
+def cmd_push(args: argparse.Namespace) -> int:
+    return _run_or_print(
+        [args.docker, "push", f"{args.image_name}:{args.image_tag}"],
+        dry_run=args.dry_run,
+        tool="docker",
+    )
+
+
+# ---------------------------------------------------------------------------
+# chart rendering (helm-template subset sufficient for deploy/helm/*)
+
+_PIPE_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _deep_get(values: dict, dotted: str):
+    cur = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _eval_expr(expr: str, ctx: dict):
+    """Evaluate one {{ ... }} expression: .Values paths, .Release/.Chart
+    fields, `index` lookups, and the default/quote pipe functions. An
+    unresolvable path (no `default` rescue) raises — a typo'd values key
+    must never ship as the literal string 'None'."""
+    stages = [s.strip() for s in expr.split("|")]
+    value = _eval_atom(stages[0], ctx)
+    for stage in stages[1:]:
+        if stage == "quote":
+            value = f'"{value}"'
+        elif stage.startswith("default "):
+            if value in (None, ""):
+                value = _eval_atom(stage[len("default "):].strip(), ctx)
+        else:
+            raise ValueError(f"unsupported template pipe {stage!r}")
+    if value is None:
+        raise ValueError(f"template expression {expr!r} resolved to nothing")
+    return value
+
+
+def _eval_atom(atom: str, ctx: dict):
+    atom = atom.strip()
+    if atom.startswith("include "):
+        # include "name" . — chart helpers; none defined in-tree, so the
+        # default pipe supplies the value
+        return None
+    if atom.startswith("index "):
+        parts = atom.split(None, 2)  # index <path> "key"
+        base = _eval_atom(parts[1], ctx)
+        key = parts[2].strip('"')
+        return (base or {}).get(key)
+    if atom.startswith(".Values."):
+        return _deep_get(ctx["Values"], atom[len(".Values."):])
+    if atom.startswith(".Release."):
+        return ctx["Release"].get(atom[len(".Release."):])
+    if atom.startswith(".Chart."):
+        return ctx["Chart"].get(atom[len(".Chart."):])
+    if atom.startswith('"') and atom.endswith('"'):
+        return atom.strip('"')
+    if atom.startswith(".") and "item" in ctx:
+        # inside a range block: bare .field resolves against the loop item
+        return _deep_get(ctx["item"], atom[1:])
+    raise ValueError(f"unsupported template atom {atom!r}")
+
+
+# {{- trims preceding whitespace/newline, -}} trailing (Go template rules);
+# range/end sit on their own lines in the in-tree chart
+_RANGE_RE = re.compile(
+    r"\n?[ \t]*\{\{-\s*range\s+(\.[\w.]+)\s*\}\}(.*?)\n?[ \t]*\{\{-\s*end\s*\}\}",
+    re.DOTALL,
+)
+
+
+def _expand_ranges(text: str, ctx: dict) -> str:
+    """Expand {{- range .Values.x }} ... {{- end }} blocks (list iteration,
+    loop fields as bare .name atoms)."""
+
+    def repl(m: re.Match) -> str:
+        items = _eval_atom(m.group(1), ctx) or []
+        body = m.group(2)
+        out = []
+        for item in items:
+            inner = dict(ctx, item=item)
+            expanded = _PIPE_RE.sub(lambda mm: str(_eval_expr(mm.group(1), inner)), body)
+            # values are literals, never re-expanded (helm semantics): mask
+            # any braces the substituted values contain from the global pass
+            out.append(expanded.replace("{{", "\x00LB\x00").replace("}}", "\x00RB\x00"))
+        return "".join(out)
+
+    return _RANGE_RE.sub(repl, text)
+
+
+def _unmask(text: str) -> str:
+    return text.replace("\x00LB\x00", "{{").replace("\x00RB\x00", "}}")
+
+
+def render_chart(
+    chart_dir: Path, *, release: str = "curate", set_values: list[str] | None = None
+) -> dict[str, str]:
+    """-> {template filename: rendered manifest}. Covers the template
+    constructs used by the in-tree chart; unknown constructs raise so a
+    chart outgrowing the renderer fails loudly (use real helm then)."""
+    import yaml
+
+    values = yaml.safe_load((chart_dir / "values.yaml").read_text()) or {}
+    chart_meta = yaml.safe_load((chart_dir / "Chart.yaml").read_text()) or {}
+    for assignment in set_values or []:
+        key, _, raw = assignment.partition("=")
+        cur = values
+        parts = key.split(".")
+        for i, p in enumerate(parts[:-1]):
+            cur = cur.setdefault(p, {})
+            if not isinstance(cur, dict):
+                raise ValueError(
+                    f"cannot override {key!r}: {'.'.join(parts[: i + 1])} is not a mapping"
+                )
+        cur[parts[-1]] = yaml.safe_load(raw)
+    ctx = {
+        "Values": values,
+        "Release": {"Name": release, "Namespace": "default"},
+        "Chart": {"Name": chart_meta.get("name", chart_dir.name)},
+    }
+
+    out: dict[str, str] = {}
+    for tmpl in sorted((chart_dir / "templates").glob("*.yaml")):
+        text = _expand_ranges(tmpl.read_text(), ctx)
+        rendered = _unmask(_PIPE_RE.sub(lambda m: str(_eval_expr(m.group(1), ctx)), text))
+        # validate: every rendered manifest must parse as YAML
+        list(yaml.safe_load_all(rendered))
+        out[tmpl.name] = rendered
+    return out
+
+
+def cmd_render(args: argparse.Namespace) -> int:
+    try:
+        manifests = render_chart(
+            Path(args.chart), release=args.release, set_values=args.set or []
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.output_dir:
+        outdir = Path(args.output_dir)
+        outdir.mkdir(parents=True, exist_ok=True)
+        for name, text in manifests.items():
+            (outdir / name).write_text(text)
+            print(f"wrote {outdir / name}")
+    else:
+        for name, text in manifests.items():
+            print(f"---\n# Source: {name}\n{text}")
+    return 0
+
+
+def cmd_apply(args: argparse.Namespace) -> int:
+    try:
+        manifests = render_chart(
+            Path(args.chart), release=args.release, set_values=args.set or []
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    doc = "\n---\n".join(manifests.values())
+    cmd = [args.kubectl, "apply", "-f", "-"]
+    if args.namespace:
+        cmd += ["-n", args.namespace]
+    return _run_or_print(cmd, dry_run=args.dry_run, tool="kubectl", stdin=doc.encode())
+
+
+# ---------------------------------------------------------------------------
+# argparse wiring
+
+
+def register(sub) -> None:
+    """Same lazy-registration convention as the other cli modules."""
+    add_image_parser(sub)
+    add_deploy_parser(sub)
+
+
+def add_image_parser(sub) -> None:
+    image = sub.add_parser("image", help="build/push the container image")
+    isub = image.add_subparsers(dest="image_cmd", required=True)
+
+    build = isub.add_parser("build", help="docker build the curate image")
+    build.add_argument("--image-name", default="cosmos-curate-tpu")
+    build.add_argument("--image-tag", default="0.1.0")
+    build.add_argument("--dockerfile", default=str(DEFAULT_DOCKERFILE))
+    build.add_argument("--context", default=str(REPO_ROOT))
+    build.add_argument("--cache-from", action="append", default=None)
+    build.add_argument("--cache-to", default=None)
+    build.add_argument("--platform", default=None)
+    build.add_argument("--push", action="store_true")
+    build.add_argument("--docker", default="docker")
+    build.add_argument("--dry-run", action="store_true")
+    build.set_defaults(func=cmd_build)
+
+    push = isub.add_parser("push", help="docker push the curate image")
+    push.add_argument("--image-name", default="cosmos-curate-tpu")
+    push.add_argument("--image-tag", default="0.1.0")
+    push.add_argument("--docker", default="docker")
+    push.add_argument("--dry-run", action="store_true")
+    push.set_defaults(func=cmd_push)
+
+
+def add_deploy_parser(sub) -> None:
+    deploy = sub.add_parser("deploy", help="render/apply the k8s deployment")
+    dsub = deploy.add_subparsers(dest="deploy_cmd", required=True)
+
+    render = dsub.add_parser("render", help="expand the Helm chart to manifests")
+    render.add_argument("--chart", default=str(DEFAULT_CHART))
+    render.add_argument("--release", default="curate")
+    render.add_argument("--set", action="append", help="values override key=val")
+    render.add_argument("--output-dir", default=None)
+    render.set_defaults(func=cmd_render)
+
+    apply = dsub.add_parser("apply", help="kubectl-apply the rendered manifests")
+    apply.add_argument("--chart", default=str(DEFAULT_CHART))
+    apply.add_argument("--release", default="curate")
+    apply.add_argument("--set", action="append")
+    apply.add_argument("--namespace", default=None)
+    apply.add_argument("--kubectl", default="kubectl")
+    apply.add_argument("--dry-run", action="store_true")
+    apply.set_defaults(func=cmd_apply)

@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
         models_cli.register(sub)
     except ImportError:
         pass
+    # no optional deps — an ImportError here would be a real defect, so no guard
+    from cosmos_curate_tpu.cli import image_cli
+
+    image_cli.register(sub)
     return parser
 
 

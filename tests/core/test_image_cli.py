@@ -1,0 +1,125 @@
+"""image build / deploy render CLI (reference image_app.py + nvcf deploy
+capability, retargeted at docker + the Helm chart)."""
+
+from __future__ import annotations
+
+import yaml
+
+from cosmos_curate_tpu.cli.image_cli import DEFAULT_CHART, render_chart
+from cosmos_curate_tpu.cli.main import build_parser
+
+
+def _run(argv: list[str], capsys) -> tuple[int, str]:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    rc = args.func(args)
+    return rc, capsys.readouterr().out
+
+
+def test_image_build_dry_run(capsys):
+    rc, out = _run(
+        [
+            "image", "build", "--dry-run",
+            "--image-tag", "9.9.9",
+            "--cache-from", "type=registry,ref=cache:latest",
+            "--push",
+        ],
+        capsys,
+    )
+    assert rc == 0
+    assert "docker build" in out
+    assert "-t cosmos-curate-tpu:9.9.9" in out
+    assert "--cache-from type=registry,ref=cache:latest" in out
+    assert "docker push cosmos-curate-tpu:9.9.9" in out
+
+
+def test_image_build_missing_docker_is_clear(capsys):
+    rc, _ = _run(
+        ["image", "build", "--docker", "definitely-not-a-binary"], capsys
+    )
+    assert rc == 3
+
+
+def test_render_chart_produces_valid_manifests():
+    manifests = render_chart(DEFAULT_CHART, release="myrun")
+    assert "deployment.yaml" in manifests and "service.yaml" in manifests
+    deploy = yaml.safe_load(manifests["deployment.yaml"])
+    assert deploy["kind"] == "Deployment"
+    assert deploy["metadata"]["name"] == "myrun"
+    tpl = deploy["spec"]["template"]["spec"]
+    assert tpl["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    container = tpl["containers"][0]
+    assert container["image"] == "cosmos-curate-tpu:0.1.0"
+    assert container["resources"]["limits"]["google.com/tpu"] == 8
+
+
+def test_render_chart_set_overrides():
+    manifests = render_chart(
+        DEFAULT_CHART,
+        set_values=["image.tag=2.0.0", "replicas=3", "tpu.topology=2x2"],
+    )
+    deploy = yaml.safe_load(manifests["deployment.yaml"])
+    assert deploy["spec"]["replicas"] == 3
+    assert deploy["spec"]["template"]["spec"]["containers"][0]["image"].endswith(":2.0.0")
+    assert (
+        deploy["spec"]["template"]["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+        == "2x2"
+    )
+
+
+def test_deploy_render_cli_writes_dir(tmp_path, capsys):
+    rc, out = _run(
+        ["deploy", "render", "--output-dir", str(tmp_path), "--release", "r1"], capsys
+    )
+    assert rc == 0
+    assert (tmp_path / "deployment.yaml").exists()
+    assert yaml.safe_load((tmp_path / "service.yaml").read_text())["kind"] == "Service"
+
+
+def test_deploy_apply_dry_run(capsys):
+    rc, out = _run(["deploy", "apply", "--dry-run"], capsys)
+    assert rc == 0
+    assert "kubectl apply -f -" in out
+    assert "kind: Deployment" in out
+
+
+def test_render_range_block_with_items():
+    manifests = render_chart(
+        DEFAULT_CHART,
+        set_values=['env=[{"name": "CURATE_LOG_LEVEL", "value": "DEBUG"}]'],
+    )
+    deploy = yaml.safe_load(manifests["deployment.yaml"])
+    env = deploy["spec"]["template"]["spec"]["containers"][0]["env"]
+    assert {"name": "CURATE_LOG_LEVEL", "value": "DEBUG"} in env
+
+
+def test_templated_env_value_stays_literal():
+    """helm never re-expands substituted values; '{{ ... }}' inside an env
+    value must survive verbatim."""
+    manifests = render_chart(
+        DEFAULT_CHART,
+        set_values=['env=[{"name": "T", "value": "{{ .Release.Name }}"}]'],
+    )
+    deploy = yaml.safe_load(manifests["deployment.yaml"])
+    env = deploy["spec"]["template"]["spec"]["containers"][0]["env"]
+    assert {"name": "T", "value": "{{ .Release.Name }}"} in env
+
+
+def test_bad_set_path_is_clear_error(capsys):
+    parser = build_parser()
+    args = parser.parse_args(["deploy", "render", "--set", "replicas.max=3"])
+    rc = args.func(args)
+    assert rc == 2
+    assert "not a mapping" in capsys.readouterr().err
+
+
+def test_missing_values_path_raises(tmp_path):
+    import pytest
+
+    chart = tmp_path / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "values.yaml").write_text("a: 1\n")
+    (chart / "Chart.yaml").write_text("name: t\n")
+    (chart / "templates" / "x.yaml").write_text("v: {{ .Values.missing.key }}\n")
+    with pytest.raises(ValueError, match="resolved to nothing"):
+        render_chart(chart)
