@@ -99,7 +99,8 @@ def _eval_expr(expr: str, ctx: dict):
     value = _eval_atom(stages[0], ctx)
     for stage in stages[1:]:
         if stage == "quote":
-            value = f'"{value}"'
+            escaped = str(value).replace("\\", "\\\\").replace('"', '\\"')
+            value = f'"{escaped}"'
         elif stage.startswith("default "):
             if value in (None, ""):
                 value = _eval_atom(stage[len("default "):].strip(), ctx)
@@ -208,9 +209,16 @@ def cmd_render(args: argparse.Namespace) -> int:
         manifests = render_chart(
             Path(args.chart), release=args.release, set_values=args.set or []
         )
-    except ValueError as e:
+    except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except Exception as e:  # yaml parse errors etc. — user input, not a bug
+        import yaml
+
+        if isinstance(e, yaml.YAMLError):
+            print(f"error: invalid YAML in chart or --set value: {e}", file=sys.stderr)
+            return 2
+        raise
     if args.output_dir:
         outdir = Path(args.output_dir)
         outdir.mkdir(parents=True, exist_ok=True)
@@ -228,9 +236,16 @@ def cmd_apply(args: argparse.Namespace) -> int:
         manifests = render_chart(
             Path(args.chart), release=args.release, set_values=args.set or []
         )
-    except ValueError as e:
+    except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except Exception as e:  # yaml parse errors etc. — user input, not a bug
+        import yaml
+
+        if isinstance(e, yaml.YAMLError):
+            print(f"error: invalid YAML in chart or --set value: {e}", file=sys.stderr)
+            return 2
+        raise
     doc = "\n---\n".join(manifests.values())
     cmd = [args.kubectl, "apply", "-f", "-"]
     if args.namespace:

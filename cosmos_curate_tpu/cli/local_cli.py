@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 
 def register(sub: argparse._SubParsersAction) -> None:
@@ -44,6 +45,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--semantic-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--clip-chunk-size", type=int, default=64)
     split.add_argument("--sequential", action="store_true", help="run in-process (no engine)")
+    split.add_argument(
+        "--runner",
+        choices=["auto", "sequential", "streaming", "map"],
+        default="auto",
+        help="execution backend: streaming engine, in-process sequential, "
+        "or barrier map over a process pool",
+    )
     split.add_argument("--profile-cpu", action="store_true")
     split.add_argument("--profile-memory", action="store_true")
     split.add_argument("--tracing", action="store_true")
@@ -254,7 +262,26 @@ def _cmd_split(args: argparse.Namespace) -> int:
             tracing=args.tracing,
             stage_save_rate=args.stage_save_rate,
         )
-    runner = SequentialRunner() if args.sequential else None
+    choice = getattr(args, "runner", "auto")
+    if args.sequential:
+        if choice not in ("auto", "sequential"):
+            print(
+                f"error: --sequential conflicts with --runner {choice}", file=sys.stderr
+            )
+            return 2
+        choice = "sequential"
+    if choice == "sequential":
+        runner = SequentialRunner()
+    elif choice == "map":
+        from cosmos_curate_tpu.core.map_runner import MapRunner
+
+        runner = MapRunner()
+    elif choice == "streaming":
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+        runner = StreamingRunner()
+    else:
+        runner = None  # run_split picks the default
     summary = run_split(pargs, runner=runner)
     print(json.dumps(summary, indent=2))
     return 0

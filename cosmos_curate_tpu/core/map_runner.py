@@ -1,0 +1,158 @@
+"""MapRunner: stage-by-stage process-pool execution backend.
+
+The reference keeps a second execution backend beside the xenna streaming
+engine — a Ray-Data map-batches pipeline with simpler barrier semantics
+(cosmos_curate/pipelines/video/ray_data/, SURVEY.md §2.4 "Ray-Data alt
+backend"). This is that alternative for the TPU stack: each stage runs to
+completion over all tasks before the next starts (a barrier, unlike the
+StreamingRunner's continuous flow), with CPU stages fanned out over a
+process pool and accelerator stages kept in-process (the TPU is owned by
+exactly one process).
+
+Semantics:
+- lifecycle per stage: worker processes run ``setup_on_node`` → ``setup``
+  once (pool initializer), then ``process_data`` per batch; ``destroy``
+  runs at pool shutdown in each worker.
+- per-batch retries honor ``StageSpec.num_run_attempts``; a failing batch
+  is dropped (raise_on_error=False) or aborts the run.
+- ``stage_times`` matches the other runners for MFU/bench accounting.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import pickle
+import time
+from concurrent.futures import ProcessPoolExecutor
+
+from cosmos_curate_tpu.core.pipeline import PipelineSpec
+from cosmos_curate_tpu.core.runner import RunnerInterface, SequentialRunner
+from cosmos_curate_tpu.core.stage import NodeInfo, WorkerMetadata
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_WORKER_STAGE = None
+
+
+def _worker_init(stage_bytes: bytes, stage_name: str) -> None:
+    global _WORKER_STAGE
+    stage = pickle.loads(stage_bytes)
+    node = NodeInfo(node_id="local")
+    meta = WorkerMetadata(
+        worker_id=f"{stage_name}-map-{os.getpid()}",
+        stage_name=stage_name,
+        node=node,
+        allocation=stage.resources,
+    )
+    stage.setup_on_node(node, meta)
+    stage.setup(meta)
+    _WORKER_STAGE = stage
+    atexit.register(stage.destroy)
+
+
+def _worker_process(batch_bytes: bytes) -> bytes:
+    batch = pickle.loads(batch_bytes)
+    result = _WORKER_STAGE.process_data(batch)
+    if result is not None and not isinstance(result, list):
+        raise TypeError(
+            f"stage {_WORKER_STAGE.name}.process_data must return "
+            f"list[PipelineTask] or None, got {type(result).__name__}"
+        )
+    return pickle.dumps(result)
+
+
+class MapRunner(RunnerInterface):
+    """Barrier-per-stage map execution over a process pool."""
+
+    def __init__(
+        self, *, max_workers: int | None = None, raise_on_error: bool = True
+    ) -> None:
+        self.max_workers = max_workers
+        self.raise_on_error = raise_on_error
+        self.stage_times: dict[str, float] = {}
+
+    def _stage_workers(self, stage_spec) -> int:
+        if self.max_workers is not None:
+            cap = self.max_workers
+        else:
+            cap = max(1, (os.cpu_count() or 1))
+        wanted = stage_spec.num_workers or cap
+        return max(1, min(wanted, cap))
+
+    def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        tasks: list[PipelineTask] = list(spec.input_data)
+        for stage_spec in spec.stages:
+            stage = stage_spec.stage
+            t0 = time.monotonic()
+            workers = self._stage_workers(stage_spec)
+            # the TPU belongs to one process: accelerator stages (and
+            # explicit single-worker stages) run in-process
+            if stage.resources.tpus > 0 or workers == 1:
+                tasks = self._run_inline(stage, stage_spec, tasks)
+            else:
+                tasks = self._run_pool(stage, stage_spec, tasks, workers)
+            stage_s = time.monotonic() - t0
+            self.stage_times[stage.name] = self.stage_times.get(stage.name, 0.0) + stage_s
+            logger.info(
+                "map stage %s: -> %d tasks in %.2fs (%s)",
+                stage.name, len(tasks), stage_s,
+                "inline" if stage.resources.tpus > 0 or workers == 1 else f"{workers} procs",
+            )
+        return tasks if spec.config.return_last_stage_outputs else None
+
+    def _run_inline(self, stage, stage_spec, tasks):
+        from cosmos_curate_tpu.core.pipeline import PipelineConfig
+
+        sub = SequentialRunner(raise_on_error=self.raise_on_error)
+        spec_one = PipelineSpec(
+            input_data=tasks,
+            stages=[stage_spec],
+            config=PipelineConfig(return_last_stage_outputs=True),
+        )
+        return sub.run(spec_one) or []
+
+    def _run_pool(self, stage, stage_spec, tasks, workers):
+        import multiprocessing
+
+        bs = max(1, stage.batch_size)
+        batches = [tasks[i : i + bs] for i in range(0, len(tasks), bs)]
+        if not batches:
+            return []
+        out: list[PipelineTask] = []
+        ctx = multiprocessing.get_context("spawn")
+        stage_bytes = pickle.dumps(stage)
+        attempts = max(1, stage_spec.num_run_attempts)
+        with ProcessPoolExecutor(
+            max_workers=min(workers, len(batches)),
+            mp_context=ctx,
+            initializer=_worker_init,
+            initargs=(stage_bytes, stage.name),
+        ) as pool:
+            pending = {pool.submit(_worker_process, pickle.dumps(b)): (b, 1) for b in batches}
+            while pending:
+                from concurrent.futures import FIRST_COMPLETED, wait
+
+                done, _ = wait(pending, return_when=FIRST_COMPLETED)
+                for fut in done:
+                    batch, attempt = pending.pop(fut)
+                    try:
+                        result = pickle.loads(fut.result())
+                    except Exception:
+                        if attempt < attempts:
+                            pending[pool.submit(_worker_process, pickle.dumps(batch))] = (
+                                batch, attempt + 1,
+                            )
+                            continue
+                        if self.raise_on_error:
+                            raise
+                        logger.exception(
+                            "map stage %s: batch failed after %d attempts; dropping",
+                            stage.name, attempt,
+                        )
+                        continue
+                    if result:
+                        out.extend(result)
+        return out

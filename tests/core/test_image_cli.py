@@ -123,3 +123,21 @@ def test_missing_values_path_raises(tmp_path):
     (chart / "templates" / "x.yaml").write_text("v: {{ .Values.missing.key }}\n")
     with pytest.raises(ValueError, match="resolved to nothing"):
         render_chart(chart)
+
+
+def test_quote_pipe_escapes_embedded_quotes():
+    manifests = render_chart(
+        DEFAULT_CHART,
+        set_values=['env=[{"name": "MSG", "value": "say \\"hi\\""}]'],
+    )
+    deploy = yaml.safe_load(manifests["deployment.yaml"])
+    env = deploy["spec"]["template"]["spec"]["containers"][0]["env"]
+    assert {"name": "MSG", "value": 'say "hi"'} in env
+
+
+def test_bad_chart_path_is_clear_error(capsys):
+    parser = build_parser()
+    args = parser.parse_args(["deploy", "render", "--chart", "/nonexistent"])
+    rc = args.func(args)
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
