@@ -84,6 +84,22 @@ def register(sub: argparse._SubParsersAction) -> None:
     image.add_argument("--limit", type=int, default=0)
     image.add_argument("--aesthetic-threshold", type=float, default=None)
     image.add_argument("--captioning", action="store_true")
+    image.add_argument(
+        "--semantic-filter", choices=["disable", "score-only", "enable"], default="disable"
+    )
+    image.add_argument("--semantic-filter-prompt", default=None)
+    image.add_argument(
+        "--classifier-labels", default="", help="comma-separated label set; empty = off"
+    )
+    image.add_argument(
+        "--api-caption-url", default="", help="OpenAI-compatible endpoint for captioning"
+    )
+    image.add_argument("--api-caption-model", default="default")
+    image.add_argument(
+        "--api-caption-key",
+        default="",
+        help="bearer token for the caption endpoint (or set CURATE_API_KEY)",
+    )
     image.add_argument("--sequential", action="store_true")
     image.set_defaults(func=_cmd_image)
 
@@ -187,6 +203,14 @@ def _cmd_image(args: argparse.Namespace) -> int:
             limit=args.limit,
             aesthetic_threshold=args.aesthetic_threshold,
             captioning=args.captioning,
+            semantic_filter=args.semantic_filter,
+            semantic_filter_prompt=args.semantic_filter_prompt,
+            classifier_labels=tuple(
+                s.strip() for s in args.classifier_labels.split(",") if s.strip()
+            ),
+            api_caption_url=args.api_caption_url,
+            api_caption_model=args.api_caption_model,
+            api_caption_key=args.api_caption_key,
         ),
         runner=SequentialRunner() if args.sequential else None,
     )

@@ -42,6 +42,10 @@ SEMANTIC_FILTER_PROMPTS: dict[str, str] = {
         "Does this video clip contain burned-in overlay text, subtitles, "
         "or watermarks? Answer yes or no."
     ),
+    "image-default": (
+        "Is this a clear, well-lit, non-synthetic real-world photograph? "
+        "Answer yes or no."
+    ),
 }
 
 

@@ -46,6 +46,8 @@ class ImageTask(PipelineTask):
     embedding: np.ndarray | None = None
     aesthetic_score: float | None = None
     caption: str = ""
+    label: str = ""
+    semantic_pass: bool | None = None
     filtered_by: str = ""
     errors: dict[str, str] = field(default_factory=dict)
 
@@ -100,6 +102,62 @@ class ImageEmbeddingStage(Stage[ImageTask, ImageTask]):
             embs = self._model.encode_frames(batch)
             for t, e in zip(live, embs):
                 t.embedding = e
+        return tasks
+
+
+class ImageVideoEmbeddingStage(Stage[ImageTask, ImageTask]):
+    """Embeds stills through the temporal video embedder by repeating the
+    frame (reference ImageCosmosEmbed1EmbeddingStage /
+    ImageInternVideo2EmbeddingStage, image_embedding_stages.py:45/132 — the
+    video-embedding space shared between clips and images enables joint
+    dedup/search across both)."""
+
+    def __init__(self, *, variant: str = "video", video_cfg=None) -> None:
+        from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_VARIANTS, VideoEmbedder
+
+        if video_cfg is not None:
+            self._model = VideoEmbedder(video_cfg)
+        else:
+            if variant not in VIDEO_EMBED_VARIANTS:
+                raise ValueError(
+                    f"unknown embedder variant {variant!r}; have {sorted(VIDEO_EMBED_VARIANTS)}"
+                )
+            cfg, model_id = VIDEO_EMBED_VARIANTS[variant]
+            self._model = VideoEmbedder(cfg, model_id=model_id)
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=1.0)
+
+    @property
+    def batch_size(self) -> int:
+        return 16
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        import cv2
+
+        live = [t for t in tasks if t.pixels is not None]
+        if not live:
+            return tasks
+        n_frames = self._model.cfg.num_frames
+        s = self._model.cfg.vit.image_size
+        frames = np.stack(
+            [
+                np.repeat(
+                    cv2.resize(t.pixels, (s, s), interpolation=cv2.INTER_AREA)[None],
+                    n_frames,
+                    axis=0,
+                )
+                for t in live
+            ]
+        )
+        embs = self._model.encode_clips(frames)
+        for t, e in zip(live, embs):
+            t.embedding = e
         return tasks
 
 
@@ -198,6 +256,8 @@ class ImageWriterStage(Stage[ImageTask, ImageTask]):
                 "height": t.height,
                 "aesthetic_score": t.aesthetic_score,
                 "caption": t.caption,
+                "label": t.label,
+                "semantic_pass": t.semantic_pass,
                 "filtered_by": t.filtered_by,
                 "errors": t.errors,
             }
@@ -241,6 +301,15 @@ class ImagePipelineArgs:
     aesthetic_threshold: float | None = None
     captioning: bool = False
     caption_prompt_variant: str = "short"
+    # VLM semantic filter (reference ImageSemanticFilterStage)
+    semantic_filter: str = "disable"  # disable | score-only | enable
+    semantic_filter_prompt: str | None = None
+    # VLM classifier (reference ImageClassifierStage); empty = off
+    classifier_labels: tuple[str, ...] = ()
+    # OpenAI-compatible API captioning instead of the local engine
+    api_caption_url: str = ""
+    api_caption_model: str = "default"
+    api_caption_key: str = ""  # falls back to $CURATE_API_KEY
 
 
 def discover_image_tasks(input_path: str, output_path: str | None = None, *, limit: int = 0):
@@ -279,7 +348,32 @@ def run_image_annotate(
     stages: list[Stage] = [ImageLoadStage(), ImageEmbeddingStage()]
     if args.aesthetic_threshold is not None:
         stages.append(ImageAestheticFilterStage(threshold=args.aesthetic_threshold))
-    if args.captioning:
+    if args.semantic_filter != "disable":
+        from cosmos_curate_tpu.pipelines.image.filters import ImageSemanticFilterStage
+
+        stages.append(
+            ImageSemanticFilterStage(
+                user_prompt=args.semantic_filter_prompt,
+                score_only=args.semantic_filter == "score-only",
+            )
+        )
+    if args.classifier_labels:
+        from cosmos_curate_tpu.pipelines.image.filters import ImageClassifierStage
+
+        stages.append(ImageClassifierStage(labels=args.classifier_labels))
+    if args.api_caption_url:
+        from cosmos_curate_tpu.pipelines.image.api_caption import ImageApiCaptionStage
+
+        import os
+
+        stages.append(
+            ImageApiCaptionStage(
+                base_url=args.api_caption_url,
+                model=args.api_caption_model,
+                api_key=args.api_caption_key or os.environ.get("CURATE_API_KEY", ""),
+            )
+        )
+    elif args.captioning:
         stages.append(ImageCaptionStage(prompt_variant=args.caption_prompt_variant))
     stages.extend(extra_stages or [])
     stages.append(ImageWriterStage(args.output_path))

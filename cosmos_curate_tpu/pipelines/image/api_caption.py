@@ -1,0 +1,133 @@
+"""API-backed image captioning via OpenAI-compatible chat endpoints.
+
+Equivalent capability of the reference's API caption stages
+(cosmos_curate/pipelines/image/captioning/image_api_caption_stages.py:234-593
+— ImageOpenAIPrepStage / ImageOpenAICaptionStage / ImageGeminiCaptionStage:
+caption through a hosted multimodal endpoint instead of the local model).
+One stage speaking the OpenAI chat-completions dialect covers any
+compatible server (hosted APIs, vLLM/llama.cpp serving, a gateway in front
+of Gemini). stdlib urllib only; concurrency via a small thread pool;
+per-image retry with backoff; failures recorded per task, never fatal.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.pipelines.image.annotate import ImageTask
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ImageApiCaptionStage(Stage[ImageTask, ImageTask]):
+    def __init__(
+        self,
+        *,
+        base_url: str,
+        model: str = "default",
+        api_key: str = "",
+        prompt: str = "Describe this image in one detailed sentence.",
+        max_tokens: int = 128,
+        timeout_s: float = 60.0,
+        max_retries: int = 3,
+        concurrency: int = 4,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.model_name = model
+        self.api_key = api_key
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.concurrency = max(1, concurrency)
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0)
+
+    _MEDIA_TYPES = {
+        ".png": "image/png",
+        ".webp": "image/webp",
+        ".bmp": "image/bmp",
+        ".jpg": "image/jpeg",
+        ".jpeg": "image/jpeg",
+    }
+
+    def _payload(self, task: ImageTask) -> bytes:
+        suffix = "." + task.path.rsplit(".", 1)[-1].lower() if "." in task.path else ""
+        media = self._MEDIA_TYPES.get(suffix, "image/jpeg")
+        b64 = base64.b64encode(task.raw_bytes or b"").decode()
+        return json.dumps(
+            {
+                "model": self.model_name,
+                "max_tokens": self.max_tokens,
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": [
+                            {"type": "text", "text": self.prompt},
+                            {
+                                "type": "image_url",
+                                "image_url": {"url": f"data:{media};base64,{b64}"},
+                            },
+                        ],
+                    }
+                ],
+            }
+        ).encode()
+
+    def _caption_one(self, task: ImageTask) -> None:
+        url = f"{self.base_url}/v1/chat/completions"
+        payload = self._payload(task)
+        last: Exception | None = None
+        for attempt in range(self.max_retries):
+            req = urllib.request.Request(
+                url, data=payload, method="POST",
+                headers={"content-type": "application/json"},
+            )
+            if self.api_key:
+                req.add_header("authorization", f"Bearer {self.api_key}")
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    body = json.loads(resp.read())
+                task.caption = body["choices"][0]["message"]["content"].strip()
+                return
+            except urllib.error.HTTPError as e:
+                last = e
+                if e.code not in (429, 500, 502, 503, 504):
+                    break  # 4xx won't heal on retry
+            except (
+                urllib.error.URLError,
+                ConnectionError,
+                TimeoutError,
+                # malformed 200 bodies: non-JSON, empty choices, null message
+                ValueError,
+                KeyError,
+                IndexError,
+                TypeError,
+                AttributeError,
+            ) as e:
+                last = e
+            if attempt + 1 < self.max_retries:
+                time.sleep(min(2.0**attempt * 0.2, 5.0))
+        task.errors["api_caption"] = repr(last)
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        live = [t for t in tasks if t.raw_bytes is not None and not t.filtered_by]
+        if not live:
+            return tasks
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            list(pool.map(self._caption_one, live))
+        done = sum(1 for t in live if t.caption)
+        if done < len(live):
+            logger.warning(
+                "api captioning: %d/%d images failed", len(live) - done, len(live)
+            )
+        return tasks

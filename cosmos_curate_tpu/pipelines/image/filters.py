@@ -1,0 +1,141 @@
+"""Image semantic filter + classifier stages.
+
+Equivalent capability of the reference's image filtering
+(cosmos_curate/pipelines/image/filtering/filter_stages.py:54
+``ImageSemanticFilterStage`` — rejects images whose VLM filter-caption
+matches rejection criteria — and :137 ``ImageClassifierStage`` — assigns a
+class label parsed from a VLM answer). Both run on the shared caption
+engine like the video twins (pipelines/video/stages/semantic_filter.py).
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.models.prompts import SEMANTIC_FILTER_PROMPTS
+from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
+from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
+from cosmos_curate_tpu.pipelines.image.annotate import ImageTask
+from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
+from cosmos_curate_tpu.pipelines.video.stages.semantic_filter import parse_yes_no
+
+
+class ImageSemanticFilterStage(Stage[ImageTask, ImageTask]):
+    """Marks images the VLM answers 'no' for as filtered (or scores only)."""
+
+    def __init__(
+        self,
+        *,
+        prompt_variant: str = "image-default",
+        user_prompt: str | None = None,
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        score_only: bool = False,
+        keep_on_unparseable: bool = True,
+    ) -> None:
+        self.prompt = user_prompt or SEMANTIC_FILTER_PROMPTS[prompt_variant]
+        self.score_only = score_only
+        self.keep_on_unparseable = keep_on_unparseable
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = default_caption_tokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        targets: dict[str, ImageTask] = {}
+        for t in tasks:
+            if t.pixels is None or t.filtered_by:
+                continue
+            targets[t.path] = t
+            engine.add_request(
+                CaptionRequest(
+                    request_id=t.path,
+                    prompt_ids=self.tokenizer.encode(self.prompt),
+                    frames=t.pixels[None],
+                    sampling=SamplingConfig(max_new_tokens=8),
+                )
+            )
+        if not targets:
+            return tasks
+        for res in engine.run_until_complete():
+            t = targets.get(res.request_id)
+            if t is None:
+                continue
+            verdict = parse_yes_no(res.text)
+            t.semantic_pass = verdict  # recorded even in score-only mode
+            keep = verdict if verdict is not None else self.keep_on_unparseable
+            if not self.score_only and not keep:
+                t.filtered_by = "semantic"
+        return tasks
+
+
+class ImageClassifierStage(Stage[ImageTask, ImageTask]):
+    """Assigns ``task.label`` from a label set via a VLM answer (reference
+    ImageClassifierStage capability)."""
+
+    def __init__(
+        self,
+        labels: tuple[str, ...] = ("photo", "illustration", "screenshot", "document"),
+        *,
+        cfg: VLMConfig = VLM_BASE,
+        max_batch: int = 8,
+        unknown_label: str = "unknown",
+    ) -> None:
+        if not labels:
+            raise ValueError("labels must be non-empty")
+        self.labels = labels
+        self.unknown_label = unknown_label
+        self.prompt = (
+            "Classify this image into exactly one of these categories: "
+            + ", ".join(labels)
+            + ". Answer with only the category name."
+        )
+        self._model = _CaptionVLM(cfg, max_batch)
+        self.tokenizer = default_caption_tokenizer()
+
+    @property
+    def model(self) -> ModelInterface:
+        return self._model
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    def parse_label(self, text: str) -> str:
+        t = text.strip().lower()
+        for label in self.labels:
+            if label.lower() in t:
+                return label
+        return self.unknown_label
+
+    def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:
+        engine = self._model.engine
+        assert engine is not None, "setup() not called"
+        targets: dict[str, ImageTask] = {}
+        for t in tasks:
+            if t.pixels is None or t.filtered_by:
+                continue
+            targets[t.path] = t
+            engine.add_request(
+                CaptionRequest(
+                    request_id=t.path,
+                    prompt_ids=self.tokenizer.encode(self.prompt),
+                    frames=t.pixels[None],
+                    sampling=SamplingConfig(max_new_tokens=12),
+                )
+            )
+        if not targets:
+            return tasks
+        for res in engine.run_until_complete():
+            t = targets.get(res.request_id)
+            if t is not None:
+                t.label = self.parse_label(res.text)
+        return tasks

@@ -1,0 +1,213 @@
+"""Image semantic filter / classifier / API caption stages (reference
+filter_stages.py + image_api_caption_stages.py capability)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import cv2
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.models.vlm import VLM_TINY_TEST
+from cosmos_curate_tpu.pipelines.image.annotate import ImageLoadStage, ImageTask
+from cosmos_curate_tpu.pipelines.image.api_caption import ImageApiCaptionStage
+from cosmos_curate_tpu.pipelines.image.filters import (
+    ImageClassifierStage,
+    ImageSemanticFilterStage,
+)
+
+
+@pytest.fixture()
+def image_tasks(tmp_path):
+    rng = np.random.default_rng(0)
+    tasks = []
+    for i in range(3):
+        p = tmp_path / f"i{i}.jpg"
+        cv2.imwrite(str(p), rng.integers(0, 255, (32, 48, 3), np.uint8))
+        tasks.append(ImageTask(path=str(p)))
+    return tasks
+
+
+def test_semantic_filter_runs_engine(image_tasks):
+    out = run_pipeline(
+        image_tasks,
+        [
+            ImageLoadStage(),
+            ImageSemanticFilterStage(cfg=VLM_TINY_TEST, score_only=True, max_batch=4),
+        ],
+        runner=SequentialRunner(),
+    )
+    assert len(out) == 3
+    # score_only: nothing dropped regardless of the tiny model's answers
+    assert all(not t.filtered_by or t.filtered_by == "" for t in out)
+
+
+def test_classifier_assigns_label(image_tasks):
+    stage = ImageClassifierStage(labels=("photo", "chart"), cfg=VLM_TINY_TEST, max_batch=4)
+    out = run_pipeline(
+        image_tasks, [ImageLoadStage(), stage], runner=SequentialRunner()
+    )
+    assert all(t.label in ("photo", "chart", "unknown") for t in out)
+
+
+def test_classifier_label_parsing():
+    stage = ImageClassifierStage(labels=("photo", "chart"), cfg=VLM_TINY_TEST)
+    assert stage.parse_label("This is a Photo of a dog") == "photo"
+    assert stage.parse_label("CHART") == "chart"
+    assert stage.parse_label("gibberish") == "unknown"
+
+
+class _FakeOpenAI:
+    def __init__(self, *, fail_first: int = 0) -> None:
+        self.requests: list[dict] = []
+        self.fail_first = fail_first
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("content-length", "0"))
+                body = json.loads(self.rfile.read(length))
+                srv.requests.append(
+                    {"path": self.path, "auth": self.headers.get("authorization"), "body": body}
+                )
+                if srv.fail_first > 0:
+                    srv.fail_first -= 1
+                    self.send_response(503)
+                    self.end_headers()
+                    return
+                n = len(body["messages"][0]["content"][1]["image_url"]["url"])
+                reply = json.dumps(
+                    {"choices": [{"message": {"content": f"a synthetic image ({n} b64 chars)"}}]}
+                ).encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(reply)))
+                self.end_headers()
+                self.wfile.write(reply)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def test_api_caption_stage(image_tasks):
+    with _FakeOpenAI() as api:
+        stage = ImageApiCaptionStage(
+            base_url=api.endpoint, model="test-vlm", api_key="sk-test", concurrency=2
+        )
+        out = run_pipeline(
+            image_tasks, [ImageLoadStage(), stage], runner=SequentialRunner()
+        )
+        assert all(t.caption.startswith("a synthetic image") for t in out)
+        req = api.requests[0]
+        assert req["path"] == "/v1/chat/completions"
+        assert req["auth"] == "Bearer sk-test"
+        assert req["body"]["model"] == "test-vlm"
+        assert req["body"]["messages"][0]["content"][1]["image_url"]["url"].startswith(
+            "data:image/jpeg;base64,"
+        )
+
+
+def test_api_caption_retries_then_succeeds(image_tasks):
+    with _FakeOpenAI(fail_first=2) as api:
+        stage = ImageApiCaptionStage(base_url=api.endpoint, max_retries=3, concurrency=1)
+        out = run_pipeline(
+            image_tasks[:1], [ImageLoadStage(), stage], runner=SequentialRunner()
+        )
+        assert out[0].caption
+
+
+def test_api_caption_unreachable_records_error(image_tasks):
+    stage = ImageApiCaptionStage(
+        base_url="http://127.0.0.1:1", max_retries=1, timeout_s=2, concurrency=1
+    )
+    out = run_pipeline(
+        image_tasks[:1], [ImageLoadStage(), stage], runner=SequentialRunner()
+    )
+    assert "api_caption" in out[0].errors
+    assert not out[0].caption
+
+
+def test_image_video_embedding_stage(image_tasks):
+    from cosmos_curate_tpu.models.embedder import VideoEmbedConfig
+    from cosmos_curate_tpu.models.vit import ViTConfig
+    from cosmos_curate_tpu.pipelines.image.annotate import ImageVideoEmbeddingStage
+
+    tiny = VideoEmbedConfig(
+        vit=ViTConfig(image_size=32, patch_size=16, width=32, layers=1, heads=2, projection_dim=16),
+        temporal_layers=1,
+        temporal_heads=2,
+        num_frames=2,
+        output_dim=16,
+    )
+    stage = ImageVideoEmbeddingStage(video_cfg=tiny)
+    out = run_pipeline(image_tasks, [ImageLoadStage(), stage], runner=SequentialRunner())
+    assert all(t.embedding is not None and t.embedding.shape == (16,) for t in out)
+
+
+def test_semantic_filter_score_only_records_verdict(image_tasks):
+    out = run_pipeline(
+        image_tasks[:1],
+        [
+            ImageLoadStage(),
+            ImageSemanticFilterStage(cfg=VLM_TINY_TEST, score_only=True, max_batch=4),
+        ],
+        runner=SequentialRunner(),
+    )
+    # verdict recorded even when not filtering (None allowed: unparseable)
+    assert hasattr(out[0], "semantic_pass")
+    assert out[0].semantic_pass in (True, False, None)
+
+
+def test_api_caption_malformed_200_recorded_per_task(image_tasks):
+    import json as json_mod
+    import threading as threading_mod
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("content-length", "0"))
+            self.rfile.read(length)
+            reply = json_mod.dumps({"choices": []}).encode()  # malformed: empty
+            self.send_response(200)
+            self.send_header("content-length", str(len(reply)))
+            self.end_headers()
+            self.wfile.write(reply)
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading_mod.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        host, port = server.server_address[:2]
+        stage = ImageApiCaptionStage(
+            base_url=f"http://{host}:{port}", max_retries=2, concurrency=1
+        )
+        out = run_pipeline(
+            image_tasks[:1], [ImageLoadStage(), stage], runner=SequentialRunner()
+        )
+        assert "api_caption" in out[0].errors  # recorded, not raised
+    finally:
+        server.shutdown()
+        server.server_close()
