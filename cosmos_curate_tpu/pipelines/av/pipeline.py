@@ -21,7 +21,7 @@ from pathlib import PurePath
 from cosmos_curate_tpu.core.pipeline import run_pipeline
 from cosmos_curate_tpu.core.runner import RunnerInterface
 from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask, Video
-from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB, ClipRow
+from cosmos_curate_tpu.pipelines.av.state_db import ClipRow, open_state_db
 from cosmos_curate_tpu.storage.client import get_storage_client
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -34,7 +34,7 @@ _SESSION_RE = re.compile(r"^(?P<session>.+?)_(?P<camera>[A-Za-z0-9\-]+)$")
 class AVPipelineArgs:
     input_path: str = ""
     output_path: str = ""
-    db_path: str = ""  # default <output>/av_state.sqlite
+    db_path: str = ""  # sqlite path or postgres:// DSN; default <output>/av_state.sqlite
     clip_len_s: float = 10.0
     min_clip_len_s: float | None = None  # default: min(2.0, clip_len_s)
     caption_prompt_variant: str = "av"
@@ -64,7 +64,7 @@ def discover_sessions(input_path: str) -> dict[str, dict[str, str]]:
 
 def run_av_ingest(args: AVPipelineArgs) -> dict:
     sessions = discover_sessions(args.input_path)
-    db = AVStateDB(args.resolved_db)
+    db = open_state_db(args.resolved_db)
     try:
         for sid, cams in sessions.items():
             db.upsert_session(sid, len(cams))
@@ -86,7 +86,7 @@ def run_av_split(args: AVPipelineArgs, *, runner: RunnerInterface | None = None)
 
     t0 = time.monotonic()
     sessions = discover_sessions(args.input_path)
-    db = AVStateDB(args.resolved_db)
+    db = open_state_db(args.resolved_db)
     try:
         tasks = []
         cam_of_path: dict[str, tuple[str, str]] = {}
@@ -147,7 +147,7 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
     from cosmos_curate_tpu.video.decode import extract_frames_at_fps
 
     t0 = time.monotonic()
-    db = AVStateDB(args.resolved_db)
+    db = open_state_db(args.resolved_db)
     tok = default_caption_tokenizer()
     variants = [args.caption_prompt_variant, *args.extra_caption_variants]
     prompts = {v: get_caption_prompt(v) for v in variants}
@@ -220,7 +220,7 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
             f"av package writes the dataset locally; output_path {root!r} "
             "must be a local directory (sync to object storage afterwards)"
         )
-    db = AVStateDB(args.resolved_db)
+    db = open_state_db(args.resolved_db)
     try:
         todo = db.clips(state="captioned")
         if args.limit:

@@ -173,3 +173,159 @@ class AVStateDB:
 
     def close(self) -> None:
         self._conn.close()
+
+
+_PG_SCHEMA = _SCHEMA.replace("REAL", "DOUBLE PRECISION")
+
+
+class PostgresAVStateDB:
+    """Same state API over a real Postgres (reference PostgresDB,
+    core/utils/db/), via the SDK-free wire client (utils/pg_client.py).
+    The SQL here is written in the dialect intersection: identical
+    statements run on both backends."""
+
+    # SQLSTATEs worth retrying: serialization/deadlock/lock + admin shutdown
+    _TRANSIENT_SQLSTATES = ("40001", "40P01", "55P03", "57P03")
+
+    def __init__(self, dsn: str) -> None:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(dsn)
+        self._conn_kwargs = dict(
+            host=u.hostname or "127.0.0.1",
+            port=u.port or 5432,
+            user=urllib.parse.unquote(u.username or "postgres"),
+            password=urllib.parse.unquote(u.password or ""),
+            database=(u.path or "/postgres").lstrip("/") or "postgres",
+        )
+        self._conn = self._connect()
+        for stmt in _PG_SCHEMA.split(";"):
+            if stmt.strip():
+                self._retry_execute(stmt)
+
+    def _connect(self):
+        from cosmos_curate_tpu.utils.pg_client import PgConnection
+
+        return PgConnection(**self._conn_kwargs)
+
+    def _retry_execute(self, sql: str, params: tuple = ()):
+        """Transient-only retries, with reconnect on a dead socket (a
+        desynced/closed connection can never serve the retry otherwise).
+        Permanent PgErrors (syntax, constraint) surface immediately —
+        matching the sqlite twin's OperationalError-only policy."""
+        from cosmos_curate_tpu.utils.pg_client import PgError
+
+        last: Exception | None = None
+        for attempt in range(5):
+            try:
+                return self._conn.execute(sql, params)
+            except (ConnectionError, OSError) as e:
+                last = e
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                try:
+                    self._conn = self._connect()
+                except (ConnectionError, OSError) as e2:
+                    last = e2
+            except PgError as e:
+                if e.fields.get("C") not in self._TRANSIENT_SQLSTATES:
+                    raise
+                last = e
+            time.sleep(min(0.2 * 2**attempt, 2.0))
+        raise last  # type: ignore[misc]
+
+    def upsert_session(self, session_id: str, num_cameras: int) -> None:
+        self._retry_execute(
+            "INSERT INTO sessions (session_id, num_cameras, created_s) "
+            "VALUES (%s, %s, %s) ON CONFLICT(session_id) DO UPDATE SET "
+            "num_cameras = excluded.num_cameras",
+            (session_id, num_cameras, time.time()),
+        )
+
+    def set_session_state(self, session_id: str, state: str) -> None:
+        self._retry_execute(
+            "UPDATE sessions SET state = %s WHERE session_id = %s", (state, session_id)
+        )
+
+    def sessions(self, state: str | None = None) -> list[tuple[str, int, str]]:
+        q = "SELECT session_id, num_cameras, state FROM sessions"
+        params: tuple = ()
+        if state:
+            q += " WHERE state = %s"
+            params = (state,)
+        res = self._retry_execute(q, params)
+        return [(r[0], int(r[1]), r[2]) for r in res.rows]
+
+    def add_clips(self, rows: list[ClipRow], *, chunk: int = 500) -> None:
+        from cosmos_curate_tpu.utils.pg_client import quote_literal
+
+        for i in range(0, len(rows), chunk):
+            values = ", ".join(
+                "(%s)" % ", ".join(
+                    quote_literal(v)
+                    for v in (r.clip_uuid, r.session_id, r.camera, r.span_start,
+                              r.span_end, r.state, r.caption)
+                )
+                for r in rows[i : i + chunk]
+            )
+            self._retry_execute(
+                "INSERT INTO clips "
+                "(clip_uuid, session_id, camera, span_start, span_end, state, caption) "
+                f"VALUES {values} "
+                "ON CONFLICT(clip_uuid) DO UPDATE SET "
+                "session_id = excluded.session_id, camera = excluded.camera, "
+                "span_start = excluded.span_start, span_end = excluded.span_end"
+            )
+
+    def clips(self, *, session_id: str | None = None, state: str | None = None) -> list[ClipRow]:
+        q = "SELECT clip_uuid, session_id, camera, span_start, span_end, state, caption FROM clips"
+        conds, params = [], []
+        if session_id:
+            conds.append("session_id = %s")
+            params.append(session_id)
+        if state:
+            conds.append("state = %s")
+            params.append(state)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        res = self._retry_execute(q, tuple(params))
+        return [
+            ClipRow(r[0], r[1], r[2], float(r[3]), float(r[4]), r[5], r[6] or "")
+            for r in res.rows
+        ]
+
+    def set_caption(self, clip_uuid: str, caption: str, variant: str = "default") -> None:
+        self._retry_execute(
+            "INSERT INTO clip_captions (clip_uuid, variant, caption) "
+            "VALUES (%s, %s, %s) ON CONFLICT(clip_uuid, variant) "
+            "DO UPDATE SET caption = excluded.caption",
+            (clip_uuid, variant, caption),
+        )
+        if variant == "default":
+            self._retry_execute(
+                "UPDATE clips SET caption = %s, state = 'captioned' WHERE clip_uuid = %s",
+                (caption, clip_uuid),
+            )
+
+    def variant_captions(self, clip_uuid: str) -> dict[str, str]:
+        res = self._retry_execute(
+            "SELECT variant, caption FROM clip_captions WHERE clip_uuid = %s", (clip_uuid,)
+        )
+        return dict(res.rows)
+
+    def set_clip_state(self, clip_uuid: str, state: str) -> None:
+        self._retry_execute(
+            "UPDATE clips SET state = %s WHERE clip_uuid = %s", (state, clip_uuid)
+        )
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def open_state_db(path_or_dsn: str):
+    """sqlite file path or postgres:// DSN -> the matching backend."""
+    if path_or_dsn.startswith(("postgres://", "postgresql://")):
+        return PostgresAVStateDB(path_or_dsn)
+    return AVStateDB(path_or_dsn)

@@ -39,7 +39,9 @@ class ImageApiCaptionStage(Stage[ImageTask, ImageTask]):
         max_retries: int = 3,
         concurrency: int = 4,
     ) -> None:
-        self.base_url = base_url.rstrip("/")
+        # accept both conventions: a server root or an OpenAI-SDK-style
+        # base_url already ending in /v1
+        self.base_url = base_url.rstrip("/").removesuffix("/v1")
         self.model_name = model
         self.api_key = api_key
         self.prompt = prompt

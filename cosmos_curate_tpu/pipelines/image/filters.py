@@ -111,7 +111,12 @@ class ImageClassifierStage(Stage[ImageTask, ImageTask]):
 
     def parse_label(self, text: str) -> str:
         t = text.strip().lower()
+        # exact answer first; then longest label first, so 'clip art' isn't
+        # shadowed by its substring 'art'
         for label in self.labels:
+            if t == label.lower():
+                return label
+        for label in sorted(self.labels, key=len, reverse=True):
             if label.lower() in t:
                 return label
         return self.unknown_label

@@ -1,0 +1,192 @@
+"""In-process fake PostgreSQL server (wire protocol v3 over a socket,
+queries executed on in-memory sqlite) for exercising utils/pg_client.py —
+including the MD5 and SCRAM-SHA-256 authentication exchanges."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import sqlite3
+import struct
+import threading
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha256).digest()
+
+
+class FakePgServer:
+    def __init__(self, *, auth: str = "trust", user: str = "curate", password: str = "pw") -> None:
+        assert auth in ("trust", "md5", "scram")
+        self.auth = auth
+        self.user = user
+        self.password = password
+        self.queries: list[str] = []
+        self._db = sqlite3.connect(":memory:", check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self._threads: list[threading.Thread] = []
+        self._accepting = threading.Thread(target=self._accept_loop, daemon=True)
+        self._closed = False
+
+    @property
+    def dsn(self) -> str:
+        host, port = self._listener.getsockname()[:2]
+        return f"postgres://{self.user}:{self.password}@{host}:{port}/testdb"
+
+    def __enter__(self) -> "FakePgServer":
+        self._accepting.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._closed = True
+        self._listener.close()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- message helpers ---------------------------------------------------
+
+    @staticmethod
+    def _send(sock: socket.socket, type_byte: bytes, payload: bytes) -> None:
+        sock.sendall(type_byte + struct.pack("!I", len(payload) + 4) + payload)
+
+    # -- session -----------------------------------------------------------
+
+    def _serve(self, sock: socket.socket) -> None:
+        # buffered reader per connection: recv() may return MORE than asked
+        buf = bytearray()
+
+        def recv_exact(n: int) -> bytes:
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("client gone")
+                buf.extend(chunk)
+            out = bytes(buf[:n])
+            del buf[:n]
+            return out
+
+        def recv_typed() -> tuple[bytes, bytes]:
+            head = recv_exact(5)
+            (length,) = struct.unpack("!I", head[1:])
+            return head[:1], recv_exact(length - 4)
+
+        try:
+            head = recv_exact(8)
+            (length, proto) = struct.unpack("!II", head)
+            recv_exact(length - 8)  # startup params
+            if proto != 196608:
+                return
+            if not self._authenticate(sock, recv_typed):
+                return
+            self._send(sock, b"R", struct.pack("!I", 0))  # AuthenticationOk
+            self._send(sock, b"Z", b"I")  # ReadyForQuery
+            while True:
+                t, body = recv_typed()
+                if t == b"X":
+                    return
+                if t != b"Q":
+                    continue
+                sql = body.rstrip(b"\x00").decode()
+                self.queries.append(sql)
+                self._run_query(sock, sql)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            sock.close()
+
+    def _authenticate(self, sock: socket.socket, recv_typed) -> bool:
+        if self.auth == "trust":
+            return True
+        if self.auth == "md5":
+            salt = os.urandom(4)
+            self._send(sock, b"R", struct.pack("!I", 5) + salt)
+            _, body = recv_typed()
+            given = body.rstrip(b"\x00").decode()
+            inner = hashlib.md5((self.password + self.user).encode()).hexdigest()
+            expected = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            if given != expected:
+                self._error(sock, "28P01", "password authentication failed")
+                return False
+            return True
+        # SCRAM-SHA-256
+        self._send(sock, b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00")
+        _, body = recv_typed()
+        mech, rest = body.split(b"\x00", 1)
+        assert mech == b"SCRAM-SHA-256"
+        (n,) = struct.unpack("!I", rest[:4])
+        client_first = rest[4 : 4 + n].decode()
+        first_bare = client_first.split(",", 2)[2]
+        client_nonce = dict(kv.split("=", 1) for kv in first_bare.split(","))["r"]
+        server_nonce = client_nonce + base64.b64encode(os.urandom(12)).decode()
+        salt = os.urandom(16)
+        iterations = 4096
+        server_first = (
+            f"r={server_nonce},s={base64.b64encode(salt).decode()},i={iterations}"
+        )
+        self._send(sock, b"R", struct.pack("!I", 11) + server_first.encode())
+
+        _, body = recv_typed()
+        client_final = body.decode()
+        parts = dict(kv.split("=", 1) for kv in client_final.split(","))
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        auth_message = f"{first_bare},{server_first},{without_proof}".encode()
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt, iterations)
+        client_key = _hmac(salted, b"Client Key")
+        stored_key = hashlib.sha256(client_key).digest()
+        client_sig = _hmac(stored_key, auth_message)
+        expected_proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        if base64.b64decode(parts["p"]) != expected_proof:
+            self._error(sock, "28P01", "SCRAM proof verification failed")
+            return False
+        server_key = _hmac(salted, b"Server Key")
+        server_sig = _hmac(server_key, auth_message)
+        final = f"v={base64.b64encode(server_sig).decode()}"
+        self._send(sock, b"R", struct.pack("!I", 12) + final.encode())
+        return True
+
+    def _error(self, sock: socket.socket, code: str, message: str) -> None:
+        payload = f"SERROR\x00C{code}\x00M{message}\x00".encode() + b"\x00"
+        self._send(sock, b"E", payload)
+        self._send(sock, b"Z", b"I")
+
+    def _run_query(self, sock: socket.socket, sql: str) -> None:
+        try:
+            with self._db_lock, self._db:
+                cur = self._db.execute(sql)
+                rows = cur.fetchall()
+                desc = cur.description
+        except sqlite3.Error as e:
+            self._error(sock, "42601", str(e))
+            return
+        if desc:
+            cols = b"".join(
+                c[0].encode() + b"\x00" + struct.pack("!IhIhih", 0, 0, 25, -1, -1, 0)
+                for c in desc
+            )
+            self._send(sock, b"T", struct.pack("!H", len(desc)) + cols)
+            for row in rows:
+                out = struct.pack("!H", len(row))
+                for v in row:
+                    if v is None:
+                        out += struct.pack("!i", -1)
+                    else:
+                        b = str(v).encode()
+                        out += struct.pack("!i", len(b)) + b
+                self._send(sock, b"D", out)
+            tag = f"SELECT {len(rows)}".encode()
+        else:
+            tag = b"OK"
+        self._send(sock, b"C", tag + b"\x00")
+        self._send(sock, b"Z", b"I")

@@ -60,6 +60,9 @@ def test_classifier_label_parsing():
     assert stage.parse_label("This is a Photo of a dog") == "photo"
     assert stage.parse_label("CHART") == "chart"
     assert stage.parse_label("gibberish") == "unknown"
+    nested = ImageClassifierStage(labels=("art", "clip art"), cfg=VLM_TINY_TEST)
+    assert nested.parse_label("this is clip art") == "clip art"
+    assert nested.parse_label("art") == "art"
 
 
 class _FakeOpenAI:

@@ -1,0 +1,127 @@
+"""Postgres wire client + PostgresAVStateDB against the in-process fake
+server (reference core/utils/db/ PostgresDB capability)."""
+
+from __future__ import annotations
+
+import pytest
+
+from cosmos_curate_tpu.pipelines.av.state_db import ClipRow, open_state_db
+from cosmos_curate_tpu.utils.pg_client import PgConnection, PgError, quote_literal
+from tests.pipelines.fake_pg import FakePgServer
+
+
+@pytest.mark.parametrize("auth", ["trust", "md5", "scram"])
+def test_auth_and_basic_query(auth):
+    with FakePgServer(auth=auth) as srv:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(srv.dsn)
+        with PgConnection(
+            host=u.hostname, port=u.port, user=u.username, password=u.password,
+            database="testdb",
+        ) as conn:
+            conn.execute("CREATE TABLE t (a TEXT, b INTEGER)")
+            conn.execute("INSERT INTO t VALUES (%s, %s)", ("x'y", 7))
+            res = conn.execute("SELECT a, b FROM t")
+            assert res.columns == ["a", "b"]
+            assert res.rows == [("x'y", "7")]
+
+
+def test_wrong_password_rejected():
+    with FakePgServer(auth="md5") as srv:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(srv.dsn)
+        with pytest.raises(PgError, match="authentication"):
+            PgConnection(
+                host=u.hostname, port=u.port, user=u.username, password="WRONG",
+                database="testdb",
+            )
+
+
+def test_scram_wrong_password_rejected():
+    with FakePgServer(auth="scram") as srv:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(srv.dsn)
+        with pytest.raises(PgError):
+            PgConnection(
+                host=u.hostname, port=u.port, user=u.username, password="WRONG",
+                database="testdb",
+            )
+
+
+def test_sql_error_surfaces():
+    with FakePgServer() as srv:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(srv.dsn)
+        with PgConnection(
+            host=u.hostname, port=u.port, user=u.username, password=u.password,
+            database="testdb",
+        ) as conn:
+            with pytest.raises(PgError, match="42601"):
+                conn.execute("SELEKT nonsense")
+            # connection stays usable after an error
+            res = conn.execute("SELECT 1")
+            assert res.rows == [("1",)]
+
+
+def test_quote_literal():
+    assert quote_literal(None) == "NULL"
+    assert quote_literal(True) == "TRUE"
+    assert quote_literal(3) == "3"
+    assert quote_literal("it's") == "'it''s'"
+    assert quote_literal("a\\b") == "E'a\\\\b'"
+
+
+def test_postgres_state_db_end_to_end():
+    """The AV state machine over the postgres backend: same behavior the
+    sqlite twin's tests assert."""
+    with FakePgServer(auth="scram") as srv:
+        db = open_state_db(srv.dsn)
+        db.upsert_session("s1", 3)
+        db.upsert_session("s1", 4)  # upsert updates camera count
+        assert db.sessions() == [("s1", 4, "ingested")]
+
+        db.add_clips(
+            [
+                ClipRow("c1", "s1", "front", 0.0, 10.0),
+                ClipRow("c2", "s1", "rear", 10.0, 20.0),
+            ]
+        )
+        db.set_caption("c1", "a road", variant="default")
+        db.set_caption("c1", "ein Weg", variant="alt")
+        # re-split must not wipe captions/state (identity-only upsert)
+        db.add_clips([ClipRow("c1", "s1", "front", 0.0, 10.0)])
+        rows = {r.clip_uuid: r for r in db.clips(session_id="s1")}
+        assert rows["c1"].state == "captioned"
+        assert rows["c1"].caption == "a road"
+        assert db.variant_captions("c1") == {"default": "a road", "alt": "ein Weg"}
+
+        captioned = db.clips(state="captioned")
+        assert [r.clip_uuid for r in captioned] == ["c1"]
+        db.set_session_state("s1", "done")
+        assert db.sessions(state="done")[0][0] == "s1"
+        db.close()
+
+
+def test_add_clips_batches_one_round_trip():
+    with FakePgServer() as srv:
+        db = open_state_db(srv.dsn)
+        db.upsert_session("s", 1)
+        before = len(srv.queries)
+        db.add_clips([ClipRow(f"c{i}", "s", "cam", float(i), i + 1.0) for i in range(40)])
+        assert len(srv.queries) - before == 1  # one multi-VALUES statement
+        assert len(db.clips(session_id="s")) == 40
+        db.close()
+
+
+def test_permanent_error_not_retried():
+    with FakePgServer() as srv:
+        db = open_state_db(srv.dsn)
+        before = len(srv.queries)
+        with pytest.raises(PgError):
+            db._retry_execute("SELEKT broken")
+        assert len(srv.queries) - before == 1  # no pointless retries
+        db.close()
