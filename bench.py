@@ -62,28 +62,15 @@ def ensure_live_backend() -> None:
     retrying with backoff, since the relay recovers on its own schedule — and
     only after every attempt fails re-exec on pure CPU so the bench always
     reports a number (flagged in the JSON) instead of hanging the driver."""
-    import subprocess
 
     if os.environ.get("BENCH_BACKEND_CHECKED"):
         return
     attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "3"))
-    alive = False
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                capture_output=True,
-                timeout=150,
-            )
-            alive = r.returncode == 0
-        except subprocess.TimeoutExpired:
-            alive = False
-        if alive:
-            break
-        if i + 1 < attempts:
-            delay = 30 * (i + 1)
-            log(f"bench: TPU probe {i + 1}/{attempts} failed; retrying in {delay}s")
-            time.sleep(delay)
+    from cosmos_curate_tpu.utils.health import accelerator_health_gate
+
+    alive = accelerator_health_gate(
+        attempts=attempts, probe_timeout_s=150, backoff_s=45
+    )
     if not alive:
         log("bench: TPU backend unavailable; re-executing on CPU (result is NOT a TPU number)")
         env = {**os.environ, "BENCH_BACKEND_CHECKED": "1", "JAX_PLATFORMS": "cpu"}

@@ -20,6 +20,7 @@ import threading
 import time
 import traceback
 from dataclasses import dataclass, field
+from typing import Any
 
 import cloudpickle
 
@@ -145,40 +146,118 @@ class BasePool:
         self.draining.clear()
 
 
+def _base_worker_env() -> dict[str, str]:
+    import os
+
+    env = {
+        "JAX_PLATFORMS": "cpu",  # CPU workers must never claim the TPU
+        "OPENCV_FFMPEG_LOGLEVEL": "-8",
+        # segments a worker creates are owned by this coordinator process
+        # (see object_store.put): recycled workers leave live data behind
+        "CURATE_STORE_OWNER": os.environ.get("CURATE_STORE_OWNER", str(os.getpid())),
+    }
+    from cosmos_curate_tpu.observability.tracing import tracing_enabled
+
+    if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
+        env["CURATE_TRACING"] = "1"
+    return env
+
+
+class PrewarmPool:
+    """Warm spares: generic worker processes spawned ahead of need.
+
+    Worker processes are stage-agnostic until their SetupMsg arrives, so the
+    expensive part of a cold start (interpreter spawn + imports, ~3-5 s) can
+    be prepaid. Autoscale-up adopts a spare and pays only stage setup; a
+    replacement spare is spawned in the background after each adoption
+    (addresses the engine's known scale-up cold-start cost)."""
+
+    def __init__(self, results_q, size: int = 0) -> None:
+        self.results_q = results_q
+        self.size = size
+        self._spares: list[tuple[Any, Any]] = []  # (in_q, proc)
+        self._lock = threading.Lock()
+        self._closed = False
+        for _ in range(size):
+            self._spawn()
+
+    def _spawn(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+        in_q = _MP.Queue()
+        env = dict(_base_worker_env(), CURATE_WORKER_ID="prewarm-spare")
+        proc = _MP.Process(
+            target=worker_main, args=(in_q, self.results_q, env), daemon=True,
+            name="prewarm-spare",
+        )
+        proc.start()
+        with self._lock:
+            if self._closed:  # shutdown raced the spawn: stop the newborn
+                try:
+                    in_q.put(ShutdownMsg())
+                except Exception:
+                    proc.terminate()
+                return
+            self._spares.append((in_q, proc))
+
+    def take(self):
+        """-> (in_q, proc) of a live spare, or None. Replenishes async —
+        one replacement per pop, so crashed spares don't shrink the pool."""
+        replacements = 0
+        taken = None
+        with self._lock:
+            while self._spares and taken is None:
+                in_q, proc = self._spares.pop()
+                replacements += 1
+                if proc.is_alive():
+                    taken = (in_q, proc)
+                else:
+                    proc.join(timeout=0)  # reap the dead spare
+        for _ in range(replacements):
+            threading.Thread(target=self._spawn, daemon=True).start()
+        return taken
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            spares, self._spares = self._spares, []
+        for in_q, proc in spares:
+            try:
+                in_q.put(ShutdownMsg())
+            except Exception:
+                proc.terminate()
+
+
 class ProcessPool(BasePool):
-    def __init__(self, spec: StageSpec, node: NodeInfo, results_q, pool_id: int = 0) -> None:
+    def __init__(
+        self, spec: StageSpec, node: NodeInfo, results_q, pool_id: int = 0,
+        prewarm: "PrewarmPool | None" = None,
+    ) -> None:
         super().__init__(spec, node, pool_id)
         self.results_q = results_q  # mp queue shared by all pools' processes
+        self.prewarm = prewarm
         self._stage_pickle = cloudpickle.dumps(spec.stage)
 
     def start_worker(self) -> WorkerHandle:
         wid = f"s{self.pool_id}-{self.name}-p{self._next_id}"
         self._next_id += 1
-        in_q = _MP.Queue()
-        import os
-
-        env = {
-            "JAX_PLATFORMS": "cpu",  # CPU workers must never claim the TPU
-            "CURATE_WORKER_ID": wid,
-            "OPENCV_FFMPEG_LOGLEVEL": "-8",
-            # segments a worker creates are owned by this coordinator process
-            # (see object_store.put): recycled workers leave live data behind
-            "CURATE_STORE_OWNER": os.environ.get(
-                "CURATE_STORE_OWNER", str(os.getpid())
-            ),
-        }
-        from cosmos_curate_tpu.observability.tracing import tracing_enabled
-
-        if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
-            env["CURATE_TRACING"] = "1"
-        proc = _MP.Process(
-            target=worker_main, args=(in_q, self.results_q, env), daemon=True, name=wid
-        )
-        proc.start()
+        env = dict(_base_worker_env(), CURATE_WORKER_ID=wid)
+        adopted = self.prewarm.take() if self.prewarm is not None else None
+        if adopted is not None:
+            in_q, proc = adopted
+            setup_env = env  # applied by the worker before loading the stage
+        else:
+            in_q = _MP.Queue()
+            proc = _MP.Process(
+                target=worker_main, args=(in_q, self.results_q, env), daemon=True, name=wid
+            )
+            proc.start()
+            setup_env = None
         meta = WorkerMetadata(
             worker_id=wid, stage_name=self.name, node=self.node, allocation=self.stage.resources
         )
-        in_q.put(SetupMsg(self._stage_pickle, cloudpickle.dumps(meta)))
+        in_q.put(SetupMsg(self._stage_pickle, cloudpickle.dumps(meta), env=setup_env))
         handle = WorkerHandle(worker_id=wid, in_q=in_q, proc=proc)
         self.workers[wid] = handle
         return handle
@@ -283,7 +362,10 @@ class InProcessPool(BasePool):
         self.workers.pop(w.worker_id, None)
 
 
-def make_pool(spec: StageSpec, node: NodeInfo, mp_results_q, thread_results_q, pool_id: int = 0):
+def make_pool(
+    spec: StageSpec, node: NodeInfo, mp_results_q, thread_results_q, pool_id: int = 0,
+    prewarm: PrewarmPool | None = None,
+):
     if spec.stage.resources.uses_tpu:
         return InProcessPool(spec, node, thread_results_q, pool_id)
-    return ProcessPool(spec, node, mp_results_q, pool_id)
+    return ProcessPool(spec, node, mp_results_q, pool_id, prewarm=prewarm)

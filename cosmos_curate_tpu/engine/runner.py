@@ -103,8 +103,17 @@ class StreamingRunner(RunnerInterface):
         budget = Budget(cpus=node.num_cpus, tpus=float(node.num_tpu_chips))
         mp_results: mp.Queue = mp.get_context("spawn").Queue()
         thread_results: queue.Queue = queue.Queue()
+        # warm spares prepay worker spawn+import (~3-5 s) so autoscale-up is
+        # stage-setup-bound only; CURATE_PREWARM=0 disables
+        from cosmos_curate_tpu.engine.pool import PrewarmPool
+
+        n_prewarm = int(os.environ.get("CURATE_PREWARM", "2"))
+        prewarm = PrewarmPool(mp_results, size=n_prewarm) if n_prewarm > 0 else None
         states = [
-            _StageState(spec=s, pool=make_pool(s, node, mp_results, thread_results, pool_id=i))
+            _StageState(
+                spec=s,
+                pool=make_pool(s, node, mp_results, thread_results, pool_id=i, prewarm=prewarm),
+            )
             for i, s in enumerate(stage_specs)
         ]
         store = object_store.StoreBudget(
@@ -225,6 +234,8 @@ class StreamingRunner(RunnerInterface):
                     for r in batch.refs:
                         store.release(r)
                 st.pool.shutdown()
+            if prewarm is not None:
+                prewarm.shutdown()
 
     # ------------------------------------------------------------------
     def _on_ready(self, states, msg: ReadyMsg, errors: list[str]) -> None:

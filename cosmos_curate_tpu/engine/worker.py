@@ -29,6 +29,9 @@ from cosmos_curate_tpu.engine import object_store
 class SetupMsg:
     stage_pickle: bytes
     worker_meta_pickle: bytes
+    # set when a prewarmed (generic) worker is adopted by a pool: applied
+    # before the stage loads so worker id/tracing reflect the adopter
+    env: dict[str, str] | None = None
 
 
 @dataclass
@@ -101,6 +104,10 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
                 break
             if isinstance(msg, SetupMsg):
                 try:
+                    if msg.env:
+                        os.environ.update(msg.env)
+                        worker_id = msg.env.get("CURATE_WORKER_ID", worker_id)
+                        setup_tracing_from_env()
                     stage = cloudpickle.loads(msg.stage_pickle)
                     meta = cloudpickle.loads(msg.worker_meta_pickle)
                     stage.setup_on_node(meta.node, meta)

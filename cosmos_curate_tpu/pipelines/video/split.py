@@ -198,6 +198,23 @@ def run_split(
 ) -> dict:
     """Build inputs (with resume), run, write summary.json; returns summary."""
     t0 = time.monotonic()
+    # retrying accelerator gate (reference gpu_start_helper): catch a dead
+    # TPU relay BEFORE spawning workers so the failure mode is one clear
+    # message, not N crashed model setups. One quick probe by default;
+    # CURATE_HEALTH_GATE=strict makes TPU mandatory.
+    import os as _os
+
+    gate_mode = _os.environ.get("CURATE_HEALTH_GATE", "")  # ""|strict|off
+    if gate_mode != "off":
+        from cosmos_curate_tpu.utils.health import accelerator_health_gate
+
+        strict = gate_mode == "strict"
+        accelerator_health_gate(
+            attempts=3 if strict else 1,
+            probe_timeout_s=120,
+            backoff_s=30,
+            require=strict,
+        )
     if args.tracing:
         from cosmos_curate_tpu.observability.tracing import enable_tracing
 

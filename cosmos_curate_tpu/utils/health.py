@@ -1,0 +1,71 @@
+"""Accelerator health gate: retrying TPU liveness probe.
+
+Equivalent capability of the reference's GPU start helper
+(cosmos_curate/core/utils/infra/gpu_start_helper.py — a retrying health
+gate that blocks pipeline start until the accelerator answers, instead of
+letting the first model call crash a worker mid-run).
+
+TPU twist: on this platform a wedged device relay can make ``import jax``
+itself block for minutes, so the probe ALWAYS runs in a subprocess with a
+timeout — the probing process stays healthy no matter what the plugin does.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def probe_accelerator(timeout_s: float = 120.0) -> bool:
+    """One subprocess probe: does ``jax.devices()`` answer with a non-CPU
+    backend within the timeout?"""
+    code = (
+        "import jax, sys; d = jax.devices(); "
+        "sys.exit(0 if d and d[0].platform != 'cpu' else 1)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout_s
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def accelerator_health_gate(
+    *,
+    attempts: int = 3,
+    probe_timeout_s: float = 120.0,
+    backoff_s: float = 30.0,
+    require: bool = False,
+) -> bool:
+    """Retrying gate (the relay recovers on its own schedule). Returns
+    liveness; ``require=True`` raises instead of returning False so a
+    TPU-mandatory entry point fails with a clear message up front rather
+    than crashing a worker later."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False  # explicitly CPU-pinned: nothing to gate
+    for i in range(attempts):
+        if probe_accelerator(probe_timeout_s):
+            if i:
+                logger.info("accelerator answered on probe %d/%d", i + 1, attempts)
+            return True
+        if i + 1 < attempts:
+            logger.warning(
+                "accelerator probe %d/%d failed; retrying in %.0fs",
+                i + 1, attempts, backoff_s,
+            )
+            time.sleep(backoff_s)
+    if require:
+        raise RuntimeError(
+            f"accelerator unhealthy after {attempts} probes x {probe_timeout_s:.0f}s "
+            "(TPU relay down?) — rerun with JAX_PLATFORMS=cpu to accept CPU execution"
+        )
+    logger.warning("accelerator unhealthy after %d probes; continuing on CPU", attempts)
+    return False

@@ -64,8 +64,13 @@ class PgConnection:
         password: str = "",
         database: str = "postgres",
         timeout_s: float = 30.0,
+        query_timeout_s: float = 600.0,
     ) -> None:
+        """``timeout_s`` bounds connect+auth; ``query_timeout_s`` bounds each
+        statement — generous by default so a lock wait (a normal, transient
+        condition) is not misread as a dead connection by retry layers."""
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._query_timeout_s = query_timeout_s
         self._buf = b""
         self.user = user
         self.password = password
@@ -186,9 +191,19 @@ class PgConnection:
     def execute(self, sql: str, params: tuple = ()) -> QueryResult:
         """Simple-query execution. ``params`` substitute ``%s`` placeholders
         as escaped literals (client-side; the simple protocol has no binds).
-        """
+        Only the literal token ``%s`` is a placeholder — other ``%``
+        characters (LIKE patterns, modulo) pass through untouched."""
         if params:
-            sql = sql % tuple(quote_literal(p) for p in params)
+            parts = sql.split("%s")
+            if len(parts) - 1 != len(params):
+                raise ValueError(
+                    f"query has {len(parts) - 1} %s placeholders, got {len(params)} params"
+                )
+            sql = "".join(
+                part + (quote_literal(params[i]) if i < len(params) else "")
+                for i, part in enumerate(parts)
+            )
+        self._sock.settimeout(self._query_timeout_s)
         self._send(b"Q", sql.encode() + b"\x00")
         columns: list[str] = []
         rows: list[tuple] = []

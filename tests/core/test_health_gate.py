@@ -1,0 +1,44 @@
+"""Accelerator health gate (reference gpu_start_helper capability)."""
+
+from __future__ import annotations
+
+import pytest
+
+from cosmos_curate_tpu.utils import health
+
+
+def test_cpu_pinned_env_short_circuits(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert health.accelerator_health_gate(attempts=1) is False
+
+
+def test_retries_then_gives_up(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calls = []
+    monkeypatch.setattr(health, "probe_accelerator", lambda timeout_s=0: calls.append(1) or False)
+    monkeypatch.setattr(health.time, "sleep", lambda s: None)
+    assert health.accelerator_health_gate(attempts=3, backoff_s=0) is False
+    assert len(calls) == 3
+
+
+def test_recovers_mid_retries(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    answers = iter([False, True])
+    monkeypatch.setattr(health, "probe_accelerator", lambda timeout_s=0: next(answers))
+    monkeypatch.setattr(health.time, "sleep", lambda s: None)
+    assert health.accelerator_health_gate(attempts=3, backoff_s=0) is True
+
+
+def test_require_raises(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(health, "probe_accelerator", lambda timeout_s=0: False)
+    monkeypatch.setattr(health.time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="accelerator unhealthy"):
+        health.accelerator_health_gate(attempts=2, backoff_s=0, require=True)
+
+
+def test_probe_subprocess_times_out_cleanly():
+    """A wedged relay (import jax blocks) must surface as False after the
+    timeout, never hang the prober. Simulated with a tiny timeout: even a
+    healthy import can't finish in 0.2s, so the TimeoutExpired path runs."""
+    assert health.probe_accelerator(timeout_s=0.2) is False

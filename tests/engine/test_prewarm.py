@@ -1,0 +1,64 @@
+"""Warm-spare worker pool (engine cold-start mitigation)."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import time
+
+import pytest
+
+from cosmos_curate_tpu.core.stage import NodeInfo, Resources, Stage, StageSpec
+from cosmos_curate_tpu.engine.pool import PrewarmPool, ProcessPool
+from cosmos_curate_tpu.engine.worker import ReadyMsg
+
+
+class Echo(Stage):
+    @property
+    def name(self) -> str:
+        return "echo"
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    def process_data(self, tasks):
+        return tasks
+
+
+def test_adopted_spare_becomes_stage_worker():
+    results_q = mp.get_context("spawn").Queue()
+    prewarm = PrewarmPool(results_q, size=1)
+    try:
+        # give the spare a moment to boot
+        deadline = time.monotonic() + 60
+        pool = ProcessPool(
+            StageSpec(Echo()), NodeInfo(node_id="local"), results_q, prewarm=prewarm
+        )
+        handle = pool.start_worker()
+        # the adopted process must complete stage setup under its NEW id
+        while time.monotonic() < deadline:
+            try:
+                msg = results_q.get(timeout=5)
+            except Exception:
+                continue
+            if isinstance(msg, ReadyMsg):
+                assert msg.error is None, msg.error
+                assert msg.worker_id == handle.worker_id
+                break
+        else:
+            pytest.fail("no ReadyMsg from adopted worker")
+        # a replacement spare is being spawned in the background
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not prewarm._spares:
+            time.sleep(0.5)
+        assert prewarm._spares, "prewarm pool did not replenish"
+        pool.shutdown()
+    finally:
+        prewarm.shutdown()
+
+
+def test_take_from_empty_pool_returns_none():
+    results_q = mp.get_context("spawn").Queue()
+    prewarm = PrewarmPool(results_q, size=0)
+    assert prewarm.take() is None
+    prewarm.shutdown()

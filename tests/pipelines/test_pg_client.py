@@ -125,3 +125,20 @@ def test_permanent_error_not_retried():
             db._retry_execute("SELEKT broken")
         assert len(srv.queries) - before == 1  # no pointless retries
         db.close()
+
+
+def test_percent_in_literals_passes_through():
+    with FakePgServer() as srv:
+        import urllib.parse
+
+        u = urllib.parse.urlparse(srv.dsn)
+        with PgConnection(
+            host=u.hostname, port=u.port, user=u.username, password=u.password,
+            database="testdb",
+        ) as conn:
+            conn.execute("CREATE TABLE lk (c TEXT)")
+            conn.execute("INSERT INTO lk VALUES (%s)", ("road trip",))
+            res = conn.execute("SELECT c FROM lk WHERE c LIKE 'road%' AND c != %s", ("x",))
+            assert res.rows == [("road trip",)]
+            with pytest.raises(ValueError, match="placeholders"):
+                conn.execute("SELECT %s, %s", ("only-one",))
