@@ -61,7 +61,7 @@ def register(sub: argparse._SubParsersAction) -> None:
     av = lsub.add_parser("av", help="multi-camera AV pipelines")
     av.add_argument(
         "subcommand2",
-        choices=["ingest", "split", "caption", "package", "shard"],
+        choices=["ingest", "split", "caption", "trajectory", "package", "shard"],
         metavar="step",
     )
     av.add_argument(
@@ -184,6 +184,10 @@ def _cmd_av(args: argparse.Namespace) -> int:
         )
     elif step == "caption":
         summary = av.run_av_caption(pargs)
+    elif step == "trajectory":
+        from cosmos_curate_tpu.pipelines.av.trajectory import run_av_trajectory
+
+        summary = run_av_trajectory(pargs)
     elif step == "package":
         summary = av.run_av_package(pargs)
     else:

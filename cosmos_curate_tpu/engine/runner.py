@@ -108,7 +108,12 @@ class StreamingRunner(RunnerInterface):
         from cosmos_curate_tpu.engine.pool import PrewarmPool
 
         n_prewarm = int(os.environ.get("CURATE_PREWARM", "2"))
-        prewarm = PrewarmPool(mp_results, size=n_prewarm) if n_prewarm > 0 else None
+        any_process_stage = any(not s.stage.resources.uses_tpu for s in stage_specs)
+        prewarm = (
+            PrewarmPool(mp_results, size=n_prewarm)
+            if n_prewarm > 0 and any_process_stage
+            else None
+        )
         states = [
             _StageState(
                 spec=s,

@@ -41,6 +41,11 @@ class AVPipelineArgs:
     # extra prompt variants captioned per clip (reference AV clips carry one
     # caption per variant, captioning_stages.py:156)
     extra_caption_variants: tuple[str, ...] = ()
+    # windowed captioning (reference CaptionWindow, av_data_model.py:195 +
+    # get_clip_window_mappings:562): long clips caption in frame windows —
+    # the primary variant captions every window, extra variants the front
+    # window only (mirrors the reference's default-vs-front policy)
+    caption_window_frames: int = 8
     limit: int = 0
 
     @property
@@ -157,37 +162,79 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
             todo = todo[: args.limit]
         # gather work BEFORE building the engine: a no-op resume run must
         # not pay the full model load
-        pending: list[tuple[str, "np.ndarray"]] = []
-        for row in todo:
-            clip_path = f"{args.output_path.rstrip('/')}/clips/{row.clip_uuid}.mp4"
-            try:
-                frames = extract_frames_at_fps(read_bytes(clip_path), target_fps=1.0, resize_hw=(224, 224))
-            except FileNotFoundError:
-                continue
-            if frames.shape[0] == 0:
-                continue
-            pending.append((row.clip_uuid, frames[:8]))
-        if not pending:
-            return {"num_captioned": 0, "tokens_per_s": 0.0, "elapsed_s": time.monotonic() - t0}
-        if engine is None:
-            engine = CaptionEngine(VLM_BASE, max_batch=8)
-            engine.setup()
-        for cid, frames in pending:
-            for variant in variants:
-                engine.add_request(
-                    CaptionRequest(
-                        request_id=f"{cid}::{variant}",
-                        prompt_ids=tok.encode(prompts[variant]),
-                        frames=frames,
-                        sampling=SamplingConfig(max_new_tokens=96),
+        import numpy as np
+
+        w = max(1, args.caption_window_frames)
+
+        def clip_windows(frames: "np.ndarray") -> list["np.ndarray"]:
+            """Fixed-size caption windows; the ragged tail is padded to w by
+            repeating the last frame so the jitted vision encoder sees ONE
+            frame-count shape (a fresh XLA compile per residue otherwise)."""
+            wins = []
+            for i in range(0, frames.shape[0], w):
+                win = frames[i : i + w]
+                if win.shape[0] < w:
+                    pad = np.repeat(win[-1:], w - win.shape[0], axis=0)
+                    win = np.concatenate([win, pad], axis=0)
+                wins.append(win)
+            return wins
+
+        num_windows = 0
+        num_captioned = 0
+        # chunked gather→caption→store: memory stays bounded by chunk size,
+        # not the full backlog of decoded frames
+        chunk_size = 32
+        for start in range(0, len(todo), chunk_size):
+            chunk_pending = []
+            for row in todo[start : start + chunk_size]:
+                clip_path = f"{args.output_path.rstrip('/')}/clips/{row.clip_uuid}.mp4"
+                try:
+                    frames = extract_frames_at_fps(
+                        read_bytes(clip_path), target_fps=1.0, resize_hw=(224, 224)
                     )
-                )
-        for res in engine.run_until_complete():
-            cid, _, variant = res.request_id.rpartition("::")
-            # the primary variant lands in the clips table as "default"
-            db.set_caption(cid, res.text, "default" if variant == variants[0] else variant)
+                except FileNotFoundError:
+                    continue
+                if frames.shape[0] == 0:
+                    continue
+                chunk_pending.append((row.clip_uuid, frames))
+            if not chunk_pending:
+                continue
+            if engine is None:
+                engine = CaptionEngine(VLM_BASE, max_batch=8)
+                engine.setup()
+            for cid, frames in chunk_pending:
+                windows = clip_windows(frames)
+                for variant in variants:
+                    # primary variant captions every window; extras front-only
+                    sel = windows if variant == variants[0] else windows[:1]
+                    for k, win in enumerate(sel):
+                        num_windows += 1
+                        engine.add_request(
+                            CaptionRequest(
+                                request_id=f"{cid}::{variant}::w{k}",
+                                prompt_ids=tok.encode(prompts[variant]),
+                                frames=win,
+                                sampling=SamplingConfig(max_new_tokens=96),
+                            )
+                        )
+            num_captioned += len(chunk_pending)
+            for res in engine.run_until_complete():
+                cid_variant, _, wtag = res.request_id.rpartition("::")
+                cid, _, variant = cid_variant.rpartition("::")
+                k = int(wtag[1:])
+                name = "default" if variant == variants[0] else variant
+                if k == 0:
+                    # window 0 of the primary fills clips.caption + advances
+                    db.set_caption(cid, res.text, name)
+                else:
+                    # later windows: stored per-window (reference keeps a
+                    # caption list per variant over caption windows)
+                    db.set_caption(cid, res.text, f"{name}#w{k}")
+        if num_captioned == 0:
+            return {"num_captioned": 0, "tokens_per_s": 0.0, "elapsed_s": time.monotonic() - t0}
         return {
-            "num_captioned": len(pending),
+            "num_captioned": num_captioned,
+            "num_windows": num_windows,
             "num_variants": len(variants),
             "tokens_per_s": engine.tokens_per_second,
             "elapsed_s": time.monotonic() - t0,

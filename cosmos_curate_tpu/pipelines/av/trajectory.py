@@ -1,0 +1,140 @@
+"""Egomotion trajectory extraction for AV clips.
+
+Equivalent capability of the reference's trajectory task family
+(cosmos_curate/pipelines/av/utils/av_data_model.py:469 ``ClipForTrajectory``
+/ :491 ``AvSessionTrajectoryTask`` — per-clip vehicle-motion artifacts
+consumed by the sharding/packaging steps).
+
+TPU-first estimator: global inter-frame translation by **phase
+correlation** — FFT of consecutive grayscale frames, normalized cross-power
+spectrum, inverse FFT, argmax = displacement. The whole clip runs in ONE
+jitted program (batched over frame pairs, no Python per frame); cumulative
+summation of the per-frame displacements yields the 2D egomotion trajectory
+in pixel units, plus summary stats (path length, net displacement, max
+step) used to classify drive segments (straight/turning/stationary).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _phase_correlate_pairs(gray: jax.Array) -> jax.Array:
+    """gray [T, H, W] float32 -> per-pair displacement [T-1, 2] (dx, dy).
+
+    Hann-windowed phase correlation: peak of IFFT(F1 * conj(F2) / |.|).
+    Displacements are wrapped from FFT coordinates into [-H/2, H/2)."""
+    t, h, w = gray.shape
+    wy = jnp.hanning(h)[:, None]
+    wx = jnp.hanning(w)[None, :]
+    windowed = gray * (wy * wx)[None]
+    f = jnp.fft.rfft2(windowed)
+    cross = f[:-1] * jnp.conj(f[1:])
+    cross = cross / jnp.maximum(jnp.abs(cross), 1e-9)
+    corr = jnp.fft.irfft2(cross, s=(h, w))  # [T-1, H, W]
+    flat_idx = corr.reshape(corr.shape[0], -1).argmax(axis=-1)
+    py = flat_idx // w
+    px = flat_idx % w
+    # wrap: a peak at H-2 means displacement -2
+    dy = jnp.where(py > h // 2, py - h, py).astype(jnp.float32)
+    dx = jnp.where(px > w // 2, px - w, px).astype(jnp.float32)
+    return jnp.stack([dx, dy], axis=-1)
+
+
+def estimate_trajectory(frames_u8: np.ndarray) -> dict:
+    """uint8 [T, H, W, 3] -> trajectory dict.
+
+    Returns: ``positions`` [T, 2] cumulative (x, y) displacement in pixels
+    (position 0 is the origin), ``steps`` [T-1, 2], and summary stats."""
+    if frames_u8.shape[0] < 2:
+        zeros = np.zeros((frames_u8.shape[0], 2), np.float32)
+        return {
+            "positions": zeros,
+            "steps": np.zeros((0, 2), np.float32),
+            "path_length": 0.0,
+            "net_displacement": 0.0,
+            "max_step": 0.0,
+            "motion_class": "stationary",
+        }
+    gray = jnp.asarray(frames_u8, jnp.float32).mean(axis=-1) / 255.0
+    from cosmos_curate_tpu.models.batching import pad_batch
+
+    padded, n = pad_batch(np.asarray(gray))  # pow2 T-buckets: few compiles
+    steps = np.asarray(_phase_correlate_pairs(jnp.asarray(padded)))[: n - 1]
+    positions = np.concatenate(
+        [np.zeros((1, 2), np.float32), np.cumsum(steps, axis=0)], axis=0
+    )
+    lengths = np.hypot(steps[:, 0], steps[:, 1])
+    path_length = float(lengths.sum())
+    net = float(np.hypot(*positions[-1]))
+    max_step = float(lengths.max()) if len(lengths) else 0.0
+    # simple drive-segment classification on the displacement geometry
+    if path_length < 1.0 * len(steps) * 0.05 + 1.0:
+        motion = "stationary"
+    elif net > 0.7 * path_length:
+        motion = "straight"
+    else:
+        motion = "turning"
+    return {
+        "positions": positions,
+        "steps": steps,
+        "path_length": path_length,
+        "net_displacement": net,
+        "max_step": max_step,
+        "motion_class": motion,
+    }
+
+
+def run_av_trajectory(args) -> dict:
+    """Per-clip trajectory artifacts for all split/captioned clips:
+    ``trajectories/<uuid>.npy`` (positions) + a stats row in the summary."""
+    import json
+    import time as time_mod
+    from pathlib import Path
+
+    from cosmos_curate_tpu.pipelines.av.state_db import open_state_db
+    from cosmos_curate_tpu.storage.client import read_bytes
+    from cosmos_curate_tpu.video.decode import extract_frames_at_fps
+
+    t0 = time_mod.monotonic()
+    root = args.output_path.rstrip("/")
+    db = open_state_db(args.resolved_db)
+    stats = []
+    try:
+        todo = [
+            r
+            for r in db.clips()
+            if r.state in ("split", "captioned", "packaged")
+        ]
+        if args.limit:
+            todo = todo[: args.limit]
+        out_dir = Path(root) / "trajectories"
+        out_dir.mkdir(parents=True, exist_ok=True)
+        for row in todo:
+            try:
+                clip_bytes = read_bytes(f"{root}/clips/{row.clip_uuid}.mp4")
+            except FileNotFoundError:
+                continue
+            frames = extract_frames_at_fps(clip_bytes, target_fps=4.0, resize_hw=(128, 128))
+            if frames.shape[0] < 2:
+                continue
+            traj = estimate_trajectory(frames)
+            np.save(out_dir / f"{row.clip_uuid}.npy", traj["positions"])
+            stats.append(
+                {
+                    "clip_uuid": row.clip_uuid,
+                    "camera": row.camera,
+                    "path_length": traj["path_length"],
+                    "net_displacement": traj["net_displacement"],
+                    "motion_class": traj["motion_class"],
+                }
+            )
+        (Path(root) / "trajectories" / "stats.json").write_text(json.dumps(stats, indent=1))
+        return {"num_trajectories": len(stats), "elapsed_s": time_mod.monotonic() - t0}
+    finally:
+        db.close()

@@ -200,21 +200,24 @@ def run_split(
     t0 = time.monotonic()
     # retrying accelerator gate (reference gpu_start_helper): catch a dead
     # TPU relay BEFORE spawning workers so the failure mode is one clear
-    # message, not N crashed model setups. One quick probe by default;
-    # CURATE_HEALTH_GATE=strict makes TPU mandatory.
+    # action, not N crashed model setups. Opt-in (probing costs a subprocess
+    # jax import): CURATE_HEALTH_GATE=on degrades this run to CPU when the
+    # TPU is unhealthy; =strict aborts with a clear message instead.
     import os as _os
 
-    gate_mode = _os.environ.get("CURATE_HEALTH_GATE", "")  # ""|strict|off
-    if gate_mode != "off":
+    gate_mode = _os.environ.get("CURATE_HEALTH_GATE", "off")  # off|on|strict
+    if gate_mode in ("on", "strict"):
         from cosmos_curate_tpu.utils.health import accelerator_health_gate
 
-        strict = gate_mode == "strict"
-        accelerator_health_gate(
-            attempts=3 if strict else 1,
+        alive = accelerator_health_gate(
+            attempts=3,
             probe_timeout_s=120,
             backoff_s=30,
-            require=strict,
+            require=gate_mode == "strict",
         )
+        if not alive:
+            logger.warning("health gate: TPU unhealthy — running this job on CPU")
+            _os.environ["JAX_PLATFORMS"] = "cpu"
     if args.tracing:
         from cosmos_curate_tpu.observability.tracing import enable_tracing
 

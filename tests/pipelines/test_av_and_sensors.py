@@ -241,3 +241,82 @@ class TestSensors:
         session = load_session_jsonl(self._session_file(tmp_path))
         grid = sampling_grid(session, rate_hz=10.0)
         assert grid[0] >= 0.01  # starts at the latest first-frame
+
+
+def test_phase_correlation_trajectory():
+    """A synthetic panning clip must yield a near-straight trajectory whose
+    per-step displacement matches the injected pan."""
+    import numpy as np
+
+    from cosmos_curate_tpu.pipelines.av.trajectory import estimate_trajectory
+
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, (256, 256, 3), np.uint8)
+    frames = np.stack([np.roll(base, (0, -3 * i), axis=(0, 1)) for i in range(10)])
+    traj = estimate_trajectory(frames[:, 64:192, 64:192])
+    steps = traj["steps"]
+    # injected pan: content moves left 3 px/frame -> dx ≈ +3 (scene shift)
+    assert abs(abs(steps[:, 0].mean()) - 3) < 1.0, steps[:, 0]
+    assert abs(steps[:, 1].mean()) < 1.0
+    assert traj["motion_class"] == "straight"
+    assert traj["positions"].shape == (10, 2)
+
+
+def test_stationary_clip_classified():
+    import numpy as np
+
+    from cosmos_curate_tpu.pipelines.av.trajectory import estimate_trajectory
+
+    frames = np.full((6, 64, 64, 3), 128, np.uint8)
+    traj = estimate_trajectory(frames)
+    assert traj["motion_class"] == "stationary"
+    assert traj["path_length"] < 2.0
+
+
+def test_windowed_captioning(tmp_path):
+    """Long clips caption per window: primary variant covers every window
+    (stored as default, default#w1, ...), extras the front window only."""
+    import cv2
+    import numpy as np
+
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.models.vlm import CaptionEngine, VLM_TINY_TEST
+    from cosmos_curate_tpu.pipelines.av.pipeline import (
+        AVPipelineArgs,
+        run_av_caption,
+        run_av_ingest,
+        run_av_split,
+    )
+    from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB
+
+    d = tmp_path / "cams"
+    d.mkdir()
+    w = cv2.VideoWriter(str(d / "sess_front.mp4"), cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (64, 48))
+    for i in range(72):  # 3 s -> 3 frames at 1 fps
+        w.write(np.full((48, 64, 3), (i * 3) % 255, np.uint8))
+    w.release()
+
+    args = AVPipelineArgs(
+        input_path=str(d),
+        output_path=str(tmp_path / "out"),
+        clip_len_s=3.0,
+        min_clip_len_s=0.5,
+        caption_prompt_variant="av",
+        extra_caption_variants=("short",),
+        caption_window_frames=1,  # every extracted frame its own window
+    )
+    run_av_ingest(args)
+    run_av_split(args, runner=SequentialRunner())
+    engine = CaptionEngine(VLM_TINY_TEST, max_batch=4)
+    engine.setup()
+    cap = run_av_caption(args, engine=engine)
+    assert cap["num_windows"] >= 3  # >=2 primary windows + 1 extra front
+
+    db = AVStateDB(args.resolved_db)
+    try:
+        row = db.clips(state="captioned")[0]
+        vc = db.variant_captions(row.clip_uuid)
+        assert "default" in vc and "short" in vc
+        assert any(k.startswith("default#w") for k in vc), vc
+    finally:
+        db.close()
