@@ -104,6 +104,11 @@ def load_params(
 
     Format: flax msgpack (``flax.serialization``) — synchronous and
     self-contained; the tree structure comes from ``init_fn``."""
+    from cosmos_curate_tpu.utils.jax_cache import enable_persistent_cache
+
+    # Every model load precedes that model's compiles; enabling here makes
+    # repeat compiles (fresh processes, re-created stage instances) disk hits.
+    enable_persistent_cache()
     ckpt = find_checkpoint(model_id)
     if ckpt is not None:
         import flax.serialization

@@ -61,10 +61,12 @@ def estimate_trajectory(frames_u8: np.ndarray) -> dict:
             "max_step": 0.0,
             "motion_class": "stationary",
         }
-    gray = jnp.asarray(frames_u8, jnp.float32).mean(axis=-1) / 255.0
+    # grayscale on host: pad_batch needs host arrays anyway, so a jnp
+    # reduction here would round-trip the full stack device->host->device
+    gray = frames_u8.astype(np.float32).mean(axis=-1) / 255.0
     from cosmos_curate_tpu.models.batching import pad_batch
 
-    padded, n = pad_batch(np.asarray(gray))  # pow2 T-buckets: few compiles
+    padded, n = pad_batch(gray)  # pow2 T-buckets: few compiles
     steps = np.asarray(_phase_correlate_pairs(jnp.asarray(padded)))[: n - 1]
     positions = np.concatenate(
         [np.zeros((1, 2), np.float32), np.cumsum(steps, axis=0)], axis=0
@@ -103,6 +105,14 @@ def run_av_trajectory(args) -> dict:
 
     t0 = time_mod.monotonic()
     root = args.output_path.rstrip("/")
+    if "://" in root:
+        # clips are read through the URL-aware storage client, but
+        # trajectories are written with local paths — a remote output root
+        # would silently land in a local "s3:/..." directory.
+        raise ValueError(
+            f"av trajectory writes locally; output_path {root!r} must be a "
+            "local directory (sync to object storage afterwards)"
+        )
     db = open_state_db(args.resolved_db)
     stats = []
     try:

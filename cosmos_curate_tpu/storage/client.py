@@ -163,6 +163,20 @@ def _make_gcs_client() -> StorageClient:
     return GcsStorageClient()
 
 
+def _make_azure_client() -> StorageClient:
+    # No SDK path: the REST backend (Shared Key / SAS over urllib) IS the
+    # Azure client in this build.
+    from cosmos_curate_tpu.storage.azure_rest import AzureRestClient
+
+    try:
+        return AzureRestClient()
+    except RuntimeError as e:
+        class AzureGated(_GatedClient):
+            scheme, reason = "az://", str(e)
+
+        return AzureGated()
+
+
 _LOCAL = LocalStorageClient()
 
 
@@ -173,7 +187,7 @@ def get_storage_client(path: str | os.PathLike[str]) -> StorageClient:
     if s.startswith("gs://"):
         return _make_gcs_client()
     if s.startswith("az://"):
-        raise RuntimeError("az:// storage not supported in this build")
+        return _make_azure_client()
     return _LOCAL
 
 

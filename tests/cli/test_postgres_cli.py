@@ -1,0 +1,183 @@
+"""Postgres admin CLI (reference core/managers/postgres_cli.py:204-490):
+schema introspection and guarded additive migration, over both the sqlite
+twin and the wire-protocol Postgres path (fake server)."""
+
+from __future__ import annotations
+
+import pytest
+
+from cosmos_curate_tpu.cli.postgres_cli import (
+    ColumnInfo,
+    SqliteInspector,
+    apply_changes,
+    diff_schema,
+    open_inspector,
+    parse_schema_ddl,
+    target_schema,
+)
+from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB, ClipRow
+
+
+@pytest.fixture()
+def state_path(tmp_path):
+    db = AVStateDB(str(tmp_path / "state.db"))
+    db.upsert_session("sess-1", 4)
+    db.add_clips(
+        [ClipRow(clip_uuid="c1", session_id="sess-1", camera="front", span_start=0, span_end=2)]
+    )
+    db.close()
+    return str(tmp_path / "state.db")
+
+
+def test_parse_schema_ddl_extracts_tables_and_columns():
+    from cosmos_curate_tpu.pipelines.av import state_db
+
+    tables = parse_schema_ddl(state_db._SCHEMA)
+    assert set(tables) == {"sessions", "clips", "clip_captions"}
+    clips = {c.name: c for c in tables["clips"]}
+    assert clips["span_start"].data_type == "REAL"
+    assert not clips["session_id"].nullable
+    assert clips["caption"].nullable
+    # constraint lines must not leak in as columns
+    assert "FOREIGN" not in clips and "PRIMARY" not in clips
+
+
+def test_pg_dialect_multiword_types_and_numeric_defaults():
+    """DOUBLE PRECISION must survive parsing whole, and ALTER backfill
+    defaults must match the column type (review findings: '' is invalid for
+    numeric columns on Postgres)."""
+    from cosmos_curate_tpu.cli.postgres_cli import SchemaChanges
+
+    tables = target_schema("postgres")
+    clips = {c.name: c for c in tables["clips"]}
+    assert clips["span_start"].data_type == "DOUBLE PRECISION"
+
+    class _NoopInspector:
+        dialect = "postgres"
+
+        def execute(self, sql):  # pragma: no cover - dry_run never calls
+            raise AssertionError
+
+    changes = SchemaChanges([], [("clips", clips["span_start"]), ("clips", clips["camera"])], [], [])
+    stmts = apply_changes(_NoopInspector(), changes, dry_run=True)
+    assert stmts[0] == (
+        "ALTER TABLE clips ADD COLUMN span_start DOUBLE PRECISION NOT NULL DEFAULT 0"
+    )
+    assert stmts[1].endswith("camera TEXT NOT NULL DEFAULT ''")
+
+
+def test_sqlite_inspector_tables_and_counts(state_path):
+    insp = SqliteInspector(state_path)
+    assert set(insp.tables()) == {"sessions", "clips", "clip_captions"}
+    assert insp.row_count("clips") == 1
+    cols = {c.name for c in insp.columns("sessions")}
+    assert {"session_id", "num_cameras", "state", "created_s"} <= cols
+    fks = insp.foreign_keys()
+    assert any(fk.table == "clips" and fk.ref_table == "sessions" for fk in fks)
+    insp.close()
+
+
+def test_diff_schema_clean_database_is_up_to_date(state_path):
+    insp = SqliteInspector(state_path)
+    changes = diff_schema(insp, target_schema("sqlite"))
+    assert changes.empty
+    assert not changes.extra_tables
+    insp.close()
+
+
+def test_update_schemas_adds_missing_column_and_table(tmp_path):
+    import sqlite3
+
+    path = str(tmp_path / "old.db")
+    con = sqlite3.connect(path)
+    # an "old" deploy: clips missing the caption column, clip_captions absent
+    con.execute(
+        "CREATE TABLE sessions (session_id TEXT PRIMARY KEY, num_cameras INTEGER NOT NULL, "
+        "state TEXT NOT NULL DEFAULT 'ingested', created_s REAL NOT NULL)"
+    )
+    con.execute(
+        "CREATE TABLE clips (clip_uuid TEXT PRIMARY KEY, session_id TEXT NOT NULL, "
+        "camera TEXT NOT NULL, span_start REAL NOT NULL, span_end REAL NOT NULL, "
+        "state TEXT NOT NULL DEFAULT 'split')"
+    )
+    con.commit()
+    con.close()
+
+    insp = SqliteInspector(path)
+    changes = diff_schema(insp, target_schema("sqlite"))
+    assert changes.missing_tables == ["clip_captions"]
+    assert [(t, c.name) for t, c in changes.missing_columns] == [("clips", "caption")]
+
+    # dry run leaves the db untouched
+    stmts = apply_changes(insp, changes, dry_run=True)
+    assert len(stmts) == 2
+    assert "caption" not in {c.name for c in insp.columns("clips")}
+
+    apply_changes(insp, changes, dry_run=False)
+    assert "caption" in {c.name for c in insp.columns("clips")}
+    assert "clip_captions" in insp.tables()
+    # idempotent: second diff is clean
+    assert diff_schema(insp, target_schema("sqlite")).empty
+    insp.close()
+
+
+def test_extra_columns_reported_not_dropped(state_path):
+    import sqlite3
+
+    con = sqlite3.connect(state_path)
+    con.execute("ALTER TABLE clips ADD COLUMN legacy_note TEXT")
+    con.execute("CREATE TABLE scratch (x TEXT)")
+    con.commit()
+    con.close()
+    insp = SqliteInspector(state_path)
+    changes = diff_schema(insp, target_schema("sqlite"))
+    assert changes.empty  # nothing to add
+    assert ("clips", "legacy_note") in changes.extra_columns
+    assert "scratch" in changes.extra_tables
+    # still present after an apply pass
+    apply_changes(insp, changes, dry_run=False)
+    assert "legacy_note" in {c.name for c in insp.columns("clips")}
+    assert "scratch" in insp.tables()
+    insp.close()
+
+
+def test_postgres_inspector_over_wire_protocol():
+    from cosmos_curate_tpu.pipelines.av.state_db import PostgresAVStateDB
+    from tests.pipelines.fake_pg import FakePgServer
+
+    with FakePgServer(auth="scram") as srv:
+        db = PostgresAVStateDB(srv.dsn)
+        db.upsert_session("s1", 2)
+        db.close()
+
+        insp = open_inspector(srv.dsn)
+        assert insp.dialect == "postgres"
+        assert "sessions" in insp.tables()
+        assert insp.row_count("sessions") == 1
+        cols = {c.name: c for c in insp.columns("sessions")}
+        assert "num_cameras" in cols and not cols["num_cameras"].nullable
+        fks = insp.foreign_keys()
+        assert any(fk.table == "clips" and fk.ref_table == "sessions" for fk in fks)
+        assert diff_schema(insp, target_schema("postgres")).empty
+        insp.close()
+
+
+def test_cli_entry_show_tables(state_path, capsys):
+    from cosmos_curate_tpu.cli.main import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(["postgres", "show-tables", "--db", state_path])
+    assert args.func(args) == 0
+    out = capsys.readouterr().out
+    assert "clips\t1" in out
+
+
+def test_cli_entry_update_schemas_dry_run(state_path, capsys):
+    from cosmos_curate_tpu.cli.main import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(
+        ["postgres", "update-schemas", "--db", state_path, "--dry-run"]
+    )
+    assert args.func(args) == 0
+    assert "up to date" in capsys.readouterr().out

@@ -161,7 +161,34 @@ class FakePgServer:
         self._send(sock, b"E", payload)
         self._send(sock, b"Z", b"I")
 
+    def _translate(self, sql: str) -> str:
+        """Map the inspector's information_schema queries onto sqlite
+        equivalents so the PostgresInspector path is exercisable end-to-end
+        over the real wire protocol."""
+        import re
+
+        if "information_schema.tables" in sql:
+            return (
+                "SELECT name FROM sqlite_master WHERE type='table' "
+                "AND name NOT LIKE 'sqlite_%' ORDER BY name"
+            )
+        if "information_schema.columns" in sql:
+            m = re.search(r"table_name = '(\w+)'", sql)
+            table = m.group(1) if m else ""
+            return (
+                f"SELECT name, type, CASE WHEN \"notnull\" THEN 'NO' ELSE 'YES' END "
+                f"FROM pragma_table_info('{table}') ORDER BY cid"
+            )
+        if "information_schema.table_constraints" in sql:
+            return (
+                "SELECT m.name, f.\"from\", f.\"table\", f.\"to\" "
+                "FROM sqlite_master m JOIN pragma_foreign_key_list(m.name) f "
+                "WHERE m.type='table'"
+            )
+        return sql
+
     def _run_query(self, sock: socket.socket, sql: str) -> None:
+        sql = self._translate(sql)
         try:
             with self._db_lock, self._db:
                 cur = self._db.execute(sql)

@@ -1,0 +1,242 @@
+"""In-process fake Azure Blob server (Azurite-style) for the REST backend.
+
+Speaks enough of the Blob REST dialect for AzureRestClient: path-style
+GET/PUT/HEAD/DELETE under ``/<account>/<container>/<blob>``, ranged GET,
+container listing with markers, and the Put Block / Put Block List
+handshake. Shared Key signatures are **re-computed and verified** against
+the known test key, so a signing bug in storage/azure_shared_key.py fails
+these tests instead of surfacing as a 403 against real Azure.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+TEST_ACCOUNT = "testaccount"
+TEST_KEY = base64.b64encode(b"azure-test-key-material").decode()
+
+
+class FakeAzureState:
+    def __init__(self) -> None:
+        self.blobs: dict[tuple[str, str], bytes] = {}
+        self.blocks: dict[tuple[str, str], dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        self.fail_next = 0
+        self.verify_signatures = True
+        self.auth_failures: list[str] = []
+
+
+def _handler(state: FakeAzureState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def _split(self) -> tuple[str, str, dict[str, list[str]]]:
+            u = urllib.parse.urlparse(self.path)
+            parts = u.path.lstrip("/").split("/", 2)
+            # /<account>/<container>[/<blob>]
+            container = parts[1] if len(parts) > 1 else ""
+            blob = urllib.parse.unquote(parts[2]) if len(parts) > 2 else ""
+            return container, blob, urllib.parse.parse_qs(u.query, keep_blank_values=True)
+
+        def _check_auth(self) -> bool:
+            if not state.verify_signatures:
+                return True
+            auth = self.headers.get("authorization", "")
+            try:
+                assert auth.startswith(f"SharedKey {TEST_ACCOUNT}:"), f"bad auth {auth!r}"
+                client_sig = auth.split(":", 1)[1]
+                u = urllib.parse.urlparse(self.path)
+                low = {k.lower(): v.strip() for k, v in self.headers.items()}
+                ms = "".join(
+                    f"{k}:{low[k]}\n" for k in sorted(low) if k.startswith("x-ms-")
+                )
+                resource = f"/{TEST_ACCOUNT}{u.path}"
+                q = {
+                    k.lower(): ",".join(v)
+                    for k, v in urllib.parse.parse_qs(
+                        u.query, keep_blank_values=True
+                    ).items()
+                }
+                for name in sorted(q):
+                    resource += f"\n{name}:{q[name]}"
+                length = int(low.get("content-length") or 0)
+                sts = "\n".join(
+                    [
+                        self.command,
+                        low.get("content-encoding", ""),
+                        low.get("content-language", ""),
+                        str(length) if length else "",
+                        low.get("content-md5", ""),
+                        low.get("content-type", ""),
+                        "",
+                        low.get("if-modified-since", ""),
+                        low.get("if-match", ""),
+                        low.get("if-none-match", ""),
+                        low.get("if-unmodified-since", ""),
+                        low.get("range", ""),
+                    ]
+                ) + "\n" + ms + resource
+                expected = base64.b64encode(
+                    hmac.new(
+                        base64.b64decode(TEST_KEY), sts.encode(), hashlib.sha256
+                    ).digest()
+                ).decode()
+                assert hmac.compare_digest(expected, client_sig), (
+                    f"signature mismatch on {self.command} {self.path}"
+                )
+                return True
+            except (AssertionError, KeyError, IndexError) as e:
+                with state.lock:
+                    state.auth_failures.append(f"{self.command} {self.path}: {e}")
+                length = int(self.headers.get("content-length") or 0)
+                if length:
+                    self.rfile.read(length)
+                self._reply(403, b"<Error><Code>AuthenticationFailed</Code></Error>")
+                return False
+
+        def _maybe_fail(self) -> bool:
+            with state.lock:
+                if state.fail_next > 0:
+                    state.fail_next -= 1
+                    self._reply(503, b"server busy")
+                    return True
+            return False
+
+        def _reply(self, status: int, body: bytes = b"", headers: dict | None = None) -> None:
+            self.send_response(status)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("content-length", str(len(body)))
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802
+            if not self._check_auth() or self._maybe_fail():
+                return
+            container, blob, q = self._split()
+            if "comp" in q and q["comp"][0] == "list":
+                self._list(container, q)
+                return
+            with state.lock:
+                data = state.blobs.get((container, blob))
+            if data is None:
+                self._reply(404, b"<Error><Code>BlobNotFound</Code></Error>")
+                return
+            rng = self.headers.get("range", "")
+            if rng.startswith("bytes="):
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                start = int(start_s)
+                end = min(int(end_s), len(data) - 1) if end_s else len(data) - 1
+                self._reply(206, data[start : end + 1])
+                return
+            self._reply(200, data)
+
+        def _list(self, container: str, q: dict[str, list[str]]) -> None:
+            prefix = q.get("prefix", [""])[0]
+            max_results = int(q.get("maxresults", ["1000"])[0])
+            marker = q.get("marker", [""])[0]
+            delimiter = q.get("delimiter", [""])[0]
+            with state.lock:
+                names = sorted(
+                    b for (c, b) in state.blobs if c == container and b.startswith(prefix)
+                )
+            if delimiter:
+                names = [n for n in names if delimiter not in n[len(prefix):]]
+            if marker:
+                names = [n for n in names if n > marker]
+            page, rest = names[:max_results], names[max_results:]
+            blobs_xml = "".join(
+                f"<Blob><Name>{n}</Name><Properties>"
+                f"<Content-Length>{len(state.blobs[(container, n)])}</Content-Length>"
+                f"</Properties></Blob>"
+                for n in page
+            )
+            next_marker = f"<NextMarker>{page[-1]}</NextMarker>" if rest else "<NextMarker/>"
+            body = (
+                f'<?xml version="1.0" encoding="utf-8"?>'
+                f'<EnumerationResults ContainerName="{container}">'
+                f"<Blobs>{blobs_xml}</Blobs>{next_marker}</EnumerationResults>"
+            ).encode()
+            self._reply(200, body)
+
+        def do_HEAD(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
+            container, blob, _ = self._split()
+            with state.lock:
+                data = state.blobs.get((container, blob))
+            if data is None:
+                self._reply(404)
+            else:
+                self._reply(200, data)
+
+        def do_PUT(self) -> None:  # noqa: N802
+            if not self._check_auth() or self._maybe_fail():
+                return
+            container, blob, q = self._split()
+            length = int(self.headers.get("content-length", "0"))
+            data = self.rfile.read(length)
+            comp = q.get("comp", [""])[0]
+            if comp == "block":
+                bid = q["blockid"][0]
+                with state.lock:
+                    state.blocks.setdefault((container, blob), {})[bid] = data
+                self._reply(201)
+                return
+            if comp == "blocklist":
+                import xml.etree.ElementTree as ET
+
+                root = ET.fromstring(data)
+                ids = [el.text or "" for el in root]
+                with state.lock:
+                    staged = state.blocks.pop((container, blob), {})
+                    try:
+                        state.blobs[(container, blob)] = b"".join(staged[i] for i in ids)
+                    except KeyError:
+                        self._reply(400, b"<Error><Code>InvalidBlockList</Code></Error>")
+                        return
+                self._reply(201)
+                return
+            if self.headers.get("x-ms-blob-type") != "BlockBlob":
+                self._reply(400, b"<Error><Code>MissingRequiredHeader</Code></Error>")
+                return
+            with state.lock:
+                state.blobs[(container, blob)] = data
+            self._reply(201)
+
+        def do_DELETE(self) -> None:  # noqa: N802
+            if not self._check_auth():
+                return
+            container, blob, _ = self._split()
+            with state.lock:
+                existed = state.blobs.pop((container, blob), None)
+            self._reply(202 if existed is not None else 404)
+
+    return Handler
+
+
+class FakeAzureServer:
+    def __init__(self) -> None:
+        self.state = FakeAzureState()
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _handler(self.state))
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}/{TEST_ACCOUNT}"
+
+    def __enter__(self) -> "FakeAzureServer":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,149 @@
+"""Azure Blob REST backend against the in-process fake server (closes the
+round-1 storage gap: az:// was unsupported, VERDICT #8/PARITY §2.2)."""
+
+from __future__ import annotations
+
+import pytest
+
+import cosmos_curate_tpu.storage.azure_rest as azure_rest
+from cosmos_curate_tpu.storage.azure_rest import AzureError, AzureRestClient
+from tests.storage.fake_azure import TEST_ACCOUNT, TEST_KEY, FakeAzureServer
+
+
+@pytest.fixture()
+def server():
+    with FakeAzureServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    return AzureRestClient(
+        account_name=TEST_ACCOUNT,
+        account_key=TEST_KEY,
+        endpoint_url=server.endpoint,
+    )
+
+
+def test_round_trip(client):
+    client.write_bytes("az://cont/a/b.txt", b"hello azure")
+    assert client.read_bytes("az://cont/a/b.txt") == b"hello azure"
+    assert client.exists("az://cont/a/b.txt")
+    assert not client.exists("az://cont/a/missing.txt")
+    assert client.size("az://cont/a/b.txt") == 11
+    client.delete("az://cont/a/b.txt")
+    assert not client.exists("az://cont/a/b.txt")
+
+
+def test_read_missing_raises(client):
+    with pytest.raises(AzureError):
+        client.read_bytes("az://cont/nope")
+
+
+def test_ranged_read(client):
+    client.write_bytes("az://cont/r.bin", bytes(range(100)))
+    assert client.read_range("az://cont/r.bin", 10, 19) == bytes(range(10, 20))
+
+
+def test_list_pagination_and_suffix_filter(client):
+    for i in range(25):
+        client.write_bytes(f"az://cont/pre/f{i:03d}.mp4", b"x" * i)
+    client.write_bytes("az://cont/pre/skip.txt", b"t")
+    client.write_bytes("az://cont/other/g.mp4", b"y")
+
+    import unittest.mock
+
+    orig = AzureRestClient._request
+
+    def small_pages(self, method, container, blob, *, query=None, **kw):
+        if query and query.get("maxresults"):
+            query = dict(query, maxresults="10")
+        return orig(self, method, container, blob, query=query, **kw)
+
+    with unittest.mock.patch.object(AzureRestClient, "_request", small_pages):
+        infos = list(client.list_files("az://cont/pre/", suffixes=(".mp4",)))
+    assert len(infos) == 25
+    assert infos[0].path == "az://cont/pre/f000.mp4"
+    assert infos[3].size == 3
+
+
+def test_retry_on_503(client, server):
+    server.state.fail_next = 2
+    client.write_bytes("az://cont/retry.bin", b"ok")
+    assert client.read_bytes("az://cont/retry.bin") == b"ok"
+
+
+def test_block_list_upload(client, server, monkeypatch):
+    monkeypatch.setattr(azure_rest, "BLOCK_THRESHOLD", 1024)
+    monkeypatch.setattr(azure_rest, "BLOCK_CHUNK", 400)
+    data = bytes(i % 251 for i in range(2500))
+    client.write_bytes("az://cont/big.bin", data)
+    assert client.read_bytes("az://cont/big.bin") == data
+    assert not server.state.blocks  # committed block list is cleaned up
+
+
+def test_storage_dispatch_constructs_azure_client(server, monkeypatch):
+    """get_storage_client('az://...') must construct the REST client when
+    credentials are configured."""
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", TEST_ACCOUNT)
+    monkeypatch.setenv("AZURE_STORAGE_KEY", TEST_KEY)
+    monkeypatch.setenv("AZURE_STORAGE_ENDPOINT", server.endpoint)
+    from cosmos_curate_tpu.storage import client as storage_client
+
+    c = storage_client.get_storage_client("az://cont/x")
+    assert isinstance(c, AzureRestClient)
+    c.write_bytes("az://cont/x", b"dispatch")
+    assert storage_client.read_bytes("az://cont/x") == b"dispatch"
+
+
+def test_bad_key_rejected(server):
+    """The fake re-computes Shared Key signatures, so signing with the wrong
+    key must get 403 — proving the auth layer is actually checked."""
+    import base64
+
+    bad = AzureRestClient(
+        account_name=TEST_ACCOUNT,
+        account_key=base64.b64encode(b"WRONG").decode(),
+        endpoint_url=server.endpoint,
+    )
+    with pytest.raises(AzureError) as ei:
+        bad.write_bytes("az://cont/x.bin", b"data")
+    assert ei.value.status == 403
+    with pytest.raises(AzureError) as ei2:
+        bad.exists("az://cont/x.bin")
+    assert ei2.value.status == 403
+    assert server.state.auth_failures
+
+
+def test_sas_auth_skips_signing(server, monkeypatch):
+    """With a SAS token configured (no key), requests carry the token in the
+    query string and no Authorization header."""
+    server.state.verify_signatures = False
+    c = AzureRestClient(
+        account_name=TEST_ACCOUNT,
+        sas_token="?sv=2021-08-06&sig=testsig",
+        endpoint_url=server.endpoint,
+    )
+    c.write_bytes("az://cont/sas.txt", b"via sas")
+    assert c.read_bytes("az://cont/sas.txt") == b"via sas"
+
+
+def test_missing_credentials_raise(monkeypatch):
+    for var in (
+        "AZURE_STORAGE_ACCOUNT",
+        "AZURE_STORAGE_KEY",
+        "AZURE_STORAGE_SAS_TOKEN",
+        "AZURE_STORAGE_ENDPOINT",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(RuntimeError, match="account"):
+        AzureRestClient()
+    with pytest.raises(RuntimeError, match="credentials"):
+        AzureRestClient(account_name="acct")
+
+
+def test_non_recursive_list(client):
+    client.write_bytes("az://cont/top/a.mp4", b"1")
+    client.write_bytes("az://cont/top/sub/b.mp4", b"2")
+    infos = list(client.list_files("az://cont/top/", recursive=False))
+    assert [i.path for i in infos] == ["az://cont/top/a.mp4"]
