@@ -1,0 +1,82 @@
+"""Camera-sensor sampling throughput benchmark.
+
+Equivalent of the reference's camera_sensor_benchmark
+(cosmos_curate/core/sensors/scripts/camera_sensor_benchmark.py): frames/s
+through ``CameraSensor.sample`` for a given grid rate and window length —
+the number that sizes the CPU prep pool feeding TPU stages from sensor
+rigs.
+
+Usage: python -m benchmarks.camera_sensor_benchmark [--video PATH]
+(synthesizes a fixture video when none is given).
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def synthesize_video(path: str, *, frames: int = 240, fps: float = 24.0) -> None:
+    import cv2
+
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (320, 240))
+    for i in range(frames):
+        frame = np.full((240, 320, 3), (i * 3) % 255, np.uint8)
+        frame[50:100, (i * 5) % 280 : (i * 5) % 280 + 40] = 255
+        w.write(frame)
+    w.release()
+
+
+def run(video: str, *, rate_hz: float, window_size: int, camera: str = "front") -> dict:
+    from cosmos_curate_tpu.sensors.camera_sensor import CameraSensor
+    from cosmos_curate_tpu.sensors.sampling import SamplingGrid, SamplingSpec
+    from cosmos_curate_tpu.sensors.video_index import camera_frame_refs
+
+    sensor = CameraSensor(camera, camera_frame_refs(camera, video))
+    grid = SamplingGrid.from_rate(
+        sensor.start_ns,
+        sample_rate_hz=rate_hz,
+        end_ns=sensor.end_ns,
+        window_size=window_size,
+    )
+    spec = SamplingSpec(grid=grid)
+    t0 = time.monotonic()
+    frames = 0
+    windows = 0
+    for batch in sensor.sample(spec):
+        frames += len(batch)
+        windows += 1
+    elapsed = time.monotonic() - t0
+    return {
+        "windows": windows,
+        "frames": frames,
+        "elapsed_s": round(elapsed, 3),
+        "frames_per_s": round(frames / elapsed, 1) if elapsed > 0 else 0.0,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--video", default="", help="mp4 to sample (synthesized if empty)")
+    ap.add_argument("--rate-hz", type=float, default=10.0)
+    ap.add_argument("--window-size", type=int, default=64, help="grid samples per window")
+    args = ap.parse_args()
+    video = args.video
+    if not video:
+        tmp = tempfile.mkdtemp(prefix="cam_bench_")
+        video = str(Path(tmp) / "bench.mp4")
+        synthesize_video(video)
+    stats = run(video, rate_hz=args.rate_hz, window_size=args.window_size)
+    print(
+        f"camera sensor: {stats['frames']} frames / {stats['windows']} windows "
+        f"in {stats['elapsed_s']}s -> {stats['frames_per_s']} frames/s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

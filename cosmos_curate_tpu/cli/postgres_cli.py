@@ -142,18 +142,9 @@ class PostgresInspector:
     dialect = "postgres"
 
     def __init__(self, dsn: str) -> None:
-        import urllib.parse
+        from cosmos_curate_tpu.utils.pg_client import PgConnection, parse_dsn
 
-        from cosmos_curate_tpu.utils.pg_client import PgConnection
-
-        u = urllib.parse.urlparse(dsn)
-        self._conn = PgConnection(
-            host=u.hostname or "127.0.0.1",
-            port=u.port or 5432,
-            user=urllib.parse.unquote(u.username or "postgres"),
-            password=urllib.parse.unquote(u.password or ""),
-            database=(u.path or "/postgres").lstrip("/") or "postgres",
-        )
+        self._conn = PgConnection(**parse_dsn(dsn))
 
     def tables(self) -> list[str]:
         res = self._conn.execute(
@@ -161,6 +152,8 @@ class PostgresInspector:
             "WHERE table_schema = 'public' ORDER BY table_name"
         )
         return [r[0] for r in res.rows]
+
+    _SCHEMA_FILTER = "AND table_schema = 'public' "
 
     def row_count(self, table: str) -> int:
         res = self._conn.execute(f"SELECT COUNT(*) FROM {table}")
@@ -172,7 +165,8 @@ class PostgresInspector:
         res = self._conn.execute(
             "SELECT column_name, data_type, is_nullable "
             "FROM information_schema.columns "
-            f"WHERE table_name = {quote_literal(table)} ORDER BY ordinal_position"
+            f"WHERE table_name = {quote_literal(table)} "
+            f"{self._SCHEMA_FILTER}ORDER BY ordinal_position"
         )
         return [
             ColumnInfo(r[0], (r[1] or "text").upper(), r[2] in ("YES", "1"))
@@ -187,7 +181,8 @@ class PostgresInspector:
             "ON tc.constraint_name = kcu.constraint_name "
             "JOIN information_schema.constraint_column_usage ccu "
             "ON tc.constraint_name = ccu.constraint_name "
-            "WHERE tc.constraint_type = 'FOREIGN KEY'"
+            "WHERE tc.constraint_type = 'FOREIGN KEY' "
+            "AND tc.table_schema = 'public'"
         )
         return [ForeignKeyInfo(*r) for r in res.rows]
 
@@ -249,14 +244,24 @@ def apply_changes(insp, changes: SchemaChanges, *, dry_run: bool) -> list[str]:
         if m:
             stmts.append(m.group(1))
     for table, col in changes.missing_columns:
+        # backfill default must match the column type; for types we can't
+        # guess a safe default for, add the column nullable and warn — an
+        # additive migration must not abort half-applied on bad DDL
+        head = col.data_type.split()[0]
         if col.nullable:
             null = ""
+        elif head in ("INTEGER", "BIGINT", "SMALLINT", "REAL", "DOUBLE", "NUMERIC", "FLOAT"):
+            null = " NOT NULL DEFAULT 0"
+        elif head in ("TEXT", "VARCHAR", "CHARACTER", "CHAR"):
+            null = " NOT NULL DEFAULT ''"
+        elif head in ("BOOLEAN", "BOOL"):
+            null = " NOT NULL DEFAULT FALSE"
         else:
-            # backfill default must match the column type
-            numeric = col.data_type.split()[0] in (
-                "INTEGER", "BIGINT", "SMALLINT", "REAL", "DOUBLE", "NUMERIC", "FLOAT"
+            logger.warning(
+                "no safe backfill default for %s.%s (%s); adding as nullable",
+                table, col.name, col.data_type,
             )
-            null = " NOT NULL DEFAULT 0" if numeric else " NOT NULL DEFAULT ''"
+            null = ""
         stmts.append(f"ALTER TABLE {table} ADD COLUMN {col.name} {col.data_type}{null}")
     for sql in stmts:
         if dry_run:

@@ -1,15 +1,32 @@
-"""`cosmos-curate-tpu slurm` — generate/submit sbatch scripts for TPU pods.
+"""`cosmos-curate-tpu slurm` — submit and manage pipeline jobs on Slurm.
 
 Equivalent capability of the reference's slurm CLI
-(cosmos_curate/client/slurm_cli/slurm.py + scripts/onto_slurm.py — node 0
-runs the driver, others join the cluster). TPU-flavored: every node runs the
-same program under `jax.distributed` (SPMD), with node 0 also running the
-pipeline driver; coordinator discovery via the Slurm nodelist.
+(cosmos_curate/client/slurm_cli/slurm.py:244-564 + scripts/onto_slurm.py +
+prometheus_service_discovery.py): sbatch generation, local or SSH remote
+submission with job-id parsing, job status/log/cancel management, and
+Prometheus service-discovery file generation so a fleet dashboard scrapes
+per-node engine metrics.
+
+TPU-flavored topology: the reference runs node 0 as a Ray head plus driver
+and the rest as Ray workers; here every node runs the same SPMD program
+under ``jax.distributed`` (cosmos_curate_tpu/parallel/distributed.py), with
+deterministic task partitioning and convergent resume across nodes — node 0
+is only special as the coordinator address.
+
+Subcommands:
+  submit   generate an sbatch script; print, write, or submit it
+           (``--remote-host user@host`` scp+sbatch's it over SSH)
+  status   squeue/sacct for a job id
+  logs     tail the job's output file
+  cancel   scancel a job id
+  prom-sd  write a Prometheus HTTP-SD JSON from a hostfile
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import re
 import shlex
 import subprocess
 from pathlib import Path
@@ -30,7 +47,7 @@ COORD=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)
 export CURATE_COORDINATOR_ADDRESS="$COORD:{coordinator_port}"
 export CURATE_NUM_NODES="$SLURM_JOB_NUM_NODES"
 {env_exports}
-
+{prom_sd_step}
 # srun exports the environment; no nested shell, so arbitrary quoting in
 # the command survives verbatim. Node rank is read from SLURM_NODEID by
 # cosmos_curate_tpu.parallel.distributed in each task.
@@ -38,38 +55,42 @@ srun --kill-on-bad-exit=1 {python} -m cosmos_curate_tpu.cli.main {command}
 {merge_step}"""
 
 
-def register(sub: argparse._SubParsersAction) -> None:
-    slurm = sub.add_parser("slurm", help="generate/submit sbatch for a TPU pod")
-    slurm.add_argument("--job-name", default="cosmos-curate-tpu")
-    slurm.add_argument("--nodes", type=int, default=1)
-    slurm.add_argument("--cpus-per-task", type=int, default=96)
-    slurm.add_argument("--time-limit", default="04:00:00")
-    slurm.add_argument("--log-dir", default="slurm_logs")
-    slurm.add_argument("--partition", default="")
-    slurm.add_argument("--account", default="")
-    slurm.add_argument("--coordinator-port", type=int, default=8476)
-    slurm.add_argument("--env", action="append", default=[], metavar="K=V")
-    slurm.add_argument(
-        "--merge-output",
-        default="",
-        metavar="PATH",
-        help="after all nodes finish, merge per-node summaries under PATH "
-        "into summary-merged.json (runs once, on the batch host)",
-    )
-    slurm.add_argument("--output", default="", help="write script here instead of submitting")
-    slurm.add_argument("--submit", action="store_true", help="sbatch the generated script")
-    slurm.add_argument("command", nargs=argparse.REMAINDER, help="cosmos-curate-tpu subcommand to run")
-    slurm.set_defaults(func=_cmd_slurm)
+def parse_job_id(sbatch_output: str) -> str:
+    """'Submitted batch job 12345' -> '12345' (reference slurm.py:302)."""
+    m = re.search(r"Submitted batch job (\d+)", sbatch_output)
+    if not m:
+        raise ValueError(f"cannot parse job id from sbatch output: {sbatch_output!r}")
+    return m.group(1)
 
 
-def _cmd_slurm(args: argparse.Namespace) -> int:
-    command = args.command
-    if command and command[0] == "--":
-        command = command[1:]
-    if not command:
-        print("error: pass the pipeline command after '--', e.g. "
-              "slurm --nodes 4 -- local split --config run.yaml")
-        return 2
+def write_prometheus_sd(
+    path: Path,
+    hosts: list[str],
+    *,
+    port: int,
+    job_id: str = "",
+    job_name: str = "",
+    job_user: str = "",
+) -> None:
+    """Prometheus HTTP-SD / file-SD JSON listing every node's metrics
+    endpoint (reference prometheus_service_discovery.py:53-71; our engine
+    serves the `pipeline_*` gauges on --metrics-port)."""
+    data = [
+        {
+            "labels": {
+                "job": "cosmos-curate-tpu",
+                "slurm_job_user": job_user,
+                "slurm_job_id": job_id,
+                "slurm_job_name": job_name,
+            },
+            "targets": [f"{h}:{port}" for h in hosts if h],
+        }
+    ]
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=2))
+
+
+def render_sbatch(args: argparse.Namespace, command: list[str]) -> str:
     extra = []
     if args.partition:
         extra.append(f"#SBATCH --partition={args.partition}")
@@ -83,8 +104,26 @@ def _cmd_slurm(args: argparse.Namespace) -> int:
             f"python -m cosmos_curate_tpu.cli.main local merge-summaries "
             f"--output-path {shlex.quote(args.merge_output)}\n"
         )
-    script = _SBATCH_TEMPLATE.format(
+    prom_sd_step = ""
+    if args.prom_sd_file:
+        # monitoring registration must never kill the compute job (the
+        # template runs under set -e), hence the || warning; the nodes temp
+        # file is removed either way
+        prom_sd_step = (
+            "# register every node with the metrics scraper before the run\n"
+            'NODES_FILE=$(mktemp)\n'
+            'scontrol show hostnames "$SLURM_JOB_NODELIST" > "$NODES_FILE"\n'
+            f"python -m cosmos_curate_tpu.cli.main slurm prom-sd "
+            f"--path {shlex.quote(args.prom_sd_file)} "
+            f'--hostfile "$NODES_FILE" '
+            f"--port {args.metrics_port} "
+            '--job-id "$SLURM_JOB_ID" --job-name "$SLURM_JOB_NAME" --job-user "$USER" '
+            '|| echo "warning: prometheus service-discovery registration failed" >&2\n'
+            'rm -f "$NODES_FILE"\n'
+        )
+    return _SBATCH_TEMPLATE.format(
         merge_step=merge_step,
+        prom_sd_step=prom_sd_step,
         job_name=args.job_name,
         nodes=args.nodes,
         cpus_per_task=args.cpus_per_task,
@@ -96,16 +135,178 @@ def _cmd_slurm(args: argparse.Namespace) -> int:
         python="python",
         command=" ".join(shlex.quote(c) for c in command),
     )
+
+
+def _run(cmd: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd, capture_output=True, text=True)
+
+
+def _remote(host: str, cmd: list[str]) -> subprocess.CompletedProcess:
+    return _run(["ssh", "-o", "BatchMode=yes", host, shlex.join(cmd)])
+
+
+# -- commands --------------------------------------------------------------
+
+
+def _cmd_submit(args: argparse.Namespace) -> int:
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print(
+            "error: pass the pipeline command after '--', e.g. "
+            "slurm submit --nodes 4 -- local split --config run.yaml"
+        )
+        return 2
+    script = render_sbatch(args, command)
     if args.output:
         Path(args.output).write_text(script)
         print(f"wrote {args.output}")
     else:
         print(script)
-    if args.submit:
-        target = args.output or "/tmp/cosmos_curate_tpu.sbatch"
-        if not args.output:
-            Path(target).write_text(script)
-        result = subprocess.run(["sbatch", target], capture_output=True, text=True)
-        print(result.stdout or result.stderr)
-        return result.returncode
+    if not args.submit:
+        return 0
+    target = args.output or "/tmp/cosmos_curate_tpu.sbatch"
+    if not args.output:
+        Path(target).write_text(script)
+    if args.remote_host:
+        remote_path = f"/tmp/{Path(target).name}"
+        scp = _run(["scp", "-o", "BatchMode=yes", target, f"{args.remote_host}:{remote_path}"])
+        if scp.returncode != 0:
+            print(scp.stderr)
+            return scp.returncode
+        result = _remote(args.remote_host, ["sbatch", remote_path])
+    else:
+        result = _run(["sbatch", target])
+    out = result.stdout or result.stderr
+    print(out.strip())
+    if result.returncode == 0:
+        try:
+            print(f"job-id: {parse_job_id(out)}")
+        except ValueError:
+            pass
+    return result.returncode
+
+
+def _cmd_status(args: argparse.Namespace) -> int:
+    cmd = ["squeue", "-j", args.job_id, "-o", "%i %j %T %M %D %R"]
+    result = _remote(args.remote_host, cmd) if args.remote_host else _run(cmd)
+    out = (result.stdout or "").strip()
+    # a finished job drops out of squeue; fall back to accounting
+    if result.returncode != 0 or len(out.splitlines()) < 2:
+        cmd = ["sacct", "-j", args.job_id, "--format=JobID,JobName,State,Elapsed", "-n"]
+        result = _remote(args.remote_host, cmd) if args.remote_host else _run(cmd)
+        out = (result.stdout or result.stderr).strip()
+    print(out)
+    return result.returncode
+
+
+def _cmd_logs(args: argparse.Namespace) -> int:
+    log = str(Path(args.log_dir) / f"{args.job_name}-{args.job_id}.out")
+    cmd = ["tail", "-n", str(args.lines), log]
+    if args.follow:
+        cmd.insert(1, "-f")
+        # follow streams to the terminal; no capture
+        if args.remote_host:
+            return subprocess.run(
+                ["ssh", "-o", "BatchMode=yes", args.remote_host, shlex.join(cmd)]
+            ).returncode
+        return subprocess.run(cmd).returncode
+    result = _remote(args.remote_host, cmd) if args.remote_host else _run(cmd)
+    print(result.stdout or result.stderr)
+    return result.returncode
+
+
+def _cmd_cancel(args: argparse.Namespace) -> int:
+    cmd = ["scancel", args.job_id]
+    result = _remote(args.remote_host, cmd) if args.remote_host else _run(cmd)
+    if result.returncode == 0:
+        print(f"cancelled {args.job_id}")
+    else:
+        print(result.stderr.strip())
+    return result.returncode
+
+
+def _cmd_prom_sd(args: argparse.Namespace) -> int:
+    hosts = [
+        line.strip()
+        for line in Path(args.hostfile).read_text().splitlines()
+        if line.strip()
+    ]
+    write_prometheus_sd(
+        Path(args.path),
+        hosts,
+        port=args.port,
+        job_id=args.job_id,
+        job_name=args.job_name,
+        job_user=args.job_user,
+    )
+    print(f"wrote {args.path} ({len(hosts)} targets)")
     return 0
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    slurm = sub.add_parser("slurm", help="submit/manage pipeline jobs on Slurm")
+    ssub = slurm.add_subparsers(dest="slurm_command", metavar="subcommand", required=True)
+
+    sb = ssub.add_parser("submit", help="generate/submit an sbatch script")
+    sb.add_argument("--job-name", default="cosmos-curate-tpu")
+    sb.add_argument("--nodes", type=int, default=1)
+    sb.add_argument("--cpus-per-task", type=int, default=96)
+    sb.add_argument("--time-limit", default="04:00:00")
+    sb.add_argument("--log-dir", default="slurm_logs")
+    sb.add_argument("--partition", default="")
+    sb.add_argument("--account", default="")
+    sb.add_argument("--coordinator-port", type=int, default=8476)
+    sb.add_argument("--env", action="append", default=[], metavar="K=V")
+    sb.add_argument(
+        "--merge-output",
+        default="",
+        metavar="PATH",
+        help="after all nodes finish, merge per-node summaries under PATH "
+        "into summary-merged.json (runs once, on the batch host)",
+    )
+    sb.add_argument(
+        "--prom-sd-file",
+        default="",
+        metavar="PATH",
+        help="write a Prometheus service-discovery JSON for the allocation's "
+        "nodes at job start",
+    )
+    sb.add_argument("--metrics-port", type=int, default=9002)
+    sb.add_argument("--output", default="", help="write script here instead of printing")
+    sb.add_argument("--submit", action="store_true", help="sbatch the generated script")
+    sb.add_argument(
+        "--remote-host", default="", metavar="USER@HOST",
+        help="scp the script to this host and sbatch there over SSH",
+    )
+    sb.add_argument("command", nargs=argparse.REMAINDER, help="cosmos-curate-tpu subcommand")
+    sb.set_defaults(func=_cmd_submit)
+
+    st = ssub.add_parser("status", help="squeue/sacct for a job")
+    st.add_argument("--job-id", required=True)
+    st.add_argument("--remote-host", default="")
+    st.set_defaults(func=_cmd_status)
+
+    lg = ssub.add_parser("logs", help="show the job's output log")
+    lg.add_argument("--job-id", required=True)
+    lg.add_argument("--job-name", default="cosmos-curate-tpu")
+    lg.add_argument("--log-dir", default="slurm_logs")
+    lg.add_argument("--lines", type=int, default=100)
+    lg.add_argument("--follow", action="store_true")
+    lg.add_argument("--remote-host", default="")
+    lg.set_defaults(func=_cmd_logs)
+
+    ca = ssub.add_parser("cancel", help="scancel a job")
+    ca.add_argument("--job-id", required=True)
+    ca.add_argument("--remote-host", default="")
+    ca.set_defaults(func=_cmd_cancel)
+
+    pd = ssub.add_parser("prom-sd", help="write Prometheus service-discovery JSON")
+    pd.add_argument("--path", required=True)
+    pd.add_argument("--hostfile", required=True)
+    pd.add_argument("--port", type=int, default=9002)
+    pd.add_argument("--job-id", default="")
+    pd.add_argument("--job-name", default="")
+    pd.add_argument("--job-user", default="")
+    pd.set_defaults(func=_cmd_prom_sd)

@@ -148,9 +148,6 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
     from cosmos_curate_tpu.models.prompts import get_caption_prompt
     from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
     from cosmos_curate_tpu.models.vlm import CaptionEngine, CaptionRequest, SamplingConfig, VLM_BASE
-    from cosmos_curate_tpu.storage.client import read_bytes
-    from cosmos_curate_tpu.video.decode import extract_frames_at_fps
-
     t0 = time.monotonic()
     db = open_state_db(args.resolved_db)
     tok = default_caption_tokenizer()
@@ -182,21 +179,23 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
         num_windows = 0
         num_captioned = 0
         # chunked gather→caption→store: memory stays bounded by chunk size,
-        # not the full backlog of decoded frames
+        # not the full backlog of decoded frames; within a chunk the fetch+
+        # decode fans out over a thread pool (downloaders.prefetch_clips) so
+        # the engine overlaps with IO
+        from cosmos_curate_tpu.pipelines.av.downloaders import prefetch_clips
+
         chunk_size = 32
         for start in range(0, len(todo), chunk_size):
-            chunk_pending = []
-            for row in todo[start : start + chunk_size]:
-                clip_path = f"{args.output_path.rstrip('/')}/clips/{row.clip_uuid}.mp4"
-                try:
-                    frames = extract_frames_at_fps(
-                        read_bytes(clip_path), target_fps=1.0, resize_hw=(224, 224)
-                    )
-                except FileNotFoundError:
-                    continue
-                if frames.shape[0] == 0:
-                    continue
-                chunk_pending.append((row.clip_uuid, frames))
+            chunk_pending = [
+                (cid, frames)
+                for cid, frames in prefetch_clips(
+                    todo[start : start + chunk_size],
+                    args.output_path,
+                    target_fps=1.0,
+                    resize_hw=(224, 224),
+                )
+                if frames.shape[0] > 0
+            ]
             if not chunk_pending:
                 continue
             if engine is None:

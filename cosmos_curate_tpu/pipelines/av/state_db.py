@@ -188,16 +188,9 @@ class PostgresAVStateDB:
     _TRANSIENT_SQLSTATES = ("40001", "40P01", "55P03", "57P03")
 
     def __init__(self, dsn: str) -> None:
-        import urllib.parse
+        from cosmos_curate_tpu.utils.pg_client import parse_dsn
 
-        u = urllib.parse.urlparse(dsn)
-        self._conn_kwargs = dict(
-            host=u.hostname or "127.0.0.1",
-            port=u.port or 5432,
-            user=urllib.parse.unquote(u.username or "postgres"),
-            password=urllib.parse.unquote(u.password or ""),
-            database=(u.path or "/postgres").lstrip("/") or "postgres",
-        )
+        self._conn_kwargs = parse_dsn(dsn)
         self._conn = self._connect()
         for stmt in _PG_SCHEMA.split(";"):
             if stmt.strip():
@@ -325,7 +318,12 @@ class PostgresAVStateDB:
 
 
 def open_state_db(path_or_dsn: str):
-    """sqlite file path or postgres:// DSN -> the matching backend."""
+    """sqlite file path, object-store sqlite URL, or postgres:// DSN ->
+    the matching backend."""
     if path_or_dsn.startswith(("postgres://", "postgresql://")):
         return PostgresAVStateDB(path_or_dsn)
+    if path_or_dsn.startswith(("s3://", "gs://", "az://")):
+        from cosmos_curate_tpu.pipelines.av.downloaders import RemoteSyncedStateDB
+
+        return RemoteSyncedStateDB(path_or_dsn)
     return AVStateDB(path_or_dsn)

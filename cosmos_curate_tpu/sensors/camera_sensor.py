@@ -60,8 +60,13 @@ class CameraSensor:
         self.intrinsics = intrinsics
         self.extrinsics = extrinsics
         self.resize_hw = resize_hw
-        self._ts_ns = np.asarray(
-            [round(f.timestamp_s * NS) for f in self.frames], np.int64
+        from cosmos_curate_tpu.sensors.validation import strictly_increasing_int64
+
+        # fail-loud on duplicate/backward timestamps at construction
+        # (reference utils/validation.py) — not as a misalignment later
+        self._ts_ns = strictly_increasing_int64(
+            f"camera {camera!r} timestamps",
+            [round(f.timestamp_s * NS) for f in self.frames],
         )
 
     @classmethod

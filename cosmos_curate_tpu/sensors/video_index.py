@@ -1,0 +1,63 @@
+"""Video frame index: per-frame timestamps for sensor construction.
+
+Equivalent capability of the reference's video index utils
+(cosmos_curate/core/sensors/utils/video.py — decode-plan/time-base mapping
+used by camera sensors): derive a nanosecond timestamp per frame of an mp4
+so a bare video becomes a CameraSensor without a sidecar log. cv2 exposes
+no reliable per-packet PTS, so the index is constant-frame-rate (fps from
+the container), anchored at a caller-supplied capture start time — exact
+for the CFR captures AV rigs produce.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from cosmos_curate_tpu.sensors.sampling import NS
+
+
+@dataclass(frozen=True)
+class VideoIndex:
+    path: str
+    fps: float
+    frame_count: int
+    timestamps_ns: np.ndarray  # int64 [frame_count], anchored at t0_ns
+
+    @property
+    def duration_s(self) -> float:
+        return self.frame_count / self.fps if self.fps > 0 else 0.0
+
+
+def index_video(path: str, *, t0_ns: int = 0) -> VideoIndex:
+    import cv2
+
+    cap = cv2.VideoCapture(path)
+    try:
+        if not cap.isOpened():
+            raise FileNotFoundError(f"unreadable video {path}")
+        fps = cap.get(cv2.CAP_PROP_FPS) or 0.0
+        count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0)
+    finally:
+        cap.release()
+    if fps <= 0 or count <= 0:
+        raise ValueError(f"video {path} has no usable fps/frame count ({fps}, {count})")
+    ts = t0_ns + (np.arange(count, dtype=np.int64) * round(NS / fps)).astype(np.int64)
+    return VideoIndex(path=path, fps=float(fps), frame_count=count, timestamps_ns=ts)
+
+
+def camera_frame_refs(camera: str, path: str, *, t0_ns: int = 0) -> list:
+    """CameraFrameRef list for a bare mp4 — feed straight to CameraSensor."""
+    from cosmos_curate_tpu.sensors.data import CameraFrameRef
+
+    index = index_video(path, t0_ns=t0_ns)
+    return [
+        CameraFrameRef(
+            camera=camera,
+            video_path=path,
+            frame_index=i,
+            timestamp_s=float(index.timestamps_ns[i]) / NS,
+        )
+        for i in range(index.frame_count)
+    ]

@@ -123,7 +123,10 @@ class AzureRestClient(StorageClient):
         query = {k.lower(): v for k, v in (query or {}).items()}
         scheme, host, url_path = self._url_parts(container, blob)
         headers = dict(headers or {})
-        if data:
+        # empty-body PUT/POST must still send Content-Length: 0 (Azure
+        # returns 411 otherwise); data=None would omit it
+        req_body = data if data or method.upper() in ("PUT", "POST") else None
+        if req_body is not None:
             # urllib injects a default content-type on bodied requests; pin it
             # so the signed and sent values agree.
             headers.setdefault("content-type", "application/octet-stream")
@@ -146,7 +149,7 @@ class AzureRestClient(StorageClient):
         url = f"{scheme}://{host}{url_path}" + (f"?{qs}" if qs else "")
         last: Exception | None = None
         for attempt in range(_RETRIES):
-            req = urllib.request.Request(url, data=data or None, method=method.upper())
+            req = urllib.request.Request(url, data=req_body, method=method.upper())
             for k, v in headers.items():
                 req.add_header(k, v)
             try:
@@ -170,6 +173,10 @@ class AzureRestClient(StorageClient):
     def read_bytes(self, path: str) -> bytes:
         container, blob = _split(path)
         status, body, _ = self._request("GET", container, blob, context=f"get {path}")
+        if status == 404:
+            # match local-disk semantics so callers' missing-file handling
+            # is backend-agnostic
+            raise FileNotFoundError(path)
         if status != 200:
             raise AzureError(status, body.decode(errors="replace"), f"get {path}")
         return body

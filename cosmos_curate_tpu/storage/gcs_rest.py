@@ -67,8 +67,11 @@ class GcsRestClient(StorageClient):
         context: str = "",
     ) -> tuple[int, bytes]:
         last: Exception | None = None
+        # empty-body POST must still send Content-Length: 0 (zero-byte
+        # object upload); data=None would omit it
+        req_body = data if data or method.upper() == "POST" else None
         for attempt in range(_RETRIES):
-            req = urllib.request.Request(url, data=data or None, method=method)
+            req = urllib.request.Request(url, data=req_body, method=method)
             if self._token:
                 req.add_header("authorization", f"Bearer {self._token}")
             if data:
@@ -99,6 +102,10 @@ class GcsRestClient(StorageClient):
         status, body = self._request(
             "GET", self._obj_url(bucket, key, alt="media"), context=f"get {path}"
         )
+        if status == 404:
+            # match local-disk semantics so callers' missing-file handling
+            # is backend-agnostic
+            raise FileNotFoundError(path)
         if status != 200:
             raise GcsError(status, body.decode(errors="replace"), f"get {path}")
         return body

@@ -122,8 +122,11 @@ class S3RestClient(StorageClient):
         qs = urllib.parse.urlencode(sorted(query.items()), quote_via=urllib.parse.quote)
         url = f"{scheme}://{host}{url_path}" + (f"?{qs}" if qs else "")
         last: Exception | None = None
+        # empty-body PUT/POST must still send Content-Length: 0; data=None
+        # would omit it and some endpoints reject the length-less request
+        req_body = data if data or method.upper() in ("PUT", "POST") else None
         for attempt in range(_RETRIES):
-            req = urllib.request.Request(url, data=data or None, method=method.upper())
+            req = urllib.request.Request(url, data=req_body, method=method.upper())
             for k, v in signed.items():
                 if k != "host":
                     req.add_header(k, v)
@@ -148,6 +151,10 @@ class S3RestClient(StorageClient):
     def read_bytes(self, path: str) -> bytes:
         bucket, key = _split(path)
         status, body, _ = self._request("GET", bucket, key, context=f"get {path}")
+        if status == 404:
+            # match local-disk semantics so callers' missing-file handling
+            # is backend-agnostic
+            raise FileNotFoundError(path)
         if status != 200:
             raise S3Error(status, body.decode(errors="replace"), f"get {path}")
         return body

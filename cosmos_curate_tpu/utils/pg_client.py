@@ -54,6 +54,21 @@ class QueryResult:
     command: str
 
 
+def parse_dsn(dsn: str) -> dict:
+    """postgres:// DSN -> PgConnection kwargs (single source of truth for
+    host/port/user/password/database defaults)."""
+    import urllib.parse
+
+    u = urllib.parse.urlparse(dsn)
+    return dict(
+        host=u.hostname or "127.0.0.1",
+        port=u.port or 5432,
+        user=urllib.parse.unquote(u.username or "postgres"),
+        password=urllib.parse.unquote(u.password or ""),
+        database=(u.path or "/postgres").lstrip("/") or "postgres",
+    )
+
+
 class PgConnection:
     def __init__(
         self,

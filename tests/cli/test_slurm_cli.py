@@ -1,0 +1,133 @@
+"""Slurm CLI: sbatch rendering, job-id parsing, prom service discovery, and
+job management commands against stubbed slurm binaries (reference
+client/slurm_cli/slurm.py + prometheus_service_discovery.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.cli.main import main
+from cosmos_curate_tpu.cli.slurm_cli import parse_job_id, write_prometheus_sd
+
+
+def _stub(bin_dir: Path, name: str, script: str) -> None:
+    p = bin_dir / name
+    p.write_text(f"#!/bin/sh\n{script}\n")
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+
+
+@pytest.fixture()
+def slurm_bin(tmp_path, monkeypatch):
+    """Fake sbatch/squeue/scancel on PATH, recording their argv."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    calls = tmp_path / "calls.log"
+    _stub(bin_dir, "sbatch", f'echo "sbatch $@" >> {calls}; echo "Submitted batch job 4242"')
+    _stub(
+        bin_dir,
+        "squeue",
+        f'echo "squeue $@" >> {calls}; echo "JOBID NAME STATE TIME NODES REASON"; '
+        'echo "4242 job RUNNING 1:00 2 none"',
+    )
+    _stub(bin_dir, "scancel", f'echo "scancel $@" >> {calls}')
+    monkeypatch.setenv("PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}")
+    return calls
+
+
+def test_parse_job_id():
+    assert parse_job_id("Submitted batch job 12345\n") == "12345"
+    with pytest.raises(ValueError):
+        parse_job_id("sbatch: error")
+
+
+def test_submit_renders_prom_sd_step(tmp_path):
+    script_path = tmp_path / "job.sbatch"
+    rc = main(
+        [
+            "slurm", "submit",
+            "--nodes", "4",
+            "--prom-sd-file", "/etc/prom/sd/curate.json",
+            "--metrics-port", "9002",
+            "--output", str(script_path),
+            "--", "local", "split", "--input-path", "/in", "--output-path", "/out",
+        ]
+    )
+    assert rc == 0
+    script = script_path.read_text()
+    assert "slurm prom-sd" in script
+    assert "--port 9002" in script
+    assert "CURATE_COORDINATOR_ADDRESS" in script
+    assert "--nodes=4" in script
+
+
+def test_submit_invokes_sbatch_and_prints_job_id(tmp_path, slurm_bin, capsys):
+    script_path = tmp_path / "job.sbatch"
+    rc = main(
+        [
+            "slurm", "submit", "--nodes", "1",
+            "--output", str(script_path), "--submit",
+            "--", "info",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "job-id: 4242" in out
+    assert "sbatch" in slurm_bin.read_text()
+
+
+def test_status_uses_squeue(slurm_bin, capsys):
+    rc = main(["slurm", "status", "--job-id", "4242"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "RUNNING" in out
+    assert "squeue -j 4242" in slurm_bin.read_text()
+
+
+def test_cancel_uses_scancel(slurm_bin, capsys):
+    rc = main(["slurm", "cancel", "--job-id", "4242"])
+    assert rc == 0
+    assert "cancelled 4242" in capsys.readouterr().out
+    assert "scancel 4242" in slurm_bin.read_text()
+
+
+def test_logs_reads_output_file(tmp_path, capsys):
+    log_dir = tmp_path / "slurm_logs"
+    log_dir.mkdir()
+    (log_dir / "cosmos-curate-tpu-7.out").write_text("line1\nline2\n")
+    rc = main(
+        ["slurm", "logs", "--job-id", "7", "--log-dir", str(log_dir), "--lines", "1"]
+    )
+    assert rc == 0
+    assert "line2" in capsys.readouterr().out
+
+
+def test_prom_sd_roundtrip(tmp_path, capsys):
+    hostfile = tmp_path / "nodes"
+    hostfile.write_text("node-a\nnode-b\n\n")
+    sd_path = tmp_path / "sd" / "curate.json"
+    rc = main(
+        [
+            "slurm", "prom-sd",
+            "--path", str(sd_path),
+            "--hostfile", str(hostfile),
+            "--port", "9002",
+            "--job-id", "4242",
+            "--job-name", "curate",
+            "--job-user", "ops",
+        ]
+    )
+    assert rc == 0
+    data = json.loads(sd_path.read_text())
+    assert data[0]["targets"] == ["node-a:9002", "node-b:9002"]
+    assert data[0]["labels"]["slurm_job_id"] == "4242"
+
+
+def test_write_prometheus_sd_skips_empty_hosts(tmp_path):
+    p = tmp_path / "sd.json"
+    write_prometheus_sd(p, ["h1", "", "h2"], port=9100)
+    assert json.loads(p.read_text())[0]["targets"] == ["h1:9100", "h2:9100"]

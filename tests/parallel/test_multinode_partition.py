@@ -124,6 +124,7 @@ def test_slurm_script_carries_partition_contract(tmp_path):
     rc = main(
         [
             "slurm",
+            "submit",
             "--nodes",
             "2",
             "--output",

@@ -1,0 +1,163 @@
+"""AV download plane: concurrent clip prefetch and remote state-db sync
+(reference av/downloaders/download_stages.py:282-446)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.pipelines.av.downloaders import (
+    RemoteSyncedStateDB,
+    is_remote,
+    prefetch_clips,
+)
+
+
+def _write_clip(path, frames=12):
+    import cv2
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), 4.0, (64, 48))
+    for i in range(frames):
+        w.write(np.full((48, 64, 3), i * 20 % 255, np.uint8))
+    w.release()
+
+
+class TestPrefetchClips:
+    def test_yields_all_present_clips(self, tmp_path):
+        for uid in ("c1", "c2", "c3"):
+            _write_clip(tmp_path / "clips" / f"{uid}.mp4")
+        got = dict(
+            prefetch_clips(["c1", "c2", "c3", "missing"], str(tmp_path), workers=2)
+        )
+        assert set(got) == {"c1", "c2", "c3"}
+        assert all(f.shape[0] > 0 and f.shape[-1] == 3 for f in got.values())
+
+    def test_empty_input(self, tmp_path):
+        assert list(prefetch_clips([], str(tmp_path))) == []
+
+    def test_row_objects_and_decode_error_isolation(self, tmp_path):
+        class Row:
+            def __init__(self, uid):
+                self.clip_uuid = uid
+
+        _write_clip(tmp_path / "clips" / "ok.mp4")
+        (tmp_path / "clips" / "corrupt.mp4").write_bytes(b"not a video")
+
+        def decode(data):
+            from cosmos_curate_tpu.video.decode import extract_frames_at_fps
+
+            return extract_frames_at_fps(data, target_fps=2.0, resize_hw=(32, 32))
+
+        got = dict(
+            prefetch_clips(
+                [Row("ok"), Row("corrupt")], str(tmp_path), workers=2, decode=decode
+            )
+        )
+        # corrupt clip is skipped (or decoded to empty), the good one arrives
+        assert "ok" in got
+        assert got["ok"].shape[1:] == (32, 32, 3)
+
+
+class TestRemoteSyncedStateDB:
+    @pytest.fixture()
+    def fake_s3_env(self, monkeypatch):
+        from tests.storage.fake_s3 import TEST_ACCESS_KEY, TEST_SECRET_KEY, FakeS3Server
+
+        with FakeS3Server() as srv:
+            monkeypatch.setenv("AWS_ACCESS_KEY_ID", TEST_ACCESS_KEY)
+            monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", TEST_SECRET_KEY)
+            monkeypatch.setenv("AWS_ENDPOINT_URL", srv.endpoint)
+            yield srv
+
+    def test_round_trip_through_object_storage(self, fake_s3_env, tmp_path):
+        from cosmos_curate_tpu.pipelines.av.state_db import ClipRow, open_state_db
+
+        url = "s3://av/state/session1.sqlite"
+        db = open_state_db(url)
+        assert isinstance(db, RemoteSyncedStateDB)
+        db.upsert_session("s1", 2)
+        db.add_clips(
+            [ClipRow(clip_uuid="c1", session_id="s1", camera="front", span_start=0, span_end=5)]
+        )
+        db.close()
+        # remote object now exists; a second open sees the data
+        db2 = open_state_db(url)
+        assert [r.clip_uuid for r in db2.clips()] == ["c1"]
+        db2.set_clip_state("c1", "captioned")
+        db2.close()
+        db3 = open_state_db(url)
+        assert db3.clips()[0].state == "captioned"
+        db3.close()
+
+    def test_multinode_launch_rejected(self, fake_s3_env, monkeypatch):
+        """Last-writer-wins remote sqlite under a multi-node launch must
+        fail loud, not silently drop rows."""
+        monkeypatch.setenv("CURATE_NUM_NODES", "4")
+        with pytest.raises(RuntimeError, match="single-writer"):
+            RemoteSyncedStateDB("s3://av/state/multi.sqlite")
+        monkeypatch.setenv("CURATE_ALLOW_REMOTE_DB_MULTINODE", "1")
+        db = RemoteSyncedStateDB("s3://av/state/multi.sqlite")
+        db.close()
+
+    def test_close_is_idempotent(self, fake_s3_env):
+        db = RemoteSyncedStateDB("s3://av/state/x.sqlite")
+        db.upsert_session("s", 1)
+        db.close()
+        db.close()  # no double-upload crash
+
+
+def test_is_remote():
+    assert is_remote("s3://b/k") and is_remote("gs://b/k") and is_remote("az://c/b")
+    assert not is_remote("/local/path.sqlite")
+
+
+def test_av_caption_uses_prefetch(tmp_path):
+    """End-to-end: split then caption against a fake engine; captions land
+    for every split clip (prefetch path)."""
+    from cosmos_curate_tpu.pipelines.av.pipeline import (
+        AVPipelineArgs,
+        run_av_caption,
+        run_av_ingest,
+        run_av_split,
+    )
+    from cosmos_curate_tpu.pipelines.av.state_db import open_state_db
+    from tests.fixtures.media import make_scene_video
+
+    vids = tmp_path / "in"
+    vids.mkdir()
+    make_scene_video(vids / "sessA_front.mp4", scene_len_frames=48, num_scenes=2)
+    args = AVPipelineArgs()
+    args.input_path = str(vids)
+    args.output_path = str(tmp_path / "out")
+    args.clip_len_s = 2.0
+    run_av_ingest(args)
+    run_av_split(args)
+
+    class FakeEngine:
+        tokens_per_second = 1.0
+
+        def __init__(self):
+            self.requests = []
+
+        def add_request(self, req):
+            self.requests.append(req)
+
+        def run_until_complete(self):
+            from types import SimpleNamespace
+
+            out = [
+                SimpleNamespace(request_id=r.request_id, text=f"caption for {r.request_id}")
+                for r in self.requests
+            ]
+            self.requests = []
+            return out
+
+    summary = run_av_caption(args, engine=FakeEngine())
+    assert summary["num_captioned"] >= 2
+    db = open_state_db(args.resolved_db)
+    try:
+        caps = {r.clip_uuid: r.caption for r in db.clips()}
+        assert all(c.startswith("caption for") for c in caps.values())
+    finally:
+        db.close()

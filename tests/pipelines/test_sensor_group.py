@@ -1,0 +1,166 @@
+"""SensorGroup / ImageSensor / validation / video index (reference
+core/sensors/sensors/group.py, image_sensor.py, utils/validation.py,
+utils/video.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.sensors.group import GroupFrame, Sensor, SensorGroup
+from cosmos_curate_tpu.sensors.image_sensor import ImageSensor, timestamp_from_name
+from cosmos_curate_tpu.sensors.sampling import NS, SamplingGrid, SamplingPolicy, SamplingSpec
+from cosmos_curate_tpu.sensors.validation import (
+    require_finite,
+    require_nondecreasing,
+    require_strictly_increasing,
+    strictly_increasing_int64,
+)
+
+
+def _write_images(tmp_path, times_ns, size=(24, 32)):
+    import cv2
+
+    paths = []
+    for i, t in enumerate(times_ns):
+        p = tmp_path / f"cam_{t}.png"
+        img = np.full((*size, 3), (i * 40) % 255, np.uint8)
+        cv2.imwrite(str(p), img)
+        paths.append(p)
+    return paths
+
+
+class TestValidation:
+    def test_strictly_increasing_ok_and_violation(self):
+        require_strictly_increasing("ts", np.array([1, 2, 3]))
+        with pytest.raises(ValueError, match="strictly increasing"):
+            require_strictly_increasing("ts", np.array([1, 2, 2]))
+
+    def test_nondecreasing(self):
+        require_nondecreasing("ts", np.array([1, 2, 2]))
+        with pytest.raises(ValueError, match="non-decreasing"):
+            require_nondecreasing("ts", np.array([3, 1]))
+
+    def test_finite(self):
+        require_finite("x", np.array([1.0, 2.0]))
+        with pytest.raises(ValueError, match="non-finite"):
+            require_finite("x", np.array([1.0, np.nan]))
+
+    def test_canonical_constructor(self):
+        arr = strictly_increasing_int64("ts", [1, 5, 9])
+        assert arr.dtype == np.int64
+        with pytest.raises(ValueError):
+            strictly_increasing_int64("ts", [[1, 2]])
+
+
+class TestImageSensor:
+    def test_timestamp_parsing(self, tmp_path):
+        from pathlib import Path
+
+        assert timestamp_from_name(Path("frame_170000.jpg")) == 170000
+        assert timestamp_from_name(Path("170000.png")) == 170000
+        with pytest.raises(ValueError):
+            timestamp_from_name(Path("noindex.jpg"))
+
+    def test_from_dir_sample(self, tmp_path):
+        times = [0, NS, 2 * NS, 3 * NS]
+        _write_images(tmp_path, times)
+        sensor = ImageSensor.from_dir(tmp_path)
+        assert sensor.start_ns == 0 and sensor.end_ns == 3 * NS
+        grid = SamplingGrid.from_rate(0, sample_rate_hz=1.0, end_ns=3 * NS, window_size=2)
+        batches = list(sensor.sample(SamplingSpec(grid=grid)))
+        assert len(batches) == len(grid)
+        total = sum(len(b) for b in batches)
+        assert total == 4  # 1 Hz over [0, 3e9] inclusive-start grid
+        assert batches[0].frames.shape[1:] == (24, 32, 3)
+        assert batches[0].paths[0].endswith("cam_0.png")
+
+    def test_tolerance_drops_uncovered_windows(self, tmp_path):
+        _write_images(tmp_path, [0, 10 * NS])
+        sensor = ImageSensor.from_dir(tmp_path)
+        grid = SamplingGrid.from_rate(0, sample_rate_hz=1.0, end_ns=10 * NS, window_size=4)
+        spec = SamplingSpec(grid=grid, policy=SamplingPolicy(tolerance_ns=NS // 2))
+        batches = list(sensor.sample(spec))
+        # only grid points 0s and 10s have an image within 0.5s
+        assert sum(len(b) for b in batches) == 2
+
+    def test_mismatched_timestamps_raise(self, tmp_path):
+        paths = _write_images(tmp_path, [0, NS])
+        with pytest.raises(ValueError, match="timestamps"):
+            ImageSensor(paths, timestamps_ns=[0])
+
+
+class TestSensorGroup:
+    def test_empty_group_rejected(self):
+        with pytest.raises(ValueError):
+            SensorGroup({})
+
+    def test_lockstep_alignment_with_partial_coverage(self, tmp_path):
+        # sensor A covers [0, 3s]; sensor B only [0, 1s]
+        a_dir = tmp_path / "a"
+        b_dir = tmp_path / "b"
+        a_dir.mkdir(), b_dir.mkdir()
+        _write_images(a_dir, [0, NS, 2 * NS, 3 * NS])
+        _write_images(b_dir, [0, NS])
+        group = SensorGroup(
+            {"a": ImageSensor.from_dir(a_dir), "b": ImageSensor.from_dir(b_dir)}
+        )
+        assert group.start_ns == 0 and group.end_ns == 3 * NS
+        assert isinstance(group.sensors["a"], Sensor)
+        grid = SamplingGrid.from_rate(0, sample_rate_hz=1.0, end_ns=3 * NS, window_size=2)
+        spec = SamplingSpec(grid=grid, policy=SamplingPolicy(tolerance_ns=NS // 4))
+        frames = list(group.sample(spec))
+        assert all(isinstance(f, GroupFrame) for f in frames)
+        # window 0 covers [0s, 2s): both sensors have data
+        assert set(frames[0].sensor_data) == {"a", "b"}
+        # window 1 covers [2s, 3s]: only sensor a
+        assert set(frames[1].sensor_data) == {"a"}
+        np.testing.assert_array_equal(
+            frames[0].align_timestamps_ns, grid.timestamps_ns[:2]
+        )
+
+
+class TestVideoIndex:
+    def test_index_and_refs(self, tmp_path):
+        import cv2
+
+        path = str(tmp_path / "v.mp4")
+        w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (64, 48))
+        for i in range(48):
+            w.write(np.full((48, 64, 3), i * 5 % 255, np.uint8))
+        w.release()
+
+        from cosmos_curate_tpu.sensors.video_index import camera_frame_refs, index_video
+
+        idx = index_video(path, t0_ns=1000)
+        assert idx.frame_count == 48
+        assert idx.fps == pytest.approx(24.0, abs=0.1)
+        assert idx.timestamps_ns[0] == 1000
+        assert len(idx.timestamps_ns) == 48
+        assert idx.duration_s == pytest.approx(2.0, abs=0.05)
+
+        refs = camera_frame_refs("front", path, t0_ns=0)
+        assert refs[0].frame_index == 0 and refs[0].camera == "front"
+        # refs feed CameraSensor directly
+        from cosmos_curate_tpu.sensors.camera_sensor import CameraSensor
+
+        sensor = CameraSensor("front", refs)
+        assert sensor.start_ns == 0
+        grid = SamplingGrid.from_rate(0, sample_rate_hz=4.0, end_ns=sensor.end_ns, window_size=8)
+        batches = list(sensor.sample(SamplingSpec(grid=grid)))
+        assert sum(len(b) for b in batches) == len(grid.timestamps_ns)
+
+    def test_missing_video_raises(self):
+        from cosmos_curate_tpu.sensors.video_index import index_video
+
+        with pytest.raises((FileNotFoundError, ValueError)):
+            index_video("/nope/missing.mp4")
+
+
+def test_camera_benchmark_runs(tmp_path):
+    from benchmarks.camera_sensor_benchmark import run, synthesize_video
+
+    video = str(tmp_path / "b.mp4")
+    synthesize_video(video, frames=48)
+    stats = run(video, rate_hz=4.0, window_size=8)
+    assert stats["frames"] > 0 and stats["frames_per_s"] > 0

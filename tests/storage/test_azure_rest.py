@@ -36,8 +36,15 @@ def test_round_trip(client):
 
 
 def test_read_missing_raises(client):
-    with pytest.raises(AzureError):
+    with pytest.raises(FileNotFoundError):
         client.read_bytes("az://cont/nope")
+
+
+def test_empty_object_write(client):
+    """Zero-byte markers must carry Content-Length: 0 (Azure 411s without)."""
+    client.write_bytes("az://cont/marker", b"")
+    assert client.read_bytes("az://cont/marker") == b""
+    assert client.size("az://cont/marker") == 0
 
 
 def test_ranged_read(client):

@@ -29,7 +29,7 @@ def test_round_trip(client):
 
 
 def test_read_missing_raises(client):
-    with pytest.raises(GcsError):
+    with pytest.raises(FileNotFoundError):
         client.read_bytes("gs://bkt/none")
 
 

@@ -37,7 +37,7 @@ def test_round_trip(client):
 
 
 def test_read_missing_raises(client):
-    with pytest.raises(S3Error):
+    with pytest.raises(FileNotFoundError):
         client.read_bytes("s3://bkt/nope")
 
 
