@@ -99,7 +99,18 @@ def main() -> int:
     expected_clips_per_video = int(NUM_SCENES * SCENE_FRAMES / 24.0 / STRIDE_S)
     from cosmos_curate_tpu.models.batching import next_pow2
 
-    for b in {next_pow2(expected_clips_per_video), next_pow2(max(1, expected_clips_per_video - 1))}:
+    from cosmos_curate_tpu.pipelines.video.stages.embedding import EMBED_STAGE_TASK_BATCH
+
+    # The embed stage batches across tasks, so the run hits pow2 shapes
+    # between one video's clips and a full task-batch's.
+    full = next_pow2(expected_clips_per_video * min(EMBED_STAGE_TASK_BATCH, NUM_VIDEOS))
+    single = next_pow2(expected_clips_per_video)
+    b = single
+    shapes = set()
+    while b <= full:
+        shapes.add(b)
+        b *= 2
+    for b in sorted(shapes):
         warm.encode_clips(
             np.zeros((b, VIDEO_EMBED_BASE.num_frames, 224, 224, 3), np.uint8)
         )

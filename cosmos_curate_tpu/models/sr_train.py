@@ -1,0 +1,165 @@
+"""Synthetic-data training for the super-resolution network.
+
+The reference ships SeedVR2's pretrained diffusion SR
+(cosmos_curate/pipelines/video/super_resolution/); this image has no
+network egress, so a functional (non-random) SR checkpoint comes from
+training our residual SRNet (models/super_resolution.py) on synthesized
+LR→HR pairs: crisp procedural textures (edges, text-like glyphs, gradients,
+checkers) downsampled with the same bilinear kernel the model's residual
+base uses — the net learns exactly the detail the base loses. The trained
+checkpoint is staged through the registry (commit under
+``weights/super-resolution-tpu/``); staging a converted real checkpoint
+under $CURATE_MODEL_WEIGHTS_DIR still wins.
+
+TPU-first: one jitted L1-loss train step (conv-heavy → MXU); synthesis on
+host numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cosmos_curate_tpu.models.super_resolution import SR_BASE, SRConfig, SRNet
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _texture(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
+    """One crisp HR frame [h, w, 3] float32 in [0, 1] with high-frequency
+    content worth recovering."""
+    kind = rng.integers(0, 4)
+    img = np.zeros((h, w, 3), np.float32)
+    if kind == 0:  # random oriented edges
+        img += rng.uniform(0.1, 0.9, 3)
+        for _ in range(6):
+            x0, y0 = rng.integers(0, w), rng.integers(0, h)
+            angle = rng.uniform(0, np.pi)
+            yy, xx = np.mgrid[0:h, 0:w]
+            side = (xx - x0) * np.cos(angle) + (yy - y0) * np.sin(angle) > 0
+            img[side] = rng.uniform(0, 1, 3)
+    elif kind == 1:  # checkerboard at random phase/scale
+        s = int(rng.integers(2, 6))
+        yy, xx = np.mgrid[0:h, 0:w]
+        mask = ((xx // s) + (yy // s)) % 2 == 0
+        a, b = rng.uniform(0, 1, (2, 3))
+        img[mask] = a
+        img[~mask] = b
+    elif kind == 2:  # text-like glyph strokes
+        img += rng.uniform(0.6, 1.0, 3)
+        ink = rng.uniform(0.0, 0.3, 3)
+        for _ in range(10):
+            x0, y0 = rng.integers(0, w - 6), rng.integers(0, h - 6)
+            lw = int(rng.integers(1, 3))
+            if rng.random() < 0.5:
+                img[y0 : y0 + 6, x0 : x0 + lw] = ink
+            else:
+                img[y0 : y0 + lw, x0 : x0 + 6] = ink
+    else:  # smooth gradient + sharp dots
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        img += (xx / w)[..., None] * rng.uniform(0.3, 1.0, 3)
+        for _ in range(12):
+            x0, y0 = rng.integers(1, w - 1), rng.integers(1, h - 1)
+            img[y0, x0] = rng.uniform(0, 1, 3)
+    return np.clip(img, 0.0, 1.0)
+
+
+def synthesize_batch(
+    rng: np.random.Generator, batch: int, hr: int, scale: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(lr_u8 [B, hr/scale, hr/scale, 3], hr_u8 [B, hr, hr, 3])."""
+    import cv2
+
+    lr_size = hr // scale
+    hrs = np.empty((batch, hr, hr, 3), np.uint8)
+    lrs = np.empty((batch, lr_size, lr_size, 3), np.uint8)
+    for i in range(batch):
+        img = _texture(rng, hr, hr)
+        hrs[i] = (img * 255).astype(np.uint8)
+        lrs[i] = (
+            cv2.resize(img, (lr_size, lr_size), interpolation=cv2.INTER_LINEAR) * 255
+        ).astype(np.uint8)
+    return lrs, hrs
+
+
+def train(
+    cfg: SRConfig = SR_BASE,
+    *,
+    steps: int = 500,
+    batch: int = 16,
+    hr_size: int = 64,
+    lr: float = 2e-4,
+    seed: int = 0,
+    log_every: int = 100,
+):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    model = SRNet(cfg)
+    rng = np.random.default_rng(seed)
+    lrs0, _ = synthesize_batch(rng, batch, hr_size, cfg.scale)
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(lrs0[:1]))
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, lr_u8, hr_u8):
+        # vmap over the batch: the model is written per-clip [T, H, W, 3].
+        # float_out: gradients through the uint8 output cast are zero.
+        out = jax.vmap(lambda x: model.apply(p, x[None], float_out=True)[0])(lr_u8)
+        return jnp.abs(out - hr_u8.astype(jnp.float32) / 255.0).mean()
+
+    @jax.jit
+    def step(params, opt_state, lr_u8, hr_u8):
+        loss, grads = jax.value_and_grad(loss_fn)(params, lr_u8, hr_u8)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    loss = None
+    for i in range(steps):
+        lrs, hrs = synthesize_batch(rng, batch, hr_size, cfg.scale)
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(lrs), jnp.asarray(hrs))
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("sr train step %d/%d loss %.5f", i + 1, steps, float(loss))
+    return params, float(loss) if loss is not None else float("nan")
+
+
+def train_and_stage(
+    cfg: SRConfig = SR_BASE,
+    *,
+    model_id: str = "super-resolution-tpu",
+    out_dir: str | None = None,
+    **train_kw,
+):
+    import flax.serialization
+
+    from cosmos_curate_tpu.models import registry
+
+    params, loss = train(cfg, **train_kw)
+    if out_dir is not None:
+        from pathlib import Path
+
+        ckpt = Path(out_dir) / model_id / "params.msgpack"
+        ckpt.parent.mkdir(parents=True, exist_ok=True)
+        ckpt.write_bytes(flax.serialization.to_bytes(params))
+    else:
+        ckpt = registry.save_params(model_id, params)
+    logger.info("staged %s (final loss %.5f) at %s", model_id, loss, ckpt)
+    return ckpt, loss
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Train SRNet on synthetic LR/HR pairs")
+    ap.add_argument("--steps", type=int, default=500)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--hr-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out-dir", default=None, help="e.g. <repo>/weights to commit")
+    a = ap.parse_args()
+    train_and_stage(
+        steps=a.steps, batch=a.batch, hr_size=a.hr_size, lr=a.lr, seed=a.seed,
+        out_dir=a.out_dir,
+    )

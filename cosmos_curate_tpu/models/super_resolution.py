@@ -51,8 +51,11 @@ class SRNet(nn.Module):
     cfg: SRConfig
 
     @nn.compact
-    def __call__(self, frames_u8):
-        """uint8 [T, H, W, 3] -> uint8 [T, H*scale, W*scale, 3]."""
+    def __call__(self, frames_u8, *, float_out: bool = False):
+        """uint8 [T, H, W, 3] -> uint8 [T, H*scale, W*scale, 3].
+
+        ``float_out=True`` returns the pre-quantization float image in
+        [0, 1] — required for training (the uint8 cast has zero gradient)."""
         cfg = self.cfg
         x = frames_u8.astype(jnp.bfloat16) / 255.0
         x = nn.Conv(cfg.channels, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
@@ -67,6 +70,8 @@ class SRNet(nn.Module):
             frames_u8.astype(jnp.float32) / 255.0, (t, h * s, w * s, 3), "bilinear"
         )
         out = jnp.clip(base + x.astype(jnp.float32), 0.0, 1.0)
+        if float_out:
+            return out
         return (out * 255.0).astype(jnp.uint8)
 
 

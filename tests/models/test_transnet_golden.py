@@ -1,0 +1,114 @@
+"""Golden cut-detection test for the trained TransNet checkpoint
+(VERDICT weak #2: shot detection must be validated for correctness, not
+just shapes — reference tests/.../test_fixed_stride_extraction.py is the
+golden-test pattern).
+
+Runs only when a trained checkpoint is staged (the committed
+``weights/transnetv2-tpu/params.msgpack`` or $CURATE_MODEL_WEIGHTS_DIR);
+with random weights the probabilities are noise and the test would be
+meaningless.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.models import registry
+
+
+def _trained_weights_available() -> bool:
+    return registry.find_checkpoint("transnetv2-tpu") is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _trained_weights_available(),
+    reason="no trained transnetv2-tpu checkpoint staged",
+)
+
+
+def _two_scene_frames(t_per_scene: int = 60) -> tuple[np.ndarray, int]:
+    """Synthetic two-scene clip with a hard cut; returns (frames, cut_idx).
+    Scene textures match the training generators' family (solid + moving
+    rectangle) without replicating any specific training sample."""
+    rng = np.random.default_rng(7)
+    h, w = 27, 48
+    scenes = []
+    for base, fg in (((200, 60, 60), (30, 30, 220)), ((40, 180, 90), (240, 240, 240))):
+        frames = np.empty((t_per_scene, h, w, 3), np.uint8)
+        for i in range(t_per_scene):
+            frame = np.full((h, w, 3), base, np.float32)
+            x = (i * 2) % (w - 12)
+            frame[8:20, x : x + 12] = fg
+            frames[i] = np.clip(frame + rng.normal(0, 2, frame.shape), 0, 255)
+        scenes.append(frames)
+    return np.concatenate(scenes), t_per_scene
+
+
+def test_cut_detected_at_scene_boundary():
+    from cosmos_curate_tpu.models.transnetv2 import TransNetV2TPU
+
+    frames, cut = _two_scene_frames()
+    model = TransNetV2TPU()
+    model.setup()
+    probs = model.predict_transitions(frames)
+    assert probs.shape == (len(frames),)
+    # the transition frame must dominate: highest probability within ±2 of
+    # the true cut, and clearly separated from the scene interiors
+    peak = int(np.argmax(probs))
+    assert abs(peak - cut) <= 2, f"peak at {peak}, true cut at {cut}"
+    interior = np.concatenate([probs[5 : cut - 5], probs[cut + 5 : -5]])
+    assert probs[peak] > 0.5, f"peak prob {probs[peak]:.3f} too weak"
+    assert probs[peak] > 5 * interior.max(), (
+        f"cut {probs[peak]:.3f} not separated from interior max {interior.max():.3f}"
+    )
+
+
+def test_no_cut_in_continuous_clip():
+    from cosmos_curate_tpu.models.transnetv2 import TransNetV2TPU
+
+    rng = np.random.default_rng(3)
+    h, w = 27, 48
+    frames = np.empty((80, h, w, 3), np.uint8)
+    for i in range(80):
+        frame = np.full((h, w, 3), (90, 120, 200), np.float32)
+        x = i % (w - 10)
+        frame[10:18, x : x + 10] = (250, 250, 80)
+        frames[i] = np.clip(frame + rng.normal(0, 2, frame.shape), 0, 255)
+    model = TransNetV2TPU()
+    model.setup()
+    probs = model.predict_transitions(frames)
+    assert probs[4:-4].max() < 0.5, f"false cut at prob {probs[4:-4].max():.3f}"
+
+
+def test_stage_extracts_two_clips_from_two_scene_video(tmp_path):
+    """End-to-end through the shot-detection stage: a two-scene video
+    splits at the detected boundary."""
+    import cv2
+
+    from cosmos_curate_tpu.data.model import SplitPipeTask, Video
+    from cosmos_curate_tpu.pipelines.video.stages.shot_detection import (
+        TransNetV2ClipExtractionStage,
+    )
+
+    frames, cut = _two_scene_frames(t_per_scene=48)
+    path = str(tmp_path / "two_scene.mp4")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (192, 108))
+    for f in frames:
+        w.write(cv2.cvtColor(cv2.resize(f, (192, 108), interpolation=cv2.INTER_NEAREST), cv2.COLOR_RGB2BGR))
+    w.release()
+
+    from cosmos_curate_tpu.core.pipeline import run_pipeline
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+
+    task = SplitPipeTask(video=Video(path=path))
+    task.video.raw_bytes = open(path, "rb").read()
+    out = run_pipeline(
+        [task],
+        [TransNetV2ClipExtractionStage(min_clip_len_s=0.5)],
+        runner=SequentialRunner(),
+    )
+    clips = out[0].video.clips
+    assert len(clips) == 2, f"expected 2 scene clips, got {[c.span for c in clips]}"
+    # boundary within 4 frames of the true cut
+    assert abs(clips[0].span[1] - cut / 24.0) < 4 / 24.0
