@@ -226,8 +226,6 @@ def train_recognizer(
 
 
 def train_and_stage(*, out_dir: str | None = None, det_kw=None, rec_kw=None):
-    import flax.serialization
-
     from cosmos_curate_tpu.models import registry
 
     results = {}
@@ -236,14 +234,7 @@ def train_and_stage(*, out_dir: str | None = None, det_kw=None, rec_kw=None):
         ("ocr-recognizer-tpu", train_recognizer, rec_kw or {}),
     ):
         params, loss = trainer(**kw)
-        if out_dir is not None:
-            from pathlib import Path
-
-            ckpt = Path(out_dir) / model_id / "params.msgpack"
-            ckpt.parent.mkdir(parents=True, exist_ok=True)
-            ckpt.write_bytes(flax.serialization.to_bytes(params))
-        else:
-            ckpt = registry.save_params(model_id, params)
+        ckpt = registry.save_params(model_id, params, root=out_dir)
         logger.info("staged %s (final loss %.4f) at %s", model_id, loss, ckpt)
         results[model_id] = (ckpt, loss)
     return results

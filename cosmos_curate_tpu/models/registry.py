@@ -143,11 +143,14 @@ def load_params(
     return init_fn(seed)
 
 
-def save_params(model_id: str, params: Any) -> Path:
-    """Write staged weights into the registry location."""
+def save_params(model_id: str, params: Any, *, root: Path | str | None = None) -> Path:
+    """Write staged weights into the registry location (or under ``root``
+    — e.g. the repo's committed weights/ tree). Single source of truth for
+    the checkpoint layout: trainers must not re-implement it."""
     import flax.serialization
 
-    ckpt = local_dir_for(model_id) / "params.msgpack"
+    base = Path(root) if root is not None else weights_root()
+    ckpt = base / model_id / "params.msgpack"
     ckpt.parent.mkdir(parents=True, exist_ok=True)
     ckpt.write_bytes(flax.serialization.to_bytes(params))
     return ckpt

@@ -131,19 +131,10 @@ def train_and_stage(
     out_dir: str | None = None,
     **train_kw,
 ):
-    import flax.serialization
-
     from cosmos_curate_tpu.models import registry
 
     params, loss = train(cfg, **train_kw)
-    if out_dir is not None:
-        from pathlib import Path
-
-        ckpt = Path(out_dir) / model_id / "params.msgpack"
-        ckpt.parent.mkdir(parents=True, exist_ok=True)
-        ckpt.write_bytes(flax.serialization.to_bytes(params))
-    else:
-        ckpt = registry.save_params(model_id, params)
+    ckpt = registry.save_params(model_id, params, root=out_dir)
     logger.info("staged %s (final loss %.5f) at %s", model_id, loss, ckpt)
     return ckpt, loss
 

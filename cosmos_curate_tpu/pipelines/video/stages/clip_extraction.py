@@ -90,8 +90,15 @@ class ClipTranscodingStage(Stage[SplitPipeTask, SplitPipeTask]):
             return
         src = video.raw_bytes if video.raw_bytes is not None else video.path
         try:
+            from cosmos_curate_tpu.video.decode import get_frame_timestamps
+
+            # same PTS mapping the span producers used (VFR-exact on mp4)
+            ts = get_frame_timestamps(src)
             results = transcode_clips(
-                src, [c.span for c in video.clips], resize_hw=self.resize_hw
+                src,
+                [c.span for c in video.clips],
+                resize_hw=self.resize_hw,
+                timestamps_s=ts if len(ts) else None,
             )
             for clip, (data, codec) in zip(video.clips, results):
                 if not data:

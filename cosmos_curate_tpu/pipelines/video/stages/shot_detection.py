@@ -58,6 +58,11 @@ class TransNetV2ClipExtractionStage(Stage[SplitPipeTask, SplitPipeTask]):
                     video.errors["shot_detection"] = "no frames decoded"
                     continue
                 probs = self._model.predict_transitions(frames)
+                # exact per-frame PTS (mp4 sample tables) keeps spans
+                # correct on VFR sources; None falls back to fps mapping
+                from cosmos_curate_tpu.video.decode import get_frame_timestamps
+
+                ts = get_frame_timestamps(src)
                 spans = scene_spans_from_predictions(
                     probs,
                     fps=video.metadata.fps,
@@ -65,6 +70,7 @@ class TransNetV2ClipExtractionStage(Stage[SplitPipeTask, SplitPipeTask]):
                     min_scene_len_s=self.min_clip_len_s,
                     max_scene_len_s=self.max_clip_len_s,
                     crop_s=self.crop_s,
+                    timestamps_s=ts if len(ts) == len(probs) else None,
                 )
                 video.clips = make_clips(video.path, spans)
                 video.num_total_clips = len(video.clips)

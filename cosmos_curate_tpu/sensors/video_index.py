@@ -31,6 +31,20 @@ class VideoIndex:
 
 
 def index_video(path: str, *, t0_ns: int = 0) -> VideoIndex:
+    # exact per-frame PTS from the container's sample tables when the file
+    # is ISO-BMFF (correct for VFR too); constant-rate fallback otherwise
+    from cosmos_curate_tpu.video.mp4_index import Mp4ParseError, parse_mp4_video_index
+
+    try:
+        idx = parse_mp4_video_index(path)
+    except (Mp4ParseError, OSError):
+        idx = None
+    if idx is not None and idx.frame_count > 0:
+        ts = t0_ns + np.round(idx.pts_s * NS).astype(np.int64)
+        fps = idx.frame_count / idx.duration_s if idx.duration_s > 0 else 0.0
+        return VideoIndex(
+            path=path, fps=float(fps), frame_count=idx.frame_count, timestamps_ns=ts
+        )
     import cv2
 
     cap = cv2.VideoCapture(path)

@@ -170,8 +170,17 @@ def extract_frames_at_fps(
 
 def get_frame_timestamps(source: str | bytes) -> np.ndarray:
     """Per-frame presentation timestamps in seconds (reference
-    ``get_video_timestamps``:230). Constant-rate assumption when the
-    container lacks per-frame PTS."""
+    ``get_video_timestamps``:230, PyAV packet PTS).
+
+    Exact for mp4/mov — the container's sample tables are parsed directly
+    (video/mp4_index.py), correct for VFR too. Other containers fall back
+    to a constant-rate assumption from probed fps."""
+    from cosmos_curate_tpu.video.mp4_index import Mp4ParseError, parse_mp4_video_index
+
+    try:
+        return parse_mp4_video_index(source).pts_s
+    except (Mp4ParseError, OSError):
+        pass
     meta = extract_video_metadata(source)
     if meta.fps <= 0:
         return np.zeros(0, np.float64)

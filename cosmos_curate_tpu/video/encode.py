@@ -143,6 +143,7 @@ def transcode_clips(
     spans_s: list[tuple[float, float]],
     *,
     resize_hw: tuple[int, int] | None = None,
+    timestamps_s=None,
 ) -> list[tuple[bytes, str]]:
     """Cut every span of ``source`` in ONE sequential decode pass.
 
@@ -158,7 +159,25 @@ def transcode_clips(
         return []
     with _open_capture(source) as cap:
         fps = float(cap.get(cv2.CAP_PROP_FPS)) or 24.0
-        clips = [_ClipWriter(int(a * fps), int(b * fps)) for a, b in spans_s]
+        if timestamps_s is not None and len(timestamps_s) > 0:
+            # exact PTS mapping — must mirror the span computation
+            # (splitter.scene_spans_from_predictions with timestamps_s),
+            # or VFR clips cut at the wrong frames
+            import numpy as np
+
+            ts = np.asarray(timestamps_s, np.float64)
+            clips = [
+                _ClipWriter(
+                    int(np.searchsorted(ts, a, side="left")),
+                    max(
+                        int(np.searchsorted(ts, a, side="left")) + 1,
+                        int(np.searchsorted(ts, b, side="left")),
+                    ),
+                )
+                for a, b in spans_s
+            ]
+        else:
+            clips = [_ClipWriter(int(a * fps), int(b * fps)) for a, b in spans_s]
         # sorted view by start frame for an O(1) active set sweep
         pending = sorted(range(len(clips)), key=lambda i: clips[i].start_f)
         active: list[int] = []

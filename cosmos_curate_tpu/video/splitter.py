@@ -45,6 +45,7 @@ def scene_spans_from_predictions(
     min_scene_len_s: float = 2.0,
     max_scene_len_s: float = 60.0,
     crop_s: float = 0.0,
+    timestamps_s: np.ndarray | None = None,
 ) -> list[tuple[float, float]]:
     """Turn per-frame shot-transition probabilities into scene spans.
 
@@ -54,16 +55,28 @@ def scene_spans_from_predictions(
     - ``crop_s`` is trimmed off both ends (transition blur guard).
     Mirrors the reference's post-processing semantics
     (transnetv2_extraction_stages.py:264-365).
+
+    ``timestamps_s`` (per-frame PTS, len == len(predictions)) makes the
+    frame→time mapping exact on variable-frame-rate sources; without it
+    the constant-rate ``fps`` mapping is used.
     """
-    if predictions.size == 0 or fps <= 0:
+    if predictions.size == 0:
+        return []
+    n = int(predictions.size)
+    if timestamps_s is not None and len(timestamps_s) == n:
+        tail = float(np.median(np.diff(timestamps_s))) if n > 1 else 1.0 / max(fps, 1.0)
+        frame_time = np.append(np.asarray(timestamps_s, np.float64), timestamps_s[-1] + tail)
+    elif fps > 0:
+        frame_time = np.arange(n + 1, dtype=np.float64) / fps
+    else:
         return []
     cuts = np.flatnonzero(predictions >= threshold)
-    boundaries = [0, *(int(c) + 1 for c in cuts), int(predictions.size)]
+    boundaries = [0, *(int(c) + 1 for c in cuts), n]
     spans: list[tuple[float, float]] = []
     for a, b in zip(boundaries[:-1], boundaries[1:]):
         if b <= a:
             continue
-        start, end = a / fps + crop_s, b / fps - crop_s
+        start, end = float(frame_time[a]) + crop_s, float(frame_time[b]) - crop_s
         if end - start < min_scene_len_s:
             continue
         while end - start > max_scene_len_s:

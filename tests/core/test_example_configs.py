@@ -1,0 +1,21 @@
+"""The committed example configs must always load against the current args
+schema (they double as schema documentation)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.mark.parametrize(
+    "path", sorted(p.name for p in (REPO / "examples").glob("*.yaml"))
+)
+def test_example_config_loads(path):
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs
+    from cosmos_curate_tpu.utils.config import load_pipeline_config
+
+    args = load_pipeline_config(str(REPO / "examples" / path), SplitPipelineArgs)
+    assert args.output_path

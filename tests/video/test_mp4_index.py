@@ -1,0 +1,169 @@
+"""MP4 sample-table parser: exact per-frame PTS including VFR
+(reference decoder_utils.get_video_timestamps via PyAV packet PTS)."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.video.mp4_index import (
+    Mp4ParseError,
+    parse_mp4_video_index,
+)
+
+
+def _box(btype: bytes, payload: bytes) -> bytes:
+    return struct.pack(">I", 8 + len(payload)) + btype + payload
+
+
+def _full(btype: bytes, version: int, payload: bytes) -> bytes:
+    return _box(btype, bytes([version, 0, 0, 0]) + payload)
+
+
+def _make_mp4(
+    *,
+    timescale: int = 1000,
+    stts: list[tuple[int, int]],
+    ctts: list[tuple[int, int]] | None = None,
+    stss: list[int] | None = None,
+) -> bytes:
+    """Minimal moov-only ISO-BMFF with one video track."""
+    mdhd = _full(
+        b"mdhd",
+        0,
+        struct.pack(">IIIIHH", 0, 0, timescale, 0, 0, 0),
+    )
+    hdlr = _full(b"hdlr", 0, struct.pack(">I", 0) + b"vide" + b"\x00" * 13)
+    stts_payload = struct.pack(">I", len(stts)) + b"".join(
+        struct.pack(">II", c, d) for c, d in stts
+    )
+    stbl_children = _full(b"stts", 0, stts_payload)
+    if ctts is not None:
+        ctts_payload = struct.pack(">I", len(ctts)) + b"".join(
+            struct.pack(">Ii", c, o) for c, o in ctts
+        )
+        stbl_children += _full(b"ctts", 1, ctts_payload)
+    if stss is not None:
+        stss_payload = struct.pack(">I", len(stss)) + b"".join(
+            struct.pack(">I", s) for s in stss
+        )
+        stbl_children += _full(b"stss", 0, stss_payload)
+    stbl = _box(b"stbl", stbl_children)
+    minf = _box(b"minf", stbl)
+    mdia = _box(b"mdia", mdhd + hdlr + minf)
+    trak = _box(b"trak", mdia)
+    moov = _box(b"moov", trak)
+    ftyp = _box(b"ftyp", b"isom\x00\x00\x02\x00isom")
+    return ftyp + moov
+
+
+class TestHandCrafted:
+    def test_cfr(self):
+        idx = parse_mp4_video_index(_make_mp4(stts=[(5, 100)]))
+        assert idx.frame_count == 5
+        np.testing.assert_allclose(idx.pts_s, [0.0, 0.1, 0.2, 0.3, 0.4])
+        assert idx.keyframes.all()
+
+    def test_vfr_exact(self):
+        # 2 frames at 100 ticks, 1 at 250, 2 at 50 — true VFR
+        idx = parse_mp4_video_index(_make_mp4(stts=[(2, 100), (1, 250), (2, 50)]))
+        np.testing.assert_allclose(idx.pts_s, [0.0, 0.1, 0.2, 0.45, 0.5])
+        assert idx.duration_s == pytest.approx(0.6, abs=0.01)
+
+    def test_ctts_reorders_to_presentation_order(self):
+        # B-frame-style: DTS 0,100,200 with offsets making PTS 100,0,200
+        idx = parse_mp4_video_index(
+            _make_mp4(stts=[(3, 100)], ctts=[(1, 100), (1, -100), (1, 0)])
+        )
+        np.testing.assert_allclose(idx.pts_s, [0.0, 0.1, 0.2])
+
+    def test_stss_keyframes(self):
+        idx = parse_mp4_video_index(_make_mp4(stts=[(6, 100)], stss=[1, 4]))
+        np.testing.assert_array_equal(
+            idx.keyframes, [True, False, False, True, False, False]
+        )
+
+    def test_decoder_delay_normalized_to_zero(self):
+        """B-frame mp4s carry a constant ctts decoder-delay; PTS must be
+        anchored at 0 (the elst-compensated presentation time)."""
+        idx = parse_mp4_video_index(
+            _make_mp4(stts=[(3, 100)], ctts=[(3, 200)])
+        )
+        np.testing.assert_allclose(idx.pts_s, [0.0, 0.1, 0.2])
+
+    def test_corrupt_tables_raise_parse_error(self):
+        """Truncated/garbage sample tables must degrade to Mp4ParseError
+        (the callers' fallback trigger), never struct.error/MemoryError."""
+        good = _make_mp4(stts=[(5, 100)])
+        # corrupt the stts entry count to a huge value
+        bad = good.replace(
+            struct.pack(">I", 1) + struct.pack(">II", 5, 100),
+            struct.pack(">I", 0x7FFFFFFF) + struct.pack(">II", 5, 100),
+        )
+        assert bad != good
+        with pytest.raises(Mp4ParseError):
+            parse_mp4_video_index(bad)
+
+    def test_file_path_reads_only_moov(self, tmp_path):
+        """A large mdat before moov must not be slurped into memory."""
+        mp4 = _make_mp4(stts=[(4, 100)])
+        ftyp_end = 8 + len(b"isom\x00\x00\x02\x00isom")
+        big_mdat = _box(b"mdat", b"\x00" * (8 * 1024 * 1024))
+        path = tmp_path / "big.mp4"
+        path.write_bytes(mp4[:ftyp_end] + big_mdat + mp4[ftyp_end:])
+        import tracemalloc
+
+        tracemalloc.start()
+        idx = parse_mp4_video_index(str(path))
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert idx.frame_count == 4
+        assert peak < 4 * 1024 * 1024, f"peak {peak} suggests the mdat was read"
+
+    def test_not_mp4_raises(self):
+        with pytest.raises(Mp4ParseError):
+            parse_mp4_video_index(b"\x1aE\xdf\xa3 webm-ish garbage" * 4)
+
+    def test_no_video_track_raises(self):
+        # moov with a sound track only
+        mdhd = _full(b"mdhd", 0, struct.pack(">IIIIHH", 0, 0, 1000, 0, 0, 0))
+        hdlr = _full(b"hdlr", 0, struct.pack(">I", 0) + b"soun" + b"\x00" * 13)
+        moov = _box(b"moov", _box(b"trak", _box(b"mdia", mdhd + hdlr)))
+        with pytest.raises(Mp4ParseError, match="video track"):
+            parse_mp4_video_index(_box(b"ftyp", b"isom") + moov)
+
+
+class TestRealFile:
+    def test_cv2_written_mp4_matches_metadata(self, tmp_path):
+        import cv2
+
+        path = str(tmp_path / "v.mp4")
+        w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 25.0, (64, 48))
+        for i in range(50):
+            w.write(np.full((48, 64, 3), i * 5 % 255, np.uint8))
+        w.release()
+
+        idx = parse_mp4_video_index(path)
+        assert idx.frame_count == 50
+        deltas = np.diff(idx.pts_s)
+        np.testing.assert_allclose(deltas, 1 / 25.0, rtol=1e-6)
+        assert idx.duration_s == pytest.approx(2.0, abs=0.05)
+
+    def test_get_frame_timestamps_uses_parser_and_falls_back(self, tmp_path):
+        import cv2
+
+        from cosmos_curate_tpu.video.decode import get_frame_timestamps
+
+        path = str(tmp_path / "v.mp4")
+        w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (64, 48))
+        for i in range(24):
+            w.write(np.zeros((48, 64, 3), np.uint8))
+        w.release()
+        ts = get_frame_timestamps(path)
+        assert len(ts) == 24
+        np.testing.assert_allclose(np.diff(ts), 1 / 24.0, rtol=1e-6)
+        # bytes input works too
+        ts2 = get_frame_timestamps(open(path, "rb").read())
+        np.testing.assert_allclose(ts2, ts)

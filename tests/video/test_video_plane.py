@@ -110,6 +110,22 @@ def test_transcode_clip(scene_video):
     assert frames.reshape(meta.num_frames, -1, 3).mean(axis=(0, 1)).argmax() == 1
 
 
+def test_transcode_with_timestamps_maps_spans_exactly(scene_video):
+    """PTS-based span mapping must select the same frames the span
+    producer meant (VFR consistency, review finding)."""
+    from cosmos_curate_tpu.video.decode import decode_frames, get_frame_timestamps
+    from cosmos_curate_tpu.video.encode import transcode_clips
+
+    ts = get_frame_timestamps(scene_video)
+    assert len(ts) > 0
+    # span = frames [12, 36) expressed through their exact PTS
+    span = (float(ts[12]), float(ts[36]))
+    (data, codec), = transcode_clips(scene_video, [span], timestamps_s=ts)
+    assert data
+    frames = decode_frames(data)
+    assert frames.shape[0] == 24
+
+
 def test_transcode_out_of_range_returns_empty(scene_video):
     data, _ = transcode_clip(scene_video, (100.0, 110.0))
     assert data == b""
@@ -143,6 +159,25 @@ class TestSpanMath:
         preds = np.zeros(24 * 100)
         spans = scene_spans_from_predictions(preds, fps=24.0, max_scene_len_s=30.0)
         assert spans == [(0.0, 30.0), (30.0, 60.0), (60.0, 90.0), (90.0, 100.0)]
+
+    def test_scene_spans_vfr_timestamps(self):
+        """Exact PTS mapping: a cut at frame 2 on a VFR source must land at
+        the frame's true time, not the constant-rate estimate."""
+        preds = np.zeros(6)
+        preds[2] = 0.9  # cut after frame index 2
+        # VFR: 0.0, 0.1, 0.2, then slow frames at 0.7, 1.2, 1.7
+        ts = np.array([0.0, 0.1, 0.2, 0.7, 1.2, 1.7])
+        spans = scene_spans_from_predictions(
+            preds, fps=24.0, min_scene_len_s=0.5, timestamps_s=ts
+        )
+        # scene 1 = [0.0, 0.7) (frames 0-2), scene 2 = [0.7, 2.2)
+        assert spans[0] == (0.0, 0.7)
+        assert spans[1][0] == 0.7 and spans[1][1] == pytest.approx(2.2)
+        # mismatched length falls back to fps mapping
+        spans_cfr = scene_spans_from_predictions(
+            preds, fps=24.0, min_scene_len_s=0.01, timestamps_s=ts[:3]
+        )
+        assert spans_cfr[0] == (0.0, 3 / 24.0)
 
     def test_make_clips_deterministic(self):
         a = make_clips("v.mp4", [(0.0, 5.0)])
