@@ -33,17 +33,13 @@ import numpy as np
 from cosmos_curate_tpu.models.batching import next_pow2
 from cosmos_curate_tpu.models.tokenizer import ByteTokenizer, default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, init_cache
+
+# full sampling surface (top_p/min_p/penalties/min_tokens) lives in
+# models/vlm/sampling.py; re-exported here for the existing import paths
+from cosmos_curate_tpu.models.vlm.sampling import SamplingConfig, sample_token
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
-
-
-@dataclass
-class SamplingConfig:
-    max_new_tokens: int = 256
-    temperature: float = 0.0  # 0 = greedy
-    top_k: int = 0
-    seed: int = 0
 
 
 @dataclass
@@ -183,26 +179,11 @@ class CaptionEngine:
             greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
             return greedy, step_logits, ck, cv
 
-        host_rng = np.random.default_rng(seed)
-
-        def sample_host(logits_row: np.ndarray, sampling: SamplingConfig):
-            """Non-greedy sampling, entirely on host (no device round-trips
-            — they were the per-slot-per-token cost this path removes)."""
-            scaled = logits_row.astype(np.float64) / sampling.temperature
-            k = min(sampling.top_k, scaled.shape[-1])  # out-of-range = no filter
-            if 0 < k < scaled.shape[-1]:
-                kth = np.partition(scaled, -k)[-k]
-                scaled = np.where(scaled < kth, -np.inf, scaled)
-            scaled -= scaled.max()
-            probs = np.exp(scaled)
-            probs /= probs.sum()
-            return int(host_rng.choice(len(probs), p=probs))
-
+        self._host_rng = np.random.default_rng(seed)
         self._encode_images = encode_images
         self._embed_tokens = embed_tokens
         self._prefill_batch = prefill_batch
         self._decode = decode_step
-        self._sample_host = sample_host
         self._built = True
 
     # -- public API -----------------------------------------------------
@@ -350,10 +331,13 @@ class CaptionEngine:
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
         for j, (slot_idx, req, _emb, t_valid) in enumerate(items):
-            if req.sampling.temperature <= 0.0:
-                first = int(logits_np[j].argmax())
-            else:
-                first = self._sample_host(logits_np[j], req.sampling)
+            first = sample_token(
+                logits_np[j],
+                req.sampling,
+                generated=[],
+                eos_id=self.tokenizer.eos_id,
+                rng=self._host_rng,
+            )
             slot = _Slot(request=req, position=t_valid, generated=[first])
             self.slots[slot_idx] = slot
             self._maybe_finish(slot_idx, slot)
@@ -371,16 +355,25 @@ class CaptionEngine:
         greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
         self._decode_time += time.monotonic() - t0
         self._decode_tokens += len(self.slots)
-        needs_sampling = any(
-            s.request.sampling.temperature > 0.0 for s in self.slots.values()
+        # the device argmax suffices only for pure-greedy rows with no
+        # penalties and min_tokens already satisfied
+        needs_logits = any(
+            s.request.sampling.needs_logits(len(s.generated))
+            for s in self.slots.values()
         )
-        logits_np = np.asarray(logits) if needs_sampling else None
+        logits_np = np.asarray(logits) if needs_logits else None
         for i in list(self.slots):
             slot = self.slots[i]
-            if slot.request.sampling.temperature <= 0.0:
-                nxt = int(greedy_np[i])
+            if slot.request.sampling.needs_logits(len(slot.generated)):
+                nxt = sample_token(
+                    logits_np[i],
+                    slot.request.sampling,
+                    generated=slot.generated,
+                    eos_id=self.tokenizer.eos_id,
+                    rng=self._host_rng,
+                )
             else:
-                nxt = self._sample_host(logits_np[i], slot.request.sampling)
+                nxt = int(greedy_np[i])
             slot.generated.append(nxt)
             slot.position += 1
             self._maybe_finish(i, slot)

@@ -1,0 +1,130 @@
+"""Full sampling surface (reference VllmSamplingConfig,
+data_model.py:900-931): top_p/min_p nucleus filtering, repetition/presence/
+frequency penalties, min_tokens EOS suppression."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.models.vlm.sampling import (
+    SamplingConfig,
+    apply_penalties,
+    sample_token,
+)
+
+
+def test_greedy_default():
+    logits = np.array([0.1, 2.0, 0.5])
+    assert sample_token(logits, SamplingConfig()) == 1
+
+
+def test_min_tokens_suppresses_eos():
+    logits = np.array([0.0, 0.0, 5.0])  # EOS (id 2) dominates
+    cfg = SamplingConfig(min_tokens=4)
+    assert sample_token(logits, cfg, generated=[7], eos_id=2) != 2
+    # once min_tokens generated, EOS wins again
+    assert sample_token(logits, cfg, generated=[7, 8, 9, 10], eos_id=2) == 2
+
+
+def test_repetition_penalty_discourages_repeats():
+    logits = np.array([1.0, 1.01, 0.0])
+    cfg = SamplingConfig(repetition_penalty=2.0)
+    # token 1 was generated; its logit halves, so 0 wins
+    assert sample_token(logits, cfg, generated=[1]) == 0
+    # negative logits get MORE negative (vLLM semantics)
+    out = apply_penalties(np.array([-1.0, 0.5]), [0], cfg)
+    assert out[0] == -2.0
+
+
+def test_presence_and_frequency_penalties():
+    logits = np.array([2.0, 1.9, 0.0])
+    assert sample_token(logits, SamplingConfig(presence_penalty=0.5), generated=[0]) == 1
+    # frequency scales with occurrence count
+    out = apply_penalties(np.array([3.0, 0.0]), [0, 0, 0], SamplingConfig(frequency_penalty=0.5))
+    assert out[0] == pytest.approx(1.5)
+
+
+def test_top_p_restricts_to_nucleus():
+    # one dominant token (p~0.88); top_p=0.5 keeps only it
+    logits = np.array([5.0, 3.0, 2.0, 1.0])
+    cfg = SamplingConfig(temperature=1.0, top_p=0.5)
+    rng = np.random.default_rng(0)
+    picks = {sample_token(logits, cfg, rng=rng) for _ in range(50)}
+    assert picks == {0}
+
+
+def test_min_p_filters_unlikely_tokens():
+    logits = np.array([5.0, 5.0, -5.0])
+    cfg = SamplingConfig(temperature=1.0, min_p=0.5)
+    rng = np.random.default_rng(0)
+    picks = {sample_token(logits, cfg, rng=rng) for _ in range(50)}
+    assert picks <= {0, 1}
+
+
+def test_top_k_still_works():
+    logits = np.array([5.0, 4.0, -10.0, -10.0])
+    cfg = SamplingConfig(temperature=1.0, top_k=2)
+    rng = np.random.default_rng(0)
+    picks = {sample_token(logits, cfg, rng=rng) for _ in range(50)}
+    assert picks <= {0, 1}
+
+
+def test_penalty_counts_align_after_range_filter():
+    """Out-of-range history ids must not shift occurrence counts
+    (review finding: truncation vs mask)."""
+    out = apply_penalties(
+        np.array([0.0, 0.0, 0.0, 0.0, 0.0, 3.0]),
+        [-1, 5, 5],
+        SamplingConfig(frequency_penalty=0.5),
+    )
+    assert out[5] == pytest.approx(3.0 - 0.5 * 2)
+
+
+def test_top_p_before_min_p_order():
+    """top_p nucleus is computed over the RAW distribution (vLLM order);
+    min_p then filters within it."""
+    # probs ~ [0.4, 0.3, 0.2, 0.1]-ish
+    probs = np.array([0.4, 0.3, 0.2, 0.1])
+    logits = np.log(probs)
+    cfg = SamplingConfig(temperature=1.0, top_p=0.69, min_p=0.0)
+    rng = np.random.default_rng(0)
+    picks = {sample_token(logits, cfg, rng=rng) for _ in range(80)}
+    assert picks == {0, 1}  # nucleus over raw probs keeps two tokens
+
+
+def test_fallback_rng_advances_between_calls():
+    logits = np.log(np.array([0.5, 0.5]))
+    cfg = SamplingConfig(temperature=1.0, seed=123)
+    picks = [sample_token(logits, cfg) for _ in range(32)]
+    assert len(set(picks)) == 2  # a fresh rng per call would repeat one draw
+
+
+def test_needs_logits_gating():
+    assert not SamplingConfig().needs_logits(0)
+    assert SamplingConfig(min_tokens=3).needs_logits(2)
+    assert not SamplingConfig(min_tokens=3).needs_logits(3)
+    assert SamplingConfig(repetition_penalty=1.3).needs_logits(100)
+    assert SamplingConfig(temperature=0.7).needs_host_sampling
+
+
+def test_engine_honors_min_tokens():
+    """Engine-level: a request with min_tokens must emit at least that many
+    tokens even if the tiny random model wants EOS immediately."""
+    from cosmos_curate_tpu.models.vlm import (
+        VLM_TINY_TEST,
+        CaptionEngine,
+        CaptionRequest,
+    )
+
+    engine = CaptionEngine(VLM_TINY_TEST, max_batch=2)
+    engine.setup()
+    engine.add_request(
+        CaptionRequest(
+            request_id="r1",
+            prompt_ids=[1, 2, 3],
+            sampling=SamplingConfig(max_new_tokens=12, min_tokens=6),
+        )
+    )
+    (res,) = engine.run_until_complete()
+    assert res.num_output_tokens >= 6
