@@ -62,6 +62,9 @@ class _Slot:
     request: CaptionRequest
     position: int  # next cache position to write (== current length)
     generated: list[int] = field(default_factory=list)
+    # per-request generator when sampling.seed is set (reproducible
+    # captions regardless of batch interleaving); None = engine-shared rng
+    rng: np.random.Generator | None = None
 
 
 @dataclass
@@ -331,14 +334,19 @@ class CaptionEngine:
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
         for j, (slot_idx, req, _emb, t_valid) in enumerate(items):
+            rng = (
+                np.random.default_rng(req.sampling.seed) if req.sampling.seed else None
+            )
             first = sample_token(
                 logits_np[j],
                 req.sampling,
-                generated=[],
+                # penalty history covers prompt tokens too (vLLM semantics)
+                generated=list(req.prompt_ids),
+                num_generated=0,
                 eos_id=self.tokenizer.eos_id,
-                rng=self._host_rng,
+                rng=rng if rng is not None else self._host_rng,
             )
-            slot = _Slot(request=req, position=t_valid, generated=[first])
+            slot = _Slot(request=req, position=t_valid, generated=[first], rng=rng)
             self.slots[slot_idx] = slot
             self._maybe_finish(slot_idx, slot)
 
@@ -368,9 +376,12 @@ class CaptionEngine:
                 nxt = sample_token(
                     logits_np[i],
                     slot.request.sampling,
-                    generated=slot.generated,
+                    # penalty history covers prompt tokens too (vLLM
+                    # semantics); min_tokens counts only the output
+                    generated=list(slot.request.prompt_ids) + slot.generated,
+                    num_generated=len(slot.generated),
                     eos_id=self.tokenizer.eos_id,
-                    rng=self._host_rng,
+                    rng=slot.rng if slot.rng is not None else self._host_rng,
                 )
             else:
                 nxt = int(greedy_np[i])
@@ -385,11 +396,41 @@ class CaptionEngine:
             or len(slot.generated) >= req.sampling.max_new_tokens
             or slot.position + 1 >= self.cfg.max_seq
         )
+        stop_text: str | None = None
+        if not done and req.sampling.stop:
+            # stop strings match on decoded text (vLLM `stop`); the match
+            # and everything after it is dropped. Hot path decodes only a
+            # tail window (decode is per-token byte concatenation, so the
+            # window is byte-exact); the full decode runs once, on a hit.
+            longest = max(len(s) for s in req.sampling.stop)
+            # 4 bytes/char worst case; each token decodes to >= 1 byte
+            window = min(len(slot.generated), 4 * longest + 8)
+            tail = self.tokenizer.decode(
+                [t for t in slot.generated[-window:] if t != self.tokenizer.eos_id]
+            )
+            if any(s in tail for s in req.sampling.stop):
+                full = self.tokenizer.decode(
+                    [t for t in slot.generated if t != self.tokenizer.eos_id]
+                )
+                idx = min(
+                    (i for i in (full.find(s) for s in req.sampling.stop) if i >= 0),
+                    default=-1,
+                )
+                if idx >= 0:
+                    stop_text = full[:idx]
+                    done = True
         if not done:
             return
         del self.slots[slot_idx]
         out_ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
-        text = self.tokenizer.decode(out_ids)
+        text = stop_text if stop_text is not None else self.tokenizer.decode(out_ids)
+        if stop_text is None and req.sampling.stop:
+            # a stop string may land in the same step that hit eos/max
+            for s in req.sampling.stop:
+                idx = text.find(s)
+                if idx >= 0:
+                    text = text[:idx]
+                    break
         result = CaptionResult(
             request_id=req.request_id,
             text=text,

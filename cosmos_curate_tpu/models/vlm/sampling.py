@@ -26,6 +26,9 @@ class SamplingConfig:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     min_tokens: int = 0  # suppress EOS until this many tokens generated
+    # generation stops when any of these strings appears in the decoded
+    # text; the match and everything after it is dropped (vLLM `stop`)
+    stop: tuple[str, ...] = ()
     seed: int = 0
 
     @property
@@ -77,16 +80,23 @@ def sample_token(
     cfg: SamplingConfig,
     *,
     generated: list[int] | None = None,
+    num_generated: int | None = None,
     eos_id: int | None = None,
     rng: np.random.Generator | None = None,
 ) -> int:
     """One token from one logits row under the full sampling config.
 
-    ``eos_id`` is masked out while ``len(generated) < min_tokens``.
-    Greedy (temperature<=0) still applies penalties and the EOS mask."""
+    ``generated`` is the penalty history — vLLM's repetition penalty covers
+    prompt AND output tokens, so callers pass both. ``num_generated`` is the
+    OUTPUT-token count governing min_tokens (defaults to len(generated) for
+    standalone use). ``eos_id`` is masked out while num_generated <
+    min_tokens. Greedy (temperature<=0) still applies penalties and the
+    EOS mask."""
     generated = generated or []
+    if num_generated is None:
+        num_generated = len(generated)
     logits = apply_penalties(np.asarray(logits_row), generated, cfg)
-    if eos_id is not None and len(generated) < cfg.min_tokens:
+    if eos_id is not None and num_generated < cfg.min_tokens:
         logits = logits.astype(np.float64).copy()
         logits[eos_id] = -np.inf
     if cfg.temperature <= 0.0:

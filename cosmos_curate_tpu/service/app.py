@@ -74,22 +74,52 @@ class ServiceState:
         return None
 
 
-def _runner_code(pipeline: str, args: dict, summary_path: str) -> str:
-    """Child-process program: run the pipeline, write summary.json."""
-    payload = json.dumps({"pipeline": pipeline, "args": args, "summary": summary_path})
+def _runner_code(
+    pipeline: str,
+    args: dict,
+    summary_path: str,
+    work_dir: str = "",
+    input_zip_url: str = "",
+    output_zip_url: str = "",
+) -> str:
+    """Child-process program: optional presigned-zip ingest (reference
+    nvcf_main.py handle_presigned_urls — credential-less I/O: inputs arrive
+    as a GET-able zip, results leave as a PUT-able zip), run the pipeline,
+    write summary.json, optional zip+upload of the output directory."""
+    payload = json.dumps(
+        {
+            "pipeline": pipeline,
+            "args": args,
+            "summary": summary_path,
+            "work_dir": work_dir,
+            "input_zip_url": input_zip_url,
+            "output_zip_url": output_zip_url,
+        }
+    )
     return (
         "import json, sys\n"
         f"spec = json.loads({payload!r})\n"
+        "args = spec['args']\n"
+        "if spec['input_zip_url']:\n"
+        "    from cosmos_curate_tpu.storage.zip_transport import download_and_extract\n"
+        "    inp = spec['work_dir'] + '/input'\n"
+        "    download_and_extract(spec['input_zip_url'], inp)\n"
+        "    args['input_path'] = inp\n"
+        "if spec['output_zip_url'] and not args.get('output_path'):\n"
+        "    args['output_path'] = spec['work_dir'] + '/output'\n"
         "from cosmos_curate_tpu.pipelines.video import split as split_mod\n"
         "from cosmos_curate_tpu.pipelines.video import dedup as dedup_mod\n"
         "from cosmos_curate_tpu.pipelines.video import shard as shard_mod\n"
         "if spec['pipeline'] == 'split':\n"
-        "    s = split_mod.run_split(split_mod.SplitPipelineArgs(**spec['args']))\n"
+        "    s = split_mod.run_split(split_mod.SplitPipelineArgs(**args))\n"
         "elif spec['pipeline'] == 'dedup':\n"
-        "    s = dedup_mod.run_dedup(dedup_mod.DedupPipelineArgs(**spec['args']))\n"
+        "    s = dedup_mod.run_dedup(dedup_mod.DedupPipelineArgs(**args))\n"
         "else:\n"
-        "    s = shard_mod.run_shard(shard_mod.ShardPipelineArgs(**spec['args']))\n"
+        "    s = shard_mod.run_shard(shard_mod.ShardPipelineArgs(**args))\n"
         "json.dump(s, open(spec['summary'], 'w'))\n"
+        "if spec['output_zip_url']:\n"
+        "    from cosmos_curate_tpu.storage.zip_transport import zip_and_upload_directory\n"
+        "    zip_and_upload_directory(args['output_path'], spec['output_zip_url'])\n"
     )
 
 
@@ -136,6 +166,17 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
                 {"error": "a pipeline is already running", "active_job": state.active_job().job_id},
                 status=409,
             )
+        input_zip_url = body.get("input_zip_url", "")
+        output_zip_url = body.get("output_zip_url", "")
+        if not isinstance(input_zip_url, str) or not isinstance(output_zip_url, str):
+            return web.json_response({"error": "zip urls must be strings"}, status=400)
+        if output_zip_url and "://" in str(args.get("output_path", "")):
+            # zipping a remote output root would silently upload an empty
+            # archive — the zip leaves from a local directory
+            return web.json_response(
+                {"error": "output_zip_url requires a local output_path (or none)"},
+                status=400,
+            )
         job_id = uuid.uuid4().hex[:12]
         work_dir = state.work_root / job_id
         work_dir.mkdir(parents=True)
@@ -143,7 +184,18 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
         log_f = open(job.log_path, "wb")
         try:
             job.proc = subprocess.Popen(
-                [sys.executable, "-c", _runner_code(pipeline, args, str(job.summary_path))],
+                [
+                    sys.executable,
+                    "-c",
+                    _runner_code(
+                        pipeline,
+                        args,
+                        str(job.summary_path),
+                        work_dir=str(work_dir),
+                        input_zip_url=input_zip_url,
+                        output_zip_url=output_zip_url,
+                    ),
+                ],
                 stdout=log_f,
                 stderr=subprocess.STDOUT,
                 cwd=str(Path(__file__).resolve().parents[2]),

@@ -108,6 +108,91 @@ def test_needs_logits_gating():
     assert SamplingConfig(temperature=0.7).needs_host_sampling
 
 
+def test_repetition_penalty_covers_prompt_history():
+    """vLLM semantics: the penalty history includes prompt tokens, so a
+    token present only in the prompt is still penalized."""
+    logits = np.array([1.0, 1.01, 0.0])
+    cfg = SamplingConfig(repetition_penalty=2.0)
+    # token 1 appears in the (prompt) history, zero output tokens so far
+    assert sample_token(logits, cfg, generated=[1], num_generated=0) == 0
+    # min_tokens keys off num_generated, not history length
+    cfg2 = SamplingConfig(min_tokens=2)
+    out = sample_token(
+        np.array([0.0, 0.0, 9.0]), cfg2, generated=[5, 6, 7], num_generated=0, eos_id=2
+    )
+    assert out != 2
+
+
+def test_engine_per_request_seed_reproducible():
+    """sampling.seed pins a request's draws regardless of what else is in
+    the batch."""
+    from cosmos_curate_tpu.models.vlm import (
+        VLM_TINY_TEST,
+        CaptionEngine,
+        CaptionRequest,
+    )
+
+    def run(extra_riders: int) -> str:
+        engine = CaptionEngine(VLM_TINY_TEST, max_batch=4)
+        engine.setup()
+        for j in range(extra_riders):
+            engine.add_request(
+                CaptionRequest(
+                    request_id=f"rider{j}",
+                    prompt_ids=[9, 8, 7],
+                    sampling=SamplingConfig(max_new_tokens=6, temperature=1.0),
+                )
+            )
+        engine.add_request(
+            CaptionRequest(
+                request_id="pinned",
+                prompt_ids=[1, 2, 3],
+                sampling=SamplingConfig(max_new_tokens=8, temperature=1.0, seed=42),
+            )
+        )
+        results = {r.request_id: r for r in engine.run_until_complete()}
+        return results["pinned"].text
+
+    assert run(0) == run(2)
+
+
+def test_engine_stop_sequences_truncate():
+    """A stop string ends generation early and is dropped from the text
+    (vLLM `stop` semantics)."""
+    from cosmos_curate_tpu.models.vlm import (
+        VLM_TINY_TEST,
+        CaptionEngine,
+        CaptionRequest,
+    )
+
+    engine = CaptionEngine(VLM_TINY_TEST, max_batch=2)
+    engine.setup()
+    # derive a stop string the tiny random model will actually emit: take
+    # the first few chars of an unconstrained rollout
+    engine.add_request(
+        CaptionRequest(
+            request_id="probe",
+            prompt_ids=[1, 2, 3],
+            sampling=SamplingConfig(max_new_tokens=24),
+        )
+    )
+    (probe,) = engine.run_until_complete()
+    if len(probe.text) < 4:
+        pytest.skip("tiny model emitted too little text to derive a stop")
+    stop = probe.text[2:4]
+    engine.add_request(
+        CaptionRequest(
+            request_id="stopped",
+            prompt_ids=[1, 2, 3],
+            sampling=SamplingConfig(max_new_tokens=24, stop=(stop,)),
+        )
+    )
+    (res,) = engine.run_until_complete()
+    assert stop not in res.text
+    assert len(res.text) <= len(probe.text)
+    assert res.num_output_tokens <= probe.num_output_tokens
+
+
 def test_engine_honors_min_tokens():
     """Engine-level: a request with min_tokens must emit at least that many
     tokens even if the tiny random model wants EOS immediately."""

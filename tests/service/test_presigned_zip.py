@@ -1,0 +1,125 @@
+"""Presigned-zip job I/O (reference core/cf/nvcf_main.py
+handle_presigned_urls + presigned_s3_zip.py): inputs arrive as a GET-able
+zip, results leave as a PUT-able zip — no storage credentials on either
+side."""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tests.fixtures.media import make_scene_video
+from tests.service.test_service import _req, client  # noqa: F401  (fixture)
+
+
+class _ZipHost:
+    """Serves one zip on GET /input.zip; stores PUT /output.zip bodies."""
+
+    def __init__(self, zip_bytes: bytes) -> None:
+        self.zip_bytes = zip_bytes
+        self.uploaded: bytes | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("content-length", str(len(outer.zip_bytes)))
+                self.end_headers()
+                self.wfile.write(outer.zip_bytes)
+
+            def do_PUT(self):
+                length = int(self.headers.get("content-length", 0))
+                outer.uploaded = self.rfile.read(length)
+                self.send_response(200)
+                self.send_header("content-length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def base(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def test_presigned_zip_round_trip(client, tmp_path):  # noqa: F811
+    # build the input zip: one small video
+    vids = tmp_path / "zin"
+    vids.mkdir()
+    make_scene_video(vids / "v.mp4", scene_len_frames=24, num_scenes=1)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.write(vids / "v.mp4", "v.mp4")
+
+    with _ZipHost(buf.getvalue()) as host:
+        status, body = _req(
+            client,
+            "POST",
+            "/v1/invoke",
+            json={
+                "pipeline": "split",
+                "args": {"fixed_stride_len_s": 1.0, "min_clip_len_s": 0.5},
+                "input_zip_url": f"{host.base}/input.zip?sig=presigned",
+                "output_zip_url": f"{host.base}/output.zip?sig=presigned",
+            },
+        )
+        assert status == 200, body
+        job_id = body["job_id"]
+
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            status, body = _req(client, "GET", f"/v1/progress/{job_id}")
+            if body["state"] in ("done", "failed"):
+                break
+            time.sleep(1.0)
+        assert body["state"] == "done", _req(client, "GET", f"/v1/logs/{job_id}")
+
+        assert host.uploaded, "no output zip was PUT back"
+        with zipfile.ZipFile(io.BytesIO(host.uploaded)) as z:
+            names = z.namelist()
+        assert any(n.startswith("clips/") and n.endswith(".mp4") for n in names), names
+        assert any(n == "summary.json" or n.endswith("/summary.json") for n in names), names
+
+
+def test_remote_output_path_with_zip_url_rejected(client):  # noqa: F811
+    """Zipping a remote output root would upload an empty archive; the
+    service must refuse up front (review finding)."""
+    status, body = _req(
+        client,
+        "POST",
+        "/v1/invoke",
+        json={
+            "pipeline": "split",
+            "args": {"output_path": "s3://bucket/out"},
+            "output_zip_url": "http://example.invalid/out.zip",
+        },
+    )
+    assert status == 400
+    assert "local output_path" in body["error"]
+
+
+def test_invalid_zip_url_type_rejected(client):  # noqa: F811
+    status, body = _req(
+        client,
+        "POST",
+        "/v1/invoke",
+        json={"pipeline": "split", "args": {}, "input_zip_url": 42},
+    )
+    assert status == 400
