@@ -32,9 +32,11 @@ class ByteTokenizer:
         ids = list(text.encode("utf-8"))
         return ([self.BOS] if add_bos else []) + ids
 
+    def decode_bytes(self, ids: list[int]) -> bytes:
+        return bytes(i for i in ids if i < 256)
+
     def decode(self, ids: list[int]) -> str:
-        data = bytes(i for i in ids if i < 256)
-        return data.decode("utf-8", errors="replace")
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
 
     @property
     def eos_id(self) -> int:
@@ -111,13 +113,15 @@ class BPETokenizer:
             out.extend(self._apply_merges(list(piece.encode("utf-8"))))
         return out
 
-    def decode(self, ids: list[int]) -> str:
-        data = b"".join(
+    def decode_bytes(self, ids: list[int]) -> bytes:
+        return b"".join(
             self._token_bytes[i] for i in ids if i < len(self._token_bytes) and i not in (
                 self.PAD, self.BOS, self.EOS, self.IMAGE
             )
         )
-        return data.decode("utf-8", errors="replace")
+
+    def decode(self, ids: list[int]) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
 
     @property
     def eos_id(self) -> int:

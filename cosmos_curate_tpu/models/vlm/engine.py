@@ -65,6 +65,19 @@ class _Slot:
     # per-request generator when sampling.seed is set (reproducible
     # captions regardless of batch interleaving); None = engine-shared rng
     rng: np.random.Generator | None = None
+    # incrementally decoded output bytes (exact: decode is per-token byte
+    # concatenation) — stop-string checks scan a bounded tail of this
+    raw: bytearray = field(default_factory=bytearray)
+    # prompt+output token counts maintained incrementally for penalties
+    # (None when no penalty is configured)
+    penalty_counts: dict[int, int] | None = None
+
+
+def _truncate_at_stop(text: str, stops: tuple[str, ...]) -> str | None:
+    """Text before the EARLIEST stop-string match (tuple order must not
+    matter), or None when nothing matches."""
+    idx = min((i for i in (text.find(s) for s in stops) if i >= 0), default=-1)
+    return text[:idx] if idx >= 0 else None
 
 
 @dataclass
@@ -334,19 +347,43 @@ class CaptionEngine:
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
         for j, (slot_idx, req, _emb, t_valid) in enumerate(items):
+            # seed=None is the unseeded sentinel; any int (incl. 0) pins
             rng = (
-                np.random.default_rng(req.sampling.seed) if req.sampling.seed else None
+                np.random.default_rng(req.sampling.seed)
+                if req.sampling.seed is not None
+                else None
             )
+            counts: dict[int, int] | None = None
+            s = req.sampling
+            if (
+                s.repetition_penalty != 1.0
+                or s.presence_penalty != 0.0
+                or s.frequency_penalty != 0.0
+            ):
+                # penalty history covers prompt tokens too (vLLM
+                # semantics); maintained incrementally from here on
+                counts = {}
+                for t in req.prompt_ids:
+                    counts[t] = counts.get(t, 0) + 1
             first = sample_token(
                 logits_np[j],
                 req.sampling,
-                # penalty history covers prompt tokens too (vLLM semantics)
-                generated=list(req.prompt_ids),
+                generated=counts,
                 num_generated=0,
                 eos_id=self.tokenizer.eos_id,
                 rng=rng if rng is not None else self._host_rng,
             )
-            slot = _Slot(request=req, position=t_valid, generated=[first], rng=rng)
+            slot = _Slot(
+                request=req,
+                position=t_valid,
+                generated=[first],
+                rng=rng,
+                penalty_counts=counts,
+            )
+            if counts is not None:
+                counts[first] = counts.get(first, 0) + 1
+            if req.sampling.stop:
+                slot.raw += self.tokenizer.decode_bytes([first])
             self.slots[slot_idx] = slot
             self._maybe_finish(slot_idx, slot)
 
@@ -376,9 +413,9 @@ class CaptionEngine:
                 nxt = sample_token(
                     logits_np[i],
                     slot.request.sampling,
-                    # penalty history covers prompt tokens too (vLLM
-                    # semantics); min_tokens counts only the output
-                    generated=list(slot.request.prompt_ids) + slot.generated,
+                    # incrementally maintained prompt+output counts; the
+                    # decode loop must not re-unique the history per token
+                    generated=slot.penalty_counts,
                     num_generated=len(slot.generated),
                     eos_id=self.tokenizer.eos_id,
                     rng=slot.rng if slot.rng is not None else self._host_rng,
@@ -386,6 +423,10 @@ class CaptionEngine:
             else:
                 nxt = int(greedy_np[i])
             slot.generated.append(nxt)
+            if slot.penalty_counts is not None:
+                slot.penalty_counts[nxt] = slot.penalty_counts.get(nxt, 0) + 1
+            if slot.request.sampling.stop:
+                slot.raw += self.tokenizer.decode_bytes([nxt])
             slot.position += 1
             self._maybe_finish(i, slot)
 
@@ -399,26 +440,17 @@ class CaptionEngine:
         stop_text: str | None = None
         if not done and req.sampling.stop:
             # stop strings match on decoded text (vLLM `stop`); the match
-            # and everything after it is dropped. Hot path decodes only a
-            # tail window (decode is per-token byte concatenation, so the
-            # window is byte-exact); the full decode runs once, on a hit.
+            # and everything after it is dropped. The hot path scans only a
+            # bounded tail of the incrementally maintained byte buffer
+            # (slot.raw — exact regardless of zero-byte special tokens);
+            # the full decode runs once, on a hit.
             longest = max(len(s) for s in req.sampling.stop)
-            # 4 bytes/char worst case; each token decodes to >= 1 byte
-            window = min(len(slot.generated), 4 * longest + 8)
-            tail = self.tokenizer.decode(
-                [t for t in slot.generated[-window:] if t != self.tokenizer.eos_id]
-            )
+            tail = bytes(slot.raw[-(4 * longest + 8) :]).decode("utf-8", errors="replace")
             if any(s in tail for s in req.sampling.stop):
-                full = self.tokenizer.decode(
-                    [t for t in slot.generated if t != self.tokenizer.eos_id]
+                stop_text = _truncate_at_stop(
+                    bytes(slot.raw).decode("utf-8", errors="replace"), req.sampling.stop
                 )
-                idx = min(
-                    (i for i in (full.find(s) for s in req.sampling.stop) if i >= 0),
-                    default=-1,
-                )
-                if idx >= 0:
-                    stop_text = full[:idx]
-                    done = True
+                done = stop_text is not None
         if not done:
             return
         del self.slots[slot_idx]
@@ -426,11 +458,9 @@ class CaptionEngine:
         text = stop_text if stop_text is not None else self.tokenizer.decode(out_ids)
         if stop_text is None and req.sampling.stop:
             # a stop string may land in the same step that hit eos/max
-            for s in req.sampling.stop:
-                idx = text.find(s)
-                if idx >= 0:
-                    text = text[:idx]
-                    break
+            truncated = _truncate_at_stop(text, req.sampling.stop)
+            if truncated is not None:
+                text = truncated
         result = CaptionResult(
             request_id=req.request_id,
             text=text,

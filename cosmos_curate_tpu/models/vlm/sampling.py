@@ -29,7 +29,9 @@ class SamplingConfig:
     # generation stops when any of these strings appears in the decoded
     # text; the match and everything after it is dropped (vLLM `stop`)
     stop: tuple[str, ...] = ()
-    seed: int = 0
+    # None = unseeded (engine-shared rng); any int — including 0 — pins
+    # this request's draws to a dedicated generator
+    seed: int | None = None
 
     @property
     def needs_host_sampling(self) -> bool:
@@ -46,12 +48,18 @@ class SamplingConfig:
 
 
 def apply_penalties(
-    logits: np.ndarray, generated: list[int], cfg: SamplingConfig
+    logits: np.ndarray,
+    generated: list[int] | dict[int, int],
+    cfg: SamplingConfig,
 ) -> np.ndarray:
     """Repetition / presence / frequency penalties over generated history
     (vLLM semantics: repetition divides positive logits and multiplies
     negative ones; presence subtracts once per seen token; frequency
-    subtracts per occurrence)."""
+    subtracts per occurrence).
+
+    ``generated`` may be a token list or a precomputed ``{token: count}``
+    map — hot loops maintain the map incrementally instead of re-uniquing
+    the full prompt+output history every token."""
     if not generated or (
         cfg.repetition_penalty == 1.0
         and cfg.presence_penalty == 0.0
@@ -59,7 +67,11 @@ def apply_penalties(
     ):
         return logits
     logits = logits.astype(np.float64).copy()
-    seen, counts = np.unique(np.asarray(generated, np.int64), return_counts=True)
+    if isinstance(generated, dict):
+        seen = np.fromiter(generated.keys(), np.int64, len(generated))
+        counts = np.fromiter(generated.values(), np.int64, len(generated))
+    else:
+        seen, counts = np.unique(np.asarray(generated, np.int64), return_counts=True)
     in_range = (seen >= 0) & (seen < logits.shape[-1])
     seen = seen[in_range]
     counts = counts[in_range]
@@ -79,22 +91,24 @@ def sample_token(
     logits_row: np.ndarray,
     cfg: SamplingConfig,
     *,
-    generated: list[int] | None = None,
+    generated: list[int] | dict[int, int] | None = None,
     num_generated: int | None = None,
     eos_id: int | None = None,
     rng: np.random.Generator | None = None,
 ) -> int:
     """One token from one logits row under the full sampling config.
 
-    ``generated`` is the penalty history — vLLM's repetition penalty covers
-    prompt AND output tokens, so callers pass both. ``num_generated`` is the
-    OUTPUT-token count governing min_tokens (defaults to len(generated) for
-    standalone use). ``eos_id`` is masked out while num_generated <
-    min_tokens. Greedy (temperature<=0) still applies penalties and the
-    EOS mask."""
+    ``generated`` is the penalty history (list or ``{token: count}`` map) —
+    vLLM's repetition penalty covers prompt AND output tokens, so callers
+    pass both. ``num_generated`` is the OUTPUT-token count governing
+    min_tokens (defaults to len(generated) for standalone list use).
+    ``eos_id`` is masked out while num_generated < min_tokens. Greedy
+    (temperature<=0) still applies penalties and the EOS mask."""
     generated = generated or []
     if num_generated is None:
-        num_generated = len(generated)
+        num_generated = (
+            int(sum(generated.values())) if isinstance(generated, dict) else len(generated)
+        )
     logits = apply_penalties(np.asarray(logits_row), generated, cfg)
     if eos_id is not None and num_generated < cfg.min_tokens:
         logits = logits.astype(np.float64).copy()

@@ -1,0 +1,36 @@
+"""Benchmark-harness metric computation and invariants (reference
+tests/benchmarks/split_pipeline/test_nvcf_split_benchmark.py:27-129)."""
+
+from __future__ import annotations
+
+import argparse
+
+from benchmarks.split_benchmark import make_synthetic_corpus, run_benchmark
+
+
+def test_split_benchmark_metrics(tmp_path):
+    args = argparse.Namespace(
+        input_path="",
+        output_path=str(tmp_path),
+        synthetic=2,
+        limit=0,
+        splitting_algorithm="fixed-stride",
+        motion=False,
+        embedding_model="",  # no model stage: hermetic and fast
+        attempts=1,
+        sequential=True,
+    )
+    result = run_benchmark(args)
+    assert result["num_videos"] == 2
+    assert result["num_clips"] >= result["num_transcoded"] >= 1
+    assert result["num_with_embeddings"] == 0
+    assert result["clips_per_sec"] > 0
+    assert result["wall_s"] > 0
+    assert result["video_hours_per_day_per_chip"] >= 0
+
+
+def test_synthetic_corpus_shape(tmp_path):
+    vids = make_synthetic_corpus(tmp_path, 3, seconds=2.0)
+    files = sorted(vids.glob("*.mp4"))
+    assert len(files) == 3
+    assert all(f.stat().st_size > 0 for f in files)

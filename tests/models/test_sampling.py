@@ -123,6 +123,61 @@ def test_repetition_penalty_covers_prompt_history():
     assert out != 2
 
 
+def test_penalties_accept_count_map():
+    """Hot loops pass precomputed {token: count} maps; results must match
+    the list form."""
+    logits = np.array([3.0, 2.0, 1.0])
+    cfg = SamplingConfig(frequency_penalty=0.5)
+    from_list = apply_penalties(logits, [0, 0, 2], cfg)
+    from_map = apply_penalties(logits, {0: 2, 2: 1}, cfg)
+    np.testing.assert_allclose(from_list, from_map)
+    # num_generated derives from the map's total when not given
+    assert sample_token(logits, SamplingConfig(min_tokens=2), generated={5: 1}, eos_id=0) != 0
+
+
+def test_truncate_at_stop_earliest_match_wins():
+    from cosmos_curate_tpu.models.vlm.engine import _truncate_at_stop
+
+    # '!' appears later than '.', so '.' must win regardless of tuple order
+    assert _truncate_at_stop("a.b!", ("!", ".")) == "a"
+    assert _truncate_at_stop("a.b!", (".", "!")) == "a"
+    assert _truncate_at_stop("abc", ("!",)) is None
+
+
+def test_seed_zero_is_a_real_seed():
+    """seed=0 must pin draws (None is the unseeded sentinel): the pinned
+    request's text is independent of shared-rng riders in the batch."""
+    from cosmos_curate_tpu.models.vlm import (
+        VLM_TINY_TEST,
+        CaptionEngine,
+        CaptionRequest,
+    )
+
+    def run(with_rider: bool) -> str:
+        engine = CaptionEngine(VLM_TINY_TEST, max_batch=2)
+        engine.setup(seed=7)
+        if with_rider:
+            # a rider perturbs the shared rng stream between pinned draws
+            engine.add_request(
+                CaptionRequest(
+                    request_id="rider",
+                    prompt_ids=[4, 4],
+                    sampling=SamplingConfig(max_new_tokens=4, temperature=1.0),
+                )
+            )
+        engine.add_request(
+            CaptionRequest(
+                request_id="pinned",
+                prompt_ids=[1, 2],
+                sampling=SamplingConfig(max_new_tokens=6, temperature=1.0, seed=0),
+            )
+        )
+        res = {r.request_id: r for r in engine.run_until_complete()}
+        return res["pinned"].text
+
+    assert run(True) == run(False)
+
+
 def test_engine_per_request_seed_reproducible():
     """sampling.seed pins a request's draws regardless of what else is in
     the batch."""
