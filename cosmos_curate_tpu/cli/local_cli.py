@@ -27,6 +27,8 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--splitting-algorithm", choices=["fixed-stride", "transnetv2"], default="fixed-stride")
     split.add_argument("--fixed-stride-len-s", type=float, default=10.0)
     split.add_argument("--min-clip-len-s", type=float, default=2.0)
+    split.add_argument("--multicam", action="store_true", help="input is <session>/<camera>.mp4 dirs")
+    split.add_argument("--primary-camera", default="", help="primary camera filename stem")
     split.add_argument("--motion-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--aesthetic-threshold", type=float, default=None)
     split.add_argument(
@@ -272,6 +274,8 @@ def _cmd_split(args: argparse.Namespace) -> int:
             splitting_algorithm=args.splitting_algorithm,
             fixed_stride_len_s=args.fixed_stride_len_s,
             min_clip_len_s=args.min_clip_len_s,
+            multicam=args.multicam,
+            primary_camera=args.primary_camera,
             motion_filter=args.motion_filter,
             aesthetic_threshold=args.aesthetic_threshold,
             embedding_model=args.embedding_model,

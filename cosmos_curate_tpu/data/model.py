@@ -172,6 +172,9 @@ class Video:
     """A source video being split."""
 
     path: str = ""
+    # camera label for multicam sessions (filename stem by convention);
+    # empty for single-camera inputs
+    camera: str = ""
     raw_bytes: bytes | None = None
     metadata: VideoMetadata = field(default_factory=VideoMetadata)
     clips: list[Clip] = field(default_factory=list)
@@ -192,16 +195,36 @@ class Video:
 @dataclass
 class SplitPipeTask(PipelineTask):
     """Unit of work in the split-annotate pipeline: one video (or one chunk
-    of its clips after dynamic re-chunking)."""
+    of its clips after dynamic re-chunking).
+
+    Multi-camera sessions (reference docs/curator/design/MULTICAM.md):
+    ``video`` is the PRIMARY camera — every single-camera stage (filters,
+    embedding, captioning) keeps operating on it unchanged; time-aligned
+    secondary cameras ride in ``aux_videos`` and are handled by the
+    camera-aware stages (download, extraction, transcode, writer)."""
 
     video: Video = field(default_factory=Video)
+    # secondary cameras, clips time-aligned with the primary's spans
+    aux_videos: list[Video] = field(default_factory=list)
+    # multicam session identity (the session directory name); empty for
+    # single-camera tasks
+    session_id: str = ""
     stage_perf: dict[str, float] = field(default_factory=dict)
     stats: ClipStats | None = None
 
     @property
+    def videos(self) -> list[Video]:
+        """All cameras, primary first."""
+        return [self.video, *self.aux_videos]
+
+    @property
+    def is_multicam(self) -> bool:
+        return bool(self.aux_videos)
+
+    @property
     def weight(self) -> float:
         # Weight by content duration so the scheduler balances long videos.
-        return max(1.0, self.video.metadata.duration_s / 60.0)
+        return max(1.0, self.video.metadata.duration_s / 60.0) * len(self.videos)
 
     @property
     def fraction(self) -> float:

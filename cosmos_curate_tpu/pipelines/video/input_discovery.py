@@ -66,3 +66,63 @@ def discover_split_tasks(
         len(tasks), input_path, skipped,
     )
     return tasks
+
+
+def discover_multicam_tasks(
+    input_path: str,
+    output_path: str | None = None,
+    *,
+    primary_camera: str = "",
+    limit: int = 0,
+) -> list[SplitPipeTask]:
+    """Session-based multicam discovery (reference MULTICAM.md: session =
+    a subdirectory of ``input_path``; its video files are time-aligned
+    cameras). The primary camera is the one whose filename stem matches
+    ``primary_camera``, else the lexicographically first. Resume keys off
+    the primary's record id."""
+    from collections import defaultdict
+    from pathlib import PurePath
+
+    from cosmos_curate_tpu.storage.client import relative_to_prefix
+
+    client = get_storage_client(input_path)
+    done = _processed_video_ids(output_path) if output_path else set()
+    sessions: dict[str, list[str]] = defaultdict(list)
+    for info in client.list_files(input_path, suffixes=VIDEO_SUFFIXES):
+        rel = relative_to_prefix(info.path, input_path)
+        parts = PurePath(rel).parts if rel else ()
+        if len(parts) < 2:
+            logger.warning("skipping %s: multicam input expects <session>/<camera>", info.path)
+            continue
+        sessions[parts[0]].append(info.path)
+
+    tasks: list[SplitPipeTask] = []
+    skipped = 0
+    for session_id in sorted(sessions):
+        paths = sorted(sessions[session_id])
+        stems = {PurePath(p).stem: p for p in paths}
+        primary_path = stems.get(primary_camera)
+        if primary_path is None:
+            if primary_camera:
+                logger.warning(
+                    "session %s has no %r camera; using %s as primary",
+                    session_id, primary_camera, PurePath(paths[0]).stem,
+                )
+            primary_path = paths[0]
+        if video_record_id(primary_path) in done:
+            skipped += 1
+            continue
+        videos = [Video(path=primary_path, camera=PurePath(primary_path).stem)]
+        videos += [
+            Video(path=p, camera=PurePath(p).stem) for p in paths if p != primary_path
+        ]
+        tasks.append(
+            SplitPipeTask(video=videos[0], aux_videos=videos[1:], session_id=session_id)
+        )
+        if limit and len(tasks) >= limit:
+            break
+    logger.info(
+        "discovered %d multicam sessions under %s (%d already processed, skipped)",
+        len(tasks), input_path, skipped,
+    )
+    return tasks

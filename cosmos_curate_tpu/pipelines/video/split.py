@@ -59,6 +59,10 @@ class SplitPipelineArgs:
     semantic_filter: str = "disable"  # disable | score-only | enable
     semantic_filter_prompt: str = "default"
     embedding_model: str = ""  # "" | "clip" | "video"
+    # multicam sessions: input_path holds <session>/<camera>.mp4 dirs;
+    # spans come from the primary camera, aux cameras split time-aligned
+    multicam: bool = False
+    primary_camera: str = ""  # filename stem; "" = lexicographically first
     captioning: bool = False
     caption_window_len: int = 256
     caption_prompt_variant: str = "default"
@@ -229,7 +233,26 @@ def run_split(
 
     maybe_initialize_distributed()
     try:
-        tasks = discover_split_tasks(args.input_path, args.output_path, limit=args.limit)
+        if args.multicam:
+            from cosmos_curate_tpu.pipelines.video.input_discovery import (
+                discover_multicam_tasks,
+            )
+
+            if args.splitting_algorithm != "fixed-stride":
+                raise ValueError(
+                    "multicam sessions split fixed-stride only (time-aligned "
+                    "spans across cameras; reference MULTICAM.md scope)"
+                )
+            tasks = discover_multicam_tasks(
+                args.input_path,
+                args.output_path,
+                primary_camera=args.primary_camera,
+                limit=args.limit,
+            )
+        else:
+            tasks = discover_split_tasks(
+                args.input_path, args.output_path, limit=args.limit
+            )
         # multi-node: each node takes a disjoint task slice (host-level data
         # parallelism; resume records keep re-runs consistent)
         tasks = partition_tasks_for_node(tasks)

@@ -56,6 +56,19 @@ class FixedStrideExtractorStage(Stage[SplitPipeTask, SplitPipeTask]):
                 )
             video.clips = make_clips(video.path, spans)
             video.num_total_clips = len(video.clips)
+            # multicam: secondary cameras take the PRIMARY's spans verbatim
+            # (time-aligned clips, reference MULTICAM.md — fixed-stride
+            # only), clipped to each camera's own duration
+            for aux in task.aux_videos:
+                if aux.errors:
+                    continue
+                aux_spans = [
+                    (a, min(b, aux.metadata.duration_s))
+                    for a, b in spans
+                    if a < aux.metadata.duration_s
+                ]
+                aux.clips = make_clips(aux.path, aux_spans)
+                aux.num_total_clips = len(aux.clips)
         return tasks
 
 
@@ -75,16 +88,23 @@ class ClipTranscodingStage(Stage[SplitPipeTask, SplitPipeTask]):
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         # One sequential decode pass per video (transcode_clips decodes each
         # source frame exactly once, feeding all spans); videos in the batch
-        # fan across the thread pool — that is what num_threads CPUs buys.
+        # (every camera of every task) fan across the thread pool — that is
+        # what num_threads CPUs buys.
         with ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-            list(pool.map(self._transcode_video, tasks))
+            list(pool.map(self._transcode_video, [v for t in tasks for v in t.videos]))
         out: list[SplitPipeTask] = []
         for task in tasks:
-            out.extend(chunk_split_task(task, self.chunk_size))
+            if task.is_multicam:
+                # aligned aux clip lists make chunk re-slicing ambiguous;
+                # multicam sessions stay one task (reference MULTICAM scope)
+                task.video.num_clip_chunks = 1
+                task.video.clip_chunk_index = 0
+                out.append(task)
+            else:
+                out.extend(chunk_split_task(task, self.chunk_size))
         return out
 
-    def _transcode_video(self, task: SplitPipeTask) -> None:
-        video = task.video
+    def _transcode_video(self, video) -> None:
         if not video.clips:
             video.release_raw()
             return

@@ -29,15 +29,16 @@ class VideoDownloadStage(Stage[SplitPipeTask, SplitPipeTask]):
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:
-            video = task.video
-            try:
-                video.raw_bytes = read_bytes(video.path)
-                if self.probe_metadata:
-                    video.metadata = extract_video_metadata(video.raw_bytes)
-                    video.metadata.size_bytes = len(video.raw_bytes)
-                    if not video.metadata.is_valid:
-                        video.errors["download"] = "invalid or empty video stream"
-            except Exception as e:
-                logger.warning("failed to fetch %s: %s", video.path, e)
-                video.errors["download"] = str(e)
+            # multicam sessions fetch every camera; single-cam = [video]
+            for video in task.videos:
+                try:
+                    video.raw_bytes = read_bytes(video.path)
+                    if self.probe_metadata:
+                        video.metadata = extract_video_metadata(video.raw_bytes)
+                        video.metadata.size_bytes = len(video.raw_bytes)
+                        if not video.metadata.is_valid:
+                            video.errors["download"] = "invalid or empty video stream"
+                except Exception as e:
+                    logger.warning("failed to fetch %s: %s", video.path, e)
+                    video.errors["download"] = str(e)
         return tasks

@@ -81,7 +81,12 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             stats = ClipStats()
             embedding_rows: dict[str, list[tuple[str, np.ndarray]]] = defaultdict(list)
             for clip in video.clips:
-                self._write_clip(clip, stats, embedding_rows)
+                self._write_clip(
+                    clip, stats, embedding_rows,
+                    camera=video.camera if task.is_multicam else "",
+                )
+            if task.is_multicam:
+                self._write_aux_cameras(task, stats)
             for clip in video.filtered_clips:
                 stats.num_clips += 1
                 self._count_filtered(clip, stats)
@@ -112,12 +117,37 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             task.stats = stats
         return tasks
 
-    def _write_clip(self, clip: Clip, stats: ClipStats, embedding_rows) -> None:
+    def _write_aux_cameras(self, task: SplitPipeTask, stats: ClipStats) -> None:
+        """Secondary cameras land beside the primary under the clip's
+        directory: clips/<primary-uuid>/<camera>.mp4 (reference MULTICAM.md
+        per-camera clip layout). Aux clips match the primary's by span
+        start (a shorter camera simply lacks the tail clips)."""
+        for aux in task.aux_videos:
+            by_start = {round(c.span[0], 6): c for c in aux.clips}
+            for primary_clip in task.video.clips:
+                aux_clip = by_start.get(round(primary_clip.span[0], 6))
+                if aux_clip is None or not aux_clip.encoded_data:
+                    continue
+                write_bytes(
+                    f"{self.output_path}/clips/{primary_clip.uuid}/{aux.camera}.mp4",
+                    aux_clip.encoded_data,
+                )
+                aux_clip.encoded_data = None
+                stats.num_transcoded += 1
+
+    def _write_clip(
+        self, clip: Clip, stats: ClipStats, embedding_rows, *, camera: str = ""
+    ) -> None:
         stats.num_clips += 1
         stats.total_clip_duration_s += clip.duration_s
         stats.max_clip_duration_s = max(stats.max_clip_duration_s, clip.duration_s)
         if clip.encoded_data:
-            write_bytes(f"{self.output_path}/clips/{clip.uuid}.mp4", clip.encoded_data)
+            dest = (
+                f"{self.output_path}/clips/{clip.uuid}/{camera}.mp4"
+                if camera
+                else f"{self.output_path}/clips/{clip.uuid}.mp4"
+            )
+            write_bytes(dest, clip.encoded_data)
             stats.num_transcoded += 1
         if clip.webp_preview and self.write_previews:
             write_bytes(f"{self.output_path}/previews/{clip.uuid}.webp", clip.webp_preview)

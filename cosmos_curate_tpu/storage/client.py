@@ -34,6 +34,24 @@ def is_remote_path(path: str | os.PathLike[str]) -> bool:
     return str(path).startswith(_REMOTE_SCHEMES)
 
 
+def relative_to_prefix(path: str, prefix: str) -> str | None:
+    """``path`` relative to ``prefix``, or None when not under it.
+
+    Exact string prefix for remote URLs; local paths are normalized first
+    (a listing of ``./videos`` yields ``videos/...`` entries — a naive
+    startswith would misattribute every file)."""
+    base = prefix.rstrip("/")
+    if path.startswith(base + "/"):
+        return path[len(base) + 1:]
+    if is_remote_path(prefix):
+        return None
+    norm_base = os.path.normpath(base)
+    norm_path = os.path.normpath(path)
+    if norm_path.startswith(norm_base + os.sep):
+        return norm_path[len(norm_base) + 1:]
+    return None
+
+
 @dataclass(frozen=True)
 class ObjectInfo:
     path: str
@@ -65,11 +83,10 @@ class StorageClient(abc.ABC):
     ) -> list[str]:
         """Paths under ``prefix`` relative to it (reference
         ``get_files_relative``)."""
-        base = prefix.rstrip("/") + "/"
         out = []
         for info in self.list_files(prefix, suffixes=suffixes):
-            p = info.path
-            out.append(p[len(base):] if p.startswith(base) else p)
+            rel = relative_to_prefix(info.path, prefix)
+            out.append(rel if rel is not None else info.path)
         return out
 
 

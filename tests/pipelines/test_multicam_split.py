@@ -1,0 +1,119 @@
+"""Multicam session split (reference docs/curator/design/MULTICAM.md):
+time-aligned fixed-stride clips across cameras, primary-camera annotation,
+per-camera clip layout, session discovery + resume."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.pipelines.video.input_discovery import discover_multicam_tasks
+from tests.fixtures.media import make_scene_video
+
+
+@pytest.fixture()
+def session_dir(tmp_path):
+    root = tmp_path / "sessions"
+    for sess, cams, scenes in (
+        ("drive-a", ("cam_front", "cam_rear"), 2),
+        ("drive-b", ("cam_front",), 1),
+    ):
+        d = root / sess
+        d.mkdir(parents=True)
+        for cam in cams:
+            make_scene_video(d / f"{cam}.mp4", scene_len_frames=24, num_scenes=scenes)
+    return root
+
+
+class TestDiscovery:
+    def test_sessions_and_primary(self, session_dir):
+        tasks = discover_multicam_tasks(str(session_dir))
+        assert len(tasks) == 2
+        by_sess = {t.session_id: t for t in tasks}
+        a = by_sess["drive-a"]
+        assert a.is_multicam and len(a.videos) == 2
+        assert a.video.camera == "cam_front"  # lexicographically first
+        assert a.aux_videos[0].camera == "cam_rear"
+        b = by_sess["drive-b"]
+        assert not b.is_multicam
+
+    def test_primary_camera_override(self, session_dir):
+        tasks = discover_multicam_tasks(str(session_dir), primary_camera="cam_rear")
+        a = next(t for t in tasks if t.session_id == "drive-a")
+        assert a.video.camera == "cam_rear"
+        assert a.aux_videos[0].camera == "cam_front"
+
+    def test_flat_files_warned_and_skipped(self, tmp_path):
+        make_scene_video(tmp_path / "flat.mp4", scene_len_frames=24, num_scenes=1)
+        assert discover_multicam_tasks(str(tmp_path)) == []
+
+
+class TestEndToEnd:
+    def test_split_writes_per_camera_clips(self, session_dir, tmp_path):
+        from cosmos_curate_tpu.core.runner import SequentialRunner
+        from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+        out = tmp_path / "out"
+        args = SplitPipelineArgs(
+            input_path=str(session_dir),
+            output_path=str(out),
+            multicam=True,
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            extract_fps=(4.0,),
+            extract_resize_hw=(64, 64),
+        )
+        summary = run_split(args, runner=SequentialRunner())
+        assert summary["num_videos"] == 2  # two sessions
+
+        # drive-a: primary + rear per clip under clips/<uuid>/<camera>.mp4
+        clip_dirs = [p for p in (out / "clips").iterdir() if p.is_dir()]
+        assert clip_dirs, "multicam clips must be per-uuid directories"
+        for d in clip_dirs:
+            names = {f.name for f in d.iterdir()}
+            assert "cam_front.mp4" in names
+            assert "cam_rear.mp4" in names
+        # drive-b is single-cam: flat clip files
+        flat = [p for p in (out / "clips").iterdir() if p.suffix == ".mp4"]
+        assert flat
+
+        # aligned spans: each camera file decodes to the same frame count
+        import cv2
+
+        d = clip_dirs[0]
+        counts = []
+        for f in sorted(d.iterdir()):
+            cap = cv2.VideoCapture(str(f))
+            counts.append(int(cap.get(cv2.CAP_PROP_FRAME_COUNT)))
+            cap.release()
+        assert len(set(counts)) == 1, counts
+
+    def test_resume_skips_completed_sessions(self, session_dir, tmp_path):
+        from cosmos_curate_tpu.core.runner import SequentialRunner
+        from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+        out = tmp_path / "out"
+        args = SplitPipelineArgs(
+            input_path=str(session_dir),
+            output_path=str(out),
+            multicam=True,
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            extract_fps=(4.0,),
+            extract_resize_hw=(64, 64),
+        )
+        run_split(args, runner=SequentialRunner())
+        tasks = discover_multicam_tasks(str(session_dir), str(out))
+        assert tasks == []
+
+    def test_transnetv2_rejected_for_multicam(self, session_dir, tmp_path):
+        from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+        args = SplitPipelineArgs(
+            input_path=str(session_dir),
+            output_path=str(tmp_path / "o"),
+            multicam=True,
+            splitting_algorithm="transnetv2",
+        )
+        with pytest.raises(ValueError, match="fixed-stride"):
+            run_split(args)
