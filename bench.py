@@ -91,6 +91,43 @@ def main() -> int:
     tmp = Path(tempfile.mkdtemp(prefix="curate_bench_"))
     vids = make_corpus(tmp)
 
+    # Caption throughput rides along in the same driver artifact (reference
+    # SPEED_OF_LIGHT.md:22-52: "output tokens/s is THE metric"). Run it
+    # FIRST, before this process initializes JAX: libtpu is single-process,
+    # so a child launched after the parent grabs the chip would silently
+    # fall back to CPU and poison the number. Subprocess also means an
+    # engine failure can't void the clips/s measurement.
+    caption: dict = {}
+    caption_cfg = "tiny" if os.environ.get("JAX_PLATFORMS") == "cpu" else "base"
+    try:
+        import subprocess
+
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "benchmarks.caption_benchmark",
+                "--config",
+                caption_cfg,
+                "--requests",
+                os.environ.get("BENCH_CAPTION_REQUESTS", "8"),
+                "--max-new",
+                "48",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=2400,
+            cwd=str(REPO),
+            env=dict(os.environ),
+        )
+        caption = json.loads(proc.stdout.strip().splitlines()[-1])
+        log(
+            f"bench: caption {caption['value']} tok/s "
+            f"(backend={caption.get('backend')}, config={caption_cfg})"
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"bench: caption benchmark failed ({e}); clips/s still valid")
+
     # Warm up the embedder compile outside the timed window (all power-of-2
     # batch shapes the run will hit).
     log("bench: warming up embedder compiles")
@@ -116,11 +153,20 @@ def main() -> int:
         )
     del warm
 
+    # The reference's canonical perf config is transnet shot detection +
+    # motion + aesthetics + embeddings (benchmarks/split_pipeline/
+    # invoke.json:1-45). Run that as the headline whenever trained transnet
+    # weights are staged; fall back to fixed-stride (the round-1/2 config)
+    # when they are not, and say which one was measured.
+    transnet_weights = (REPO / "weights" / "transnetv2-tpu" / "params.msgpack").exists()
+    config_name = "transnet+motion+embed" if transnet_weights else "fixed-stride+embed"
     args = SplitPipelineArgs(
         input_path=str(vids),
         output_path=str(tmp / "out"),
+        splitting_algorithm="transnetv2" if transnet_weights else "fixed-stride",
         fixed_stride_len_s=STRIDE_S,
         min_clip_len_s=0.5,
+        motion_filter="score-only" if transnet_weights else "disable",
         extract_fps=(8.0,),
         extract_resize_hw=(224, 224),
         embedding_model="video",
@@ -167,13 +213,16 @@ def main() -> int:
         "value": round(value, 3),
         "unit": "clips/s",
         "vs_baseline": round(vs, 3),
+        "config": config_name,
     }
     # MFU for the embed stage (reference SPEED_OF_LIGHT.md's efficiency
-    # method, translated to TPU peak via models/flops.py).
+    # method, translated to TPU peak via models/flops.py). Only meaningful
+    # against a TPU peak, so suppressed on a CPU-fallback run — a number
+    # computed against v5e peak while running on CPU invites misreading.
     from cosmos_curate_tpu.models.flops import chip_peak_flops, mfu, video_embed_forward_flops
 
     embed_s = getattr(runner, "stage_times", {}).get("ClipEmbeddingStage", 0.0)
-    if embedded and embed_s > 0:
+    if embedded and embed_s > 0 and backend == "tpu":
         flops = embedded * video_embed_forward_flops(VIDEO_EMBED_BASE)
         record["mfu"] = round(mfu(flops, embed_s), 4)
         record["embed_stage_s"] = round(embed_s, 2)
@@ -181,6 +230,15 @@ def main() -> int:
     if backend != "tpu":
         # degraded run (dead TPU tunnel fallback) must be machine-detectable
         record["backend"] = backend
+
+    if caption:
+        record["caption_output_tokens_per_sec"] = caption["value"]
+        record["caption_config"] = caption_cfg
+        if caption.get("backend") == "tpu":
+            record["decode_mfu"] = caption.get("decode_mfu", 0.0)
+        elif caption.get("backend") != backend:
+            # a cross-backend caption number must be machine-detectable
+            record["caption_backend"] = caption.get("backend")
     print(json.dumps(record))
     return 0
 

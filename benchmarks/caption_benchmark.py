@@ -32,6 +32,13 @@ def main() -> int:
     ap.add_argument("--config", choices=("base", "tiny"), default="base")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--frames", type=int, default=4)
+    ap.add_argument(
+        "--uniform",
+        action="store_true",
+        help="all-equal prompt lengths (default is a mixed-length workload: "
+        "1/3 of requests carry a long transcript-style prompt, exercising "
+        "chunked prefill + the short/long KV lanes)",
+    )
     args = ap.parse_args()
 
     import numpy as np
@@ -47,30 +54,44 @@ def main() -> int:
     )
 
     cfg = VLM_BASE if args.config == "base" else VLM_TINY_TEST
-    engine = CaptionEngine(cfg, max_batch=args.batch)
+    # mixed-length workload gets short/long KV lanes so KV memory tracks
+    # actual lengths (half the slots short, half worst-case)
+    lanes = None
+    if not args.uniform:
+        short = min(max(256, cfg.max_seq // 4), cfg.max_seq // 2)
+        lanes = ((short, max(2, args.batch // 2)), (cfg.max_seq, max(2, args.batch // 2)))
+    engine = CaptionEngine(cfg, max_batch=args.batch, kv_lanes=lanes)
     engine.setup()
     tok = engine.tokenizer
     prompt_ids = tok.encode(get_caption_prompt("default"))
+    long_ids = tok.encode(
+        get_caption_prompt("default")
+        + " transcript: " + "the camera pans across the scene. " * 40
+    )
     rng = np.random.default_rng(0)
-    size = cfg.vision.image_size
+    size = cfg.vision.image_size if cfg.vision_variant == "vit" else cfg.qwen_vision.image_size
 
-    def make_request(rid: str) -> CaptionRequest:
+    def make_request(rid: str, i: int = 0) -> CaptionRequest:
+        ids = long_ids if (not args.uniform and i % 3 == 2) else prompt_ids
         return CaptionRequest(
             request_id=rid,
-            prompt_ids=list(prompt_ids),
+            prompt_ids=list(ids),
             frames=rng.integers(0, 255, (args.frames, size, size, 3), dtype=np.uint8),
             sampling=SamplingConfig(max_new_tokens=args.max_new),
         )
 
-    # warmup: compile prefill buckets + decode program outside the window
+    # warmup: compile prefill buckets + decode programs (both lanes'
+    # shapes) outside the window
     engine.add_request(make_request("warmup"))
+    if not args.uniform:
+        engine.add_request(make_request("warmup-long", 2))
     engine.run_until_complete()
     engine._decode_tokens = 0
     engine._decode_time = 0.0
 
     t0 = time.monotonic()
     for i in range(args.requests):
-        engine.add_request(make_request(f"r{i}"))
+        engine.add_request(make_request(f"r{i}", i))
     results = engine.run_until_complete()
     elapsed = time.monotonic() - t0
 

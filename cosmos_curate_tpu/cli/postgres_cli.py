@@ -101,6 +101,13 @@ def target_schema(dialect: str) -> dict[str, list[ColumnInfo]]:
 # -- inspectors ------------------------------------------------------------
 
 
+def quote_ident(name: str) -> str:
+    """Quote an SQL identifier (table names come from DB metadata, which a
+    hostile or merely mixed-case schema can use to break — or inject into —
+    the admin session's queries)."""
+    return '"' + name.replace('"', '""') + '"'
+
+
 class SqliteInspector:
     dialect = "sqlite"
 
@@ -117,16 +124,16 @@ class SqliteInspector:
         return [r[0] for r in rows]
 
     def row_count(self, table: str) -> int:
-        return self._db.execute(f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+        return self._db.execute(f"SELECT COUNT(*) FROM {quote_ident(table)}").fetchone()[0]
 
     def columns(self, table: str) -> list[ColumnInfo]:
-        rows = self._db.execute(f"PRAGMA table_info({table})").fetchall()
+        rows = self._db.execute(f"PRAGMA table_info({quote_ident(table)})").fetchall()
         return [ColumnInfo(r[1], (r[2] or "TEXT").upper(), not r[3]) for r in rows]
 
     def foreign_keys(self) -> list[ForeignKeyInfo]:
         out = []
         for t in self.tables():
-            for r in self._db.execute(f"PRAGMA foreign_key_list({t})").fetchall():
+            for r in self._db.execute(f"PRAGMA foreign_key_list({quote_ident(t)})").fetchall():
                 out.append(ForeignKeyInfo(t, r[3], r[2], r[4] or ""))
         return out
 
@@ -156,7 +163,7 @@ class PostgresInspector:
     _SCHEMA_FILTER = "AND table_schema = 'public' "
 
     def row_count(self, table: str) -> int:
-        res = self._conn.execute(f"SELECT COUNT(*) FROM {table}")
+        res = self._conn.execute(f"SELECT COUNT(*) FROM {quote_ident(table)}")
         return int(res.rows[0][0])
 
     def columns(self, table: str) -> list[ColumnInfo]:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import re
+import os
 import shlex
 import subprocess
 from pathlib import Path
@@ -166,11 +167,23 @@ def _cmd_submit(args: argparse.Namespace) -> int:
         print(script)
     if not args.submit:
         return 0
-    target = args.output or "/tmp/cosmos_curate_tpu.sbatch"
-    if not args.output:
-        Path(target).write_text(script)
+    if args.output:
+        target = args.output
+    else:
+        # Unpredictable per-invocation name: a fixed path in world-writable
+        # /tmp is clobbered by concurrent submitters and invites symlink
+        # pre-creation races on shared login nodes.
+        import tempfile
+
+        fd, target = tempfile.mkstemp(prefix="cosmos_curate_tpu_", suffix=".sbatch")
+        with os.fdopen(fd, "w") as fh:
+            fh.write(script)
     if args.remote_host:
-        remote_path = f"/tmp/{Path(target).name}"
+        mk = _remote(args.remote_host, ["mktemp", "-t", "cosmos_curate_tpu_XXXXXX.sbatch"])
+        if mk.returncode != 0:
+            print(mk.stderr)
+            return mk.returncode
+        remote_path = mk.stdout.strip()
         scp = _run(["scp", "-o", "BatchMode=yes", target, f"{args.remote_host}:{remote_path}"])
         if scp.returncode != 0:
             print(scp.stderr)

@@ -8,10 +8,13 @@ rope 1e6), so this converter maps every LM tensor name exactly — numeric
 parity is proven against a randomly initialized HF Qwen2 in
 tests/models/test_convert_qwen.py.
 
-The Qwen2-VL *vision* encoder (``visual.*`` tensors) is architecturally
-different (3D-conv patchify, windowed attention, m-rope); our ViT vision
-tower is retained instead, and ``convert_qwen2_lm`` reports those tensors as
-intentionally unmapped rather than silently dropping them.
+The Qwen2-VL *vision* encoder (``visual.*`` tensors) maps onto our Flax
+``QwenVisionTower`` (models/vlm/vision_qwen.py — 3D-conv patchify as a
+matmul, 2D rope, patch merger) via ``convert_qwen2_vision``; numeric parity
+vs a randomly initialized HF `Qwen2VisionTransformerPretrainedModel` is
+proven in tests/models/test_convert_qwen.py. ``convert_qwen2_lm`` alone
+still reports vision tensors as intentionally unmapped for the LM-only
+path; ``convert_qwen2_vl`` maps both halves.
 """
 
 from __future__ import annotations
@@ -43,6 +46,8 @@ def qwen2_lm_config(hf_config, **overrides):
     head_dim = getattr(hf_config, "head_dim", None) or (
         hf_config.hidden_size // hf_config.num_attention_heads
     )
+    rope_scaling = getattr(hf_config, "rope_scaling", None) or {}
+    mrope = rope_scaling.get("mrope_section")
     kw = dict(
         vocab=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -53,6 +58,8 @@ def qwen2_lm_config(hf_config, **overrides):
         hidden_mult=hf_config.intermediate_size / hf_config.hidden_size,
         rope_theta=hf_config.rope_theta,
         qkv_bias=True,
+        mrope_section=tuple(mrope) if mrope else None,
+        rms_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
     )
     kw.update(overrides)
     return VLMConfig(**kw)
@@ -128,6 +135,104 @@ def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]
     return {"params": params}, report
 
 
+def qwen2_vision_config(hf_vision_config, **overrides):
+    """Our QwenVisionConfig from an HF Qwen2VLVisionConfig."""
+    from cosmos_curate_tpu.models.vlm.vision_qwen import QwenVisionConfig
+
+    kw = dict(
+        depth=hf_vision_config.depth,
+        embed_dim=hf_vision_config.embed_dim,
+        num_heads=hf_vision_config.num_heads,
+        hidden_size=hf_vision_config.hidden_size,
+        mlp_ratio=hf_vision_config.mlp_ratio,
+        patch_size=hf_vision_config.patch_size,
+        temporal_patch_size=hf_vision_config.temporal_patch_size,
+        spatial_merge_size=hf_vision_config.spatial_merge_size,
+        in_channels=hf_vision_config.in_channels,
+    )
+    kw.update(overrides)
+    return QwenVisionConfig(**kw)
+
+
+def convert_qwen2_vision(state_dict, depth: int) -> tuple[dict, ConversionReport]:
+    """HF ``visual.*`` tensors → our QwenVisionTower params subtree.
+
+    The Conv3d patchify (kernel == stride) becomes the dense patch_embed
+    kernel: ``[E, C, tps, ps, ps]`` flattens to ``[E, patch_dim]`` and
+    transposes — valid because both sides consume patches flattened in
+    (C, tps, ps, ps) order (HF PatchEmbed.forward views exactly that
+    shape; frames_to_patches emits it).
+    """
+    sd = dict(state_dict)
+    report = ConversionReport()
+    prefix = "visual."
+    if f"{prefix}patch_embed.proj.weight" not in sd:
+        if "model.visual.patch_embed.proj.weight" in sd:
+            prefix = "model.visual."
+        else:
+            raise KeyError("no visual.* tensors found in state dict")
+
+    def take(name: str) -> np.ndarray:
+        report.mapped.append(name)
+        return _t(sd[name])
+
+    def lin(stem: str) -> dict:
+        return {
+            "kernel": take(f"{stem}.weight").T,
+            "bias": take(f"{stem}.bias"),
+        }
+
+    def ln(stem: str) -> dict:
+        return {"scale": take(f"{stem}.weight"), "bias": take(f"{stem}.bias")}
+
+    conv = take(f"{prefix}patch_embed.proj.weight")  # [E, C, tps, ps, ps]
+    params: dict = {"patch_embed": {"kernel": conv.reshape(conv.shape[0], -1).T}}
+    for i in range(depth):
+        e = f"{prefix}blocks.{i}."
+        params[f"block_{i}"] = {
+            "ln1": ln(f"{e}norm1"),
+            "ln2": ln(f"{e}norm2"),
+            "qkv": lin(f"{e}attn.qkv"),
+            "proj": lin(f"{e}attn.proj"),
+            "fc1": lin(f"{e}mlp.fc1"),
+            "fc2": lin(f"{e}mlp.fc2"),
+        }
+    params["ln_q"] = ln(f"{prefix}merger.ln_q")
+    params["merger_fc1"] = lin(f"{prefix}merger.mlp.0")
+    params["merger_fc2"] = lin(f"{prefix}merger.mlp.2")
+
+    mapped = set(report.mapped)
+    for k in sd:
+        if k not in mapped and k.startswith(prefix):
+            report.unmapped.append(k)
+    logger.info(
+        "converted Qwen2-VL vision: %d tensors mapped, %d unmapped",
+        len(report.mapped),
+        len(report.unmapped),
+    )
+    return {"params": params}, report
+
+
+def convert_qwen2_vl(
+    state_dict, n_layers: int, vision_depth: int
+) -> tuple[dict, dict, ConversionReport]:
+    """Full Qwen2-VL checkpoint → (lm_params, vision_params, report).
+
+    Unlike ``convert_qwen2_lm`` alone, nothing is "intentionally skipped":
+    a Qwen2-VL checkpoint converts completely, so ``report.vision_skipped``
+    is empty and multimodal forwards see the trained tower.
+    """
+    lm_params, lm_report = convert_qwen2_lm(state_dict, n_layers)
+    vision_params, v_report = convert_qwen2_vision(state_dict, vision_depth)
+    report = ConversionReport(
+        mapped=lm_report.mapped + v_report.mapped,
+        vision_skipped=[],
+        unmapped=[u for u in lm_report.unmapped if not u.startswith(("visual.", "model.visual."))]
+        + v_report.unmapped,
+    )
+    return lm_params, vision_params, report
+
+
 def merge_lm_params(init_tree: dict, lm_params: dict) -> dict:
     """Overlay converted LM params onto a full init tree (vision tower +
     projector keep their existing — e.g. self-trained — values)."""
@@ -136,4 +241,15 @@ def merge_lm_params(init_tree: dict, lm_params: dict) -> dict:
     merged = flax.core.unfreeze(init_tree)
     for key, val in lm_params["params"].items():
         merged["params"][key] = val
+    return merged
+
+
+def merge_vision_params(init_tree: dict, vision_params: dict) -> dict:
+    """Overlay converted Qwen vision-tower params under the VLM's
+    ``vision`` submodule (plus the top-level merger ln_q, which QwenVisionTower
+    owns)."""
+    import flax
+
+    merged = flax.core.unfreeze(init_tree)
+    merged["params"]["vision"] = vision_params["params"]
     return merged

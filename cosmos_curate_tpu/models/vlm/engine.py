@@ -47,6 +47,10 @@ class CaptionRequest:
     request_id: str
     prompt_ids: list[int]
     frames: np.ndarray | None = None  # uint8 [N, H, W, 3]
+    # text tokens embedded BEFORE the vision block (chat templates put the
+    # system turn + <|vision_start|> ahead of the image pads); prompt_ids
+    # follow the vision block
+    prefix_ids: list[int] = field(default_factory=list)
     sampling: SamplingConfig = field(default_factory=SamplingConfig)
     # called with the finished text; may return a follow-up request
     # (two-stage caption refinement, reference vllm_interface.py:543)
@@ -61,6 +65,9 @@ class CaptionRequest:
 class _Slot:
     request: CaptionRequest
     position: int  # next cache position to write (== current length)
+    # next ROPE position — under m-rope this lags the cache position
+    # (vision tokens share t/h/w coordinates; text resumes at max(grid)+1)
+    rope_position: int = 0
     generated: list[int] = field(default_factory=list)
     # per-request generator when sampling.seed is set (reproducible
     # captions regardless of batch interleaving); None = engine-shared rng
@@ -90,6 +97,51 @@ class CaptionResult:
     owner: Any = None
 
 
+@dataclass
+class _PendingPrefill:
+    """A slot whose prompt is being prefilled chunk by chunk.
+
+    Long prompts are admitted in fixed-size chunks interleaved with decode
+    steps (vLLM chunked prefill, reference models/vllm_interface.py:543 +
+    SPEED_OF_LIGHT.md:116-121): one prefill group no longer stalls every
+    in-flight request's decode for its whole duration. The chunk program is
+    the same compiled family as bucket prefill (static [N, C, D] shapes,
+    per-row write_index), so chunking adds zero recompiles."""
+
+    request: CaptionRequest
+    embeds: np.ndarray  # [T, D] full prompt embeds
+    t_valid: int
+    rope_pos: np.ndarray  # [T] or [T, 3]
+    next_rope: int
+    progress: int = 0  # prompt tokens already written to the cache
+
+
+@dataclass
+class _Lane:
+    """One KV pool: ``n_slots`` cache rows of ``length`` positions each.
+
+    The length-bucketed answer to vLLM's paged KV (reference
+    SPEED_OF_LIGHT.md:116-121): instead of paging — dynamic gather per
+    attention read, hostile to XLA's static-shape compilation — KV memory is
+    bound by ACTUAL request lengths at bucket granularity. Short requests
+    land in short lanes, so the same HBM holds several times more
+    concurrent slots than one worst-case-length pool; decode cost already
+    scales with true lengths (kv_len masking + the Pallas kernel's early
+    exit), so lanes attack the memory axis, which paging exists to fix.
+    Each lane decodes as its own batch (programs are cached per shape)."""
+
+    length: int
+    base: int  # global slot-id offset (lane-local idx + base = public id)
+    n_slots: int
+    cache_k: Any = None
+    cache_v: Any = None
+    slots: dict = field(default_factory=dict)
+    pending: dict = field(default_factory=dict)
+    # slot indices claimed by _admit's current grouping pass (released when
+    # the group prefill runs)
+    reserved: set = field(default_factory=set)
+
+
 class CaptionEngine:
     def __init__(
         self,
@@ -98,14 +150,28 @@ class CaptionEngine:
         max_batch: int = 8,
         params: Any = None,
         tokenizer: ByteTokenizer | None = None,
+        prefill_chunk: int = 256,
+        kv_lanes: tuple[tuple[int, int], ...] | None = None,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
+        # prompts longer than this prefill in chunks of this size,
+        # interleaved with decode steps
+        self.prefill_chunk = min(prefill_chunk, cfg.max_seq)
         self.tokenizer = tokenizer or default_caption_tokenizer()
         self.model = VLM(cfg)
         self.params = params
         self.waiting: list[CaptionRequest] = []
-        self.slots: dict[int, _Slot] = {}
+        # (length, n_slots) per KV pool; default = one worst-case-length
+        # pool, the round-2 behavior
+        spec = kv_lanes or ((cfg.max_seq, max_batch),)
+        base = 0
+        self.lanes: list[_Lane] = []
+        for length, n in sorted(spec):
+            if length > cfg.max_seq:
+                raise ValueError(f"lane length {length} exceeds max_seq {cfg.max_seq}")
+            self.lanes.append(_Lane(length=length, base=base, n_slots=n))
+            base += n
         self.completed: list[CaptionResult] = []
         self._decode_tokens = 0
         self._decode_time = 0.0
@@ -118,11 +184,32 @@ class CaptionEngine:
         # so one stage's run cannot steal another stage's results.
         self._lock = threading.RLock()
 
+    # read-only aggregate views over the lanes (public slot id = lane.base
+    # + lane-local index, unique across lanes)
+    @property
+    def slots(self) -> dict[int, _Slot]:
+        return {l.base + i: s for l in self.lanes for i, s in l.slots.items()}
+
+    @property
+    def pending(self) -> dict[int, _PendingPrefill]:
+        return {l.base + i: p for l in self.lanes for i, p in l.pending.items()}
+
+    def kv_bytes(self) -> int:
+        return sum(
+            l.cache_k.nbytes + l.cache_v.nbytes
+            for l in self.lanes
+            if l.cache_k is not None
+        )
+
     # -- setup ----------------------------------------------------------
     def setup(self, seed: int = 0) -> None:
         cfg = self.cfg
         if self.params is None:
-            size = cfg.vision.image_size
+            size = (
+                cfg.qwen_vision.image_size
+                if cfg.vision_variant == "qwen2"
+                else cfg.vision.image_size
+            )
             frames = jnp.zeros((1, 1, size, size, 3), jnp.uint8)
             ids = jnp.zeros((1, 4), jnp.int32)
             ck, cv = init_cache(cfg, 1)
@@ -134,7 +221,8 @@ class CaptionEngine:
                 cv,
                 method=self.model.init_everything,
             )
-        self.cache_k, self.cache_v = init_cache(cfg, self.max_batch)
+        for lane in self.lanes:
+            lane.cache_k, lane.cache_v = init_cache(cfg, lane.n_slots, length=lane.length)
 
         model = self.model
 
@@ -146,26 +234,28 @@ class CaptionEngine:
         def embed_tokens(params, ids):
             return model.apply(params, ids, method=model.embed_tokens)
 
+        mrope = cfg.mrope_section is not None
+
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_batch(params, cache_k, cache_v, embeds, slots, t_valid):
-            """Batched bucket prefill (replaces the round-1 one-request-at-a-
-            time admission — the reference leans on vLLM's batched prefill,
-            vllm_interface.py:543). embeds: [N, Tb, D] (bucket-padded);
-            slots/t_valid: [N]. Writes every request's cache rows in one
-            program and returns each row's logits at its last valid
+        def prefill_batch(params, cache_k, cache_v, embeds, slots, write_index, t_valid, rope_pos):
+            """Batched prefill (replaces the round-1 one-request-at-a-time
+            admission — the reference leans on vLLM's batched prefill,
+            vllm_interface.py:543). embeds: [N, Tb, D] (bucket- or
+            chunk-padded); slots/write_index/t_valid: [N]; rope_pos:
+            [N, Tb] (or [N, Tb, 3] m-rope). write_index > 0 rows are later
+            chunks of a chunked prefill. Writes every row's cache cells in
+            one program and returns each row's logits at its last valid
             position: [N, V]."""
             ck = cache_k[:, slots]  # [L, N, S, Hkv, Dh]
             cv = cache_v[:, slots]
-            n, t, _ = embeds.shape
-            positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (n, t))
             logits, nk, nv = model.apply(
                 params,
                 embeds,
                 ck,
                 cv,
-                positions,
-                jnp.zeros((n,), jnp.int32),
-                t_valid,
+                rope_pos,
+                write_index,
+                write_index + t_valid,
             )
             cache_k = cache_k.at[:, slots].set(nk)
             cache_v = cache_v.at[:, slots].set(nv)
@@ -175,19 +265,25 @@ class CaptionEngine:
             return last, cache_k, cache_v
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, cache_k, cache_v, tokens, positions):
-            """tokens/positions: [max_batch]; one token for every slot.
+        def decode_step(params, cache_k, cache_v, tokens, positions, rope_positions):
+            """tokens/positions/rope_positions: [max_batch]; one token per
+            slot. positions index the cache; rope_positions are the rotary
+            positions (identical unless m-rope lagged them at prefill).
 
             Greedy argmax happens ON DEVICE for the whole batch — per-slot
             host argmaxes were the decode loop's bottleneck (one device
             sync per slot per token)."""
             embeds = model.apply(params, tokens[:, None], method=model.embed_tokens)
+            rp = rope_positions[:, None]
+            if mrope:
+                # decode is always text: all three components equal
+                rp = jnp.broadcast_to(rp[..., None], (*rp.shape, 3))
             logits, ck, cv = model.apply(
                 params,
                 embeds,
                 cache_k,
                 cache_v,
-                positions[:, None],
+                rp,
                 positions,
                 positions + 1,
             )
@@ -203,13 +299,21 @@ class CaptionEngine:
         self._built = True
 
     # -- public API -----------------------------------------------------
+    @property
+    def _max_len(self) -> int:
+        return self.lanes[-1].length  # lanes are sorted by length
+
     def add_request(self, request: CaptionRequest, owner: Any = None) -> None:
-        budget = self.cfg.max_seq - request.sampling.max_new_tokens - 1
+        budget = self._max_len - request.sampling.max_new_tokens - 1
         if budget <= 0:
             raise ValueError(
                 f"max_new_tokens={request.sampling.max_new_tokens} leaves no "
-                f"prompt budget in max_seq={self.cfg.max_seq}"
+                f"prompt budget in the longest KV lane ({self._max_len})"
             )
+        if any(not s for s in request.sampling.stop):
+            # '' in tail is always True — an empty stop string would finish
+            # the request after one token with empty text
+            raise ValueError("stop strings must be non-empty")
         if request.owner is None:
             request.owner = owner if owner is not None else threading.get_ident()
         with self._lock:
@@ -218,9 +322,11 @@ class CaptionEngine:
     def has_work(self, owner: Any = None) -> bool:
         with self._lock:
             if owner is None:
-                return bool(self.waiting or self.slots)
-            return any(r.owner == owner for r in self.waiting) or any(
-                s.request.owner == owner for s in self.slots.values()
+                return bool(self.waiting or self.slots or self.pending)
+            return (
+                any(r.owner == owner for r in self.waiting)
+                or any(s.request.owner == owner for s in self.slots.values())
+                or any(p.request.owner == owner for p in self.pending.values())
             )
 
     def run_until_complete(self, owner: Any = None) -> list[CaptionResult]:
@@ -252,34 +358,85 @@ class CaptionEngine:
 
     # -- engine internals ----------------------------------------------
     def step(self) -> None:
-        """Admit waiting requests into free slots, then one decode step."""
+        """Admit waiting requests, advance chunked prefills by ONE chunk,
+        then one decode step per active lane — so a long prompt never blocks
+        the in-flight batch's decode for more than a chunk's latency."""
         if not self._built:
             raise RuntimeError("call setup() first")
         with self._lock:
             self._admit()
-            if self.slots:
-                self._decode_once()
+            for lane in self.lanes:
+                if lane.pending:
+                    self._prefill_chunk_step(lane)
+                if lane.slots:
+                    self._decode_once(lane)
+
+    def _route(self, need: int) -> _Lane | None:
+        """Smallest lane that fits ``need`` positions and has a free slot."""
+        for lane in self.lanes:  # sorted by length
+            occupied = len(lane.slots) + len(lane.pending) + len(lane.reserved)
+            if lane.length >= need and occupied < lane.n_slots:
+                return lane
+        return None
+
+    def _prompt_len_estimate(self, req: CaptionRequest) -> int:
+        """Prompt length WITHOUT running the encoders (used for routing)."""
+        n = len(req.prefix_ids) + len(req.prompt_ids)
+        if req.frames is not None:
+            if self.cfg.vision_variant == "qwen2":
+                n += self.cfg.qwen_vision.tokens_out(req.frames.shape[0])
+            else:
+                n += self.cfg.vision_tokens
+        return min(n, self._max_len - req.sampling.max_new_tokens - 1)
 
     def _admit(self) -> None:
-        free = [i for i in range(self.max_batch) if i not in self.slots]
-        prepared: list[tuple[int, CaptionRequest, Any, int]] = []
-        while free and self.waiting:
-            slot_idx = free.pop(0)
-            req = self.waiting.pop(0)
+        groups: dict[tuple[int, int], list[tuple]] = {}
+        while self.waiting:
+            req = self.waiting[0]
+            need = self._prompt_len_estimate(req) + req.sampling.max_new_tokens + 1
+            lane = self._route(min(need, self._max_len))
+            if lane is None:
+                break  # head-of-line waits for a slot to free (FIFO)
+            self.waiting.pop(0)
             try:
-                embeds, t_valid = self._prepare_embeds(req)
+                embeds, t_valid, rope_pos, next_rope = self._prepare_embeds(req)
             except Exception:
                 logger.exception("prefill prep failed for %s; dropping", req.request_id)
                 continue
-            prepared.append((slot_idx, req, embeds, t_valid))
-        # group by prefill bucket; each group runs ONE batched prefill
-        groups: dict[int, list[tuple[int, CaptionRequest, Any, int]]] = {}
-        for item in prepared:
-            bucket = min(next_pow2(item[3]), self.cfg.max_seq)
-            groups.setdefault(bucket, []).append(item)
-        for bucket, items in sorted(groups.items()):
+            lane_budget = lane.length - req.sampling.max_new_tokens - 1
+            if t_valid > lane_budget:  # estimate was off: truncate to fit
+                embeds = embeds[-lane_budget:]
+                rope_pos = rope_pos[-lane_budget:]
+                t_valid = lane_budget
+            slot_idx = next(
+                i
+                for i in range(lane.n_slots)
+                if i not in lane.slots
+                and i not in lane.pending
+                and i not in lane.reserved
+            )
+            if t_valid > self.prefill_chunk:
+                # long prompt: prefill in chunks interleaved with decode
+                lane.pending[slot_idx] = _PendingPrefill(
+                    request=req,
+                    embeds=np.asarray(embeds, np.float32),
+                    t_valid=t_valid,
+                    rope_pos=np.asarray(rope_pos),
+                    next_rope=next_rope,
+                )
+                continue
+            bucket = min(next_pow2(t_valid), lane.length)
+            groups.setdefault((self.lanes.index(lane), bucket), []).append(
+                (slot_idx, req, embeds, t_valid, rope_pos, next_rope)
+            )
+            # reserve the slot so this loop's later iterations see it taken
+            lane.reserved.add(slot_idx)
+        for (lane_i, bucket), items in sorted(groups.items()):
+            lane = self.lanes[lane_i]
+            for slot_idx, *_ in items:  # release the reservations
+                lane.reserved.discard(slot_idx)
             try:
-                self._prefill_group(bucket, items)
+                self._prefill_group(lane, bucket, items)
             except Exception:
                 if len(items) == 1:
                     logger.exception(
@@ -293,30 +450,55 @@ class CaptionEngine:
                 )
                 for item in items:
                     try:
-                        self._prefill_group(bucket, [item])
+                        self._prefill_group(lane, bucket, [item])
                     except Exception:
                         logger.exception(
                             "prefill failed for %s; dropping", item[1].request_id
                         )
 
     def _prepare_embeds(self, req: CaptionRequest):
-        """Vision encode + token embed for one request -> ([T, D], t_valid)."""
+        """Vision encode + token embed for one request.
+
+        Returns ([T, D] embeds, t_valid, [T(,3)] rope positions, next_rope).
+        Under m-rope the rope positions come from build_mrope_positions over
+        the [prefix][vision][prompt] layout; otherwise they are arange."""
+        from cosmos_curate_tpu.models.vlm.model import build_mrope_positions
+
         parts = []
+        grid_merged = None
+        if req.prefix_ids:
+            pre = jnp.asarray(req.prefix_ids, jnp.int32)
+            parts.append(self._embed_tokens(self.params, pre[None])[0])
         if req.frames is not None:
             vis = self._encode_images(self.params, jnp.asarray(req.frames)[None])
             parts.append(vis[0])
+            if self.cfg.vision_variant == "qwen2":
+                grid_merged = self.cfg.qwen_vision.merged_grid(req.frames.shape[0])
         ids = jnp.asarray(req.prompt_ids, jnp.int32)
         parts.append(self._embed_tokens(self.params, ids[None])[0])
         embeds = jnp.concatenate(parts, axis=0)
         t_valid = embeds.shape[0]
-        budget = self.cfg.max_seq - req.sampling.max_new_tokens - 1
+        if self.cfg.mrope_section is not None:
+            n_vis = t_valid - len(req.prefix_ids) - len(req.prompt_ids)
+            if grid_merged is None and n_vis:
+                # vit-variant vision tokens: treat as a 1 x 1 x n_vis row
+                grid_merged = (1, 1, n_vis)
+            rope_pos, next_rope = build_mrope_positions(
+                len(req.prefix_ids), grid_merged, len(req.prompt_ids)
+            )
+        else:
+            rope_pos = np.arange(t_valid, dtype=np.int32)
+            next_rope = t_valid
+        budget = self._max_len - req.sampling.max_new_tokens - 1
         if t_valid > budget:
-            # keep the tail (task instructions usually come last)
+            # keep the tail (task instructions usually come last); rope
+            # positions stay absolute for the kept tokens
             embeds = embeds[-budget:]
+            rope_pos = rope_pos[-budget:]
             t_valid = budget
-        return embeds, t_valid
+        return embeds, t_valid, rope_pos, next_rope
 
-    def _prefill_group(self, bucket: int, items: list) -> None:
+    def _prefill_group(self, lane: _Lane, bucket: int, items: list) -> None:
         """One batched prefill for all requests sharing a length bucket.
 
         The row count is padded to a power of two by duplicating row 0
@@ -324,91 +506,177 @@ class CaptionEngine:
         values), so compiled program count stays O(log max_batch x
         log max_seq)."""
         n = len(items)
-        n_pad = min(next_pow2(n), self.max_batch)
+        n_pad = next_pow2(n)  # bounded by next_pow2(lane.n_slots)
         dim = items[0][2].shape[-1]
         embeds = np.zeros((n_pad, bucket, dim), np.float32)
         slots_arr = np.zeros(n_pad, np.int32)
         t_valids = np.ones(n_pad, np.int32)
-        for j, (slot_idx, _req, emb, t_valid) in enumerate(items):
+        mrope = self.cfg.mrope_section is not None
+        rope_shape = (n_pad, bucket, 3) if mrope else (n_pad, bucket)
+        rope_buf = np.zeros(rope_shape, np.int32)
+        for j, (slot_idx, _req, emb, t_valid, rope_pos, _next) in enumerate(items):
             embeds[j, :t_valid] = np.asarray(emb, np.float32)[:t_valid]
             slots_arr[j] = slot_idx
             t_valids[j] = t_valid
+            rope_buf[j, :t_valid] = rope_pos[:t_valid]
         for j in range(n, n_pad):  # duplicate row 0 into padding
             embeds[j] = embeds[0]
             slots_arr[j] = slots_arr[0]
             t_valids[j] = t_valids[0]
-        logits, self.cache_k, self.cache_v = self._prefill_batch(
+            rope_buf[j] = rope_buf[0]
+        logits, lane.cache_k, lane.cache_v = self._prefill_batch(
             self.params,
-            self.cache_k,
-            self.cache_v,
+            lane.cache_k,
+            lane.cache_v,
             jnp.asarray(embeds),
             jnp.asarray(slots_arr),
+            jnp.zeros(n_pad, jnp.int32),
             jnp.asarray(t_valids),
+            jnp.asarray(rope_buf),
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
-        for j, (slot_idx, req, _emb, t_valid) in enumerate(items):
-            # seed=None is the unseeded sentinel; any int (incl. 0) pins
-            rng = (
-                np.random.default_rng(req.sampling.seed)
-                if req.sampling.seed is not None
-                else None
-            )
-            counts: dict[int, int] | None = None
-            s = req.sampling
-            if (
-                s.repetition_penalty != 1.0
-                or s.presence_penalty != 0.0
-                or s.frequency_penalty != 0.0
-            ):
-                # penalty history covers prompt tokens too (vLLM
-                # semantics); maintained incrementally from here on
-                counts = {}
-                for t in req.prompt_ids:
-                    counts[t] = counts.get(t, 0) + 1
-            first = sample_token(
-                logits_np[j],
-                req.sampling,
-                generated=counts,
-                num_generated=0,
-                eos_id=self.tokenizer.eos_id,
-                rng=rng if rng is not None else self._host_rng,
-            )
-            slot = _Slot(
-                request=req,
-                position=t_valid,
-                generated=[first],
-                rng=rng,
-                penalty_counts=counts,
-            )
-            if counts is not None:
-                counts[first] = counts.get(first, 0) + 1
-            if req.sampling.stop:
-                slot.raw += self.tokenizer.decode_bytes([first])
-            self.slots[slot_idx] = slot
-            self._maybe_finish(slot_idx, slot)
+        for j, (slot_idx, req, _emb, t_valid, _rope, next_rope) in enumerate(items):
+            self._start_slot(lane, slot_idx, req, t_valid, next_rope, logits_np[j])
 
-    def _decode_once(self) -> None:
-        tokens = np.full(self.max_batch, self.tokenizer.pad_id, np.int32)
-        positions = np.zeros(self.max_batch, np.int32)
-        for i, slot in self.slots.items():
+    def _start_slot(
+        self,
+        lane: _Lane,
+        slot_idx: int,
+        req: CaptionRequest,
+        t_valid: int,
+        next_rope: int,
+        logits_row: np.ndarray,
+    ) -> None:
+        """Sample the first token from the last-prompt-position logits and
+        enter the slot into the continuous decode batch."""
+        # seed=None is the unseeded sentinel; any int (incl. 0) pins
+        rng = (
+            np.random.default_rng(req.sampling.seed)
+            if req.sampling.seed is not None
+            else None
+        )
+        counts: dict[int, int] | None = None
+        s = req.sampling
+        if (
+            s.repetition_penalty != 1.0
+            or s.presence_penalty != 0.0
+            or s.frequency_penalty != 0.0
+        ):
+            # penalty history covers prompt tokens too (vLLM
+            # semantics); maintained incrementally from here on
+            counts = {}
+            for t in [*req.prefix_ids, *req.prompt_ids]:
+                counts[t] = counts.get(t, 0) + 1
+        first = sample_token(
+            logits_row,
+            req.sampling,
+            generated=counts,
+            num_generated=0,
+            eos_id=self.tokenizer.eos_id,
+            rng=rng if rng is not None else self._host_rng,
+        )
+        slot = _Slot(
+            request=req,
+            position=t_valid,
+            rope_position=next_rope,
+            generated=[first],
+            rng=rng,
+            penalty_counts=counts,
+        )
+        if counts is not None:
+            counts[first] = counts.get(first, 0) + 1
+        if req.sampling.stop:
+            slot.raw += self.tokenizer.decode_bytes([first])
+        lane.slots[slot_idx] = slot
+        self._maybe_finish(lane, slot_idx, slot)
+
+    def _prefill_chunk_step(self, lane: _Lane) -> None:
+        """Advance every pending chunked prefill by one chunk (one batched
+        program call); rows finishing their prompt enter the decode batch."""
+        C = self.prefill_chunk
+        items = list(lane.pending.items())
+        if not items:
+            return
+        n = len(items)
+        n_pad = next_pow2(n)  # bounded by next_pow2(lane.n_slots)
+        dim = items[0][1].embeds.shape[-1]
+        mrope = self.cfg.mrope_section is not None
+        embeds = np.zeros((n_pad, C, dim), np.float32)
+        slots_arr = np.zeros(n_pad, np.int32)
+        write_idx = np.zeros(n_pad, np.int32)
+        chunk_valid = np.ones(n_pad, np.int32)
+        rope_buf = np.zeros((n_pad, C, 3) if mrope else (n_pad, C), np.int32)
+        for j, (slot_idx, p) in enumerate(items):
+            take = min(C, p.t_valid - p.progress)
+            embeds[j, :take] = p.embeds[p.progress : p.progress + take]
+            slots_arr[j] = slot_idx
+            write_idx[j] = p.progress
+            chunk_valid[j] = take
+            rope_buf[j, :take] = p.rope_pos[p.progress : p.progress + take]
+        for j in range(n, n_pad):  # duplicate row 0 (identical writes: safe)
+            embeds[j] = embeds[0]
+            slots_arr[j] = slots_arr[0]
+            write_idx[j] = write_idx[0]
+            chunk_valid[j] = chunk_valid[0]
+            rope_buf[j] = rope_buf[0]
+        logits, lane.cache_k, lane.cache_v = self._prefill_batch(
+            self.params,
+            lane.cache_k,
+            lane.cache_v,
+            jnp.asarray(embeds),
+            jnp.asarray(slots_arr),
+            jnp.asarray(write_idx),
+            jnp.asarray(chunk_valid),
+            jnp.asarray(rope_buf),
+        )
+        finished = []
+        for j, (slot_idx, p) in enumerate(items):
+            p.progress += min(C, p.t_valid - p.progress)
+            if p.progress >= p.t_valid:
+                finished.append((j, slot_idx, p))
+        if finished:
+            logits_np = np.asarray(logits)
+            for j, slot_idx, p in finished:
+                del lane.pending[slot_idx]
+                self._start_slot(lane, slot_idx, p.request, p.t_valid, p.next_rope, logits_np[j])
+
+    def _decode_once(self, lane: _Lane) -> None:
+        tokens = np.full(lane.n_slots, self.tokenizer.pad_id, np.int32)
+        positions = np.zeros(lane.n_slots, np.int32)
+        rope_positions = np.zeros(lane.n_slots, np.int32)
+        # The decode program scatters K/V for EVERY row (static shapes, no
+        # write mask), so idle rows' write positions must be harmless.
+        # Fully-free rows hold no valid data — position 0 is fine — but a
+        # row mid-chunked-prefill holds real prompt K/V: point its write at
+        # p.progress, the exact cell the NEXT chunk overwrites anyway,
+        # so the pad-token garbage can never survive into attention reads.
+        for i, p in lane.pending.items():
+            positions[i] = p.progress
+        for i, slot in lane.slots.items():
             tokens[i] = slot.generated[-1]
             positions[i] = slot.position
+            rope_positions[i] = slot.rope_position
         t0 = time.monotonic()
-        greedy, logits, self.cache_k, self.cache_v = self._decode(
-            self.params, self.cache_k, self.cache_v, jnp.asarray(tokens), jnp.asarray(positions)
+        greedy, logits, lane.cache_k, lane.cache_v = self._decode(
+            self.params,
+            lane.cache_k,
+            lane.cache_v,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(rope_positions),
         )
         greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
         self._decode_time += time.monotonic() - t0
-        self._decode_tokens += len(self.slots)
+        self._decode_tokens += len(lane.slots)
         # the device argmax suffices only for pure-greedy rows with no
         # penalties and min_tokens already satisfied
         needs_logits = any(
             s.request.sampling.needs_logits(len(s.generated))
-            for s in self.slots.values()
+            for s in lane.slots.values()
         )
         logits_np = np.asarray(logits) if needs_logits else None
-        for i in list(self.slots):
-            slot = self.slots[i]
+        for i in list(lane.slots):
+            slot = lane.slots[i]
             if slot.request.sampling.needs_logits(len(slot.generated)):
                 nxt = sample_token(
                     logits_np[i],
@@ -428,14 +696,15 @@ class CaptionEngine:
             if slot.request.sampling.stop:
                 slot.raw += self.tokenizer.decode_bytes([nxt])
             slot.position += 1
-            self._maybe_finish(i, slot)
+            slot.rope_position += 1
+            self._maybe_finish(lane, i, slot)
 
-    def _maybe_finish(self, slot_idx: int, slot: _Slot) -> None:
+    def _maybe_finish(self, lane: _Lane, slot_idx: int, slot: _Slot) -> None:
         req = slot.request
         done = (
             slot.generated[-1] == self.tokenizer.eos_id
             or len(slot.generated) >= req.sampling.max_new_tokens
-            or slot.position + 1 >= self.cfg.max_seq
+            or slot.position + 1 >= lane.length
         )
         stop_text: str | None = None
         if not done and req.sampling.stop:
@@ -453,7 +722,7 @@ class CaptionEngine:
                 done = stop_text is not None
         if not done:
             return
-        del self.slots[slot_idx]
+        del lane.slots[slot_idx]
         out_ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
         text = stop_text if stop_text is not None else self.tokenizer.decode(out_ids)
         if stop_text is None and req.sampling.stop:
@@ -464,7 +733,7 @@ class CaptionEngine:
         result = CaptionResult(
             request_id=req.request_id,
             text=text,
-            num_prompt_tokens=len(req.prompt_ids),
+            num_prompt_tokens=len(req.prefix_ids) + len(req.prompt_ids),
             num_output_tokens=len(slot.generated),
             metadata=req.metadata,
             owner=req.owner,

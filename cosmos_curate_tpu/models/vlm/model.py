@@ -23,9 +23,17 @@ from functools import partial
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cosmos_curate_tpu.models.layers import MODEL_AXIS, dense
 from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
+from cosmos_curate_tpu.models.vlm.vision_qwen import (
+    QWEN2_VL_2B_VISION,
+    QWEN_VISION_TINY_TEST,
+    QwenVisionConfig,
+    QwenVisionTower,
+    frames_to_patches,
+)
 
 
 @dataclass(frozen=True)
@@ -43,15 +51,24 @@ class VLMConfig:
     qkv_bias: bool = False
     vision: ViTConfig = VIT_B_16
     vision_tokens: int = 64  # LM embeddings per image after pooling
+    # "vit" = our shared ViT backbone + projector; "qwen2" = the Qwen2-VL
+    # vision tower (vision_qwen.py), whose merger IS the projector
+    vision_variant: str = "vit"
+    qwen_vision: QwenVisionConfig | None = None
+    # Qwen2-VL multimodal rope: freq dims split into (t, h, w) sections
+    # (HF `rope_scaling.mrope_section`); None = standard 1D rope
+    mrope_section: tuple[int, int, int] | None = None
+    rms_eps: float = 1e-6
 
 
 VLM_BASE = VLMConfig()
 # Qwen2-VL-2B-class shapes (reference serves Qwen2/2.5-VL via vLLM,
-# cosmos_curate/models/vllm_qwen.py:122-260): the LM stack matches
-# Qwen2-VL-2B-Instruct tensor-for-tensor (GQA 12/2 heads, SwiGLU 8960,
-# tied embeddings, rope 1e6) so convert_qwen.convert_qwen2_lm can load the
-# real checkpoint; the vision tower stays our ViT (Qwen's windowed vision
-# encoder is architecturally different — documented in convert_qwen.py).
+# cosmos_curate/models/vllm_qwen.py:122-260): both halves match
+# Qwen2-VL-2B-Instruct tensor-for-tensor — the LM stack (GQA 12/2 heads,
+# SwiGLU 8960, tied embeddings, rope 1e6, m-rope 16/24/24) via
+# convert_qwen.convert_qwen2_lm, and the vision tower (32-deep 1280-wide
+# windowless ViT with 3D-conv patchify, 2D rope, patch merger) via
+# convert_qwen.convert_qwen2_vision — so a real checkpoint loads completely.
 VLM_QWEN2_2B = VLMConfig(
     vocab=151936,
     dim=1536,
@@ -65,6 +82,9 @@ VLM_QWEN2_2B = VLMConfig(
     qkv_bias=True,
     vision=VIT_B_16,
     vision_tokens=64,
+    vision_variant="qwen2",
+    qwen_vision=QWEN2_VL_2B_VISION,
+    mrope_section=(16, 24, 24),
 )
 VLM_TINY_TEST = VLMConfig(
     vocab=512,
@@ -77,21 +97,87 @@ VLM_TINY_TEST = VLMConfig(
     vision=VIT_TINY_TEST,
     vision_tokens=8,
 )
+VLM_QWEN2VL_TINY_TEST = VLMConfig(
+    vocab=512,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    max_seq=128,
+    vision=VIT_TINY_TEST,
+    vision_variant="qwen2",
+    qwen_vision=QWEN_VISION_TINY_TEST,
+    mrope_section=(2, 3, 3),
+)
 
 
 def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """x: [B, T, H, D]; positions: [B, T] absolute positions."""
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    theta: float,
+    mrope_section: tuple[int, int, int] | None = None,
+) -> jnp.ndarray:
+    """x: [B, T, H, D]; positions: [B, T] absolute positions, or [B, T, 3]
+    (t, h, w) multimodal positions under m-rope.
+
+    M-rope (HF apply_multimodal_rotary_pos_emb semantics): the D/2 rotary
+    frequency dims are split into mrope_section chunks; chunk c's angles
+    use position component c. With all three components equal (any pure-text
+    span) this reduces exactly to standard 1D rope.
+    """
     freqs = rope_frequencies(x.shape[-1], theta)  # [D/2]
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    if positions.ndim == 3:
+        if mrope_section is None:
+            raise ValueError("3-component positions require mrope_section")
+        comp = np.repeat(np.arange(3), np.asarray(mrope_section))  # [D/2]
+        pos_sel = positions[..., comp].astype(jnp.float32)  # [B, T, D/2]
+        angles = pos_sel * freqs
+    else:
+        angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
+
+
+def build_mrope_positions(
+    n_text_before: int,
+    grid_merged: tuple[int, int, int] | None,
+    n_text_after: int,
+) -> tuple[np.ndarray, int]:
+    """(t, h, w) position ids for a [text][vision][text] prompt layout.
+
+    HF ``Qwen2VLModel.get_rope_index`` semantics: text tokens carry equal
+    components; a vision block starting at offset ``st`` gets
+    ``st + (t_idx, h_idx, w_idx)`` over the MERGED token grid in t-major
+    row-major order (exactly the merger's output order); text resumes at
+    ``st + max(grid)``. Returns ([T, 3] int32, next_position).
+    """
+    parts = []
+    if n_text_before:
+        t = np.arange(n_text_before, dtype=np.int32)
+        parts.append(np.stack([t, t, t], axis=-1))
+    offset = n_text_before
+    if grid_merged is not None:
+        gt, gh, gw = grid_merged
+        t_idx = np.repeat(np.arange(gt, dtype=np.int32), gh * gw)
+        h_idx = np.tile(np.repeat(np.arange(gh, dtype=np.int32), gw), gt)
+        w_idx = np.tile(np.tile(np.arange(gw, dtype=np.int32), gh), gt)
+        parts.append(offset + np.stack([t_idx, h_idx, w_idx], axis=-1))
+        offset += max(gt, gh, gw)
+    if n_text_after:
+        t = offset + np.arange(n_text_after, dtype=np.int32)
+        parts.append(np.stack([t, t, t], axis=-1))
+        offset += n_text_after
+    if not parts:
+        return np.zeros((0, 3), np.int32), offset
+    return np.concatenate(parts, axis=0).astype(np.int32), offset
 
 
 def _use_flash_decode(cache_len: int) -> bool:
@@ -107,11 +193,13 @@ def _use_flash_decode(cache_len: int) -> bool:
 
 
 class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
         x32 = x.astype(jnp.float32)
-        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
         return (normed * scale).astype(x.dtype)
 
 
@@ -123,22 +211,24 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, cache_k, cache_v, positions, write_index, kv_len):
         """One decoder layer with slot KV cache.
 
-        x: [B, T, D]; cache_k/v: [B, S, Hkv, Dh]; positions: [B, T];
-        write_index: [B] offset where this chunk's K/V land; kv_len: [B]
-        valid cache length AFTER writing (= write_index + T for active rows).
-        Returns (y, new_cache_k, new_cache_v).
+        x: [B, T, D]; cache_k/v: [B, S, Hkv, Dh]; positions: [B, T] rope
+        positions (or [B, T, 3] m-rope components — under m-rope, rope
+        position ≠ cache index, so causality derives from write_index, not
+        positions); write_index: [B] offset where this chunk's K/V land;
+        kv_len: [B] valid cache length AFTER writing (= write_index + T for
+        active rows). Returns (y, new_cache_k, new_cache_v).
         """
         cfg = self.cfg
         b, t, _ = x.shape
         s = cache_k.shape[1]
         h, hk, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-        y = RMSNorm(name="ln1")(x)
+        y = RMSNorm(eps=cfg.rms_eps, name="ln1")(x)
         q = dense(h * dh, "out", name="q", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
         k = dense(hk * dh, "out", name="k", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
         v = dense(hk * dh, "out", name="v", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
-        q = apply_rope(q.reshape(b, t, h, dh), positions, cfg.rope_theta)
-        k = apply_rope(k.reshape(b, t, hk, dh), positions, cfg.rope_theta)
+        q = apply_rope(q.reshape(b, t, h, dh), positions, cfg.rope_theta, cfg.mrope_section)
+        k = apply_rope(k.reshape(b, t, hk, dh), positions, cfg.rope_theta, cfg.mrope_section)
         v = v.reshape(b, t, hk, dh)
 
         # scatter this chunk into the cache at each row's write_index
@@ -167,7 +257,10 @@ class DecoderLayer(nn.Module):
                 "btkgd,bskd->bkgts", qg.astype(jnp.float32), new_k.astype(jnp.float32)
             )
             k_pos = jnp.arange(s)[None, None, None, None, :]  # cache slot index
-            causal = k_pos <= positions[:, None, None, :, None]  # key pos <= query pos
+            # causality is over cache order (write_index + chunk offset) —
+            # under m-rope the rope positions are NOT monotone in it
+            q_seq = write_index[:, None] + jnp.arange(t)[None, :]  # [B, T]
+            causal = k_pos <= q_seq[:, None, None, :, None]
             written = k_pos < kv_len[:, None, None, None, None]
             logits = jnp.where(causal & written, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
@@ -175,7 +268,7 @@ class DecoderLayer(nn.Module):
         attn = attn.reshape(b, t, h * dh)
         x = x + dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(attn)
 
-        y = RMSNorm(name="ln2")(x)
+        y = RMSNorm(eps=cfg.rms_eps, name="ln2")(x)
         up = dense(int(cfg.dim * cfg.hidden_mult), "out", name="up", use_bias=False, dtype=self.dtype)(y)
         gate = dense(int(cfg.dim * cfg.hidden_mult), "out", name="gate", use_bias=False, dtype=self.dtype)(y)
         down = dense(cfg.dim, "in", name="down", use_bias=False, dtype=self.dtype)(
@@ -198,24 +291,34 @@ class VLM(nn.Module):
             embedding_init=nn.with_partitioning(nn.initializers.normal(0.02), (None, MODEL_AXIS)),
         )
         self.layers = [DecoderLayer(cfg, dtype=self.dtype, name=f"layer_{i}") for i in range(cfg.n_layers)]
-        self.ln_f = RMSNorm(name="ln_f")
-        self.vision_tower = ViT(cfg.vision, dtype=self.dtype, name="vision")
-        self.projector = nn.Sequential(
-            [
-                dense(cfg.dim * 2, None, use_bias=True, dtype=self.dtype),
-                nn.gelu,
-                dense(cfg.dim, None, use_bias=True, dtype=self.dtype),
-            ],
-            name="projector",
-        )
+        self.ln_f = RMSNorm(eps=cfg.rms_eps, name="ln_f")
+        if cfg.vision_variant == "qwen2":
+            self.vision_tower = QwenVisionTower(cfg.qwen_vision, dtype=self.dtype, name="vision")
+            self.projector = None  # the Qwen merger already maps to LM dim
+        else:
+            self.vision_tower = ViT(cfg.vision, dtype=self.dtype, name="vision")
+            self.projector = nn.Sequential(
+                [
+                    dense(cfg.dim * 2, None, use_bias=True, dtype=self.dtype),
+                    nn.gelu,
+                    dense(cfg.dim, None, use_bias=True, dtype=self.dtype),
+                ],
+                name="projector",
+            )
 
     def encode_images(self, frames_u8):
-        """uint8 [B, N, Hp, Wp, 3] -> [B, vision_tokens, dim] LM embeddings.
+        """uint8 [B, N, Hp, Wp, 3] -> [B, T_vis, dim] LM embeddings.
 
-        N frames are encoded by the ViT; their patch tokens are mean-pooled
-        over frames, then strided down to ``vision_tokens`` and projected.
+        ``vit`` variant: frames through the ViT, patch tokens mean-pooled
+        over frames, strided to ``vision_tokens``, projected.
+        ``qwen2`` variant: frames → 3D patches → QwenVisionTower; the merged
+        token grid (t·h·w/merge²) IS the LM embedding sequence, ordered
+        t-major row-major (what build_mrope_positions assumes).
         """
         cfg = self.cfg
+        if cfg.vision_variant == "qwen2":
+            patches, grid = frames_to_patches(frames_u8, cfg.qwen_vision)
+            return self.vision_tower(patches, grid)
         b, n = frames_u8.shape[:2]
         pixels = preprocess_frames(
             frames_u8, image_size=cfg.vision.image_size, mode=cfg.vision.preprocess
@@ -265,6 +368,6 @@ class VLM(nn.Module):
         return logits, jnp.stack(new_ks), jnp.stack(new_vs)
 
 
-def init_cache(cfg: VLMConfig, batch: int, dtype=jnp.bfloat16):
-    shape = (cfg.n_layers, batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+def init_cache(cfg: VLMConfig, batch: int, dtype=jnp.bfloat16, length: int | None = None):
+    shape = (cfg.n_layers, batch, length or cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,216 @@
+"""Qwen2-VL vision tower in Flax — 3D-conv patchify, 2D rope, patch merger.
+
+Equivalent capability of the vision encoder the reference serves through
+vLLM for its Qwen-VL captioners (cosmos_curate/models/vllm_qwen.py:122-260;
+HF `Qwen2VisionTransformerPretrainedModel`): tensor-for-tensor the same
+architecture, so `convert_qwen.convert_qwen2_vision` can load a real
+Qwen2-VL checkpoint's ``visual.*`` weights and multimodal captions see the
+trained tower, not a random-init stand-in.
+
+TPU-first differences from the HF implementation (behavior-preserving):
+
+- **Static shapes.** HF flattens all images of a request into one ragged
+  sequence partitioned by ``cu_seqlens``; here a batch is a dense
+  ``[B, S, patch_dim]`` array with one static ``(t, h, w)`` grid per
+  compiled program (the caption engine buckets by shape anyway), so
+  attention is one big batched MXU matmul instead of per-image splits.
+- **Patchify as a matmul.** The Conv3d with kernel == stride over
+  pre-extracted patches is exactly a dense layer on the flattened patch
+  vector — one ``[B*S, patch_dim] @ [patch_dim, embed]`` MXU call.
+- The 2D rotary tables and the merge-window patch ordering are computed
+  host-side once per grid (static) and closed over by the jitted program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.models.layers import dense, quick_gelu
+
+
+@dataclass(frozen=True)
+class QwenVisionConfig:
+    depth: int = 32
+    embed_dim: int = 1280
+    num_heads: int = 16
+    hidden_size: int = 1536  # LM dim the merger projects into
+    mlp_ratio: float = 4.0
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    in_channels: int = 3
+    image_size: int = 224  # our fixed inference resolution
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size**2
+
+    def grid(self, n_frames: int) -> tuple[int, int, int]:
+        """Static (t, h, w) patch grid for n_frames at image_size."""
+        t = -(-n_frames // self.temporal_patch_size)
+        hw = self.image_size // self.patch_size
+        return t, hw, hw
+
+    def tokens_out(self, n_frames: int) -> int:
+        t, h, w = self.grid(n_frames)
+        return t * h * w // self.spatial_merge_size**2
+
+    def merged_grid(self, n_frames: int) -> tuple[int, int, int]:
+        """Grid of MERGED tokens (what the LM sees; m-rope position space)."""
+        t, h, w = self.grid(n_frames)
+        m = self.spatial_merge_size
+        return t, h // m, w // m
+
+
+# Qwen2-VL-2B-Instruct's visual config (depth 32 / 1280 / 16 heads,
+# merger → 1536). hidden_size must match the LM dim.
+QWEN2_VL_2B_VISION = QwenVisionConfig()
+QWEN_VISION_TINY_TEST = QwenVisionConfig(
+    depth=2,
+    embed_dim=64,
+    num_heads=4,
+    hidden_size=64,
+    mlp_ratio=2.0,
+    patch_size=8,
+    image_size=32,
+)
+
+
+def rotary_tables(cfg: QwenVisionConfig, grid: tuple[int, int, int]) -> np.ndarray:
+    """Host-side [S, head_dim] rope angles in merge-window patch order.
+
+    Matches HF ``rot_pos_emb`` (modeling_qwen2_vl.py): h/w position ids are
+    permuted so each spatial_merge_size² window is contiguous, each position
+    indexes a 1D table of ``outer(pos, inv_freq(head_dim//2))``, the (h, w)
+    halves concatenate to head_dim//2, then the whole thing doubles for
+    rotate-half cos/sin.
+    """
+    t, h, w = grid
+    msz = cfg.spatial_merge_size
+    hpos = np.arange(h)[:, None].repeat(w, axis=1)
+    wpos = np.arange(w)[None, :].repeat(h, axis=0)
+
+    def merge_order(pos):
+        return (
+            pos.reshape(h // msz, msz, w // msz, msz).transpose(0, 2, 1, 3).reshape(-1)
+        )
+
+    hpos, wpos = merge_order(hpos), merge_order(wpos)  # [h*w]
+    dim = cfg.head_dim // 2
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    table = np.arange(max(h, w), dtype=np.float64)[:, None] * inv_freq[None, :]
+    angles = np.concatenate([table[hpos], table[wpos]], axis=-1)  # [h*w, dim]
+    angles = np.tile(angles, (t, 1))  # temporal repeat: same 2D pos every t
+    return np.concatenate([angles, angles], axis=-1).astype(np.float32)  # [S, head_dim]
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+class QwenVisionBlock(nn.Module):
+    cfg: QwenVisionConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, cos, sin, block_mask):
+        """x: [B, S, E]; cos/sin: [S, head_dim] rope tables; block_mask:
+        [S, S] bool — HF splits attention at cu_seqlens boundaries (each
+        temporal frame's h·w patches attend only among themselves), which
+        for our static grid is a block-diagonal mask."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        h, dh = cfg.num_heads, cfg.head_dim
+
+        y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln1")(x)
+        # fused qkv (one MXU matmul), as in the checkpoint layout
+        qkv = dense(3 * cfg.embed_dim, "out", name="qkv", use_bias=True, dtype=self.dtype)(y)
+        q, k, v = jnp.split(qkv.reshape(b, s, 3, h, dh), 3, axis=2)
+        q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]  # [B, S, H, Dh]
+        cos_ = cos[None, :, None, :]
+        sin_ = sin[None, :, None, :]
+        qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = (qf * cos_ + _rotate_half(qf) * sin_).astype(self.dtype)
+        k = (kf * cos_ + _rotate_half(kf) * sin_).astype(self.dtype)
+
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32) * dh**-0.5, k.astype(jnp.float32)
+        )
+        logits = jnp.where(block_mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(self.dtype), v)
+        attn = attn.reshape(b, s, h * dh)
+        x = x + dense(cfg.embed_dim, "in", name="proj", use_bias=True, dtype=self.dtype)(attn)
+
+        y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln2")(x)
+        hdim = int(cfg.embed_dim * cfg.mlp_ratio)
+        y = dense(hdim, "out", name="fc1", use_bias=True, dtype=self.dtype)(y)
+        y = quick_gelu(y)
+        return x + dense(cfg.embed_dim, "in", name="fc2", use_bias=True, dtype=self.dtype)(y)
+
+
+class QwenVisionTower(nn.Module):
+    """[B, S, patch_dim] pixel patches -> [B, S/merge², hidden_size]."""
+
+    cfg: QwenVisionConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, patches, grid: tuple[int, int, int]):
+        cfg = self.cfg
+        b, s, _ = patches.shape
+        assert s == grid[0] * grid[1] * grid[2], (s, grid)
+        x = dense(cfg.embed_dim, None, name="patch_embed", use_bias=False, dtype=self.dtype)(
+            patches.astype(self.dtype)
+        )
+        angles = rotary_tables(cfg, grid)
+        cos, sin = jnp.cos(jnp.asarray(angles)), jnp.sin(jnp.asarray(angles))
+        # attention never crosses temporal frames (HF cu_seqlens semantics)
+        frame = np.arange(s) // (grid[1] * grid[2])
+        block_mask = jnp.asarray(frame[:, None] == frame[None, :])
+        for i in range(cfg.depth):
+            x = QwenVisionBlock(cfg, dtype=self.dtype, name=f"block_{i}")(x, cos, sin, block_mask)
+        # merger: group each merge-window's msz² consecutive tokens
+        msz2 = cfg.spatial_merge_size**2
+        x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln_q")(x)
+        x = x.reshape(b, s // msz2, msz2 * cfg.embed_dim)
+        x = dense(msz2 * cfg.embed_dim, "out", name="merger_fc1", use_bias=True, dtype=self.dtype)(x)
+        x = nn.gelu(x, approximate=False)
+        return dense(cfg.hidden_size, "in", name="merger_fc2", use_bias=True, dtype=self.dtype)(x)
+
+
+def frames_to_patches(frames_u8, cfg: QwenVisionConfig):
+    """uint8 [B, N, H, W, 3] -> ([B, S, patch_dim], grid), HF processor order.
+
+    Device-side equivalent of Qwen2VLImageProcessor._preprocess: CLIP
+    mean/std normalization at image_size, last frame repeated to a multiple
+    of temporal_patch_size, then the
+    (t, tps, C, h/m, m, ps, w/m, m, ps) → (t, h/m, w/m, m, m, C, tps, ps, ps)
+    transpose that puts each merge window's patches contiguous.
+    """
+    from cosmos_curate_tpu.models.vit import preprocess_frames
+
+    b, n = frames_u8.shape[:2]
+    tps, ps, msz = cfg.temporal_patch_size, cfg.patch_size, cfg.spatial_merge_size
+    x = preprocess_frames(frames_u8, image_size=cfg.image_size, mode="clip")
+    if n % tps:
+        pad = tps - n % tps
+        x = jnp.concatenate([x, jnp.repeat(x[:, -1:], pad, axis=1)], axis=1)
+        n += pad
+    t, gh, gw = cfg.grid(n)
+    # [B, N, H, W, C] -> channel-first patch blocks
+    x = x.transpose(0, 1, 4, 2, 3)  # [B, N, C, H, W]
+    x = x.reshape(b, t, tps, cfg.in_channels, gh // msz, msz, ps, gw // msz, msz, ps)
+    x = x.transpose(0, 1, 4, 7, 5, 8, 3, 2, 6, 9)
+    patches = x.reshape(b, t * gh * gw, cfg.patch_dim)
+    return patches, (t, gh, gw)

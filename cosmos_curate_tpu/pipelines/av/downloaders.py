@@ -54,14 +54,26 @@ def prefetch_clips(
     idx_lock = threading.Lock()
     it = iter(rows)
     _DONE = object()
+    cancelled = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded put that gives up when the consumer abandoned the
+        # generator, so worker threads (and their decoded-frame payloads)
+        # don't leak for the life of the process.
+        while not cancelled.is_set():
+            try:
+                out.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def work() -> None:
-        while True:
+        while not cancelled.is_set():
             with idx_lock:
                 row = next(it, None)
             if row is None:
-                out.put(_DONE)
-                return
+                break
             uuid = getattr(row, "clip_uuid", row)
             path = f"{root.rstrip('/')}/clips/{uuid}.mp4"
             try:
@@ -72,20 +84,31 @@ def prefetch_clips(
             except Exception:
                 logger.exception("clip %s failed to fetch/decode; skipping", uuid)
                 continue
-            out.put((uuid, frames))
+            if not _put((uuid, frames)):
+                return
+        _put(_DONE)
 
     threads = [threading.Thread(target=work, daemon=True) for _ in range(workers)]
     for t in threads:
         t.start()
-    done = 0
-    while done < workers:
-        item = out.get()
-        if item is _DONE:
-            done += 1
-            continue
-        yield item
-    for t in threads:
-        t.join()
+    try:
+        done = 0
+        while done < workers:
+            item = out.get()
+            if item is _DONE:
+                done += 1
+                continue
+            yield item
+    finally:
+        cancelled.set()
+        # Drain so any worker blocked on a full queue can observe the flag.
+        try:
+            while True:
+                out.get_nowait()
+        except queue.Empty:
+            pass
+        for t in threads:
+            t.join(timeout=5.0)
 
 
 class RemoteSyncedStateDB:
@@ -119,7 +142,12 @@ class RemoteSyncedStateDB:
         digest = hashlib.sha256(remote_path.encode()).hexdigest()[:16]
         base = Path(cache_dir or tempfile.gettempdir()) / "curate_av_state"
         base.mkdir(parents=True, exist_ok=True)
-        self._local = base / f"{digest}.sqlite"
+        # Per-process local name: a stale file from a crashed run (or a
+        # concurrent same-host process on the same remote path) must never
+        # be silently reopened as if it were the remote's current state.
+        self._local = base / f"{digest}.{os.getpid()}.sqlite"
+        if self._local.exists():
+            self._local.unlink()
         if self._client.exists(remote_path):
             self._local.write_bytes(self._client.read_bytes(remote_path))
             logger.info("pulled state db %s -> %s", remote_path, self._local)
@@ -135,6 +163,7 @@ class RemoteSyncedStateDB:
         self._db.close()
         self._client.write_bytes(self._remote, self._local.read_bytes())
         logger.info("pushed state db %s -> %s", self._local, self._remote)
+        self._local.unlink(missing_ok=True)
         self._closed = True
 
 

@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import json
+import os
 import struct
 
 import numpy as np
@@ -113,14 +115,32 @@ class TestHandCrafted:
         big_mdat = _box(b"mdat", b"\x00" * (8 * 1024 * 1024))
         path = tmp_path / "big.mp4"
         path.write_bytes(mp4[:ftyp_end] + big_mdat + mp4[ftyp_end:])
-        import tracemalloc
+        # Measure in a subprocess: tracemalloc state is process-global, so an
+        # in-process peak reading is poisoned by whatever earlier tests (the
+        # profiling backends also drive tracemalloc) left allocated.
+        import subprocess
+        import sys
 
-        tracemalloc.start()
-        idx = parse_mp4_video_index(str(path))
-        _, peak = tracemalloc.get_traced_memory()
-        tracemalloc.stop()
-        assert idx.frame_count == 4
-        assert peak < 4 * 1024 * 1024, f"peak {peak} suggests the mdat was read"
+        code = (
+            "import json, sys, tracemalloc\n"
+            "from cosmos_curate_tpu.video.mp4_index import parse_mp4_video_index\n"
+            "tracemalloc.start()\n"
+            "idx = parse_mp4_video_index(sys.argv[1])\n"
+            "_, peak = tracemalloc.get_traced_memory()\n"
+            "print(json.dumps({'frames': idx.frame_count, 'peak': peak}))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code, str(path)],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["frames"] == 4
+        assert result["peak"] < 4 * 1024 * 1024, (
+            f"peak {result['peak']} suggests the mdat was read"
+        )
 
     def test_not_mp4_raises(self):
         with pytest.raises(Mp4ParseError):
