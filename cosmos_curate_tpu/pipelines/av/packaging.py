@@ -1,0 +1,210 @@
+"""AV dataset packaging writers matching the reference's output layouts.
+
+Equivalent capability of the reference's packaging-writer family
+(pipelines/av/writers/):
+
+- :func:`write_cosmos_predict2_clip` — CosmosPredict2WriterStage
+  (cosmos_predict2_writer_stage.py:288-555): per clip,
+  ``datasets/{name}/videos/{view}/{uuid}.mp4``,
+  ``metas/{view}/{uuid}.txt`` and ``t5_xxl/{view}/{uuid}.pkl``.
+- :func:`package_t5_embeddings_e` — T5EmbeddingPackagingStageE
+  (dataset_writer_stage.py:238-398, embeddings-first): one tar per
+  clip-session per T5 variant at ``datasets/{name}/{variant}/{session}.tar``
+  holding ``{session}.{camera}.bin`` + ``{session}.{camera}.json``.
+- :func:`package_t5_embeddings_h` — T5EmbeddingPackagingStageH
+  (dataset_writer_stage.py:400-…, hierarchical): window-indexed tars at
+  ``datasets/{name}/{variant}/part_{p:06d}/t5_{i:06d}.tar`` with a sidecar
+  ``t5_{i:06d}.json`` metadata map, bounded embeddings per tar and tars
+  per part.
+
+All writes go through the URL-aware storage client, so the same code lands
+the layout on a local root or object storage; a consumer of the reference's
+dataset layout finds byte-identical directory structure. Embedding payloads
+are pickled numpy arrays (the serialization the downstream cosmos-predict2
+loaders expect); tars are deterministic (sorted entries, fixed mtime).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import tarfile
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from cosmos_curate_tpu.storage.client import write_bytes
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class CameraWindows:
+    """One camera's contribution to a clip-session: per-window captions and
+    T5 embeddings (index k = caption window k)."""
+
+    clip_uuid: str
+    captions: list[str] = field(default_factory=list)
+    embeddings: list[np.ndarray] = field(default_factory=list)
+    window_start_frames: list[int] = field(default_factory=list)
+    window_end_frames: list[int] = field(default_factory=list)
+
+
+@dataclass
+class SessionSample:
+    """A clip-session across its cameras (reference ClipSample)."""
+
+    session_uuid: str
+    cameras: dict[str, CameraWindows] = field(default_factory=dict)
+
+
+def _tar_bytes(items: list[tuple[bytes, str]]) -> bytes:
+    """Deterministic in-memory tar (reference _create_tar_bytes)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for data, name in items:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = 0
+            tf.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def predict2_paths(root: str, dataset: str, camera: str, clip_uuid: str) -> dict[str, str]:
+    base = f"{root.rstrip('/')}/datasets/{dataset}"
+    return {
+        "video": f"{base}/videos/{camera}/{clip_uuid}.mp4",
+        "meta": f"{base}/metas/{camera}/{clip_uuid}.txt",
+        "t5": f"{base}/t5_xxl/{camera}/{clip_uuid}.pkl",
+    }
+
+
+def write_cosmos_predict2_clip(
+    root: str,
+    dataset: str,
+    camera: str,
+    clip_uuid: str,
+    *,
+    video_bytes: bytes,
+    caption: str,
+    t5_embedding: np.ndarray,
+) -> dict[str, str]:
+    """Write one clip's predict2 triplet; returns the three paths."""
+    paths = predict2_paths(root, dataset, camera, clip_uuid)
+    write_bytes(paths["video"], video_bytes)
+    write_bytes(paths["meta"], caption.encode())
+    # the reference pickles a LIST holding the (windowed) embedding
+    write_bytes(paths["t5"], pickle.dumps([np.asarray(t5_embedding)]))
+    return paths
+
+
+def write_prefix_embeddings_cache(
+    root: str,
+    dataset: str,
+    camera: str,
+    prefix_embeddings: dict[str, np.ndarray],
+) -> str:
+    """Predict2 per-view prompt-prefix embedding cache
+    (cosmos_predict2_writer_stage.py:220-286)."""
+    path = f"{root.rstrip('/')}/datasets/{dataset}/cache/prefix_t5_embeddings_{camera}.pkl"
+    write_bytes(path, pickle.dumps({k: np.asarray(v) for k, v in prefix_embeddings.items()}))
+    return path
+
+
+def package_t5_embeddings_e(
+    samples: list[SessionSample],
+    root: str,
+    dataset: str,
+    *,
+    variants: tuple[str, ...] = ("t5_xxl",),
+) -> list[str]:
+    """Embeddings-first tars: one tar per session per variant.
+
+    Tar members per camera: ``{session}.{camera}.bin`` (pickled embedding
+    for window k of that variant) and ``{session}.{camera}.json`` holding
+    ``[clip_uuid, [caption], [start_frame], [end_frame]]`` — the exact
+    member naming + metadata shape of T5EmbeddingPackagingStageE.
+    """
+    written: list[str] = []
+    base = f"{root.rstrip('/')}/datasets/{dataset}"
+    for sample in samples:
+        for k, variant in enumerate(variants):
+            items: list[tuple[bytes, str]] = []
+            for camera in sorted(sample.cameras):
+                cw = sample.cameras[camera]
+                if k >= len(cw.embeddings):
+                    logger.warning(
+                        "session %s camera %s lacks window %d embedding; skipping member",
+                        sample.session_uuid, camera, k,
+                    )
+                    continue
+                name = f"{sample.session_uuid}.{camera}"
+                items.append((pickle.dumps(np.asarray(cw.embeddings[k])), f"{name}.bin"))
+                meta = [
+                    cw.clip_uuid,
+                    [cw.captions[k] if k < len(cw.captions) else ""],
+                    [cw.window_start_frames[k] if k < len(cw.window_start_frames) else 0],
+                    [cw.window_end_frames[k] if k < len(cw.window_end_frames) else 0],
+                ]
+                items.append((json.dumps(meta).encode(), f"{name}.json"))
+            path = f"{base}/{variant}/{sample.session_uuid}.tar"
+            write_bytes(path, _tar_bytes(items))
+            written.append(path)
+    logger.info("packaged %d embeddings-first tars under %s", len(written), base)
+    return written
+
+
+def package_t5_embeddings_h(
+    samples: list[SessionSample],
+    root: str,
+    dataset: str,
+    *,
+    variant: str = "t5_xxl",
+    window: int = 0,
+    embeddings_per_tar: int = 16,
+    tars_per_part: int = 1000,
+) -> list[str]:
+    """Hierarchical tars: sessions accumulate into fixed-size tars grouped
+    into parts — ``{variant}/part_{p:06d}/t5_{i:06d}.tar`` plus a sidecar
+    ``t5_{i:06d}.json`` mapping session → camera → metadata
+    (T5EmbeddingPackagingStageH's layout)."""
+    base = f"{root.rstrip('/')}/datasets/{dataset}/{variant}"
+    written: list[str] = []
+    items: list[tuple[bytes, str]] = []
+    metadata: dict[str, dict[str, list]] = {}
+    tar_idx = 0
+
+    def flush() -> None:
+        nonlocal items, metadata, tar_idx
+        if not items:
+            return
+        part = tar_idx // tars_per_part
+        prefix = f"{base}/part_{part:06d}/t5_{tar_idx % tars_per_part:06d}"
+        write_bytes(f"{prefix}.tar", _tar_bytes(items))
+        write_bytes(f"{prefix}.json", json.dumps(metadata).encode())
+        written.append(f"{prefix}.tar")
+        items, metadata = [], {}
+        tar_idx += 1
+
+    count = 0
+    for sample in samples:
+        for camera in sorted(sample.cameras):
+            cw = sample.cameras[camera]
+            if window >= len(cw.embeddings):
+                continue
+            name = f"{sample.session_uuid}.{camera}"
+            items.append((pickle.dumps(np.asarray(cw.embeddings[window])), f"{name}.bin"))
+            metadata.setdefault(sample.session_uuid, {})[camera] = [
+                dataset,
+                [cw.captions[window] if window < len(cw.captions) else ""],
+                [cw.window_start_frames[window] if window < len(cw.window_start_frames) else 0],
+                [cw.window_end_frames[window] if window < len(cw.window_end_frames) else 0],
+            ]
+            count += 1
+            if count % embeddings_per_tar == 0:
+                flush()
+    flush()
+    logger.info("packaged %d hierarchical tars under %s", len(written), base)
+    return written

@@ -30,6 +30,11 @@ logger = get_logger(__name__)
 _SESSION_RE = re.compile(r"^(?P<session>.+?)_(?P<camera>[A-Za-z0-9\-]+)$")
 
 
+# caption-time frame sampling rate; T5 tar window metadata is expressed in
+# this frame space
+AV_CAPTION_FPS = 1.0
+
+
 @dataclass
 class AVPipelineArgs:
     input_path: str = ""
@@ -47,6 +52,11 @@ class AVPipelineArgs:
     # window only (mirrors the reference's default-vs-front policy)
     caption_window_frames: int = 8
     limit: int = 0
+    # dataset name in the packaged layout (reference datasets/{name}/...)
+    dataset_name: str = "av-dataset"
+    # shard-time T5 packaging: none | e (embeddings-first, one tar per
+    # session) | h (hierarchical part_NNNNNN/t5_NNNNNN.tar)
+    t5_packaging: str = "none"
 
     @property
     def resolved_db(self) -> str:
@@ -191,7 +201,7 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
                 for cid, frames in prefetch_clips(
                     todo[start : start + chunk_size],
                     args.output_path,
-                    target_fps=1.0,
+                    target_fps=AV_CAPTION_FPS,
                     resize_hw=(224, 224),
                 )
                 if frames.shape[0] > 0
@@ -243,29 +253,22 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
 
 
 def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
-    """Package captioned clips into a training-dataset layout.
+    """Package captioned clips into the cosmos-predict2 dataset layout.
 
     Equivalent capability of the reference's cosmos-predict2 dataset writer
-    (pipelines/av/writers/cosmos_predict2_writer_stage.py:288-555): per-camera
-    directories holding the clip video, the caption text, and the caption's
-    T5 per-token embedding; clip state advances to 'packaged', and sessions
-    whose clips are all packaged advance too.
+    (pipelines/av/writers/cosmos_predict2_writer_stage.py:288-555), emitting
+    the SAME directory/file layout — ``datasets/{name}/videos/{view}/
+    {uuid}.mp4``, ``metas/{view}/{uuid}.txt``, ``t5_xxl/{view}/{uuid}.pkl``
+    — so downstream predict2 loaders consume either output unchanged. Clip
+    state advances to 'packaged'; sessions whose clips are all packaged
+    advance too.
     """
-    import numpy as np
-
     from cosmos_curate_tpu.models.t5 import T5_BASE, T5EncoderTPU
+    from cosmos_curate_tpu.pipelines.av.packaging import write_cosmos_predict2_clip
     from cosmos_curate_tpu.storage.client import read_bytes
 
     t0 = time.monotonic()
     root = args.output_path.rstrip("/")
-    if "://" in root:
-        # clips are read through the URL-aware storage client, but the
-        # dataset layout is written with local paths — a remote output root
-        # would silently land in a local "s3:/..." directory.
-        raise ValueError(
-            f"av package writes the dataset locally; output_path {root!r} "
-            "must be a local directory (sync to object storage afterwards)"
-        )
     db = open_state_db(args.resolved_db)
     try:
         todo = db.clips(state="captioned")
@@ -276,8 +279,6 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
         if encoder is None:
             encoder = T5EncoderTPU(T5_BASE)
             encoder.setup()
-        from pathlib import Path
-
         packaged = 0
         texts = [r.caption for r in todo]
         encoded = encoder.encode(texts)
@@ -287,12 +288,15 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
             except FileNotFoundError:
                 logger.warning("clip %s missing on disk; skipping", row.clip_uuid)
                 continue
-            cam_dir = Path(root) / "dataset" / row.camera
-            for sub in ("videos", "captions", "t5"):
-                (cam_dir / sub).mkdir(parents=True, exist_ok=True)
-            (cam_dir / "videos" / f"{row.clip_uuid}.mp4").write_bytes(clip_bytes)
-            (cam_dir / "captions" / f"{row.clip_uuid}.txt").write_text(row.caption)
-            np.save(cam_dir / "t5" / f"{row.clip_uuid}.npy", enc.embedding)
+            write_cosmos_predict2_clip(
+                root,
+                args.dataset_name,
+                row.camera,
+                row.clip_uuid,
+                video_bytes=clip_bytes,
+                caption=row.caption,
+                t5_embedding=enc.embedding,
+            )
             db.set_clip_state(row.clip_uuid, "packaged")
             packaged += 1
         # sessions whose clips are all packaged advance
@@ -306,11 +310,79 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
 
 
 def run_av_shard(args: AVPipelineArgs) -> dict:
+    if args.t5_packaging in ("e", "h"):
+        summary = _shard_t5_packaging(args)
+    else:
+        summary = {}
     from cosmos_curate_tpu.pipelines.video.shard import ShardPipelineArgs, run_shard
 
-    return run_shard(
+    return summary | run_shard(
         ShardPipelineArgs(
             input_path=args.output_path,
             output_path=f"{args.output_path.rstrip('/')}/shards",
         )
     )
+
+
+def _shard_t5_packaging(args: AVPipelineArgs) -> dict:
+    """Shard-time T5 tar packaging (reference T5EmbeddingPackagingStageE/H,
+    av/writers/dataset_writer_stage.py:238/400): regroup the per-clip
+    ``t5_xxl/{view}/{uuid}.pkl`` files written by ``av package`` into
+    clip-session tars (E) or hierarchical part tars (H).
+
+    A "clip-session" is one synchronized span across a session's cameras
+    (the reference's clip_session_uuid) — grouped here by
+    (session_id, span_start, span_end), NOT by whole session, so every clip
+    of a long multi-clip camera lands in its own tar.
+    """
+    import pickle
+    import uuid as uuid_mod
+
+    from cosmos_curate_tpu.pipelines.av.packaging import (
+        CameraWindows,
+        SessionSample,
+        package_t5_embeddings_e,
+        package_t5_embeddings_h,
+    )
+    from cosmos_curate_tpu.storage.client import read_bytes
+
+    root = args.output_path.rstrip("/")
+    db = open_state_db(args.resolved_db)
+    try:
+        by_span: dict[tuple, SessionSample] = {}
+        for row in db.clips(state="packaged"):
+            path = (
+                f"{root}/datasets/{args.dataset_name}/t5_xxl/{row.camera}/"
+                f"{row.clip_uuid}.pkl"
+            )
+            try:
+                embeddings = pickle.loads(read_bytes(path))
+            except FileNotFoundError:
+                logger.warning("no packaged t5 for clip %s; skipping", row.clip_uuid)
+                continue
+            key = (row.session_id, round(row.span_start, 3), round(row.span_end, 3))
+            if key not in by_span:
+                csu = uuid_mod.uuid5(
+                    uuid_mod.NAMESPACE_URL, f"{key[0]}:{key[1]}:{key[2]}"
+                )
+                by_span[key] = SessionSample(session_uuid=str(csu))
+            # window frame indices are in caption-frame space (clips are
+            # captioned at AV_CAPTION_FPS, run_av_caption)
+            n_frames = max(
+                1, int(round((row.span_end - row.span_start) * AV_CAPTION_FPS))
+            )
+            by_span[key].cameras[row.camera] = CameraWindows(
+                clip_uuid=row.clip_uuid,
+                captions=[row.caption] * len(embeddings),
+                embeddings=list(embeddings),
+                window_start_frames=[0] * len(embeddings),
+                window_end_frames=[n_frames] * len(embeddings),
+            )
+        samples = list(by_span.values())
+        if args.t5_packaging == "e":
+            tars = package_t5_embeddings_e(samples, root, args.dataset_name)
+        else:
+            tars = package_t5_embeddings_h(samples, root, args.dataset_name)
+        return {"num_t5_tars": len(tars), "t5_packaging": args.t5_packaging}
+    finally:
+        db.close()

@@ -253,12 +253,31 @@ def run_split(
             tasks = discover_split_tasks(
                 args.input_path, args.output_path, limit=args.limit
             )
-        # multi-node: each node takes a disjoint task slice (host-level data
-        # parallelism; resume records keep re-runs consistent)
-        tasks = partition_tasks_for_node(tasks)
         stages = assemble_stages(args)
         stages = _apply_observability_wrappers(stages, args)
-        out = run_pipeline(tasks, stages, config=config, runner=runner) or []
+        from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
+        from cosmos_curate_tpu.parallel.work_stealing import (
+            run_with_stealing,
+            stealing_enabled,
+        )
+
+        _, n_nodes = node_rank_and_count()
+        if n_nodes > 1 and stealing_enabled():
+            # shared-ledger mode: nodes pull claim batches until dry, so a
+            # skewed input split rebalances instead of idling fast nodes
+            from cosmos_curate_tpu.pipelines.video.stages.writer import video_record_id
+
+            out = run_with_stealing(
+                tasks,
+                args.output_path,
+                lambda batch: run_pipeline(batch, stages, config=config, runner=runner),
+                record_id=lambda t: video_record_id(t.video.path),
+            )
+        else:
+            # default: each node takes a disjoint task slice (host-level
+            # data parallelism; resume records keep re-runs consistent)
+            tasks = partition_tasks_for_node(tasks)
+            out = run_pipeline(tasks, stages, config=config, runner=runner) or []
     finally:
         if args.tracing:
             from cosmos_curate_tpu.observability.tracing import disable_tracing

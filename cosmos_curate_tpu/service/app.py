@@ -81,6 +81,7 @@ def _runner_code(
     work_dir: str = "",
     input_zip_url: str = "",
     output_zip_url: str = "",
+    output_zip_multipart: dict | None = None,
 ) -> str:
     """Child-process program: optional presigned-zip ingest (reference
     nvcf_main.py handle_presigned_urls — credential-less I/O: inputs arrive
@@ -94,6 +95,7 @@ def _runner_code(
             "work_dir": work_dir,
             "input_zip_url": input_zip_url,
             "output_zip_url": output_zip_url,
+            "output_zip_multipart": output_zip_multipart,
         }
     )
     return (
@@ -105,7 +107,7 @@ def _runner_code(
         "    inp = spec['work_dir'] + '/input'\n"
         "    download_and_extract(spec['input_zip_url'], inp)\n"
         "    args['input_path'] = inp\n"
-        "if spec['output_zip_url'] and not args.get('output_path'):\n"
+        "if (spec['output_zip_url'] or spec['output_zip_multipart']) and not args.get('output_path'):\n"
         "    args['output_path'] = spec['work_dir'] + '/output'\n"
         "from cosmos_curate_tpu.pipelines.video import split as split_mod\n"
         "from cosmos_curate_tpu.pipelines.video import dedup as dedup_mod\n"
@@ -117,7 +119,10 @@ def _runner_code(
         "else:\n"
         "    s = shard_mod.run_shard(shard_mod.ShardPipelineArgs(**args))\n"
         "json.dump(s, open(spec['summary'], 'w'))\n"
-        "if spec['output_zip_url']:\n"
+        "if spec['output_zip_multipart']:\n"
+        "    from cosmos_curate_tpu.storage.zip_transport import PresignedMultipart, zip_and_upload_directory\n"
+        "    zip_and_upload_directory(args['output_path'], PresignedMultipart.from_dict(spec['output_zip_multipart']))\n"
+        "elif spec['output_zip_url']:\n"
         "    from cosmos_curate_tpu.storage.zip_transport import zip_and_upload_directory\n"
         "    zip_and_upload_directory(args['output_path'], spec['output_zip_url'])\n"
     )
@@ -168,9 +173,21 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
             )
         input_zip_url = body.get("input_zip_url", "")
         output_zip_url = body.get("output_zip_url", "")
+        # multi-GB outputs go through presigned multipart (per-part retry,
+        # no single-PUT size limits, reference presigned_s3_zip.py:334)
+        output_zip_multipart = body.get("output_zip_multipart")
         if not isinstance(input_zip_url, str) or not isinstance(output_zip_url, str):
             return web.json_response({"error": "zip urls must be strings"}, status=400)
-        if output_zip_url and "://" in str(args.get("output_path", "")):
+        if output_zip_multipart is not None and (
+            not isinstance(output_zip_multipart, dict)
+            or not output_zip_multipart.get("part_urls")
+            or not output_zip_multipart.get("complete_url")
+        ):
+            return web.json_response(
+                {"error": "output_zip_multipart needs part_urls + complete_url"},
+                status=400,
+            )
+        if (output_zip_url or output_zip_multipart) and "://" in str(args.get("output_path", "")):
             # zipping a remote output root would silently upload an empty
             # archive — the zip leaves from a local directory
             return web.json_response(
@@ -194,6 +211,7 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
                         work_dir=str(work_dir),
                         input_zip_url=input_zip_url,
                         output_zip_url=output_zip_url,
+                        output_zip_multipart=output_zip_multipart,
                     ),
                 ],
                 stdout=log_f,

@@ -27,7 +27,14 @@ class GcsStorageClient(StorageClient):
 
     def read_bytes(self, path: str) -> bytes:
         bucket, key = _split(path)
-        return self._client.bucket(bucket).blob(key).download_as_bytes()
+        try:
+            return self._client.bucket(bucket).blob(key).download_as_bytes()
+        except Exception as e:
+            # normalize missing-object to the FileNotFoundError contract
+            # (the REST clients already raise it on 404)
+            if type(e).__name__ == "NotFound" or getattr(e, "code", None) == 404:
+                raise FileNotFoundError(path) from e
+            raise
 
     def write_bytes(self, path: str, data: bytes) -> None:
         bucket, key = _split(path)

@@ -34,7 +34,16 @@ class S3StorageClient(StorageClient):
 
     def read_bytes(self, path: str) -> bytes:
         bucket, key = _split(path)
-        return self._s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+        try:
+            return self._s3.get_object(Bucket=bucket, Key=key)["Body"].read()
+        except Exception as e:
+            # normalize the missing-object error to the contract every
+            # caller's warn-and-skip path relies on (the REST clients raise
+            # FileNotFoundError on 404 already)
+            code = getattr(e, "response", {}).get("Error", {}).get("Code", "")
+            if code in ("NoSuchKey", "404", "NotFound"):
+                raise FileNotFoundError(path) from e
+            raise
 
     def write_bytes(self, path: str, data: bytes) -> None:
         bucket, key = _split(path)

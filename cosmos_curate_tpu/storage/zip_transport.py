@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import io
 import os
+import time
 import zipfile
+from dataclasses import dataclass, field
 from pathlib import Path
 
 from cosmos_curate_tpu.storage.client import read_bytes, write_bytes
@@ -27,6 +29,31 @@ from cosmos_curate_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _HTTP = ("http://", "https://")
+
+
+@dataclass
+class PresignedMultipart:
+    """A presigned S3 multipart upload, as handed out by a job submitter.
+
+    The submitter initiates the multipart upload with its own credentials
+    and presigns one URL per part plus the completion (and optionally
+    abort) call; the uploader here never sees credentials — matching the
+    reference's zip_and_upload_directory_multipart contract
+    (core/utils/storage/presigned_s3_zip.py:334-478)."""
+
+    part_urls: list[str] = field(default_factory=list)  # part 1 first
+    complete_url: str = ""
+    abort_url: str | None = None
+    part_size: int = 64 * 1024 * 1024  # S3 minimum is 5 MiB per part
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PresignedMultipart":
+        return cls(
+            part_urls=list(d["part_urls"]),
+            complete_url=d["complete_url"],
+            abort_url=d.get("abort_url"),
+            part_size=int(d.get("part_size", 64 * 1024 * 1024)),
+        )
 
 
 def zip_directory_to_file(src_dir: str | Path, zip_path: str | Path) -> int:
@@ -55,10 +82,11 @@ def zip_directory(src_dir: str | Path) -> bytes:
         return f.read()
 
 
-def zip_and_upload_directory(src_dir: str | Path, dest: str) -> int:
-    """Zip ``src_dir`` and PUT it to ``dest`` (storage path or presigned
-    HTTP URL). Returns the zip size in bytes. The archive is staged on
-    local disk; only the transport step holds it in memory (for local
+def zip_and_upload_directory(src_dir: str | Path, dest: "str | PresignedMultipart") -> int:
+    """Zip ``src_dir`` and upload it to ``dest`` (storage path, presigned
+    HTTP URL, or a :class:`PresignedMultipart`). Returns the zip size in
+    bytes. The archive is staged on local disk; only one part (multipart)
+    or the transport step (single-PUT) holds bytes in memory (for local
     destinations it is an os-level rename, zero extra memory)."""
     import shutil
     import tempfile
@@ -67,7 +95,9 @@ def zip_and_upload_directory(src_dir: str | Path, dest: str) -> int:
     os.close(fd)
     try:
         size = zip_directory_to_file(src_dir, tmp)
-        if dest.startswith(_HTTP):
+        if isinstance(dest, PresignedMultipart):
+            _multipart_put(tmp, size, dest)
+        elif dest.startswith(_HTTP):
             with open(tmp, "rb") as f:
                 _http_put(dest, f.read())
         elif "://" not in dest:
@@ -77,11 +107,56 @@ def zip_and_upload_directory(src_dir: str | Path, dest: str) -> int:
         else:
             with open(tmp, "rb") as f:
                 write_bytes(dest, f.read())
-        logger.info("uploaded %s (%d bytes) -> %s", src_dir, size, _redact(dest))
+        logger.info("uploaded %s (%d bytes) -> %s", src_dir, size, _redact_dest(dest))
         return size
     finally:
         if tmp is not None and os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _multipart_put(zip_path: str, size: int, spec: PresignedMultipart, *, retries: int = 3) -> None:
+    """Stream the staged zip through presigned part URLs with per-part
+    retry, then complete. A failed part re-sends ONLY that part (the
+    single-PUT path re-uploads everything — the reason multipart exists,
+    reference presigned_s3_zip.py:334); completion posts the standard
+    CompleteMultipartUpload XML with the collected ETags."""
+    n_parts = max(1, -(-size // spec.part_size))
+    if n_parts > len(spec.part_urls):
+        raise ValueError(
+            f"zip needs {n_parts} parts of {spec.part_size} B but only "
+            f"{len(spec.part_urls)} presigned part URLs were provided"
+        )
+    etags: list[str] = []
+    try:
+        with open(zip_path, "rb") as f:
+            for i in range(n_parts):
+                data = f.read(spec.part_size)
+                etags.append(_put_part(spec.part_urls[i], data, retries=retries))
+        parts_xml = "".join(
+            f"<Part><PartNumber>{i + 1}</PartNumber><ETag>{e}</ETag></Part>"
+            for i, e in enumerate(etags)
+        )
+        xml = f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>"
+        _http_request(spec.complete_url, xml.encode(), method="POST", retries=retries)
+        logger.info("multipart upload complete: %d parts, %d bytes", n_parts, size)
+    except Exception:
+        if spec.abort_url:
+            try:
+                _http_request(spec.abort_url, None, method="DELETE", retries=1)
+                logger.info("aborted multipart upload after failure")
+            except Exception:
+                logger.exception("multipart abort also failed; upload may leak parts")
+        raise
+
+
+def _put_part(url: str, data: bytes, *, retries: int) -> str:
+    headers = _http_request(url, data, method="PUT", retries=retries)
+    etag = next((v for k, v in headers.items() if k.lower() == "etag"), "")
+    if not etag:
+        # fail on the FIRST part: completing with an empty <ETag> would be
+        # rejected only after every byte has been uploaded
+        raise RuntimeError(f"part PUT returned no ETag header: {_redact(url)}")
+    return etag
 
 
 def download_and_extract(src: str, dest_dir: str | Path) -> list[str]:
@@ -115,13 +190,29 @@ def download_and_extract(src: str, dest_dir: str | Path) -> list[str]:
 
 
 def _http_put(url: str, data: bytes) -> None:
+    _http_request(url, data, method="PUT", retries=1)
+
+
+def _http_request(
+    url: str, data: bytes | None, *, method: str, retries: int
+) -> dict[str, str]:
     import urllib.request
 
-    req = urllib.request.Request(url, data=data, method="PUT")
-    req.add_header("Content-Type", "application/zip")
-    with urllib.request.urlopen(req, timeout=600) as resp:
-        if resp.status >= 300:
-            raise RuntimeError(f"PUT failed with {resp.status}")
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            req = urllib.request.Request(url, data=data, method=method)
+            if method == "PUT":
+                req.add_header("Content-Type", "application/zip")
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                if resp.status >= 300:
+                    raise RuntimeError(f"{method} failed with {resp.status}")
+                return dict(resp.headers)
+        except Exception as e:  # noqa: BLE001
+            last = e
+            if attempt + 1 < retries:
+                time.sleep(min(2**attempt, 8))
+    raise RuntimeError(f"{method} {_redact(url)} failed after {retries} attempts: {last}")
 
 
 def _http_get(url: str) -> bytes:
@@ -134,3 +225,9 @@ def _http_get(url: str) -> bytes:
 def _redact(url: str) -> str:
     """Presigned URLs carry signatures in the query string; never log them."""
     return url.split("?", 1)[0] if url.startswith(_HTTP) else url
+
+
+def _redact_dest(dest: "str | PresignedMultipart") -> str:
+    if isinstance(dest, PresignedMultipart):
+        return f"<multipart x{len(dest.part_urls)}: {_redact(dest.complete_url)}>"
+    return _redact(dest)

@@ -1,0 +1,106 @@
+"""Fake-driven tests for the image/deploy CLI command construction.
+
+``image build/push`` shells out to docker and ``deploy apply`` pipes
+manifests to kubectl; neither tool exists in this image, so these tests put
+fake executables on PATH that record argv + stdin — the exact paths that
+otherwise rot silently (reference client/image_cli/image_app.py:30-242).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.cli.main import main
+
+
+@pytest.fixture()
+def fake_tools(tmp_path, monkeypatch):
+    """Install recording fakes for docker/kubectl at the front of PATH."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    log = tmp_path / "calls.jsonl"
+
+    script = f"""#!/bin/bash
+stdin=$(cat)
+python3 - "$0" "$@" <<PYEOF
+import json, sys
+print(json.dumps({{"tool": sys.argv[1].split("/")[-1], "args": sys.argv[2:], "stdin": '''$stdin'''}}),
+      file=open({str(log)!r}, "a"))
+PYEOF
+exit ${{FAKE_RC:-0}}
+"""
+    for tool in ("docker", "kubectl"):
+        p = bin_dir / tool
+        p.write_text(script)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+
+    def calls() -> list[dict]:
+        if not log.exists():
+            return []
+        return [json.loads(line) for line in log.read_text().splitlines()]
+
+    return calls
+
+
+class TestImageBuild:
+    def test_build_and_push_command_construction(self, fake_tools, tmp_path, capsys):
+        dockerfile = tmp_path / "Dockerfile"
+        dockerfile.write_text("FROM scratch\n")
+        rc = main(
+            [
+                "image",
+                "build",
+                "--dockerfile",
+                str(dockerfile),
+                "--image-name",
+                "registry.local/curate",
+                "--image-tag",
+                "v9",
+                "--push",
+            ]
+        )
+        assert rc == 0
+        calls = fake_tools()
+        assert [c["tool"] for c in calls] == ["docker", "docker"]
+        build = calls[0]["args"]
+        assert build[0] == "build"
+        assert "-f" in build and str(dockerfile) in build
+        assert "registry.local/curate:v9" in " ".join(build)
+        assert calls[1]["args"][:2] == ["push", "registry.local/curate:v9"]
+
+    def test_push_failure_propagates_rc(self, fake_tools, monkeypatch):
+        monkeypatch.setenv("FAKE_RC", "7")
+        rc = main(
+            ["image", "push", "--image-name", "r/c", "--image-tag", "t"]
+        )
+        assert rc == 7
+
+    def test_missing_tool_fails_loud(self, tmp_path, monkeypatch, capsys):
+        # PATH with no docker at all
+        monkeypatch.setenv("PATH", str(tmp_path))
+        rc = main(["image", "push", "--image-name", "r/c", "--image-tag", "t"])
+        assert rc == 3
+        assert "not found" in capsys.readouterr().err
+
+
+class TestDeployApply:
+    def test_apply_pipes_rendered_manifests(self, fake_tools):
+        rc = main(["deploy", "apply", "--set", "replicas=3"])
+        assert rc == 0
+        calls = fake_tools()
+        assert len(calls) == 1
+        assert calls[0]["tool"] == "kubectl"
+        assert calls[0]["args"] == ["apply", "-f", "-"]
+        doc = calls[0]["stdin"]
+        assert "kind:" in doc and "replicas: 3" in doc
+
+    def test_apply_failure_propagates_rc(self, fake_tools, monkeypatch):
+        monkeypatch.setenv("FAKE_RC", "2")
+        rc = main(["deploy", "apply"])
+        assert rc == 2

@@ -1,0 +1,106 @@
+"""Shared-ledger work stealing (reference ARCHITECTURE.md:25-27,83-93: work
+moves to idle nodes). Timing-free assertions — on this 1-core box two node
+processes share the CPU, so balance is proven by coverage, not wall clock."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tests.parallel.test_multinode_partition import _make_videos
+
+_DRIVER = """
+import sys
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+args = SplitPipelineArgs(
+    input_path=sys.argv[1], output_path=sys.argv[2],
+    fixed_stride_len_s=1.0, min_clip_len_s=0.5,
+    extract_fps=(4.0,), extract_resize_hw=(32, 32),
+)
+summary = run_split(args, runner=SequentialRunner())
+print("NODE-DONE", summary["num_videos"], summary["num_clips"])
+"""
+
+
+def _run_node(rank: int, num: int, vids: Path, out: Path, *, wait=True):
+    env = {
+        **os.environ,
+        "CURATE_NUM_NODES": str(num),
+        "CURATE_NODE_RANK": str(rank),
+        "CURATE_WORK_STEALING": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+    }
+    env.pop("CURATE_COORDINATOR_ADDRESS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-c", _DRIVER, str(vids), str(out)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    if not wait:
+        return p
+    stdout, stderr = p.communicate(timeout=420)
+    assert p.returncode == 0, stderr[-3000:]
+    return stdout
+
+
+class TestClaimLedger:
+    def test_claims_are_exclusive_per_rank(self, tmp_path):
+        from cosmos_curate_tpu.parallel.work_stealing import claim_next_batch
+
+        tasks = [f"t{i}" for i in range(6)]
+        got0 = claim_next_batch(tasks, str(tmp_path), record_id=str, batch=6, rank=0)
+        got1 = claim_next_batch(tasks, str(tmp_path), record_id=str, batch=6, rank=1)
+        assert sorted(got0) == tasks  # rank 0 claimed everything first
+        assert got1 == []  # fresh claims block rank 1
+
+    def test_stale_claims_reclaimable(self, tmp_path):
+        from cosmos_curate_tpu.parallel.work_stealing import claim_next_batch
+
+        tasks = ["a", "b"]
+        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=2, rank=0)
+        # with ttl 0 every claim is stale; rank 1 may take over
+        got = claim_next_batch(tasks, str(tmp_path), record_id=str, batch=2, rank=1, ttl_s=0.0)
+        assert sorted(got) == tasks
+
+    def test_own_claims_not_retried(self, tmp_path):
+        from cosmos_curate_tpu.parallel.work_stealing import claim_next_batch
+
+        tasks = ["x"]
+        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=1, rank=0)
+        # same rank asking again gets nothing (failed-task retry loops terminate)
+        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=1, rank=0, ttl_s=0.0) == []
+
+
+@pytest.mark.slow
+class TestStealingEndToEnd:
+    def test_fast_node_drains_entire_ledger(self, tmp_path):
+        """The redistribution property itself: rank 1 of 2 runs ALONE and
+        processes ALL videos (static partition would cap it at its half);
+        rank 0 arriving later finds nothing left."""
+        vids = _make_videos(tmp_path, 4)
+        out = tmp_path / "out"
+        out1 = _run_node(1, 2, vids, out)
+        assert "NODE-DONE 4" in out1
+        out0 = _run_node(0, 2, vids, out)
+        assert "NODE-DONE 0 0" in out0
+
+    def test_simultaneous_nodes_cover_exactly_once(self, tmp_path):
+        vids = _make_videos(tmp_path, 4)
+        out = tmp_path / "out"
+        procs = [
+            _run_node(0, 2, vids, out, wait=False),
+            _run_node(1, 2, vids, out, wait=False),
+        ]
+        for p in procs:
+            _, stderr = p.communicate(timeout=420)
+            assert p.returncode == 0, stderr[-3000:]
+        from cosmos_curate_tpu.utils.summary import merge_node_summaries
+
+        merged = merge_node_summaries(str(out))
+        assert merged["num_videos"] == 4
+        assert merged["num_errors"] == 0

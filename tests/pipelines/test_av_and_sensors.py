@@ -128,14 +128,25 @@ class TestAVCaptionAndPackage:
         enc.setup()
         pkg = run_av_package(args, encoder=enc)
         assert pkg["num_packaged"] >= 1
-        root = tmp_path / "out" / "dataset"
-        cams = list(root.iterdir())
+        # the reference's predict2 layout, exactly
+        # (cosmos_predict2_writer_stage.py:70):
+        #   datasets/{name}/videos/{view}/{uuid}.mp4
+        #   datasets/{name}/metas/{view}/{uuid}.txt
+        #   datasets/{name}/t5_xxl/{view}/{uuid}.pkl
+        import pickle
+
+        base = tmp_path / "out" / "datasets" / args.dataset_name
+        assert base.is_dir()
+        cams = list((base / "videos").iterdir())
         assert cams
-        vids = list((cams[0] / "videos").glob("*.mp4"))
+        view = cams[0].name
+        vids = list((base / "videos" / view).glob("*.mp4"))
         assert vids
         uuid = vids[0].stem
-        assert (cams[0] / "captions" / f"{uuid}.txt").read_text()
-        emb = np.load(cams[0] / "t5" / f"{uuid}.npy")
+        assert (base / "metas" / view / f"{uuid}.txt").read_text()
+        payload = pickle.loads((base / "t5_xxl" / view / f"{uuid}.pkl").read_bytes())
+        assert isinstance(payload, list) and len(payload) == 1
+        emb = np.asarray(payload[0])
         assert emb.ndim == 2 and emb.shape[1] == T5_TINY_TEST.dim
 
         db = AVStateDB(args.resolved_db)
@@ -143,6 +154,47 @@ class TestAVCaptionAndPackage:
             assert db.clips(state="packaged")
         finally:
             db.close()
+
+        # shard-time T5 tar packaging, both reference formats
+        from cosmos_curate_tpu.pipelines.av.pipeline import _shard_t5_packaging
+
+        args.t5_packaging = "e"
+        se = _shard_t5_packaging(args)
+        assert se["num_t5_tars"] >= 1
+        import tarfile
+
+        db = AVStateDB(args.resolved_db)
+        try:
+            packaged_uuids = {c.clip_uuid for c in db.clips(state="packaged")}
+        finally:
+            db.close()
+        tar_e = base / "t5_xxl"
+        e_tars = list(tar_e.glob("*.tar"))
+        assert e_tars, "StageE layout: datasets/{name}/{variant}/{session}.tar"
+        seen_clip_uuids = set()
+        for tar_path in e_tars:
+            with tarfile.open(tar_path) as tf:
+                names = tf.getnames()
+                session = tar_path.stem
+                assert any(n == f"{session}.{view}.bin" for n in names), names
+                assert any(n == f"{session}.{view}.json" for n in names), names
+                for member in names:
+                    if not member.endswith(".json"):
+                        continue
+                    meta = __import__("json").loads(tf.extractfile(member).read())
+                    assert meta[0] in packaged_uuids, meta
+                    assert isinstance(meta[1], list) and meta[1][0]
+                    seen_clip_uuids.add(meta[0])
+        # every packaged clip for this view lands in its own clip-session
+        # tar — a long camera's N clips must all appear (not just the last)
+        assert seen_clip_uuids == packaged_uuids
+
+        args.t5_packaging = "h"
+        sh = _shard_t5_packaging(args)
+        assert sh["num_t5_tars"] >= 1
+        h_parts = list(tar_e.glob("part_*/t5_*.tar"))
+        assert h_parts, "StageH layout: {variant}/part_NNNNNN/t5_NNNNNN.tar"
+        assert h_parts[0].with_suffix(".json").exists()
 
 
 class TestSuperResolution:

@@ -123,3 +123,34 @@ def test_invalid_zip_url_type_rejected(client):  # noqa: F811
         json={"pipeline": "split", "args": {}, "input_zip_url": 42},
     )
     assert status == 400
+
+
+def test_invalid_multipart_spec_rejected(client):  # noqa: F811
+    status, body = _req(
+        client,
+        "POST",
+        "/v1/invoke",
+        json={
+            "pipeline": "split",
+            "args": {},
+            "output_zip_multipart": {"part_urls": []},
+        },
+    )
+    assert status == 400
+    assert "part_urls" in body["error"]
+
+
+def test_multipart_spec_reaches_runner_code():
+    """The job child program routes the output through PresignedMultipart
+    when the multipart spec is present."""
+    from cosmos_curate_tpu.service.app import _runner_code
+
+    code = _runner_code(
+        "split",
+        {},
+        "/tmp/s.json",
+        work_dir="/tmp/w",
+        output_zip_multipart={"part_urls": ["u1"], "complete_url": "c"},
+    )
+    assert "PresignedMultipart.from_dict" in code
+    compile(code, "<runner>", "exec")  # must be valid python

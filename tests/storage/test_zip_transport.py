@@ -41,3 +41,78 @@ def test_zip_slip_rejected(tmp_path):
     with pytest.raises(ValueError, match="escapes"):
         download_and_extract(str(evil), tmp_path / "out")
     assert not (tmp_path / "evil.txt").exists()
+
+
+class TestPresignedMultipart:
+    """Presigned multipart upload against the fake S3 multipart handshake
+    (reference zip_and_upload_directory_multipart, presigned_s3_zip.py:334)."""
+
+    def _spec_and_server(self, n_parts, part_size):
+        from cosmos_curate_tpu.storage.zip_transport import PresignedMultipart
+        from tests.storage.fake_s3 import FakeS3Server
+
+        srv = FakeS3Server()
+        srv.state.verify_signatures = False  # presigned URLs carry no headers
+        srv.__enter__()
+        # the "submitter" initiates the upload and presigns per-part URLs
+        srv.state.next_upload += 1
+        upload_id = f"up-{srv.state.next_upload}"
+        srv.state.uploads[upload_id] = {}
+        srv.state.upload_keys[upload_id] = ("bkt", "out.zip")
+        base = f"{srv.endpoint}/bkt/out.zip"
+        spec = PresignedMultipart(
+            part_urls=[
+                f"{base}?partNumber={i + 1}&uploadId={upload_id}" for i in range(n_parts)
+            ],
+            complete_url=f"{base}?uploadId={upload_id}",
+            abort_url=f"{base}?uploadId={upload_id}",
+            part_size=part_size,
+        )
+        return srv, spec
+
+    def test_three_part_upload_with_injected_failure(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "big.bin").write_bytes(bytes(range(256)) * 300)  # ~75 KB zip-resistant
+        srv, spec = self._spec_and_server(n_parts=8, part_size=16 * 1024)
+        try:
+            srv.state.fail_next = 1  # first part PUT gets a 503, must retry
+            size = zip_and_upload_directory(src, spec)
+            assert size > 2 * spec.part_size, "fixture must exceed 2 parts"
+            obj = srv.state.objects[("bkt", "out.zip")]
+            assert len(obj) == size
+            # round-trip: the assembled object is the exact archive
+            up = tmp_path / "up.zip"
+            up.write_bytes(obj)
+            out = tmp_path / "extract"
+            download_and_extract(str(up), out)
+            assert (out / "big.bin").read_bytes() == bytes(range(256)) * 300
+        finally:
+            srv.__exit__()
+
+    def test_too_few_part_urls_rejected(self, tmp_path):
+        from cosmos_curate_tpu.storage.zip_transport import PresignedMultipart
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "a.bin").write_bytes(bytes(range(256)) * 200)
+        spec = PresignedMultipart(
+            part_urls=["http://invalid/p1"], complete_url="http://invalid/c", part_size=1024
+        )
+        with pytest.raises(ValueError, match="part URLs"):
+            zip_and_upload_directory(src, spec)
+
+    def test_abort_on_completion_failure(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "a.bin").write_bytes(bytes(range(256)) * 200)
+        srv, spec = self._spec_and_server(n_parts=8, part_size=16 * 1024)
+        try:
+            # a complete URL pointing nowhere: upload must abort, not leak
+            spec.complete_url = f"{srv.endpoint}/bkt/out.zip"  # bad POST -> 400
+            with pytest.raises(RuntimeError):
+                zip_and_upload_directory(src, spec)
+            assert not srv.state.uploads, "abort must clear the pending upload"
+            assert ("bkt", "out.zip") not in srv.state.objects
+        finally:
+            srv.__exit__()
