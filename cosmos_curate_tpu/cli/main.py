@@ -63,7 +63,24 @@ def build_parser() -> argparse.ArgumentParser:
     from cosmos_curate_tpu.cli import image_cli
 
     image_cli.register(sub)
+
+    agent = sub.add_parser(
+        "agent",
+        help="join a driver's cross-node engine plane as a worker node",
+    )
+    agent.add_argument("--driver", required=True, help="driver HOST:PORT")
+    agent.add_argument("--node-id", default=None)
+    agent.add_argument("--num-cpus", type=float, default=None)
+    agent.set_defaults(func=_cmd_agent)
     return parser
+
+
+def _cmd_agent(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.engine.remote_agent import NodeAgent
+
+    return NodeAgent(
+        args.driver, node_id=args.node_id, num_cpus=args.num_cpus
+    ).run()
 
 
 def _cmd_info(args: argparse.Namespace) -> int:

@@ -49,11 +49,28 @@ export CURATE_COORDINATOR_ADDRESS="$COORD:{coordinator_port}"
 export CURATE_NUM_NODES="$SLURM_JOB_NUM_NODES"
 {env_exports}
 {prom_sd_step}
-# srun exports the environment; no nested shell, so arbitrary quoting in
+{engine_plane_exports}# srun exports the environment; no nested shell, so arbitrary quoting in
 # the command survives verbatim. Node rank is read from SLURM_NODEID by
 # cosmos_curate_tpu.parallel.distributed in each task.
-srun --kill-on-bad-exit=1 {python} -m cosmos_curate_tpu.cli.main {command}
+{srun_step}
 {merge_step}"""
+
+_SRUN_DEFAULT = "srun --kill-on-bad-exit=1 {python} -m cosmos_curate_tpu.cli.main {command}"
+# engine-plane topology: node 0 runs the driver (the pipeline command,
+# carried shlex-quoted in CURATE_DRIVER_CMD and re-parsed by eval); every
+# other node runs an agent that joins the driver's CPU-stage pools
+_SRUN_ENGINE_PLANE = (
+    "srun --kill-on-bad-exit=1 bash -c 'if [ \"$SLURM_NODEID\" = 0 ]; then "
+    # the driver is a SINGLE-node pipeline whose extra capacity arrives via
+    # agents — the jax.distributed/partition contract must not see N nodes
+    # (it would block in initialize waiting for peers that run agents, and
+    # partition away (N-1)/N of the input)
+    "export CURATE_NUM_NODES=1; unset CURATE_COORDINATOR_ADDRESS; "
+    'eval "exec {python} -m cosmos_curate_tpu.cli.main $CURATE_DRIVER_CMD"; else '
+    "unset CURATE_ENGINE_DRIVER_PORT; "
+    "exec {python} -m cosmos_curate_tpu.engine.remote_agent "
+    '--driver "$COORD:{engine_port}"; fi\''
+)
 
 
 def parse_job_id(sbatch_output: str) -> str:
@@ -122,6 +139,22 @@ def render_sbatch(args: argparse.Namespace, command: list[str]) -> str:
             '|| echo "warning: prometheus service-discovery registration failed" >&2\n'
             'rm -f "$NODES_FILE"\n'
         )
+    quoted_command = " ".join(shlex.quote(c) for c in command)
+    engine_plane_exports = ""
+    if getattr(args, "engine_plane", False):
+        engine_plane_exports = (
+            "# cross-node engine plane: node 0 drives, other nodes run agents\n"
+            "export CURATE_ENGINE_TOKEN=\"${CURATE_ENGINE_TOKEN:-"
+            "$(head -c16 /dev/urandom | od -An -tx1 | tr -d ' \\n')}\"\n"
+            f"export CURATE_ENGINE_DRIVER_PORT={args.engine_port}\n"
+            'export CURATE_ENGINE_WAIT_NODES="$((SLURM_JOB_NUM_NODES - 1))"\n'
+            f"export CURATE_DRIVER_CMD={shlex.quote(quoted_command)}\n"
+        )
+        srun_step = _SRUN_ENGINE_PLANE.format(
+            python="python", engine_port=args.engine_port
+        )
+    else:
+        srun_step = _SRUN_DEFAULT.format(python="python", command=quoted_command)
     return _SBATCH_TEMPLATE.format(
         merge_step=merge_step,
         prom_sd_step=prom_sd_step,
@@ -133,8 +166,8 @@ def render_sbatch(args: argparse.Namespace, command: list[str]) -> str:
         extra_directives="\n".join(extra),
         coordinator_port=args.coordinator_port,
         env_exports=env_exports,
-        python="python",
-        command=" ".join(shlex.quote(c) for c in command),
+        engine_plane_exports=engine_plane_exports,
+        srun_step=srun_step,
     )
 
 
@@ -271,6 +304,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     sb.add_argument("--partition", default="")
     sb.add_argument("--account", default="")
     sb.add_argument("--coordinator-port", type=int, default=8476)
+    sb.add_argument(
+        "--engine-plane",
+        action="store_true",
+        help="node 0 drives the streaming engine; other nodes run "
+        "engine.remote_agent workers joined over the cross-node data plane",
+    )
+    sb.add_argument("--engine-port", type=int, default=8478)
     sb.add_argument("--env", action="append", default=[], metavar="K=V")
     sb.add_argument(
         "--merge-output",

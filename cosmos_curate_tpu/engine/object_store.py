@@ -79,8 +79,14 @@ def put(obj, *, prefix: str | None = None) -> ObjectRef:
     behind that downstream stages still consume."""
     if prefix is None:
         prefix = f"cur{os.environ.get('CURATE_STORE_OWNER', os.getpid())}"
+    import cloudpickle
+
     buffers: list[pickle.PickleBuffer] = []
-    payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    # cloudpickle (same protocol-5 out-of-band buffer path as pickle, and
+    # its output is a standard pickle stream for get()): tasks whose classes
+    # live in __main__ — a user's driver script — serialize by value, which
+    # the cross-node plane needs on agents that never import that script
+    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
     views = [b.raw() for b in buffers]
     sizes = [len(v) for v in views]
     # layout: [u64 len(payload)][payload][u64 nbuf][u64 size]*nbuf [buffers...]

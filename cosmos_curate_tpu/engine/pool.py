@@ -111,6 +111,10 @@ class BasePool:
     def stop_worker(self, w: WorkerHandle) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    def note_worker_gone(self, w: WorkerHandle) -> None:
+        """Called when the runner reaps a DEAD worker (stop_worker never
+        ran): release any placement accounting."""
+
     def submit(self, w: WorkerHandle, batch_id: int, refs: list) -> None:
         w.busy_batch = batch_id
         w.in_q.put(ProcessMsg(batch_id=batch_id, refs=refs))
@@ -232,17 +236,41 @@ class PrewarmPool:
 class ProcessPool(BasePool):
     def __init__(
         self, spec: StageSpec, node: NodeInfo, results_q, pool_id: int = 0,
-        prewarm: "PrewarmPool | None" = None,
+        prewarm: "PrewarmPool | None" = None, remote_mgr=None,
     ) -> None:
         super().__init__(spec, node, pool_id)
         self.results_q = results_q  # mp queue shared by all pools' processes
         self.prewarm = prewarm
+        # cross-node plane (engine/remote_plane.py): when set, start_worker
+        # may place a worker on a connected node agent once local CPUs fill
+        self.remote_mgr = remote_mgr
         self._stage_pickle = cloudpickle.dumps(spec.stage)
+
+    @property
+    def _cpu_cost(self) -> float:
+        return self.stage.resources.cpus
 
     def start_worker(self) -> WorkerHandle:
         wid = f"s{self.pool_id}-{self.name}-p{self._next_id}"
         self._next_id += 1
         env = dict(_base_worker_env(), CURATE_WORKER_ID=wid)
+        agent = (
+            self.remote_mgr.place(self._cpu_cost) if self.remote_mgr is not None else None
+        )
+        if agent is not None:
+            meta = WorkerMetadata(
+                worker_id=wid,
+                stage_name=self.name,
+                node=NodeInfo(node_id=agent.node_id, num_cpus=agent.num_cpus, num_tpu_chips=0),
+                allocation=self.stage.resources,
+            )
+            in_q, proc = self.remote_mgr.start_remote_worker(
+                agent, wid, self._stage_pickle, cloudpickle.dumps(meta), env,
+                cpu_cost=self._cpu_cost,
+            )
+            handle = WorkerHandle(worker_id=wid, in_q=in_q, proc=proc)
+            self.workers[wid] = handle
+            return handle
         adopted = self.prewarm.take() if self.prewarm is not None else None
         if adopted is not None:
             in_q, proc = adopted
@@ -260,6 +288,8 @@ class ProcessPool(BasePool):
         in_q.put(SetupMsg(self._stage_pickle, cloudpickle.dumps(meta), env=setup_env))
         handle = WorkerHandle(worker_id=wid, in_q=in_q, proc=proc)
         self.workers[wid] = handle
+        if self.remote_mgr is not None:
+            self.remote_mgr.note_local_start(self._cpu_cost)
         return handle
 
     def stop_worker(self, w: WorkerHandle) -> None:
@@ -269,8 +299,22 @@ class ProcessPool(BasePool):
         except Exception:
             pass
         self.workers.pop(w.worker_id, None)
+        if self.remote_mgr is not None and not hasattr(w.proc, "_agent"):
+            # locally placed worker (remote handles carry _RemoteProc; their
+            # cost is released by the manager's StopWorker path)
+            self.remote_mgr.note_local_stop(self._cpu_cost)
         if w.proc is not None:
             self.draining.append((w, time.monotonic()))
+
+    def note_worker_gone(self, w: WorkerHandle) -> None:
+        """Dead-worker reap: release placement accounting (stop_worker did
+        not run, so the counters would drift otherwise)."""
+        if self.remote_mgr is None:
+            return
+        if hasattr(w.proc, "_agent"):
+            self.remote_mgr.note_remote_gone(w.proc)
+        else:
+            self.remote_mgr.note_local_stop(self._cpu_cost)
 
 
 class InProcessPool(BasePool):
@@ -364,8 +408,12 @@ class InProcessPool(BasePool):
 
 def make_pool(
     spec: StageSpec, node: NodeInfo, mp_results_q, thread_results_q, pool_id: int = 0,
-    prewarm: PrewarmPool | None = None,
+    prewarm: PrewarmPool | None = None, remote_mgr=None,
 ):
     if spec.stage.resources.uses_tpu:
+        # TPU stages never place remotely: each host's chips belong to that
+        # host's engine process
         return InProcessPool(spec, node, thread_results_q, pool_id)
-    return ProcessPool(spec, node, mp_results_q, pool_id, prewarm=prewarm)
+    return ProcessPool(
+        spec, node, mp_results_q, pool_id, prewarm=prewarm, remote_mgr=remote_mgr
+    )

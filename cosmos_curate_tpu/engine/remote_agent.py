@@ -1,0 +1,249 @@
+"""Node agent: joins a driver's engine plane and hosts remote stage workers.
+
+Run on every non-driver node (the slurm template and Helm chart wire this
+up automatically):
+
+    python -m cosmos_curate_tpu.engine.remote_agent --driver HOST:PORT
+
+The agent spawns the SAME worker processes the driver uses locally
+(engine/worker.py ``worker_main`` — spawn, never fork; CPU-pinned JAX) and
+relays their control/result queues over the authenticated socket. Task
+payloads land in this node's object store on arrival and results are
+materialized back to bytes before the return hop — the driver's store and
+the agent's store never share segments. Reference match: the per-node Ray
+worker processes xenna schedules onto (ARCHITECTURE.md:70-81).
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import queue
+import socket
+import threading
+import time
+
+import cloudpickle
+
+from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.engine.remote_plane import (
+    AgentReady,
+    AgentResult,
+    Bye,
+    Hello,
+    StartWorker,
+    StopWorker,
+    SubmitBatch,
+    WorkerDied,
+    _token,
+    recv_msg,
+    send_msg,
+)
+from cosmos_curate_tpu.engine.worker import (
+    ProcessMsg,
+    ReadyMsg,
+    ResultMsg,
+    SetupMsg,
+    ShutdownMsg,
+    worker_main,
+)
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MP = mp.get_context("spawn")
+
+
+class NodeAgent:
+    def __init__(self, driver: str, *, node_id: str | None = None, num_cpus: float | None = None) -> None:
+        host, _, port = driver.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.node_id = node_id or f"{socket.gethostname()}-{os.getpid()}"
+        self.num_cpus = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
+        self.token = _token()
+        self.workers: dict[str, tuple[object, object]] = {}  # key -> (in_q, proc)
+        # (worker_key, batch_id) -> input refs, deleted once the result is
+        # relayed (or the worker dies) so /dev/shm never accumulates
+        self.inflight: dict[tuple[str, int], list] = {}
+        self.results_q: mp.Queue = _MP.Queue()
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def run(self, *, connect_timeout_s: float = 60.0) -> int:
+        object_store.cleanup_stale_segments()
+        deadline = time.monotonic() + connect_timeout_s
+        while True:  # the driver may come up after the agents (srun races)
+            try:
+                sock = socket.create_connection(self.addr, timeout=10)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        self.sock = sock
+        send_msg(sock, Hello(self.node_id, self.num_cpus), self.token)
+        logger.info(
+            "agent %s joined driver %s:%d (%.0f cpus)",
+            self.node_id, self.addr[0], self.addr[1], self.num_cpus,
+        )
+        relay = threading.Thread(target=self._relay_results, daemon=True)
+        relay.start()
+        threading.Thread(target=self._watchdog, daemon=True).start()
+        try:
+            while True:
+                msg = recv_msg(sock, self.token)
+                if isinstance(msg, Bye):
+                    break
+                try:
+                    self._handle(msg)
+                except Exception:
+                    # one poisoned batch/worker must not sever the link
+                    logger.exception("agent failed handling %s", type(msg).__name__)
+                    if isinstance(msg, SubmitBatch):
+                        import traceback
+
+                        self._send(
+                            AgentResult(
+                                msg.worker_key, msg.batch_id, error=traceback.format_exc()
+                            )
+                        )
+        except (ConnectionError, OSError) as e:
+            logger.warning("driver link lost: %s", e)
+        finally:
+            self._stop.set()
+            for key, (in_q, _proc) in list(self.workers.items()):
+                try:
+                    in_q.put(ShutdownMsg())
+                except Exception:
+                    pass
+            time.sleep(0.2)
+            for key, (_in_q, proc) in list(self.workers.items()):
+                if proc.is_alive():
+                    proc.terminate()
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return 0
+
+    def _send(self, msg) -> None:
+        with self._send_lock:
+            send_msg(self.sock, msg, self.token)
+
+    def _handle(self, msg) -> None:
+        if isinstance(msg, StartWorker):
+            in_q = _MP.Queue()
+            env = dict(msg.env)
+            env["CURATE_WORKER_ID"] = msg.worker_key
+            env["CURATE_STORE_OWNER"] = str(os.getpid())  # agent owns segments
+            proc = _MP.Process(
+                target=worker_main,
+                args=(in_q, self.results_q, env),
+                daemon=True,
+                name=msg.worker_key,
+            )
+            proc.start()
+            in_q.put(SetupMsg(msg.stage_pickle, msg.meta_pickle))
+            self.workers[msg.worker_key] = (in_q, proc)
+        elif isinstance(msg, SubmitBatch):
+            entry = self.workers.get(msg.worker_key)
+            if entry is None:
+                self._send(
+                    AgentResult(
+                        msg.worker_key, msg.batch_id, error="unknown worker on agent"
+                    )
+                )
+                return
+            tasks = cloudpickle.loads(msg.tasks_pickle)
+            refs = [object_store.put(t) for t in tasks]
+            self.inflight[(msg.worker_key, msg.batch_id)] = refs
+            entry[0].put(ProcessMsg(batch_id=msg.batch_id, refs=refs))
+        elif isinstance(msg, StopWorker):
+            entry = self.workers.pop(msg.worker_key, None)
+            if entry is not None:
+                try:
+                    entry[0].put(ShutdownMsg())
+                except Exception:
+                    entry[1].terminate()
+
+    def _release_inflight(self, worker_key: str, batch_id: int) -> None:
+        refs = self.inflight.pop((worker_key, batch_id), [])
+        for r in refs:
+            try:
+                object_store.delete(r)
+            except Exception:
+                pass
+
+    def _relay_results(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self.results_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                if isinstance(msg, ReadyMsg):
+                    self._send(AgentReady(msg.worker_id, error=msg.error))
+                elif isinstance(msg, ResultMsg):
+                    self._release_inflight(msg.worker_id, msg.batch_id)
+                    if msg.error is not None:
+                        self._send(
+                            AgentResult(
+                                msg.worker_id,
+                                msg.batch_id,
+                                error=msg.error,
+                                process_time_s=msg.process_time_s,
+                            )
+                        )
+                        continue
+                    outputs = [object_store.get(r) for r in msg.out_refs]
+                    # outputs are pickled for the wire; their segments are
+                    # dead weight from here on
+                    for r in msg.out_refs:
+                        try:
+                            object_store.delete(r)
+                        except Exception:
+                            pass
+                    self._send(
+                        AgentResult(
+                            msg.worker_id,
+                            msg.batch_id,
+                            outputs_pickle=cloudpickle.dumps(outputs),
+                            process_time_s=msg.process_time_s,
+                            deserialize_time_s=msg.deserialize_time_s,
+                        )
+                    )
+            except OSError:
+                return
+
+    def _watchdog(self) -> None:
+        """Detect remote worker PROCESS deaths (the driver can only see the
+        link): report WorkerDied so the driver's reap requeues the batch,
+        and free the dead worker's in-flight input segments."""
+        while not self._stop.is_set():
+            time.sleep(1.0)
+            for key, (_in_q, proc) in list(self.workers.items()):
+                if proc.is_alive():
+                    continue
+                self.workers.pop(key, None)
+                logger.warning("worker %s died on agent (exit %s)", key, proc.exitcode)
+                for wkey, batch_id in list(self.inflight):
+                    if wkey == key:
+                        self._release_inflight(wkey, batch_id)
+                try:
+                    self._send(WorkerDied(key))
+                except OSError:
+                    return
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="cosmos-curate-tpu engine node agent")
+    ap.add_argument("--driver", required=True, help="driver HOST:PORT")
+    ap.add_argument("--node-id", default=None)
+    ap.add_argument("--num-cpus", type=float, default=None)
+    args = ap.parse_args(argv)
+    return NodeAgent(args.driver, node_id=args.node_id, num_cpus=args.num_cpus).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,447 @@
+"""Cross-node inter-stage data plane for the streaming engine.
+
+Equivalent capability of xenna's cross-node execution (reference
+ARCHITECTURE.md:25-27,70-81 — tasks move between nodes' per-stage pools
+with the driver's central loop doing placement): worker processes on REMOTE
+hosts join a CPU stage's pool, batches flow to them over TCP, results flow
+back — the driver's orchestration loop, retries, autoscaler and object
+store are unchanged (remote results materialize into the driver's store and
+become ordinary ``ObjectRef``s).
+
+Topology: the driver (node rank 0) listens on ``CURATE_ENGINE_DRIVER_PORT``;
+every other node runs ``python -m cosmos_curate_tpu.engine.remote_agent
+--driver host:port``, which spawns the SAME spawned-process workers
+(engine/worker.py) the driver uses locally and relays their queues over the
+socket. TPU stages never place remotely — each host's chips belong to that
+host's engine process (the package invariant); host-level TPU scale stays
+with the partition/work-stealing modes.
+
+Wire format: length-prefixed frames authenticated with
+HMAC-SHA256(``CURATE_ENGINE_TOKEN``) — a frame that fails the MAC is
+dropped before any unpickling, so the plane refuses to run without a
+shared token. This replaces the reference's Ray object-plane trust model
+with an explicit cluster secret.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import cloudpickle
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TOKEN_ENV = "CURATE_ENGINE_TOKEN"
+DRIVER_PORT_ENV = "CURATE_ENGINE_DRIVER_PORT"
+WAIT_NODES_ENV = "CURATE_ENGINE_WAIT_NODES"
+WAIT_S_ENV = "CURATE_ENGINE_WAIT_S"
+
+_MAGIC = b"CRPL"
+
+
+# -- messages ---------------------------------------------------------------
+
+
+@dataclass
+class Hello:
+    node_id: str
+    num_cpus: float
+
+
+@dataclass
+class StartWorker:
+    worker_key: str
+    stage_pickle: bytes
+    meta_pickle: bytes
+    env: dict[str, str]
+
+
+@dataclass
+class SubmitBatch:
+    worker_key: str
+    batch_id: int
+    tasks_pickle: bytes
+
+
+@dataclass
+class StopWorker:
+    worker_key: str
+
+
+@dataclass
+class AgentReady:
+    worker_key: str
+    error: str | None = None
+
+
+@dataclass
+class AgentResult:
+    worker_key: str
+    batch_id: int
+    outputs_pickle: bytes | None = None
+    error: str | None = None
+    process_time_s: float = 0.0
+    deserialize_time_s: float = 0.0
+
+
+@dataclass
+class WorkerDied:
+    """Agent → driver: a remote worker PROCESS died (the link is fine).
+
+    The driver marks the worker dead so the orchestration loop's normal
+    dead-worker reap requeues its in-flight batch — remote crashes recover
+    through the same path as local ones."""
+
+    worker_key: str
+
+
+@dataclass
+class Bye:
+    pass
+
+
+# -- framing ----------------------------------------------------------------
+
+
+def _token() -> bytes:
+    tok = os.environ.get(TOKEN_ENV, "")
+    if not tok:
+        raise RuntimeError(
+            f"the cross-node engine plane requires {TOKEN_ENV} (shared "
+            "cluster secret; frames are HMAC-authenticated before unpickling)"
+        )
+    return tok.encode()
+
+
+def send_msg(sock: socket.socket, msg: Any, token: bytes) -> None:
+    payload = cloudpickle.dumps(msg)
+    mac = hmac.new(token, payload, hashlib.sha256).digest()
+    header = _MAGIC + struct.pack(">Q", len(payload)) + mac
+    sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = 1 << 31) -> Any:
+    header = _recv_exact(sock, 4 + 8 + 32)
+    if header[:4] != _MAGIC:
+        raise ConnectionError("bad frame magic")
+    (length,) = struct.unpack(">Q", header[4:12])
+    if length > max_bytes:
+        raise ConnectionError(f"frame too large: {length}")
+    mac = header[12:44]
+    payload = _recv_exact(sock, length)
+    want = hmac.new(token, payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(mac, want):
+        raise ConnectionError("frame failed authentication")
+    return cloudpickle.loads(payload)
+
+
+# -- driver side ------------------------------------------------------------
+
+
+class _RemoteProc:
+    """Stands in for mp.Process in WorkerHandle: liveness = agent link AND
+    the worker process on the agent (WorkerDied marks the latter)."""
+
+    exitcode = "remote"  # runner logs this; remote exit codes stay remote
+
+    def __init__(self, agent: "AgentLink", worker_key: str) -> None:
+        self._agent = agent
+        self._key = worker_key
+
+    def is_alive(self) -> bool:
+        return self._agent.alive and self._key not in self._agent.dead_workers
+
+    def join(self, timeout: float | None = None) -> None:  # noqa: ARG002
+        return
+
+    def terminate(self) -> None:
+        return
+
+
+class _RemoteInQ:
+    """Stands in for the worker's mp in-queue.
+
+    ``put`` only ENQUEUES — materialization, pickling and the socket send
+    happen on the manager's sender thread, so a large batch or a slow agent
+    link never stalls the orchestration loop (the local path's mp.Queue has
+    the same non-blocking property via its feeder thread)."""
+
+    def __init__(self, mgr: "RemoteWorkerManager", agent: "AgentLink", worker_key: str) -> None:
+        self._mgr = mgr
+        self._agent = agent
+        self._key = worker_key
+
+    def put(self, msg: Any) -> None:
+        from cosmos_curate_tpu.engine.worker import ProcessMsg, ShutdownMsg
+
+        if isinstance(msg, (ShutdownMsg, ProcessMsg)):
+            self._mgr.enqueue_send(self._agent, self._key, msg)
+            return
+        raise TypeError(f"unexpected message for remote worker: {type(msg)}")
+
+
+@dataclass
+class AgentLink:
+    node_id: str
+    num_cpus: float
+    sock: socket.socket
+    token: bytes
+    alive: bool = True
+    # worker_key -> cpu cost; accounting is in CPU units, matching the
+    # autoscaler's per-worker resources.cpus
+    worker_costs: dict = field(default_factory=dict)
+    dead_workers: set = field(default_factory=set)
+    _send_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def cpus_used(self) -> float:
+        return sum(self.worker_costs.values())
+
+    def send(self, msg: Any) -> None:
+        if self.sock is None:
+            return
+        try:
+            with self._send_lock:
+                send_msg(self.sock, msg, self.token)
+        except OSError:
+            self.alive = False
+
+
+class RemoteWorkerManager:
+    """Driver-side registry of connected node agents.
+
+    ``results_q`` receives ReadyMsg/ResultMsg exactly as local pools emit
+    them; remote outputs are put() into the driver's object store first, so
+    downstream stages cannot tell where a batch ran."""
+
+    def __init__(self, port: int, results_q, *, local_cpu_budget: float) -> None:
+        self.token = _token()
+        self.results_q = results_q
+        self.local_cpu_budget = local_cpu_budget
+        self.local_cpus_used = 0.0  # all pools' locally placed workers (cpu units)
+        self.agents: list[AgentLink] = []
+        self._lock = threading.Lock()
+        self._server = socket.create_server(("0.0.0.0", port), backlog=8)
+        self._closed = False
+        # async sender: materialize+pickle+send off the orchestration loop
+        import queue as _queue
+
+        self._send_q: "_queue.Queue" = _queue.Queue()
+        threading.Thread(target=self._sender_loop, daemon=True).start()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        logger.info("engine driver listening for node agents on :%d", port)
+
+    def enqueue_send(self, agent: AgentLink, worker_key: str, msg) -> None:
+        self._send_q.put((agent, worker_key, msg))
+
+    def _sender_loop(self) -> None:
+        import queue as _queue
+
+        from cosmos_curate_tpu.engine import object_store
+        from cosmos_curate_tpu.engine.worker import ProcessMsg, ShutdownMsg
+
+        while not self._closed:
+            try:
+                agent, key, msg = self._send_q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            try:
+                if isinstance(msg, ShutdownMsg):
+                    agent.send(StopWorker(key))
+                    with self._lock:
+                        agent.worker_costs.pop(key, None)
+                elif isinstance(msg, ProcessMsg):
+                    tasks = [object_store.get(r) for r in msg.refs]
+                    agent.send(SubmitBatch(key, msg.batch_id, cloudpickle.dumps(tasks)))
+            except Exception:
+                logger.exception("remote send failed for worker %s", key)
+                agent.alive = False
+
+    # -- connection handling -------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, addr = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_agent, args=(sock, addr), daemon=True
+            ).start()
+
+    def _serve_agent(self, sock: socket.socket, addr) -> None:
+        try:
+            hello = recv_msg(sock, self.token)
+        except (ConnectionError, OSError) as e:
+            logger.warning("rejected agent connection from %s: %s", addr, e)
+            sock.close()
+            return
+        if not isinstance(hello, Hello):
+            sock.close()
+            return
+        link = AgentLink(hello.node_id, hello.num_cpus, sock, self.token)
+        with self._lock:
+            self.agents.append(link)
+        logger.info(
+            "node agent joined: %s (%.0f cpus) from %s", hello.node_id, hello.num_cpus, addr
+        )
+        try:
+            while True:
+                msg = recv_msg(sock, self.token)
+                self._on_agent_msg(link, msg)
+        except (ConnectionError, OSError):
+            link.alive = False
+            logger.warning("node agent %s disconnected", link.node_id)
+
+    def _on_agent_msg(self, link: AgentLink, msg: Any) -> None:
+        from cosmos_curate_tpu.engine import object_store
+        from cosmos_curate_tpu.engine.worker import ReadyMsg, ResultMsg
+
+        if isinstance(msg, WorkerDied):
+            with self._lock:
+                link.dead_workers.add(msg.worker_key)
+                link.worker_costs.pop(msg.worker_key, None)
+        elif isinstance(msg, AgentReady):
+            self.results_q.put(ReadyMsg(worker_id=msg.worker_key, error=msg.error))
+        elif isinstance(msg, AgentResult):
+            if msg.error is not None:
+                self.results_q.put(
+                    ResultMsg(
+                        msg.batch_id,
+                        error=msg.error,
+                        process_time_s=msg.process_time_s,
+                        worker_id=msg.worker_key,
+                    )
+                )
+                return
+            outputs = cloudpickle.loads(msg.outputs_pickle or b"\x80\x04]\x94.")
+            refs = [object_store.put(t) for t in outputs]
+            self.results_q.put(
+                ResultMsg(
+                    msg.batch_id,
+                    out_refs=refs,
+                    process_time_s=msg.process_time_s,
+                    deserialize_time_s=msg.deserialize_time_s,
+                    worker_id=msg.worker_key,
+                )
+            )
+
+    # -- placement (all accounting in CPU units: a worker costs its
+    # stage's resources.cpus, matching the autoscaler's budget math) ----
+    def remote_cpus(self) -> float:
+        with self._lock:
+            return sum(a.num_cpus for a in self.agents if a.alive)
+
+    def place(self, cpu_cost: float) -> AgentLink | None:
+        """None = place locally. Local CPUs fill first (no network hop),
+        then the least-loaded live agent with room for this worker."""
+        cost = max(0.25, cpu_cost)  # zero-cost stages still occupy budget
+        with self._lock:
+            if self.local_cpus_used + cost <= self.local_cpu_budget + 1e-9:
+                return None
+            candidates = [
+                a
+                for a in self.agents
+                if a.alive and a.cpus_used + cost <= a.num_cpus + 1e-9
+            ]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda a: a.cpus_used)
+
+    def note_local_start(self, cpu_cost: float) -> None:
+        with self._lock:
+            self.local_cpus_used += max(0.25, cpu_cost)
+
+    def note_local_stop(self, cpu_cost: float) -> None:
+        with self._lock:
+            self.local_cpus_used = max(0.0, self.local_cpus_used - max(0.25, cpu_cost))
+
+    def note_remote_gone(self, proc: _RemoteProc) -> None:
+        with self._lock:
+            proc._agent.worker_costs.pop(proc._key, None)
+            proc._agent.dead_workers.discard(proc._key)
+
+    def start_remote_worker(
+        self,
+        agent: AgentLink,
+        worker_key: str,
+        stage_pickle: bytes,
+        meta_pickle: bytes,
+        env: dict,
+        *,
+        cpu_cost: float = 1.0,
+    ):
+        with self._lock:
+            agent.worker_costs[worker_key] = max(0.25, cpu_cost)
+        agent.send(StartWorker(worker_key, stage_pickle, meta_pickle, env))
+        return _RemoteInQ(self, agent, worker_key), _RemoteProc(agent, worker_key)
+
+    def wait_for_agents(self, n: int, timeout_s: float) -> int:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = sum(1 for a in self.agents if a.alive)
+            if live >= n:
+                return live
+            time.sleep(0.1)
+        with self._lock:
+            return sum(1 for a in self.agents if a.alive)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                a.node_id: {
+                    "cpus": a.num_cpus,
+                    "workers": len(a.worker_costs),
+                    "cpus_used": a.cpus_used,
+                }
+                for a in self.agents
+            }
+
+    def shutdown(self) -> None:
+        self._closed = True
+        with self._lock:
+            agents = list(self.agents)
+        for a in agents:
+            a.send(Bye())
+            if a.sock is not None:
+                try:
+                    a.sock.close()
+                except OSError:
+                    pass
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+def maybe_create_manager(results_q, *, local_cpu_budget: float) -> RemoteWorkerManager | None:
+    """Driver-side entry: active only when the env contract is present."""
+    port = os.environ.get(DRIVER_PORT_ENV)
+    if not port:
+        return None
+    mgr = RemoteWorkerManager(int(port), results_q, local_cpu_budget=local_cpu_budget)
+    want = int(os.environ.get(WAIT_NODES_ENV, "0"))
+    if want:
+        got = mgr.wait_for_agents(want, float(os.environ.get(WAIT_S_ENV, "30")))
+        logger.info("engine plane: %d/%d node agents connected", got, want)
+    return mgr

@@ -100,9 +100,20 @@ class StreamingRunner(RunnerInterface):
             num_cpus=cfg.num_cpus or float(os.cpu_count() or 1),
             num_tpu_chips=self._discover_tpus(cfg, stage_specs),
         )
-        budget = Budget(cpus=node.num_cpus, tpus=float(node.num_tpu_chips))
         mp_results: mp.Queue = mp.get_context("spawn").Queue()
         thread_results: queue.Queue = queue.Queue()
+        # cross-node data plane (engine/remote_plane.py): active when
+        # CURATE_ENGINE_DRIVER_PORT is set — connected node agents' CPUs
+        # join the budget and CPU-stage pools place workers on them
+        from cosmos_curate_tpu.engine.remote_plane import maybe_create_manager
+
+        remote_mgr = maybe_create_manager(
+            thread_results, local_cpu_budget=node.num_cpus
+        )
+        budget = Budget(
+            cpus=node.num_cpus + (remote_mgr.remote_cpus() if remote_mgr else 0.0),
+            tpus=float(node.num_tpu_chips),
+        )
         # warm spares prepay worker spawn+import (~3-5 s) so autoscale-up is
         # stage-setup-bound only; CURATE_PREWARM=0 disables
         from cosmos_curate_tpu.engine.pool import PrewarmPool
@@ -117,7 +128,10 @@ class StreamingRunner(RunnerInterface):
         states = [
             _StageState(
                 spec=s,
-                pool=make_pool(s, node, mp_results, thread_results, pool_id=i, prewarm=prewarm),
+                pool=make_pool(
+                    s, node, mp_results, thread_results, pool_id=i,
+                    prewarm=prewarm, remote_mgr=remote_mgr,
+                ),
             )
             for i, s in enumerate(stage_specs)
         ]
@@ -241,6 +255,9 @@ class StreamingRunner(RunnerInterface):
                 st.pool.shutdown()
             if prewarm is not None:
                 prewarm.shutdown()
+            if remote_mgr is not None:
+                self.remote_stats = remote_mgr.stats()
+                remote_mgr.shutdown()
 
     # ------------------------------------------------------------------
     def _on_ready(self, states, msg: ReadyMsg, errors: list[str]) -> None:
@@ -321,8 +338,10 @@ class StreamingRunner(RunnerInterface):
             for w in list(st.pool.workers.values()):
                 proc = w.proc
                 if proc is not None and not proc.is_alive():
-                    logger.warning("worker %s died (exit %s)", w.worker_id, proc.exitcode)
+                    exitcode = getattr(proc, "exitcode", "remote")
+                    logger.warning("worker %s died (exit %s)", w.worker_id, exitcode)
                     st.pool.workers.pop(w.worker_id, None)
+                    st.pool.note_worker_gone(w)
                     if not w.ready:
                         # died before ReadyMsg: likely a setup crash. A cap
                         # prevents an infinite respawn loop when setup is
@@ -331,7 +350,7 @@ class StreamingRunner(RunnerInterface):
                         if st.pool.setup_deaths >= self._MAX_SETUP_DEATHS:
                             raise RuntimeError(
                                 f"stage {st.spec.name}: {st.pool.setup_deaths} workers "
-                                f"died during setup (last exit {proc.exitcode}); "
+                                f"died during setup (last exit {exitcode}); "
                                 f"aborting pipeline"
                             )
                     if w.busy_batch is not None and w.busy_batch in batches:

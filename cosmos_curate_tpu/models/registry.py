@@ -25,6 +25,11 @@ from cosmos_curate_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 WEIGHTS_DIR_ENV = "CURATE_MODEL_WEIGHTS_DIR"
+# Remote prefix weights are pulled from on demand (s3:// gs:// az:// or a
+# local/NFS path) — the reference's download/staging flow
+# (model_utils.py:139 pulls from HF/S3 to node-local disk; here the pull
+# rides the SDK-free storage clients).
+WEIGHTS_URI_ENV = "CURATE_WEIGHTS_URI"
 
 
 @dataclass(frozen=True)
@@ -83,9 +88,87 @@ def find_checkpoint(model_id: str) -> Path | None:
 
 def stage_weights_on_node(model_ids: list[str]) -> None:
     """Per-node staging hook (reference: one Ray task per node copies weights
-    to local SSD, model_utils.py:139). Local build: ensure dirs exist."""
+    to local SSD, model_utils.py:139). Ensures dirs exist and, when
+    ``CURATE_WEIGHTS_URI`` names a remote prefix, pulls each model's
+    checkpoint down to node-local disk."""
     for mid in model_ids:
         local_dir_for(mid).mkdir(parents=True, exist_ok=True)
+        maybe_pull_remote_weights(mid)
+
+
+def maybe_pull_remote_weights(model_id: str) -> Path | None:
+    """Pull ``{CURATE_WEIGHTS_URI}/{model_id}/params.msgpack`` to the local
+    staging dir if it is not already there.
+
+    Fan-out safe: concurrent worker processes on one node serialize on a
+    file lock and land the bytes via atomic rename, so every node pays the
+    download ONCE regardless of worker count (the reference's one-Ray-task-
+    per-node staging property). A ``params.msgpack.sha256`` sidecar, when
+    present, is verified before the rename — a truncated or corrupted pull
+    never becomes a "staged checkpoint".
+    """
+    uri = os.environ.get(WEIGHTS_URI_ENV, "").rstrip("/")
+    if not uri:
+        return None
+    dest = local_dir_for(model_id) / "params.msgpack"
+    if dest.exists():
+        return dest
+    from cosmos_curate_tpu.storage.client import get_storage_client
+    from cosmos_curate_tpu.utils.file_lock import file_lock
+
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    lock_path = dest.parent / ".staging.lock"
+    with file_lock(lock_path):
+        if dest.exists():  # another worker won the race while we waited
+            return dest
+        remote = f"{uri}/{model_id}/params.msgpack"
+        client = get_storage_client(remote)
+        try:
+            want = client.read_bytes(f"{remote}.sha256").decode().split()[0]
+        except FileNotFoundError:
+            want = ""
+        import hashlib
+
+        tmp = dest.with_suffix(".msgpack.tmp")
+        digest = hashlib.sha256()
+        chunk = 32 * 1024 * 1024
+        # stream ranged reads through the hash into the temp file: a
+        # multi-GB checkpoint never sits fully in RAM (the realistic VLM
+        # case this plane exists for)
+        read_range = getattr(client, "read_range", None)
+        if getattr(client, "size", None) is None:
+            read_range = None  # ranged streaming needs the object size too
+        size = 0
+        try:
+            with tmp.open("wb") as fh:
+                if read_range is not None:
+                    total = client.size(remote)
+                    for start in range(0, total, chunk):
+                        part = read_range(remote, start, min(start + chunk, total) - 1)
+                        digest.update(part)
+                        fh.write(part)
+                        size += len(part)
+                else:
+                    data = client.read_bytes(remote)
+                    digest.update(data)
+                    fh.write(data)
+                    size = len(data)
+        except FileNotFoundError:
+            tmp.unlink(missing_ok=True)
+            logger.info("no remote weights at %s", remote)
+            return None
+        except Exception:
+            tmp.unlink(missing_ok=True)
+            raise
+        if want and digest.hexdigest() != want:
+            tmp.unlink(missing_ok=True)
+            raise RuntimeError(
+                f"weights integrity check failed for {remote}: "
+                f"sha256 {digest.hexdigest()} != manifest {want}"
+            )
+        tmp.rename(dest)  # atomic: readers never see a partial file
+        logger.info("staged %s from %s (%d bytes)", model_id, remote, size)
+        return dest
 
 
 def load_params(
@@ -110,6 +193,12 @@ def load_params(
     # repeat compiles (fresh processes, re-created stage instances) disk hits.
     enable_persistent_cache()
     ckpt = find_checkpoint(model_id)
+    if ckpt is None:
+        try:
+            ckpt = maybe_pull_remote_weights(model_id)
+        except Exception:
+            logger.exception("remote weight staging failed for %s", model_id)
+            ckpt = None
     if ckpt is not None:
         import flax.serialization
 

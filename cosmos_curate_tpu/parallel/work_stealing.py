@@ -72,10 +72,12 @@ def claim_next_batch(
         path = f"{root}/{rid}.json"
         try:
             rec = json.loads(client.read_bytes(path))
-            if int(rec.get("rank", -1)) == rank:
-                continue  # already attempted by us (terminates retry loops)
             if now - float(rec.get("ts", 0)) < ttl_s:
-                continue  # freshly claimed by another node
+                # fresh claim blocks everyone INCLUDING our own rank — a
+                # failing task is retried only after the TTL, and a
+                # restarted node (same rank, fresh process) can reclaim
+                # its own stale claims instead of skipping them forever
+                continue
         except Exception:
             pass  # no claim yet (or unreadable: treat as stale)
         client.write_bytes(path, json.dumps({"rank": rank, "ts": now}).encode())
@@ -103,14 +105,24 @@ def run_with_stealing(
     record_id: Callable[[object], str],
     batch: int = 0,
     ttl_s: float = DEFAULT_TTL_S,
+    is_done: Callable[[object], bool] | None = None,
+    poll_s: float = 15.0,
 ) -> list:
-    """Drain ``tasks`` by pulling claim batches until the ledger is dry.
+    """Drain ``tasks`` by pulling claim batches until every task is claimed
+    AND finished.
 
     ``run_batch`` processes one claimed batch and returns its outputs.
     ``batch=0`` (default) sizes claims adaptively — about half a node's
     fair share per pull, shrinking as the ledger drains — so each node pays
     ~2·log(share) pipeline spin-ups instead of one per pair of tasks, while
-    the tail still rebalances at fine grain."""
+    the tail still rebalances at fine grain.
+
+    When nothing is claimable but tasks remain (fresh claims held by other
+    nodes), the node LINGERS: tasks whose ``is_done`` turns true drop off;
+    tasks whose claimer crashed become claimable at the TTL and are taken
+    over. Without the linger, a peer crashing after claiming would leave
+    its tasks processed by no one while the run reports success. Pass
+    ``is_done=None`` to keep the old exit-when-dry behavior."""
     from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
 
     _, n_nodes = node_rank_and_count()
@@ -121,9 +133,21 @@ def run_with_stealing(
         got = claim_next_batch(
             remaining, output_path, record_id=record_id, batch=size, ttl_s=ttl_s
         )
-        if not got:
+        if got:
+            out += run_batch(got) or []
+            claimed_ids = {record_id(t) for t in got}
+            remaining = [t for t in remaining if record_id(t) not in claimed_ids]
+            continue
+        if is_done is None:
             break
-        out += run_batch(got) or []
-        claimed_ids = {record_id(t) for t in got}
-        remaining = [t for t in remaining if record_id(t) not in claimed_ids]
+        before = len(remaining)
+        remaining = [t for t in remaining if not is_done(t)]
+        if not remaining:
+            break
+        if len(remaining) == before:
+            logger.info(
+                "waiting on %d task(s) claimed elsewhere (takeover after "
+                "claim TTL if the claimer died)", len(remaining),
+            )
+            time.sleep(poll_s)
     return out

@@ -113,45 +113,98 @@ def write_prefix_embeddings_cache(
     return path
 
 
+@dataclass
+class CameraClipMedia:
+    """One camera's media for a clip-session tar."""
+
+    video_bytes: bytes
+    timestamps_ms: list[int] = field(default_factory=list)
+    trajectory: np.ndarray | None = None
+
+
+@dataclass
+class ClipSessionMedia:
+    session_uuid: str
+    cameras: dict[str, CameraClipMedia] = field(default_factory=dict)
+
+
+def package_clip_sessions(
+    samples: list["ClipSessionMedia"],
+    root: str,
+    dataset: str,
+    *,
+    subdir: str = "clips",
+) -> list[str]:
+    """Mp4 clip-session tars (reference ClipPackagingStage,
+    dataset_writer_stage.py:140-236): one tar per clip-session holding, per
+    camera, ``{session}.{camera}.mp4`` (the encoded clip),
+    ``{session}.{camera}.json`` (per-frame timestamps as
+    ``[{"frame_num": n, "timestamp": ms}, ...]``) and optionally
+    ``{session}.{camera}.bin`` (the egomotion trajectory)."""
+    base = f"{root.rstrip('/')}/datasets/{dataset}/{subdir}"
+    written: list[str] = []
+    for sample in samples:
+        items: list[tuple[bytes, str]] = []
+        for camera in sorted(sample.cameras):
+            media = sample.cameras[camera]
+            name = f"{sample.session_uuid}.{camera}"
+            items.append((media.video_bytes, f"{name}.mp4"))
+            meta = [
+                {"frame_num": i, "timestamp": int(ts_ms)}
+                for i, ts_ms in enumerate(media.timestamps_ms)
+            ]
+            items.append((json.dumps(meta).encode(), f"{name}.json"))
+            if media.trajectory is not None:
+                items.append((np.asarray(media.trajectory).tobytes(), f"{name}.bin"))
+        path = f"{base}/{sample.session_uuid}.tar"
+        write_bytes(path, _tar_bytes(items))
+        written.append(path)
+    logger.info("packaged %d clip-session tars under %s", len(written), base)
+    return written
+
+
 def package_t5_embeddings_e(
     samples: list[SessionSample],
     root: str,
     dataset: str,
     *,
-    variants: tuple[str, ...] = ("t5_xxl",),
+    variant: str = "t5_xxl",
+    window: int = 0,
 ) -> list[str]:
-    """Embeddings-first tars: one tar per session per variant.
+    """Embeddings-first tars: one tar per clip-session for ONE T5 variant.
 
+    A ``SessionSample`` carries one variant's per-WINDOW embeddings, so a
+    multi-variant dataset calls this once per variant with that variant's
+    samples (the reference packs its T5_VARIANTS from parallel per-variant
+    embedding lists, dataset_writer_stage.py:238-398 — same tar layout).
     Tar members per camera: ``{session}.{camera}.bin`` (pickled embedding
-    for window k of that variant) and ``{session}.{camera}.json`` holding
-    ``[clip_uuid, [caption], [start_frame], [end_frame]]`` — the exact
-    member naming + metadata shape of T5EmbeddingPackagingStageE.
+    for ``window``) and ``{session}.{camera}.json`` holding
+    ``[clip_uuid, [caption], [start_frame], [end_frame]]``.
     """
     written: list[str] = []
     base = f"{root.rstrip('/')}/datasets/{dataset}"
     for sample in samples:
-        for k, variant in enumerate(variants):
-            items: list[tuple[bytes, str]] = []
-            for camera in sorted(sample.cameras):
-                cw = sample.cameras[camera]
-                if k >= len(cw.embeddings):
-                    logger.warning(
-                        "session %s camera %s lacks window %d embedding; skipping member",
-                        sample.session_uuid, camera, k,
-                    )
-                    continue
-                name = f"{sample.session_uuid}.{camera}"
-                items.append((pickle.dumps(np.asarray(cw.embeddings[k])), f"{name}.bin"))
-                meta = [
-                    cw.clip_uuid,
-                    [cw.captions[k] if k < len(cw.captions) else ""],
-                    [cw.window_start_frames[k] if k < len(cw.window_start_frames) else 0],
-                    [cw.window_end_frames[k] if k < len(cw.window_end_frames) else 0],
-                ]
-                items.append((json.dumps(meta).encode(), f"{name}.json"))
-            path = f"{base}/{variant}/{sample.session_uuid}.tar"
-            write_bytes(path, _tar_bytes(items))
-            written.append(path)
+        items: list[tuple[bytes, str]] = []
+        for camera in sorted(sample.cameras):
+            cw = sample.cameras[camera]
+            if window >= len(cw.embeddings):
+                logger.warning(
+                    "session %s camera %s lacks window %d embedding; skipping member",
+                    sample.session_uuid, camera, window,
+                )
+                continue
+            name = f"{sample.session_uuid}.{camera}"
+            items.append((pickle.dumps(np.asarray(cw.embeddings[window])), f"{name}.bin"))
+            meta = [
+                cw.clip_uuid,
+                [cw.captions[window] if window < len(cw.captions) else ""],
+                [cw.window_start_frames[window] if window < len(cw.window_start_frames) else 0],
+                [cw.window_end_frames[window] if window < len(cw.window_end_frames) else 0],
+            ]
+            items.append((json.dumps(meta).encode(), f"{name}.json"))
+        path = f"{base}/{variant}/{sample.session_uuid}.tar"
+        write_bytes(path, _tar_bytes(items))
+        written.append(path)
     logger.info("packaged %d embeddings-first tars under %s", len(written), base)
     return written
 

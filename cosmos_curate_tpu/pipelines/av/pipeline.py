@@ -57,6 +57,8 @@ class AVPipelineArgs:
     # shard-time T5 packaging: none | e (embeddings-first, one tar per
     # session) | h (hierarchical part_NNNNNN/t5_NNNNNN.tar)
     t5_packaging: str = "none"
+    # shard-time mp4 clip-session tars (reference ClipPackagingStage)
+    clip_packaging: bool = False
 
     @property
     def resolved_db(self) -> str:
@@ -310,10 +312,11 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
 
 
 def run_av_shard(args: AVPipelineArgs) -> dict:
+    summary = {}
     if args.t5_packaging in ("e", "h"):
-        summary = _shard_t5_packaging(args)
-    else:
-        summary = {}
+        summary |= _shard_t5_packaging(args)
+    if args.clip_packaging:
+        summary |= _shard_clip_packaging(args)
     from cosmos_curate_tpu.pipelines.video.shard import ShardPipelineArgs, run_shard
 
     return summary | run_shard(
@@ -322,6 +325,61 @@ def run_av_shard(args: AVPipelineArgs) -> dict:
             output_path=f"{args.output_path.rstrip('/')}/shards",
         )
     )
+
+
+def _shard_clip_packaging(args: AVPipelineArgs) -> dict:
+    """Mp4 clip-session tars (reference ClipPackagingStage,
+    av/writers/dataset_writer_stage.py:140-236): each synchronized span's
+    per-camera clips + exact per-frame timestamps (from the MP4 sample
+    tables) tar up together."""
+    import uuid as uuid_mod
+
+    from cosmos_curate_tpu.pipelines.av.packaging import (
+        CameraClipMedia,
+        ClipSessionMedia,
+        package_clip_sessions,
+    )
+    from cosmos_curate_tpu.storage.client import read_bytes
+    from cosmos_curate_tpu.video.mp4_index import Mp4ParseError, parse_mp4_video_index
+
+    root = args.output_path.rstrip("/")
+    db = open_state_db(args.resolved_db)
+    try:
+        # group FIRST (rows only), then read + tar one clip-session at a
+        # time — memory is bounded by a single session's clips, not the
+        # whole dataset's mp4 bytes
+        by_span: dict[tuple, list] = {}
+        for row in db.clips():
+            if row.state not in ("captioned", "packaged"):
+                continue
+            key = (row.session_id, round(row.span_start, 3), round(row.span_end, 3))
+            by_span.setdefault(key, []).append(row)
+        num_tars = 0
+        for key, rows in by_span.items():
+            csu = uuid_mod.uuid5(uuid_mod.NAMESPACE_URL, f"{key[0]}:{key[1]}:{key[2]}")
+            sample = ClipSessionMedia(session_uuid=str(csu))
+            for row in rows:
+                try:
+                    data = read_bytes(f"{root}/clips/{row.clip_uuid}.mp4")
+                except FileNotFoundError:
+                    logger.warning(
+                        "clip %s missing; skipping from clip tar", row.clip_uuid
+                    )
+                    continue
+                try:
+                    idx = parse_mp4_video_index(data)
+                    ts_ms = [int(round(t * 1000)) for t in idx.pts_s]
+                except Mp4ParseError:
+                    ts_ms = []
+                sample.cameras[row.camera] = CameraClipMedia(
+                    video_bytes=data, timestamps_ms=ts_ms
+                )
+            if sample.cameras:
+                package_clip_sessions([sample], root, args.dataset_name)
+                num_tars += 1
+        return {"num_clip_tars": num_tars}
+    finally:
+        db.close()
 
 
 def _shard_t5_packaging(args: AVPipelineArgs) -> dict:

@@ -265,13 +265,28 @@ def run_split(
         if n_nodes > 1 and stealing_enabled():
             # shared-ledger mode: nodes pull claim batches until dry, so a
             # skewed input split rebalances instead of idling fast nodes
+            from cosmos_curate_tpu.pipelines.video.input_discovery import (
+                _processed_video_ids,
+            )
             from cosmos_curate_tpu.pipelines.video.stages.writer import video_record_id
+
+            done_cache = {"ts": 0.0, "ids": set()}
+
+            def _task_done(t) -> bool:
+                # resume records are the completion signal; one listing per
+                # linger poll, not per task
+                now = time.monotonic()
+                if now - done_cache["ts"] > 5.0:
+                    done_cache["ids"] = _processed_video_ids(args.output_path)
+                    done_cache["ts"] = now
+                return video_record_id(t.video.path) in done_cache["ids"]
 
             out = run_with_stealing(
                 tasks,
                 args.output_path,
                 lambda batch: run_pipeline(batch, stages, config=config, runner=runner),
                 record_id=lambda t: video_record_id(t.video.path),
+                is_done=_task_done,
             )
         else:
             # default: each node takes a disjoint task slice (host-level

@@ -131,3 +131,27 @@ def test_write_prometheus_sd_skips_empty_hosts(tmp_path):
     p = tmp_path / "sd.json"
     write_prometheus_sd(p, ["h1", "", "h2"], port=9100)
     assert json.loads(p.read_text())[0]["targets"] == ["h1:9100", "h2:9100"]
+
+
+def test_engine_plane_sbatch_topology(tmp_path):
+    """--engine-plane renders the driver/agent split with a shared token
+    and the quoted driver command carried via CURATE_DRIVER_CMD."""
+    from cosmos_curate_tpu.cli.main import main
+
+    out = tmp_path / "job.sbatch"
+    rc = main(
+        [
+            "slurm", "submit", "--nodes", "3", "--engine-plane",
+            "--output", str(out),
+            "--", "local", "split", "--config", "my run.yaml",
+        ]
+    )
+    assert rc == 0
+    script = out.read_text()
+    assert "CURATE_ENGINE_TOKEN" in script
+    assert "CURATE_ENGINE_DRIVER_PORT=8478" in script
+    assert 'CURATE_ENGINE_WAIT_NODES="$((SLURM_JOB_NUM_NODES - 1))"' in script
+    assert "engine.remote_agent" in script
+    assert "SLURM_NODEID" in script
+    # the command with a space survives shlex round-trip
+    assert "'my run.yaml'" in script

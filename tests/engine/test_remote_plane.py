@@ -1,0 +1,192 @@
+"""Cross-node inter-stage data plane, end to end on localhost.
+
+A real node-agent subprocess joins the driver's plane; a CPU stage's pool
+places workers on it once local CPUs fill; batches flow over the
+authenticated socket and results come back as ordinary ObjectRefs
+(reference ARCHITECTURE.md:25-27,70-81 — xenna's cross-node scheduling)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, PipelineSpec, run_pipeline
+from cosmos_curate_tpu.core.stage import Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+
+class _NodeStampTask(PipelineTask):
+    def __init__(self, value: int) -> None:
+        self.value = value
+        self.node_id = ""
+
+
+class _StampStage(Stage):
+    """Doubles the value and stamps which node processed it."""
+
+    def setup(self, meta) -> None:
+        self._node_id = meta.node.node_id
+
+    def process_data(self, tasks):
+        out = []
+        for t in tasks:
+            t.value *= 2
+            t.node_id = self._node_id
+            out.append(t)
+        return out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+class TestRemotePlane:
+    def test_agent_processes_batches(self, monkeypatch, tmp_path):
+        port = _free_port()
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "test-cluster-secret")
+        monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", "1")
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+        monkeypatch.setenv("CURATE_PREWARM", "0")
+
+        env = {
+            **os.environ,
+            "CURATE_ENGINE_TOKEN": "test-cluster-secret",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+        }
+        agent = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "cosmos_curate_tpu.engine.remote_agent",
+                "--driver",
+                f"127.0.0.1:{port}",
+                "--node-id",
+                "agent-a",
+                "--num-cpus",
+                "2",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+            runner = StreamingRunner(poll_interval_s=0.01)
+            tasks = [_NodeStampTask(i) for i in range(12)]
+            spec = PipelineSpec(
+                input_data=tasks,
+                stages=[StageSpec(_StampStage(), num_workers=3)],
+                config=PipelineConfig(
+                    num_cpus=1.0,  # local budget 1 -> workers 2..3 go remote
+                    return_last_stage_outputs=True,
+                ),
+            )
+            out = runner.run(spec)
+            assert out is not None and len(out) == 12
+            assert sorted(t.value for t in out) == [i * 2 for i in range(12)]
+            nodes = {t.node_id for t in out}
+            assert "agent-a" in nodes, f"no batch ran remotely: {nodes}"
+            assert any(n != "agent-a" for n in nodes), "local workers idle?"
+            stats = getattr(runner, "remote_stats", {})
+            assert "agent-a" in stats
+        finally:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
+
+    def test_plane_refuses_without_token(self, monkeypatch):
+        from cosmos_curate_tpu.engine.remote_plane import maybe_create_manager
+
+        monkeypatch.delenv("CURATE_ENGINE_TOKEN", raising=False)
+        monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(_free_port()))
+        import queue
+
+        with pytest.raises(RuntimeError, match="CURATE_ENGINE_TOKEN"):
+            maybe_create_manager(queue.Queue(), local_cpu_budget=1.0)
+
+    def test_unauthenticated_frames_rejected(self, monkeypatch):
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import (
+            Hello,
+            RemoteWorkerManager,
+            send_msg,
+        )
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "right-token")
+        port = _free_port()
+        mgr = RemoteWorkerManager(port, queue.Queue(), local_cpu_budget=1.0)
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+            send_msg(sock, Hello("evil", 8.0), b"wrong-token")
+            time.sleep(0.3)
+            assert mgr.stats() == {}, "agent with a bad token must not join"
+            sock.close()
+        finally:
+            mgr.shutdown()
+
+    def test_worker_died_marks_remote_proc_dead(self, monkeypatch):
+        """An agent-reported worker crash must surface through the same
+        is_alive() seam the runner's dead-worker reap polls."""
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import (
+            AgentLink,
+            RemoteWorkerManager,
+            WorkerDied,
+            _RemoteProc,
+        )
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "t")
+        mgr = RemoteWorkerManager(_free_port(), queue.Queue(), local_cpu_budget=1.0)
+        try:
+            link = AgentLink("n1", 4.0, sock=None, token=b"t")
+            link.worker_costs["w1"] = 1.0
+            proc = _RemoteProc(link, "w1")
+            assert proc.is_alive()
+            mgr._on_agent_msg(link, WorkerDied("w1"))
+            assert not proc.is_alive()
+            assert link.cpus_used == 0.0  # cost released for replacement
+        finally:
+            mgr.shutdown()
+
+    def test_cpu_cost_placement(self, monkeypatch):
+        """Placement accounts CPU units, not worker counts."""
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import AgentLink, RemoteWorkerManager
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "t")
+        mgr = RemoteWorkerManager(_free_port(), queue.Queue(), local_cpu_budget=8.0)
+        try:
+            link = AgentLink("n1", 8.0, sock=None, token=b"t")
+            mgr.agents.append(link)
+            # 4-cpu workers: two fit locally, then spill to the agent
+            assert mgr.place(4.0) is None
+            mgr.note_local_start(4.0)
+            assert mgr.place(4.0) is None
+            mgr.note_local_start(4.0)
+            assert mgr.place(4.0) is link
+            link.worker_costs["w"] = 4.0
+            assert mgr.place(4.0) is link
+            link.worker_costs["w2"] = 4.0
+            assert mgr.place(4.0) is None  # everything full
+        finally:
+            mgr.shutdown()

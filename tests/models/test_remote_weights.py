@@ -1,0 +1,77 @@
+"""Remote weight staging (reference model_utils.py:56-778 download flow):
+pull from object storage through the SDK-free clients, integrity-checked,
+fan-out safe per node."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.models.registry import (
+    WEIGHTS_URI_ENV,
+    load_params,
+    maybe_pull_remote_weights,
+)
+
+
+@pytest.fixture()
+def weights_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path / "staged"))
+    remote = tmp_path / "remote"
+    remote.mkdir()
+    monkeypatch.setenv(WEIGHTS_URI_ENV, str(remote))
+    return remote
+
+
+def _publish(remote, model_id: str, payload: bytes, *, with_sha=True, bad_sha=False):
+    d = remote / model_id
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "params.msgpack").write_bytes(payload)
+    if with_sha:
+        digest = hashlib.sha256(payload).hexdigest()
+        if bad_sha:
+            digest = "0" * 64
+        (d / "params.msgpack.sha256").write_text(f"{digest}  params.msgpack\n")
+
+
+class TestRemoteStaging:
+    def test_pull_and_load(self, weights_env):
+        import flax.serialization
+
+        params = {"w": np.arange(4, dtype=np.float32)}
+        _publish(weights_env, "transnetv2-tpu", flax.serialization.to_bytes(params))
+        got = load_params(
+            "transnetv2-tpu", lambda seed: {"w": np.zeros(4, np.float32)}
+        )
+        np.testing.assert_array_equal(got["w"], params["w"])
+
+    def test_bad_sha_rejected(self, weights_env):
+        _publish(weights_env, "transnetv2-tpu", b"payload", bad_sha=True)
+        with pytest.raises(RuntimeError, match="integrity"):
+            maybe_pull_remote_weights("transnetv2-tpu")
+
+    def test_missing_remote_is_quiet(self, weights_env):
+        assert maybe_pull_remote_weights("video-embed-tpu") is None
+
+    def test_no_sidecar_still_stages(self, weights_env):
+        _publish(weights_env, "transnetv2-tpu", b"data", with_sha=False)
+        path = maybe_pull_remote_weights("transnetv2-tpu")
+        assert path is not None and path.read_bytes() == b"data"
+
+    def test_concurrent_workers_stage_once(self, weights_env):
+        _publish(weights_env, "transnetv2-tpu", b"big" * 1000)
+        results = []
+
+        def work():
+            results.append(maybe_pull_remote_weights("transnetv2-tpu"))
+
+        threads = [threading.Thread(target=work) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(p is not None and p.exists() for p in results)
+        assert len({str(p) for p in results}) == 1

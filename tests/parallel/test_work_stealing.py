@@ -67,13 +67,25 @@ class TestClaimLedger:
         got = claim_next_batch(tasks, str(tmp_path), record_id=str, batch=2, rank=1, ttl_s=0.0)
         assert sorted(got) == tasks
 
-    def test_own_claims_not_retried(self, tmp_path):
+    def test_fresh_own_claims_not_retried(self, tmp_path):
         from cosmos_curate_tpu.parallel.work_stealing import claim_next_batch
 
         tasks = ["x"]
         assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=1, rank=0)
-        # same rank asking again gets nothing (failed-task retry loops terminate)
-        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=1, rank=0, ttl_s=0.0) == []
+        # a FRESH claim blocks everyone, including our own rank (failed-task
+        # retry loops terminate within a run)
+        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=1, rank=0) == []
+
+    def test_restarted_rank_reclaims_own_stale_claims(self, tmp_path):
+        """A node that crashed and was requeued must be able to take back
+        its own stale claims — otherwise those tasks are processed by
+        no one while the run reports success."""
+        from cosmos_curate_tpu.parallel.work_stealing import claim_next_batch
+
+        tasks = ["x", "y"]
+        assert claim_next_batch(tasks, str(tmp_path), record_id=str, batch=2, rank=0)
+        got = claim_next_batch(tasks, str(tmp_path), record_id=str, batch=2, rank=0, ttl_s=0.0)
+        assert sorted(got) == tasks
 
 
 @pytest.mark.slow

@@ -372,3 +372,51 @@ def test_windowed_captioning(tmp_path):
         assert any(k.startswith("default#w") for k in vc), vc
     finally:
         db.close()
+
+
+def test_clip_session_tar_packaging(av_dir, tmp_path):
+    """ClipPackagingStage layout: datasets/{name}/clips/{session}.tar with
+    per-camera mp4 + frame-timestamp json members
+    (reference av/writers/dataset_writer_stage.py:140-236)."""
+    import json as json_mod
+    import tarfile
+
+    from cosmos_curate_tpu.pipelines.av.pipeline import (
+        AVPipelineArgs,
+        _shard_clip_packaging,
+        run_av_ingest,
+        run_av_split,
+    )
+    from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB
+
+    args = AVPipelineArgs(
+        input_path=str(av_dir),
+        output_path=str(tmp_path / "out"),
+        clip_len_s=2.0,
+        min_clip_len_s=0.5,
+        limit=2,
+        clip_packaging=True,
+    )
+    run_av_ingest(args)
+    run_av_split(args, runner=SequentialRunner())
+    # promote split clips so the packer sees them
+    db = AVStateDB(args.resolved_db)
+    try:
+        for c in db.clips(state="split"):
+            db.set_caption(c.clip_uuid, "a clip")
+    finally:
+        db.close()
+    summary = _shard_clip_packaging(args)
+    assert summary["num_clip_tars"] >= 1
+    tars = list((tmp_path / "out" / "datasets" / args.dataset_name / "clips").glob("*.tar"))
+    assert tars
+    with tarfile.open(tars[0]) as tf:
+        names = tf.getnames()
+        mp4s = [n for n in names if n.endswith(".mp4")]
+        jsons = [n for n in names if n.endswith(".json")]
+        assert mp4s and jsons
+        session = tars[0].stem
+        assert all(n.startswith(f"{session}.") for n in names), names
+        meta = json_mod.loads(tf.extractfile(jsons[0]).read())
+        assert meta and {"frame_num", "timestamp"} <= set(meta[0])
+        assert meta[0]["frame_num"] == 0
