@@ -123,8 +123,18 @@ def _token() -> bytes:
     return tok.encode()
 
 
+MAX_FRAME_BYTES = 1 << 31
+
+
 def send_msg(sock: socket.socket, msg: Any, token: bytes) -> None:
     payload = cloudpickle.dumps(msg)
+    if len(payload) > MAX_FRAME_BYTES:
+        # enforce the receiver's cap at the SENDER: an oversized frame must
+        # fail as one batch error, not sever the link when the peer rejects
+        raise ValueError(
+            f"frame of {len(payload)} bytes exceeds the plane's "
+            f"{MAX_FRAME_BYTES}-byte cap; shrink the stage batch size"
+        )
     mac = hmac.new(token, payload, hashlib.sha256).digest()
     header = _MAGIC + struct.pack(">Q", len(payload)) + mac
     sock.sendall(header + payload)
@@ -140,7 +150,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = 1 << 31) -> Any:
+def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
     header = _recv_exact(sock, 4 + 8 + 32)
     if header[:4] != _MAGIC:
         raise ConnectionError("bad frame magic")
@@ -260,22 +270,36 @@ class RemoteWorkerManager:
         from cosmos_curate_tpu.engine import object_store
         from cosmos_curate_tpu.engine.worker import ProcessMsg, ShutdownMsg
 
+        from cosmos_curate_tpu.engine.worker import ResultMsg
+
         while not self._closed:
             try:
                 agent, key, msg = self._send_q.get(timeout=0.2)
             except _queue.Empty:
                 continue
+            if isinstance(msg, ShutdownMsg):
+                agent.send(StopWorker(key))
+                with self._lock:
+                    agent.worker_costs.pop(key, None)
+                continue
+            if not isinstance(msg, ProcessMsg):
+                continue
             try:
-                if isinstance(msg, ShutdownMsg):
-                    agent.send(StopWorker(key))
-                    with self._lock:
-                        agent.worker_costs.pop(key, None)
-                elif isinstance(msg, ProcessMsg):
-                    tasks = [object_store.get(r) for r in msg.refs]
-                    agent.send(SubmitBatch(key, msg.batch_id, cloudpickle.dumps(tasks)))
+                tasks = [object_store.get(r) for r in msg.refs]
+                frame = SubmitBatch(key, msg.batch_id, cloudpickle.dumps(tasks))
             except Exception:
-                logger.exception("remote send failed for worker %s", key)
-                agent.alive = False
+                # a materialize/serialize failure is a BATCH failure (the
+                # local path would fail the same way), never a link failure
+                import traceback
+
+                logger.exception("remote dispatch prep failed for worker %s", key)
+                self.results_q.put(
+                    ResultMsg(
+                        msg.batch_id, error=traceback.format_exc(), worker_id=key
+                    )
+                )
+                continue
+            agent.send(frame)  # socket errors mark the link dead internally
 
     # -- connection handling -------------------------------------------
     def _accept_loop(self) -> None:

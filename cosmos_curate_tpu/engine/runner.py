@@ -225,6 +225,14 @@ class StreamingRunner(RunnerInterface):
                 # 4. autoscale
                 now = time.monotonic()
                 if now - last_autoscale >= cfg.streaming.autoscale_interval_s:
+                    if remote_mgr is not None:
+                        # agents join/leave mid-run: re-base the budget so a
+                        # dead agent's capacity stops being planned for (and
+                        # a late joiner's starts being used)
+                        budget = Budget(
+                            cpus=node.num_cpus + remote_mgr.remote_cpus(),
+                            tpus=budget.tpus,
+                        )
                     self._apply_allocation(states, budget, cfg)
                     last_autoscale = now
                 # 5. metrics + completion

@@ -60,6 +60,7 @@ def qwen2_lm_config(hf_config, **overrides):
         qkv_bias=True,
         mrope_section=tuple(mrope) if mrope else None,
         rms_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        tied_embeddings=getattr(hf_config, "tie_word_embeddings", True),
     )
     kw.update(overrides)
     return VLMConfig(**kw)
@@ -117,13 +118,15 @@ def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]
         if k.startswith(("visual.", "model.visual.")):
             report.vision_skipped.append(k)
         elif k == "lm_head.weight":
-            # tied-embedding checkpoints may still serialize the head; our
-            # logits use embed.attend, so a TIED head is already covered.
             head, emb = _t(sd[k]), params["embed"]["embedding"]
             if head.shape == emb.shape and np.array_equal(head, emb):
+                # tied checkpoints may still serialize the head; covered by
+                # embed.attend
                 report.mapped.append(k)
             else:
-                report.unmapped.append(k)
+                # untied head (Qwen2.5-VL): its own projection matrix
+                params["lm_head"] = {"kernel": head.T}
+                report.mapped.append(k)
         else:
             report.unmapped.append(k)
     logger.info(
@@ -136,20 +139,39 @@ def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]
 
 
 def qwen2_vision_config(hf_vision_config, **overrides):
-    """Our QwenVisionConfig from an HF Qwen2VLVisionConfig."""
+    """Our QwenVisionConfig from an HF Qwen2VLVisionConfig OR
+    Qwen2_5_VLVisionConfig (detected by ``out_hidden_size``, the 2.5
+    layout where ``hidden_size`` is the EMBED dim)."""
     from cosmos_curate_tpu.models.vlm.vision_qwen import QwenVisionConfig
 
-    kw = dict(
-        depth=hf_vision_config.depth,
-        embed_dim=hf_vision_config.embed_dim,
-        num_heads=hf_vision_config.num_heads,
-        hidden_size=hf_vision_config.hidden_size,
-        mlp_ratio=hf_vision_config.mlp_ratio,
-        patch_size=hf_vision_config.patch_size,
-        temporal_patch_size=hf_vision_config.temporal_patch_size,
-        spatial_merge_size=hf_vision_config.spatial_merge_size,
-        in_channels=hf_vision_config.in_channels,
-    )
+    c = hf_vision_config
+    if hasattr(c, "out_hidden_size"):  # Qwen2.5-VL
+        kw = dict(
+            depth=c.depth,
+            embed_dim=c.hidden_size,
+            num_heads=c.num_heads,
+            hidden_size=c.out_hidden_size,
+            intermediate_size=c.intermediate_size,
+            patch_size=c.patch_size,
+            temporal_patch_size=c.temporal_patch_size,
+            spatial_merge_size=c.spatial_merge_size,
+            in_channels=c.in_channels,
+            variant="qwen2_5",
+            window_size=c.window_size,
+            fullatt_block_indexes=tuple(c.fullatt_block_indexes),
+        )
+    else:
+        kw = dict(
+            depth=c.depth,
+            embed_dim=c.embed_dim,
+            num_heads=c.num_heads,
+            hidden_size=c.hidden_size,
+            mlp_ratio=c.mlp_ratio,
+            patch_size=c.patch_size,
+            temporal_patch_size=c.temporal_patch_size,
+            spatial_merge_size=c.spatial_merge_size,
+            in_channels=c.in_channels,
+        )
     kw.update(overrides)
     return QwenVisionConfig(**kw)
 
@@ -185,19 +207,31 @@ def convert_qwen2_vision(state_dict, depth: int) -> tuple[dict, ConversionReport
     def ln(stem: str) -> dict:
         return {"scale": take(f"{stem}.weight"), "bias": take(f"{stem}.bias")}
 
+    # Qwen2.5-VL: RMSNorm blocks (weight-only norms) + SwiGLU MLP
+    is_25 = f"{prefix}blocks.0.mlp.gate_proj.weight" in sd
+
+    def rms(stem: str) -> dict:
+        return {"scale": take(f"{stem}.weight")}
+
     conv = take(f"{prefix}patch_embed.proj.weight")  # [E, C, tps, ps, ps]
     params: dict = {"patch_embed": {"kernel": conv.reshape(conv.shape[0], -1).T}}
     for i in range(depth):
         e = f"{prefix}blocks.{i}."
-        params[f"block_{i}"] = {
-            "ln1": ln(f"{e}norm1"),
-            "ln2": ln(f"{e}norm2"),
+        block = {
+            "ln1": rms(f"{e}norm1") if is_25 else ln(f"{e}norm1"),
+            "ln2": rms(f"{e}norm2") if is_25 else ln(f"{e}norm2"),
             "qkv": lin(f"{e}attn.qkv"),
             "proj": lin(f"{e}attn.proj"),
-            "fc1": lin(f"{e}mlp.fc1"),
-            "fc2": lin(f"{e}mlp.fc2"),
         }
-    params["ln_q"] = ln(f"{prefix}merger.ln_q")
+        if is_25:
+            block["gate"] = lin(f"{e}mlp.gate_proj")
+            block["up"] = lin(f"{e}mlp.up_proj")
+            block["down"] = lin(f"{e}mlp.down_proj")
+        else:
+            block["fc1"] = lin(f"{e}mlp.fc1")
+            block["fc2"] = lin(f"{e}mlp.fc2")
+        params[f"block_{i}"] = block
+    params["ln_q"] = rms(f"{prefix}merger.ln_q") if is_25 else ln(f"{prefix}merger.ln_q")
     params["merger_fc1"] = lin(f"{prefix}merger.mlp.0")
     params["merger_fc2"] = lin(f"{prefix}merger.mlp.2")
 

@@ -25,6 +25,12 @@ from cosmos_curate_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 WEIGHTS_DIR_ENV = "CURATE_MODEL_WEIGHTS_DIR"
+
+
+class WeightsIntegrityError(RuntimeError):
+    """A pulled checkpoint failed its sha256 manifest — never silently
+    degraded to random init (corrupted staging must abort, not caption
+    a dataset with garbage at full cost)."""
 # Remote prefix weights are pulled from on demand (s3:// gs:// az:// or a
 # local/NFS path) — the reference's download/staging flow
 # (model_utils.py:139 pulls from HF/S3 to node-local disk; here the pull
@@ -162,7 +168,7 @@ def maybe_pull_remote_weights(model_id: str) -> Path | None:
             raise
         if want and digest.hexdigest() != want:
             tmp.unlink(missing_ok=True)
-            raise RuntimeError(
+            raise WeightsIntegrityError(
                 f"weights integrity check failed for {remote}: "
                 f"sha256 {digest.hexdigest()} != manifest {want}"
             )
@@ -196,6 +202,8 @@ def load_params(
     if ckpt is None:
         try:
             ckpt = maybe_pull_remote_weights(model_id)
+        except WeightsIntegrityError:
+            raise  # corruption must abort, not fall back to random init
         except Exception:
             logger.exception("remote weight staging failed for %s", model_id)
             ckpt = None

@@ -29,6 +29,7 @@ from cosmos_curate_tpu.models.layers import MODEL_AXIS, dense
 from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
 from cosmos_curate_tpu.models.vlm.vision_qwen import (
     QWEN2_VL_2B_VISION,
+    QWEN25_VL_7B_VISION,
     QWEN_VISION_TINY_TEST,
     QwenVisionConfig,
     QwenVisionTower,
@@ -59,6 +60,9 @@ class VLMConfig:
     # (HF `rope_scaling.mrope_section`); None = standard 1D rope
     mrope_section: tuple[int, int, int] | None = None
     rms_eps: float = 1e-6
+    # tied = logits via embed.attend (Qwen2-VL-2B); untied checkpoints
+    # (Qwen2.5-VL-7B) carry a separate lm_head matrix
+    tied_embeddings: bool = True
 
 
 VLM_BASE = VLMConfig()
@@ -85,6 +89,27 @@ VLM_QWEN2_2B = VLMConfig(
     vision_variant="qwen2",
     qwen_vision=QWEN2_VL_2B_VISION,
     mrope_section=(16, 24, 24),
+)
+# Qwen2.5-VL-7B-Instruct — the family the reference actually serves for
+# captions (vllm_qwen.py; CosmosReason shares this architecture): GQA
+# 28/4 heads, SwiGLU 18944, untied head, m-rope 16/24/24, windowed vision.
+VLM_QWEN25_7B = VLMConfig(
+    vocab=152064,
+    dim=3584,
+    n_layers=28,
+    n_heads=28,
+    n_kv_heads=4,
+    head_dim=128,
+    hidden_mult=18944 / 3584,
+    max_seq=4096,
+    rope_theta=1_000_000.0,
+    qkv_bias=True,
+    vision=VIT_B_16,
+    vision_tokens=64,
+    vision_variant="qwen2",
+    qwen_vision=QWEN25_VL_7B_VISION,
+    mrope_section=(16, 24, 24),
+    tied_embeddings=False,
 )
 VLM_TINY_TEST = VLMConfig(
     vocab=512,
@@ -292,6 +317,11 @@ class VLM(nn.Module):
         )
         self.layers = [DecoderLayer(cfg, dtype=self.dtype, name=f"layer_{i}") for i in range(cfg.n_layers)]
         self.ln_f = RMSNorm(eps=cfg.rms_eps, name="ln_f")
+        self.lm_head = (
+            None
+            if cfg.tied_embeddings
+            else dense(cfg.vocab, "out", name="lm_head", use_bias=False, dtype=jnp.float32)
+        )
         if cfg.vision_variant == "qwen2":
             self.vision_tower = QwenVisionTower(cfg.qwen_vision, dtype=self.dtype, name="vision")
             self.projector = None  # the Qwen merger already maps to LM dim
@@ -364,7 +394,10 @@ class VLM(nn.Module):
             new_ks.append(nk)
             new_vs.append(nv)
         x = self.ln_f(x)
-        logits = self.embed.attend(x.astype(jnp.float32))
+        if self.lm_head is not None:  # untied checkpoints (Qwen2.5-VL-7B)
+            logits = self.lm_head(x.astype(jnp.float32))
+        else:
+            logits = self.embed.attend(x.astype(jnp.float32))
         return logits, jnp.stack(new_ks), jnp.stack(new_vs)
 
 

@@ -45,6 +45,20 @@ class QwenVisionConfig:
     spatial_merge_size: int = 2
     in_channels: int = 3
     image_size: int = 224  # our fixed inference resolution
+    # "qwen2" = LayerNorm blocks + quick_gelu MLP, full per-frame attention;
+    # "qwen2_5" = RMSNorm blocks + SwiGLU MLP, windowed attention with
+    # full-attention blocks at fullatt_block_indexes (also CosmosReason's
+    # vision architecture)
+    variant: str = "qwen2"
+    intermediate_size: int | None = None  # qwen2_5 sets this explicitly
+    window_size: int = 112  # pixels; qwen2_5 only
+    fullatt_block_indexes: tuple[int, ...] = ()
+
+    @property
+    def mlp_hidden(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        return int(self.embed_dim * self.mlp_ratio)
 
     @property
     def head_dim(self) -> int:
@@ -74,6 +88,19 @@ class QwenVisionConfig:
 # Qwen2-VL-2B-Instruct's visual config (depth 32 / 1280 / 16 heads,
 # merger → 1536). hidden_size must match the LM dim.
 QWEN2_VL_2B_VISION = QwenVisionConfig()
+# Qwen2.5-VL-7B-Instruct's visual config (windowed attention; also the
+# CosmosReason family's tower): depth 32 / 1280 / 16 heads, SwiGLU 3420,
+# window 112px, full attention at blocks 7/15/23/31, merger → 3584.
+QWEN25_VL_7B_VISION = QwenVisionConfig(
+    depth=32,
+    embed_dim=1280,
+    num_heads=16,
+    hidden_size=3584,
+    intermediate_size=3420,
+    variant="qwen2_5",
+    window_size=112,
+    fullatt_block_indexes=(7, 15, 23, 31),
+)
 QWEN_VISION_TINY_TEST = QwenVisionConfig(
     depth=2,
     embed_dim=64,
@@ -118,21 +145,72 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
+def window_partition(cfg: QwenVisionConfig, grid: tuple[int, int, int]):
+    """Host-side window permutation for the qwen2_5 variant.
+
+    HF ``get_window_index`` semantics for one static grid: merge units
+    (spatial_merge_size² consecutive tokens) are regrouped into
+    window-major order; returns (token_perm [S], window segment id per
+    permuted token [S]) — static arrays the jitted program closes over.
+    Frame (t) boundaries are preserved by the permutation, so the per-frame
+    full-attention mask formula is unchanged.
+    """
+    t, h, w = grid
+    msz = cfg.spatial_merge_size
+    unit = msz * msz
+    lh, lw = h // msz, w // msz
+    vws = max(1, cfg.window_size // msz // cfg.patch_size)
+    index = np.arange(t * lh * lw).reshape(t, lh, lw)
+    pad_h = (-lh) % vws
+    pad_w = (-lw) % vws
+    nh, nw = (lh + pad_h) // vws, (lw + pad_w) // vws
+    padded = np.full((t, lh + pad_h, lw + pad_w), -100, dtype=np.int64)
+    padded[:, :lh, :lw] = index
+    padded = (
+        padded.reshape(t, nh, vws, nw, vws)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(t, nh * nw, vws, vws)
+    )
+    seqlens = (padded != -100).sum(axis=(2, 3)).reshape(-1)  # merge units/window
+    flat = padded.reshape(-1)
+    unit_perm = flat[flat != -100]  # [S/unit] merge-unit permutation
+    token_perm = (unit_perm[:, None] * unit + np.arange(unit)).reshape(-1)
+    # window segment id per permuted TOKEN (empty windows contribute none)
+    seg = np.repeat(np.arange(len(seqlens)), seqlens * unit)
+    return token_perm.astype(np.int64), seg.astype(np.int64), unit_perm.astype(np.int64)
+
+
+class _VisionRMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (scale * normed).astype(x.dtype)
+
+
 class QwenVisionBlock(nn.Module):
     cfg: QwenVisionConfig
     dtype: jnp.dtype = jnp.bfloat16
 
+    def _norm(self, name: str):
+        if self.cfg.variant == "qwen2_5":
+            return _VisionRMSNorm(name=name)
+        return nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name=name)
+
     @nn.compact
     def __call__(self, x, cos, sin, block_mask):
         """x: [B, S, E]; cos/sin: [S, head_dim] rope tables; block_mask:
-        [S, S] bool — HF splits attention at cu_seqlens boundaries (each
-        temporal frame's h·w patches attend only among themselves), which
+        [S, S] bool — HF splits attention at cu_seqlens boundaries (per
+        temporal frame, or per window for qwen2_5's windowed blocks), which
         for our static grid is a block-diagonal mask."""
         cfg = self.cfg
         b, s, _ = x.shape
         h, dh = cfg.num_heads, cfg.head_dim
 
-        y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln1")(x)
+        y = self._norm("ln1")(x)
         # fused qkv (one MXU matmul), as in the checkpoint layout
         qkv = dense(3 * cfg.embed_dim, "out", name="qkv", use_bias=True, dtype=self.dtype)(y)
         q, k, v = jnp.split(qkv.reshape(b, s, 3, h, dh), 3, axis=2)
@@ -152,8 +230,13 @@ class QwenVisionBlock(nn.Module):
         attn = attn.reshape(b, s, h * dh)
         x = x + dense(cfg.embed_dim, "in", name="proj", use_bias=True, dtype=self.dtype)(attn)
 
-        y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln2")(x)
-        hdim = int(cfg.embed_dim * cfg.mlp_ratio)
+        y = self._norm("ln2")(x)
+        hdim = cfg.mlp_hidden
+        if cfg.variant == "qwen2_5":  # SwiGLU (with biases, HF Qwen2_5_VLMLP)
+            gate = dense(hdim, "out", name="gate", use_bias=True, dtype=self.dtype)(y)
+            up = dense(hdim, "out", name="up", use_bias=True, dtype=self.dtype)(y)
+            y = nn.silu(gate) * up
+            return x + dense(cfg.embed_dim, "in", name="down", use_bias=True, dtype=self.dtype)(y)
         y = dense(hdim, "out", name="fc1", use_bias=True, dtype=self.dtype)(y)
         y = quick_gelu(y)
         return x + dense(cfg.embed_dim, "in", name="fc2", use_bias=True, dtype=self.dtype)(y)
@@ -174,19 +257,41 @@ class QwenVisionTower(nn.Module):
             patches.astype(self.dtype)
         )
         angles = rotary_tables(cfg, grid)
-        cos, sin = jnp.cos(jnp.asarray(angles)), jnp.sin(jnp.asarray(angles))
-        # attention never crosses temporal frames (HF cu_seqlens semantics)
+        # per-frame full attention (HF cu_seqlens semantics)
         frame = np.arange(s) // (grid[1] * grid[2])
-        block_mask = jnp.asarray(frame[:, None] == frame[None, :])
+        full_mask = jnp.asarray(frame[:, None] == frame[None, :])
+        windowed_mask = None
+        inverse_unit_perm = None
+        if cfg.variant == "qwen2_5":
+            # static window permutation: tokens regroup window-major; all
+            # blocks except fullatt_block_indexes attend within windows
+            token_perm, seg, unit_perm = window_partition(cfg, grid)
+            x = x[:, token_perm]
+            angles = angles[token_perm]
+            windowed_mask = jnp.asarray(seg[:, None] == seg[None, :])
+            inverse_unit_perm = np.argsort(unit_perm)
+        cos, sin = jnp.cos(jnp.asarray(angles)), jnp.sin(jnp.asarray(angles))
         for i in range(cfg.depth):
-            x = QwenVisionBlock(cfg, dtype=self.dtype, name=f"block_{i}")(x, cos, sin, block_mask)
+            if cfg.variant == "qwen2_5" and i not in cfg.fullatt_block_indexes:
+                mask = windowed_mask
+            else:
+                mask = full_mask
+            x = QwenVisionBlock(cfg, dtype=self.dtype, name=f"block_{i}")(x, cos, sin, mask)
         # merger: group each merge-window's msz² consecutive tokens
         msz2 = cfg.spatial_merge_size**2
-        x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln_q")(x)
+        if cfg.variant == "qwen2_5":
+            x = _VisionRMSNorm(name="ln_q")(x)
+        else:
+            x = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name="ln_q")(x)
         x = x.reshape(b, s // msz2, msz2 * cfg.embed_dim)
         x = dense(msz2 * cfg.embed_dim, "out", name="merger_fc1", use_bias=True, dtype=self.dtype)(x)
         x = nn.gelu(x, approximate=False)
-        return dense(cfg.hidden_size, "in", name="merger_fc2", use_bias=True, dtype=self.dtype)(x)
+        x = dense(cfg.hidden_size, "in", name="merger_fc2", use_bias=True, dtype=self.dtype)(x)
+        if inverse_unit_perm is not None:
+            # undo the window permutation so outputs are t-major row-major
+            # (what build_mrope_positions and the engine assume)
+            x = x[:, inverse_unit_perm]
+        return x
 
 
 def frames_to_patches(frames_u8, cfg: QwenVisionConfig):

@@ -134,6 +134,7 @@ def package_clip_sessions(
     dataset: str,
     *,
     subdir: str = "clips",
+    log_summary: bool = True,
 ) -> list[str]:
     """Mp4 clip-session tars (reference ClipPackagingStage,
     dataset_writer_stage.py:140-236): one tar per clip-session holding, per
@@ -159,7 +160,8 @@ def package_clip_sessions(
         path = f"{base}/{sample.session_uuid}.tar"
         write_bytes(path, _tar_bytes(items))
         written.append(path)
-    logger.info("packaged %d clip-session tars under %s", len(written), base)
+    if log_summary:
+        logger.info("packaged %d clip-session tars under %s", len(written), base)
     return written
 
 

@@ -375,8 +375,11 @@ def _shard_clip_packaging(args: AVPipelineArgs) -> dict:
                     video_bytes=data, timestamps_ms=ts_ms
                 )
             if sample.cameras:
-                package_clip_sessions([sample], root, args.dataset_name)
+                package_clip_sessions(
+                    [sample], root, args.dataset_name, log_summary=False
+                )
                 num_tars += 1
+        logger.info("packaged %d clip-session tars for %s", num_tars, args.dataset_name)
         return {"num_clip_tars": num_tars}
     finally:
         db.close()

@@ -75,3 +75,10 @@ class TestRemoteStaging:
             t.join()
         assert all(p is not None and p.exists() for p in results)
         assert len({str(p) for p in results}) == 1
+
+    def test_integrity_failure_propagates_through_load(self, weights_env):
+        """A corrupted pull must abort load_params, never degrade to
+        random init (the integrity check's only live call site)."""
+        _publish(weights_env, "transnetv2-tpu", b"payload", bad_sha=True)
+        with pytest.raises(RuntimeError, match="integrity"):
+            load_params("transnetv2-tpu", lambda seed: {"w": np.zeros(2)})

@@ -226,3 +226,195 @@ class TestFullMultimodalParity:
             jnp.full((1,), total, jnp.int32),
         )
         np.testing.assert_allclose(np.asarray(logits[0]), want, atol=5e-4, rtol=1e-3)
+
+
+class TestQwen25VisionParity:
+    """Qwen2.5-VL vision tower (windowed attention, RMSNorm, SwiGLU —
+    also CosmosReason's vision architecture, reference vllm_qwen.py)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import torch
+
+        from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+            Qwen2_5_VLVisionConfig,
+        )
+        from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+            Qwen2_5_VisionTransformerPretrainedModel,
+        )
+
+        from cosmos_curate_tpu.models.convert_qwen import (
+            convert_qwen2_vision,
+            qwen2_vision_config,
+        )
+
+        hf_cfg = Qwen2_5_VLVisionConfig(
+            depth=4,
+            hidden_size=32,
+            num_heads=4,
+            intermediate_size=64,
+            out_hidden_size=48,
+            patch_size=4,
+            temporal_patch_size=2,
+            spatial_merge_size=2,
+            # window = 16px -> 2x2 merged tokens per window; full attention
+            # only at block 2, so windows are genuinely exercised
+            window_size=16,
+            fullatt_block_indexes=[2],
+        )
+        torch.manual_seed(23)
+        hf = Qwen2_5_VisionTransformerPretrainedModel(hf_cfg).eval()
+        ours_cfg = qwen2_vision_config(hf_cfg, image_size=32)
+        assert ours_cfg.variant == "qwen2_5"
+        sd = {f"visual.{k}": v for k, v in hf.state_dict().items()}
+        vision_params, report = convert_qwen2_vision(sd, hf_cfg.depth)
+        tower = QwenVisionTower(ours_cfg, dtype=jnp.float32)
+        return hf, tower, ours_cfg, vision_params, report
+
+    def test_every_tensor_mapped(self, pair):
+        hf, _, _, _, report = pair
+        assert not report.unmapped, report.unmapped
+        assert set(report.mapped) == {f"visual.{k}" for k in hf.state_dict()}
+
+    @pytest.mark.parametrize("grid", [(1, 8, 8), (2, 8, 8), (1, 6, 6)])
+    def test_output_matches_hf(self, pair, grid):
+        """Grids larger than (and not divisible by) the window size —
+        the permutation, padding, and per-block mask switching all bite."""
+        import torch
+
+        hf, tower, cfg, vision_params, _ = pair
+        t, h, w = grid
+        s = t * h * w
+        patches = np.random.default_rng(29).normal(size=(s, cfg.patch_dim)).astype(np.float32)
+        with torch.no_grad():
+            want = hf(
+                torch.from_numpy(patches), grid_thw=torch.tensor([[t, h, w]])
+            ).numpy()
+        got = tower.apply(vision_params, jnp.asarray(patches)[None], grid)[0]
+        assert got.shape == want.shape == (s // 4, cfg.hidden_size)
+        np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=1e-3)
+
+
+class TestQwen25FullParity:
+    """Full Qwen2.5-VL checkpoint conversion: untied lm_head + windowed
+    vision tower + m-rope, numerically against HF end to end."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import torch
+
+        from cosmos_curate_tpu.models.convert_qwen import (
+            convert_qwen2_vl,
+            qwen2_lm_config,
+            qwen2_vision_config,
+        )
+        from cosmos_curate_tpu.models.vlm.model import VLM
+
+        cfg = transformers.Qwen2_5_VLConfig(
+            vocab_size=128,
+            hidden_size=48,
+            intermediate_size=96,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=128,
+            rope_theta=10000.0,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 2, 2]},
+            tie_word_embeddings=False,
+            attention_dropout=0.0,
+            vision_config=dict(
+                depth=3,
+                hidden_size=32,
+                num_heads=4,
+                intermediate_size=64,
+                out_hidden_size=48,
+                patch_size=4,
+                temporal_patch_size=2,
+                spatial_merge_size=2,
+                window_size=16,
+                fullatt_block_indexes=[1],
+            ),
+            image_token_id=125,
+            video_token_id=126,
+            vision_start_token_id=123,
+            vision_end_token_id=124,
+        )
+        torch.manual_seed(31)
+        hf = transformers.Qwen2_5_VLForConditionalGeneration(cfg).eval()
+        v_cfg = qwen2_vision_config(hf.config.vision_config, image_size=32)
+        ours_cfg = qwen2_lm_config(
+            hf.config, max_seq=128, vision_variant="qwen2", qwen_vision=v_cfg
+        )
+        assert not ours_cfg.tied_embeddings
+        lm_params, vision_params, report = convert_qwen2_vl(
+            hf.state_dict(), cfg.num_hidden_layers, cfg.vision_config.depth
+        )
+        model = VLM(ours_cfg, dtype=jnp.float32)
+        return hf, model, ours_cfg, lm_params, vision_params, report
+
+    def test_converts_completely(self, pair):
+        hf, _, _, _, _, report = pair
+        assert report.vision_skipped == []
+        assert not report.unmapped, report.unmapped
+        assert set(report.mapped) >= set(hf.state_dict())
+
+    def test_multimodal_logits_match(self, pair):
+        import torch
+
+        from cosmos_curate_tpu.models.convert_qwen import (
+            merge_lm_params,
+            merge_vision_params,
+        )
+        from cosmos_curate_tpu.models.vlm.model import build_mrope_positions, init_cache
+
+        hf, model, cfg, lm_params, vision_params, _ = pair
+        grid = (1, 8, 8)  # bigger than the 2x2-merged-token window
+        t, h, w = grid
+        s = t * h * w
+        n_merged = s // 4
+        rng = np.random.default_rng(37)
+        patches = rng.normal(size=(s, cfg.qwen_vision.patch_dim)).astype(np.float32)
+        text = rng.integers(0, 120, 5).astype(np.int64)
+        input_ids = np.concatenate(
+            [[123], np.full(n_merged, 125), [124], text]
+        ).astype(np.int64)
+        with torch.no_grad():
+            want = hf(
+                input_ids=torch.from_numpy(input_ids)[None],
+                pixel_values=torch.from_numpy(patches),
+                image_grid_thw=torch.tensor([[t, h, w]]),
+            ).logits[0].numpy()
+
+        ck, cv = init_cache(cfg, 1, dtype=jnp.float32)
+        size = cfg.qwen_vision.image_size
+        init_tree = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 2, size, size, 3), jnp.uint8),
+            jnp.zeros((1, 4), jnp.int32),
+            ck,
+            cv,
+            method=model.init_everything,
+        )
+        params = merge_vision_params(merge_lm_params(init_tree, lm_params), vision_params)
+        vis = model.apply(
+            params,
+            jnp.asarray(patches)[None],
+            grid,
+            method=lambda m, p, g: m.vision_tower(p, g),
+        )
+        pre = model.apply(params, jnp.asarray([[123]], jnp.int32), method=model.embed_tokens)
+        post_ids = np.concatenate([[124], text]).astype(np.int32)
+        post = model.apply(params, jnp.asarray(post_ids)[None], method=model.embed_tokens)
+        embeds = jnp.concatenate([pre, vis, post], axis=1)
+        rope_pos, _ = build_mrope_positions(1, (t, h // 2, w // 2), len(post_ids))
+        total = embeds.shape[1]
+        logits, _, _ = model.apply(
+            params,
+            embeds,
+            ck,
+            cv,
+            jnp.asarray(rope_pos)[None],
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), total, jnp.int32),
+        )
+        np.testing.assert_allclose(np.asarray(logits[0]), want, atol=7e-4, rtol=1e-3)
