@@ -191,27 +191,164 @@ class BPETokenizer:
         ``vocab.json`` + ``merges.txt``). Token ids are remapped into our
         layout: the base alphabet collapses to raw bytes 0-255; each merge
         becomes one new id in file order, so text round-trips exactly (ids
-        differ from HF's — use this tokenizer end-to-end, not mixed)."""
-        decoder = {v: k for k, v in _gpt2_byte_encoder().items()}
-
-        def to_bytes(token: str) -> bytes:
-            return bytes(decoder[ch] for ch in token)
-
+        differ from HF's — for converted-checkpoint inference use
+        HFVocabTokenizer, which preserves the HF ids the embedding rows
+        are indexed by)."""
+        vocab, pairs = _parse_gpt2_files(vocab_json, merges_txt)
         bytes_to_id: dict[bytes, int] = {bytes([i]): i for i in range(256)}
         merges: list[tuple[int, int]] = []
         next_id = cls._FIRST_MERGE
-        for line in Path(merges_txt).read_text().splitlines():
-            if not line or line.startswith("#version"):
-                continue
-            left, _, right = line.partition(" ")
-            lb, rb = to_bytes(left), to_bytes(right)
+        for lb, rb in pairs:
             if lb not in bytes_to_id or rb not in bytes_to_id:
                 continue  # merge over a token we never formed (defensive)
             merges.append((bytes_to_id[lb], bytes_to_id[rb]))
             bytes_to_id[lb + rb] = next_id
             next_id += 1
-        n_vocab = len(json.loads(Path(vocab_json).read_text()))
-        return cls(merges, vocab_size=max(n_vocab + 4, next_id))
+        return cls(merges, vocab_size=max(len(vocab) + 4, next_id))
+
+
+
+def _parse_gpt2_files(vocab_json: str | Path, merges_txt: str | Path):
+    """Shared GPT-2-format loader: (vocab as bytes->HF id, merge byte
+    pairs in file order). Both tokenizer loaders build on this so the file
+    parsing cannot drift between them."""
+    decoder = {v: k for k, v in _gpt2_byte_encoder().items()}
+
+    def to_bytes(token: str) -> bytes:
+        return bytes(decoder[ch] for ch in token)
+
+    raw = json.loads(Path(vocab_json).read_text())
+    vocab = {to_bytes(tok): tid for tok, tid in raw.items()}
+    pairs: list[tuple[bytes, bytes]] = []
+    for line in Path(merges_txt).read_text().splitlines():
+        if not line or line.startswith("#version"):
+            continue
+        left, _, right = line.partition(" ")
+        pairs.append((to_bytes(left), to_bytes(right)))
+    return vocab, pairs
+
+
+class HFVocabTokenizer:
+    """GPT-2-format BPE tokenizer that preserves the checkpoint's EXACT
+    token ids — required when the LM weights are converted from HF (the
+    embedding table is indexed by HF ids; `from_gpt2_files`' remapped ids
+    would address the wrong rows).
+
+    Byte-level BPE with HF's merge ranks and pre-tokenizer regex (Qwen2's
+    cl100k-style split), plus the checkpoint's special tokens. Satisfies
+    the CaptionEngine tokenizer protocol (encode/decode/decode_bytes/
+    eos_id/pad_id/vocab_size).
+    """
+
+    # Qwen2/Qwen2.5 pre-tokenizer split (tokenizer.json pretokenizer)
+    _PRETOK = (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}|"
+        r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+    )
+
+    def __init__(
+        self,
+        vocab: dict[bytes, int],
+        merge_ranks: dict[tuple[bytes, bytes], int],
+        *,
+        specials: dict[str, int] | None = None,
+        eos_token: str = "<|im_end|>",
+        pad_token: str = "<|endoftext|>",
+    ) -> None:
+        import regex
+
+        self._vocab = vocab
+        self._ranks = merge_ranks
+        self._id_to_bytes = {i: b for b, i in vocab.items()}
+        self.specials = dict(specials or {})
+        for name, sid in self.specials.items():
+            self._id_to_bytes.setdefault(sid, b"")  # specials decode to ''
+        self._eos = self.specials.get(eos_token)
+        self._pad = self.specials.get(pad_token)
+        if self._eos is None or self._pad is None:
+            raise ValueError(
+                f"specials must define {eos_token!r} and {pad_token!r}"
+            )
+        self._splitter = regex.compile(self._PRETOK)
+        self.vocab_size = max(
+            max(vocab.values()), *self.specials.values(), 0
+        ) + 1
+
+    @classmethod
+    def from_gpt2_files(
+        cls,
+        vocab_json: str | Path,
+        merges_txt: str | Path,
+        *,
+        specials: dict[str, int] | None = None,
+        **kw,
+    ) -> "HFVocabTokenizer":
+        vocab, pairs = _parse_gpt2_files(vocab_json, merges_txt)
+        ranks = {pair: rank for rank, pair in enumerate(pairs)}
+        if specials is None:
+            specials = QWEN2_SPECIAL_TOKENS
+        return cls(vocab, ranks, specials=specials, **kw)
+
+    def _bpe(self, chunk: bytes) -> list[int]:
+        parts = [bytes([b]) for b in chunk]
+        while len(parts) > 1:
+            best, best_rank = -1, None
+            for i in range(len(parts) - 1):
+                r = self._ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best_rank is None:
+                break
+            parts[best : best + 2] = [parts[best] + parts[best + 1]]
+        out = []
+        for p in parts:
+            tid = self._vocab.get(p)
+            if tid is None:
+                # unmergeable byte outside the vocab (shouldn't happen for
+                # byte-level vocabs, defensive)
+                out.extend(self._vocab.get(bytes([b]), 0) for b in p)
+            else:
+                out.append(tid)
+        return out
+
+    def encode(self, text: str, *, add_bos: bool = False) -> list[int]:  # noqa: ARG002
+        import unicodedata
+
+        # HF's Qwen2 tokenizer NFC-normalizes before pre-tokenization
+        # (prepare_for_tokenization) — required for the exact-id guarantee
+        # on decomposed input (e.g. macOS-originated 'café')
+        text = unicodedata.normalize("NFC", text)
+        ids: list[int] = []
+        for piece in self._splitter.findall(text):
+            ids.extend(self._bpe(piece.encode("utf-8")))
+        return ids
+
+    def decode_bytes(self, ids: list[int]) -> bytes:
+        return b"".join(self._id_to_bytes.get(i, b"") for i in ids)
+
+    def decode(self, ids: list[int]) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
+
+    @property
+    def eos_id(self) -> int:
+        return self._eos
+
+    @property
+    def pad_id(self) -> int:
+        return self._pad
+
+
+# Qwen2/Qwen2.5(-VL) special-token ids (tokenizer_config.json)
+QWEN2_SPECIAL_TOKENS = {
+    "<|endoftext|>": 151643,
+    "<|im_start|>": 151644,
+    "<|im_end|>": 151645,
+    "<|vision_start|>": 151652,
+    "<|vision_end|>": 151653,
+    "<|vision_pad|>": 151654,
+    "<|image_pad|>": 151655,
+    "<|video_pad|>": 151656,
+}
 
 
 def default_caption_tokenizer():

@@ -1,0 +1,66 @@
+"""Qwen chat-template prompt construction for real checkpoints.
+
+Equivalent capability of the reference's vLLM chat handling for its Qwen
+captioners (cosmos_curate/models/vllm_qwen.py builds
+``<|im_start|>...<|im_end|>`` turns with ``<|vision_start|>`` image
+placeholders via the HF processor): produces the engine's
+``(prefix_ids, prompt_ids)`` pair so vision embeddings splice exactly
+where the template puts the image — matching what the checkpoint saw in
+training. Use with :class:`~cosmos_curate_tpu.models.tokenizer.
+HFVocabTokenizer` (exact HF ids) and a converted Qwen2/2.5-VL checkpoint.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.models.tokenizer import QWEN2_SPECIAL_TOKENS
+
+DEFAULT_SYSTEM = "You are a helpful assistant."
+
+
+def build_qwen_vl_chat(
+    tokenizer,
+    user_text: str,
+    *,
+    system: str = DEFAULT_SYSTEM,
+    has_vision: bool = True,
+    specials: dict[str, int] | None = None,
+) -> tuple[list[int], list[int]]:
+    """Token ids for one captioning turn in Qwen2(-VL)'s chat template.
+
+    Returns ``(prefix_ids, prompt_ids)`` for ``CaptionRequest``: the vision
+    embeddings splice between them, standing in for the template's
+    ``<|image_pad|>`` run (the engine inserts real embeddings instead of
+    placeholder tokens, so no pad-token count is needed)::
+
+        <|im_start|>system\\n{system}<|im_end|>\\n
+        <|im_start|>user\\n<|vision_start|>[VISION]<|vision_end|>{text}<|im_end|>\\n
+        <|im_start|>assistant\\n
+
+    Generation naturally stops at ``<|im_end|>`` — make it the engine
+    tokenizer's ``eos_id`` (HFVocabTokenizer's default).
+    """
+    sp = specials or QWEN2_SPECIAL_TOKENS
+    im_start, im_end = sp["<|im_start|>"], sp["<|im_end|>"]
+    nl = tokenizer.encode("\n")
+    prefix = (
+        [im_start]
+        + tokenizer.encode("system\n" + system)
+        + [im_end]
+        + nl
+        + [im_start]
+        + tokenizer.encode("user\n")
+    )
+    if has_vision:
+        prefix = prefix + [sp["<|vision_start|>"]]
+        suffix = [sp["<|vision_end|>"]]
+    else:
+        suffix = []
+    suffix = (
+        suffix
+        + tokenizer.encode(user_text)
+        + [im_end]
+        + nl
+        + [im_start]
+        + tokenizer.encode("assistant\n")
+    )
+    return prefix, suffix

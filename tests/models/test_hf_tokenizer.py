@@ -1,0 +1,132 @@
+"""HFVocabTokenizer: exact-HF-id BPE (the converted checkpoint's embedding
+rows are indexed by these ids) + Qwen chat template construction."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from cosmos_curate_tpu.models.tokenizer import HFVocabTokenizer, _gpt2_byte_encoder
+
+
+@pytest.fixture(scope="module")
+def gpt2_files(tmp_path_factory):
+    """A small but real byte-level BPE file set (every byte + common
+    merges), loadable by BOTH transformers' Qwen2Tokenizer and ours."""
+    enc = _gpt2_byte_encoder()
+
+    def s(b: bytes) -> str:
+        return "".join(enc[x] for x in b)
+
+    merge_pairs = [
+        (b"t", b"h"), (b"th", b"e"), (b"i", b"n"), (b"a", b"n"),
+        (b"o", b"n"), (b"e", b"r"), (b"in", b"g"), (b"\xc4\xa0"[:1], b"t"),
+        (b" ", b"the"), (b" ", b"a"), (b"c", b"a"), (b"ca", b"r"),
+        (b" ", b"car"), (b"r", b"o"), (b"ro", b"a"), (b"roa", b"d"),
+        (b" ", b"road"), (b"d", b"o"), (b"w", b"n"),
+    ]
+    # drop the raw-space pair variants that GPT-2 byte encoding renders oddly
+    merges = []
+    vocab = {s(bytes([i])): i for i in range(256)}
+    next_id = 256
+    formed = {bytes([i]) for i in range(256)}
+    for a, b in merge_pairs:
+        if a not in formed or b not in formed:
+            continue
+        merges.append(f"{s(a)} {s(b)}")
+        vocab[s(a + b)] = next_id
+        formed.add(a + b)
+        next_id += 1
+    d = tmp_path_factory.mktemp("tok")
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "merges.txt").write_text("#version: 0.2\n" + "\n".join(merges))
+    return d, next_id
+
+
+class TestExactIds:
+    def test_matches_transformers_qwen2_tokenizer(self, gpt2_files):
+        d, n_vocab = gpt2_files
+        from transformers.models.qwen2.tokenization_qwen2 import Qwen2Tokenizer
+
+        hf = Qwen2Tokenizer(str(d / "vocab.json"), str(d / "merges.txt"))
+        # HF appends added specials after the base vocab
+        specials = {
+            "<|endoftext|>": hf.convert_tokens_to_ids("<|endoftext|>"),
+            "<|im_end|>": hf.convert_tokens_to_ids("<|endoftext|>"),
+        }
+        ours = HFVocabTokenizer.from_gpt2_files(
+            d / "vocab.json", d / "merges.txt", specials=specials
+        )
+        for text in (
+            "the car on the road",
+            "down the road again, 1234 times!",
+            "  leading spaces\nand\nnewlines",
+            "mixed:  punct-u-ation's test",
+        ):
+            got = ours.encode(text)
+            want = hf(text, add_special_tokens=False)["input_ids"]
+            assert got == want, (text, got, want)
+            assert ours.decode(got) == text
+
+    def test_specials_decode_empty_and_gate_eos(self, gpt2_files):
+        d, _ = gpt2_files
+        specials = {"<|endoftext|>": 9000, "<|im_end|>": 9001}
+        tok = HFVocabTokenizer.from_gpt2_files(
+            d / "vocab.json", d / "merges.txt", specials=specials
+        )
+        assert tok.eos_id == 9001 and tok.pad_id == 9000
+        ids = tok.encode("the road") + [tok.eos_id]
+        assert tok.decode(ids) == "the road"
+        assert tok.vocab_size > 9001
+
+
+class TestQwenChat:
+    def test_template_structure(self, gpt2_files):
+        d, _ = gpt2_files
+        from cosmos_curate_tpu.models.vlm.chat import build_qwen_vl_chat
+
+        specials = {
+            "<|endoftext|>": 9000,
+            "<|im_start|>": 9001,
+            "<|im_end|>": 9002,
+            "<|vision_start|>": 9003,
+            "<|vision_end|>": 9004,
+        }
+        tok = HFVocabTokenizer.from_gpt2_files(
+            d / "vocab.json", d / "merges.txt", specials=specials,
+        )
+        prefix, prompt = build_qwen_vl_chat(
+            tok, "describe the road", system="be terse", specials=specials
+        )
+        # vision splice point: prefix ends with vision_start, prompt begins
+        # with vision_end
+        assert prefix[0] == 9001  # <|im_start|> (system turn)
+        assert prefix[-1] == 9003
+        assert prompt[0] == 9004
+        assert prompt.count(9001) == 1  # assistant turn opener
+        # round-trip of the text parts (specials decode to '')
+        assert "be terse" in tok.decode(prefix)
+        assert "describe the road" in tok.decode(prompt)
+
+    def test_text_only_variant(self, gpt2_files):
+        d, _ = gpt2_files
+        from cosmos_curate_tpu.models.vlm.chat import build_qwen_vl_chat
+
+        specials = {
+            "<|endoftext|>": 9000,
+            "<|im_start|>": 9001,
+            "<|im_end|>": 9002,
+            "<|vision_start|>": 9003,
+            "<|vision_end|>": 9004,
+        }
+        tok = HFVocabTokenizer.from_gpt2_files(
+            d / "vocab.json", d / "merges.txt", specials=specials
+        )
+        prefix, prompt = build_qwen_vl_chat(
+            tok, "enhance this caption", has_vision=False, specials=specials
+        )
+        assert 9003 not in prefix and 9004 not in prompt
