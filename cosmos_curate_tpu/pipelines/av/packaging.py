@@ -89,14 +89,17 @@ def write_cosmos_predict2_clip(
     *,
     video_bytes: bytes,
     caption: str,
-    t5_embedding: np.ndarray,
+    t5_embeddings: list[np.ndarray],
 ) -> dict[str, str]:
-    """Write one clip's predict2 triplet; returns the three paths."""
+    """Write one clip's predict2 triplet; returns the three paths.
+
+    ``t5_embeddings`` is the per-CAPTION-WINDOW embedding list (reference
+    CaptionWindow semantics: one T5 embedding per window; single-window
+    clips pickle a one-element list, matching the reference's layout)."""
     paths = predict2_paths(root, dataset, camera, clip_uuid)
     write_bytes(paths["video"], video_bytes)
     write_bytes(paths["meta"], caption.encode())
-    # the reference pickles a LIST holding the (windowed) embedding
-    write_bytes(paths["t5"], pickle.dumps([np.asarray(t5_embedding)]))
+    write_bytes(paths["t5"], pickle.dumps([np.asarray(e) for e in t5_embeddings]))
     return paths
 
 

@@ -282,9 +282,11 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
             encoder = T5EncoderTPU(T5_BASE)
             encoder.setup()
         packaged = 0
-        texts = [r.caption for r in todo]
-        encoded = encoder.encode(texts)
-        for row, enc in zip(todo, encoded):
+        windows = [_window_texts(db, r.clip_uuid, r.caption) for r in todo]
+        flat = [t for ws in windows for t in ws]
+        encoded = iter(encoder.encode(flat))
+        per_clip = [[next(encoded) for _ in ws] for ws in windows]
+        for row, encs in zip(todo, per_clip):
             try:
                 clip_bytes = read_bytes(f"{root}/clips/{row.clip_uuid}.mp4")
             except FileNotFoundError:
@@ -297,7 +299,7 @@ def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
                 row.clip_uuid,
                 video_bytes=clip_bytes,
                 caption=row.caption,
-                t5_embedding=enc.embedding,
+                t5_embeddings=[e.embedding for e in encs],
             )
             db.set_clip_state(row.clip_uuid, "packaged")
             packaged += 1
@@ -325,6 +327,18 @@ def run_av_shard(args: AVPipelineArgs) -> dict:
             output_path=f"{args.output_path.rstrip('/')}/shards",
         )
     )
+
+
+def _window_texts(db, clip_uuid: str, fallback: str) -> list[str]:
+    """Per-clip caption WINDOW texts (reference CaptionWindow: window k of
+    the primary variant is stored as 'default#wk' by run_av_caption)."""
+    vc = db.variant_captions(clip_uuid)
+    wins = [vc.get("default", fallback)]
+    k = 1
+    while f"default#w{k}" in vc:
+        wins.append(vc[f"default#w{k}"])
+        k += 1
+    return wins
 
 
 def _shard_clip_packaging(args: AVPipelineArgs) -> dict:
@@ -428,16 +442,24 @@ def _shard_t5_packaging(args: AVPipelineArgs) -> dict:
                 )
                 by_span[key] = SessionSample(session_uuid=str(csu))
             # window frame indices are in caption-frame space (clips are
-            # captioned at AV_CAPTION_FPS, run_av_caption)
+            # captioned at AV_CAPTION_FPS, run_av_caption); window k spans
+            # [k*w, min((k+1)*w, n)) caption frames
             n_frames = max(
                 1, int(round((row.span_end - row.span_start) * AV_CAPTION_FPS))
             )
+            caps = _window_texts(db, row.clip_uuid, row.caption)
+            n_win = len(embeddings)
+            # run_av_caption windows are caption_window_frames wide with a
+            # ragged tail — use the SAME width, not a reconstruction
+            w = max(1, args.caption_window_frames)
             by_span[key].cameras[row.camera] = CameraWindows(
                 clip_uuid=row.clip_uuid,
-                captions=[row.caption] * len(embeddings),
+                captions=[
+                    caps[i] if i < len(caps) else row.caption for i in range(n_win)
+                ],
                 embeddings=list(embeddings),
-                window_start_frames=[0] * len(embeddings),
-                window_end_frames=[n_frames] * len(embeddings),
+                window_start_frames=[i * w for i in range(n_win)],
+                window_end_frames=[min((i + 1) * w, n_frames) for i in range(n_win)],
             )
         samples = list(by_span.values())
         if args.t5_packaging == "e":

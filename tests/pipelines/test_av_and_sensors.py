@@ -420,3 +420,43 @@ def test_clip_session_tar_packaging(av_dir, tmp_path):
         meta = json_mod.loads(tf.extractfile(jsons[0]).read())
         assert meta and {"frame_num", "timestamp"} <= set(meta[0])
         assert meta[0]["frame_num"] == 0
+
+
+def test_multi_window_t5_packaging(av_dir, tmp_path):
+    """Clips with several caption windows package one T5 embedding PER
+    WINDOW (reference CaptionWindow semantics), not just the first."""
+    import pickle
+
+    from cosmos_curate_tpu.models.t5 import T5_TINY_TEST, T5EncoderTPU
+    from cosmos_curate_tpu.models.vlm import CaptionEngine, VLM_TINY_TEST
+    from cosmos_curate_tpu.pipelines.av.pipeline import (
+        AVPipelineArgs,
+        run_av_caption,
+        run_av_ingest,
+        run_av_package,
+        run_av_split,
+    )
+
+    args = AVPipelineArgs(
+        input_path=str(av_dir),
+        output_path=str(tmp_path / "out"),
+        clip_len_s=2.0,
+        min_clip_len_s=0.5,
+        caption_window_frames=1,  # 2 s @ 1 fps -> 2 windows per clip
+        limit=1,
+    )
+    run_av_ingest(args)
+    run_av_split(args, runner=SequentialRunner())
+    engine = CaptionEngine(VLM_TINY_TEST, max_batch=4)
+    engine.setup()
+    cap = run_av_caption(args, engine=engine)
+    assert cap["num_windows"] >= 2
+    enc = T5EncoderTPU(T5_TINY_TEST)
+    enc.setup()
+    assert run_av_package(args, encoder=enc)["num_packaged"] >= 1
+    base = tmp_path / "out" / "datasets" / args.dataset_name / "t5_xxl"
+    pkls = list(base.glob("*/*.pkl"))
+    assert pkls
+    payload = pickle.loads(pkls[0].read_bytes())
+    assert isinstance(payload, list) and len(payload) >= 2
+    assert all(np.asarray(e).ndim == 2 for e in payload)
