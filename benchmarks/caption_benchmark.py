@@ -86,8 +86,7 @@ def main() -> int:
     if not args.uniform:
         engine.add_request(make_request("warmup-long", 2))
     engine.run_until_complete()
-    engine._decode_tokens = 0
-    engine._decode_time = 0.0
+    engine.reset_stats()
 
     t0 = time.monotonic()
     for i in range(args.requests):
@@ -113,6 +112,10 @@ def main() -> int:
         "requests": len(results),
         "output_tokens": out_tokens,
         "elapsed_s": round(elapsed, 2),
+        # dead-work measure: fraction of executed decode rows that produced
+        # a token (static slot batches; VERDICT r2 weak #5)
+        "decode_slot_utilization": round(engine.decode_slot_utilization, 3),
+        "kv_bytes": engine.kv_bytes(),
         "peak_flops": chip_peak_flops(),
         "backend": jax.devices()[0].platform,
     }

@@ -175,6 +175,10 @@ class CaptionEngine:
         self.completed: list[CaptionResult] = []
         self._decode_tokens = 0
         self._decode_time = 0.0
+        # dead-work accounting: every decode step runs a lane's FULL slot
+        # batch (static shapes); rows without an active slot are wasted.
+        # utilization = tokens produced / rows executed
+        self._decode_rows = 0
         self._built = False
         # One engine is shared by every caption-family stage in a pipeline
         # (weights + KV cache are too big to duplicate). Stages run in
@@ -355,6 +359,20 @@ class CaptionEngine:
     @property
     def tokens_per_second(self) -> float:
         return self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0
+
+    def reset_stats(self) -> None:
+        """Zero the throughput counters (e.g. after benchmark warmup) —
+        the counter set and its reset stay in one place."""
+        self._decode_tokens = 0
+        self._decode_time = 0.0
+        self._decode_rows = 0
+
+    @property
+    def decode_slot_utilization(self) -> float:
+        """Fraction of executed decode rows that produced a token (the
+        static-batch dead-work measure; lanes raise it by keeping batches
+        near their occupancy)."""
+        return self._decode_tokens / self._decode_rows if self._decode_rows else 0.0
 
     # -- engine internals ----------------------------------------------
     def step(self) -> None:
@@ -668,6 +686,7 @@ class CaptionEngine:
         greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
         self._decode_time += time.monotonic() - t0
         self._decode_tokens += len(lane.slots)
+        self._decode_rows += lane.n_slots
         # the device argmax suffices only for pure-greedy rows with no
         # penalties and min_tokens already satisfied
         needs_logits = any(
