@@ -106,8 +106,8 @@ def main() -> int:
         "value": round(end_to_end_tok_s, 2),
         "unit": "tok/s",
         "decode_tokens_per_sec": round(decode_tok_s, 2),
-        "decode_mfu": round(mfu(decode_flops * engine._decode_tokens, engine._decode_time), 5)
-        if engine._decode_time > 0
+        "decode_mfu": round(mfu(decode_flops * engine.decode_tokens, engine.decode_time_s), 5)
+        if engine.decode_time_s > 0
         else 0.0,
         "requests": len(results),
         "output_tokens": out_tokens,

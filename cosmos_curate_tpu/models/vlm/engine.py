@@ -360,6 +360,14 @@ class CaptionEngine:
     def tokens_per_second(self) -> float:
         return self._decode_tokens / self._decode_time if self._decode_time > 0 else 0.0
 
+    @property
+    def decode_tokens(self) -> int:
+        return self._decode_tokens
+
+    @property
+    def decode_time_s(self) -> float:
+        return self._decode_time
+
     def reset_stats(self) -> None:
         """Zero the throughput counters (e.g. after benchmark warmup) —
         the counter set and its reset stay in one place."""

@@ -205,16 +205,26 @@ def build_mrope_positions(
     return np.concatenate(parts, axis=0).astype(np.int32), offset
 
 
-def _use_flash_decode(cache_len: int) -> bool:
-    """Gate for the Pallas decode kernel: on by default on TPU for caches
-    where streaming pays off; CURATE_FLASH_DECODE=1/0 forces (tests use 1
-    with the interpreter off-TPU)."""
+def _flash_gate(env_var: str, cache_len: int, min_len: int) -> bool:
+    """Shared Pallas-kernel gate: the env var forces 1/0 (tests use 1 with
+    the interpreter off-TPU); otherwise on-TPU above the length where
+    streaming beats XLA's materialized path."""
     import os
 
-    env = os.environ.get("CURATE_FLASH_DECODE")
+    env = os.environ.get(env_var)
     if env is not None:
         return env == "1"
-    return jax.devices()[0].platform == "tpu" and cache_len >= 512
+    return jax.devices()[0].platform == "tpu" and cache_len >= min_len
+
+
+def _use_flash_decode(cache_len: int) -> bool:
+    return _flash_gate("CURATE_FLASH_DECODE", cache_len, 512)
+
+
+def _use_flash_prefill(cache_len: int) -> bool:
+    # the XLA prefill materializes fp32 [B, Hkv, G, T, S] logits — the HBM
+    # hot spot of long-prompt prefill (ops/prefill_attention.py)
+    return _flash_gate("CURATE_FLASH_PREFILL", cache_len, 1024)
 
 
 class RMSNorm(nn.Module):
@@ -276,6 +286,12 @@ class DecoderLayer(nn.Module):
                 q[:, 0].reshape(b, hk, group, dh), new_k, new_v, kv_len
             )
             attn = out.astype(self.dtype)[:, None]  # [B, 1, Hkv, G, Dh]
+        elif t > 1 and _use_flash_prefill(s):
+            from cosmos_curate_tpu.ops.prefill_attention import prefill_attention
+
+            attn = prefill_attention(
+                q.reshape(b, t, hk, group, dh), new_k, new_v, write_index, kv_len
+            ).astype(self.dtype)
         else:
             qg = (q * (dh**-0.5)).reshape(b, t, hk, group, dh)
             logits = jnp.einsum(
