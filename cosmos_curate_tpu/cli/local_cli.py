@@ -11,6 +11,10 @@ import argparse
 import json
 import sys
 
+# mirrors models/vlm/model.py VLM_FLAVORS (pinned by
+# tests/models/test_vlm_engine.py::test_cli_choices_match_flavors)
+CAPTION_MODEL_CHOICES = ("base", "qwen25vl-7b", "qwen2vl-2b", "tiny-test")
+
 
 def register(sub: argparse._SubParsersAction) -> None:
     local = sub.add_parser("local", help="run pipelines on this host")
@@ -37,6 +41,15 @@ def register(sub: argparse._SubParsersAction) -> None:
         default="",
     )
     split.add_argument("--captioning", action="store_true")
+    # static list (kept in sync with VLM_FLAVORS by a test): importing the
+    # model module here would pull jax into --help, which can hang when the
+    # TPU relay is wedged
+    split.add_argument(
+        "--caption-model",
+        default="base",
+        choices=CAPTION_MODEL_CHOICES,
+        help="VLM flavor for every caption-family stage",
+    )
     split.add_argument("--enhance-captions", action="store_true")
     split.add_argument("--t5-embeddings", action="store_true")
     split.add_argument("--previews", action="store_true")
@@ -280,6 +293,7 @@ def _cmd_split(args: argparse.Namespace) -> int:
             aesthetic_threshold=args.aesthetic_threshold,
             embedding_model=args.embedding_model,
             captioning=args.captioning,
+            caption_model=args.caption_model,
             enhance_captions=args.enhance_captions,
             t5_embeddings=args.t5_embeddings,
             previews=args.previews,

@@ -62,6 +62,8 @@ for _mid, _desc in [
     ("aesthetics-mlp-tpu", "aesthetic score head over CLIP embeddings"),
     ("video-embed-tpu", "temporal-transformer video embedder"),
     ("caption-vlm-tpu", "vision-language captioning model (Flax)"),
+    ("caption-qwen2vl-2b-tpu", "Qwen2-VL-2B-class captioner (converted checkpoint slot)"),
+    ("caption-qwen25vl-7b-tpu", "Qwen2.5-VL-7B/CosmosReason-class captioner (converted checkpoint slot)"),
     ("t5-encoder-tpu", "text encoder for caption embeddings"),
     ("ocr-detector-tpu", "overlay-text region detector (Flax FCN)"),
     ("ocr-recognizer-tpu", "text recognizer CRNN with CTC decoding"),

@@ -122,6 +122,21 @@ VLM_TINY_TEST = VLMConfig(
     vision=VIT_TINY_TEST,
     vision_tokens=8,
 )
+# Named caption-model flavors selectable from pipeline args (CLI
+# --caption-model); each pairs an architecture with its weight-registry id.
+VLM_FLAVORS: dict[str, tuple["VLMConfig", str]] = {}
+
+
+def vlm_flavor(name: str) -> tuple["VLMConfig", str]:
+    """(config, weight-registry model id) for a named caption flavor."""
+    try:
+        return VLM_FLAVORS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown caption model {name!r}; choose from {sorted(VLM_FLAVORS)}"
+        ) from None
+
+
 VLM_QWEN2VL_TINY_TEST = VLMConfig(
     vocab=512,
     dim=64,
@@ -134,6 +149,15 @@ VLM_QWEN2VL_TINY_TEST = VLMConfig(
     vision_variant="qwen2",
     qwen_vision=QWEN_VISION_TINY_TEST,
     mrope_section=(2, 3, 3),
+)
+
+VLM_FLAVORS.update(
+    {
+        "base": (VLM_BASE, "caption-vlm-tpu"),
+        "qwen2vl-2b": (VLM_QWEN2_2B, "caption-qwen2vl-2b-tpu"),
+        "qwen25vl-7b": (VLM_QWEN25_7B, "caption-qwen25vl-7b-tpu"),
+        "tiny-test": (VLM_TINY_TEST, "caption-vlm-tpu"),
+    }
 )
 
 

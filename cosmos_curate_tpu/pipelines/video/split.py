@@ -66,6 +66,9 @@ class SplitPipelineArgs:
     captioning: bool = False
     caption_window_len: int = 256
     caption_prompt_variant: str = "default"
+    # named VLM flavor (models/vlm/model.py VLM_FLAVORS): base |
+    # qwen2vl-2b | qwen25vl-7b | tiny-test
+    caption_model: str = "base"
     enhance_captions: bool = False
     t5_embeddings: bool = False
     previews: bool = False
@@ -149,6 +152,7 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
                 prompt_variant=args.semantic_filter_prompt,
                 score_only=args.semantic_filter == "score-only",
                 extraction=primary_sig,
+                model_flavor=args.caption_model,
             )
         )
     if args.embedding_model:
@@ -164,11 +168,16 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         stages.append(
             CaptionPrepStage(window_len=args.caption_window_len, extraction=primary_sig)
         )
-        stages.append(CaptionStage(prompt_variant=args.caption_prompt_variant))
+        stages.append(
+            CaptionStage(
+                prompt_variant=args.caption_prompt_variant,
+                model_flavor=args.caption_model,
+            )
+        )
     if args.enhance_captions:
         from cosmos_curate_tpu.pipelines.video.stages.enhance_caption import EnhanceCaptionStage
 
-        stages.append(EnhanceCaptionStage(prompt_variant=args.caption_prompt_variant))
+        stages.append(EnhanceCaptionStage(prompt_variant=args.caption_prompt_variant, model_flavor=args.caption_model))
     if args.t5_embeddings:
         from cosmos_curate_tpu.pipelines.video.stages.caption_embedding import (
             CaptionEmbeddingStage,
@@ -188,7 +197,7 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
             PerEventCaptionStage,
         )
 
-        stages.append(PerEventCaptionStage())
+        stages.append(PerEventCaptionStage(model_flavor=args.caption_model))
     stages.extend(args.extra_stages)
     stages.append(ClipWriterStage(args.output_path))
     return stages

@@ -91,17 +91,28 @@ _ENGINES: dict[tuple, CaptionEngine] = {}
 class _CaptionVLM(ModelInterface):
     MODEL_ID = "caption-vlm-tpu"
 
-    def __init__(self, cfg: VLMConfig, max_batch: int) -> None:
+    def __init__(
+        self,
+        cfg: VLMConfig,
+        max_batch: int,
+        model_id: str | None = None,
+        require_weights: bool = False,
+    ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
+        self.model_id = model_id or self.MODEL_ID
+        self.require_weights = require_weights
         self.engine: CaptionEngine | None = None
 
     @property
     def model_id_names(self) -> list[str]:
-        return [self.MODEL_ID]
+        return [self.model_id]
 
     def setup(self) -> None:
-        key = (self.cfg, self.max_batch)
+        # model_id is part of the key: the same architecture under two
+        # weight ids must NOT share one engine (the second would silently
+        # caption with the first checkpoint's weights)
+        key = (self.cfg, self.max_batch, self.model_id)
         engine = _ENGINES.get(key)
         if engine is None:
             engine = CaptionEngine(self.cfg, max_batch=self.max_batch)
@@ -110,9 +121,30 @@ class _CaptionVLM(ModelInterface):
             def init(seed: int):
                 return engine.params
 
-            engine.params = registry.load_params(self.MODEL_ID, init)
+            engine.params = registry.load_params(
+                self.model_id, init, require=self.require_weights
+            )
             _ENGINES[key] = engine
         self.engine = engine
+
+
+def resolve_caption_model(
+    cfg: VLMConfig | None, model_flavor: str | None, max_batch: int
+) -> _CaptionVLM:
+    """One resolution rule for every caption-family stage (captioning,
+    enhancement, semantic filter, per-event): an explicit flavor selects
+    (config, weight id) from VLM_FLAVORS and REQUIRES staged weights for
+    the non-default checkpoints — a user asking for qwen25vl-7b must not
+    silently get random-init gibberish."""
+    if cfg is not None and model_flavor is not None:
+        raise ValueError("pass cfg OR model_flavor, not both")
+    if model_flavor is not None:
+        from cosmos_curate_tpu.models.vlm.model import vlm_flavor
+
+        fcfg, model_id = vlm_flavor(model_flavor)
+        require = model_flavor not in ("base", "tiny-test")
+        return _CaptionVLM(fcfg, max_batch, model_id=model_id, require_weights=require)
+    return _CaptionVLM(cfg or VLM_BASE, max_batch)
 
 
 class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
@@ -122,16 +154,21 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self,
         *,
         prompt_variant: str = "default",
-        cfg: VLMConfig = VLM_BASE,
+        cfg: VLMConfig | None = None,
         max_batch: int = 8,
         max_new_tokens: int = 128,
         refine: bool = False,
+        model_flavor: str | None = None,
     ) -> None:
         self.prompt_variant = prompt_variant
         self.prompt_text = get_caption_prompt(prompt_variant)
         self.max_new_tokens = max_new_tokens
         self.refine = refine
-        self._model = _CaptionVLM(cfg, max_batch)
+        self._model = resolve_caption_model(cfg, model_flavor, max_batch)
+        # a small-context flavor must clamp generation, not refuse requests
+        # (half the context stays available for vision + prompt)
+        if self.max_new_tokens >= self._model.cfg.max_seq // 2:
+            self.max_new_tokens = self._model.cfg.max_seq // 2
         self.tokenizer = default_caption_tokenizer()
         self._refined_ids: set[str] = set()  # stage-2 bookkeeping (not user data)
 

@@ -22,13 +22,20 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self,
         *,
         prompt_variant: str = "default",
-        cfg: VLMConfig = VLM_BASE,
+        cfg: VLMConfig | None = None,
         max_batch: int = 8,
         max_new_tokens: int = 128,
+        model_flavor: str | None = None,
     ) -> None:
+        from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            resolve_caption_model,
+        )
+
         self.prompt_variant = prompt_variant
         self.max_new_tokens = max_new_tokens
-        self._model = _CaptionVLM(cfg, max_batch)
+        self._model = resolve_caption_model(cfg, model_flavor, max_batch)
+        if self.max_new_tokens >= self._model.cfg.max_seq // 2:
+            self.max_new_tokens = self._model.cfg.max_seq // 2
         self.tokenizer = default_caption_tokenizer()
 
     @property

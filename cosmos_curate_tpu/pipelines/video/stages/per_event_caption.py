@@ -61,12 +61,17 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
     def __init__(
         self,
         *,
-        cfg: VLMConfig = VLM_BASE,
+        cfg: VLMConfig | None = None,
         max_batch: int = 8,
         max_new_tokens: int = 64,
         frames_per_event: int = 4,
+        model_flavor: str | None = None,
     ) -> None:
-        self._model = _CaptionVLM(cfg, max_batch)
+        from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            resolve_caption_model,
+        )
+
+        self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         self.max_new_tokens = max_new_tokens
         self.frames_per_event = frames_per_event
         self.tokenizer = default_caption_tokenizer()

@@ -37,8 +37,9 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self,
         *,
         prompt_variant: str = "default",
-        cfg: VLMConfig = VLM_BASE,
+        cfg: VLMConfig | None = None,
         max_batch: int = 8,
+        model_flavor: str | None = None,
         score_only: bool = False,
         keep_on_unparseable: bool = True,
         num_frames: int = 4,
@@ -49,7 +50,11 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.keep_on_unparseable = keep_on_unparseable
         self.num_frames = num_frames
         self.extraction = extraction
-        self._model = _CaptionVLM(cfg, max_batch)
+        from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            resolve_caption_model,
+        )
+
+        self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         self.tokenizer = default_caption_tokenizer()
 
     @property

@@ -355,3 +355,29 @@ class TestFlashPrefillPath:
         monkeypatch.setenv("CURATE_FLASH_PREFILL", "1")
         flash = run()
         assert base == flash
+
+
+def test_vlm_flavors_resolve():
+    from cosmos_curate_tpu.models import registry
+    from cosmos_curate_tpu.models.vlm.model import VLM_FLAVORS, vlm_flavor
+
+    for name, (cfg, model_id) in VLM_FLAVORS.items():
+        assert cfg.vocab > 0
+        assert model_id in registry.registered_models(), (name, model_id)
+    with __import__("pytest").raises(ValueError, match="unknown caption model"):
+        vlm_flavor("nope")
+
+
+def test_caption_stage_accepts_flavor():
+    from cosmos_curate_tpu.pipelines.video.stages.captioning import CaptionStage
+
+    stage = CaptionStage(model_flavor="tiny-test")
+    assert stage._model.cfg is VLM_TINY_TEST
+    assert stage._model.model_id == "caption-vlm-tpu"
+
+
+def test_cli_choices_match_flavors():
+    from cosmos_curate_tpu.cli.local_cli import CAPTION_MODEL_CHOICES
+    from cosmos_curate_tpu.models.vlm.model import VLM_FLAVORS
+
+    assert sorted(CAPTION_MODEL_CHOICES) == sorted(VLM_FLAVORS)
