@@ -70,8 +70,48 @@ class NodeAgent:
         self._send_lock = threading.Lock()
         self._stop = threading.Event()
 
-    def run(self, *, connect_timeout_s: float = 60.0) -> int:
+    def run(self, *, connect_timeout_s: float = 60.0, reconnect: bool = True) -> int:
+        """Serve the driver until it says Bye.
+
+        A lost link (driver restart, transient network) does NOT end the
+        agent: workers are torn down and the agent dials again — a
+        rejoining agent is just a fresh Hello to the driver, which re-places
+        workers on it at the next autoscale tick. Exit paths: an explicit
+        Bye, or the driver staying unreachable past the reconnect window
+        (a Bye lost to a RST must not pin the slurm allocation forever) —
+        both exit 0."""
+        reconnect_s = float(os.environ.get("CURATE_AGENT_RECONNECT_S", "300"))
+        while True:
+            try:
+                said_bye = self._serve_once(connect_timeout_s=connect_timeout_s)
+            except OSError as e:
+                logger.info("driver unreachable (%s); agent exiting", e)
+                return 0
+            if said_bye or not reconnect:
+                return 0
+            logger.info("driver link lost; reconnecting")
+            connect_timeout_s = reconnect_s
+
+    def _serve_once(self, *, connect_timeout_s: float) -> bool:
+        """One connect→serve cycle; True when the driver sent Bye."""
         object_store.cleanup_stale_segments()
+        # the previous cycle's in-flight inputs are dead weight now (their
+        # workers were terminated): unlink the shm segments — this agent
+        # process stays alive, so the stale-segment janitor never would
+        for key, batch_id in list(self.inflight):
+            self._release_inflight(key, batch_id)
+        # stale worker results must not leak into the NEW session (the
+        # driver would see results for workers it never started)
+        try:
+            while True:
+                self.results_q.get_nowait()
+        except queue.Empty:
+            pass
+        # each cycle gets its OWN stop event: a relay thread stuck in a
+        # stalled send can never be revived by a later cycle's clear()
+        self._stop = threading.Event()
+        self.workers.clear()
+        self.inflight.clear()
         deadline = time.monotonic() + connect_timeout_s
         while True:  # the driver may come up after the agents (srun races)
             try:
@@ -87,13 +127,17 @@ class NodeAgent:
             "agent %s joined driver %s:%d (%.0f cpus)",
             self.node_id, self.addr[0], self.addr[1], self.num_cpus,
         )
-        relay = threading.Thread(target=self._relay_results, daemon=True)
+        stop = self._stop
+        relay = threading.Thread(target=self._relay_results, args=(stop,), daemon=True)
         relay.start()
-        threading.Thread(target=self._watchdog, daemon=True).start()
+        watchdog = threading.Thread(target=self._watchdog, args=(stop,), daemon=True)
+        watchdog.start()
+        said_bye = False
         try:
             while True:
                 msg = recv_msg(sock, self.token)
                 if isinstance(msg, Bye):
+                    said_bye = True
                     break
                 try:
                     self._handle(msg)
@@ -125,7 +169,7 @@ class NodeAgent:
                 sock.close()
             except OSError:
                 pass
-        return 0
+        return said_bye
 
     def _send(self, msg) -> None:
         with self._send_lock:
@@ -175,8 +219,8 @@ class NodeAgent:
             except Exception:
                 pass
 
-    def _relay_results(self) -> None:
-        while not self._stop.is_set():
+    def _relay_results(self, stop: threading.Event) -> None:
+        while not stop.is_set():
             try:
                 msg = self.results_q.get(timeout=0.2)
             except queue.Empty:
@@ -216,11 +260,11 @@ class NodeAgent:
             except OSError:
                 return
 
-    def _watchdog(self) -> None:
+    def _watchdog(self, stop: threading.Event) -> None:
         """Detect remote worker PROCESS deaths (the driver can only see the
         link): report WorkerDied so the driver's reap requeues the batch,
         and free the dead worker's in-flight input segments."""
-        while not self._stop.is_set():
+        while not stop.is_set():
             time.sleep(1.0)
             for key, (_in_q, proc) in list(self.workers.items()):
                 if proc.is_alive():

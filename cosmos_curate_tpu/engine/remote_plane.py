@@ -251,7 +251,26 @@ class RemoteWorkerManager:
         self.local_cpus_used = 0.0  # all pools' locally placed workers (cpu units)
         self.agents: list[AgentLink] = []
         self._lock = threading.Lock()
-        self._server = socket.create_server(("0.0.0.0", port), backlog=8)
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # a restarted driver must rebind the well-known port: SO_REUSEADDR
+        # covers TIME_WAIT, and a short retry covers the window where a
+        # predecessor's accepted connections are still tearing down
+        # (agents keep dialing, so seconds of delay cost nothing)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        import errno
+
+        deadline = time.monotonic() + 20.0
+        while True:
+            try:
+                self._server.bind(("0.0.0.0", port))
+                break
+            except OSError as e:
+                # only the predecessor-teardown race is transient; EACCES
+                # etc. are deterministic and must surface immediately
+                if e.errno != errno.EADDRINUSE or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+        self._server.listen(8)
         self._closed = False
         # async sender: materialize+pickle+send off the orchestration loop
         import queue as _queue

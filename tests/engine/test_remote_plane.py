@@ -195,3 +195,50 @@ class TestRemotePlane:
             assert mgr.place(4.0) is None  # everything full
         finally:
             mgr.shutdown()
+
+    def test_agent_reconnects_after_driver_restart(self, monkeypatch):
+        """A lost link tears down workers and the agent dials again — two
+        successive driver sessions are served by ONE agent process."""
+        import queue
+        import subprocess
+
+        from cosmos_curate_tpu.engine.remote_plane import RemoteWorkerManager
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "reconnect-secret")
+        port = _free_port()
+        env = {
+            **os.environ,
+            "CURATE_ENGINE_TOKEN": "reconnect-secret",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+        }
+        agent = subprocess.Popen(
+            [
+                sys.executable, "-m", "cosmos_curate_tpu.engine.remote_agent",
+                "--driver", f"127.0.0.1:{port}", "--node-id", "re-agent",
+                "--num-cpus", "1",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            for session in range(2):
+                mgr = RemoteWorkerManager(port, queue.Queue(), local_cpu_budget=1.0)
+                try:
+                    got = mgr.wait_for_agents(1, 30.0)
+                    assert got == 1, f"session {session}: agent did not (re)join"
+                finally:
+                    # closing WITHOUT Bye simulates a driver crash: sockets
+                    # drop, the agent must reconnect for the next session
+                    for a in mgr.agents:
+                        try:
+                            a.sock.close()
+                        except OSError:
+                            pass
+                    mgr._closed = True
+                    mgr._server.close()
+        finally:
+            agent.terminate()
+            try:
+                agent.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                agent.kill()
