@@ -251,5 +251,9 @@ def save_params(model_id: str, params: Any, *, root: Path | str | None = None) -
     base = Path(root) if root is not None else weights_root()
     ckpt = base / model_id / "params.msgpack"
     ckpt.parent.mkdir(parents=True, exist_ok=True)
-    ckpt.write_bytes(flax.serialization.to_bytes(params))
+    # Atomic publish: a trainer killed mid-write (watcher timeouts) must not
+    # leave a truncated params.msgpack that later passes exists() checks.
+    tmp = ckpt.with_name(ckpt.name + ".tmp")
+    tmp.write_bytes(flax.serialization.to_bytes(params))
+    tmp.replace(ckpt)
     return ckpt
