@@ -13,7 +13,13 @@ import sys
 
 # mirrors models/vlm/model.py VLM_FLAVORS (pinned by
 # tests/models/test_vlm_engine.py::test_cli_choices_match_flavors)
-CAPTION_MODEL_CHOICES = ("base", "qwen25vl-7b", "qwen2vl-2b", "tiny-test")
+CAPTION_MODEL_CHOICES = (
+    "base",
+    "qwen25vl-7b",
+    "qwen2vl-2b",
+    "qwen-chat-tiny-test",
+    "tiny-test",
+)
 
 
 def register(sub: argparse._SubParsersAction) -> None:

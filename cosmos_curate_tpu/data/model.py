@@ -77,6 +77,8 @@ class Window:
     end_frame: int = 0
     mp4_bytes: bytes | None = None
     frames: np.ndarray | None = None  # uint8 [T, H, W, 3]
+    # sampling rate of `frames` in source-time fps (temporal m-rope scaling)
+    frame_fps: float | None = None
     caption: dict[str, str] = field(default_factory=dict)  # prompt_variant -> text
     enhanced_caption: dict[str, str] = field(default_factory=dict)
     t5_embedding: np.ndarray | None = None

@@ -87,11 +87,23 @@ def local_dir_for(model_id: str) -> Path:
 
 
 def find_checkpoint(model_id: str) -> Path | None:
+    return find_model_file(model_id, "params.msgpack")
+
+
+def find_model_file(model_id: str, filename: str) -> Path | None:
+    """A staged/committed auxiliary model file (tokenizer vocab, config,
+    ...), staging dir first so a pulled real asset wins over a committed
+    test fixture."""
     for root in (weights_root(), REPO_WEIGHTS_DIR):
-        ckpt = root / model_id / "params.msgpack"
-        if ckpt.exists():
-            return ckpt
+        p = root / model_id / filename
+        if p.exists():
+            return p
     return None
+
+
+# Non-checkpoint files pulled alongside a caption model's weights: converted
+# HF checkpoints are unusable without their exact-id tokenizer files.
+TOKENIZER_AUX_FILES = ("vocab.json", "merges.txt")
 
 
 def stage_weights_on_node(model_ids: list[str]) -> None:
@@ -177,6 +189,31 @@ def maybe_pull_remote_weights(model_id: str) -> Path | None:
         tmp.rename(dest)  # atomic: readers never see a partial file
         logger.info("staged %s from %s (%d bytes)", model_id, remote, size)
         return dest
+
+
+def maybe_pull_tokenizer_files(model_id: str) -> None:
+    """Best-effort pull of the tokenizer sidecar files a converted HF
+    caption checkpoint needs. Called by hf_chat flavors ONLY (repo-native
+    flavors must not pay doomed remote GETs on every setup)."""
+    uri = os.environ.get(WEIGHTS_URI_ENV, "").rstrip("/")
+    if not uri:
+        return
+    from cosmos_curate_tpu.storage.client import get_storage_client
+
+    for name in TOKENIZER_AUX_FILES:
+        dest = local_dir_for(model_id) / name
+        if dest.exists():
+            continue
+        remote = f"{uri}/{model_id}/{name}"
+        try:
+            data = get_storage_client(remote).read_bytes(remote)
+        except FileNotFoundError:
+            continue
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        tmp = dest.with_name(dest.name + ".tmp")
+        tmp.write_bytes(data)
+        tmp.rename(dest)
+        logger.info("staged %s for %s", name, model_id)
 
 
 def load_params(

@@ -123,12 +123,36 @@ VLM_TINY_TEST = VLMConfig(
     vision_tokens=8,
 )
 # Named caption-model flavors selectable from pipeline args (CLI
-# --caption-model); each pairs an architecture with its weight-registry id.
-VLM_FLAVORS: dict[str, tuple["VLMConfig", str]] = {}
+# --caption-model); each pairs an architecture with its weight-registry id
+# plus the serving knobs that must travel with the checkpoint choice.
+@dataclass(frozen=True)
+class FlavorSpec:
+    cfg: "VLMConfig"
+    model_id: str
+    # Converted-HF-checkpoint flavors index embeddings by the checkpoint's
+    # EXACT token ids and were trained on its chat template: serving them
+    # requires HFVocabTokenizer (staged vocab.json/merges.txt) + the
+    # Qwen chat layout (vlm/chat.py). Repo-native flavors use the local
+    # byte/BPE tokenizer and raw prompts.
+    hf_chat: bool = False
+    # Flavors naming a real checkpoint must refuse to run random-init
+    # (a user asking for qwen25vl-7b must not silently get gibberish).
+    require_weights: bool = True
+    # hf_chat special-token table override (None = Qwen2 defaults); tuple
+    # of (token, id) pairs so the spec stays hashable.
+    specials: tuple[tuple[str, int], ...] | None = None
+    # Default KV lane layout ((length, n_slots), ...) for the caption
+    # engine — memory-bounding by actual request lengths (None = one
+    # worst-case-length pool). Chosen per checkpoint size so the
+    # production caption stage runs laned by default.
+    kv_lanes: tuple[tuple[int, int], ...] | None = None
 
 
-def vlm_flavor(name: str) -> tuple["VLMConfig", str]:
-    """(config, weight-registry model id) for a named caption flavor."""
+VLM_FLAVORS: dict[str, FlavorSpec] = {}
+
+
+def vlm_flavor(name: str) -> FlavorSpec:
+    """The full serving spec for a named caption flavor."""
     try:
         return VLM_FLAVORS[name]
     except KeyError:
@@ -150,13 +174,64 @@ VLM_QWEN2VL_TINY_TEST = VLMConfig(
     qwen_vision=QWEN_VISION_TINY_TEST,
     mrope_section=(2, 3, 3),
 )
+# chat-template prompts in byte-level test tokens run ~170 ids — the
+# hf_chat test flavor needs the extra context
+VLM_QWEN_CHAT_TINY_TEST = VLMConfig(
+    vocab=512,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    max_seq=256,
+    vision=VIT_TINY_TEST,
+    vision_variant="qwen2",
+    qwen_vision=QWEN_VISION_TINY_TEST,
+    mrope_section=(2, 3, 3),
+)
+
+# Special-token ids small enough for the tiny test config's 512-row
+# embedding table; layout mirrors QWEN2_SPECIAL_TOKENS.
+_TINY_CHAT_SPECIALS = (
+    ("<|endoftext|>", 500),
+    ("<|im_start|>", 501),
+    ("<|im_end|>", 502),
+    ("<|vision_start|>", 503),
+    ("<|vision_end|>", 504),
+    ("<|vision_pad|>", 505),
+    ("<|image_pad|>", 506),
+    ("<|video_pad|>", 507),
+)
 
 VLM_FLAVORS.update(
     {
-        "base": (VLM_BASE, "caption-vlm-tpu"),
-        "qwen2vl-2b": (VLM_QWEN2_2B, "caption-qwen2vl-2b-tpu"),
-        "qwen25vl-7b": (VLM_QWEN25_7B, "caption-qwen25vl-7b-tpu"),
-        "tiny-test": (VLM_TINY_TEST, "caption-vlm-tpu"),
+        "base": FlavorSpec(VLM_BASE, "caption-vlm-tpu", require_weights=False),
+        "qwen2vl-2b": FlavorSpec(
+            VLM_QWEN2_2B,
+            "caption-qwen2vl-2b-tpu",
+            hf_chat=True,
+            # 2B-class KV is cheap (2 kv-heads): plenty of short-lane slots
+            # for caption windows, a few full-context rows for long prompts
+            kv_lanes=((1024, 8), (4096, 4)),
+        ),
+        "qwen25vl-7b": FlavorSpec(
+            VLM_QWEN25_7B,
+            "caption-qwen25vl-7b-tpu",
+            hf_chat=True,
+            # 7B KV rows are 4x the 2B's — halve the lane budget
+            kv_lanes=((1024, 4), (4096, 2)),
+        ),
+        "tiny-test": FlavorSpec(VLM_TINY_TEST, "caption-vlm-tpu", require_weights=False),
+        # hf_chat plumbing under test shapes: exercises HFVocabTokenizer +
+        # chat-template request building without a real checkpoint
+        "qwen-chat-tiny-test": FlavorSpec(
+            VLM_QWEN_CHAT_TINY_TEST,
+            "caption-vlm-tpu",
+            hf_chat=True,
+            require_weights=False,
+            specials=_TINY_CHAT_SPECIALS,
+            kv_lanes=((192, 4), (256, 2)),
+        ),
     }
 )
 
@@ -199,6 +274,7 @@ def build_mrope_positions(
     n_text_before: int,
     grid_merged: tuple[int, int, int] | None,
     n_text_after: int,
+    t_scale: float = 1.0,
 ) -> tuple[np.ndarray, int]:
     """(t, h, w) position ids for a [text][vision][text] prompt layout.
 
@@ -206,7 +282,13 @@ def build_mrope_positions(
     components; a vision block starting at offset ``st`` gets
     ``st + (t_idx, h_idx, w_idx)`` over the MERGED token grid in t-major
     row-major order (exactly the merger's output order); text resumes at
-    ``st + max(grid)``. Returns ([T, 3] int32, next_position).
+    ``st + max(vision indices) + 1``. Returns ([T, 3] int32, next_position).
+
+    ``t_scale`` is Qwen2.5-VL's absolute-time temporal component
+    (HF ``Qwen2_5_VLModel.get_rope_index``):
+    ``t_index = floor(grid_t_idx * second_per_grid_t * tokens_per_second)``
+    with ``t_scale = second_per_grid_t * tokens_per_second``. The default
+    1.0 reproduces Qwen2-VL's unscaled ``arange`` exactly.
     """
     parts = []
     if n_text_before:
@@ -215,11 +297,13 @@ def build_mrope_positions(
     offset = n_text_before
     if grid_merged is not None:
         gt, gh, gw = grid_merged
-        t_idx = np.repeat(np.arange(gt, dtype=np.int32), gh * gw)
+        t_idx = np.floor(
+            np.repeat(np.arange(gt, dtype=np.float64), gh * gw) * t_scale
+        ).astype(np.int32)
         h_idx = np.tile(np.repeat(np.arange(gh, dtype=np.int32), gw), gt)
         w_idx = np.tile(np.tile(np.arange(gw, dtype=np.int32), gh), gt)
         parts.append(offset + np.stack([t_idx, h_idx, w_idx], axis=-1))
-        offset += max(gt, gh, gw)
+        offset += max(int(t_idx[-1]) + 1 if gt else 0, gh, gw)
     if n_text_after:
         t = offset + np.arange(n_text_after, dtype=np.int32)
         parts.append(np.stack([t, t, t], axis=-1))

@@ -53,6 +53,10 @@ class QwenVisionConfig:
     intermediate_size: int | None = None  # qwen2_5 sets this explicitly
     window_size: int = 112  # pixels; qwen2_5 only
     fullatt_block_indexes: tuple[int, ...] = ()
+    # Qwen2.5-VL scales the temporal m-rope component to absolute time:
+    # t_index = floor(grid_t_idx * second_per_grid_t * tokens_per_second)
+    # (HF get_rope_index). None = unscaled (Qwen2-VL behavior).
+    tokens_per_second: float | None = None
 
     @property
     def mlp_hidden(self) -> int:
@@ -100,6 +104,7 @@ QWEN25_VL_7B_VISION = QwenVisionConfig(
     variant="qwen2_5",
     window_size=112,
     fullatt_block_indexes=(7, 15, 23, 31),
+    tokens_per_second=2.0,  # HF Qwen2.5-VL vision_config.tokens_per_second
 )
 QWEN_VISION_TINY_TEST = QwenVisionConfig(
     depth=2,

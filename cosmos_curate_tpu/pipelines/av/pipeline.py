@@ -225,6 +225,7 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
                                 request_id=f"{cid}::{variant}::w{k}",
                                 prompt_ids=tok.encode(prompts[variant]),
                                 frames=win,
+                                frame_fps=AV_CAPTION_FPS,
                                 sampling=SamplingConfig(max_new_tokens=96),
                             )
                         )

@@ -78,13 +78,18 @@ class CaptionPrepStage(Stage[SplitPipeTask, SplitPipeTask]):
                     idx = np.linspace(ea, min(eb, n_ext) - 1, self.frames_per_window)
                     win = Window(start_frame=a, end_frame=b)
                     win.frames = frames[idx.round().astype(int)]
+                    # effective sampling rate of the window's frames in
+                    # source time (Qwen2.5 temporal m-rope scaling)
+                    span_s = (b - a) / max(task.video.metadata.fps, 1e-6)
+                    win.frame_fps = self.frames_per_window / max(span_s, 1e-6)
                     clip.windows.append(win)
         return tasks
 
 
-# One engine per (config, batch) per process: several caption-family stages
-# (captioning, enhancement, semantic filter, per-event) in one pipeline must
-# share weights + KV cache instead of loading the VLM repeatedly.
+# One engine per (config, batch, lanes) per process: several caption-family
+# stages (captioning, enhancement, semantic filter, per-event) in one
+# pipeline must share weights + KV cache instead of loading the VLM
+# repeatedly.
 _ENGINES: dict[tuple, CaptionEngine] = {}
 
 
@@ -97,25 +102,111 @@ class _CaptionVLM(ModelInterface):
         max_batch: int,
         model_id: str | None = None,
         require_weights: bool = False,
+        hf_chat: bool = False,
+        specials: dict[str, int] | None = None,
+        kv_lanes: tuple[tuple[int, int], ...] | None = None,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
         self.model_id = model_id or self.MODEL_ID
         self.require_weights = require_weights
+        self.hf_chat = hf_chat
+        self.specials = specials
+        self.kv_lanes = kv_lanes
         self.engine: CaptionEngine | None = None
+        self._tokenizer = None
+        # encode_prompt memo: the HF BPE is pure-Python and the caption
+        # prompts are loop-invariant across windows/clips/events
+        self._prompt_cache: dict[tuple[str, bool], tuple[list[int], list[int]]] = {}
+
+    def __getstate__(self):
+        # engines and tokenizers are worker-local (the engine holds device
+        # buffers; the tokenizer may load node-staged files)
+        state = self.__dict__.copy()
+        state["engine"] = None
+        state["_tokenizer"] = None
+        state["_prompt_cache"] = {}
+        return state
 
     @property
     def model_id_names(self) -> list[str]:
         return [self.model_id]
 
+    @property
+    def tokenizer(self):
+        """The tokenizer requests for this model MUST be encoded with.
+
+        A converted HF checkpoint's embedding table is indexed by the
+        checkpoint's exact token ids, so hf_chat flavors load
+        HFVocabTokenizer from the staged ``vocab.json``/``merges.txt``
+        (ADVICE r3: encoding such prompts with the repo BPE feeds wrong
+        embedding rows and the eos check never fires). Missing tokenizer
+        files fail loudly, like ``require_weights`` does for params.
+        """
+        if self._tokenizer is None:
+            if self.hf_chat:
+                from cosmos_curate_tpu.models.tokenizer import HFVocabTokenizer
+
+                registry.maybe_pull_tokenizer_files(self.model_id)
+                vocab = registry.find_model_file(self.model_id, "vocab.json")
+                merges = registry.find_model_file(self.model_id, "merges.txt")
+                if vocab is None or merges is None:
+                    raise FileNotFoundError(
+                        f"{self.model_id} is a converted-checkpoint flavor: "
+                        f"stage its tokenizer files (vocab.json + merges.txt) "
+                        f"under weights/{self.model_id}/ — encoding with the "
+                        f"repo tokenizer would address wrong embedding rows"
+                    )
+                self._tokenizer = HFVocabTokenizer.from_gpt2_files(
+                    vocab, merges, specials=self.specials
+                )
+            else:
+                self._tokenizer = default_caption_tokenizer()
+        return self._tokenizer
+
+    def encode_prompt(
+        self, user_text: str, *, has_vision: bool
+    ) -> tuple[list[int], list[int]]:
+        """(prefix_ids, prompt_ids) for a CaptionRequest in this flavor's
+        prompt format: the checkpoint's chat template for hf_chat flavors
+        (vision embeddings splice between the two), a raw encode otherwise.
+        Memoized — stages call this per window/clip/event with identical
+        text."""
+        key = (user_text, has_vision)
+        hit = self._prompt_cache.get(key)
+        if hit is None:
+            if self.hf_chat:
+                from cosmos_curate_tpu.models.vlm.chat import build_qwen_vl_chat
+
+                hit = build_qwen_vl_chat(
+                    self.tokenizer,
+                    user_text,
+                    has_vision=has_vision,
+                    specials=self.specials or None,
+                )
+            else:
+                hit = [], self.tokenizer.encode(user_text)
+            if len(self._prompt_cache) < 4096:  # bound memory on unique texts
+                self._prompt_cache[key] = hit
+        # copies: requests must not alias the cached lists
+        return list(hit[0]), list(hit[1])
+
     def setup(self) -> None:
         # model_id is part of the key: the same architecture under two
         # weight ids must NOT share one engine (the second would silently
         # caption with the first checkpoint's weights)
-        key = (self.cfg, self.max_batch, self.model_id)
+        key = (self.cfg, self.max_batch, self.model_id, self.kv_lanes)
         engine = _ENGINES.get(key)
         if engine is None:
-            engine = CaptionEngine(self.cfg, max_batch=self.max_batch)
+            # build the tokenizer BEFORE the engine: a missing staged
+            # tokenizer must fail setup, not first inference
+            tokenizer = self.tokenizer
+            engine = CaptionEngine(
+                self.cfg,
+                max_batch=self.max_batch,
+                tokenizer=tokenizer,
+                kv_lanes=self.kv_lanes,
+            )
             engine.setup()
 
             def init(seed: int):
@@ -133,17 +224,25 @@ def resolve_caption_model(
 ) -> _CaptionVLM:
     """One resolution rule for every caption-family stage (captioning,
     enhancement, semantic filter, per-event): an explicit flavor selects
-    (config, weight id) from VLM_FLAVORS and REQUIRES staged weights for
-    the non-default checkpoints — a user asking for qwen25vl-7b must not
-    silently get random-init gibberish."""
+    the full serving spec from VLM_FLAVORS — architecture, weight id,
+    tokenizer/chat handling, and default KV lanes — and REQUIRES staged
+    weights for real-checkpoint flavors (a user asking for qwen25vl-7b
+    must not silently get random-init gibberish)."""
     if cfg is not None and model_flavor is not None:
         raise ValueError("pass cfg OR model_flavor, not both")
     if model_flavor is not None:
         from cosmos_curate_tpu.models.vlm.model import vlm_flavor
 
-        fcfg, model_id = vlm_flavor(model_flavor)
-        require = model_flavor not in ("base", "tiny-test")
-        return _CaptionVLM(fcfg, max_batch, model_id=model_id, require_weights=require)
+        spec = vlm_flavor(model_flavor)
+        return _CaptionVLM(
+            spec.cfg,
+            max_batch,
+            model_id=spec.model_id,
+            require_weights=spec.require_weights,
+            hf_chat=spec.hf_chat,
+            specials=dict(spec.specials) if spec.specials else None,
+            kv_lanes=spec.kv_lanes,
+        )
     return _CaptionVLM(cfg or VLM_BASE, max_batch)
 
 
@@ -169,7 +268,6 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         # (half the context stays available for vision + prompt)
         if self.max_new_tokens >= self._model.cfg.max_seq // 2:
             self.max_new_tokens = self._model.cfg.max_seq // 2
-        self.tokenizer = default_caption_tokenizer()
         self._refined_ids: set[str] = set()  # stage-2 bookkeeping (not user data)
 
     @property
@@ -210,7 +308,9 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         return tasks
 
     def _make_request(self, rid: str, win: Window) -> CaptionRequest:
-        prompt_ids = self.tokenizer.encode(self.prompt_text)
+        prefix_ids, prompt_ids = self._model.encode_prompt(
+            self.prompt_text, has_vision=True
+        )
         sampling = SamplingConfig(max_new_tokens=self.max_new_tokens)
         on_complete = None
         if self.refine:
@@ -218,17 +318,24 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                 if _rid in self._refined_ids:
                     return None
                 self._refined_ids.add(_rid)
+                pre, ids = self._model.encode_prompt(
+                    REFINEMENT_PROMPT + text, has_vision=True
+                )
                 return CaptionRequest(
                     request_id=_rid,
-                    prompt_ids=self.tokenizer.encode(REFINEMENT_PROMPT + text),
+                    prefix_ids=pre,
+                    prompt_ids=ids,
                     frames=_win.frames,
+                    frame_fps=_win.frame_fps,
                     sampling=sampling,
                     on_complete=on_complete,
                 )
         return CaptionRequest(
             request_id=rid,
+            prefix_ids=prefix_ids,
             prompt_ids=prompt_ids,
             frames=win.frames,
+            frame_fps=win.frame_fps,
             sampling=sampling,
             on_complete=on_complete,
         )

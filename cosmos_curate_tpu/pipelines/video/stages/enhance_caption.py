@@ -12,7 +12,6 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask
 from cosmos_curate_tpu.models.prompts import ENHANCE_PROMPT
-from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 
@@ -36,7 +35,6 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         if self.max_new_tokens >= self._model.cfg.max_seq // 2:
             self.max_new_tokens = self._model.cfg.max_seq // 2
-        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:
@@ -58,10 +56,14 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                         continue
                     rid = f"{clip.uuid}-{i}"
                     windows[rid] = win
+                    pre, ids = self._model.encode_prompt(
+                        ENHANCE_PROMPT + text, has_vision=False
+                    )
                     engine.add_request(
                         CaptionRequest(
                             request_id=rid,
-                            prompt_ids=self.tokenizer.encode(ENHANCE_PROMPT + text),
+                            prefix_ids=pre,
+                            prompt_ids=ids,
                             sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
                         )
                     )

@@ -15,7 +15,6 @@ import numpy as np
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask
-from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 from cosmos_curate_tpu.utils.logging import get_logger
@@ -74,7 +73,6 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         self.max_new_tokens = max_new_tokens
         self.frames_per_event = frames_per_event
-        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:
@@ -111,10 +109,12 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                         out_size=self._model.cfg.vision.image_size,
                     )
                     targets[rid] = (clip, k)
+                    pre, ids = self._model.encode_prompt(EVENT_PROMPT, has_vision=True)
                     engine.add_request(
                         CaptionRequest(
                             request_id=rid,
-                            prompt_ids=self.tokenizer.encode(EVENT_PROMPT),
+                            prefix_ids=pre,
+                            prompt_ids=ids,
                             frames=crops,
                             sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
                         )

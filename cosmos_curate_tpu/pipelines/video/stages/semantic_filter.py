@@ -16,7 +16,6 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import FrameExtractionSignature, SplitPipeTask
 from cosmos_curate_tpu.models.prompts import SEMANTIC_FILTER_PROMPTS
-from cosmos_curate_tpu.models.tokenizer import default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm import CaptionRequest, SamplingConfig, VLM_BASE, VLMConfig
 from cosmos_curate_tpu.pipelines.video.stages.captioning import _CaptionVLM
 
@@ -55,7 +54,6 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         )
 
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
-        self.tokenizer = default_caption_tokenizer()
 
     @property
     def model(self) -> ModelInterface:
@@ -77,11 +75,14 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
                     continue
                 idx = np.linspace(0, frames.shape[0] - 1, self.num_frames).round().astype(int)
                 targets[str(clip.uuid)] = clip
+                pre, ids = self._model.encode_prompt(self.prompt, has_vision=True)
                 engine.add_request(
                     CaptionRequest(
                         request_id=str(clip.uuid),
-                        prompt_ids=self.tokenizer.encode(self.prompt),
+                        prefix_ids=pre,
+                        prompt_ids=ids,
                         frames=frames[idx],
+                        frame_fps=self.num_frames / max(clip.duration_s, 1e-6),
                         sampling=SamplingConfig(max_new_tokens=8),
                     )
                 )

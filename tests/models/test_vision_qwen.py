@@ -418,3 +418,92 @@ class TestQwen25FullParity:
             jnp.full((1,), total, jnp.int32),
         )
         np.testing.assert_allclose(np.asarray(logits[0]), want, atol=7e-4, rtol=1e-3)
+
+
+class TestMRopeTemporalScaling:
+    """Qwen2.5-VL scales the temporal m-rope component to absolute time
+    (ADVICE r3): parity of build_mrope_positions(t_scale) with HF
+    Qwen2_5_VLModel.get_rope_index on a video prompt."""
+
+    # integer seconds-per-grid only: transformers 4.57 casts
+    # second_per_grid_t to the int64 range dtype before multiplying
+    # (truncating 0.5 -> 0) — a regression vs the original Qwen float
+    # computation ("interval = tokens_per_second * temporal_patch_size /
+    # fps ... 25 * 2 / 1 = 50", HF docstring). We implement the float
+    # semantics (floor applied at the END, test below), so HF parity can
+    # only be asserted where both agree.
+    @pytest.mark.parametrize("second_per_grid_t", [1.0, 2.0, 5.0])
+    def test_video_positions_match_hf(self, second_per_grid_t):
+        import torch
+        from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+            Qwen2_5_VLConfig,
+        )
+        from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+            Qwen2_5_VLModel,
+        )
+
+        from cosmos_curate_tpu.models.vlm.model import build_mrope_positions
+
+        cfg = Qwen2_5_VLConfig(
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=1,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=160,
+            vision_start_token_id=123,
+            image_token_id=125,
+            video_token_id=126,
+            vision_config=dict(
+                depth=1,
+                hidden_size=16,
+                intermediate_size=32,
+                num_heads=2,
+                patch_size=8,
+                spatial_merge_size=2,
+                tokens_per_second=2.0,
+                out_hidden_size=32,
+            ),
+            rope_scaling={"type": "mrope", "mrope_section": [2, 1, 1]},
+        )
+        hf = Qwen2_5_VLModel(cfg)
+        gt, gh, gw = 3, 4, 4  # pre-merge grid
+        mh, mw = gh // 2, gw // 2
+        n_vis = gt * mh * mw
+        n_before, n_after = 4, 3
+        input_ids = torch.tensor(
+            [[*range(10, 10 + n_before - 1), 123, *([126] * n_vis), *range(40, 40 + n_after)]]
+        )
+        pos, _ = hf.get_rope_index(
+            input_ids=input_ids,
+            image_grid_thw=None,
+            video_grid_thw=torch.tensor([[gt, gh, gw]]),
+            second_per_grid_ts=torch.tensor([second_per_grid_t]),
+            attention_mask=torch.ones_like(input_ids),
+        )
+        want = pos[:, 0].numpy().T  # [T, 3]
+
+        t_scale = 2.0 * second_per_grid_t
+        ours, next_pos = build_mrope_positions(n_before, (gt, mh, mw), n_after, t_scale)
+        np.testing.assert_array_equal(ours, want)
+        assert next_pos == want.max() + 1
+
+    def test_fractional_scale_floors_at_the_end(self):
+        from cosmos_curate_tpu.models.vlm.model import build_mrope_positions
+
+        # t_scale 1.5 over grid_t=3: temporal ids floor(0,1.5,3.0)=0,1,3
+        # (the original Qwen float semantics; HF 4.57's int cast would
+        # give 0,1,2)
+        ours, next_pos = build_mrope_positions(2, (3, 1, 1), 1, 1.5)
+        assert list(ours[2:5, 0]) == [2, 3, 5]
+        assert list(ours[2:5, 1]) == [2, 2, 2]
+        # text resumes at abs-t-max 5 + 1 = 6; one trailing token -> 7
+        assert next_pos == 7
+
+    def test_unit_scale_matches_qwen2_behavior(self):
+        from cosmos_curate_tpu.models.vlm.model import build_mrope_positions
+
+        a, na = build_mrope_positions(3, (2, 2, 2), 4)
+        b, nb = build_mrope_positions(3, (2, 2, 2), 4, 1.0)
+        np.testing.assert_array_equal(a, b)
+        assert na == nb
