@@ -43,7 +43,7 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--aesthetic-threshold", type=float, default=None)
     split.add_argument(
         "--embedding-model",
-        choices=["", "clip", "video", "video-512", "video-256"],
+        choices=["", "clip", "video", "video-512", "video-256", "iv2", "iv2-tiny-test"],
         default="",
     )
     split.add_argument("--captioning", action="store_true")

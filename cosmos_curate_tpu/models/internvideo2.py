@@ -1,0 +1,368 @@
+"""InternVideo2 video embedder — the reference's flagship embedding model.
+
+Equivalent capability of the reference's InternVideo2 stage-2 video tower
+(cosmos_curate/models/internvideo2_mm.py:334 `get_vid_feat` over the
+vendored `PretrainInternVideo2`,
+models/internvideo2_multi_modality/internvideo2/internvideo2.py:390): a
+deep ViT over 3D tubelet patches with RMSNorm blocks, QK-normalization and
+LayerScale, an attentive-pooling projector, and the multimodal
+`vision_proj` head producing the l2-normalized 512-d contrastive embedding
+the splitting pipeline stores per clip (dedup + shard consume it).
+
+TPU-first re-design of the same architecture:
+
+- the Conv3d patchify is a single dense matmul over host-reshaped tubelet
+  patches (MXU-shaped, no conv lowering),
+- attention stays head-grouped with fp32 softmax; the whole stack runs in
+  a configurable compute dtype (bf16 on chip),
+- the 3D sincos position table is bound as a parameter, so a converted
+  checkpoint's (possibly temporally-interpolated) table loads verbatim,
+- inference = `jit`ted pure function over a static [B, T, S, S, 3] shape;
+  one compiled program per clip-batch bucket.
+
+The training-only branches of the reference tower (masked-token path,
+CLIP-teacher decoders `clip_decoder`/`final_clip_decoder`, the separate
+`clip_pos_embed` table that only feeds those decoders) are deliberately
+absent: `get_vid_feat` never uses them at inference. The converter
+(convert_iv2.py) maps a real stage-2 checkpoint's remaining tensors 1:1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models.layers import dense
+
+
+@dataclass(frozen=True)
+class IV2Config:
+    img_size: int = 224
+    patch_size: int = 14
+    tubelet_size: int = 1
+    num_frames: int = 8
+    embed_dim: int = 1408
+    depth: int = 40
+    num_heads: int = 16
+    mlp_ratio: float = 48 / 11
+    qkv_bias: bool = False
+    qk_normalization: bool = True
+    # LayerScale init (checkpoint values load over it)
+    init_values: float = 1e-5
+    attn_pool_num_heads: int = 16
+    clip_embed_dim: int = 768
+    # the multimodal head's contrastive dim (internvideo2_mm "embed_dim")
+    proj_dim: int = 512
+    rms_eps: float = 1e-6
+    ln_eps: float = 1e-5
+
+    @property
+    def grid(self) -> tuple[int, int, int]:
+        hw = self.img_size // self.patch_size
+        return (self.num_frames // self.tubelet_size, hw, hw)
+
+    @property
+    def num_patches(self) -> int:
+        gt, gh, gw = self.grid
+        return gt * gh * gw
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.tubelet_size * self.patch_size * self.patch_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+# InternVideo2-1B stage2 (internvideo2.py:696 pretrain_internvideo2_1b_
+# patch14_224 + internvideo2_mm_config_model.json: clip_embed_dim 768,
+# mm embed_dim 512)
+IV2_1B = IV2Config()
+IV2_TINY_TEST = IV2Config(
+    img_size=28,
+    patch_size=14,
+    num_frames=2,
+    embed_dim=32,
+    depth=2,
+    num_heads=4,
+    mlp_ratio=2.0,
+    attn_pool_num_heads=4,
+    clip_embed_dim=16,
+    proj_dim=8,
+)
+
+# ImageNet normalization (internvideo2_mm.py:378)
+IV2_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IV2_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def sincos_1d(dim: int, positions: np.ndarray) -> np.ndarray:
+    """Standard 1D sincos table (even dim): [len(positions), dim]."""
+    omega = 1.0 / (10000 ** (np.arange(dim // 2, dtype=np.float64) / (dim / 2.0)))
+    out = np.einsum("p,d->pd", positions.astype(np.float64), omega)
+    return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+
+def sincos_3d_pos_embed(dim: int, grid: tuple[int, int, int]) -> np.ndarray:
+    """3D sincos position table with cls row, matching the reference's
+    `get_3d_sincos_pos_embed` split (pos_embed.py): dim//4 temporal +
+    3*dim//4 spatial (2D sincos over h/w halves)."""
+    gt, gh, gw = grid
+    dim_t = dim // 4
+    dim_s = dim - dim_t  # 2D part
+    # 2D sincos, h-major row order. Reference quirk (pos_embed.py:40
+    # "here w goes first"): the FIRST spatial half encodes the w
+    # coordinate, the second the h coordinate.
+    hh, ww = np.meshgrid(np.arange(gh), np.arange(gw), indexing="ij")
+    emb_w = sincos_1d(dim_s // 2, ww.reshape(-1))
+    emb_h = sincos_1d(dim_s // 2, hh.reshape(-1))
+    spatial = np.concatenate([emb_w, emb_h], axis=1)  # [gh*gw, dim_s]
+    temporal = sincos_1d(dim_t, np.arange(gt))  # [gt, dim_t]
+    spatial = np.tile(spatial[None], (gt, 1, 1)).reshape(gt * gh * gw, dim_s)
+    temporal = np.repeat(temporal, gh * gw, axis=0)
+    table = np.concatenate([temporal, spatial], axis=1)
+    return np.concatenate([np.zeros((1, dim)), table], axis=0).astype(np.float32)
+
+
+def frames_to_tubelets(frames: jnp.ndarray, cfg: IV2Config) -> jnp.ndarray:
+    """uint8/float [B, T, H, W, 3] -> [B, num_patches, patch_dim] tubelet
+    vectors in (c, kt, kh, kw) element order — the flatten order of the
+    reference Conv3d's weight, so the converter's kernel reshape is exact.
+    Grid order is (t, h, w) row-major, matching the tower's
+    `flatten(3).permute` token order."""
+    b = frames.shape[0]
+    gt, gh, gw = cfg.grid
+    tub, p = cfg.tubelet_size, cfg.patch_size
+    x = frames.astype(jnp.float32) / 255.0
+    x = (x - IV2_MEAN) / IV2_STD
+    x = x.reshape(b, gt, tub, gh, p, gw, p, 3)
+    x = x.transpose(0, 1, 3, 5, 7, 2, 4, 6)  # [B, gt, gh, gw, c, tub, ph, pw]
+    return x.reshape(b, cfg.num_patches, cfg.patch_dim)
+
+
+class IV2RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(x.dtype)
+
+
+class IV2Block(nn.Module):
+    cfg: IV2Config
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, n, c = x.shape
+        h, d = cfg.num_heads, cfg.head_dim
+
+        y = IV2RMSNorm(eps=cfg.rms_eps, name="ln1")(x)
+        qkv = dense(3 * c, "out", name="qkv", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
+        qkv = qkv.reshape(b, n, 3, h, d)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.qk_normalization:
+            # the reference normalizes q/k over the FULL flattened head dim
+            # (internvideo2.py:219), not per head
+            q = IV2RMSNorm(eps=cfg.rms_eps, name="q_norm")(q.reshape(b, n, c)).reshape(b, n, h, d)
+            k = IV2RMSNorm(eps=cfg.rms_eps, name="k_norm")(k.reshape(b, n, c)).reshape(b, n, h, d)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (d**-0.5)
+        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, n, c)
+        attn = dense(c, "in", name="attn_out", use_bias=True, dtype=self.dtype)(attn)
+        ls1 = self.param(
+            "ls1", nn.initializers.constant(cfg.init_values), (c,), jnp.float32
+        )
+        x = x + (attn.astype(jnp.float32) * ls1).astype(x.dtype)
+
+        y = IV2RMSNorm(eps=cfg.rms_eps, name="ln2")(x)
+        hidden = int(c * cfg.mlp_ratio)
+        y = dense(hidden, "out", name="fc1", use_bias=True, dtype=self.dtype)(y)
+        y = nn.gelu(y, approximate=False)  # torch nn.GELU default: exact erf
+        y = dense(c, "in", name="fc2", use_bias=True, dtype=self.dtype)(y)
+        ls2 = self.param(
+            "ls2", nn.initializers.constant(cfg.init_values), (c,), jnp.float32
+        )
+        return x + (y.astype(jnp.float32) * ls2).astype(x.dtype)
+
+
+class IV2AttentionPool(nn.Module):
+    """The reference `AttentionPoolingBlock` (internvideo2.py:146): mean
+    query cross-attends the token sequence; output projected to
+    clip_embed_dim."""
+
+    cfg: IV2Config
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, n, c = x.shape
+        h = cfg.attn_pool_num_heads
+        d = c // h
+        q_in = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_q", dtype=jnp.float32)(
+            x.mean(axis=1, keepdims=True).astype(jnp.float32)
+        )
+        k_in = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_k", dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        v_in = nn.LayerNorm(epsilon=cfg.ln_eps, name="ln_v", dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        q = dense(c, None, name="q", use_bias=True, dtype=self.dtype)(q_in.astype(self.dtype))
+        k = dense(c, None, name="k", use_bias=True, dtype=self.dtype)(k_in.astype(self.dtype))
+        v = dense(c, None, name="v", use_bias=True, dtype=self.dtype)(v_in.astype(self.dtype))
+        q = q.reshape(b, 1, h, d)
+        k = k.reshape(b, n, h, d)
+        v = v.reshape(b, n, h, d)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (d**-0.5)
+        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+        pooled = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, 1, c)
+        out = dense(cfg.clip_embed_dim, None, name="out", use_bias=True, dtype=self.dtype)(pooled)
+        return out[:, 0]
+
+
+class InternVideo2Tower(nn.Module):
+    """Frames -> l2-normalized [B, proj_dim] contrastive video embedding
+    (the `get_vid_feat` path: tower -> attentive pool -> vision_proj ->
+    normalize)."""
+
+    cfg: IV2Config
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, frames_u8):
+        cfg = self.cfg
+        patches = frames_to_tubelets(frames_u8, cfg)
+        x = dense(
+            cfg.embed_dim, None, name="patch_proj", use_bias=True, dtype=self.dtype
+        )(patches.astype(self.dtype))
+        b = x.shape[0]
+        cls = self.param(
+            "cls", nn.initializers.normal(0.02), (1, 1, cfg.embed_dim), jnp.float32
+        )
+        pos = self.param(
+            "pos_embed",
+            lambda _rng: jnp.asarray(
+                sincos_3d_pos_embed(cfg.embed_dim, cfg.grid)[None]
+            ),
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, cfg.embed_dim)).astype(self.dtype), x], axis=1)
+        x = (x.astype(jnp.float32) + pos).astype(self.dtype)
+        for i in range(cfg.depth):
+            x = IV2Block(cfg, dtype=self.dtype, name=f"block_{i}")(x)
+        pooled = IV2AttentionPool(cfg, dtype=self.dtype, name="pool")(x)
+        proj = dense(cfg.proj_dim, None, name="vision_proj", use_bias=True, dtype=jnp.float32)(
+            pooled.astype(jnp.float32)
+        )
+        return proj / (jnp.linalg.norm(proj, axis=-1, keepdims=True) + 1e-12)
+
+
+# Embedding-stage variants: name -> (config, weight-registry id,
+# require staged weights). The 1B flavor refuses random-init (a user
+# asking for InternVideo2 embeddings must not silently get noise).
+IV2_VARIANTS: dict[str, tuple[IV2Config, str, bool]] = {
+    "iv2": (IV2_1B, "internvideo2-1b-tpu", True),
+    "iv2-tiny-test": (IV2_TINY_TEST, "internvideo2-tiny-test", False),
+}
+
+_APPLY_CACHE: dict[tuple, object] = {}
+
+
+def _jitted_apply(cfg: IV2Config, dtype):
+    """One compiled apply per (config, dtype) — shared across stage
+    instances so warmup survives stage construction."""
+    key = (cfg, str(dtype))
+    fn = _APPLY_CACHE.get(key)
+    if fn is None:
+        model = InternVideo2Tower(cfg, dtype=dtype)
+        fn = jax.jit(model.apply)
+        _APPLY_CACHE[key] = fn
+    return fn
+
+
+class IV2Embedder(ModelInterface):
+    """ModelInterface wrapper serving the embedding stage
+    (same surface as VideoEmbedder: sample_frame_indices / encode_clips /
+    embedding_dim). Mirrors the reference's inference flow
+    (internvideo2_mm.py:396 `_construct_frames`: stride-sample num_frames,
+    cv2-resize to img_size, normalize, one batched forward)."""
+
+    MODEL_ID = "internvideo2-1b-tpu"
+
+    def __init__(self, cfg: IV2Config = IV2_1B, *, model_id: str | None = None,
+                 require_weights: bool = False, dtype=jnp.bfloat16) -> None:
+        self.cfg = cfg
+        self.model_id = model_id or self.MODEL_ID
+        self.require_weights = require_weights
+        self.dtype = dtype
+        self._apply = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.model_id]
+
+    @property
+    def embedding_dim(self) -> int:
+        return self.cfg.proj_dim
+
+    def setup(self) -> None:
+        from cosmos_curate_tpu.models import registry
+
+        model = InternVideo2Tower(self.cfg, dtype=self.dtype)
+
+        def init(seed: int):
+            s = self.cfg.img_size
+            dummy = jnp.zeros((1, self.cfg.num_frames, s, s, 3), jnp.uint8)
+            return model.init(jax.random.PRNGKey(seed), dummy)
+
+        self._params = registry.load_params(
+            self.model_id, init, require=self.require_weights
+        )
+        self._apply = _jitted_apply(self.cfg, self.dtype)
+
+    def sample_frame_indices(self, total: int) -> np.ndarray:
+        """Uniform temporal sampling to cfg.num_frames (the reference
+        strides then truncates; linspace covers the same span without
+        dropping the tail on non-divisible counts)."""
+        n = self.cfg.num_frames
+        if total <= 0:
+            return np.zeros(0, np.int64)
+        return np.linspace(0, max(total - 1, 0), n).round().astype(np.int64)
+
+    def _resize(self, clips: np.ndarray) -> np.ndarray:
+        s = self.cfg.img_size
+        if clips.shape[2] == s and clips.shape[3] == s:
+            return clips
+        import cv2
+
+        b, t = clips.shape[:2]
+        out = np.empty((b, t, s, s, 3), np.uint8)
+        for i in range(b):
+            for j in range(t):
+                out[i, j] = cv2.resize(clips[i, j], (s, s), interpolation=cv2.INTER_AREA)
+        return out
+
+    def encode_clips(self, clips_frames: np.ndarray) -> np.ndarray:
+        """uint8 [B, T, H, W, 3] -> float32 [B, proj_dim] l2-normalized."""
+        if self._apply is None:
+            raise RuntimeError("call setup() first")
+        from cosmos_curate_tpu.models.batching import pad_batch
+
+        padded, n = pad_batch(self._resize(clips_frames))
+        return np.asarray(self._apply(self._params, padded))[:n].astype(np.float32)

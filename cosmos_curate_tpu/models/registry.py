@@ -61,6 +61,8 @@ for _mid, _desc in [
     ("clip-vit-b16-tpu", "CLIP ViT-B/16 image embedder (Flax)"),
     ("aesthetics-mlp-tpu", "aesthetic score head over CLIP embeddings"),
     ("video-embed-tpu", "temporal-transformer video embedder"),
+    ("internvideo2-1b-tpu", "InternVideo2-1B stage2 video embedder (converted checkpoint slot)"),
+    ("internvideo2-tiny-test", "InternVideo2 tiny test config"),
     ("caption-vlm-tpu", "vision-language captioning model (Flax)"),
     ("caption-qwen2vl-2b-tpu", "Qwen2-VL-2B-class captioner (converted checkpoint slot)"),
     ("caption-qwen25vl-7b-tpu", "Qwen2.5-VL-7B/CosmosReason-class captioner (converted checkpoint slot)"),
@@ -251,9 +253,22 @@ def load_params(
 
         logger.info("loading %s weights from %s", model_id, ckpt)
         template = init_fn(seed)
+        data = ckpt.read_bytes()
         try:
-            return flax.serialization.from_bytes(template, ckpt.read_bytes())
-        except (ValueError, KeyError, TypeError) as e:
+            # canonical format: UNBOXED raw arrays (what converters emit
+            # and save_params writes); sharding metadata is re-attached
+            # from the init template so pjit layouts survive the roundtrip
+            restored = flax.serialization.from_bytes(_unbox_tree(template), data)
+            return _rebox_like(template, restored)
+        except (ValueError, KeyError, TypeError) as unboxed_err:
+            # legacy format: checkpoints written before the unboxed
+            # canonicalization serialized Partitioned leaves as
+            # {'value': ...} state dicts — restore against the boxed
+            # template keeps them loadable
+            try:
+                return flax.serialization.from_bytes(template, data)
+            except (ValueError, KeyError, TypeError):
+                e = unboxed_err  # report the canonical-format error
             if require:
                 raise RuntimeError(
                     f"staged weights at {ckpt} do not match {model_id}'s "
@@ -288,9 +303,42 @@ def save_params(model_id: str, params: Any, *, root: Path | str | None = None) -
     base = Path(root) if root is not None else weights_root()
     ckpt = base / model_id / "params.msgpack"
     ckpt.parent.mkdir(parents=True, exist_ok=True)
+    # Canonical checkpoint format: unboxed raw arrays. Partitioned sharding
+    # boxes are process-local compile metadata, not weights — converters
+    # emit raw arrays and load_params re-boxes from the init template.
     # Atomic publish: a trainer killed mid-write (watcher timeouts) must not
     # leave a truncated params.msgpack that later passes exists() checks.
     tmp = ckpt.with_name(ckpt.name + ".tmp")
-    tmp.write_bytes(flax.serialization.to_bytes(params))
+    tmp.write_bytes(flax.serialization.to_bytes(_unbox_tree(params)))
     tmp.replace(ckpt)
     return ckpt
+
+
+def _unbox_tree(tree: Any) -> Any:
+    """Strip flax AxisMetadata boxes (nn.Partitioned) down to raw arrays."""
+    import jax
+    from flax import linen as fnn
+
+    return jax.tree_util.tree_map(
+        lambda x: x.unbox() if isinstance(x, fnn.Partitioned) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, fnn.Partitioned),
+    )
+
+
+def _rebox_like(template: Any, values: Any) -> Any:
+    """Wrap restored raw arrays back into the template's Partitioned boxes
+    (positional zip over the flattened trees; structures match because the
+    unboxed template produced the restore target)."""
+    import jax
+    from flax import linen as fnn
+
+    t_leaves, treedef = jax.tree_util.tree_flatten(
+        template, is_leaf=lambda x: isinstance(x, fnn.Partitioned)
+    )
+    v_leaves = jax.tree_util.tree_leaves(values)
+    out = [
+        t.replace_boxed(v) if isinstance(t, fnn.Partitioned) else v
+        for t, v in zip(t_leaves, v_leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)

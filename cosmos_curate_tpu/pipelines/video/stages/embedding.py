@@ -38,17 +38,19 @@ class ClipEmbeddingStage(Stage[SplitPipeTask, SplitPipeTask]):
         extraction: FrameExtractionSignature = FrameExtractionSignature("fps", 2.0),
     ) -> None:
         from cosmos_curate_tpu.models.embedder import VIDEO_EMBED_VARIANTS
+        from cosmos_curate_tpu.models.internvideo2 import IV2_VARIANTS, IV2Embedder
 
-        if variant != "clip" and variant not in VIDEO_EMBED_VARIANTS:
-            raise ValueError(
-                f"unknown embedding variant {variant!r}; have "
-                f"{['clip', *VIDEO_EMBED_VARIANTS]}"
-            )
+        known = ["clip", *VIDEO_EMBED_VARIANTS, *IV2_VARIANTS]
+        if variant not in known:
+            raise ValueError(f"unknown embedding variant {variant!r}; have {known}")
         self.variant = "clip" if variant == "clip" else "video"
         self.extraction = extraction
         self._model: ModelInterface
         if variant == "clip":
             self._model = CLIPImageEmbeddings(clip_variant)
+        elif variant in IV2_VARIANTS:
+            cfg, model_id, require = IV2_VARIANTS[variant]
+            self._model = IV2Embedder(cfg, model_id=model_id, require_weights=require)
         elif video_cfg is not None:
             self._model = VideoEmbedder(video_cfg)
         else:

@@ -1,0 +1,188 @@
+"""InternVideo2 Flax tower parity vs the reference's vendored PyTorch
+implementation (tiny config, CPU, no downloads).
+
+The oracle is the reference checkout's own vendored
+`PretrainInternVideo2` (cosmos_curate/models/internvideo2_multi_modality/
+internvideo2/internvideo2.py) — the exact architecture a real 1B stage-2
+checkpoint loads into — imported read-only with a minimal `timm.layers`
+shim (this image lacks timm; only DropPath/to_2tuple/trunc_normal_ are
+used, all with torch equivalents). Skipped when the reference checkout is
+unavailable."""
+
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+_REF = Path("/root/reference")
+if not (_REF / "cosmos_curate/models/internvideo2_multi_modality").exists():
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+
+def _load_vendored():
+    if "timm" not in sys.modules:
+        timm = types.ModuleType("timm")
+        layers = types.ModuleType("timm.layers")
+        layers.DropPath = torch.nn.Identity
+        layers.to_2tuple = lambda x: x if isinstance(x, tuple) else (x, x)
+        layers.trunc_normal_ = torch.nn.init.trunc_normal_
+        timm.layers = layers
+        sys.modules["timm"] = timm
+        sys.modules["timm.layers"] = layers
+    if str(_REF) not in sys.path:
+        sys.path.insert(0, str(_REF))
+    from cosmos_curate.models.internvideo2_multi_modality.internvideo2.internvideo2 import (
+        PretrainInternVideo2,
+    )
+
+    return PretrainInternVideo2
+
+
+from cosmos_curate_tpu.models.convert_iv2 import convert_internvideo2
+from cosmos_curate_tpu.models.internvideo2 import (
+    IV2_MEAN,
+    IV2_STD,
+    IV2_TINY_TEST,
+    InternVideo2Tower,
+    sincos_3d_pos_embed,
+)
+
+
+@pytest.fixture(scope="module")
+def pair():
+    PretrainInternVideo2 = _load_vendored()
+    cfg = IV2_TINY_TEST
+    torch.manual_seed(7)
+    ref = PretrainInternVideo2(
+        img_size=cfg.img_size,
+        patch_size=cfg.patch_size,
+        embed_dim=cfg.embed_dim,
+        depth=cfg.depth,
+        num_heads=cfg.num_heads,
+        mlp_ratio=cfg.mlp_ratio,
+        qkv_bias=cfg.qkv_bias,
+        qk_normalization=cfg.qk_normalization,
+        init_values=cfg.init_values,
+        attn_pool_num_heads=cfg.attn_pool_num_heads,
+        clip_embed_dim=cfg.clip_embed_dim,
+        num_frames=cfg.num_frames,
+        tubelet_size=cfg.tubelet_size,
+        clip_teacher_embed_dim=12,
+        clip_teacher_final_dim=8,
+        clip_return_layer=1,
+        drop_path_rate=0.0,
+    ).eval()
+    vision_proj = torch.nn.Linear(cfg.clip_embed_dim, cfg.proj_dim)
+    # randomize the degenerate inits (LayerScale=1e-5, RMSNorm=1) so a
+    # transposition/misrouting bug cannot hide behind near-zero weights
+    gen = torch.Generator().manual_seed(11)
+    with torch.no_grad():
+        for name, p in ref.named_parameters():
+            if any(s in name for s in ("ls1", "ls2", "norm", "cls_token")):
+                p.copy_(torch.rand(p.shape, generator=gen) * 0.5 + 0.25)
+    sd = {**ref.state_dict(), **{f"vision_proj.{k}": v for k, v in vision_proj.state_dict().items()}}
+    params, report = convert_internvideo2(sd, cfg)
+    return ref, vision_proj, params, report, cfg
+
+
+class TestConversion:
+    def test_everything_inference_relevant_is_mapped(self, pair):
+        _, _, _, report, _ = pair
+        assert not report.unmapped, report.unmapped
+        # skips are exactly the training-only families
+        for k in report.vision_skipped:
+            assert k.startswith(("clip_decoder.", "final_clip_decoder.", "clip_pos_embed")), k
+
+    def test_video_embedding_matches_reference(self, pair):
+        ref, vision_proj, params, _, cfg = pair
+        rng = np.random.default_rng(3)
+        frames = rng.integers(0, 255, (2, cfg.num_frames, cfg.img_size, cfg.img_size, 3), np.uint8)
+        # reference input: processor-normalized [B, 3, T, H, W]
+        x = ((frames.astype(np.float32) / 255.0) - IV2_MEAN) / IV2_STD
+        xt = torch.from_numpy(np.transpose(x, (0, 4, 1, 2, 3)))
+        with torch.no_grad():
+            _, pooled, _, _ = ref(xt)
+            want = vision_proj(pooled)
+            want = (want / want.norm(dim=-1, keepdim=True)).numpy()
+
+        import jax.numpy as jnp
+
+        tower = InternVideo2Tower(cfg)
+        got = np.asarray(tower.apply(params, jnp.asarray(frames)))
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-4)
+        # embeddings are l2-normalized
+        np.testing.assert_allclose(np.linalg.norm(got, axis=-1), 1.0, atol=1e-5)
+
+    def test_pooled_only_checkpoint_reports_missing_proj(self, pair):
+        ref, _, _, _, cfg = pair
+        _, report = convert_internvideo2(ref.state_dict(), cfg)
+        assert any("vision_proj" in u for u in report.unmapped)
+
+
+class TestPosEmbed:
+    def test_sincos_matches_reference_table(self):
+        """Our init table == the reference's get_3d_sincos_pos_embed (used
+        when training from scratch; converted checkpoints overwrite it)."""
+        _load_vendored()
+        from cosmos_curate.models.internvideo2_multi_modality.internvideo2.pos_embed import (
+            get_3d_sincos_pos_embed,
+        )
+
+        cfg = IV2_TINY_TEST
+        gt, gh, gw = cfg.grid
+        want = get_3d_sincos_pos_embed(cfg.embed_dim, gh, gt, cls_token=True)
+        got = sincos_3d_pos_embed(cfg.embed_dim, cfg.grid)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+class TestStageIntegration:
+    def test_embed_stage_runs_iv2_and_loads_converted_checkpoint(
+        self, pair, tmp_path, monkeypatch
+    ):
+        """The embedding stage accepts the converted format end to end:
+        torch state dict -> convert -> registry save -> stage setup picks
+        it up -> per-clip embeddings match the torch oracle."""
+        ref, vision_proj, params, _, cfg = pair
+        monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path))
+        from cosmos_curate_tpu.models import registry
+
+        registry.save_params("internvideo2-tiny-test", params)
+
+        from cosmos_curate_tpu.data.model import Clip, FrameExtractionSignature, SplitPipeTask, Video
+        from cosmos_curate_tpu.pipelines.video.stages.embedding import ClipEmbeddingStage
+
+        sig = FrameExtractionSignature("fps", 2.0)
+        stage = ClipEmbeddingStage(variant="iv2-tiny-test", extraction=sig)
+        stage._model.setup()
+        rng = np.random.default_rng(5)
+        # 6 source frames at 40x40: stage samples num_frames and resizes
+        frames = rng.integers(0, 255, (6, 40, 40, 3), np.uint8)
+        clip = Clip(uuid="c0", source_video="v", span=(0.0, 3.0))
+        clip.extracted_frames[sig.key()] = frames
+        video = Video(path="v")
+        video.clips = [clip]
+        task = SplitPipeTask(video=video)
+        stage.process_data([task])
+        emb = clip.embeddings["internvideo2-tiny-test"]
+        assert emb.shape == (cfg.proj_dim,)
+        np.testing.assert_allclose(np.linalg.norm(emb), 1.0, atol=1e-5)
+
+        # oracle: same sampling + resize through the torch reference
+        import cv2
+        import torch as _torch
+
+        idx = stage._model.sample_frame_indices(6)
+        sampled = np.stack(
+            [cv2.resize(frames[i], (cfg.img_size, cfg.img_size), interpolation=cv2.INTER_AREA) for i in idx]
+        )
+        x = ((sampled.astype(np.float32) / 255.0) - IV2_MEAN) / IV2_STD
+        xt = _torch.from_numpy(np.transpose(x[None], (0, 4, 1, 2, 3)))
+        with _torch.no_grad():
+            _, pooled, _, _ = ref(xt)
+            want = vision_proj(pooled)
+            want = (want / want.norm(dim=-1, keepdim=True)).numpy()[0]
+        np.testing.assert_allclose(emb, want, atol=5e-5, rtol=1e-3)
