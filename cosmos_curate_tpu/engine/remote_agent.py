@@ -37,8 +37,7 @@ from cosmos_curate_tpu.engine.remote_plane import (
     SubmitBatch,
     WorkerDied,
     _token,
-    recv_msg,
-    send_msg,
+    connect_channel,
 )
 from cosmos_curate_tpu.engine.worker import (
     ProcessMsg,
@@ -67,7 +66,6 @@ class NodeAgent:
         # relayed (or the worker dies) so /dev/shm never accumulates
         self.inflight: dict[tuple[str, int], list] = {}
         self.results_q: mp.Queue = _MP.Queue()
-        self._send_lock = threading.Lock()
         self._stop = threading.Event()
 
     def run(self, *, connect_timeout_s: float = 60.0, reconnect: bool = True) -> int:
@@ -122,7 +120,10 @@ class NodeAgent:
                     raise
                 time.sleep(0.5)
         self.sock = sock
-        send_msg(sock, Hello(self.node_id, self.num_cpus), self.token)
+        # mutual-nonce handshake: both sides contribute fresh randomness
+        # to the session id, so no recorded session replays (either
+        # direction) into this one (see SecureChannel/connect_channel)
+        self.chan = connect_channel(sock, self.token, Hello(self.node_id, self.num_cpus))
         logger.info(
             "agent %s joined driver %s:%d (%.0f cpus)",
             self.node_id, self.addr[0], self.addr[1], self.num_cpus,
@@ -135,7 +136,7 @@ class NodeAgent:
         said_bye = False
         try:
             while True:
-                msg = recv_msg(sock, self.token)
+                msg = self.chan.recv()
                 if isinstance(msg, Bye):
                     said_bye = True
                     break
@@ -172,11 +173,29 @@ class NodeAgent:
         return said_bye
 
     def _send(self, msg) -> None:
-        with self._send_lock:
-            send_msg(self.sock, msg, self.token)
+        # SecureChannel serializes sends internally (per-frame sequence)
+        self.chan.send(msg)
 
     def _handle(self, msg) -> None:
         if isinstance(msg, StartWorker):
+            stale = self.workers.pop(msg.worker_key, None)
+            if stale is not None:
+                # a driver retry re-sent StartWorker while the first process
+                # was still setting up: terminate it, or its results would
+                # keep relaying under the same key (and the process leak)
+                logger.warning(
+                    "duplicate StartWorker for %s; terminating the old process",
+                    msg.worker_key,
+                )
+                try:
+                    stale[1].terminate()
+                except Exception:
+                    pass
+                # the watchdog only scans self.workers, so the popped
+                # process's in-flight input segments must be freed here
+                for wkey, batch_id in list(self.inflight):
+                    if wkey == msg.worker_key:
+                        self._release_inflight(wkey, batch_id)
             in_q = _MP.Queue()
             env = dict(msg.env)
             env["CURATE_WORKER_ID"] = msg.worker_key

@@ -110,6 +110,17 @@ class Bye:
     pass
 
 
+@dataclass
+class HelloAck:
+    """Driver's handshake reply: echoes the agent's session nonce (inside
+    the MAC'd frame, so the binding cannot be forged) and contributes the
+    driver's own nonce. The channel session id is the concatenation — BOTH
+    sides contribute fresh randomness, so neither direction of a recorded
+    session can be replayed into a later one."""
+
+    agent_sid: bytes
+
+
 # -- framing ----------------------------------------------------------------
 
 
@@ -165,6 +176,125 @@ def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BY
     return cloudpickle.loads(payload)
 
 
+class SecureChannel:
+    """Replay-bound framing over one connection.
+
+    The HMAC alone authenticates bytes but not freshness or direction: an
+    on-path recorder could replay a StartWorker/SubmitBatch frame verbatim
+    and re-execute its cloudpickle payload. Every frame therefore carries
+    ``(session_id, direction, sequence)`` INSIDE the MAC'd payload: the
+    session id is random per agent connection (a replayed frame from an
+    old session cannot match a new session's id), the per-direction
+    sequence must advance exactly by one (an in-session replay or
+    reordering drops the link), and the direction tag stops reflecting a
+    peer's own frames back at it."""
+
+    A2D = b"a2d"  # agent -> driver
+    D2A = b"d2a"  # driver -> agent
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        token: bytes,
+        sid: bytes,
+        send_dir: bytes,
+        recv_dir: bytes,
+        *,
+        send_seq_start: int = 0,
+        recv_seq_start: int = 0,
+    ) -> None:
+        self.sock = sock
+        self._token = token
+        self.sid = sid
+        self._send_dir = send_dir
+        self._recv_dir = recv_dir
+        self._send_seq = send_seq_start
+        self._recv_seq = recv_seq_start
+        self._lock = threading.Lock()
+
+    def send(self, msg: Any) -> None:
+        with self._lock:
+            send_msg(self.sock, (self.sid, self._send_dir, self._send_seq, msg), self._token)
+            self._send_seq += 1
+
+    def recv(self, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+        frame = recv_msg(self.sock, self._token, max_bytes=max_bytes)
+        sid, direction, seq, msg = _check_frame_tuple(frame)
+        if sid != self.sid:
+            raise ConnectionError("frame from a different session (replay?)")
+        if direction != self._recv_dir:
+            raise ConnectionError("frame direction mismatch (reflection?)")
+        if seq != self._recv_seq:
+            raise ConnectionError(
+                f"frame out of order: got seq {seq}, expected {self._recv_seq} (replay?)"
+            )
+        self._recv_seq += 1
+        return msg
+
+
+def _check_frame_tuple(frame: Any) -> tuple:
+    if (
+        not isinstance(frame, tuple)
+        or len(frame) != 4
+        or not isinstance(frame[0], bytes)
+        or not isinstance(frame[1], bytes)
+        or not isinstance(frame[2], int)
+    ):
+        raise ConnectionError("malformed channel frame")
+    return frame
+
+
+def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", Any]:
+    """Driver side of the handshake: read the agent's bootstrap frame,
+    reply with the driver's own nonce (HelloAck, binding the agent's), and
+    return (channel over the COMBINED session id, hello_msg). A recorded
+    agent session replayed wholesale dies here: the driver's fresh nonce
+    changes the combined id, so every post-handshake replayed frame is
+    rejected."""
+    frame = recv_msg(sock, token)
+    agent_sid, direction, seq, msg = _check_frame_tuple(frame)
+    if direction != SecureChannel.A2D or seq != 0:
+        raise ConnectionError("bad channel bootstrap frame")
+    driver_sid = os.urandom(16)
+    send_msg(sock, (driver_sid, SecureChannel.D2A, 0, HelloAck(agent_sid)), token)
+    chan = SecureChannel(
+        sock,
+        token,
+        agent_sid + driver_sid,
+        SecureChannel.D2A,
+        SecureChannel.A2D,
+        send_seq_start=1,
+        recv_seq_start=1,
+    )
+    return chan, msg
+
+
+def connect_channel(sock: socket.socket, token: bytes, hello: Any) -> "SecureChannel":
+    """Agent side of the handshake: send the bootstrap Hello under a fresh
+    nonce, verify the driver's ack binds it, and return the channel over
+    the combined session id."""
+    agent_sid = os.urandom(16)
+    send_msg(sock, (agent_sid, SecureChannel.A2D, 0, hello), token)
+    frame = recv_msg(sock, token)
+    driver_sid, direction, seq, ack = _check_frame_tuple(frame)
+    if (
+        direction != SecureChannel.D2A
+        or seq != 0
+        or not isinstance(ack, HelloAck)
+        or ack.agent_sid != agent_sid
+    ):
+        raise ConnectionError("bad handshake ack from driver")
+    return SecureChannel(
+        sock,
+        token,
+        agent_sid + driver_sid,
+        SecureChannel.A2D,
+        SecureChannel.D2A,
+        send_seq_start=1,
+        recv_seq_start=1,
+    )
+
+
 # -- driver side ------------------------------------------------------------
 
 
@@ -216,23 +346,22 @@ class AgentLink:
     num_cpus: float
     sock: socket.socket
     token: bytes
+    chan: "SecureChannel | None" = None
     alive: bool = True
     # worker_key -> cpu cost; accounting is in CPU units, matching the
     # autoscaler's per-worker resources.cpus
     worker_costs: dict = field(default_factory=dict)
     dead_workers: set = field(default_factory=set)
-    _send_lock: threading.Lock = field(default_factory=threading.Lock)
 
     @property
     def cpus_used(self) -> float:
         return sum(self.worker_costs.values())
 
     def send(self, msg: Any) -> None:
-        if self.sock is None:
+        if self.chan is None:
             return
         try:
-            with self._send_lock:
-                send_msg(self.sock, msg, self.token)
+            self.chan.send(msg)
         except OSError:
             self.alive = False
 
@@ -333,7 +462,7 @@ class RemoteWorkerManager:
 
     def _serve_agent(self, sock: socket.socket, addr) -> None:
         try:
-            hello = recv_msg(sock, self.token)
+            chan, hello = accept_channel(sock, self.token)
         except (ConnectionError, OSError) as e:
             logger.warning("rejected agent connection from %s: %s", addr, e)
             sock.close()
@@ -341,7 +470,7 @@ class RemoteWorkerManager:
         if not isinstance(hello, Hello):
             sock.close()
             return
-        link = AgentLink(hello.node_id, hello.num_cpus, sock, self.token)
+        link = AgentLink(hello.node_id, hello.num_cpus, sock, self.token, chan=chan)
         with self._lock:
             self.agents.append(link)
         logger.info(
@@ -349,7 +478,7 @@ class RemoteWorkerManager:
         )
         try:
             while True:
-                msg = recv_msg(sock, self.token)
+                msg = chan.recv()
                 self._on_agent_msg(link, msg)
         except (ConnectionError, OSError):
             link.alive = False

@@ -39,6 +39,17 @@ class _Batch:
     stage_idx: int
     refs: list[object_store.ObjectRef]
     attempts: int = 0
+    # worker/node deaths are infrastructure failures, budgeted separately
+    # from user-code exceptions (the reference's num_run_attempts_python
+    # counts exceptions only, stage_interface.py:197; Ray reschedules on
+    # actor death). A cap still bounds poison batches that kill workers.
+    worker_deaths: int = 0
+
+
+# A batch survives this many worker/node deaths before being dropped
+# (poison-batch guard: e.g. an input that OOM-kills every worker that
+# touches it must not respawn workers forever).
+MAX_WORKER_DEATHS_PER_BATCH = 3
 
 
 @dataclass
@@ -363,10 +374,15 @@ class StreamingRunner(RunnerInterface):
                             )
                     if w.busy_batch is not None and w.busy_batch in batches:
                         batch = batches.pop(w.busy_batch)
-                        batch.attempts += 1
-                        if batch.attempts < max(1, st.spec.num_run_attempts):
+                        batch.worker_deaths += 1
+                        if batch.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
                             st.retry_queue.append(batch)
                         else:
+                            logger.error(
+                                "stage %s batch %d dropped: %d workers died "
+                                "processing it (poison batch?)",
+                                st.spec.name, batch.batch_id, batch.worker_deaths,
+                            )
                             st.errored_batches += 1
                             for r in batch.refs:
                                 store.release(r)

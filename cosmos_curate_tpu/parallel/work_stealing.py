@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Callable, Sequence
 
@@ -97,6 +98,30 @@ def claim_next_batch(
     return claimed
 
 
+def _heartbeat_claims(
+    output_path: str, rids: list[str], ttl_s: float, stop: threading.Event
+) -> None:
+    """Re-write the claim records for ``rids`` every ttl/3 until stopped.
+    Failures are logged and retried next period — a flaky beat at worst
+    allows a duplicate, which the ledger's semantics already tolerate."""
+    from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
+    from cosmos_curate_tpu.storage.client import get_storage_client
+
+    rank, _ = node_rank_and_count()
+    client = get_storage_client(output_path)
+    root = f"{output_path.rstrip('/')}/work_claims"
+    period = max(1.0, ttl_s / 3.0)
+    while not stop.wait(period):
+        for rid in rids:
+            try:
+                client.write_bytes(
+                    f"{root}/{rid}.json",
+                    json.dumps({"rank": rank, "ts": time.time()}).encode(),
+                )
+            except Exception:
+                logger.exception("claim heartbeat failed for %s", rid)
+
+
 def run_with_stealing(
     tasks: Sequence,
     output_path: str,
@@ -134,7 +159,23 @@ def run_with_stealing(
             remaining, output_path, record_id=record_id, batch=size, ttl_s=ttl_s
         )
         if got:
-            out += run_batch(got) or []
+            # heartbeat while the batch runs: an adaptive batch can hold
+            # tasks serially for longer than the TTL, and a claim written
+            # once would expire mid-run — a peer would take over and
+            # duplicate the compute (ADVICE r3). Refreshing the claim
+            # JSONs keeps them fresh for exactly as long as we're alive.
+            stop = threading.Event()
+            beat = threading.Thread(
+                target=_heartbeat_claims,
+                args=(output_path, [record_id(t) for t in got], ttl_s, stop),
+                daemon=True,
+            )
+            beat.start()
+            try:
+                out += run_batch(got) or []
+            finally:
+                stop.set()
+                beat.join(timeout=5)
             claimed_ids = {record_id(t) for t in got}
             remaining = [t for t in remaining if record_id(t) not in claimed_ids]
             continue

@@ -165,6 +165,7 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
     tok = default_caption_tokenizer()
     variants = [args.caption_prompt_variant, *args.extra_caption_variants]
     prompts = {v: get_caption_prompt(v) for v in variants}
+    variant_req: dict[str, tuple[list[int], int]] = {}  # filled once engine exists
     try:
         todo = db.clips(state="split")
         if args.limit:
@@ -213,6 +214,13 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
             if engine is None:
                 engine = CaptionEngine(VLM_BASE, max_batch=8)
                 engine.setup()
+            if not variant_req:
+                # per-variant prompt ids + clamped generation budget are
+                # loop-invariant (windows are padded to exactly w frames):
+                # encode once, not per window
+                for v in variants:
+                    ids = tok.encode(prompts[v])
+                    variant_req[v] = (ids, engine.fit_max_new_tokens(96, ids, n_frames=w))
             for cid, frames in chunk_pending:
                 windows = clip_windows(frames)
                 for variant in variants:
@@ -220,13 +228,17 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
                     sel = windows if variant == variants[0] else windows[:1]
                     for k, win in enumerate(sel):
                         num_windows += 1
+                        # prompt + clamped budget computed once per variant
+                        # (fit_max_new_tokens keeps the vision block from
+                        # being rejected on small-context configs)
+                        ids, max_new = variant_req[variant]
                         engine.add_request(
                             CaptionRequest(
                                 request_id=f"{cid}::{variant}::w{k}",
-                                prompt_ids=tok.encode(prompts[variant]),
+                                prompt_ids=ids,
                                 frames=win,
                                 frame_fps=AV_CAPTION_FPS,
-                                sampling=SamplingConfig(max_new_tokens=96),
+                                sampling=SamplingConfig(max_new_tokens=max_new),
                             )
                         )
             num_captioned += len(chunk_pending)

@@ -242,3 +242,172 @@ class TestRemotePlane:
                 agent.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 agent.kill()
+
+
+@pytest.mark.slow
+class TestAgentDeathMidRun:
+    def test_agent_killed_mid_run_requeues_and_completes(self, monkeypatch):
+        """VERDICT r3 #4: SIGKILL the node agent while its workers hold
+        in-flight batches; the driver's dead-worker reap must requeue them
+        and the pipeline must finish with every task processed exactly
+        once (requeued batches re-run from the stored INPUT, so no task is
+        double-doubled)."""
+        import threading
+
+        port = _free_port()
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "kill-secret")
+        monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", "1")
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+        monkeypatch.setenv("CURATE_PREWARM", "0")
+        env = {
+            **os.environ,
+            "CURATE_ENGINE_TOKEN": "kill-secret",
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+        }
+        agent = subprocess.Popen(
+            [
+                sys.executable, "-m", "cosmos_curate_tpu.engine.remote_agent",
+                "--driver", f"127.0.0.1:{port}", "--node-id", "doomed-agent",
+                "--num-cpus", "2",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        # hard-kill (SIGKILL: no graceful teardown, sockets just drop) a few
+        # seconds in — within the 40 x 0.25s work window, so batches are
+        # guaranteed in flight somewhere
+        killer = threading.Timer(6.0, agent.kill)
+        killer.start()
+        try:
+            from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+            runner = StreamingRunner(poll_interval_s=0.01)
+            n_tasks = 40
+            tasks = [_NodeStampTask(i) for i in range(n_tasks)]
+            spec = PipelineSpec(
+                input_data=tasks,
+                stages=[StageSpec(_StampStage(), num_workers=3)],
+                config=PipelineConfig(
+                    num_cpus=1.0,
+                    return_last_stage_outputs=True,
+                ),
+            )
+            out = runner.run(spec)
+            assert out is not None and len(out) == n_tasks
+            # exactly-once effect: every value doubled once, none lost
+            assert sorted(t.value for t in out) == [i * 2 for i in range(n_tasks)]
+        finally:
+            killer.cancel()
+            if agent.poll() is None:
+                agent.kill()
+            agent.wait(timeout=10)
+
+
+class TestReplayProtection:
+    def test_replayed_frame_drops_the_link(self, monkeypatch):
+        """ADVICE r3: an on-path recorder replaying a captured frame
+        verbatim must not get it re-executed — the per-direction sequence
+        inside the MAC'd payload rejects it."""
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import (
+            Hello,
+            RemoteWorkerManager,
+            SecureChannel,
+            send_msg,
+        )
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "replay-secret")
+        port = _free_port()
+        mgr = RemoteWorkerManager(port, queue.Queue(), local_cpu_budget=1.0)
+        try:
+            token = b"replay-secret"
+            sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+            sid = b"S" * 16
+            frame = (sid, SecureChannel.A2D, 0, Hello("replayer", 2.0))
+            send_msg(sock, frame, token)
+            time.sleep(0.3)
+            assert [a.node_id for a in mgr.agents] == ["replayer"]
+            assert mgr.agents[0].alive
+            # replay the SAME frame (identical bytes an attacker recorded):
+            # seq 0 again -> the driver must drop the link
+            send_msg(sock, frame, token)
+            time.sleep(0.3)
+            assert not mgr.agents[0].alive
+        finally:
+            mgr.shutdown()
+
+    def test_cross_session_replay_rejected_by_agent_sid(self, monkeypatch):
+        """A driver->agent frame recorded in one session cannot be replayed
+        into a later session: the agent's fresh random session id never
+        matches."""
+        from cosmos_curate_tpu.engine.remote_plane import SecureChannel, StartWorker
+
+        import socket as _socket
+
+        a, b = _socket.socketpair()
+        try:
+            token = b"t"
+            old = SecureChannel(a, token, b"old-session-id!!", SecureChannel.D2A, SecureChannel.A2D)
+            old.send(StartWorker("w", b"", b"", {}))
+            new_chan = SecureChannel(
+                b, token, b"new-session-id!!", SecureChannel.A2D, SecureChannel.D2A
+            )
+            with pytest.raises(ConnectionError, match="different session"):
+                new_chan.recv()
+        finally:
+            a.close()
+            b.close()
+
+    def test_full_session_replay_rejected_by_driver_nonce(self, monkeypatch):
+        """A WHOLE recorded agent session replayed to the driver must die at
+        the first post-handshake frame: the driver's fresh nonce changes
+        the combined session id (the phantom-agent result-injection
+        attack)."""
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import (
+            AgentReady,
+            Hello,
+            HelloAck,
+            RemoteWorkerManager,
+            SecureChannel,
+            recv_msg,
+            send_msg,
+        )
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "nonce-secret")
+        token = b"nonce-secret"
+        port = _free_port()
+        results_q = queue.Queue()
+        mgr = RemoteWorkerManager(port, results_q, local_cpu_budget=1.0)
+        try:
+            sid_a = b"A" * 16
+            bootstrap = (sid_a, SecureChannel.A2D, 0, Hello("victim", 2.0))
+
+            # "recorded" session: handshake + one post-handshake frame
+            s1 = socket.create_connection(("127.0.0.1", port), timeout=5)
+            send_msg(s1, bootstrap, token)
+            sid_d1, _, _, ack = recv_msg(s1, token)
+            assert isinstance(ack, HelloAck) and ack.agent_sid == sid_a
+            frame1 = (sid_a + sid_d1, SecureChannel.A2D, 1, AgentReady("w0"))
+            send_msg(s1, frame1, token)
+            time.sleep(0.3)
+            assert results_q.qsize() == 1  # the live session's frame landed
+
+            # replay: same bootstrap bytes, then the RECORDED frame1 — whose
+            # sid embeds the OLD driver nonce
+            s2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+            send_msg(s2, bootstrap, token)
+            recv_msg(s2, token)  # fresh ack (different nonce)
+            send_msg(s2, frame1, token)
+            time.sleep(0.3)
+            # the replayed frame was NOT processed and the phantom is dead
+            assert results_q.qsize() == 1
+            replayed = [a for a in mgr.agents if a.node_id == "victim"][1]
+            assert not replayed.alive
+            s1.close()
+            s2.close()
+        finally:
+            mgr.shutdown()

@@ -116,3 +116,43 @@ class TestStealingEndToEnd:
         merged = merge_node_summaries(str(out))
         assert merged["num_videos"] == 4
         assert merged["num_errors"] == 0
+
+
+class TestClaimHeartbeat:
+    def test_long_run_batch_keeps_claims_fresh(self, tmp_path, monkeypatch):
+        """ADVICE r3: a batch running longer than the TTL must not have its
+        claims expire mid-run — the heartbeat re-writes them, so a peer
+        cannot take over and duplicate the work."""
+        import json
+        import time as _time
+
+        from cosmos_curate_tpu.parallel.work_stealing import (
+            claim_next_batch,
+            run_with_stealing,
+        )
+
+        monkeypatch.setenv("CURATE_NODE_RANK", "0")
+        monkeypatch.setenv("CURATE_NUM_NODES", "1")
+        tasks = ["a", "b"]
+        ttl = 3.0  # heartbeat period = ttl/3 = 1s
+
+        def slow_batch(got):
+            # sleep PAST the ttl: without the heartbeat the original claim
+            # (written once at t0) would be stale here and the rival would
+            # steal — the assertions below only hold if beats happened
+            _time.sleep(4.0)
+            # mid-run, a rival rank trying to steal with the SAME ttl must
+            # find the claims fresh
+            rival = claim_next_batch(
+                got, str(tmp_path), record_id=str, batch=2, rank=1, ttl_s=ttl
+            )
+            assert rival == [], "heartbeat failed: rival stole a running task"
+            return got
+
+        out = run_with_stealing(
+            tasks, str(tmp_path), slow_batch, record_id=str, batch=2, ttl_s=ttl
+        )
+        assert sorted(out) == tasks
+        # the final heartbeat wrote a recent ts
+        rec = json.loads((tmp_path / "work_claims" / "a.json").read_bytes())
+        assert _time.time() - rec["ts"] < ttl
