@@ -586,6 +586,21 @@ class CaptionEngine:
             t_valid = budget
         return embeds, t_valid, rope_pos, next_rope
 
+    def fit_max_new_tokens(
+        self,
+        requested: int,
+        prompt_ids: list[int],
+        prefix_ids: list[int] = (),
+        n_frames: int = 0,
+    ) -> int:
+        """The largest ``max_new_tokens`` (≤ requested, ≥ 1) that leaves
+        this prompt inside the longest KV lane — callers with fixed prompts
+        clamp generation instead of having the vision block rejected."""
+        n = len(prefix_ids) + len(prompt_ids)
+        if n_frames:
+            n += self._vision_token_count(n_frames)
+        return max(1, min(requested, self._max_len - n - 1))
+
     def _vision_token_count(self, n_frames: int) -> int:
         if self.cfg.vision_variant == "qwen2":
             return self.cfg.qwen_vision.tokens_out(n_frames)
