@@ -17,6 +17,8 @@ CAPTION_MODEL_CHOICES = (
     "base",
     "qwen25vl-7b",
     "qwen2vl-2b",
+    "qwen3moe-a3b-lm",
+    "qwen3moe-tiny-test",
     "qwen-chat-tiny-test",
     "tiny-test",
 )

@@ -138,6 +138,108 @@ def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]
     return {"params": params}, report
 
 
+def qwen3_moe_lm_config(hf_text_config, **overrides):
+    """Our VLMConfig from an HF Qwen3(-VL)-MoE text config: per-head qk
+    RMSNorm, no attention bias, sparse MoE FFN on every layer."""
+    from cosmos_curate_tpu.models.vlm.model import MoEConfig, VLMConfig
+
+    c = hf_text_config
+    rope_scaling = getattr(c, "rope_scaling", None) or {}
+    mrope = rope_scaling.get("mrope_section")
+    kw = dict(
+        vocab=c.vocab_size,
+        dim=c.hidden_size,
+        n_layers=c.num_hidden_layers,
+        n_heads=c.num_attention_heads,
+        n_kv_heads=c.num_key_value_heads,
+        head_dim=getattr(c, "head_dim", c.hidden_size // c.num_attention_heads),
+        hidden_mult=c.intermediate_size / c.hidden_size,
+        rope_theta=c.rope_theta,
+        qkv_bias=getattr(c, "attention_bias", False),
+        qk_norm=True,
+        # Qwen3-VL m-rope is INTERLEAVED across frequency dims
+        mrope_section=tuple(mrope) if mrope else None,
+        mrope_interleaved=bool(mrope),
+        rms_eps=getattr(c, "rms_norm_eps", 1e-6),
+        tied_embeddings=getattr(c, "tie_word_embeddings", True),
+        moe=MoEConfig(
+            n_experts=c.num_experts,
+            top_k=c.num_experts_per_tok,
+            hidden=c.moe_intermediate_size,
+        ),
+    )
+    kw.update(overrides)
+    return VLMConfig(**kw)
+
+
+def convert_qwen3_moe_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]:
+    """HF Qwen3(-VL)-MoE text state dict → our VLM params subtree + report
+    (reference serves this family via vLLM EP, models/vllm_qwen.py:313-349).
+
+    Accepts the bare text-model layout (``embed_tokens.weight``, ...) and
+    prefixed exports (``model.`` / ``model.language_model.``). Expert
+    tensors map verbatim: HF fuses gate|up as ``experts.gate_up_proj``
+    [E, D, 2H] and ``experts.down_proj`` [E, H, D] — exactly our MoEFFN's
+    parameter layout."""
+    sd = dict(state_dict)
+    report = ConversionReport()
+
+    def take(name: str) -> np.ndarray:
+        report.mapped.append(name)
+        return _t(sd[name])
+
+    prefix = ""
+    for cand in ("", "model.", "model.language_model.", "language_model.model."):
+        if f"{cand}embed_tokens.weight" in sd:
+            prefix = cand
+            break
+    params: dict = {"embed": {"embedding": take(f"{prefix}embed_tokens.weight")}}
+    for i in range(n_layers):
+        e = f"{prefix}layers.{i}."
+
+        def lin(name: str) -> dict:
+            return {"kernel": take(f"{e}{name}.weight").T}
+
+        params[f"layer_{i}"] = {
+            "ln1": {"scale": take(f"{e}input_layernorm.weight")},
+            "ln2": {"scale": take(f"{e}post_attention_layernorm.weight")},
+            "q": lin("self_attn.q_proj"),
+            "k": lin("self_attn.k_proj"),
+            "v": lin("self_attn.v_proj"),
+            "o": lin("self_attn.o_proj"),
+            "q_norm": {"scale": take(f"{e}self_attn.q_norm.weight")},
+            "k_norm": {"scale": take(f"{e}self_attn.k_norm.weight")},
+            "moe": {
+                "router": {"kernel": take(f"{e}mlp.gate.weight").T},
+                "gate_up": take(f"{e}mlp.experts.gate_up_proj"),
+                "down": take(f"{e}mlp.experts.down_proj"),
+            },
+        }
+    params["ln_f"] = {"scale": take(f"{prefix}norm.weight")}
+    mapped = set(report.mapped)
+    for k in sd:
+        if k in mapped:
+            continue
+        if k.startswith(("visual.", "model.visual.")):
+            report.vision_skipped.append(k)
+        elif k.endswith("lm_head.weight"):
+            head, emb = _t(sd[k]), params["embed"]["embedding"]
+            if head.shape == emb.shape and np.array_equal(head, emb):
+                report.mapped.append(k)
+            else:
+                params["lm_head"] = {"kernel": head.T}
+                report.mapped.append(k)
+        else:
+            report.unmapped.append(k)
+    logger.info(
+        "converted Qwen3-MoE LM: %d tensors mapped, %d vision skipped, %d unmapped",
+        len(report.mapped),
+        len(report.vision_skipped),
+        len(report.unmapped),
+    )
+    return {"params": params}, report
+
+
 def qwen2_vision_config(hf_vision_config, **overrides):
     """Our QwenVisionConfig from an HF Qwen2VLVisionConfig OR
     Qwen2_5_VLVisionConfig (detected by ``out_hidden_size``, the 2.5

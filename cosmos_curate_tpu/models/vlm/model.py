@@ -38,6 +38,22 @@ from cosmos_curate_tpu.models.vlm.vision_qwen import (
 
 
 @dataclass(frozen=True)
+class MoEConfig:
+    """Sparse mixture-of-experts FFN (the Qwen3-VL-MoE captioner class,
+    reference models/vllm_qwen.py:313-349 serves Qwen3-VL-30B/235B via
+    vLLM expert parallelism). Router semantics match HF Qwen3MoE: softmax
+    over ALL experts in fp32, THEN top-k, renormalized."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    hidden: int = 512  # per-expert intermediate (HF moe_intermediate_size)
+    # expert-queue capacity = ceil(top_k * tokens / n_experts * factor);
+    # None = no-drop (capacity = token count) — exact HF equivalence, used
+    # by tests and small decode batches
+    capacity_factor: float | None = None
+
+
+@dataclass(frozen=True)
 class VLMConfig:
     vocab: int = 512
     dim: int = 1024
@@ -63,6 +79,14 @@ class VLMConfig:
     # tied = logits via embed.attend (Qwen2-VL-2B); untied checkpoints
     # (Qwen2.5-VL-7B) carry a separate lm_head matrix
     tied_embeddings: bool = True
+    # Qwen3 family: per-head-dim RMSNorm on q/k before rope
+    qk_norm: bool = False
+    # sparse MoE FFN replaces the dense SwiGLU on every layer when set
+    moe: MoEConfig | None = None
+    # Qwen3-VL interleaves the (t, h, w) m-rope components across frequency
+    # dims ([THW THW ... TT], preserving frequency continuity) instead of
+    # Qwen2-VL's chunked [TTT HHH WWW] sections
+    mrope_interleaved: bool = False
 
 
 VLM_BASE = VLMConfig()
@@ -122,6 +146,45 @@ VLM_TINY_TEST = VLMConfig(
     vision=VIT_TINY_TEST,
     vision_tokens=8,
 )
+# Qwen3-VL-30B-A3B-class sparse captioner LM (reference roster:
+# models/vllm_qwen.py:313-349 serves the Qwen3-VL MoE family via vLLM
+# expert parallelism). Nominal checkpoint shapes; at conversion time
+# `convert_qwen.qwen3_moe_lm_config(hf_config)` derived from the actual
+# checkpoint is authoritative. The Qwen3-VL DEEPSTACK vision tower is not
+# implemented yet — this flavor serves the text/chat-LM paths (caption
+# enhancement) and the EP-sharded serving plumbing; see PARITY.md.
+VLM_QWEN3_MOE_A3B = VLMConfig(
+    vocab=151936,
+    dim=2048,
+    n_layers=48,
+    n_heads=32,
+    n_kv_heads=4,
+    head_dim=128,
+    hidden_mult=6144 / 2048,
+    max_seq=4096,
+    rope_theta=1_000_000.0,
+    qkv_bias=False,
+    qk_norm=True,
+    vision=VIT_TINY_TEST,
+    vision_tokens=8,
+    mrope_section=(24, 20, 20),
+    mrope_interleaved=True,
+    tied_embeddings=False,
+    moe=MoEConfig(n_experts=128, top_k=8, hidden=768, capacity_factor=2.0),
+)
+VLM_MOE_TINY_TEST = VLMConfig(
+    vocab=512,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    max_seq=128,
+    vision=VIT_TINY_TEST,
+    vision_tokens=8,
+    qk_norm=True,
+    moe=MoEConfig(n_experts=4, top_k=2, hidden=32),
+)
 # Named caption-model flavors selectable from pipeline args (CLI
 # --caption-model); each pairs an architecture with its weight-registry id
 # plus the serving knobs that must travel with the checkpoint choice.
@@ -141,6 +204,10 @@ class FlavorSpec:
     # hf_chat special-token table override (None = Qwen2 defaults); tuple
     # of (token, id) pairs so the spec stays hashable.
     specials: tuple[tuple[str, int], ...] | None = None
+    # The flavor serves TEXT ONLY (no trained vision tower): frame-bearing
+    # requests must be refused loudly, never encoded through a placeholder
+    # tower into silent gibberish.
+    text_only: bool = False
     # Default KV lane layout ((length, n_slots), ...) for the caption
     # engine — memory-bounding by actual request lengths (None = one
     # worst-case-length pool). Chosen per checkpoint size so the
@@ -222,6 +289,18 @@ VLM_FLAVORS.update(
             kv_lanes=((1024, 4), (4096, 2)),
         ),
         "tiny-test": FlavorSpec(VLM_TINY_TEST, "caption-vlm-tpu", require_weights=False),
+        # MoE chat-LM for the text-only caption-family paths (enhancement);
+        # captioning with frames needs the pending Qwen3-VL vision tower
+        "qwen3moe-a3b-lm": FlavorSpec(
+            VLM_QWEN3_MOE_A3B,
+            "caption-qwen3moe-a3b-tpu",
+            hf_chat=True,
+            text_only=True,  # Qwen3-VL deepstack vision tower pending
+            kv_lanes=((1024, 4), (4096, 2)),
+        ),
+        "qwen3moe-tiny-test": FlavorSpec(
+            VLM_MOE_TINY_TEST, "caption-vlm-tpu", require_weights=False
+        ),
         # hf_chat plumbing under test shapes: exercises HFVocabTokenizer +
         # chat-template request building without a real checkpoint
         "qwen-chat-tiny-test": FlavorSpec(
@@ -240,25 +319,45 @@ def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
 
 
+def mrope_component_map(
+    mrope_section: tuple[int, int, int], interleaved: bool
+) -> np.ndarray:
+    """Which (t=0, h=1, w=2) position component drives each of the D/2
+    rotary frequency dims.
+
+    Chunked (Qwen2-VL): [T]*s0 + [H]*s1 + [W]*s2. Interleaved (Qwen3-VL,
+    HF ``apply_interleaved_mrope``): start all-T, then dims 1,4,7,..
+    (< 3*s1) become H and dims 2,5,8,.. (< 3*s2) become W."""
+    if not interleaved:
+        return np.repeat(np.arange(3), np.asarray(mrope_section))
+    d2 = int(sum(mrope_section))
+    comp = np.zeros(d2, np.int64)
+    comp[1 : 3 * mrope_section[1] : 3] = 1
+    comp[2 : 3 * mrope_section[2] : 3] = 2
+    return comp
+
+
 def apply_rope(
     x: jnp.ndarray,
     positions: jnp.ndarray,
     theta: float,
     mrope_section: tuple[int, int, int] | None = None,
+    mrope_interleaved: bool = False,
 ) -> jnp.ndarray:
     """x: [B, T, H, D]; positions: [B, T] absolute positions, or [B, T, 3]
     (t, h, w) multimodal positions under m-rope.
 
-    M-rope (HF apply_multimodal_rotary_pos_emb semantics): the D/2 rotary
-    frequency dims are split into mrope_section chunks; chunk c's angles
-    use position component c. With all three components equal (any pure-text
-    span) this reduces exactly to standard 1D rope.
+    M-rope (HF apply_multimodal_rotary_pos_emb semantics): each of the D/2
+    rotary frequency dims takes its angle from one position component,
+    assigned by ``mrope_component_map`` (chunked sections for Qwen2-VL,
+    interleaved for Qwen3-VL). With all three components equal (any
+    pure-text span) both layouts reduce exactly to standard 1D rope.
     """
     freqs = rope_frequencies(x.shape[-1], theta)  # [D/2]
     if positions.ndim == 3:
         if mrope_section is None:
             raise ValueError("3-component positions require mrope_section")
-        comp = np.repeat(np.arange(3), np.asarray(mrope_section))  # [D/2]
+        comp = mrope_component_map(mrope_section, mrope_interleaved)
         pos_sel = positions[..., comp].astype(jnp.float32)  # [B, T, D/2]
         angles = pos_sel * freqs
     else:
@@ -346,6 +445,85 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
+class MoEFFN(nn.Module):
+    """Expert-parallel sparse FFN, GShard-style static dispatch.
+
+    TPU-first formulation: routing becomes one-hot einsum dispatch into a
+    fixed per-expert queue of ``capacity`` slots, the expert SwiGLU runs
+    as ONE batched [E, C, D] x [E, D, 2H] einsum (expert axis sharded over
+    the ``model`` mesh axis = expert parallelism under pjit — each device
+    holds E/ep experts and XLA all-to-alls the queues), and the combine is
+    the transpose einsum weighted by the router. No dynamic shapes, no
+    per-expert Python loops; compiled once per (tokens, capacity) bucket.
+
+    Numerics match HF Qwen3MoE (softmax-then-topk in fp32, renormalized;
+    fused gate_up chunked into gate|up; silu(gate)*up) exactly when no
+    token overflows its expert queue (``capacity_factor=None`` guarantees
+    this; a finite factor trades exactness at overflow for memory, the
+    standard GShard drop semantics)."""
+
+    cfg: VLMConfig
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        moe = self.cfg.moe
+        b, t, d = x.shape
+        n = b * t
+        e, k, h = moe.n_experts, moe.top_k, moe.hidden
+        tokens = x.reshape(n, d)
+        logits = dense(e, None, name="router", use_bias=False, dtype=jnp.float32)(
+            tokens.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_w, top_i = jax.lax.top_k(probs, k)  # [N, k]
+        top_w = top_w / top_w.sum(axis=-1, keepdims=True)
+        if moe.capacity_factor is None:
+            cap = n
+        else:
+            cap = max(1, min(n, int(np.ceil(k * n / e * moe.capacity_factor))))
+        # assignment axis A = N*k, token-major; queue position = number of
+        # earlier assignments to the same expert. Dispatch one-hots are 0/1
+        # — exact in bf16 — so the big [A, E, cap] contraction intermediate
+        # runs in compute dtype, not fp32. The engine's chunked prefill
+        # (prefill_chunk tokens per program) bounds A for the serving path;
+        # a sort-based dispatch kernel is the next step if EP profiling
+        # shows this intermediate as the HBM hot spot.
+        e_onehot32 = jax.nn.one_hot(top_i.reshape(-1), e, dtype=jnp.float32)  # [A, E]
+        prior = jnp.cumsum(e_onehot32, axis=0) - e_onehot32
+        pos = jnp.sum(prior * e_onehot32, axis=-1)  # [A]
+        e_onehot = e_onehot32.astype(self.dtype)
+        # one_hot yields an all-zero row for pos >= cap: overflow tokens
+        # drop out of the dispatch with no extra masking
+        c_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=self.dtype)
+        x_a = jnp.repeat(tokens, k, axis=0).astype(self.dtype)  # [A, D]
+        expert_in = jnp.einsum("ae,ac,ad->ecd", e_onehot, c_onehot, x_a)
+        gate_up = self.param(
+            "gate_up",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02), (MODEL_AXIS, None, None)
+            ),
+            (e, d, 2 * h),
+            jnp.float32,
+        )
+        down = self.param(
+            "down",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02), (MODEL_AXIS, None, None)
+            ),
+            (e, h, d),
+            jnp.float32,
+        )
+        z = jnp.einsum("ecd,edh->ech", expert_in, gate_up.astype(self.dtype))
+        gate, up = jnp.split(z, 2, axis=-1)
+        out = jnp.einsum(
+            "ech,ehd->ecd", nn.silu(gate) * up, down.astype(self.dtype)
+        )  # [E, C, D]
+        out_a = jnp.einsum("ae,ac,ecd->ad", e_onehot, c_onehot, out).astype(jnp.float32)
+        y = (out_a * top_w.reshape(-1)[:, None]).reshape(n, k, d).sum(axis=1)
+        return y.reshape(b, t, d).astype(x.dtype)
+
+
 class DecoderLayer(nn.Module):
     cfg: VLMConfig
     dtype: jnp.dtype = jnp.bfloat16
@@ -370,8 +548,13 @@ class DecoderLayer(nn.Module):
         q = dense(h * dh, "out", name="q", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
         k = dense(hk * dh, "out", name="k", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
         v = dense(hk * dh, "out", name="v", use_bias=cfg.qkv_bias, dtype=self.dtype)(y)
-        q = apply_rope(q.reshape(b, t, h, dh), positions, cfg.rope_theta, cfg.mrope_section)
-        k = apply_rope(k.reshape(b, t, hk, dh), positions, cfg.rope_theta, cfg.mrope_section)
+        q = q.reshape(b, t, h, dh)
+        k = k.reshape(b, t, hk, dh)
+        if cfg.qk_norm:  # Qwen3 family: per-HEAD-DIM RMSNorm before rope
+            q = RMSNorm(eps=cfg.rms_eps, name="q_norm")(q)
+            k = RMSNorm(eps=cfg.rms_eps, name="k_norm")(k)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.mrope_section, cfg.mrope_interleaved)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.mrope_section, cfg.mrope_interleaved)
         v = v.reshape(b, t, hk, dh)
 
         # scatter this chunk into the cache at each row's write_index
@@ -418,6 +601,8 @@ class DecoderLayer(nn.Module):
         x = x + dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(attn)
 
         y = RMSNorm(eps=cfg.rms_eps, name="ln2")(x)
+        if cfg.moe is not None:
+            return x + MoEFFN(cfg, dtype=self.dtype, name="moe")(y), new_k, new_v
         up = dense(int(cfg.dim * cfg.hidden_mult), "out", name="up", use_bias=False, dtype=self.dtype)(y)
         gate = dense(int(cfg.dim * cfg.hidden_mult), "out", name="gate", use_bias=False, dtype=self.dtype)(y)
         down = dense(cfg.dim, "in", name="down", use_bias=False, dtype=self.dtype)(

@@ -105,6 +105,7 @@ class _CaptionVLM(ModelInterface):
         hf_chat: bool = False,
         specials: dict[str, int] | None = None,
         kv_lanes: tuple[tuple[int, int], ...] | None = None,
+        text_only: bool = False,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
@@ -113,6 +114,7 @@ class _CaptionVLM(ModelInterface):
         self.hf_chat = hf_chat
         self.specials = specials
         self.kv_lanes = kv_lanes
+        self.text_only = text_only
         self.engine: CaptionEngine | None = None
         self._tokenizer = None
         # encode_prompt memo: the HF BPE is pure-Python and the caption
@@ -172,6 +174,13 @@ class _CaptionVLM(ModelInterface):
         (vision embeddings splice between the two), a raw encode otherwise.
         Memoized — stages call this per window/clip/event with identical
         text."""
+        if has_vision and self.text_only:
+            raise ValueError(
+                f"{self.model_id} is a TEXT-ONLY flavor (no trained vision "
+                f"tower): frame-bearing stages (captioning, semantic filter, "
+                f"per-event) cannot use it — pick a VL flavor; the LM flavor "
+                f"serves enhancement/chat paths"
+            )
         key = (user_text, has_vision)
         hit = self._prompt_cache.get(key)
         if hit is None:
@@ -242,6 +251,7 @@ def resolve_caption_model(
             hf_chat=spec.hf_chat,
             specials=dict(spec.specials) if spec.specials else None,
             kv_lanes=spec.kv_lanes,
+            text_only=spec.text_only,
         )
     return _CaptionVLM(cfg or VLM_BASE, max_batch)
 

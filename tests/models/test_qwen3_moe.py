@@ -1,0 +1,189 @@
+"""Qwen3-MoE sparse-FFN LM: HF parity + expert-dispatch semantics.
+
+The reference's captioner roster includes Qwen3-VL-30B/235B MoE variants
+served through vLLM's expert parallelism (models/vllm_qwen.py:313-349).
+Our MoE layer is a GShard-style static-dispatch einsum formulation whose
+numerics must match HF Qwen3MoE exactly in the no-drop regime."""
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.models.vlm.model import MoEConfig, MoEFFN, VLM, VLMConfig, init_cache
+
+TINY_MOE = VLMConfig(
+    vocab=128,
+    dim=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=8,
+    hidden_mult=2.0,
+    max_seq=64,
+    qkv_bias=False,
+    qk_norm=True,
+    moe=MoEConfig(n_experts=4, top_k=2, hidden=16),
+)
+
+
+class TestMoEFFN:
+    def test_dispatch_matches_dense_reference(self):
+        """No-drop static dispatch == the straightforward dense formula
+        (softmax-then-topk, renormalized, silu(gate)*up per expert)."""
+        cfg = TINY_MOE
+        ffn = MoEFFN(cfg, dtype=jnp.float32)
+        x = np.random.default_rng(0).normal(size=(2, 5, cfg.dim)).astype(np.float32)
+        params = ffn.init(jax.random.PRNGKey(1), jnp.asarray(x))
+        got = np.asarray(ffn.apply(params, jnp.asarray(x)))
+
+        from cosmos_curate_tpu.models.registry import _unbox_tree
+
+        p = jax.tree_util.tree_map(np.asarray, _unbox_tree(params))["params"]
+        tok = x.reshape(-1, cfg.dim)
+        logits = tok @ p["router"]["kernel"]
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        e, k = cfg.moe.n_experts, cfg.moe.top_k
+        want = np.zeros_like(tok)
+        for n in range(tok.shape[0]):
+            idx = np.argsort(-probs[n])[:k]
+            w = probs[n][idx]
+            w = w / w.sum()
+            for j, ei in enumerate(idx):
+                gu = tok[n] @ p["gate_up"][ei]
+                g, u = gu[: cfg.moe.hidden], gu[cfg.moe.hidden :]
+                silu = g / (1 + np.exp(-g))
+                want[n] += w[j] * ((silu * u) @ p["down"][ei])
+        np.testing.assert_allclose(got.reshape(-1, cfg.dim), want, atol=1e-5, rtol=1e-4)
+
+    def test_capacity_drop_runs_and_bounds_memory(self):
+        cfg = VLMConfig(
+            vocab=128, dim=32, n_layers=1, n_heads=4, n_kv_heads=2, head_dim=8,
+            qk_norm=True, moe=MoEConfig(n_experts=4, top_k=2, hidden=16, capacity_factor=1.0),
+        )
+        ffn = MoEFFN(cfg, dtype=jnp.float32)
+        x = jnp.ones((1, 16, cfg.dim), jnp.float32)
+        params = ffn.init(jax.random.PRNGKey(0), x)
+        out = ffn.apply(params, x)
+        assert out.shape == x.shape and bool(jnp.isfinite(out).all())
+
+
+class TestHFParity:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import torch
+        from transformers.models.qwen3_vl_moe.configuration_qwen3_vl_moe import (
+            Qwen3VLMoeTextConfig,
+        )
+        from transformers.models.qwen3_vl_moe.modeling_qwen3_vl_moe import (
+            Qwen3VLMoeTextModel,
+        )
+
+        from cosmos_curate_tpu.models.convert_qwen import (
+            convert_qwen3_moe_lm,
+            qwen3_moe_lm_config,
+        )
+
+        hf_cfg = Qwen3VLMoeTextConfig(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=8,
+            num_experts=4,
+            num_experts_per_tok=2,
+            moe_intermediate_size=16,
+            max_position_embeddings=64,
+            tie_word_embeddings=True,
+            rope_scaling={"rope_type": "default", "mrope_section": [2, 1, 1]},
+        )
+        torch.manual_seed(3)
+        hf = Qwen3VLMoeTextModel(hf_cfg).eval()
+        cfg = qwen3_moe_lm_config(hf_cfg, max_seq=64, mrope_section=None)
+        params, report = convert_qwen3_moe_lm(hf.state_dict(), cfg.n_layers)
+        return hf, cfg, params, report
+
+    def test_interleaved_component_map_matches_hf_layout(self):
+        """Our frequency->component map equals HF apply_interleaved_mrope's
+        overwrite rule (start all-T; dims 1,4,.. < 3*s1 become H; dims
+        2,5,.. < 3*s2 become W)."""
+        from cosmos_curate_tpu.models.vlm.model import mrope_component_map
+
+        sec = (24, 20, 20)
+        comp = mrope_component_map(sec, interleaved=True)
+        want = np.zeros(64, np.int64)
+        want[1 : 3 * 20 : 3] = 1
+        want[2 : 3 * 20 : 3] = 2
+        np.testing.assert_array_equal(comp, want)
+        # chunked layout unchanged
+        np.testing.assert_array_equal(
+            mrope_component_map((2, 1, 1), interleaved=False), [0, 0, 1, 2]
+        )
+
+    def test_conversion_complete(self, pair):
+        _, _, _, report = pair
+        assert not report.unmapped, report.unmapped
+        assert not report.vision_skipped
+
+    def test_logits_match_hf(self, pair):
+        import torch
+
+        hf, cfg, params, _ = pair
+        ids = np.array([[3, 17, 42, 9, 77, 5]], np.int64)
+        with torch.no_grad():
+            hidden = hf(input_ids=torch.from_numpy(ids)).last_hidden_state.numpy()
+        emb = np.asarray(params["params"]["embed"]["embedding"])
+        want = hidden @ emb.T  # tied head
+
+        model = VLM(cfg, dtype=jnp.float32)
+        t = ids.shape[1]
+        ck, cv = init_cache(cfg, 1, dtype=jnp.float32, length=cfg.max_seq)
+        embeds = model.apply(params, jnp.asarray(ids, jnp.int32), method=model.embed_tokens)
+        logits, _, _ = model.apply(
+            params,
+            embeds,
+            ck,
+            cv,
+            jnp.broadcast_to(jnp.arange(t), (1, t)),
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), t, jnp.int32),
+        )
+        np.testing.assert_allclose(np.asarray(logits[0]), want[0], atol=5e-4, rtol=1e-3)
+
+
+class TestEngineIntegration:
+    def test_caption_engine_decodes_with_moe_flavor(self):
+        """The continuous-batching engine serves an MoE-FFN model end to
+        end (prefill + decode share the sparse layer)."""
+        from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
+        from cosmos_curate_tpu.models.vlm import CaptionEngine, CaptionRequest, SamplingConfig
+        from cosmos_curate_tpu.models.vlm.model import vlm_flavor
+
+        spec = vlm_flavor("qwen3moe-tiny-test")
+        eng = CaptionEngine(spec.cfg, max_batch=2)
+        eng.setup()
+        tok = ByteTokenizer()
+        eng.add_request(
+            CaptionRequest(
+                request_id="m0",
+                prompt_ids=tok.encode("describe"),
+                sampling=SamplingConfig(max_new_tokens=6),
+            )
+        )
+        res = eng.run_until_complete()
+        assert len(res) == 1 and res[0].num_output_tokens <= 6
+
+    def test_text_only_flavor_refuses_frames(self):
+        from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            resolve_caption_model,
+        )
+
+        model = resolve_caption_model(None, "qwen3moe-a3b-lm", 2)
+        with pytest.raises(ValueError, match="TEXT-ONLY"):
+            model.encode_prompt("describe", has_vision=True)
