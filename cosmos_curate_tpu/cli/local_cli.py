@@ -84,7 +84,7 @@ def register(sub: argparse._SubParsersAction) -> None:
     av = lsub.add_parser("av", help="multi-camera AV pipelines")
     av.add_argument(
         "subcommand2",
-        choices=["ingest", "split", "caption", "trajectory", "package", "shard"],
+        choices=["ingest", "split", "caption", "trajectory", "annotate", "package", "shard"],
         metavar="step",
     )
     av.add_argument(
@@ -211,6 +211,8 @@ def _cmd_av(args: argparse.Namespace) -> int:
         from cosmos_curate_tpu.pipelines.av.trajectory import run_av_trajectory
 
         summary = run_av_trajectory(pargs)
+    elif step == "annotate":
+        summary = av.run_av_annotate(pargs)
     elif step == "package":
         summary = av.run_av_package(pargs)
     else:

@@ -25,6 +25,16 @@ def build_parser() -> argparse.ArgumentParser:
     info = sub.add_parser("info", help="show environment and device info")
     info.set_defaults(func=_cmd_info)
 
+    lance = sub.add_parser(
+        "export-lance",
+        help="convert a run's embeddings parquet output to lance datasets "
+        "(requires `pip install pylance` in the target environment)",
+    )
+    lance.add_argument("--src", required=True, help="embeddings/ dir (or one model subdir)")
+    lance.add_argument("--dest", required=True, help="output root for <model>.lance datasets")
+    lance.add_argument("--mode", default="create", choices=["create", "overwrite", "append"])
+    lance.set_defaults(func=_cmd_export_lance)
+
     # Lazy registration of heavier sub-apps to keep `--help` fast.
     try:
         from cosmos_curate_tpu.cli import local_cli
@@ -112,6 +122,19 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)
         return 130
+
+
+def _cmd_export_lance(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.storage.lance_export import export_parquet_to_lance
+
+    try:
+        written = export_parquet_to_lance(args.src, args.dest, mode=args.mode)
+    except (RuntimeError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for uri, rows in written.items():
+        print(f"{uri}: {rows} rows")
+    return 0
 
 
 if __name__ == "__main__":

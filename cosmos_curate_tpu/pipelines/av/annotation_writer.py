@@ -1,0 +1,147 @@
+"""AV annotation writers: per-clip JSON artifacts + clip_caption DB rows.
+
+Equivalent capability of the reference's annotation writer family
+(av/writers/annotation_writer_stage.py:36-340): the JSON layout
+
+- ``{prefix}/metas/{clip_uuid}.json`` — one annotation document per clip
+  (clip identity, spans, per-variant caption chains, video geometry),
+- ``{prefix}/processed_sessions/{session}.json`` — session-level record,
+- ``{prefix}/processed_session_chunks/{session}_{chunk}.json`` — chunk
+  record (this pipeline processes whole sessions: chunk 0),
+
+and the ``clip_caption`` DB rows (make_db_row.py:231 ``make_clip_caption``
+-> postgres_schema.ClipCaption): per (clip, version, prompt_type) window
+frame bounds, window captions, the packaged t5-embedding URL, and the run
+id. URLs follow the packaging layout
+(``datasets/{dataset}/{variant}/{session}.tar``, packaging.py
+``package_t5_embeddings_e``).
+
+All JSON writes go through the URL-aware storage client, so the same code
+lands artifacts on local disk or object storage.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from cosmos_curate_tpu.pipelines.av.packaging import t5_session_tar_url
+from cosmos_curate_tpu.pipelines.av.state_db import CaptionAnnotationRow
+from cosmos_curate_tpu.storage.writers import write_json
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _caption_chain(variants: dict[str, str], base: str) -> list[tuple[int, str]]:
+    """Ordered (window_index, caption) pairs for one prompt variant:
+    window 0 is the bare variant name, later windows ride as
+    ``{base}#w{k}`` (the storage convention run_av_caption writes). The
+    PARSED index travels with the text so frame bounds stay correct when a
+    middle window's caption is absent (e.g. a failed request on resume)."""
+    chain = []
+    if base in variants:
+        chain.append((0, variants[base]))
+    prefix = f"{base}#w"
+    for name, text in variants.items():
+        if name.startswith(prefix):
+            try:
+                chain.append((int(name[len(prefix) :]), text))
+            except ValueError:
+                continue
+    return sorted(chain)
+
+
+def write_clip_annotations(
+    db,
+    output_prefix: str,
+    *,
+    version: str = "v0",
+    run_id: str = "",
+    dataset: str = "av-dataset",
+    window_frames: int = 8,
+    framerate: float = 1.0,
+    height: int | None = None,
+    width: int | None = None,
+    states: tuple[str, ...] = ("captioned", "packaged"),
+    limit: int = 0,
+) -> dict[str, int]:
+    """Emit the annotation JSON layout + clip_caption DB rows for every
+    captioned clip in ``db`` (at most ``limit`` clips when set). Returns
+    artifact counts."""
+    prefix = output_prefix.rstrip("/")
+    sessions: dict[str, list] = {}
+    n_clips = 0
+    for state in states:
+        for clip in db.clips(state=state):
+            if limit and n_clips >= limit:
+                break
+            sessions.setdefault(clip.session_id, []).append(clip)
+            n_clips += 1
+    n_meta = n_rows = 0
+    for session_id, clips in sorted(sessions.items()):
+        rows: list[CaptionAnnotationRow] = []
+        for clip in clips:
+            variants = db.variant_captions(clip.clip_uuid)
+            bases = sorted({v.split("#w")[0] for v in variants})
+            chains = {b: _caption_chain(variants, b) for b in bases}
+            # caption-frame space (clips caption at `framerate`); the last
+            # window clamps to the clip's actual frame count — matching the
+            # bounds run_av_shard packs into the tars (pipeline.py:485)
+            clip_frames = max(
+                1, int(round((clip.span_end - clip.span_start) * framerate))
+            )
+            doc: dict[str, Any] = {
+                "uuid": clip.clip_uuid,
+                "session": session_id,
+                "camera": clip.camera,
+                "span_start": clip.span_start,
+                "span_end": clip.span_end,
+                "framerate": framerate,
+                "height": height,
+                "width": width,
+                "captions": {b: [t for _, t in chains[b]] for b in bases},
+            }
+            write_json(f"{prefix}/metas/{clip.clip_uuid}.json", doc)
+            n_meta += 1
+            for base in bases:
+                chain = chains[base]
+                rows.append(
+                    CaptionAnnotationRow(
+                        clip_uuid=clip.clip_uuid,
+                        version=version,
+                        prompt_type=base,
+                        window_start_frame=[
+                            min(k * window_frames, clip_frames) for k, _ in chain
+                        ],
+                        window_end_frame=[
+                            min((k + 1) * window_frames, clip_frames)
+                            for k, _ in chain
+                        ],
+                        window_caption=[t for _, t in chain],
+                        t5_embedding_url=t5_session_tar_url(
+                            prefix, dataset, session_id,
+                            clip.span_start, clip.span_end,
+                        ),
+                        run_uuid=run_id,
+                    )
+                )
+        if rows:
+            db.add_caption_annotations(rows)
+            n_rows += len(rows)
+        session_doc = {
+            "session": session_id,
+            "num_clips": len(clips),
+            "clip_uuids": [c.clip_uuid for c in clips],
+            "version": version,
+            "run_uuid": run_id,
+        }
+        write_json(f"{prefix}/processed_sessions/{session_id}.json", session_doc)
+        write_json(
+            f"{prefix}/processed_session_chunks/{session_id}_0.json",
+            {**session_doc, "session_chunk_index": 0},
+        )
+    logger.info(
+        "wrote %d clip annotation JSONs + %d clip_caption rows for %d sessions",
+        n_meta, n_rows, len(sessions),
+    )
+    return {"metas": n_meta, "rows": n_rows, "sessions": len(sessions)}

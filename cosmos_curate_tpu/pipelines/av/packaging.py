@@ -168,6 +168,35 @@ def package_clip_sessions(
     return written
 
 
+def t5_session_uuid(session_id: str, span_start: float, span_end: float) -> str:
+    """Deterministic clip-session id keying the packaged tars (span-keyed
+    uuid5). Single source of truth shared by the shard packer and the
+    annotation DB rows."""
+    import uuid as _uuid
+
+    return str(
+        _uuid.uuid5(
+            _uuid.NAMESPACE_URL,
+            f"{session_id}:{round(span_start, 3)}:{round(span_end, 3)}",
+        )
+    )
+
+
+def t5_session_tar_url(
+    root: str,
+    dataset: str,
+    session_id: str,
+    span_start: float,
+    span_end: float,
+    variant: str = "t5_xxl",
+) -> str:
+    """The exact tar URL ``package_t5_embeddings_e`` writes for one
+    clip-session — annotation DB rows must record THIS url, not a
+    lookalike."""
+    csu = t5_session_uuid(session_id, span_start, span_end)
+    return f"{root.rstrip('/')}/datasets/{dataset}/{variant}/{csu}.tar"
+
+
 def package_t5_embeddings_e(
     samples: list[SessionSample],
     root: str,

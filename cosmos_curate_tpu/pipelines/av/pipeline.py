@@ -267,6 +267,31 @@ def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
         db.close()
 
 
+def run_av_annotate(args: AVPipelineArgs) -> dict:
+    """Write per-clip annotation JSON artifacts + clip_caption DB rows
+    (reference AnnotationJsonWriterStage + AnnotationDbWriterStage,
+    av/writers/annotation_writer_stage.py:36-340)."""
+    import uuid as _uuid
+
+    from cosmos_curate_tpu.pipelines.av.annotation_writer import write_clip_annotations
+
+    t0 = time.monotonic()
+    db = open_state_db(args.resolved_db)
+    try:
+        counts = write_clip_annotations(
+            db,
+            args.output_path,
+            run_id=str(_uuid.uuid4()),
+            dataset=args.dataset_name,
+            window_frames=args.caption_window_frames,
+            framerate=AV_CAPTION_FPS,
+            limit=args.limit,
+        )
+        return {**counts, "elapsed_s": time.monotonic() - t0}
+    finally:
+        db.close()
+
+
 def run_av_package(args: AVPipelineArgs, *, encoder=None) -> dict:
     """Package captioned clips into the cosmos-predict2 dataset layout.
 
@@ -450,10 +475,13 @@ def _shard_t5_packaging(args: AVPipelineArgs) -> dict:
                 continue
             key = (row.session_id, round(row.span_start, 3), round(row.span_end, 3))
             if key not in by_span:
-                csu = uuid_mod.uuid5(
-                    uuid_mod.NAMESPACE_URL, f"{key[0]}:{key[1]}:{key[2]}"
+                from cosmos_curate_tpu.pipelines.av.packaging import t5_session_uuid
+
+                by_span[key] = SessionSample(
+                    session_uuid=t5_session_uuid(
+                        row.session_id, row.span_start, row.span_end
+                    )
                 )
-                by_span[key] = SessionSample(session_uuid=str(csu))
             # window frame indices are in caption-frame space (clips are
             # captioned at AV_CAPTION_FPS, run_av_caption); window k spans
             # [k*w, min((k+1)*w, n)) caption frames

@@ -44,6 +44,18 @@ CREATE TABLE IF NOT EXISTS clip_captions (
     caption TEXT NOT NULL,
     PRIMARY KEY (clip_uuid, variant)
 );
+CREATE TABLE IF NOT EXISTS clip_caption (
+    clip_uuid TEXT NOT NULL,
+    version TEXT NOT NULL,
+    prompt_type TEXT NOT NULL,
+    window_start_frame TEXT NOT NULL,
+    window_end_frame TEXT NOT NULL,
+    window_caption TEXT NOT NULL,
+    t5_embedding_url TEXT NOT NULL,
+    run_uuid TEXT NOT NULL,
+    created_s REAL NOT NULL,
+    PRIMARY KEY (clip_uuid, version, prompt_type)
+);
 """
 
 
@@ -171,8 +183,78 @@ class AVStateDB:
                 )
         _db_retry(op)
 
+    def add_caption_annotations(self, rows: list["CaptionAnnotationRow"]) -> None:
+        """Bulk-write clip_caption annotation rows (reference
+        AnnotationDbWriterStage.write_data, annotation_writer_stage.py:93
+        -> postgres_schema.ClipCaption). Window lists ride as JSON text so
+        sqlite and Postgres share one schema."""
+        import json as _json
+
+        def op():
+            with self._conn:
+                self._conn.executemany(
+                    "INSERT INTO clip_caption (clip_uuid, version, prompt_type, "
+                    "window_start_frame, window_end_frame, window_caption, "
+                    "t5_embedding_url, run_uuid, created_s) "
+                    "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(clip_uuid, version, prompt_type) DO UPDATE SET "
+                    "window_start_frame = excluded.window_start_frame, "
+                    "window_end_frame = excluded.window_end_frame, "
+                    "window_caption = excluded.window_caption, "
+                    "t5_embedding_url = excluded.t5_embedding_url, "
+                    "run_uuid = excluded.run_uuid",
+                    [
+                        (
+                            r.clip_uuid, r.version, r.prompt_type,
+                            _json.dumps(r.window_start_frame),
+                            _json.dumps(r.window_end_frame),
+                            _json.dumps(r.window_caption),
+                            r.t5_embedding_url, r.run_uuid, time.time(),
+                        )
+                        for r in rows
+                    ],
+                )
+        _db_retry(op)
+
+    def caption_annotations(self, clip_uuid: str | None = None) -> list["CaptionAnnotationRow"]:
+        import json as _json
+
+        q = (
+            "SELECT clip_uuid, version, prompt_type, window_start_frame, "
+            "window_end_frame, window_caption, t5_embedding_url, run_uuid "
+            "FROM clip_caption"
+        )
+        args: tuple = ()
+        if clip_uuid:
+            q += " WHERE clip_uuid = ?"
+            args = (clip_uuid,)
+        return [
+            CaptionAnnotationRow(
+                row[0], row[1], row[2],
+                _json.loads(row[3]), _json.loads(row[4]), _json.loads(row[5]),
+                row[6], row[7],
+            )
+            for row in self._conn.execute(q, args)
+        ]
+
     def close(self) -> None:
         self._conn.close()
+
+
+@dataclass
+class CaptionAnnotationRow:
+    """One clip_caption table row (reference postgres_schema.py:153):
+    per-(clip, version, prompt_type) window frame bounds + captions and
+    the packaged t5 embedding URL."""
+
+    clip_uuid: str
+    version: str
+    prompt_type: str
+    window_start_frame: list[int]
+    window_end_frame: list[int]
+    window_caption: list[str]
+    t5_embedding_url: str
+    run_uuid: str
 
 
 _PG_SCHEMA = _SCHEMA.replace("REAL", "DOUBLE PRECISION")
@@ -312,6 +394,65 @@ class PostgresAVStateDB:
         self._retry_execute(
             "UPDATE clips SET state = %s WHERE clip_uuid = %s", (state, clip_uuid)
         )
+
+    def add_caption_annotations(
+        self, rows: list[CaptionAnnotationRow], *, chunk: int = 500
+    ) -> None:
+        """Chunked multi-row VALUES like add_clips: one round trip per 500
+        rows instead of one per row."""
+        import json as _json
+
+        from cosmos_curate_tpu.utils.pg_client import quote_literal
+
+        now = time.time()
+        for i in range(0, len(rows), chunk):
+            values = ", ".join(
+                "(%s)" % ", ".join(
+                    quote_literal(v)
+                    for v in (
+                        r.clip_uuid, r.version, r.prompt_type,
+                        _json.dumps(r.window_start_frame),
+                        _json.dumps(r.window_end_frame),
+                        _json.dumps(r.window_caption),
+                        r.t5_embedding_url, r.run_uuid, now,
+                    )
+                )
+                for r in rows[i : i + chunk]
+            )
+            self._retry_execute(
+                "INSERT INTO clip_caption (clip_uuid, version, prompt_type, "
+                "window_start_frame, window_end_frame, window_caption, "
+                "t5_embedding_url, run_uuid, created_s) "
+                f"VALUES {values} "
+                "ON CONFLICT(clip_uuid, version, prompt_type) DO UPDATE SET "
+                "window_start_frame = excluded.window_start_frame, "
+                "window_end_frame = excluded.window_end_frame, "
+                "window_caption = excluded.window_caption, "
+                "t5_embedding_url = excluded.t5_embedding_url, "
+                "run_uuid = excluded.run_uuid"
+            )
+
+    def caption_annotations(self, clip_uuid: str | None = None) -> list[CaptionAnnotationRow]:
+        import json as _json
+
+        q = (
+            "SELECT clip_uuid, version, prompt_type, window_start_frame, "
+            "window_end_frame, window_caption, t5_embedding_url, run_uuid "
+            "FROM clip_caption"
+        )
+        params: tuple = ()
+        if clip_uuid:
+            q += " WHERE clip_uuid = %s"
+            params = (clip_uuid,)
+        res = self._retry_execute(q, params)
+        return [
+            CaptionAnnotationRow(
+                r[0], r[1], r[2],
+                _json.loads(r[3]), _json.loads(r[4]), _json.loads(r[5]),
+                r[6], r[7],
+            )
+            for r in res.rows
+        ]
 
     def close(self) -> None:
         self._conn.close()

@@ -460,3 +460,84 @@ def test_multi_window_t5_packaging(av_dir, tmp_path):
     payload = pickle.loads(pkls[0].read_bytes())
     assert isinstance(payload, list) and len(payload) >= 2
     assert all(np.asarray(e).ndim == 2 for e in payload)
+
+
+class TestAnnotationWriter:
+    """VERDICT r3 #9: per-annotation JSON artifact layout + clip_caption
+    DB rows matching the reference writer family's URL scheme
+    (annotation_writer_stage.py:153-287, make_db_row.py:231)."""
+
+    def _seed_db(self, tmp_path):
+        from cosmos_curate_tpu.pipelines.av.state_db import AVStateDB, ClipRow
+
+        db = AVStateDB(str(tmp_path / "state.sqlite"))
+        db.upsert_session("sessA", 1)
+        db.add_clips(
+            [
+                ClipRow("c-1", "sessA", "front", 0.0, 3.0),
+                ClipRow("c-2", "sessA", "front", 3.0, 6.0),
+            ]
+        )
+        # primary variant over two windows + one extra front-only variant
+        db.set_caption("c-1", "first window", "default")
+        db.set_caption("c-1", "second window", "default#w1")
+        db.set_caption("c-1", "short take", "short")
+        db.set_caption("c-2", "only window", "default")
+        return db
+
+    def test_layout_and_rows(self, tmp_path):
+        import json
+
+        from cosmos_curate_tpu.pipelines.av.annotation_writer import (
+            write_clip_annotations,
+        )
+
+        db = self._seed_db(tmp_path)
+        out = tmp_path / "out"
+        counts = write_clip_annotations(
+            db, str(out), version="v0", run_id="run-1", dataset="dsA",
+            window_frames=8,
+        )
+        assert counts == {"metas": 2, "rows": 3, "sessions": 1}
+        # per-clip annotation documents at metas/{uuid}.json
+        doc = json.loads((out / "metas" / "c-1.json").read_text())
+        assert doc["captions"]["default"] == ["first window", "second window"]
+        assert doc["captions"]["short"] == ["short take"]
+        assert doc["session"] == "sessA" and doc["camera"] == "front"
+        # session + chunk records
+        sess = json.loads((out / "processed_sessions" / "sessA.json").read_text())
+        assert sorted(sess["clip_uuids"]) == ["c-1", "c-2"]
+        chunk = json.loads(
+            (out / "processed_session_chunks" / "sessA_0.json").read_text()
+        )
+        assert chunk["session_chunk_index"] == 0
+        # clip_caption rows: clamped window frame bounds + the EXACT tar
+        # url the shard packer writes (span-keyed uuid5 under t5_xxl)
+        from cosmos_curate_tpu.pipelines.av.packaging import t5_session_tar_url
+
+        rows = {(r.clip_uuid, r.prompt_type): r for r in db.caption_annotations()}
+        r = rows[("c-1", "default")]
+        # clip c-1 spans 3s at 1 fps = 3 caption frames: window bounds clamp
+        assert r.window_start_frame == [0, 3]
+        assert r.window_end_frame == [3, 3]
+        assert r.window_caption == ["first window", "second window"]
+        assert r.t5_embedding_url == t5_session_tar_url(
+            str(out), "dsA", "sessA", 0.0, 3.0
+        )
+        assert r.run_uuid == "run-1"
+        assert rows[("c-1", "short")].window_caption == ["short take"]
+        db.close()
+
+    def test_rewrite_is_idempotent(self, tmp_path):
+        from cosmos_curate_tpu.pipelines.av.annotation_writer import (
+            write_clip_annotations,
+        )
+
+        db = self._seed_db(tmp_path)
+        out = tmp_path / "out"
+        write_clip_annotations(db, str(out), run_id="r1")
+        write_clip_annotations(db, str(out), run_id="r2")
+        rows = db.caption_annotations("c-1")
+        assert {r.prompt_type for r in rows} == {"default", "short"}
+        assert all(r.run_uuid == "r2" for r in rows)  # upsert, no dup rows
+        db.close()
