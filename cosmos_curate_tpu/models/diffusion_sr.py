@@ -1,0 +1,265 @@
+"""Diffusion-class video super-resolution (windowed, conditional).
+
+Equivalent capability CLASS of the reference's SeedVR2 integration
+(cosmos_curate/models/seedvr2.py:145 — a diffusion transformer denoises
+video windows conditioned on the low-res input, sequence-parallel over
+frames, inference_seedvr2_window.py:483-530). This is our own compact
+Flax design, sized to be trainable in a single TPU window on synthetic
+degradations (this image has no egress for the 3B SeedVR2 checkpoint; see
+PARITY.md for the honest quality note):
+
+- **residual diffusion**: the model denoises the HR RESIDUAL over the
+  bilinear-upsampled input — the conditioning carries all low-frequency
+  content, so a small denoiser only has to synthesize detail;
+- **denoiser** = small conv UNet (stride-2 down / depth-to-space up,
+  GroupNorm + SiLU, FiLM timestep modulation) with temporal
+  self-attention at the bottleneck, so frames inside a window agree on
+  the synthesized detail (the video-consistency property that separates
+  diffusion SR from per-frame conv SR);
+- **v-prediction** on a cosine schedule; deterministic DDIM sampling in
+  ``sample_steps`` steps with a per-window fixed seed (same input →
+  same output, the pipeline's reproducibility contract);
+- **windowed inference**: frames process in fixed ``window``-frame chunks
+  (one compiled program), chunks batched; the ``sp_size`` hook shards the
+  chunk batch over the 'seq' mesh axis (chunks are independent, so this
+  is exact — the TPU translation of the reference's sp_size frame
+  sharding, at window granularity).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cosmos_curate_tpu.core.model import ModelInterface
+from cosmos_curate_tpu.models import registry
+
+
+@dataclass(frozen=True)
+class DiffusionSRConfig:
+    scale: int = 2
+    channels: int = 48
+    levels: int = 2  # stride-2 UNet levels
+    blocks: int = 2  # res blocks per level
+    temporal_heads: int = 4
+    window: int = 4  # frames denoised together
+    timesteps: int = 1000  # training schedule resolution
+    sample_steps: int = 8  # DDIM steps at inference
+
+
+DIFF_SR_BASE = DiffusionSRConfig()
+DIFF_SR_TINY_TEST = DiffusionSRConfig(
+    channels=8, levels=1, blocks=1, temporal_heads=2, window=2, sample_steps=2
+)
+
+registry.register_model("diffusion-sr-tpu", "windowed conditional diffusion video SR (Flax)")
+
+
+def cosine_alpha_sigma(t: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Continuous cosine schedule: t in [0, 1] -> (alpha, sigma) with
+    alpha^2 + sigma^2 = 1 (public formulation, Nichol & Dhariwal)."""
+    angle = t * (jnp.pi / 2)
+    return jnp.cos(angle), jnp.sin(angle)
+
+
+def _timestep_embedding(t: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Sinusoidal embedding of continuous t in [0, 1] -> [..., dim]."""
+    half = dim // 2
+    freqs = jnp.exp(jnp.linspace(0.0, 6.0, half))
+    ang = t[..., None] * freqs
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+
+
+class _FiLMResBlock(nn.Module):
+    channels: int
+
+    @nn.compact
+    def __call__(self, x, temb):
+        h = nn.GroupNorm(num_groups=min(8, self.channels))(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.channels, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32)(h)
+        # FiLM: timestep scales/shifts the normalized features
+        mod = nn.Dense(2 * self.channels, param_dtype=jnp.float32)(temb)
+        scale, shift = jnp.split(mod, 2, axis=-1)
+        h = h * (1 + scale[:, None, None, :]) + shift[:, None, None, :]
+        h = nn.silu(h)
+        h = nn.Conv(self.channels, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32)(h)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        return x + h
+
+
+class _TemporalAttention(nn.Module):
+    """Self-attention ACROSS the frame axis at every spatial position —
+    the cross-frame consistency mechanism (frames agree on synthesized
+    detail). Tokens are frames: cost O(T^2 · HW · C), tiny for window
+    sizes."""
+
+    heads: int
+
+    @nn.compact
+    def __call__(self, x):  # [T, H, W, C]
+        t, h, w, c = x.shape
+        d = c // self.heads
+        y = nn.GroupNorm(num_groups=min(8, c))(x)
+        y = y.reshape(t, h * w, c)
+        q = nn.Dense(c, param_dtype=jnp.float32, name="q")(y)
+        k = nn.Dense(c, param_dtype=jnp.float32, name="k")(y)
+        v = nn.Dense(c, param_dtype=jnp.float32, name="v")(y)
+        q = q.reshape(t, h * w, self.heads, d)
+        k = k.reshape(t, h * w, self.heads, d)
+        v = v.reshape(t, h * w, self.heads, d)
+        # attend over the FRAME axis per (position, head)
+        logits = jnp.einsum("tphd,sphd->phts", q, k) * (d**-0.5)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("phts,sphd->tphd", probs, v).reshape(t, h * w, c)
+        out = nn.Dense(c, param_dtype=jnp.float32, name="out")(out)
+        return x + out.reshape(t, h, w, c)
+
+
+class DenoiserUNet(nn.Module):
+    """v-prediction denoiser over the HR residual, conditioned on the
+    upsampled LR frames (channel-concat) and the timestep (FiLM)."""
+
+    cfg: DiffusionSRConfig
+
+    @nn.compact
+    def __call__(self, z, cond, t):
+        """z: [T, H, W, 3] noisy residual; cond: [T, H, W, 3] bilinear-up
+        LR in [0,1]; t: scalar in [0,1]. Returns v prediction [T, H, W, 3]."""
+        cfg = self.cfg
+        temb = _timestep_embedding(jnp.full((z.shape[0],), t), 4 * cfg.channels)
+        temb = nn.Dense(4 * cfg.channels, param_dtype=jnp.float32)(temb)
+        temb = nn.silu(temb)
+        x = jnp.concatenate([z, cond], axis=-1).astype(jnp.bfloat16)
+        x = nn.Conv(cfg.channels, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+        skips = []
+        ch = cfg.channels
+        for lvl in range(cfg.levels):
+            for _ in range(cfg.blocks):
+                x = _FiLMResBlock(ch)(x, temb)
+            skips.append(x)
+            ch *= 2
+            x = nn.Conv(
+                ch, (3, 3), strides=(2, 2), dtype=jnp.bfloat16, param_dtype=jnp.float32
+            )(x)
+        for _ in range(cfg.blocks):
+            x = _FiLMResBlock(ch)(x, temb)
+        x = _TemporalAttention(cfg.temporal_heads)(x.astype(jnp.float32)).astype(jnp.bfloat16)
+        for _ in range(cfg.blocks):
+            x = _FiLMResBlock(ch)(x, temb)
+        for lvl in reversed(range(cfg.levels)):
+            ch //= 2
+            t_, h_, w_, c_ = x.shape
+            x = nn.Conv(4 * ch, (3, 3), dtype=jnp.bfloat16, param_dtype=jnp.float32)(x)
+            x = x.reshape(t_, h_, w_, 2, 2, ch).transpose(0, 1, 3, 2, 4, 5).reshape(
+                t_, h_ * 2, w_ * 2, ch
+            )
+            x = jnp.concatenate([x, skips[lvl].astype(jnp.bfloat16)], axis=-1)
+            for _ in range(cfg.blocks):
+                x = _FiLMResBlock(ch)(x, temb)
+        x = nn.GroupNorm(num_groups=min(8, ch))(x)
+        x = nn.silu(x)
+        return nn.Conv(
+            3, (3, 3), dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros,
+        )(x.astype(jnp.float32))
+
+
+def ddim_sample(model, params, cond, cfg: DiffusionSRConfig, rng_key) -> jnp.ndarray:
+    """Deterministic DDIM over ``sample_steps``: returns the denoised
+    residual x0 for one window. v-param identities: x0 = a·z − s·v,
+    eps = s·z + a·v; update z ← a'·x0 + s'·eps."""
+    z = jax.random.normal(rng_key, cond.shape, jnp.float32)
+    ts = jnp.linspace(1.0, 0.0, cfg.sample_steps + 1)
+
+    def body(z, i):
+        t_now, t_next = ts[i], ts[i + 1]
+        a, s = cosine_alpha_sigma(t_now)
+        v = model.apply(params, z, cond, t_now)
+        x0 = a * z - s * v
+        eps = s * z + a * v
+        a2, s2 = cosine_alpha_sigma(t_next)
+        return a2 * x0 + s2 * eps, None
+
+    z, _ = jax.lax.scan(body, z, jnp.arange(cfg.sample_steps))
+    # t=0: alpha=1, sigma=0 -> z IS x0
+    return z
+
+
+class DiffusionSRModel(ModelInterface):
+    MODEL_ID = "diffusion-sr-tpu"
+
+    def __init__(self, cfg: DiffusionSRConfig = DIFF_SR_BASE, *, sp_size: int = 1) -> None:
+        self.cfg = cfg
+        self.sp_size = sp_size  # window chunks sharded over 'seq' when > 1
+        self._sample = None
+        self._params = None
+
+    @property
+    def model_id_names(self) -> list[str]:
+        return [self.MODEL_ID]
+
+    def setup(self) -> None:
+        cfg = self.cfg
+        model = DenoiserUNet(cfg)
+
+        def init(seed: int):
+            s = 16 * cfg.scale
+            dummy = jnp.zeros((cfg.window, s, s, 3), jnp.float32)
+            return model.init(jax.random.PRNGKey(seed), dummy, dummy, jnp.float32(0.5))
+
+        self._params = registry.load_params(self.MODEL_ID, init)
+
+        def sample_chunks(params, conds, keys):
+            # conds: [N, window, H, W, 3] independent chunks
+            return jax.vmap(lambda c, k: ddim_sample(model, params, c, cfg, k))(
+                conds, keys
+            )
+
+        if self.sp_size > 1:
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            devs = np.array(jax.devices()[: self.sp_size])
+            mesh = Mesh(devs, axis_names=("seq",))
+            self._sample = jax.jit(
+                jax.shard_map(
+                    sample_chunks,
+                    mesh=mesh,
+                    in_specs=(P(), P("seq"), P("seq")),
+                    out_specs=P("seq"),
+                    check_vma=False,
+                )
+            )
+        else:
+            self._sample = jax.jit(sample_chunks)
+
+    def upscale_window(self, frames: np.ndarray) -> np.ndarray:
+        """uint8 [T, H, W, 3] -> uint8 [T, H*scale, W*scale, 3]."""
+        if self._sample is None:
+            raise RuntimeError("call setup() first")
+        cfg = self.cfg
+        t, h, w = frames.shape[:3]
+        # fixed-shape chunking: pad the frame axis to a window multiple
+        # (and to the sp shard multiple), one compiled program per shape
+        n_chunk = -(-t // cfg.window)
+        if self.sp_size > 1:
+            n_chunk += (-n_chunk) % self.sp_size
+        t_pad = n_chunk * cfg.window
+        if t_pad != t:
+            frames = np.concatenate([frames, np.repeat(frames[-1:], t_pad - t, 0)])
+        base = jax.image.resize(
+            jnp.asarray(frames, jnp.float32) / 255.0,
+            (t_pad, h * cfg.scale, w * cfg.scale, 3),
+            "bilinear",
+        )
+        conds = base.reshape(n_chunk, cfg.window, h * cfg.scale, w * cfg.scale, 3)
+        # per-chunk FIXED seeds: identical input -> identical output
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(n_chunk, dtype=jnp.uint32))
+        residual = self._sample(self._params, conds, keys)
+        out = jnp.clip(conds + residual, 0.0, 1.0).reshape(t_pad, h * cfg.scale, w * cfg.scale, 3)
+        return np.asarray((out * 255.0).astype(jnp.uint8))[:t]

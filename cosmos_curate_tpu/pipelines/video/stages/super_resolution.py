@@ -47,14 +47,36 @@ class SuperResolutionStage(Stage[SplitPipeTask, SplitPipeTask]):
     def __init__(
         self,
         *,
-        cfg: SRConfig = SR_BASE,
+        cfg: SRConfig | None = None,
         window_len: int = 128,
         overlap: int = 64,
         sp_size: int = 1,
+        variant: str = "diffusion",
+        diffusion_cfg=None,
     ) -> None:
+        """``variant``: "diffusion" (default — the SeedVR2-class windowed
+        conditional diffusion denoiser, models/diffusion_sr.py) or
+        "srnet" (the lighter single-pass conv net). Passing ``cfg`` (an
+        SRConfig) selects srnet; passing both configs is a caller error."""
         self.window_len = window_len
         self.overlap = overlap
-        self._model = SuperResolutionModel(cfg, sp_size=sp_size)
+        if cfg is not None and diffusion_cfg is not None:
+            raise ValueError("pass cfg (srnet) OR diffusion_cfg, not both")
+        if cfg is not None:
+            if variant == "diffusion":
+                logger.info("explicit SRConfig selects the srnet variant")
+            variant = "srnet"
+        if variant == "diffusion":
+            from cosmos_curate_tpu.models.diffusion_sr import (
+                DIFF_SR_BASE,
+                DiffusionSRModel,
+            )
+
+            self._model = DiffusionSRModel(diffusion_cfg or DIFF_SR_BASE, sp_size=sp_size)
+        elif variant == "srnet":
+            self._model = SuperResolutionModel(cfg or SR_BASE, sp_size=sp_size)
+        else:
+            raise ValueError(f"unknown SR variant {variant!r}; have diffusion|srnet")
 
     @property
     def model(self) -> ModelInterface:

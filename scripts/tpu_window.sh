@@ -5,7 +5,8 @@
 #
 #   transnet (600 steps) -> commit -> bench on chip -> commit BENCH json
 #   -> OCR -> commit -> SR -> commit -> tracker -> commit
-#   -> goldens -> kernel validation -> final bench refresh
+#   -> diffusion-SR -> commit -> goldens -> kernel validation
+#   -> final bench refresh
 #
 # Background: the axon TPU relay on this box wedges for hours at a time
 # (docs in ROUND3_NOTES.md). Run this under nohup at session start so any
@@ -87,6 +88,7 @@ for i in $(seq 1 700); do
   train_one ocr-detector-tpu cosmos_curate_tpu.models.ocr_train 3600 || { sleep 60; continue; }
   train_one super-resolution-tpu cosmos_curate_tpu.models.sr_train 3000 || { sleep 60; continue; }
   train_one tracker-siamese-tpu cosmos_curate_tpu.models.tracker_train 3000 || { sleep 60; continue; }
+  train_one diffusion-sr-tpu cosmos_curate_tpu.models.diffusion_sr_train 3600 || { sleep 60; continue; }
   log "ALL_TRAINED — running goldens"
   PYTHONPATH= JAX_PLATFORMS=cpu timeout 1800 python -m pytest tests/models -q 2>&1 | tail -3
   log "validating Pallas kernels on chip"

@@ -1,0 +1,104 @@
+"""Diffusion SR: denoiser shapes, DDIM determinism, training sanity,
+stage integration (tiny config, CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.models.diffusion_sr import (
+    DIFF_SR_TINY_TEST,
+    DenoiserUNet,
+    DiffusionSRModel,
+    cosine_alpha_sigma,
+)
+
+
+class TestDenoiser:
+    def test_schedule_endpoints(self):
+        a0, s0 = cosine_alpha_sigma(jnp.float32(0.0))
+        a1, s1 = cosine_alpha_sigma(jnp.float32(1.0))
+        assert float(a0) == pytest.approx(1.0) and float(s0) == pytest.approx(0.0)
+        assert float(a1) == pytest.approx(0.0, abs=1e-6)
+        assert float(s1) == pytest.approx(1.0)
+
+    def test_forward_shapes(self):
+        cfg = DIFF_SR_TINY_TEST
+        model = DenoiserUNet(cfg)
+        z = jnp.zeros((cfg.window, 16, 16, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), z, z, jnp.float32(0.5))
+        v = model.apply(params, z, z, jnp.float32(0.5))
+        assert v.shape == z.shape and v.dtype == jnp.float32
+        # zero-init output head: v starts at exactly 0 (identity residual)
+        assert float(jnp.abs(v).max()) == 0.0
+
+
+class TestModel:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = DiffusionSRModel(DIFF_SR_TINY_TEST)
+        m.setup()
+        return m
+
+    def test_upscale_shapes_and_determinism(self, model):
+        frames = np.random.default_rng(0).integers(0, 255, (5, 12, 16, 3), np.uint8)
+        out1 = model.upscale_window(frames)
+        out2 = model.upscale_window(frames)
+        s = model.cfg.scale
+        assert out1.shape == (5, 12 * s, 16 * s, 3) and out1.dtype == np.uint8
+        np.testing.assert_array_equal(out1, out2)  # fixed per-window seeds
+
+    def test_random_init_output_tracks_bilinear_base(self, model):
+        """Zero-init output head -> first denoise step returns ~the
+        bilinear base even untrained (no garbage before weights land)."""
+        frames = np.full((2, 8, 8, 3), 128, np.uint8)
+        out = model.upscale_window(frames)
+        assert abs(int(out.mean()) - 128) <= 2
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        from cosmos_curate_tpu.models.diffusion_sr_train import train
+
+        # few steps at tiny shapes: v-MSE must drop from the unit-variance
+        # start (zero-init head predicts 0; E||v_target||^2 ≈ 1)
+        _, loss = train(
+            DIFF_SR_TINY_TEST, steps=30, batch=2, hr_size=16, lr=2e-3, log_every=0
+        )
+        assert np.isfinite(loss) and loss < 0.9
+
+    def test_synthesized_windows_are_consistent(self):
+        from cosmos_curate_tpu.models.diffusion_sr_train import synthesize_windows
+
+        conds, residuals = synthesize_windows(
+            np.random.default_rng(0), 2, 3, 16, 2
+        )
+        assert conds.shape == residuals.shape == (2, 3, 16, 16, 3)
+        # residual + cond reconstructs a valid image
+        hr = conds + residuals
+        assert hr.min() >= -1e-3 and hr.max() <= 1.0 + 1e-3
+
+
+class TestStage:
+    def test_sr_stage_runs_diffusion_variant(self):
+        from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+        from cosmos_curate_tpu.pipelines.video.stages.super_resolution import (
+            SuperResolutionStage,
+        )
+        from cosmos_curate_tpu.video.decode import extract_video_metadata
+        from cosmos_curate_tpu.video.encode import encode_frames
+
+        frames = np.random.default_rng(1).integers(0, 255, (6, 16, 16, 3), np.uint8)
+        clip = Clip(uuid="c0", source_video="v", span=(0.0, 0.25))
+        clip.encoded_data = encode_frames(frames, fps=24.0)
+        video = Video(path="v")
+        video.clips = [clip]
+        stage = SuperResolutionStage(
+            diffusion_cfg=DIFF_SR_TINY_TEST, window_len=4, overlap=2
+        )
+        stage._model.setup()
+        stage.process_data([SplitPipeTask(video=video)])
+        assert not clip.errors
+        meta = extract_video_metadata(clip.encoded_data)
+        assert (meta.height, meta.width) == (32, 32)  # 2x upscaled
