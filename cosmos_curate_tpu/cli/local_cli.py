@@ -18,6 +18,7 @@ CAPTION_MODEL_CHOICES = (
     "qwen25vl-7b",
     "qwen2vl-2b",
     "qwen3moe-a3b-lm",
+    "qwen3vl-moe-a3b",
     "qwen3moe-tiny-test",
     "qwen-chat-tiny-test",
     "tiny-test",

@@ -278,6 +278,92 @@ def qwen2_vision_config(hf_vision_config, **overrides):
     return QwenVisionConfig(**kw)
 
 
+def qwen3_vision_config(hf_vision_config, **overrides):
+    """Our QwenVisionConfig (variant="qwen3") from an HF
+    Qwen3VL(Moe)VisionConfig."""
+    from cosmos_curate_tpu.models.vlm.vision_qwen import QwenVisionConfig
+
+    c = hf_vision_config
+    kw = dict(
+        depth=c.depth,
+        embed_dim=c.hidden_size,
+        num_heads=c.num_heads,
+        hidden_size=c.out_hidden_size,
+        intermediate_size=c.intermediate_size,
+        patch_size=c.patch_size,
+        temporal_patch_size=c.temporal_patch_size,
+        spatial_merge_size=c.spatial_merge_size,
+        in_channels=c.in_channels,
+        variant="qwen3",
+        pos_embed_side=int(round(c.num_position_embeddings**0.5)),
+        deepstack_indexes=tuple(c.deepstack_visual_indexes),
+    )
+    kw.update(overrides)
+    return QwenVisionConfig(**kw)
+
+
+def convert_qwen3_vision(state_dict, cfg) -> tuple[dict, ConversionReport]:
+    """HF Qwen3-VL vision tensors → our qwen3-variant tower params.
+
+    Accepts the standalone vision-model layout and ``model.visual.`` /
+    ``visual.`` prefixed exports. Conv3d patchify flattens exactly like
+    convert_qwen2_vision; the learned pos-embed Embedding maps verbatim;
+    deepstack mergers land as ds{level}_{norm,fc1,fc2}."""
+    sd = dict(state_dict)
+    report = ConversionReport()
+    prefix = ""
+    for cand in ("", "visual.", "model.visual."):
+        if f"{cand}patch_embed.proj.weight" in sd:
+            prefix = cand
+            break
+
+    def take(name: str) -> np.ndarray:
+        report.mapped.append(name)
+        return _t(sd[name])
+
+    def lin(stem: str) -> dict:
+        return {"kernel": take(f"{stem}.weight").T, "bias": take(f"{stem}.bias")}
+
+    def ln(stem: str) -> dict:
+        return {"scale": take(f"{stem}.weight"), "bias": take(f"{stem}.bias")}
+
+    conv = take(f"{prefix}patch_embed.proj.weight")  # [E, C, tps, ps, ps]
+    params: dict = {
+        "patch_embed": {
+            "kernel": conv.reshape(conv.shape[0], -1).T,
+            "bias": take(f"{prefix}patch_embed.proj.bias"),
+        },
+        "pos_embed": take(f"{prefix}pos_embed.weight"),
+    }
+    for i in range(cfg.depth):
+        e = f"{prefix}blocks.{i}."
+        params[f"block_{i}"] = {
+            "ln1": ln(f"{e}norm1"),
+            "ln2": ln(f"{e}norm2"),
+            "qkv": lin(f"{e}attn.qkv"),
+            "proj": lin(f"{e}attn.proj"),
+            "fc1": lin(f"{e}mlp.linear_fc1"),
+            "fc2": lin(f"{e}mlp.linear_fc2"),
+        }
+    params["ln_q"] = ln(f"{prefix}merger.norm")
+    params["merger_fc1"] = lin(f"{prefix}merger.linear_fc1")
+    params["merger_fc2"] = lin(f"{prefix}merger.linear_fc2")
+    for level in range(len(cfg.deepstack_indexes)):
+        d = f"{prefix}deepstack_merger_list.{level}."
+        params[f"ds{level}_norm"] = ln(f"{d}norm")
+        params[f"ds{level}_fc1"] = lin(f"{d}linear_fc1")
+        params[f"ds{level}_fc2"] = lin(f"{d}linear_fc2")
+    mapped = set(report.mapped)
+    report.unmapped.extend(
+        k for k in sd if k not in mapped and (not prefix or k.startswith(prefix))
+    )
+    logger.info(
+        "converted Qwen3 vision tower: %d tensors mapped, %d unmapped",
+        len(report.mapped), len(report.unmapped),
+    )
+    return {"params": params}, report
+
+
 def convert_qwen2_vision(state_dict, depth: int) -> tuple[dict, ConversionReport]:
     """HF ``visual.*`` tensors → our QwenVisionTower params subtree.
 

@@ -67,6 +67,7 @@ for _mid, _desc in [
     ("caption-qwen2vl-2b-tpu", "Qwen2-VL-2B-class captioner (converted checkpoint slot)"),
     ("caption-qwen25vl-7b-tpu", "Qwen2.5-VL-7B/CosmosReason-class captioner (converted checkpoint slot)"),
     ("caption-qwen3moe-a3b-tpu", "Qwen3-MoE-A3B-class chat LM, expert-parallel (converted checkpoint slot)"),
+    ("caption-qwen3vl-moe-a3b-tpu", "Qwen3-VL-MoE-A3B captioner: deepstack vision + sparse LM (converted checkpoint slot)"),
     ("t5-encoder-tpu", "text encoder for caption embeddings"),
     ("ocr-detector-tpu", "overlay-text region detector (Flax FCN)"),
     ("ocr-recognizer-tpu", "text recognizer CRNN with CTC decoding"),

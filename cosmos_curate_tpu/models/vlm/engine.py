@@ -117,6 +117,9 @@ class _PendingPrefill:
     rope_pos: np.ndarray  # [T] or [T, 3]
     next_rope: int
     progress: int = 0  # prompt tokens already written to the cache
+    # qwen3 deepstack visual features [L_ds, T, D] (zeros at text
+    # positions), chunk-sliced alongside embeds; None otherwise
+    ds: np.ndarray | None = None
 
 
 @dataclass
@@ -214,7 +217,7 @@ class CaptionEngine:
         if self.params is None:
             size = (
                 cfg.qwen_vision.image_size
-                if cfg.vision_variant == "qwen2"
+                if cfg.vision_variant in ("qwen2", "qwen3")
                 else cfg.vision.image_size
             )
             frames = jnp.zeros((1, 1, size, size, 3), jnp.uint8)
@@ -242,9 +245,15 @@ class CaptionEngine:
             return model.apply(params, ids, method=model.embed_tokens)
 
         mrope = cfg.mrope_section is not None
+        # qwen3 deepstack: number of LM layers receiving visual injections
+        self._ds_levels = (
+            len(cfg.qwen_vision.deepstack_indexes)
+            if cfg.vision_variant == "qwen3" and cfg.qwen_vision is not None
+            else 0
+        )
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_batch(params, cache_k, cache_v, embeds, slots, write_index, t_valid, rope_pos):
+        def prefill_batch(params, cache_k, cache_v, embeds, slots, write_index, t_valid, rope_pos, ds=None):
             """Batched prefill (replaces the round-1 one-request-at-a-time
             admission — the reference leans on vLLM's batched prefill,
             vllm_interface.py:543). embeds: [N, Tb, D] (bucket- or
@@ -263,6 +272,7 @@ class CaptionEngine:
                 rope_pos,
                 write_index,
                 write_index + t_valid,
+                deepstack=ds,
             )
             cache_k = cache_k.at[:, slots].set(nk)
             cache_v = cache_v.at[:, slots].set(nv)
@@ -455,7 +465,7 @@ class CaptionEngine:
                 break  # head-of-line waits for a slot to free (FIFO)
             self.waiting.pop(0)
             try:
-                embeds, t_valid, rope_pos, next_rope = self._prepare_embeds(req)
+                embeds, t_valid, rope_pos, next_rope, ds = self._prepare_embeds(req)
             except Exception:
                 logger.exception("prefill prep failed for %s; dropping", req.request_id)
                 continue
@@ -489,11 +499,12 @@ class CaptionEngine:
                     t_valid=t_valid,
                     rope_pos=np.asarray(rope_pos),
                     next_rope=next_rope,
+                    ds=ds,
                 )
                 continue
             bucket = min(next_pow2(t_valid), lane.length)
             groups.setdefault((self.lanes.index(lane), bucket), []).append(
-                (slot_idx, req, embeds, t_valid, rope_pos, next_rope)
+                (slot_idx, req, embeds, t_valid, rope_pos, next_rope, ds)
             )
             # reserve the slot so this loop's later iterations see it taken
             lane.reserved.add(slot_idx)
@@ -533,18 +544,30 @@ class CaptionEngine:
         frames, eff_fps = self._fit_frames_to_budget(req)
         parts = []
         grid_merged = None
+        ds_vis = None
         if req.prefix_ids:
             pre = jnp.asarray(req.prefix_ids, jnp.int32)
             parts.append(self._embed_tokens(self.params, pre[None])[0])
         if frames is not None:
             vis = self._encode_images(self.params, jnp.asarray(frames)[None])
+            if isinstance(vis, tuple):  # qwen3: (embeds, deepstack levels)
+                vis, ds_levels = vis
+                ds_vis = np.asarray(ds_levels[:, 0], np.float32)  # [L_ds, T_vis, D]
             parts.append(vis[0])
-            if self.cfg.vision_variant == "qwen2":
+            if self.cfg.vision_variant in ("qwen2", "qwen3"):
                 grid_merged = self.cfg.qwen_vision.merged_grid(frames.shape[0])
         ids = jnp.asarray(req.prompt_ids, jnp.int32)
         parts.append(self._embed_tokens(self.params, ids[None])[0])
         embeds = jnp.concatenate(parts, axis=0)
         t_valid = embeds.shape[0]
+        ds = None
+        if ds_vis is not None:
+            # deepstack buffer over the FULL prompt: zeros at text
+            # positions, the merger levels at the vision span (text-only
+            # requests carry ds=None — the prefill buffers read as zeros)
+            ds = np.zeros((self._ds_levels, t_valid, embeds.shape[-1]), np.float32)
+            off = len(req.prefix_ids)
+            ds[:, off : off + ds_vis.shape[1]] = ds_vis
         if self.cfg.mrope_section is not None:
             n_vis = t_valid - len(req.prefix_ids) - len(req.prompt_ids)
             if grid_merged is None and n_vis:
@@ -583,8 +606,10 @@ class CaptionEngine:
             # last); rope positions stay absolute for the kept tokens
             embeds = embeds[-budget:]
             rope_pos = rope_pos[-budget:]
+            if ds is not None:
+                ds = ds[:, -budget:]
             t_valid = budget
-        return embeds, t_valid, rope_pos, next_rope
+        return embeds, t_valid, rope_pos, next_rope, ds
 
     def fit_max_new_tokens(
         self,
@@ -602,7 +627,7 @@ class CaptionEngine:
         return max(1, min(requested, self._max_len - n - 1))
 
     def _vision_token_count(self, n_frames: int) -> int:
-        if self.cfg.vision_variant == "qwen2":
+        if self.cfg.vision_variant in ("qwen2", "qwen3"):
             return self.cfg.qwen_vision.tokens_out(n_frames)
         return self.cfg.vision_tokens
 
@@ -660,16 +685,25 @@ class CaptionEngine:
         mrope = self.cfg.mrope_section is not None
         rope_shape = (n_pad, bucket, 3) if mrope else (n_pad, bucket)
         rope_buf = np.zeros(rope_shape, np.int32)
-        for j, (slot_idx, _req, emb, t_valid, rope_pos, _next) in enumerate(items):
+        ds_buf = (
+            np.zeros((self._ds_levels, n_pad, bucket, dim), np.float32)
+            if self._ds_levels
+            else None
+        )
+        for j, (slot_idx, _req, emb, t_valid, rope_pos, _next, ds) in enumerate(items):
             embeds[j, :t_valid] = np.asarray(emb, np.float32)[:t_valid]
             slots_arr[j] = slot_idx
             t_valids[j] = t_valid
             rope_buf[j, :t_valid] = rope_pos[:t_valid]
+            if ds_buf is not None and ds is not None:
+                ds_buf[:, j, :t_valid] = ds[:, :t_valid]
         for j in range(n, n_pad):  # duplicate row 0 into padding
             embeds[j] = embeds[0]
             slots_arr[j] = slots_arr[0]
             t_valids[j] = t_valids[0]
             rope_buf[j] = rope_buf[0]
+            if ds_buf is not None:
+                ds_buf[:, j] = ds_buf[:, 0]
         logits, lane.cache_k, lane.cache_v = self._prefill_batch(
             self.params,
             lane.cache_k,
@@ -679,9 +713,10 @@ class CaptionEngine:
             jnp.zeros(n_pad, jnp.int32),
             jnp.asarray(t_valids),
             jnp.asarray(rope_buf),
+            None if ds_buf is None else jnp.asarray(ds_buf),
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
-        for j, (slot_idx, req, _emb, t_valid, _rope, next_rope) in enumerate(items):
+        for j, (slot_idx, req, _emb, t_valid, _rope, next_rope, _ds) in enumerate(items):
             self._start_slot(lane, slot_idx, req, t_valid, next_rope, logits_np[j])
 
     def _start_slot(
@@ -752,6 +787,11 @@ class CaptionEngine:
         write_idx = np.zeros(n_pad, np.int32)
         chunk_valid = np.ones(n_pad, np.int32)
         rope_buf = np.zeros((n_pad, C, 3) if mrope else (n_pad, C), np.int32)
+        ds_buf = (
+            np.zeros((self._ds_levels, n_pad, C, dim), np.float32)
+            if self._ds_levels
+            else None
+        )
         for j, (slot_idx, p) in enumerate(items):
             take = min(C, p.t_valid - p.progress)
             embeds[j, :take] = p.embeds[p.progress : p.progress + take]
@@ -759,12 +799,16 @@ class CaptionEngine:
             write_idx[j] = p.progress
             chunk_valid[j] = take
             rope_buf[j, :take] = p.rope_pos[p.progress : p.progress + take]
+            if ds_buf is not None and p.ds is not None:
+                ds_buf[:, j, :take] = p.ds[:, p.progress : p.progress + take]
         for j in range(n, n_pad):  # duplicate row 0 (identical writes: safe)
             embeds[j] = embeds[0]
             slots_arr[j] = slots_arr[0]
             write_idx[j] = write_idx[0]
             chunk_valid[j] = chunk_valid[0]
             rope_buf[j] = rope_buf[0]
+            if ds_buf is not None:
+                ds_buf[:, j] = ds_buf[:, 0]
         logits, lane.cache_k, lane.cache_v = self._prefill_batch(
             self.params,
             lane.cache_k,
@@ -774,6 +818,7 @@ class CaptionEngine:
             jnp.asarray(write_idx),
             jnp.asarray(chunk_valid),
             jnp.asarray(rope_buf),
+            None if ds_buf is None else jnp.asarray(ds_buf),
         )
         finished = []
         for j, (slot_idx, p) in enumerate(items):

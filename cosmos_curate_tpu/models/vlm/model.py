@@ -30,6 +30,8 @@ from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_TINY_TEST, ViT, ViTConfig
 from cosmos_curate_tpu.models.vlm.vision_qwen import (
     QWEN2_VL_2B_VISION,
     QWEN25_VL_7B_VISION,
+    QWEN3_VL_MOE_VISION,
+    QWEN3_VISION_TINY_TEST,
     QWEN_VISION_TINY_TEST,
     QwenVisionConfig,
     QwenVisionTower,
@@ -172,6 +174,46 @@ VLM_QWEN3_MOE_A3B = VLMConfig(
     tied_embeddings=False,
     moe=MoEConfig(n_experts=128, top_k=8, hidden=768, capacity_factor=2.0),
 )
+# Full Qwen3-VL-MoE: the deepstack vision tower + sparse LM (reference's
+# newest captioner roster, vllm_qwen.py:313-349). Nominal 30B-A3B shapes;
+# conversion derives exact configs from the checkpoint
+# (qwen3_moe_lm_config + qwen3_vision_config).
+VLM_QWEN3_VL_MOE_A3B = VLMConfig(
+    vocab=151936,
+    dim=2048,
+    n_layers=48,
+    n_heads=32,
+    n_kv_heads=4,
+    head_dim=128,
+    hidden_mult=6144 / 2048,
+    max_seq=4096,
+    rope_theta=1_000_000.0,
+    qkv_bias=False,
+    qk_norm=True,
+    vision=VIT_TINY_TEST,
+    vision_variant="qwen3",
+    qwen_vision=QWEN3_VL_MOE_VISION,
+    mrope_section=(24, 20, 20),
+    mrope_interleaved=True,
+    tied_embeddings=False,
+    moe=MoEConfig(n_experts=128, top_k=8, hidden=768, capacity_factor=2.0),
+)
+VLM_QWEN3VL_TINY_TEST = VLMConfig(
+    vocab=512,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    head_dim=16,
+    max_seq=128,
+    vision=VIT_TINY_TEST,
+    vision_variant="qwen3",
+    qwen_vision=QWEN3_VISION_TINY_TEST,
+    mrope_section=(2, 3, 3),
+    mrope_interleaved=True,
+    qk_norm=True,
+    moe=MoEConfig(n_experts=4, top_k=2, hidden=32),
+)
 VLM_MOE_TINY_TEST = VLMConfig(
     vocab=512,
     dim=64,
@@ -289,13 +331,20 @@ VLM_FLAVORS.update(
             kv_lanes=((1024, 4), (4096, 2)),
         ),
         "tiny-test": FlavorSpec(VLM_TINY_TEST, "caption-vlm-tpu", require_weights=False),
-        # MoE chat-LM for the text-only caption-family paths (enhancement);
-        # captioning with frames needs the pending Qwen3-VL vision tower
+        # MoE chat-LM slot for LM-ONLY converted checkpoints (enhancement
+        # and other text paths); the full-VL flavor below serves frames
         "qwen3moe-a3b-lm": FlavorSpec(
             VLM_QWEN3_MOE_A3B,
             "caption-qwen3moe-a3b-tpu",
             hf_chat=True,
-            text_only=True,  # Qwen3-VL deepstack vision tower pending
+            text_only=True,  # this slot's checkpoints carry no vision params
+            kv_lanes=((1024, 4), (4096, 2)),
+        ),
+        # full Qwen3-VL-MoE: deepstack vision + EP-sharded sparse LM
+        "qwen3vl-moe-a3b": FlavorSpec(
+            VLM_QWEN3_VL_MOE_A3B,
+            "caption-qwen3vl-moe-a3b-tpu",
+            hf_chat=True,
             kv_lanes=((1024, 4), (4096, 2)),
         ),
         "qwen3moe-tiny-test": FlavorSpec(
@@ -631,7 +680,7 @@ class VLM(nn.Module):
             if cfg.tied_embeddings
             else dense(cfg.vocab, "out", name="lm_head", use_bias=False, dtype=jnp.float32)
         )
-        if cfg.vision_variant == "qwen2":
+        if cfg.vision_variant in ("qwen2", "qwen3"):
             self.vision_tower = QwenVisionTower(cfg.qwen_vision, dtype=self.dtype, name="vision")
             self.projector = None  # the Qwen merger already maps to LM dim
         else:
@@ -653,9 +702,12 @@ class VLM(nn.Module):
         ``qwen2`` variant: frames → 3D patches → QwenVisionTower; the merged
         token grid (t·h·w/merge²) IS the LM embedding sequence, ordered
         t-major row-major (what build_mrope_positions assumes).
+        ``qwen3`` variant: same, but returns (embeds, deepstack) — the
+        deepstack levels [L_ds, B, T_vis, dim] inject into the first LM
+        layers (HF Qwen3VLTextModel._deepstack_process).
         """
         cfg = self.cfg
-        if cfg.vision_variant == "qwen2":
+        if cfg.vision_variant in ("qwen2", "qwen3"):
             patches, grid = frames_to_patches(frames_u8, cfg.qwen_vision)
             return self.vision_tower(patches, grid)
         b, n = frames_u8.shape[:2]
@@ -678,6 +730,11 @@ class VLM(nn.Module):
         params for modules traced during init)."""
         vis = self.encode_images(frames_u8)
         txt = self.embed_tokens(token_ids)
+        deepstack = None
+        if isinstance(vis, tuple):  # qwen3: (embeds, deepstack levels)
+            vis, ds = vis
+            pad = jnp.zeros((ds.shape[0], ds.shape[1], txt.shape[1], ds.shape[-1]), ds.dtype)
+            deepstack = jnp.concatenate([ds, pad], axis=2)
         embeds = jnp.concatenate([vis, txt], axis=1)
         t = embeds.shape[1]
         positions = jnp.broadcast_to(jnp.arange(t), (embeds.shape[0], t))
@@ -688,18 +745,27 @@ class VLM(nn.Module):
             positions,
             jnp.zeros((embeds.shape[0],), jnp.int32),
             jnp.full((embeds.shape[0],), t, jnp.int32),
+            deepstack=deepstack,
         )
 
-    def __call__(self, embeds, cache_k, cache_v, positions, write_index, kv_len):
+    def __call__(
+        self, embeds, cache_k, cache_v, positions, write_index, kv_len, deepstack=None
+    ):
         """Forward over input *embeddings* (text and vision already spliced).
 
-        embeds: [B, T, D]; cache_k/v: [L, B, S, Hkv, Dh].
+        embeds: [B, T, D]; cache_k/v: [L, B, S, Hkv, Dh]; deepstack:
+        optional [L_ds, B, T, D] visual features added to the hidden states
+        AFTER each of the first L_ds layers (zeros at text positions — HF
+        Qwen3VL deepstack semantics; prefill-only, decode passes None).
         Returns (logits [B, T, vocab], new_cache_k, new_cache_v).
         """
         x = embeds.astype(self.dtype)
+        n_ds = 0 if deepstack is None else deepstack.shape[0]
         new_ks, new_vs = [], []
         for i, layer in enumerate(self.layers):
             x, nk, nv = layer(x, cache_k[i], cache_v[i], positions, write_index, kv_len)
+            if i < n_ds:
+                x = x + deepstack[i].astype(x.dtype)
             new_ks.append(nk)
             new_vs.append(nv)
         x = self.ln_f(x)

@@ -57,6 +57,12 @@ class QwenVisionConfig:
     # t_index = floor(grid_t_idx * second_per_grid_t * tokens_per_second)
     # (HF get_rope_index). None = unscaled (Qwen2-VL behavior).
     tokens_per_second: float | None = None
+    # "qwen3" (deepstack) only: side length of the learned pos-embed grid
+    # (HF num_position_embeddings = side²), bilinearly interpolated to the
+    # actual patch grid; and the block indexes whose hidden states feed
+    # the deepstack mergers (injected into the LM's first layers).
+    pos_embed_side: int = 0
+    deepstack_indexes: tuple[int, ...] = ()
 
     @property
     def mlp_hidden(self) -> int:
@@ -106,6 +112,32 @@ QWEN25_VL_7B_VISION = QwenVisionConfig(
     fullatt_block_indexes=(7, 15, 23, 31),
     tokens_per_second=2.0,  # HF Qwen2.5-VL vision_config.tokens_per_second
 )
+# Qwen3-VL(-MoE) deepstack vision tower (SigLIP-shaped: 27 deep / 1152 /
+# 16 heads / gelu-tanh MLP 4304), learned 48x48 pos-embed grid, deepstack
+# taps at blocks 8/16/24; merger projects into the LM dim per checkpoint.
+QWEN3_VL_MOE_VISION = QwenVisionConfig(
+    depth=27,
+    embed_dim=1152,
+    num_heads=16,
+    hidden_size=2048,  # 30B-A3B text hidden; conversion derives from config
+    intermediate_size=4304,
+    patch_size=16,
+    variant="qwen3",
+    pos_embed_side=48,
+    deepstack_indexes=(8, 16, 24),
+)
+QWEN3_VISION_TINY_TEST = QwenVisionConfig(
+    depth=3,
+    embed_dim=32,
+    num_heads=4,
+    hidden_size=64,
+    intermediate_size=64,
+    patch_size=8,
+    image_size=32,
+    variant="qwen3",
+    pos_embed_side=4,
+    deepstack_indexes=(0, 1),
+)
 QWEN_VISION_TINY_TEST = QwenVisionConfig(
     depth=2,
     embed_dim=64,
@@ -115,6 +147,45 @@ QWEN_VISION_TINY_TEST = QwenVisionConfig(
     patch_size=8,
     image_size=32,
 )
+
+
+def pos_embed_interp_matrix(cfg: QwenVisionConfig, grid: tuple[int, int, int]) -> np.ndarray:
+    """Host-side [h*w, side²] bilinear interpolation matrix mapping the
+    learned pos-embed table onto ONE temporal slice of the (t, h, w) patch
+    grid in merge-window order (HF ``fast_pos_embed_interpolate``
+    semantics: linspace over the side, 4-neighbor weights, merge
+    permutation; the caller broadcasts the interpolated product over t —
+    tiling the matrix itself would bake a t× larger constant into the
+    jitted program)."""
+    _t, h, w = grid
+    side = cfg.pos_embed_side
+    msz = cfg.spatial_merge_size
+    h_idx = np.linspace(0, side - 1, h)
+    w_idx = np.linspace(0, side - 1, w)
+    h0 = h_idx.astype(np.int64)
+    w0 = w_idx.astype(np.int64)
+    h1 = np.clip(h0 + 1, None, side - 1)
+    w1 = np.clip(w0 + 1, None, side - 1)
+    dh = (h_idx - h0)[:, None]
+    dw = (w_idx - w0)[None, :]
+    mat = np.zeros((h * w, side * side), np.float32)
+    rows = np.arange(h * w).reshape(h, w)
+    for hi, wi, wgt in (
+        (h0, w0, (1 - dh) * (1 - dw)),
+        (h0, w1, (1 - dh) * dw),
+        (h1, w0, dh * (1 - dw)),
+        (h1, w1, dh * dw),
+    ):
+        cols = hi[:, None] * side + wi[None, :]
+        # accumulate: clipped edge neighbors can collide on the same cell
+        np.add.at(mat, (rows.reshape(-1), cols.reshape(-1)), wgt.reshape(-1))
+    perm = (
+        np.arange(h * w)
+        .reshape(h // msz, msz, w // msz, msz)
+        .transpose(0, 2, 1, 3)
+        .reshape(-1)
+    )
+    return mat[perm]  # [h*w, side²] in merge-window order
 
 
 def rotary_tables(cfg: QwenVisionConfig, grid: tuple[int, int, int]) -> np.ndarray:
@@ -243,7 +314,10 @@ class QwenVisionBlock(nn.Module):
             y = nn.silu(gate) * up
             return x + dense(cfg.embed_dim, "in", name="down", use_bias=True, dtype=self.dtype)(y)
         y = dense(hdim, "out", name="fc1", use_bias=True, dtype=self.dtype)(y)
-        y = quick_gelu(y)
+        if cfg.variant == "qwen3":  # HF hidden_act gelu_pytorch_tanh
+            y = nn.gelu(y, approximate=True)
+        else:
+            y = quick_gelu(y)
         return x + dense(cfg.embed_dim, "in", name="fc2", use_bias=True, dtype=self.dtype)(y)
 
 
@@ -258,9 +332,25 @@ class QwenVisionTower(nn.Module):
         cfg = self.cfg
         b, s, _ = patches.shape
         assert s == grid[0] * grid[1] * grid[2], (s, grid)
-        x = dense(cfg.embed_dim, None, name="patch_embed", use_bias=False, dtype=self.dtype)(
-            patches.astype(self.dtype)
-        )
+        x = dense(
+            cfg.embed_dim,
+            None,
+            name="patch_embed",
+            use_bias=cfg.variant == "qwen3",  # Qwen3's Conv3d carries a bias
+            dtype=self.dtype,
+        )(patches.astype(self.dtype))
+        if cfg.variant == "qwen3":
+            # learned pos-embed table, bilinearly interpolated to the grid
+            # (host-precomputed static matrix; HF fast_pos_embed_interpolate)
+            table = self.param(
+                "pos_embed",
+                nn.initializers.normal(0.02),
+                (cfg.pos_embed_side**2, cfg.embed_dim),
+                jnp.float32,
+            )
+            interp = jnp.asarray(pos_embed_interp_matrix(cfg, grid))
+            pos = jnp.tile(interp @ table, (grid[0], 1))  # temporal repeat
+            x = (x.astype(jnp.float32) + pos).astype(self.dtype)
         angles = rotary_tables(cfg, grid)
         # per-frame full attention (HF cu_seqlens semantics)
         frame = np.arange(s) // (grid[1] * grid[2])
@@ -276,14 +366,33 @@ class QwenVisionTower(nn.Module):
             windowed_mask = jnp.asarray(seg[:, None] == seg[None, :])
             inverse_unit_perm = np.argsort(unit_perm)
         cos, sin = jnp.cos(jnp.asarray(angles)), jnp.sin(jnp.asarray(angles))
+        msz2 = cfg.spatial_merge_size**2
+        deepstack = []
         for i in range(cfg.depth):
             if cfg.variant == "qwen2_5" and i not in cfg.fullatt_block_indexes:
                 mask = windowed_mask
             else:
                 mask = full_mask
             x = QwenVisionBlock(cfg, dtype=self.dtype, name=f"block_{i}")(x, cos, sin, mask)
+            if cfg.variant == "qwen3" and i in cfg.deepstack_indexes:
+                # deepstack merger (postshuffle norm): merge-window group
+                # FIRST, LayerNorm over the grouped features, then the MLP
+                level = cfg.deepstack_indexes.index(i)
+                d = x.reshape(b, s // msz2, msz2 * cfg.embed_dim)
+                d = nn.LayerNorm(
+                    epsilon=1e-6, dtype=jnp.float32, name=f"ds{level}_norm"
+                )(d)
+                d = dense(
+                    msz2 * cfg.embed_dim, "out", name=f"ds{level}_fc1",
+                    use_bias=True, dtype=self.dtype,
+                )(d)
+                d = nn.gelu(d, approximate=False)
+                d = dense(
+                    cfg.hidden_size, "in", name=f"ds{level}_fc2",
+                    use_bias=True, dtype=self.dtype,
+                )(d)
+                deepstack.append(d)
         # merger: group each merge-window's msz² consecutive tokens
-        msz2 = cfg.spatial_merge_size**2
         if cfg.variant == "qwen2_5":
             x = _VisionRMSNorm(name="ln_q")(x)
         else:
@@ -296,6 +405,8 @@ class QwenVisionTower(nn.Module):
             # undo the window permutation so outputs are t-major row-major
             # (what build_mrope_positions and the engine assume)
             x = x[:, inverse_unit_perm]
+        if cfg.variant == "qwen3":
+            return x, jnp.stack(deepstack) if deepstack else jnp.zeros((0, *x.shape))
         return x
 
 

@@ -507,3 +507,77 @@ class TestMRopeTemporalScaling:
         b, nb = build_mrope_positions(3, (2, 2, 2), 4, 1.0)
         np.testing.assert_array_equal(a, b)
         assert na == nb
+
+
+class TestQwen3VisionParity:
+    """Qwen3-VL deepstack vision tower (learned interpolated pos embed,
+    LayerNorm blocks with gelu-tanh MLP, multi-level deepstack mergers —
+    the tower behind the reference's Qwen3-VL MoE captioners)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        import torch
+        from transformers.models.qwen3_vl_moe.configuration_qwen3_vl_moe import (
+            Qwen3VLMoeVisionConfig,
+        )
+        from transformers.models.qwen3_vl_moe.modeling_qwen3_vl_moe import (
+            Qwen3VLMoeVisionModel,
+        )
+
+        from cosmos_curate_tpu.models.convert_qwen import (
+            convert_qwen3_vision,
+            qwen3_vision_config,
+        )
+
+        hf_cfg = Qwen3VLMoeVisionConfig(
+            depth=3,
+            hidden_size=32,
+            intermediate_size=64,
+            num_heads=4,
+            patch_size=8,
+            temporal_patch_size=2,
+            spatial_merge_size=2,
+            out_hidden_size=64,
+            # 4x4 learned grid under a 6x6 patch grid: linspace(0,3,6) is
+            # FRACTIONAL, so the bilinear 4-neighbor weights are actually
+            # exercised (an even division would collapse them to one-hot)
+            num_position_embeddings=16,
+            deepstack_visual_indexes=[0, 1],
+        )
+        torch.manual_seed(5)
+        hf = Qwen3VLMoeVisionModel(hf_cfg).eval()
+        ours_cfg = qwen3_vision_config(hf_cfg, image_size=48)
+        params, report = convert_qwen3_vision(hf.state_dict(), ours_cfg)
+        return hf, ours_cfg, params, report
+
+    def test_conversion_complete(self, pair):
+        _, _, _, report = pair
+        assert not report.unmapped, report.unmapped
+
+    def test_tower_and_deepstack_match(self, pair):
+        import torch
+
+        from cosmos_curate_tpu.models.vlm.vision_qwen import (
+            QwenVisionTower,
+            frames_to_patches,
+        )
+
+        hf, cfg, params, _ = pair
+        rng = np.random.default_rng(9)
+        frames = rng.integers(0, 255, (1, 4, 48, 48, 3), np.uint8)
+        patches, grid = frames_to_patches(jnp.asarray(frames), cfg)
+        with torch.no_grad():
+            want, want_ds = hf(
+                torch.from_numpy(np.asarray(patches))[0],
+                grid_thw=torch.tensor([list(grid)]),
+            )
+        tower = QwenVisionTower(cfg, dtype=jnp.float32)
+        got, got_ds = tower.apply(params, patches, grid)
+        np.testing.assert_allclose(
+            np.asarray(got[0]), want.numpy(), atol=2e-4, rtol=1e-3
+        )
+        assert got_ds.shape[0] == len(want_ds) == 2
+        for lvl in range(2):
+            np.testing.assert_allclose(
+                np.asarray(got_ds[lvl, 0]), want_ds[lvl].numpy(), atol=2e-4, rtol=1e-3
+            )

@@ -560,7 +560,7 @@ class TestPromptBudgetGuard:
             frames=frames,
             sampling=SamplingConfig(max_new_tokens=100),
         )
-        embeds, t_valid, rope_pos, _ = eng._prepare_embeds(req)
+        embeds, t_valid, rope_pos, _, _ = eng._prepare_embeds(req)
         assert t_valid == 25  # 5 text + 20 vision, nothing sliced
         assert embeds.shape[0] == t_valid == rope_pos.shape[0]
 
@@ -586,5 +586,5 @@ class TestPromptBudgetGuard:
             frames=frames,
             sampling=SamplingConfig(max_new_tokens=8),
         )
-        _, t_valid, _, _ = eng._prepare_embeds(req)
+        _, t_valid, _, _, _ = eng._prepare_embeds(req)
         assert t_valid == 3 + eng.cfg.qwen_vision.tokens_out(4)
