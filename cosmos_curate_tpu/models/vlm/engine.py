@@ -446,13 +446,13 @@ class CaptionEngine:
         return first_idle
 
     def _prompt_len_estimate(self, req: CaptionRequest) -> int:
-        """Prompt length WITHOUT running the encoders (used for routing)."""
+        """Prompt length WITHOUT running the encoders (used for routing).
+        Must use the exact per-variant token count: an under-estimate
+        routes to a too-short lane and the multimodal guard then drops the
+        request instead of serving it from a longer lane."""
         n = len(req.prefix_ids) + len(req.prompt_ids)
         if req.frames is not None:
-            if self.cfg.vision_variant == "qwen2":
-                n += self.cfg.qwen_vision.tokens_out(req.frames.shape[0])
-            else:
-                n += self.cfg.vision_tokens
+            n += self._vision_token_count(req.frames.shape[0])
         return min(n, self._max_len - req.sampling.max_new_tokens - 1)
 
     def _admit(self) -> None:

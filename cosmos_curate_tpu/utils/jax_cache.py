@@ -21,6 +21,28 @@ CACHE_DIR_ENV = "CURATE_JAX_CACHE_DIR"
 DEFAULT_CACHE_DIR = "/tmp/curate_jax_cache"
 
 
+def _host_fingerprint() -> str:
+    """A short tag of the CPU feature set. XLA:CPU AOT cache entries embed
+    the compile machine's features; loading them on a host with a
+    different set logs 'could lead to SIGILL' and can actually crash
+    (observed: cache written under another feature profile on this box).
+    Keying the cache dir by the host fingerprint makes entries
+    machine-local without giving up cross-process reuse."""
+    import hashlib
+    import platform
+
+    bits = f"{platform.machine()}:{platform.processor()}"
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    bits += ":" + line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(bits.encode()).hexdigest()[:10]
+
+
 def enable_persistent_cache(path: str | None = None) -> str:
     """Idempotently point jax at a persistent compilation cache directory.
 
@@ -29,7 +51,8 @@ def enable_persistent_cache(path: str | None = None) -> str:
     every model path. Returns the cache dir in use.
     """
     global _ENABLED
-    cache_dir = path or os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
+    base = path or os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
+    cache_dir = os.path.join(base, _host_fingerprint())
     with _LOCK:
         if _ENABLED:
             return cache_dir

@@ -378,3 +378,20 @@ class TestEngineDeepstack:
             return eng.run_until_complete()[0].text
 
         assert run(16) == run(128)
+
+    def test_lane_routing_uses_exact_qwen3_vision_count(self):
+        """Routing's prompt estimate must equal the real vision token count
+        for the qwen3 variant — an under-estimate would drop multimodal
+        requests at the lane-budget guard."""
+        from cosmos_curate_tpu.models.vlm import CaptionEngine, CaptionRequest, SamplingConfig
+        from cosmos_curate_tpu.models.vlm.model import VLM_QWEN3VL_TINY_TEST as C
+
+        eng = CaptionEngine(C, max_batch=2)
+        eng.setup()
+        frames = np.zeros((4, 32, 32, 3), np.uint8)
+        req = CaptionRequest(
+            request_id="e", prompt_ids=[1, 2, 3], frames=frames,
+            sampling=SamplingConfig(max_new_tokens=4),
+        )
+        want = 3 + C.qwen_vision.tokens_out(4)
+        assert eng._prompt_len_estimate(req) == min(want, eng._max_len - 5)
