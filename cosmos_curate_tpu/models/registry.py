@@ -106,8 +106,9 @@ def find_model_file(model_id: str, filename: str) -> Path | None:
 
 
 # Non-checkpoint files pulled alongside a caption model's weights: converted
-# HF checkpoints are unusable without their exact-id tokenizer files.
-TOKENIZER_AUX_FILES = ("vocab.json", "merges.txt")
+# HF checkpoints are unusable without their exact-id tokenizer files
+# (GPT-2-format pair for Qwen; tokenizer.json for T5/unigram checkpoints).
+TOKENIZER_AUX_FILES = ("vocab.json", "merges.txt", "tokenizer.json")
 
 
 def stage_weights_on_node(model_ids: list[str]) -> None:

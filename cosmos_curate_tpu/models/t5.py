@@ -26,7 +26,6 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
 from cosmos_curate_tpu.models.batching import pad_batch
 from cosmos_curate_tpu.models.layers import dense
-from cosmos_curate_tpu.models.tokenizer import ByteTokenizer
 
 
 @dataclass(frozen=True)
@@ -204,15 +203,49 @@ class T5EncoderTPU(ModelInterface):
 
     def __init__(self, cfg: T5Config = T5_BASE, *, tokenizer=None) -> None:
         self.cfg = cfg
-        self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
+        # resolution happens in setup(): remote weight/tokenizer staging
+        # runs there, and the guards need to see the staged state
+        self.tokenizer = tokenizer
         self._apply = None
         self._params = None
+
+    def _resolve_tokenizer(self):
+        """Staged ``tokenizer.json`` (exact T5 ids) wins; the byte fallback
+        serves random-init testing ONLY. Guards (mirroring the hf_chat
+        flavors' rule that wrong ids must fail loudly, not embed garbage):
+
+        - a staged checkpoint WITHOUT its tokenizer.json refuses to serve
+          (the embedding table is indexed by sentencepiece ids; pass
+          ``tokenizer=ByteTokenizer()`` explicitly to override);
+        - a tokenizer whose ids exceed ``cfg.vocab`` refuses (XLA's
+          out-of-bounds gather clamps silently)."""
+        from cosmos_curate_tpu.models import registry as _registry
+        from cosmos_curate_tpu.models.tokenizer import ByteTokenizer, t5_tokenizer
+
+        _registry.maybe_pull_tokenizer_files(self.MODEL_ID)
+        tok = t5_tokenizer(self.MODEL_ID)
+        if isinstance(tok, ByteTokenizer) and _registry.find_checkpoint(self.MODEL_ID):
+            raise FileNotFoundError(
+                f"{self.MODEL_ID} has a staged checkpoint but no "
+                f"tokenizer.json — byte-level ids would address wrong "
+                f"embedding rows; stage the checkpoint's tokenizer.json "
+                f"(or pass tokenizer= explicitly for a byte-trained model)"
+            )
+        if tok.vocab_size > self.cfg.vocab:
+            raise ValueError(
+                f"staged tokenizer has {tok.vocab_size} ids but the config "
+                f"embeds only {self.cfg.vocab} — use the matching T5Config "
+                f"(e.g. T5_SMALL for converted checkpoints)"
+            )
+        return tok
 
     @property
     def model_id_names(self) -> list[str]:
         return [self.MODEL_ID]
 
     def setup(self) -> None:
+        if self.tokenizer is None:
+            self.tokenizer = self._resolve_tokenizer()
         model = T5Encoder(self.cfg)
 
         def init(seed: int):
@@ -228,7 +261,17 @@ class T5EncoderTPU(ModelInterface):
         if not texts:
             return []
         tok = self.tokenizer
-        encoded = [tok.encode(t)[: self.cfg.max_len] for t in texts]
+        def _truncate(ids: list[int]) -> list[int]:
+            if len(ids) <= self.cfg.max_len:
+                return ids
+            out = ids[: self.cfg.max_len]
+            # HF fast tokenizers truncate BEFORE post-processing, so the
+            # final special token (</s>) survives; preserve that here
+            if ids[-1] == tok.eos_id and out[-1] != tok.eos_id:
+                out[-1] = tok.eos_id
+            return out
+
+        encoded = [_truncate(tok.encode(t)) for t in texts]
         max_t = max(len(e) for e in encoded)
         # pad T to pow2 and B to pow2 — static shapes for XLA
         from cosmos_curate_tpu.models.batching import next_pow2

@@ -338,6 +338,68 @@ class HFVocabTokenizer:
         return self._pad
 
 
+class HFJsonTokenizer:
+    """Any HF checkpoint's EXACT tokenization via its ``tokenizer.json``,
+    loaded through the ``tokenizers`` library (present in this image —
+    unlike ``sentencepiece``, so T5/unigram checkpoints are servable too).
+    Satisfies the engine tokenizer protocol (encode/decode/decode_bytes/
+    eos_id/pad_id/vocab_size)."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        eos_token: str = "</s>",
+        pad_token: str = "<pad>",
+        add_special_tokens: bool = True,
+    ) -> None:
+        from tokenizers import Tokenizer
+
+        self._tok = Tokenizer.from_file(str(path))
+        self._add_special = add_special_tokens
+        eos = self._tok.token_to_id(eos_token)
+        pad = self._tok.token_to_id(pad_token)
+        if eos is None or pad is None:
+            raise ValueError(
+                f"tokenizer at {path} lacks {eos_token!r}/{pad_token!r}"
+            )
+        self._eos = eos
+        self._pad = pad
+        self.vocab_size = self._tok.get_vocab_size(with_added_tokens=True)
+
+    def encode(self, text: str, *, add_bos: bool = False) -> list[int]:  # noqa: ARG002
+        return self._tok.encode(
+            text, add_special_tokens=self._add_special
+        ).ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def decode_bytes(self, ids: list[int]) -> bytes:
+        return self.decode(ids).encode("utf-8")
+
+    @property
+    def eos_id(self) -> int:
+        return self._eos
+
+    @property
+    def pad_id(self) -> int:
+        return self._pad
+
+
+def t5_tokenizer(model_id: str = "t5-encoder-tpu"):
+    """The T5 serving tokenizer: the checkpoint's staged ``tokenizer.json``
+    when present (exact T5 sentencepiece ids — the embedding table is
+    indexed by them), else the hermetic byte tokenizer with its documented
+    random-init-only caveat."""
+    from cosmos_curate_tpu.models.registry import find_model_file
+
+    p = find_model_file(model_id, "tokenizer.json")
+    if p is not None:
+        return HFJsonTokenizer(p)
+    return ByteTokenizer()
+
+
 # Qwen2/Qwen2.5(-VL) special-token ids (tokenizer_config.json)
 QWEN2_SPECIAL_TOKENS = {
     "<|endoftext|>": 151643,

@@ -130,3 +130,98 @@ class TestQwenChat:
             tok, "enhance this caption", has_vision=False, specials=specials
         )
         assert 9003 not in prefix and 9004 not in prompt
+
+
+class TestHFJsonTokenizer:
+    """tokenizer.json serving (T5/unigram class — sentencepiece itself is
+    absent from this image, the `tokenizers` runtime is not)."""
+
+    @pytest.fixture(scope="class")
+    def spiece_json(self, tmp_path_factory):
+        """A tiny T5-style unigram tokenizer.json built locally."""
+        from tokenizers import Tokenizer, decoders, pre_tokenizers
+        from tokenizers.models import Unigram
+        from tokenizers.processors import TemplateProcessing
+
+        vocab = [("<pad>", 0.0), ("</s>", 0.0), ("<unk>", -2.0)]
+        words = ["▁the", "▁video", "▁shows", "▁a", "▁car", "s", "▁"]
+        vocab += [(w, -1.0) for w in words]
+        vocab += [(c, -5.0) for c in "abcdefghijklmnopqrstuvwxyz"]
+        tok = Tokenizer(Unigram(vocab, unk_id=2))
+        # real T5 tokenizer.json files register these as special added
+        # tokens (what makes skip_special_tokens strip them on decode)
+        tok.add_special_tokens(["<pad>", "</s>"])
+        tok.pre_tokenizer = pre_tokenizers.Metaspace()
+        tok.decoder = decoders.Metaspace()
+        tok.post_processor = TemplateProcessing(
+            single="$A </s>", special_tokens=[("</s>", 1)]
+        )
+        p = tmp_path_factory.mktemp("t5tok") / "tokenizer.json"
+        tok.save(str(p))
+        return p
+
+    def test_matches_transformers_fast_tokenizer(self, spiece_json):
+        from transformers import PreTrainedTokenizerFast
+
+        from cosmos_curate_tpu.models.tokenizer import HFJsonTokenizer
+
+        ours = HFJsonTokenizer(spiece_json)
+        hf = PreTrainedTokenizerFast(
+            tokenizer_file=str(spiece_json), eos_token="</s>", pad_token="<pad>"
+        )
+        text = "the video shows a cars"
+        assert ours.encode(text) == hf(text)["input_ids"]
+        assert ours.encode(text)[-1] == ours.eos_id == 1
+        assert ours.pad_id == 0
+        assert ours.decode(ours.encode(text)).strip() == text
+
+    def test_t5_encoder_picks_up_staged_tokenizer(self, spiece_json, tmp_path, monkeypatch):
+        import shutil
+
+        from cosmos_curate_tpu.models.t5 import T5_TINY_TEST, T5EncoderTPU
+        from cosmos_curate_tpu.models.tokenizer import HFJsonTokenizer
+
+        monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path))
+        d = tmp_path / "t5-encoder-tpu"
+        d.mkdir(parents=True)
+        shutil.copy(spiece_json, d / "tokenizer.json")
+        model = T5EncoderTPU(T5_TINY_TEST)
+        model.setup()  # resolution happens here, after staging would run
+        assert isinstance(model.tokenizer, HFJsonTokenizer)
+        out = model.encode(["the video shows a car"])
+        assert len(out) == 1 and out[0].embedding.shape[-1] == T5_TINY_TEST.dim
+        # eos survives truncation (HF truncates before post-processing)
+        ids = model.tokenizer.encode("z " * 200)
+        assert len(ids) > T5_TINY_TEST.max_len
+        sample = model.encode(["z " * 200])[0]
+        assert sample.tokens[-1] == model.tokenizer.eos_id
+        assert len(sample.tokens) <= T5_TINY_TEST.max_len
+
+    def test_staged_checkpoint_without_tokenizer_refuses(self, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from cosmos_curate_tpu.models import registry
+        from cosmos_curate_tpu.models.t5 import T5_TINY_TEST, T5Encoder, T5EncoderTPU
+
+        monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path))
+        m = T5Encoder(T5_TINY_TEST)
+        params = m.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), jnp.ones((1, 4), bool)
+        )
+        registry.save_params("t5-encoder-tpu", params)
+        with pytest.raises(FileNotFoundError, match="tokenizer.json"):
+            T5EncoderTPU(T5_TINY_TEST).setup()
+
+    def test_oversized_tokenizer_vs_config_refuses(self, spiece_json, tmp_path, monkeypatch):
+        import shutil
+
+        from cosmos_curate_tpu.models.t5 import T5Config, T5EncoderTPU
+
+        monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path))
+        d = tmp_path / "t5-encoder-tpu"
+        d.mkdir(parents=True)
+        shutil.copy(spiece_json, d / "tokenizer.json")
+        tiny_vocab = T5Config(vocab=8, dim=32, d_kv=16, d_ff=64, layers=1, heads=2)
+        with pytest.raises(ValueError, match="embeds only"):
+            T5EncoderTPU(tiny_vocab).setup()
