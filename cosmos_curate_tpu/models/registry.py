@@ -198,8 +198,9 @@ def maybe_pull_remote_weights(model_id: str) -> Path | None:
 
 def maybe_pull_tokenizer_files(model_id: str) -> None:
     """Best-effort pull of the tokenizer sidecar files a converted HF
-    caption checkpoint needs. Called by hf_chat flavors ONLY (repo-native
-    flavors must not pay doomed remote GETs on every setup)."""
+    checkpoint needs. Called only when a converted checkpoint is in play
+    (hf_chat caption flavors; T5 after its checkpoint is staged) —
+    repo-native flavors must not pay doomed remote GETs on every setup."""
     uri = os.environ.get(WEIGHTS_URI_ENV, "").rstrip("/")
     if not uri:
         return

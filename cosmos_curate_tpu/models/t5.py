@@ -222,7 +222,20 @@ class T5EncoderTPU(ModelInterface):
         from cosmos_curate_tpu.models import registry as _registry
         from cosmos_curate_tpu.models.tokenizer import ByteTokenizer, t5_tokenizer
 
-        _registry.maybe_pull_tokenizer_files(self.MODEL_ID)
+        # pull the remote checkpoint FIRST: the staged-checkpoint guard
+        # below must see the same state load_params will (a fresh node
+        # would otherwise accept the byte fallback, then pull the real
+        # checkpoint and serve wrong ids); the sidecar pull happens only
+        # when a converted checkpoint is actually in play, so repo-native
+        # deployments never pay doomed GETs
+        try:
+            _registry.maybe_pull_remote_weights(self.MODEL_ID)
+        except _registry.WeightsIntegrityError:
+            raise
+        except Exception:
+            pass  # load_params retries and reports; resolution uses local state
+        if _registry.find_checkpoint(self.MODEL_ID):
+            _registry.maybe_pull_tokenizer_files(self.MODEL_ID)
         tok = t5_tokenizer(self.MODEL_ID)
         if isinstance(tok, ByteTokenizer) and _registry.find_checkpoint(self.MODEL_ID):
             raise FileNotFoundError(

@@ -532,21 +532,25 @@ class MoEFFN(nn.Module):
         else:
             cap = max(1, min(n, int(np.ceil(k * n / e * moe.capacity_factor))))
         # assignment axis A = N*k, token-major; queue position = number of
-        # earlier assignments to the same expert. Dispatch one-hots are 0/1
-        # — exact in bf16 — so the big [A, E, cap] contraction intermediate
-        # runs in compute dtype, not fp32. The engine's chunked prefill
-        # (prefill_chunk tokens per program) bounds A for the serving path;
-        # a sort-based dispatch kernel is the next step if EP profiling
-        # shows this intermediate as the HBM hot spot.
-        e_onehot32 = jax.nn.one_hot(top_i.reshape(-1), e, dtype=jnp.float32)  # [A, E]
+        # earlier assignments to the same expert. Dispatch/combine are
+        # scatter/gather over queue-slot ids — O(A·D) data movement —
+        # instead of one-hot einsums whose [A, E, cap] contraction costs
+        # as much FLOPs as the expert matmuls themselves.
+        a_ids = top_i.reshape(-1)  # [A] expert id per assignment
+        e_onehot32 = jax.nn.one_hot(a_ids, e, dtype=jnp.float32)  # [A, E]
         prior = jnp.cumsum(e_onehot32, axis=0) - e_onehot32
-        pos = jnp.sum(prior * e_onehot32, axis=-1)  # [A]
-        e_onehot = e_onehot32.astype(self.dtype)
-        # one_hot yields an all-zero row for pos >= cap: overflow tokens
-        # drop out of the dispatch with no extra masking
-        c_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=self.dtype)
+        pos = jnp.sum(prior * e_onehot32, axis=-1).astype(jnp.int32)  # [A]
+        a = a_ids.shape[0]
+        # destination queue slot per assignment; overflow (pos >= cap)
+        # lands out of range and is DROPPED by the scatter
+        dest = jnp.where(pos < cap, a_ids * cap + pos, e * cap)
+        gather = jnp.full((e * cap,), a, jnp.int32)  # sentinel -> zero fill
+        gather = gather.at[dest].set(jnp.arange(a, dtype=jnp.int32), mode="drop")
         x_a = jnp.repeat(tokens, k, axis=0).astype(self.dtype)  # [A, D]
-        expert_in = jnp.einsum("ae,ac,ad->ecd", e_onehot, c_onehot, x_a)
+        # OOB sentinel reads fill with zeros — no padded-copy of x_a needed
+        expert_in = jnp.take(x_a, gather, axis=0, mode="fill", fill_value=0).reshape(
+            e, cap, d
+        )
         gate_up = self.param(
             "gate_up",
             nn.with_partitioning(
@@ -568,7 +572,12 @@ class MoEFFN(nn.Module):
         out = jnp.einsum(
             "ech,ehd->ecd", nn.silu(gate) * up, down.astype(self.dtype)
         )  # [E, C, D]
-        out_a = jnp.einsum("ae,ac,ecd->ad", e_onehot, c_onehot, out).astype(jnp.float32)
+        # combine: each assignment reads back its queue slot (overflow
+        # dest is already out of range -> zero fill), weighted by the
+        # renormalized router prob
+        out_a = jnp.take(
+            out.reshape(e * cap, d), dest, axis=0, mode="fill", fill_value=0
+        ).astype(jnp.float32)
         y = (out_a * top_w.reshape(-1)[:, None]).reshape(n, k, d).sum(axis=1)
         return y.reshape(b, t, d).astype(x.dtype)
 
