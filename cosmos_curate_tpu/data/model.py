@@ -135,6 +135,12 @@ class Clip:
     span: tuple[float, float] = (0.0, 0.0)  # seconds in source
     encoded_data: bytes | None = None  # transcoded mp4
     encoding_codec: str = ""
+    # provenance recorded by the writer before encoded_data is freed
+    # (video_span rows need geometry + content hash + the REAL written
+    # destination after the pipeline ran)
+    encoded_byte_size: int = 0
+    encoded_sha256: str = ""
+    encoded_url: str = ""
     # extraction-signature key -> uint8 [T, H, W, 3]
     extracted_frames: dict[str, np.ndarray] = field(default_factory=dict)
     # model name -> float32 embedding

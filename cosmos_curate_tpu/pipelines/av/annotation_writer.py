@@ -25,7 +25,11 @@ from __future__ import annotations
 from typing import Any
 
 from cosmos_curate_tpu.pipelines.av.packaging import t5_session_tar_url
-from cosmos_curate_tpu.pipelines.av.state_db import CaptionAnnotationRow
+from cosmos_curate_tpu.pipelines.av.state_db import (
+    CAPTION_VERSION,
+    CaptionAnnotationRow,
+    parse_caption_variant,
+)
 from cosmos_curate_tpu.storage.writers import write_json
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -35,19 +39,17 @@ logger = get_logger(__name__)
 def _caption_chain(variants: dict[str, str], base: str) -> list[tuple[int, str]]:
     """Ordered (window_index, caption) pairs for one prompt variant:
     window 0 is the bare variant name, later windows ride as
-    ``{base}#w{k}`` (the storage convention run_av_caption writes). The
-    PARSED index travels with the text so frame bounds stay correct when a
-    middle window's caption is absent (e.g. a failed request on resume)."""
-    chain = []
-    if base in variants:
-        chain.append((0, variants[base]))
-    prefix = f"{base}#w"
-    for name, text in variants.items():
-        if name.startswith(prefix):
-            try:
-                chain.append((int(name[len(prefix) :]), text))
-            except ValueError:
-                continue
+    ``{base}#w{k}`` — parsed with the SAME rule the state db uses
+    (state_db.parse_caption_variant), so a variant name that merely
+    contains '#w' round-trips instead of being dropped. The PARSED index
+    travels with the text so frame bounds stay correct when a middle
+    window's caption is absent (e.g. a failed request on resume)."""
+    chain = [
+        (k, text)
+        for name, text in variants.items()
+        for b, k in (parse_caption_variant(name),)
+        if b == base
+    ]
     return sorted(chain)
 
 
@@ -55,7 +57,7 @@ def write_clip_annotations(
     db,
     output_prefix: str,
     *,
-    version: str = "v0",
+    version: str = CAPTION_VERSION,
     run_id: str = "",
     dataset: str = "av-dataset",
     window_frames: int = 8,
@@ -82,7 +84,7 @@ def write_clip_annotations(
         rows: list[CaptionAnnotationRow] = []
         for clip in clips:
             variants = db.variant_captions(clip.clip_uuid)
-            bases = sorted({v.split("#w")[0] for v in variants})
+            bases = sorted({parse_caption_variant(v)[0] for v in variants})
             chains = {b: _caption_chain(variants, b) for b in bases}
             # caption-frame space (clips caption at `framerate`); the last
             # window clamps to the clip's actual frame count — matching the
@@ -105,19 +107,25 @@ def write_clip_annotations(
             n_meta += 1
             for base in bases:
                 chain = chains[base]
+                # clip_caption arrays are POSITIONAL (entry k = window k,
+                # state_db.py module docstring): emit dense arrays up to the
+                # last captioned window, "" where a middle window's caption
+                # is absent, so caption-state reads round-trip unchanged
+                n_win = chain[-1][0] + 1 if chain else 0
+                by_k = dict(chain)
                 rows.append(
                     CaptionAnnotationRow(
                         clip_uuid=clip.clip_uuid,
                         version=version,
                         prompt_type=base,
                         window_start_frame=[
-                            min(k * window_frames, clip_frames) for k, _ in chain
+                            min(k * window_frames, clip_frames) for k in range(n_win)
                         ],
                         window_end_frame=[
                             min((k + 1) * window_frames, clip_frames)
-                            for k, _ in chain
+                            for k in range(n_win)
                         ],
-                        window_caption=[t for _, t in chain],
+                        window_caption=[by_k.get(k, "") for k in range(n_win)],
                         t5_embedding_url=t5_session_tar_url(
                             prefix, dataset, session_id,
                             clip.span_start, clip.span_end,

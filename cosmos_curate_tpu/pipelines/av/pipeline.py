@@ -130,9 +130,31 @@ def run_av_split(args: AVPipelineArgs, *, runner: RunnerInterface | None = None)
             ClipWriterStage(args.output_path),
         ]
         out = run_pipeline(tasks, stages, runner=runner) or []
+        # provenance rows mirroring the reference's run / clipped_session /
+        # video_span tables (postgres_schema.py:61-150): one run row per
+        # invocation, one clipped_session per split session, one video_span
+        # per encoded clip with geometry + content hash
+        import dataclasses as _dc
+        import json as _json
+        import uuid as _uuid
+
+        from cosmos_curate_tpu import __version__
+        from cosmos_curate_tpu.pipelines.av.state_db import (
+            CAPTION_VERSION,
+            ClippedSessionRow,
+            RunRow,
+            VideoSpanRow,
+        )
+
+        run_uuid = str(_uuid.uuid4())
+        split_algo = "fixed-stride"
         rows = []
+        span_rows = []
+        span_index: dict[str, int] = defaultdict(int)
+        encoders: dict[str, set[str]] = defaultdict(set)
         for task in out:
             sid, cam = cam_of_path.get(task.video.path, ("unknown", "unknown"))
+            meta = task.video.metadata
             for clip in task.video.clips:
                 rows.append(
                     ClipRow(
@@ -143,16 +165,88 @@ def run_av_split(args: AVPipelineArgs, *, runner: RunnerInterface | None = None)
                         span_end=clip.span[1],
                     )
                 )
+                # span_index is the clip's position in the session timeline:
+                # it must advance for EVERY clip so a failed middle transcode
+                # doesn't shift later clips' indexes between runs
+                idx = span_index[f"{sid}/{cam}"]
+                span_index[f"{sid}/{cam}"] += 1
+                # a span row asserts an mp4 on disk — clips whose transcode
+                # failed (encoded_data never produced) must not mint one
+                if not clip.encoded_byte_size:
+                    continue
+                if clip.encoding_codec:
+                    encoders[sid].add(clip.encoding_codec)
+                span_rows.append(
+                    VideoSpanRow(
+                        clip_uuid=str(clip.uuid),
+                        version=CAPTION_VERSION,
+                        session_uuid=_session_uuid(sid),
+                        camera=cam,
+                        span_index=idx,
+                        split_algo_name=split_algo,
+                        span_start=clip.span[0],
+                        span_end=clip.span[1],
+                        encoder=clip.encoding_codec,
+                        # the destination the writer ACTUALLY wrote, not a
+                        # re-derivation of its layout rule
+                        url=clip.encoded_url,
+                        byte_size=clip.encoded_byte_size,
+                        duration=clip.duration_s,
+                        framerate=meta.fps,
+                        num_frames=int(round(clip.duration_s * meta.fps)),
+                        height=meta.height,
+                        width=meta.width,
+                        sha256=clip.encoded_sha256,
+                        run_uuid=run_uuid,
+                    )
+                )
         db.add_clips(rows)
+        db.add_video_spans(span_rows)
+        db.add_run(
+            RunRow(
+                run_uuid=run_uuid,
+                run_type="split",
+                pipeline_version=__version__,
+                params=_json.dumps(_dc.asdict(args)),
+            )
+        )
+        # per-session encoder set (PK includes encoder, as in the reference):
+        # sessions with NO successful transcode write no row — an empty
+        # encoder would mint a second PK when a later re-split succeeds
+        db.add_clipped_sessions(
+            [
+                ClippedSessionRow(
+                    session_uuid=_session_uuid(sid),
+                    version=CAPTION_VERSION,
+                    source_session=sid,
+                    num_cameras=len(sessions.get(sid, {})),
+                    split_algo_name=split_algo,
+                    encoder=",".join(sorted(encoders[sid])),
+                    run_uuid=run_uuid,
+                )
+                for sid in sorted(processed_sids)
+                if encoders.get(sid)
+            ]
+        )
         for sid in processed_sids:  # only sessions actually processed
             db.set_session_state(sid, "split")
         return {
             "num_sessions": len(processed_sids),
             "num_clips": len(rows),
+            "run_uuid": run_uuid,
             "elapsed_s": time.monotonic() - t0,
         }
     finally:
         db.close()
+
+
+def _session_uuid(session_id: str) -> str:
+    """Deterministic session uuid (reference sessions carry uuids; ours are
+    derived from the name so re-splitting upserts the same rows), minted
+    with the repo-wide uuid5 chain (data/model.py deterministic_id)."""
+    from cosmos_curate_tpu.data.model import deterministic_id
+
+    return str(deterministic_id("av-session", session_id))
 
 
 def run_av_caption(args: AVPipelineArgs, *, engine=None) -> dict:
@@ -275,19 +369,34 @@ def run_av_annotate(args: AVPipelineArgs) -> dict:
 
     from cosmos_curate_tpu.pipelines.av.annotation_writer import write_clip_annotations
 
+    import dataclasses as _dc
+    import json as _json
+
+    from cosmos_curate_tpu import __version__
+    from cosmos_curate_tpu.pipelines.av.state_db import RunRow
+
     t0 = time.monotonic()
     db = open_state_db(args.resolved_db)
+    run_id = str(_uuid.uuid4())
     try:
         counts = write_clip_annotations(
             db,
             args.output_path,
-            run_id=str(_uuid.uuid4()),
+            run_id=run_id,
             dataset=args.dataset_name,
             window_frames=args.caption_window_frames,
             framerate=AV_CAPTION_FPS,
             limit=args.limit,
         )
-        return {**counts, "elapsed_s": time.monotonic() - t0}
+        db.add_run(
+            RunRow(
+                run_uuid=run_id,
+                run_type="annotate",
+                pipeline_version=__version__,
+                params=_json.dumps(_dc.asdict(args)),
+            )
+        )
+        return {**counts, "run_uuid": run_id, "elapsed_s": time.monotonic() - t0}
     finally:
         db.close()
 

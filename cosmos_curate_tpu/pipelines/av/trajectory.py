@@ -94,12 +94,22 @@ def estimate_trajectory(frames_u8: np.ndarray) -> dict:
 
 def run_av_trajectory(args) -> dict:
     """Per-clip trajectory artifacts for all split/captioned clips:
-    ``trajectories/<uuid>.npy`` (positions) + a stats row in the summary."""
+    ``trajectories/<uuid>.npy`` (positions), a stats row in the summary,
+    and one ``clip_tag`` row per clip with the ego-motion taxonomy derived
+    from the trajectory (reference ClipTag, postgres_schema.py:210)."""
     import json
     import time as time_mod
+    import uuid as uuid_mod
     from pathlib import Path
 
-    from cosmos_curate_tpu.pipelines.av.state_db import open_state_db
+    from cosmos_curate_tpu import __version__
+    from cosmos_curate_tpu.pipelines.av.ego_tags import derive_ego_tags
+    from cosmos_curate_tpu.pipelines.av.state_db import (
+        CAPTION_VERSION,
+        ClipTagRow,
+        RunRow,
+        open_state_db,
+    )
     from cosmos_curate_tpu.storage.client import read_bytes
     from cosmos_curate_tpu.video.decode import extract_frames_at_fps
 
@@ -115,6 +125,8 @@ def run_av_trajectory(args) -> dict:
         )
     db = open_state_db(args.resolved_db)
     stats = []
+    tag_rows = []
+    run_uuid = str(uuid_mod.uuid4())
     try:
         todo = [
             r
@@ -135,6 +147,15 @@ def run_av_trajectory(args) -> dict:
                 continue
             traj = estimate_trajectory(frames)
             np.save(out_dir / f"{row.clip_uuid}.npy", traj["positions"])
+            ego = derive_ego_tags(traj["positions"], fps=4.0)
+            tag_rows.append(
+                ClipTagRow(
+                    clip_uuid=row.clip_uuid,
+                    version=CAPTION_VERSION,
+                    run_uuid=run_uuid,
+                    **ego,
+                )
+            )
             stats.append(
                 {
                     "clip_uuid": row.clip_uuid,
@@ -142,9 +163,23 @@ def run_av_trajectory(args) -> dict:
                     "path_length": traj["path_length"],
                     "net_displacement": traj["net_displacement"],
                     "motion_class": traj["motion_class"],
+                    **ego,
                 }
             )
         (Path(root) / "trajectories" / "stats.json").write_text(json.dumps(stats, indent=1))
-        return {"num_trajectories": len(stats), "elapsed_s": time_mod.monotonic() - t0}
+        db.add_clip_tags(tag_rows)
+        if tag_rows:
+            db.add_run(
+                RunRow(
+                    run_uuid=run_uuid,
+                    run_type="trajectory",
+                    pipeline_version=__version__,
+                )
+            )
+        return {
+            "num_trajectories": len(stats),
+            "num_clip_tags": len(tag_rows),
+            "elapsed_s": time_mod.monotonic() - t0,
+        }
     finally:
         db.close()

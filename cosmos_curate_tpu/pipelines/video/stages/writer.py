@@ -148,6 +148,9 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
                 else f"{self.output_path}/clips/{clip.uuid}.mp4"
             )
             write_bytes(dest, clip.encoded_data)
+            clip.encoded_byte_size = len(clip.encoded_data)
+            clip.encoded_sha256 = hashlib.sha256(clip.encoded_data).hexdigest()
+            clip.encoded_url = dest
             stats.num_transcoded += 1
         if clip.webp_preview and self.write_previews:
             write_bytes(f"{self.output_path}/previews/{clip.uuid}.webp", clip.webp_preview)

@@ -29,17 +29,33 @@ def state_path(tmp_path):
     return str(tmp_path / "state.db")
 
 
+ALL_TABLES = {
+    "sessions",
+    "clips",
+    "clip_caption",
+    "run",
+    "clipped_session",
+    "video_span",
+    "clip_tag",
+}
+
+
 def test_parse_schema_ddl_extracts_tables_and_columns():
     from cosmos_curate_tpu.pipelines.av import state_db
 
     tables = parse_schema_ddl(state_db._SCHEMA)
-    assert set(tables) == {"sessions", "clips", "clip_captions"}
+    assert set(tables) == ALL_TABLES
     clips = {c.name: c for c in tables["clips"]}
     assert clips["span_start"].data_type == "REAL"
     assert not clips["session_id"].nullable
     assert clips["caption"].nullable
     # constraint lines must not leak in as columns
     assert "FOREIGN" not in clips and "PRIMARY" not in clips
+    # reference-shaped provenance tables parse with their tag columns
+    tags = {c.name: c for c in tables["clip_tag"]}
+    assert "ego_speed" in tags and not tags["ego_speed"].nullable
+    spans = {c.name: c for c in tables["video_span"]}
+    assert spans["byte_size"].data_type == "INTEGER"
 
 
 def test_pg_dialect_multiword_types_and_numeric_defaults():
@@ -68,7 +84,7 @@ def test_pg_dialect_multiword_types_and_numeric_defaults():
 
 def test_sqlite_inspector_tables_and_counts(state_path):
     insp = SqliteInspector(state_path)
-    assert set(insp.tables()) == {"sessions", "clips", "clip_captions"}
+    assert set(insp.tables()) == ALL_TABLES
     assert insp.row_count("clips") == 1
     cols = {c.name for c in insp.columns("sessions")}
     assert {"session_id", "num_cameras", "state", "created_s"} <= cols
@@ -105,17 +121,17 @@ def test_update_schemas_adds_missing_column_and_table(tmp_path):
 
     insp = SqliteInspector(path)
     changes = diff_schema(insp, target_schema("sqlite"))
-    assert changes.missing_tables == ["clip_captions"]
+    assert set(changes.missing_tables) == ALL_TABLES - {"sessions", "clips"}
     assert [(t, c.name) for t, c in changes.missing_columns] == [("clips", "caption")]
 
     # dry run leaves the db untouched
     stmts = apply_changes(insp, changes, dry_run=True)
-    assert len(stmts) == 2
+    assert len(stmts) == len(changes.missing_tables) + 1
     assert "caption" not in {c.name for c in insp.columns("clips")}
 
     apply_changes(insp, changes, dry_run=False)
     assert "caption" in {c.name for c in insp.columns("clips")}
-    assert "clip_captions" in insp.tables()
+    assert set(insp.tables()) == ALL_TABLES
     # idempotent: second diff is clean
     assert diff_schema(insp, target_schema("sqlite")).empty
     insp.close()

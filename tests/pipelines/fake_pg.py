@@ -167,6 +167,15 @@ class FakePgServer:
         over the real wire protocol."""
         import re
 
+        # transaction control + row locks: the fake executes every statement
+        # under one global lock on autocommitting sqlite, so BEGIN/COMMIT/
+        # ROLLBACK become no-ops and FOR UPDATE (PG row lock) is stripped
+        bare = sql.strip().rstrip(";").strip().upper()
+        if bare in ("BEGIN", "COMMIT", "ROLLBACK") or bare.startswith("LOCK TABLE"):
+            return "SELECT 1 WHERE 1 = 0"
+        # only the statement-trailing row-lock clause — a literal
+        # ' FOR UPDATE' inside stored text must survive
+        sql = re.sub(r"\s+FOR UPDATE\s*;?\s*$", "", sql)
         if "information_schema.tables" in sql:
             return (
                 "SELECT name FROM sqlite_master WHERE type='table' "

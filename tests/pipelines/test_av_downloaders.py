@@ -140,6 +140,9 @@ def test_av_caption_uses_prefetch(tmp_path):
         def __init__(self):
             self.requests = []
 
+        def fit_max_new_tokens(self, requested, prompt_ids, prefix_ids=(), n_frames=0):
+            return requested
+
         def add_request(self, req):
             self.requests.append(req)
 

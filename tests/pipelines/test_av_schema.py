@@ -1,0 +1,276 @@
+"""AV state-db schema depth: unified clip_caption store, legacy migration,
+reference-shaped provenance tables (run / clipped_session / video_span /
+clip_tag), and the ego-tag taxonomy."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.pipelines.av.ego_tags import (
+    EgoAccelerationType,
+    EgoManeuverType,
+    EgoSpeedTier,
+    derive_ego_tags,
+)
+from cosmos_curate_tpu.pipelines.av.state_db import (
+    CAPTION_VERSION,
+    AVStateDB,
+    ClippedSessionRow,
+    ClipRow,
+    ClipTagRow,
+    RunRow,
+    VideoSpanRow,
+    parse_caption_variant,
+)
+
+
+class TestCaptionUnification:
+    def test_parse_caption_variant(self):
+        assert parse_caption_variant("default") == ("default", 0)
+        assert parse_caption_variant("default#w3") == ("default", 3)
+        assert parse_caption_variant("short#wx") == ("short#wx", 0)
+
+    def test_captions_live_in_clip_caption_table(self, tmp_path):
+        db = AVStateDB(str(tmp_path / "s.sqlite"))
+        try:
+            db.add_clips([ClipRow("c1", "s1", "front", 0.0, 16.0)])
+            db.set_caption("c1", "window zero", "default")
+            db.set_caption("c1", "window two", "default#w2")
+            db.set_caption("c1", "short take", "short")
+            rows = {r.prompt_type: r for r in db.caption_annotations("c1")}
+            assert set(rows) == {"default", "short"}
+            # positional arrays: absent window 1 holds an empty string
+            assert rows["default"].window_caption == ["window zero", "", "window two"]
+            assert rows["default"].window_start_frame == [-1, -1, -1]
+            assert rows["short"].window_caption == ["short take"]
+            # reconstruction skips the empty window
+            assert db.variant_captions("c1") == {
+                "default": "window zero",
+                "default#w2": "window two",
+                "short": "short take",
+            }
+        finally:
+            db.close()
+
+    def test_legacy_clip_captions_table_migrates(self, tmp_path):
+        path = str(tmp_path / "legacy.sqlite")
+        con = sqlite3.connect(path)
+        con.executescript(
+            """
+            CREATE TABLE clips (clip_uuid TEXT PRIMARY KEY, session_id TEXT NOT NULL,
+                camera TEXT NOT NULL, span_start REAL NOT NULL, span_end REAL NOT NULL,
+                state TEXT NOT NULL DEFAULT 'split', caption TEXT DEFAULT '');
+            CREATE TABLE clip_captions (clip_uuid TEXT NOT NULL, variant TEXT NOT NULL,
+                caption TEXT NOT NULL, PRIMARY KEY (clip_uuid, variant));
+            INSERT INTO clips VALUES ('c1', 's1', 'front', 0, 8, 'packaged', 'main');
+            INSERT INTO clip_captions VALUES ('c1', 'default', 'main');
+            INSERT INTO clip_captions VALUES ('c1', 'default#w1', 'second');
+            INSERT INTO clip_captions VALUES ('c1', 'short', 'brief');
+            """
+        )
+        con.commit()
+        con.close()
+
+        db = AVStateDB(path)
+        try:
+            assert db.variant_captions("c1") == {
+                "default": "main",
+                "default#w1": "second",
+                "short": "brief",
+            }
+            # the legacy table is gone; migration must not regress clip state
+            names = {
+                r[0]
+                for r in db._conn.execute(
+                    "SELECT name FROM sqlite_master WHERE type='table'"
+                )
+            }
+            assert "clip_captions" not in names
+            assert db.clips()[0].state == "packaged"
+            # reopening is a no-op
+            db.close()
+            db = AVStateDB(path)
+            assert db.variant_captions("c1")["default"] == "main"
+        finally:
+            db.close()
+
+
+class TestProvenanceTables:
+    def _rows(self):
+        run = RunRow(run_uuid="r-1", run_type="split", pipeline_version="0.1.0")
+        cs = ClippedSessionRow(
+            session_uuid="su-1",
+            version=CAPTION_VERSION,
+            source_session="drive001",
+            num_cameras=2,
+            split_algo_name="fixed-stride",
+            encoder="libx264",
+            run_uuid="r-1",
+        )
+        span = VideoSpanRow(
+            clip_uuid="c1",
+            version=CAPTION_VERSION,
+            session_uuid="su-1",
+            camera="front",
+            span_index=0,
+            split_algo_name="fixed-stride",
+            span_start=0.0,
+            span_end=8.0,
+            encoder="libx264",
+            url="/out/clips/c1.mp4",
+            byte_size=1234,
+            duration=8.0,
+            framerate=24.0,
+            num_frames=192,
+            height=240,
+            width=320,
+            sha256="ab" * 32,
+            run_uuid="r-1",
+        )
+        tag = ClipTagRow(
+            clip_uuid="c1",
+            version=CAPTION_VERSION,
+            ego_speed="medium",
+            ego_turn="left_turn",
+            run_uuid="r-1",
+        )
+        return run, cs, span, tag
+
+    def test_sqlite_round_trip_and_upsert(self, tmp_path):
+        db = AVStateDB(str(tmp_path / "p.sqlite"))
+        run, cs, span, tag = self._rows()
+        try:
+            db.add_run(run)
+            db.add_clipped_sessions([cs])
+            db.add_video_spans([span])
+            db.add_clip_tags([tag])
+            assert db.runs(run_type="split") == [run]
+            assert db.clipped_sessions(source_session="drive001") == [cs]
+            assert db.video_spans(clip_uuid="c1") == [span]
+            assert db.video_spans(session_uuid="su-1") == [span]
+            assert db.clip_tags("c1") == [tag]
+            # upsert on the key: a re-run updates rather than duplicates
+            span.byte_size = 999
+            db.add_video_spans([span])
+            got = db.video_spans(clip_uuid="c1")
+            assert len(got) == 1 and got[0].byte_size == 999
+        finally:
+            db.close()
+
+    def test_postgres_round_trip_over_wire(self):
+        from cosmos_curate_tpu.pipelines.av.state_db import PostgresAVStateDB
+        from tests.pipelines.fake_pg import FakePgServer
+
+        run, cs, span, tag = self._rows()
+        with FakePgServer(auth="scram") as srv:
+            db = PostgresAVStateDB(srv.dsn)
+            try:
+                db.add_run(run)
+                db.add_clipped_sessions([cs])
+                db.add_video_spans([span])
+                db.add_clip_tags([tag])
+                assert db.runs() == [run]
+                assert db.clipped_sessions("drive001") == [cs]
+                got = db.video_spans(clip_uuid="c1")
+                assert got == [span]
+                assert isinstance(got[0].byte_size, int)  # wire text coerced back
+                assert isinstance(got[0].framerate, float)
+                assert db.clip_tags("c1") == [tag]
+                # caption path on the unified table
+                db.add_clips([ClipRow("c1", "s1", "front", 0.0, 8.0)])
+                db.set_caption("c1", "pg caption", "default")
+                db.set_caption("c1", "pg w1", "default#w1")
+                assert db.variant_captions("c1") == {
+                    "default": "pg caption",
+                    "default#w1": "pg w1",
+                }
+            finally:
+                db.close()
+
+
+class TestEgoTags:
+    def test_stationary(self):
+        pos = np.zeros((20, 2), np.float32)
+        tags = derive_ego_tags(pos, fps=4.0)
+        assert tags["ego_speed"] == EgoSpeedTier.stand_still.value
+        assert tags["ego_acceleration"] == EgoAccelerationType.maintain.value
+
+    def test_fast_straight(self):
+        t = np.arange(30, dtype=np.float32)
+        pos = np.stack([t * 15.0, np.zeros_like(t)], axis=1)  # 60 px/s at 4 fps
+        tags = derive_ego_tags(pos, fps=4.0)
+        assert tags["ego_speed"] == EgoSpeedTier.high.value
+        assert tags["ego_turn"] == EgoManeuverType.straight.value
+        assert tags["ego_curve"] == EgoManeuverType.straight.value
+
+    def test_turning(self):
+        # half-circle arc: constant speed, heading rotates ~0.35 rad/step
+        theta = np.linspace(0, np.pi, 10, dtype=np.float32)
+        pos = np.stack([np.sin(theta), 1 - np.cos(theta)], axis=1) * 40.0
+        tags = derive_ego_tags(pos, fps=4.0)
+        assert tags["ego_turn"] in (
+            EgoManeuverType.right_turn.value,
+            EgoManeuverType.left_turn.value,
+        ) or tags["ego_curve"] in (
+            EgoManeuverType.curve_left.value,
+            EgoManeuverType.curve_right.value,
+        )
+
+    def test_accelerating(self):
+        # speed ramps from ~0 to fast over the clip
+        t = np.linspace(0, 1, 40, dtype=np.float32)
+        x = np.cumsum(t * 20.0)
+        pos = np.stack([x, np.zeros_like(x)], axis=1)
+        tags = derive_ego_tags(pos, fps=4.0)
+        assert tags["ego_acceleration"] in (
+            EgoAccelerationType.fast_accel.value,
+            EgoAccelerationType.slow_accel.value,
+        )
+
+    def test_too_short_is_unknown(self):
+        tags = derive_ego_tags(np.zeros((2, 2), np.float32), fps=4.0)
+        assert tags["ego_speed"] == EgoSpeedTier.unknown.value
+
+
+def test_split_records_provenance_rows(tmp_path):
+    """run_av_split writes run / clipped_session / video_span rows with real
+    clip geometry (reference postgres_schema.py:61-150)."""
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.av.pipeline import (
+        AVPipelineArgs,
+        run_av_ingest,
+        run_av_split,
+    )
+    from tests.fixtures.media import make_scene_video
+
+    src = tmp_path / "src"
+    src.mkdir()
+    make_scene_video(src / "drive001_front.mp4", scene_len_frames=24, num_scenes=2)
+    args = AVPipelineArgs(
+        input_path=str(src),
+        output_path=str(tmp_path / "out"),
+        clip_len_s=1.0,
+        min_clip_len_s=0.5,
+    )
+    run_av_ingest(args)
+    summary = run_av_split(args, runner=SequentialRunner())
+    assert summary["run_uuid"]
+    db = AVStateDB(args.resolved_db)
+    try:
+        runs = db.runs(run_type="split")
+        assert len(runs) == 1 and runs[0].run_uuid == summary["run_uuid"]
+        assert '"clip_len_s": 1.0' in runs[0].params
+        sessions = db.clipped_sessions(source_session="drive001")
+        assert len(sessions) == 1 and sessions[0].num_cameras == 1
+        spans = db.video_spans(session_uuid=sessions[0].session_uuid)
+        assert len(spans) == summary["num_clips"] > 0
+        by_index = sorted(spans, key=lambda s: s.span_index)
+        assert [s.span_index for s in by_index] == list(range(len(spans)))
+        first = by_index[0]
+        assert first.width > 0 and first.height > 0 and first.framerate > 0
+        assert first.byte_size > 0 and len(first.sha256) == 64
+        assert first.url.endswith(f"{first.clip_uuid}.mp4")
+        assert first.run_uuid == summary["run_uuid"]
+    finally:
+        db.close()
