@@ -263,14 +263,26 @@ def load_params(
             # and save_params writes); sharding metadata is re-attached
             # from the init template so pjit layouts survive the roundtrip
             restored = flax.serialization.from_bytes(_unbox_tree(template), data)
+            # from_bytes does NOT validate leaf shapes: a checkpoint staged
+            # for other model shapes restores "successfully" and then dies
+            # deep inside apply (observed: default-config transnet weights
+            # loaded into TRANSNET_TINY_TEST). Check here so the mismatch
+            # takes the architecture-mismatch path below.
+            _assert_shapes_match(_unbox_tree(template), restored, model_id)
             return _rebox_like(template, restored)
         except (ValueError, KeyError, TypeError) as unboxed_err:
             # legacy format: checkpoints written before the unboxed
             # canonicalization serialized Partitioned leaves as
             # {'value': ...} state dicts — restore against the boxed
-            # template keeps them loadable
+            # template keeps them loadable (shape-validated like the
+            # canonical path: this fallback must not smuggle in a
+            # wrong-architecture checkpoint the canonical path rejected)
             try:
-                return flax.serialization.from_bytes(template, data)
+                restored = flax.serialization.from_bytes(template, data)
+                _assert_shapes_match(
+                    _unbox_tree(template), _unbox_tree(restored), model_id
+                )
+                return restored
             except (ValueError, KeyError, TypeError):
                 e = unboxed_err  # report the canonical-format error
             if require:
@@ -284,6 +296,7 @@ def load_params(
                 "staged weights at %s do not match %s's current architecture "
                 "(%s); falling back to random init", ckpt, model_id, e,
             )
+            return init_fn(seed)
     elif require:
         raise RuntimeError(
             f"no staged weights for {model_id} under "
@@ -328,6 +341,29 @@ def _unbox_tree(tree: Any) -> Any:
         tree,
         is_leaf=lambda x: isinstance(x, fnn.Partitioned),
     )
+
+
+def _assert_shapes_match(template: Any, restored: Any, model_id: str) -> None:
+    """Raise ValueError naming the first leaf whose shape disagrees with the
+    init template (both trees unboxed; same treedef by construction of the
+    from_bytes target)."""
+    import jax
+
+    t_leaves = jax.tree_util.tree_leaves_with_path(template)
+    r_leaves = jax.tree_util.tree_leaves(restored)
+    if len(t_leaves) != len(r_leaves):
+        raise ValueError(
+            f"{model_id} checkpoint has {len(r_leaves)} leaves, "
+            f"model expects {len(t_leaves)}"
+        )
+    for (path, t), r in zip(t_leaves, r_leaves):
+        t_shape = getattr(t, "shape", None)
+        r_shape = getattr(r, "shape", None)
+        if t_shape != r_shape:
+            raise ValueError(
+                f"{model_id} checkpoint leaf {jax.tree_util.keystr(path)} has "
+                f"shape {r_shape}, model expects {t_shape}"
+            )
 
 
 def _rebox_like(template: Any, values: Any) -> Any:

@@ -22,16 +22,21 @@ DEFAULT_CACHE_DIR = "/tmp/curate_jax_cache"
 
 
 def _host_fingerprint() -> str:
-    """A short tag of the CPU feature set. XLA:CPU AOT cache entries embed
-    the compile machine's features; loading them on a host with a
-    different set logs 'could lead to SIGILL' and can actually crash
-    (observed: cache written under another feature profile on this box).
-    Keying the cache dir by the host fingerprint makes entries
-    machine-local without giving up cross-process reuse."""
+    """A short tag of the CPU feature set AND the jax/jaxlib identity.
+    XLA:CPU AOT cache entries embed the compile-time target features;
+    loading them under a different feature profile logs 'could lead to
+    SIGILL' and can actually crash. The features XLA picks depend on the
+    jaxlib BUILD, not just /proc/cpuinfo (observed on this box: entries
+    compiled with +prefer-no-scatter/+prefer-no-gather by one jaxlib were
+    loaded by another with the same cpuinfo flags), so the key must include
+    which jaxlib produced the entry."""
     import hashlib
     import platform
 
-    bits = f"{platform.machine()}:{platform.processor()}"
+    # cache epoch: bump to orphan every entry written before the key grew
+    # the jaxlib identity (stale pre-epoch entries caused the SIGILL-risk
+    # loader errors in MULTICHIP_r04)
+    bits = f"v2:{platform.machine()}:{platform.processor()}"
     try:
         with open("/proc/cpuinfo") as fh:
             for line in fh:
@@ -39,6 +44,13 @@ def _host_fingerprint() -> str:
                     bits += ":" + line.split(":", 1)[1].strip()
                     break
     except OSError:
+        pass
+    try:
+        import jax
+        import jaxlib
+
+        bits += f":{jax.__version__}:{jaxlib.__version__}:{jaxlib.__file__}"
+    except Exception:
         pass
     return hashlib.sha256(bits.encode()).hexdigest()[:10]
 

@@ -123,3 +123,24 @@ class TestRegistry:
         registry.save_params("aesthetics-mlp-tpu", params)
         loaded = registry.load_params("aesthetics-mlp-tpu", lambda seed: {"w": jnp.zeros(4), "b": jnp.zeros(2)})
         np.testing.assert_array_equal(np.asarray(loaded["w"]), np.arange(4.0))
+
+    def test_shape_mismatch_falls_back_to_init(self, tmp_path, monkeypatch):
+        """A checkpoint staged for other model shapes must not crash deep
+        inside apply — load_params validates leaf shapes and falls back
+        (observed: default-config transnet weights under a TINY config)."""
+        monkeypatch.setenv(registry.WEIGHTS_DIR_ENV, str(tmp_path))
+        import jax.numpy as jnp
+        import pytest
+
+        registry.save_params("aesthetics-mlp-tpu", {"w": jnp.arange(8.0), "b": jnp.ones(2)})
+        loaded = registry.load_params(
+            "aesthetics-mlp-tpu", lambda seed: {"w": jnp.zeros(4), "b": jnp.zeros(2)}
+        )
+        assert np.asarray(loaded["w"]).shape == (4,)  # init template, not ckpt
+        np.testing.assert_array_equal(np.asarray(loaded["w"]), np.zeros(4))
+        with pytest.raises(RuntimeError, match="do not match"):
+            registry.load_params(
+                "aesthetics-mlp-tpu",
+                lambda seed: {"w": jnp.zeros(4), "b": jnp.zeros(2)},
+                require=True,
+            )
