@@ -65,6 +65,11 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--tracking", action="store_true")
     split.add_argument("--tracking-annotated", action="store_true")
     split.add_argument("--per-event-captions", action="store_true")
+    split.add_argument("--sr", action="store_true", help="super-resolve clips after transcode")
+    split.add_argument("--sr-variant", choices=["diffusion", "srnet"], default="diffusion")
+    split.add_argument("--sr-window-frames", type=int, default=128)
+    split.add_argument("--sr-overlap-frames", type=int, default=64)
+    split.add_argument("--sr-sp-size", type=int, default=1, help="sequence-parallel mesh size for SR")
     split.add_argument("--text-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--semantic-filter", choices=["disable", "score-only", "enable"], default="disable")
     split.add_argument("--clip-chunk-size", type=int, default=64)
@@ -313,6 +318,11 @@ def _cmd_split(args: argparse.Namespace) -> int:
             per_event_captions=args.per_event_captions,
             text_filter=args.text_filter,
             semantic_filter=args.semantic_filter,
+            sr=args.sr,
+            sr_variant=args.sr_variant,
+            sr_window_frames=args.sr_window_frames,
+            sr_overlap_frames=args.sr_overlap_frames,
+            sr_sp_size=args.sr_sp_size,
             clip_chunk_size=args.clip_chunk_size,
             profile_cpu=args.profile_cpu,
             profile_memory=args.profile_memory,

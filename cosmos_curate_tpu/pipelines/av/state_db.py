@@ -373,7 +373,7 @@ class AVStateDB(_GenericTablesMixin):
         ).fetchone()
         if not has:
             return
-        try:
+        def migrate():
             with self._conn:
                 # write statement FIRST: sqlite takes the database write
                 # lock here, so no still-running old-version writer can add
@@ -389,9 +389,21 @@ class AVStateDB(_GenericTablesMixin):
                     base, k = parse_caption_variant(variant)
                     self._store_window_caption(cid, base, k, caption)
                 self._conn.execute("DROP TABLE clip_captions")
+                return legacy
+
+        try:
+            legacy = _db_retry(migrate)
         except sqlite3.OperationalError:
-            # a concurrent opener migrated + dropped first ('no such table')
-            return
+            still_there = self._conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='table' "
+                "AND name='clip_captions'"
+            ).fetchone()
+            if not still_there:
+                # a concurrent opener migrated + dropped first
+                return
+            # migration failed with legacy data still present: readers
+            # would silently see ZERO captions for those clips — refuse
+            raise
         if legacy:
             logger.info(
                 "migrated %d legacy clip_captions rows into clip_caption", len(legacy)
@@ -700,8 +712,17 @@ class PostgresAVStateDB(_GenericTablesMixin):
 
             legacy = self._retry_txn(txn)
         except PgError:
-            # a concurrent opener migrated + dropped first (42P01)
-            return
+            res = self._retry_execute(
+                "SELECT table_name FROM information_schema.tables "
+                "WHERE table_name = 'clip_captions'"
+            )
+            if not any(r[0] == "clip_captions" for r in res.rows):
+                # a concurrent opener migrated + dropped first (42P01)
+                return
+            # legacy table still present after a failed migration (e.g. no
+            # DROP privilege): swallowing this would make every pre-upgrade
+            # caption silently invisible — refuse
+            raise
         if legacy:
             logger.info(
                 "migrated %d legacy clip_captions rows into clip_caption", len(legacy)

@@ -45,6 +45,13 @@ class SplitPipelineArgs:
     # transcode
     transcode_cpus: int = 4
     clip_chunk_size: int = 64
+    # super-resolution after transcode (reference --sr-*,
+    # splitting_pipeline.py:1313-1337 / super_resolution_stage.py:189)
+    sr: bool = False
+    sr_variant: str = "diffusion"  # diffusion | srnet
+    sr_window_frames: int = 128
+    sr_overlap_frames: int = 64
+    sr_sp_size: int = 1
     # frame extraction (uniform size so model stages can stack across clips)
     extract_fps: tuple[float, ...] = (2.0,)
     extract_resize_hw: tuple[int, int] = (224, 224)
@@ -109,6 +116,30 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
     stages.append(
         ClipTranscodingStage(num_threads=args.transcode_cpus, chunk_size=args.clip_chunk_size)
     )
+    if args.sr:
+        from cosmos_curate_tpu.pipelines.video.stages.super_resolution import (
+            SuperResolutionStage,
+        )
+
+        if args.sr_overlap_frames >= args.sr_window_frames:
+            # fail fast: the stage's per-clip error handling would otherwise
+            # swallow the ValueError and ship a full non-SR output set
+            raise ValueError(
+                f"--sr-overlap-frames ({args.sr_overlap_frames}) must be < "
+                f"--sr-window-frames ({args.sr_window_frames})"
+            )
+
+        # directly after transcode (reference inserts SR there,
+        # splitting_pipeline.py:553): filters and frame extraction then see
+        # the upscaled clips
+        stages.append(
+            SuperResolutionStage(
+                variant=args.sr_variant,
+                window_len=args.sr_window_frames,
+                overlap=args.sr_overlap_frames,
+                sp_size=args.sr_sp_size,
+            )
+        )
     if args.motion_filter != "disable":
         from cosmos_curate_tpu.pipelines.video.stages.motion_filter import MotionFilterStage
 

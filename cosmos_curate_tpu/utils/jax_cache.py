@@ -50,6 +50,16 @@ def _host_fingerprint() -> str:
         import jaxlib
 
         bits += f":{jax.__version__}:{jaxlib.__version__}:{jaxlib.__file__}"
+        # build identity, not just version: a force-reinstalled same-version
+        # wheel built with different target features lands at the same path
+        # — stat the package's native extensions so the key tracks the
+        # actual compiled artifacts
+        from pathlib import Path
+
+        pkg = Path(jaxlib.__file__).parent
+        for so in sorted(pkg.glob("*.so")) + sorted(pkg.glob("**/xla_extension*.so")):
+            st = so.stat()
+            bits += f":{so.name}:{st.st_size}:{int(st.st_mtime)}"
     except Exception:
         pass
     return hashlib.sha256(bits.encode()).hexdigest()[:10]
