@@ -137,18 +137,44 @@ def _token() -> bytes:
 MAX_FRAME_BYTES = 1 << 31
 
 
-def send_msg(sock: socket.socket, msg: Any, token: bytes) -> None:
+def _pack_meta(sid: bytes, direction: bytes, seq: int) -> bytes:
+    return struct.pack(">H", len(sid)) + sid + direction + struct.pack(">Q", seq)
+
+
+def _unpack_meta(meta: bytes) -> tuple[bytes, bytes, int]:
+    if len(meta) < 2:
+        raise ConnectionError("malformed frame meta")
+    (n,) = struct.unpack(">H", meta[:2])
+    if len(meta) != 2 + n + 3 + 8:
+        raise ConnectionError("malformed frame meta")
+    sid = meta[2 : 2 + n]
+    direction = meta[2 + n : 5 + n]
+    (seq,) = struct.unpack(">Q", meta[5 + n :])
+    return sid, direction, seq
+
+
+def send_msg(sock: socket.socket, msg: Any, token: bytes, *, meta: bytes = b"") -> None:
+    """One MAC'd frame: [meta_len u16][meta][cloudpickle payload]. ``meta``
+    carries freshness fields (session id, direction, sequence) OUTSIDE the
+    pickle so the receiver verifies them before deserializing anything."""
     payload = cloudpickle.dumps(msg)
-    if len(payload) > MAX_FRAME_BYTES:
+    body = struct.pack(">H", len(meta)) + meta + payload
+    if len(body) > MAX_FRAME_BYTES:
         # enforce the receiver's cap at the SENDER: an oversized frame must
         # fail as one batch error, not sever the link when the peer rejects
         raise ValueError(
-            f"frame of {len(payload)} bytes exceeds the plane's "
+            f"frame of {len(body)} bytes exceeds the plane's "
             f"{MAX_FRAME_BYTES}-byte cap; shrink the stage batch size"
         )
-    mac = hmac.new(token, payload, hashlib.sha256).digest()
-    header = _MAGIC + struct.pack(">Q", len(payload)) + mac
-    sock.sendall(header + payload)
+    mac = hmac.new(token, body, hashlib.sha256).digest()
+    header = _MAGIC + struct.pack(">Q", len(body)) + mac
+    sock.sendall(header + body)
+
+
+def send_frame(
+    sock: socket.socket, token: bytes, sid: bytes, direction: bytes, seq: int, msg: Any
+) -> None:
+    send_msg(sock, msg, token, meta=_pack_meta(sid, direction, seq))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -161,7 +187,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+def recv_msg_raw(
+    sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BYTES
+) -> tuple[bytes, bytes]:
+    """MAC-verified (meta, pickled_payload) WITHOUT deserializing the
+    payload — freshness checks must gate cloudpickle.loads, not follow it."""
     header = _recv_exact(sock, 4 + 8 + 32)
     if header[:4] != _MAGIC:
         raise ConnectionError("bad frame magic")
@@ -169,11 +199,29 @@ def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BY
     if length > max_bytes:
         raise ConnectionError(f"frame too large: {length}")
     mac = header[12:44]
-    payload = _recv_exact(sock, length)
-    want = hmac.new(token, payload, hashlib.sha256).digest()
+    body = _recv_exact(sock, length)
+    want = hmac.new(token, body, hashlib.sha256).digest()
     if not hmac.compare_digest(mac, want):
         raise ConnectionError("frame failed authentication")
+    if len(body) < 2:
+        raise ConnectionError("malformed frame body")
+    (n,) = struct.unpack(">H", body[:2])
+    if len(body) < 2 + n:
+        raise ConnectionError("malformed frame meta length")
+    return body[2 : 2 + n], body[2 + n :]
+
+
+def recv_msg(sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+    _, payload = recv_msg_raw(sock, token, max_bytes=max_bytes)
     return cloudpickle.loads(payload)
+
+
+def recv_frame(
+    sock: socket.socket, token: bytes, *, max_bytes: int = MAX_FRAME_BYTES
+) -> tuple[bytes, bytes, int, Any]:
+    meta, payload = recv_msg_raw(sock, token, max_bytes=max_bytes)
+    sid, direction, seq = _unpack_meta(meta)
+    return sid, direction, seq, cloudpickle.loads(payload)
 
 
 class SecureChannel:
@@ -187,7 +235,10 @@ class SecureChannel:
     old session cannot match a new session's id), the per-direction
     sequence must advance exactly by one (an in-session replay or
     reordering drops the link), and the direction tag stops reflecting a
-    peer's own frames back at it."""
+    peer's own frames back at it. The freshness fields ride a fixed
+    header INSIDE the MAC'd frame but OUTSIDE the pickled payload, so a
+    stale or reflected frame is rejected BEFORE any object is
+    deserialized (ADVICE r4)."""
 
     A2D = b"a2d"  # agent -> driver
     D2A = b"d2a"  # driver -> agent
@@ -214,12 +265,16 @@ class SecureChannel:
 
     def send(self, msg: Any) -> None:
         with self._lock:
-            send_msg(self.sock, (self.sid, self._send_dir, self._send_seq, msg), self._token)
+            send_frame(
+                self.sock, self._token, self.sid, self._send_dir, self._send_seq, msg
+            )
             self._send_seq += 1
 
     def recv(self, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
-        frame = recv_msg(self.sock, self._token, max_bytes=max_bytes)
-        sid, direction, seq, msg = _check_frame_tuple(frame)
+        meta, payload = recv_msg_raw(self.sock, self._token, max_bytes=max_bytes)
+        sid, direction, seq = _unpack_meta(meta)
+        # freshness gates deserialization: a replayed/cross-session frame is
+        # rejected before its payload objects are ever reconstructed
         if sid != self.sid:
             raise ConnectionError("frame from a different session (replay?)")
         if direction != self._recv_dir:
@@ -229,19 +284,7 @@ class SecureChannel:
                 f"frame out of order: got seq {seq}, expected {self._recv_seq} (replay?)"
             )
         self._recv_seq += 1
-        return msg
-
-
-def _check_frame_tuple(frame: Any) -> tuple:
-    if (
-        not isinstance(frame, tuple)
-        or len(frame) != 4
-        or not isinstance(frame[0], bytes)
-        or not isinstance(frame[1], bytes)
-        or not isinstance(frame[2], int)
-    ):
-        raise ConnectionError("malformed channel frame")
-    return frame
+        return cloudpickle.loads(payload)
 
 
 def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", Any]:
@@ -251,12 +294,13 @@ def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", 
     agent session replayed wholesale dies here: the driver's fresh nonce
     changes the combined id, so every post-handshake replayed frame is
     rejected."""
-    frame = recv_msg(sock, token)
-    agent_sid, direction, seq, msg = _check_frame_tuple(frame)
+    meta, payload = recv_msg_raw(sock, token)
+    agent_sid, direction, seq = _unpack_meta(meta)
     if direction != SecureChannel.A2D or seq != 0:
         raise ConnectionError("bad channel bootstrap frame")
+    msg = cloudpickle.loads(payload)
     driver_sid = os.urandom(16)
-    send_msg(sock, (driver_sid, SecureChannel.D2A, 0, HelloAck(agent_sid)), token)
+    send_frame(sock, token, driver_sid, SecureChannel.D2A, 0, HelloAck(agent_sid))
     chan = SecureChannel(
         sock,
         token,
@@ -274,15 +318,13 @@ def connect_channel(sock: socket.socket, token: bytes, hello: Any) -> "SecureCha
     nonce, verify the driver's ack binds it, and return the channel over
     the combined session id."""
     agent_sid = os.urandom(16)
-    send_msg(sock, (agent_sid, SecureChannel.A2D, 0, hello), token)
-    frame = recv_msg(sock, token)
-    driver_sid, direction, seq, ack = _check_frame_tuple(frame)
-    if (
-        direction != SecureChannel.D2A
-        or seq != 0
-        or not isinstance(ack, HelloAck)
-        or ack.agent_sid != agent_sid
-    ):
+    send_frame(sock, token, agent_sid, SecureChannel.A2D, 0, hello)
+    meta, payload = recv_msg_raw(sock, token)
+    driver_sid, direction, seq = _unpack_meta(meta)
+    if direction != SecureChannel.D2A or seq != 0:
+        raise ConnectionError("bad handshake ack from driver")
+    ack = cloudpickle.loads(payload)
+    if not isinstance(ack, HelloAck) or ack.agent_sid != agent_sid:
         raise ConnectionError("bad handshake ack from driver")
     return SecureChannel(
         sock,

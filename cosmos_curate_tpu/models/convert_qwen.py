@@ -66,7 +66,9 @@ def qwen2_lm_config(hf_config, **overrides):
     return VLMConfig(**kw)
 
 
-def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]:
+def convert_qwen2_lm(
+    state_dict, n_layers: int, *, tied_embeddings: bool | None = None
+) -> tuple[dict, ConversionReport]:
     """HF Qwen2(-VL) state dict → our VLM LM params subtree + report.
 
     Accepts both bare Qwen2 (``model.``) and Qwen2-VL (``model.`` +
@@ -119,9 +121,11 @@ def convert_qwen2_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]
             report.vision_skipped.append(k)
         elif k == "lm_head.weight":
             head, emb = _t(sd[k]), params["embed"]["embedding"]
-            if head.shape == emb.shape and np.array_equal(head, emb):
-                # tied checkpoints may still serialize the head; covered by
-                # embed.attend
+            # tied checkpoints may still serialize the head (covered by
+            # embed.attend) — but only drop it when the TARGET config does
+            # not expect a separate lm_head (see convert_qwen3_moe_lm)
+            redundant = head.shape == emb.shape and np.array_equal(head, emb)
+            if redundant and tied_embeddings is not False:
                 report.mapped.append(k)
             else:
                 # untied head (Qwen2.5-VL): its own projection matrix
@@ -172,7 +176,9 @@ def qwen3_moe_lm_config(hf_text_config, **overrides):
     return VLMConfig(**kw)
 
 
-def convert_qwen3_moe_lm(state_dict, n_layers: int) -> tuple[dict, ConversionReport]:
+def convert_qwen3_moe_lm(
+    state_dict, n_layers: int, *, tied_embeddings: bool | None = None
+) -> tuple[dict, ConversionReport]:
     """HF Qwen3(-VL)-MoE text state dict → our VLM params subtree + report
     (reference serves this family via vLLM EP, models/vllm_qwen.py:313-349).
 
@@ -224,7 +230,14 @@ def convert_qwen3_moe_lm(state_dict, n_layers: int) -> tuple[dict, ConversionRep
             report.vision_skipped.append(k)
         elif k.endswith("lm_head.weight"):
             head, emb = _t(sd[k]), params["embed"]["embedding"]
-            if head.shape == emb.shape and np.array_equal(head, emb):
+            # drop the head ONLY when it is redundant (equals the embedding)
+            # AND the target config does not expect a separate lm_head: an
+            # untied config whose head happens to equal the embedding must
+            # still carry lm_head or the restore fails spuriously. Pass
+            # tied_embeddings from the target VLMConfig; None keeps the
+            # equality heuristic for bare state-dict conversions.
+            redundant = head.shape == emb.shape and np.array_equal(head, emb)
+            if redundant and tied_embeddings is not False:
                 report.mapped.append(k)
             else:
                 params["lm_head"] = {"kernel": head.T}

@@ -365,7 +365,13 @@ class HFJsonTokenizer:
             )
         self._eos = eos
         self._pad = pad
-        self.vocab_size = self._tok.get_vocab_size(with_added_tokens=True)
+        # max id + 1, NOT the token count: a tokenizer.json with sparse
+        # added-token ids above the count would pass the T5 'tokenizer
+        # exceeds cfg.vocab' guard yet emit out-of-range ids that XLA's
+        # gather silently clamps — the exact failure that guard exists
+        # to prevent
+        vocab = self._tok.get_vocab(with_added_tokens=True)
+        self.vocab_size = (max(vocab.values()) + 1) if vocab else 0
 
     def encode(self, text: str, *, add_bos: bool = False) -> list[int]:  # noqa: ARG002
         return self._tok.encode(

@@ -470,20 +470,29 @@ class CaptionEngine:
                 logger.exception("prefill prep failed for %s; dropping", req.request_id)
                 continue
             lane_budget = lane.length - req.sampling.max_new_tokens - 1
-            if t_valid > lane_budget:  # estimate was off: truncate to fit
+            if t_valid > lane_budget:  # estimate was off
                 if req.frames is not None:
-                    # never slice a vision block (see _fit_frames_to_budget)
-                    logger.error(
-                        "%s: lane routing under-estimated a multimodal "
-                        "prompt (%d > %d); dropping",
-                        req.request_id,
-                        t_valid,
-                        lane_budget,
+                    # never slice a vision block (see _fit_frames_to_budget):
+                    # re-route on the ACTUAL token count — _prepare_embeds
+                    # guarantees t_valid fits the longest lane, so a lane
+                    # exists; None only means it is busy, so requeue at the
+                    # head and wait instead of dropping a servable request
+                    lane2 = self._route(t_valid + req.sampling.max_new_tokens + 1)
+                    if lane2 is None:
+                        self.waiting.insert(0, req)
+                        break
+                    logger.info(
+                        "%s: multimodal prompt re-routed %d -> %d lane "
+                        "(estimate %d, actual %d tokens)",
+                        req.request_id, lane.length, lane2.length,
+                        lane_budget, t_valid,
                     )
-                    continue
-                embeds = embeds[-lane_budget:]
-                rope_pos = rope_pos[-lane_budget:]
-                t_valid = lane_budget
+                    lane = lane2
+                    lane_budget = lane.length - req.sampling.max_new_tokens - 1
+                else:
+                    embeds = embeds[-lane_budget:]
+                    rope_pos = rope_pos[-lane_budget:]
+                    t_valid = lane_budget
             slot_idx = next(
                 i
                 for i in range(lane.n_slots)

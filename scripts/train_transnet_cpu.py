@@ -68,6 +68,9 @@ def main() -> int:
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--eval-every", type=int, default=25)
+    # fraction of batch rows that are a SINGLE scene (all-zero labels);
+    # see models/transnet_train.synthesize_batch
+    ap.add_argument("--single-scene-frac", type=float, default=0.35)
     # margins over the golden test's thresholds (0.5 peak, 5x separation,
     # 0.5 false-cut ceiling) so a pass here implies a pass there
     ap.add_argument("--peak-prob", type=float, default=0.65)
@@ -143,7 +146,9 @@ def main() -> int:
 
     t0 = time.time()
     for i in range(1, a.max_steps + 1):
-        frames, labels = synthesize_batch(rng, a.batch, a.window)
+        frames, labels = synthesize_batch(
+            rng, a.batch, a.window, single_scene_frac=a.single_scene_frac
+        )
         params, opt_state, loss = step(
             params, opt_state, jnp.asarray(frames), jnp.asarray(labels)
         )

@@ -315,7 +315,7 @@ class TestReplayProtection:
             Hello,
             RemoteWorkerManager,
             SecureChannel,
-            send_msg,
+            send_frame,
         )
 
         monkeypatch.setenv("CURATE_ENGINE_TOKEN", "replay-secret")
@@ -325,14 +325,13 @@ class TestReplayProtection:
             token = b"replay-secret"
             sock = socket.create_connection(("127.0.0.1", port), timeout=5)
             sid = b"S" * 16
-            frame = (sid, SecureChannel.A2D, 0, Hello("replayer", 2.0))
-            send_msg(sock, frame, token)
+            send_frame(sock, token, sid, SecureChannel.A2D, 0, Hello("replayer", 2.0))
             time.sleep(0.3)
             assert [a.node_id for a in mgr.agents] == ["replayer"]
             assert mgr.agents[0].alive
             # replay the SAME frame (identical bytes an attacker recorded):
             # seq 0 again -> the driver must drop the link
-            send_msg(sock, frame, token)
+            send_frame(sock, token, sid, SecureChannel.A2D, 0, Hello("replayer", 2.0))
             time.sleep(0.3)
             assert not mgr.agents[0].alive
         finally:
@@ -360,6 +359,40 @@ class TestReplayProtection:
             a.close()
             b.close()
 
+    def test_stale_frame_rejected_before_deserialization(self, tmp_path):
+        """ADVICE r4: freshness must GATE cloudpickle.loads — a replayed or
+        cross-session frame's payload objects are never reconstructed. The
+        tattletale payload creates a file if it is ever unpickled."""
+        import socket as _socket
+
+        from cosmos_curate_tpu.engine.remote_plane import SecureChannel
+
+        marker = tmp_path / "deserialized.marker"
+
+        class Tattletale:
+            def __init__(self, path):
+                self.path = path
+
+            def __reduce__(self):
+                return (open, (str(self.path), "w"))
+
+        a, b = _socket.socketpair()
+        try:
+            token = b"t"
+            old = SecureChannel(
+                a, token, b"old-session-id!!", SecureChannel.D2A, SecureChannel.A2D
+            )
+            old.send(Tattletale(marker))
+            new_chan = SecureChannel(
+                b, token, b"new-session-id!!", SecureChannel.A2D, SecureChannel.D2A
+            )
+            with pytest.raises(ConnectionError, match="different session"):
+                new_chan.recv()
+            assert not marker.exists(), "stale payload was deserialized"
+        finally:
+            a.close()
+            b.close()
+
     def test_full_session_replay_rejected_by_driver_nonce(self, monkeypatch):
         """A WHOLE recorded agent session replayed to the driver must die at
         the first post-handshake frame: the driver's fresh nonce changes
@@ -373,8 +406,8 @@ class TestReplayProtection:
             HelloAck,
             RemoteWorkerManager,
             SecureChannel,
-            recv_msg,
-            send_msg,
+            recv_frame,
+            send_frame,
         )
 
         monkeypatch.setenv("CURATE_ENGINE_TOKEN", "nonce-secret")
@@ -384,24 +417,22 @@ class TestReplayProtection:
         mgr = RemoteWorkerManager(port, results_q, local_cpu_budget=1.0)
         try:
             sid_a = b"A" * 16
-            bootstrap = (sid_a, SecureChannel.A2D, 0, Hello("victim", 2.0))
 
             # "recorded" session: handshake + one post-handshake frame
             s1 = socket.create_connection(("127.0.0.1", port), timeout=5)
-            send_msg(s1, bootstrap, token)
-            sid_d1, _, _, ack = recv_msg(s1, token)
+            send_frame(s1, token, sid_a, SecureChannel.A2D, 0, Hello("victim", 2.0))
+            sid_d1, _, _, ack = recv_frame(s1, token)
             assert isinstance(ack, HelloAck) and ack.agent_sid == sid_a
-            frame1 = (sid_a + sid_d1, SecureChannel.A2D, 1, AgentReady("w0"))
-            send_msg(s1, frame1, token)
+            send_frame(s1, token, sid_a + sid_d1, SecureChannel.A2D, 1, AgentReady("w0"))
             time.sleep(0.3)
             assert results_q.qsize() == 1  # the live session's frame landed
 
             # replay: same bootstrap bytes, then the RECORDED frame1 — whose
             # sid embeds the OLD driver nonce
             s2 = socket.create_connection(("127.0.0.1", port), timeout=5)
-            send_msg(s2, bootstrap, token)
-            recv_msg(s2, token)  # fresh ack (different nonce)
-            send_msg(s2, frame1, token)
+            send_frame(s2, token, sid_a, SecureChannel.A2D, 0, Hello("victim", 2.0))
+            recv_frame(s2, token)  # fresh ack (different nonce)
+            send_frame(s2, token, sid_a + sid_d1, SecureChannel.A2D, 1, AgentReady("w0"))
             time.sleep(0.3)
             # the replayed frame was NOT processed and the phantom is dead
             assert results_q.qsize() == 1

@@ -106,7 +106,9 @@ class TestHFParity:
         torch.manual_seed(3)
         hf = Qwen3VLMoeTextModel(hf_cfg).eval()
         cfg = qwen3_moe_lm_config(hf_cfg, max_seq=64, mrope_section=None)
-        params, report = convert_qwen3_moe_lm(hf.state_dict(), cfg.n_layers)
+        params, report = convert_qwen3_moe_lm(
+            hf.state_dict(), cfg.n_layers, tied_embeddings=cfg.tied_embeddings
+        )
         return hf, cfg, params, report
 
     def test_interleaved_component_map_matches_hf_layout(self):
@@ -248,7 +250,7 @@ class TestFullVLMoEParity:
             qwen_vision=v_cfg,
         )
         lm_params, lm_report = convert_qwen3_moe_lm(
-            hf.state_dict(), ours_cfg.n_layers
+            hf.state_dict(), ours_cfg.n_layers, tied_embeddings=ours_cfg.tied_embeddings
         )
         vis_params, vis_report = convert_qwen3_vision(hf.state_dict(), v_cfg)
         return hf, ours_cfg, lm_params, vis_params, lm_report, vis_report
