@@ -151,6 +151,13 @@ def get(ref: ObjectRef):
             data = f.read()
     except FileNotFoundError as e:
         raise FileNotFoundError(f"object store segment {ref.shm_name} missing") from e
+    return loads_segment(data)
+
+
+def loads_segment(data: bytes):
+    """Reconstruct an object from raw segment bytes (the store's on-disk
+    format) — used both by local get() and by the cross-node object channel
+    when the consumer wants the value without creating a local segment."""
     mv = memoryview(data)
     plen = int.from_bytes(mv[:_HEADER], "little")
     off = _HEADER
@@ -171,6 +178,49 @@ def get(ref: ObjectRef):
         bufs.append(bytes(chunk) if s < _COPY_THRESHOLD else chunk)
         off += s
     return pickle.loads(payload, buffers=bufs)
+
+
+def segment_path(name: str) -> str:
+    return os.path.join(_SHM_DIR, name)
+
+
+def valid_segment_name(name: str) -> bool:
+    """Only store-shaped names may cross the object channel (a hostile GET
+    must not read arbitrary /dev/shm files, nor contain path separators)."""
+    return re.fullmatch(r"cur\d+-[0-9a-f]+", name) is not None
+
+
+def put_raw_chunks(chunks, total_size: int, num_buffers: int, *, prefix: str | None = None) -> ObjectRef:
+    """Write raw segment bytes (the on-disk format, e.g. streamed from
+    another node's store) into a LOCAL segment under the local owner's
+    name — the source node's name must not be reused, because the stale
+    -segment janitor reclaims segments whose embedded pid is dead on THIS
+    host. Constant-memory: ``chunks`` is an iterable of byte chunks."""
+    if prefix is None:
+        prefix = f"cur{os.environ.get('CURATE_STORE_OWNER', os.getpid())}"
+    name = f"{prefix}-{uuid.uuid4().hex[:16]}"
+    tmp = segment_path(name) + ".tmp"
+    written = 0
+    try:
+        with open(tmp, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
+                written += len(chunk)
+        if written != total_size:
+            raise ConnectionError(
+                f"object transfer truncated: got {written} of {total_size} bytes"
+            )
+    except BaseException:
+        # any failure (source raised mid-stream, MAC mismatch, short write)
+        # must not leave a .tmp pinning /dev/shm RAM — the janitor's name
+        # pattern never matches it
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, segment_path(name))
+    return ObjectRef(shm_name=name, total_size=total_size, num_buffers=num_buffers)
 
 
 def delete(ref: ObjectRef) -> None:
@@ -213,13 +263,18 @@ def cleanup_stale_segments(shm_dir: str = "/dev/shm") -> int:
 
 
 class StoreBudget:
-    """Coordinator-side capacity accounting with blocking backpressure."""
+    """Coordinator-side capacity accounting with blocking backpressure.
 
-    def __init__(self, capacity_bytes: int) -> None:
+    ``deleter`` frees a released ref's storage; the default unlinks the
+    local segment, and the cross-node runner passes a location-aware
+    deleter that forwards agent-owned segments to their owner."""
+
+    def __init__(self, capacity_bytes: int, *, deleter=None) -> None:
         self.capacity = capacity_bytes
         self._used = 0
         self._live: dict[str, int] = {}
         self._cv = threading.Condition()
+        self._deleter = deleter or delete
 
     @property
     def used(self) -> int:
@@ -243,4 +298,4 @@ class StoreBudget:
             size = self._live.pop(ref.shm_name, 0)
             self._used -= size
             self._cv.notify_all()
-        delete(ref)
+        self._deleter(ref)

@@ -7,11 +7,14 @@ up automatically):
 
 The agent spawns the SAME worker processes the driver uses locally
 (engine/worker.py ``worker_main`` — spawn, never fork; CPU-pinned JAX) and
-relays their control/result queues over the authenticated socket. Task
-payloads land in this node's object store on arrival and results are
-materialized back to bytes before the return hop — the driver's store and
-the agent's store never share segments. Reference match: the per-node Ray
-worker processes xenna schedules onto (ARCHITECTURE.md:70-81).
+relays their control/result queues over the authenticated socket. The
+control link carries REFS only: input segments stream in from their owner
+(the driver's store or a peer agent) over the object channel
+(engine/object_channel.py), segments this node already owns are consumed
+in place, and outputs stay here until the driver releases them — the
+driver's NIC is not on the data path. Reference match: the per-node Ray
+worker processes xenna schedules onto, with refs moving centrally and data
+peer-to-peer (ARCHITECTURE.md:70-81).
 """
 
 from __future__ import annotations
@@ -24,14 +27,13 @@ import socket
 import threading
 import time
 
-import cloudpickle
-
-from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.engine import object_channel, object_store
 from cosmos_curate_tpu.engine.remote_plane import (
     AgentReady,
     AgentResult,
     Bye,
     Hello,
+    ReleaseObjects,
     StartWorker,
     StopWorker,
     SubmitBatch,
@@ -54,6 +56,22 @@ logger = get_logger(__name__)
 _MP = mp.get_context("spawn")
 
 
+def _delete_segments_with_prefix(prefix: str) -> int:
+    n = 0
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    for name in names:
+        if name.startswith(prefix) and object_store.valid_segment_name(name):
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
 class NodeAgent:
     def __init__(self, driver: str, *, node_id: str | None = None, num_cpus: float | None = None) -> None:
         host, _, port = driver.rpartition(":")
@@ -62,11 +80,18 @@ class NodeAgent:
         self.num_cpus = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
         self.token = _token()
         self.workers: dict[str, tuple[object, object]] = {}  # key -> (in_q, proc)
-        # (worker_key, batch_id) -> input refs, deleted once the result is
-        # relayed (or the worker dies) so /dev/shm never accumulates
+        # (worker_key, batch_id) -> input refs this agent FETCHED (local
+        # copies of remote segments), deleted once the result is relayed (or
+        # the worker dies) so /dev/shm never accumulates. Locally-owned
+        # input refs (this node's earlier outputs) are NOT tracked here —
+        # the driver releases those via ReleaseObjects.
         self.inflight: dict[tuple[str, int], list] = {}
         self.results_q: mp.Queue = _MP.Queue()
         self._stop = threading.Event()
+        # serves THIS node's segments to the driver and peer agents
+        self.object_server = object_channel.ObjectServer(self.token)
+        self.driver_object_addr: tuple[str, int] = ("", 0)
+        self._last_run_id: bytes | None = None
 
     def run(self, *, connect_timeout_s: float = 60.0, reconnect: bool = True) -> int:
         """Serve the driver until it says Bye.
@@ -123,7 +148,20 @@ class NodeAgent:
         # mutual-nonce handshake: both sides contribute fresh randomness
         # to the session id, so no recorded session replays (either
         # direction) into this one (see SecureChannel/connect_channel)
-        self.chan = connect_channel(sock, self.token, Hello(self.node_id, self.num_cpus))
+        self.chan, ack = connect_channel(
+            sock, self.token,
+            Hello(self.node_id, self.num_cpus, object_port=self.object_server.port),
+        )
+        self.driver_object_addr = (self.addr[0], ack.driver_object_port)
+        # output segments from a PREVIOUS run are unreferenced dead weight;
+        # a transient link blip within the SAME run must keep them — the
+        # driver still references them as downstream inputs (run_id tells
+        # the two apart)
+        if self._last_run_id is not None and ack.run_id != self._last_run_id:
+            n = _delete_segments_with_prefix(f"cur{os.getpid()}-")
+            if n:
+                logger.info("dropped %d output segments from the previous run", n)
+        self._last_run_id = ack.run_id
         logger.info(
             "agent %s joined driver %s:%d (%.0f cpus)",
             self.node_id, self.addr[0], self.addr[1], self.num_cpus,
@@ -218,10 +256,12 @@ class NodeAgent:
                     )
                 )
                 return
-            tasks = cloudpickle.loads(msg.tasks_pickle)
-            refs = [object_store.put(t) for t in tasks]
-            self.inflight[(msg.worker_key, msg.batch_id)] = refs
+            refs, fetched = self._resolve_specs(msg.refs)
+            self.inflight[(msg.worker_key, msg.batch_id)] = fetched
             entry[0].put(ProcessMsg(batch_id=msg.batch_id, refs=refs))
+        elif isinstance(msg, ReleaseObjects):
+            for name in msg.names:
+                object_store.delete(object_store.ObjectRef(name, 0, 0))
         elif isinstance(msg, StopWorker):
             entry = self.workers.pop(msg.worker_key, None)
             if entry is not None:
@@ -229,6 +269,40 @@ class NodeAgent:
                     entry[0].put(ShutdownMsg())
                 except Exception:
                     entry[1].terminate()
+
+    def _resolve_specs(self, specs) -> tuple[list, list]:
+        """RefSpecs -> local ObjectRefs. Segments this node already owns
+        are used in place (node affinity: zero bytes moved); everything
+        else streams from its owner — the driver's store or a PEER agent —
+        over the object channel, never through the driver's control socket.
+        Returns (refs_for_worker, fetched_local_copies)."""
+        refs: list = []
+        fetched: list = []
+        try:
+            for s in specs:
+                local = object_store.ObjectRef(s.shm_name, s.total_size, s.num_buffers)
+                if s.owner_node == self.node_id and os.path.exists(
+                    object_store.segment_path(s.shm_name)
+                ):
+                    refs.append(local)  # ours already; driver releases it later
+                    continue
+                if s.owner_node == "":  # driver-owned: dial the control host
+                    addr = self.driver_object_addr
+                else:
+                    addr = (s.owner_host, s.owner_port)
+                copy = object_channel.fetch_object(addr, self.token, local)
+                refs.append(copy)
+                fetched.append(copy)
+        except BaseException:
+            # partial failure must not orphan the copies already written
+            # (retries would leak a fresh set each attempt)
+            for r in fetched:
+                try:
+                    object_store.delete(r)
+                except Exception:
+                    pass
+            raise
+        return refs, fetched
 
     def _release_inflight(self, worker_key: str, batch_id: int) -> None:
         refs = self.inflight.pop((worker_key, batch_id), [])
@@ -259,19 +333,18 @@ class NodeAgent:
                             )
                         )
                         continue
-                    outputs = [object_store.get(r) for r in msg.out_refs]
-                    # outputs are pickled for the wire; their segments are
-                    # dead weight from here on
-                    for r in msg.out_refs:
-                        try:
-                            object_store.delete(r)
-                        except Exception:
-                            pass
+                    # outputs STAY in this node's store; only descriptors
+                    # ride the control link. Consumers pull the bytes from
+                    # our ObjectServer; the driver sends ReleaseObjects when
+                    # the last consumer is done.
                     self._send(
                         AgentResult(
                             msg.worker_id,
                             msg.batch_id,
-                            outputs_pickle=cloudpickle.dumps(outputs),
+                            out_refs=[
+                                (r.shm_name, r.total_size, r.num_buffers)
+                                for r in msg.out_refs
+                            ],
                             process_time_s=msg.process_time_s,
                             deserialize_time_s=msg.deserialize_time_s,
                         )

@@ -1,12 +1,16 @@
-"""Cross-node inter-stage data plane for the streaming engine.
+"""Cross-node inter-stage CONTROL plane for the streaming engine.
 
 Equivalent capability of xenna's cross-node execution (reference
-ARCHITECTURE.md:25-27,70-81 — tasks move between nodes' per-stage pools
-with the driver's central loop doing placement): worker processes on REMOTE
-hosts join a CPU stage's pool, batches flow to them over TCP, results flow
-back — the driver's orchestration loop, retries, autoscaler and object
-store are unchanged (remote results materialize into the driver's store and
-become ordinary ``ObjectRef``s).
+ARCHITECTURE.md:25-27,70-81 — the central loop moves ~48-byte refs between
+nodes' per-stage pools; DATA moves producer→consumer): worker processes on
+REMOTE hosts join a CPU stage's pool; SubmitBatch frames carry location
+-aware ref descriptors and results return as descriptors too — the actual
+bytes ride the peer-to-peer object channel (engine/object_channel.py)
+between whichever nodes produce and consume them, so the driver's NIC
+never gates data volume and large batches stream with no frame-size cap.
+The orchestration loop, retries, autoscaler and object store are
+unchanged; dispatch prefers the worker whose node already owns a batch's
+input bytes.
 
 Topology: the driver (node rank 0) listens on ``CURATE_ENGINE_DRIVER_PORT``;
 every other node runs ``python -m cosmos_curate_tpu.engine.remote_agent
@@ -56,6 +60,9 @@ _MAGIC = b"CRPL"
 class Hello:
     node_id: str
     num_cpus: float
+    # the agent's ObjectServer port (engine/object_channel.py): peers pull
+    # this node's segments directly from here
+    object_port: int = 0
 
 
 @dataclass
@@ -66,16 +73,41 @@ class StartWorker:
     env: dict[str, str]
 
 
+@dataclass(frozen=True)
+class RefSpec:
+    """Location-aware object descriptor — what SubmitBatch carries instead
+    of task payloads (reference ARCHITECTURE.md:70-81: the central loop
+    moves refs; data moves producer→consumer). ``owner_node`` is '' when
+    the driver's store owns the segment (the agent then dials the driver's
+    control host at ``owner_port``)."""
+
+    shm_name: str
+    total_size: int
+    num_buffers: int
+    owner_node: str = ""
+    owner_host: str = ""
+    owner_port: int = 0
+
+
 @dataclass
 class SubmitBatch:
     worker_key: str
     batch_id: int
-    tasks_pickle: bytes
+    refs: list  # list[RefSpec]
 
 
 @dataclass
 class StopWorker:
     worker_key: str
+
+
+@dataclass
+class ReleaseObjects:
+    """Driver → agent: these agent-owned segments have no remaining
+    consumers — free them (the driver's StoreBudget.release for local
+    segments, forwarded to the owner)."""
+
+    names: list  # list[str]
 
 
 @dataclass
@@ -88,7 +120,9 @@ class AgentReady:
 class AgentResult:
     worker_key: str
     batch_id: int
-    outputs_pickle: bytes | None = None
+    # (shm_name, total_size, num_buffers) per output — the segments STAY in
+    # the agent's store; consumers pull them over the object channel
+    out_refs: list | None = None
     error: str | None = None
     process_time_s: float = 0.0
     deserialize_time_s: float = 0.0
@@ -116,9 +150,16 @@ class HelloAck:
     the MAC'd frame, so the binding cannot be forged) and contributes the
     driver's own nonce. The channel session id is the concatenation — BOTH
     sides contribute fresh randomness, so neither direction of a recorded
-    session can be replayed into a later one."""
+    session can be replayed into a later one. Also advertises the driver's
+    ObjectServer port so agents can pull driver-owned segments."""
 
     agent_sid: bytes
+    driver_object_port: int = 0
+    # stable for one RemoteWorkerManager lifetime: agents use it to tell a
+    # transient link blip (same run — keep output segments, the driver still
+    # references them) from a driver restart (new run — the old outputs are
+    # unreferenced dead weight)
+    run_id: bytes = b""
 
 
 # -- framing ----------------------------------------------------------------
@@ -153,7 +194,7 @@ def _unpack_meta(meta: bytes) -> tuple[bytes, bytes, int]:
     return sid, direction, seq
 
 
-def send_msg(sock: socket.socket, msg: Any, token: bytes, *, meta: bytes = b"") -> None:
+def send_msg(sock: socket.socket, msg: Any, token: bytes, *, meta: bytes = b"") -> int:
     """One MAC'd frame: [meta_len u16][meta][cloudpickle payload]. ``meta``
     carries freshness fields (session id, direction, sequence) OUTSIDE the
     pickle so the receiver verifies them before deserializing anything."""
@@ -169,12 +210,13 @@ def send_msg(sock: socket.socket, msg: Any, token: bytes, *, meta: bytes = b"") 
     mac = hmac.new(token, body, hashlib.sha256).digest()
     header = _MAGIC + struct.pack(">Q", len(body)) + mac
     sock.sendall(header + body)
+    return len(header) + len(body)
 
 
 def send_frame(
     sock: socket.socket, token: bytes, sid: bytes, direction: bytes, seq: int, msg: Any
-) -> None:
-    send_msg(sock, msg, token, meta=_pack_meta(sid, direction, seq))
+) -> int:
+    return send_msg(sock, msg, token, meta=_pack_meta(sid, direction, seq))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -262,16 +304,21 @@ class SecureChannel:
         self._send_seq = send_seq_start
         self._recv_seq = recv_seq_start
         self._lock = threading.Lock()
+        # control-plane byte accounting: with the P2P object channel these
+        # must stay O(refs) regardless of data volume (tests assert it)
+        self.bytes_sent = 0
+        self.bytes_received = 0
 
     def send(self, msg: Any) -> None:
         with self._lock:
-            send_frame(
+            self.bytes_sent += send_frame(
                 self.sock, self._token, self.sid, self._send_dir, self._send_seq, msg
             )
             self._send_seq += 1
 
     def recv(self, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
         meta, payload = recv_msg_raw(self.sock, self._token, max_bytes=max_bytes)
+        self.bytes_received += len(meta) + len(payload) + 44
         sid, direction, seq = _unpack_meta(meta)
         # freshness gates deserialization: a replayed/cross-session frame is
         # rejected before its payload objects are ever reconstructed
@@ -287,7 +334,9 @@ class SecureChannel:
         return cloudpickle.loads(payload)
 
 
-def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", Any]:
+def accept_channel(
+    sock: socket.socket, token: bytes, *, object_port: int = 0, run_id: bytes = b""
+) -> tuple["SecureChannel", Any]:
     """Driver side of the handshake: read the agent's bootstrap frame,
     reply with the driver's own nonce (HelloAck, binding the agent's), and
     return (channel over the COMBINED session id, hello_msg). A recorded
@@ -300,7 +349,10 @@ def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", 
         raise ConnectionError("bad channel bootstrap frame")
     msg = cloudpickle.loads(payload)
     driver_sid = os.urandom(16)
-    send_frame(sock, token, driver_sid, SecureChannel.D2A, 0, HelloAck(agent_sid))
+    send_frame(
+        sock, token, driver_sid, SecureChannel.D2A, 0,
+        HelloAck(agent_sid, driver_object_port=object_port, run_id=run_id),
+    )
     chan = SecureChannel(
         sock,
         token,
@@ -313,10 +365,12 @@ def accept_channel(sock: socket.socket, token: bytes) -> tuple["SecureChannel", 
     return chan, msg
 
 
-def connect_channel(sock: socket.socket, token: bytes, hello: Any) -> "SecureChannel":
+def connect_channel(
+    sock: socket.socket, token: bytes, hello: Any
+) -> tuple["SecureChannel", "HelloAck"]:
     """Agent side of the handshake: send the bootstrap Hello under a fresh
-    nonce, verify the driver's ack binds it, and return the channel over
-    the combined session id."""
+    nonce, verify the driver's ack binds it, and return (channel over the
+    combined session id, the driver's ack)."""
     agent_sid = os.urandom(16)
     send_frame(sock, token, agent_sid, SecureChannel.A2D, 0, hello)
     meta, payload = recv_msg_raw(sock, token)
@@ -326,7 +380,7 @@ def connect_channel(sock: socket.socket, token: bytes, hello: Any) -> "SecureCha
     ack = cloudpickle.loads(payload)
     if not isinstance(ack, HelloAck) or ack.agent_sid != agent_sid:
         raise ConnectionError("bad handshake ack from driver")
-    return SecureChannel(
+    chan = SecureChannel(
         sock,
         token,
         agent_sid + driver_sid,
@@ -335,6 +389,7 @@ def connect_channel(sock: socket.socket, token: bytes, hello: Any) -> "SecureCha
         send_seq_start=1,
         recv_seq_start=1,
     )
+    return chan, ack
 
 
 # -- driver side ------------------------------------------------------------
@@ -390,6 +445,9 @@ class AgentLink:
     token: bytes
     chan: "SecureChannel | None" = None
     alive: bool = True
+    # the agent's ObjectServer endpoint (peer IP from the control socket +
+    # the Hello's object_port): where this node's segments are pulled from
+    object_addr: tuple = ("", 0)
     # worker_key -> cpu cost; accounting is in CPU units, matching the
     # autoscaler's per-worker resources.cpus
     worker_costs: dict = field(default_factory=dict)
@@ -416,12 +474,19 @@ class RemoteWorkerManager:
     downstream stages cannot tell where a batch ran."""
 
     def __init__(self, port: int, results_q, *, local_cpu_budget: float) -> None:
+        from cosmos_curate_tpu.engine.object_channel import ObjectServer
+
         self.token = _token()
         self.results_q = results_q
         self.local_cpu_budget = local_cpu_budget
         self.local_cpus_used = 0.0  # all pools' locally placed workers (cpu units)
         self.agents: list[AgentLink] = []
         self._lock = threading.Lock()
+        # P2P object plane: the driver serves ITS segments from here, and
+        # tracks which agent owns every remote segment (shm_name -> link)
+        self.object_server = ObjectServer(self.token)
+        self._locations: dict[str, AgentLink] = {}
+        self.run_id = os.urandom(16)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         # a restarted driver must rebind the well-known port: SO_REUSEADDR
         # covers TIME_WAIT, and a short retry covers the window where a
@@ -457,10 +522,7 @@ class RemoteWorkerManager:
     def _sender_loop(self) -> None:
         import queue as _queue
 
-        from cosmos_curate_tpu.engine import object_store
         from cosmos_curate_tpu.engine.worker import ProcessMsg, ShutdownMsg
-
-        from cosmos_curate_tpu.engine.worker import ResultMsg
 
         while not self._closed:
             try:
@@ -472,24 +534,30 @@ class RemoteWorkerManager:
                 with self._lock:
                     agent.worker_costs.pop(key, None)
                 continue
+            if isinstance(msg, ReleaseObjects):
+                agent.send(msg)
+                continue
             if not isinstance(msg, ProcessMsg):
                 continue
-            try:
-                tasks = [object_store.get(r) for r in msg.refs]
-                frame = SubmitBatch(key, msg.batch_id, cloudpickle.dumps(tasks))
-            except Exception:
-                # a materialize/serialize failure is a BATCH failure (the
-                # local path would fail the same way), never a link failure
-                import traceback
+            # refs only — no payloads on the driver socket. The consumer
+            # agent pulls each segment straight from its owner (this node's
+            # ObjectServer, or a peer agent's) over the object channel.
+            agent.send(SubmitBatch(key, msg.batch_id, [self._spec_for(r) for r in msg.refs]))
 
-                logger.exception("remote dispatch prep failed for worker %s", key)
-                self.results_q.put(
-                    ResultMsg(
-                        msg.batch_id, error=traceback.format_exc(), worker_id=key
-                    )
-                )
-                continue
-            agent.send(frame)  # socket errors mark the link dead internally
+    def _spec_for(self, ref) -> RefSpec:
+        with self._lock:
+            link = self._locations.get(ref.shm_name)
+        if link is None:  # driver-owned: agents dial the control host
+            return RefSpec(
+                ref.shm_name, ref.total_size, ref.num_buffers,
+                owner_node="", owner_host="", owner_port=self.object_server.port,
+            )
+        return RefSpec(
+            ref.shm_name, ref.total_size, ref.num_buffers,
+            owner_node=link.node_id,
+            owner_host=link.object_addr[0],
+            owner_port=link.object_addr[1],
+        )
 
     # -- connection handling -------------------------------------------
     def _accept_loop(self) -> None:
@@ -504,7 +572,10 @@ class RemoteWorkerManager:
 
     def _serve_agent(self, sock: socket.socket, addr) -> None:
         try:
-            chan, hello = accept_channel(sock, self.token)
+            chan, hello = accept_channel(
+                sock, self.token,
+                object_port=self.object_server.port, run_id=self.run_id,
+            )
         except (ConnectionError, OSError) as e:
             logger.warning("rejected agent connection from %s: %s", addr, e)
             sock.close()
@@ -512,7 +583,10 @@ class RemoteWorkerManager:
         if not isinstance(hello, Hello):
             sock.close()
             return
-        link = AgentLink(hello.node_id, hello.num_cpus, sock, self.token, chan=chan)
+        link = AgentLink(
+            hello.node_id, hello.num_cpus, sock, self.token, chan=chan,
+            object_addr=(addr[0], hello.object_port),
+        )
         with self._lock:
             self.agents.append(link)
         logger.info(
@@ -547,8 +621,14 @@ class RemoteWorkerManager:
                     )
                 )
                 return
-            outputs = cloudpickle.loads(msg.outputs_pickle or b"\x80\x04]\x94.")
-            refs = [object_store.put(t) for t in outputs]
+            # outputs stay in the AGENT's store: register their location and
+            # hand the orchestration loop ordinary refs — data only moves
+            # when (and to where) a consumer needs it
+            refs = []
+            with self._lock:
+                for name, size, nbuf in msg.out_refs or []:
+                    refs.append(object_store.ObjectRef(name, size, nbuf))
+                    self._locations[name] = link
             self.results_q.put(
                 ResultMsg(
                     msg.batch_id,
@@ -558,6 +638,50 @@ class RemoteWorkerManager:
                     worker_id=msg.worker_key,
                 )
             )
+
+    # -- P2P data plane -------------------------------------------------
+    def owner_node(self, ref) -> str:
+        """'' when the driver's store owns the segment, else the agent's
+        node id (dispatch affinity keys on this)."""
+        with self._lock:
+            link = self._locations.get(ref.shm_name)
+        return link.node_id if link is not None else ""
+
+    def localize(self, ref):
+        """Pull an agent-owned segment into the DRIVER's store (a local
+        consumer needs the bytes); returns the local ref. Driver-owned refs
+        return unchanged."""
+        from cosmos_curate_tpu.engine import object_channel
+
+        with self._lock:
+            link = self._locations.get(ref.shm_name)
+        if link is None:
+            return ref
+        return object_channel.fetch_object(link.object_addr, self.token, ref)
+
+    def fetch_value_if_remote(self, ref):
+        """Materialize a ref wherever it lives (final-sink path): remote
+        refs stream from their owner without creating a local segment."""
+        from cosmos_curate_tpu.engine import object_channel, object_store
+
+        with self._lock:
+            link = self._locations.get(ref.shm_name)
+        if link is None:
+            return object_store.get(ref)
+        return object_channel.fetch_value(link.object_addr, self.token, ref)
+
+    def release_data(self, ref) -> None:
+        """Location-aware delete: local segments unlink here; agent-owned
+        segments release at their owner (via the control link's sender
+        thread — never the orchestration loop)."""
+        from cosmos_curate_tpu.engine import object_store
+
+        with self._lock:
+            link = self._locations.pop(ref.shm_name, None)
+        if link is None:
+            object_store.delete(ref)
+        elif link.alive:
+            self._send_q.put((link, "", ReleaseObjects([ref.shm_name])))
 
     # -- placement (all accounting in CPU units: a worker costs its
     # stage's resources.cpus, matching the autoscaler's budget math) ----
@@ -627,6 +751,9 @@ class RemoteWorkerManager:
                     "cpus": a.num_cpus,
                     "workers": len(a.worker_costs),
                     "cpus_used": a.cpus_used,
+                    # control-link bytes: O(refs), never O(data)
+                    "ctrl_bytes_sent": a.chan.bytes_sent if a.chan else 0,
+                    "ctrl_bytes_received": a.chan.bytes_received if a.chan else 0,
                 }
                 for a in self.agents
             }
@@ -646,6 +773,7 @@ class RemoteWorkerManager:
             self._server.close()
         except OSError:
             pass
+        self.object_server.close()
 
 
 def maybe_create_manager(results_q, *, local_cpu_budget: float) -> RemoteWorkerManager | None:

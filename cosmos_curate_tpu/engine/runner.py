@@ -70,6 +70,9 @@ class StreamingRunner(RunnerInterface):
     def __init__(self, *, metrics_port: int | None = None, poll_interval_s: float = 0.02) -> None:
         self.metrics = get_metrics(metrics_port)
         self.poll_interval_s = poll_interval_s
+        self._remote_mgr = None
+        self._fetch_pool = None
+        self._final_fetches: list = []
         # stage name -> summed worker busy seconds (MFU accounting; the
         # sequential runner exposes the same attribute with wall time)
         self.stage_times: dict[str, float] = {}
@@ -146,9 +149,29 @@ class StreamingRunner(RunnerInterface):
             )
             for i, s in enumerate(stage_specs)
         ]
+        self._remote_mgr = remote_mgr
         store = object_store.StoreBudget(
-            capacity_bytes=int(_host_memory_bytes() * cfg.streaming.object_store_fraction)
+            capacity_bytes=int(_host_memory_bytes() * cfg.streaming.object_store_fraction),
+            # location-aware deletion: agent-owned segments release at their
+            # owner over the control link, local ones unlink here
+            deleter=remote_mgr.release_data if remote_mgr is not None else None,
         )
+        # network transfers NEVER run on the orchestration loop (the same
+        # property _RemoteInQ documents for sends): localizing agent-owned
+        # inputs for local workers and materializing remote final outputs
+        # happen on this executor, with completions drained like results
+        import concurrent.futures
+
+        self._fetch_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="obj-fetch"
+            )
+            if remote_mgr is not None
+            else None
+        )
+        localize_done: queue.Queue = queue.Queue()
+        localizing: set[int] = set()
+        self._final_fetches: list = []  # (stage_state, Future[(values, n_failed)])
         # Segments created by this run (and its workers) carry this pid.
         os.environ.setdefault("CURATE_STORE_OWNER", str(os.getpid()))
 
@@ -192,6 +215,29 @@ class StreamingRunner(RunnerInterface):
                         self._on_ready(states, msg, pending_setup_errors)
                         continue
                     self._on_result(states, batches, msg, outputs, store, cfg)
+                # 1b. drain finished localizations
+                while True:
+                    try:
+                        lb, err = localize_done.get_nowait()
+                    except queue.Empty:
+                        break
+                    progressed = True
+                    localizing.discard(lb.batch_id)
+                    stx = states[lb.stage_idx]
+                    if err is None:
+                        # inputs are local now: dispatch with priority
+                        stx.retry_queue.appendleft(lb)
+                    else:
+                        logger.warning(
+                            "localizing batch %d inputs failed: %s", lb.batch_id, err
+                        )
+                        lb.worker_deaths += 1  # infra failure, same budget
+                        if lb.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
+                            stx.retry_queue.append(lb)
+                        else:
+                            stx.errored_batches += 1
+                            for r in lb.refs:
+                                store.release(r)
                 if pending_setup_errors:
                     raise RuntimeError(
                         "stage worker setup failed:\n" + "\n".join(pending_setup_errors)
@@ -213,11 +259,14 @@ class StreamingRunner(RunnerInterface):
                     if limit_next is not None and len(states[i + 1].in_queue) >= limit_next:
                         continue  # backpressure: downstream full
                     bs = max(1, st.spec.stage.batch_size)
+                    idle = []
                     for w in st.pool.idle_workers():
                         if st.pool.lifetime_expired(w) and w.busy_batch is None:
                             st.pool.stop_worker(w)
                             st.pool.start_worker()
                             continue
+                        idle.append(w)
+                    while idle:
                         if st.retry_queue:  # failed batches keep their identity
                             batch = st.retry_queue.popleft()
                         elif st.in_queue:
@@ -229,6 +278,27 @@ class StreamingRunner(RunnerInterface):
                             next_batch_id += 1
                         else:
                             break
+                        # node affinity: of the idle workers, prefer the one
+                        # whose node already holds the most input bytes
+                        # (reference ARCHITECTURE.md:70-81 — node-local
+                        # deserialization preferred)
+                        w = self._pick_worker(idle, batch.refs, remote_mgr)
+                        idle.remove(w)
+                        if (
+                            remote_mgr is not None
+                            and not self._worker_node(w)
+                            and any(remote_mgr.owner_node(r) for r in batch.refs)
+                        ):
+                            # a LOCAL consumer needs agent-owned bytes: pull
+                            # them on the fetch pool, never this loop; the
+                            # batch re-enters dispatch when done (1b above)
+                            localizing.add(batch.batch_id)
+                            self._fetch_pool.submit(
+                                self._localize_batch,
+                                batch, store, remote_mgr, localize_done,
+                            )
+                            progressed = True
+                            continue
                         batches[batch.batch_id] = batch
                         st.pool.submit(w, batch.batch_id, batch.refs)
                         st.dispatched += 1
@@ -255,11 +325,23 @@ class StreamingRunner(RunnerInterface):
                 if (
                     inputs_exhausted
                     and not batches
+                    and not localizing
                     and all(not st.in_queue and not st.retry_queue for st in states)
                 ):
                     break
                 if not progressed:
                     time.sleep(self.poll_interval_s)
+            # gather remote final outputs fetched off-loop
+            for stx, fut in self._final_fetches:
+                try:
+                    values, n_failed = fut.result(timeout=120)
+                except Exception:
+                    logger.exception("final output fetch failed")
+                    stx.errored_batches += 1
+                    continue
+                outputs.extend(values)
+                if n_failed:
+                    stx.errored_batches += 1  # once per batch, not per ref
             return outputs if cfg.return_last_stage_outputs else None
         finally:
             for batch in batches.values():  # in-flight on exception exit
@@ -274,11 +356,70 @@ class StreamingRunner(RunnerInterface):
                 st.pool.shutdown()
             if prewarm is not None:
                 prewarm.shutdown()
+            if self._fetch_pool is not None:
+                self._fetch_pool.shutdown(wait=False)
             if remote_mgr is not None:
                 self.remote_stats = remote_mgr.stats()
                 remote_mgr.shutdown()
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _worker_node(w) -> str:
+        """'' for locally placed workers, else the agent's node id."""
+        agent = getattr(w.proc, "_agent", None)
+        return agent.node_id if agent is not None else ""
+
+    def _pick_worker(self, idle, refs, remote_mgr):
+        if remote_mgr is None or len(idle) == 1:
+            return idle[0]
+        owned_bytes: dict[str, int] = {}
+        for r in refs:
+            node = remote_mgr.owner_node(r)
+            owned_bytes[node] = owned_bytes.get(node, 0) + r.total_size
+        return max(idle, key=lambda w: owned_bytes.get(self._worker_node(w), 0))
+
+    @staticmethod
+    def _localize_batch(batch, store, remote_mgr, done_q) -> None:
+        """Fetch-pool job: pull a batch's agent-owned inputs into the
+        driver store (remote workers resolve their own inputs agent-side).
+        The batch is invisible to dispatch while here, so mutating its refs
+        is race-free."""
+        try:
+            for j, r in enumerate(batch.refs):
+                if not remote_mgr.owner_node(r):
+                    continue
+                local = remote_mgr.localize(r)
+                store.account(local)
+                store.release(r)  # routes the delete to the owning agent
+                batch.refs[j] = local
+            done_q.put((batch, None))
+        except Exception as e:
+            done_q.put((batch, e))
+
+    @staticmethod
+    def _fetch_final_values(refs, remote_mgr) -> tuple[list, int]:
+        """Fetch-pool job: materialize one batch's remote final outputs and
+        release them at their owner. Returns (values, n_failed)."""
+        values = []
+        failed = 0
+        for r in refs:
+            try:
+                values.append(remote_mgr.fetch_value_if_remote(r))
+            except Exception:
+                logger.exception("final output %s lost (owner gone?)", r)
+                failed += 1
+            finally:
+                remote_mgr.release_data(r)
+        return values, failed
+
+    def _free_ref(self, ref) -> None:
+        """Location-aware delete for refs OUTSIDE the store ledger (final
+        outputs, late results)."""
+        if self._remote_mgr is not None:
+            self._remote_mgr.release_data(ref)
+        else:
+            object_store.delete(ref)
+
     def _on_ready(self, states, msg: ReadyMsg, errors: list[str]) -> None:
         for st in states:
             w = st.pool.workers.get(msg.worker_id)
@@ -297,7 +438,7 @@ class StreamingRunner(RunnerInterface):
             # sent the result then died). At-least-once semantics: the rerun
             # wins; this result's outputs must not leak.
             for r in msg.out_refs:
-                object_store.delete(r)
+                self._free_ref(r)
             return
         st = states[batch.stage_idx]
         w = st.pool.workers.get(msg.worker_id)
@@ -333,19 +474,36 @@ class StreamingRunner(RunnerInterface):
         for r in batch.refs:
             store.release(r)
         nxt = batch.stage_idx + 1
+        final_remote: list = []
         for r in msg.out_refs:
             if nxt < len(states):
                 store.account(r)  # queue bounds + input gating provide backpressure
                 states[nxt].in_queue.append(r)
-            else:
-                # Final-stage outputs must NOT enter the admission ledger:
-                # they are only freed at run end, so accounting them would
-                # eventually pin ``used`` above capacity, halt input seeding,
-                # and livelock the completion condition. Materialize now (if
-                # the caller wants them) and free the segment immediately.
+                continue
+            # Final-stage outputs must NOT enter the admission ledger: they
+            # are only freed at run end, so accounting them would eventually
+            # pin ``used`` above capacity, halt input seeding, and livelock
+            # the completion condition. Local segments materialize + free
+            # here (shm read, no network); agent-owned ones stream on the
+            # fetch pool, never this loop.
+            if self._remote_mgr is not None and self._remote_mgr.owner_node(r):
                 if cfg.return_last_stage_outputs:
-                    outputs.append(object_store.get(r))
-                object_store.delete(r)
+                    final_remote.append(r)
+                else:
+                    self._free_ref(r)
+                continue
+            if cfg.return_last_stage_outputs:
+                outputs.append(object_store.get(r))
+            object_store.delete(r)
+        if final_remote:
+            self._final_fetches.append(
+                (
+                    st,
+                    self._fetch_pool.submit(
+                        self._fetch_final_values, final_remote, self._remote_mgr
+                    ),
+                )
+            )
 
     _MAX_SETUP_DEATHS = 3
 

@@ -1,0 +1,202 @@
+"""P2P object plane: the driver's control socket moves refs, never data
+(reference ARCHITECTURE.md:70-81 — the central loop moves ~48-byte refs
+with node-local data preferred). Two real node-agent subprocesses join the
+driver; a two-stage pipeline pushes megabytes of array data between them
+while the control link stays O(refs)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, PipelineSpec
+from cosmos_curate_tpu.core.stage import Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+PAYLOAD_BYTES = 2 << 20  # per task
+
+
+class _DataTask(PipelineTask):
+    def __init__(self, value: int) -> None:
+        self.value = value
+        self.blob: np.ndarray | None = None
+        self.produced_on = ""
+        self.consumed_on = ""
+        self.checksum = 0.0
+
+
+class _ProduceStage(Stage):
+    """Attaches a multi-megabyte array on whatever node this runs on."""
+
+    def setup(self, meta) -> None:
+        self._node = meta.node.node_id
+
+    def process_data(self, tasks):
+        time.sleep(0.1)
+        for t in tasks:
+            t.blob = np.full(PAYLOAD_BYTES, t.value % 251, np.uint8)
+            t.produced_on = self._node
+        return tasks
+
+
+class _ConsumeStage(Stage):
+    """Checksums and DROPS the array, so final outputs back to the driver
+    are small — the bulk bytes only ever move producer -> consumer."""
+
+    def setup(self, meta) -> None:
+        self._node = meta.node.node_id
+
+    def process_data(self, tasks):
+        time.sleep(0.1)
+        for t in tasks:
+            t.checksum = float(t.blob.sum())
+            t.blob = None
+            t.consumed_on = self._node
+        return tasks
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_agent(port: int, node_id: str, cpus: float) -> subprocess.Popen:
+    env = {
+        **os.environ,
+        "CURATE_ENGINE_TOKEN": "object-plane-secret",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "cosmos_curate_tpu.engine.remote_agent",
+            "--driver", f"127.0.0.1:{port}",
+            "--node-id", node_id,
+            "--num-cpus", str(cpus),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_driver_socket_carries_refs_not_data(monkeypatch):
+    port = _free_port()
+    monkeypatch.setenv("CURATE_ENGINE_TOKEN", "object-plane-secret")
+    monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", "2")
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+    monkeypatch.setenv("CURATE_PREWARM", "0")
+
+    agents = [_spawn_agent(port, "agent-a", 1), _spawn_agent(port, "agent-b", 1)]
+    try:
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+        runner = StreamingRunner(poll_interval_s=0.01)
+        n_tasks = 8
+        spec = PipelineSpec(
+            input_data=[_DataTask(i) for i in range(n_tasks)],
+            stages=[
+                StageSpec(_ProduceStage(), num_workers=1),
+                StageSpec(_ConsumeStage(), num_workers=1),
+            ],
+            config=PipelineConfig(
+                # local budget ~0: both stages' workers place on the agents,
+                # one per node (least-loaded placement)
+                num_cpus=0.1,
+                return_last_stage_outputs=True,
+            ),
+        )
+        out = runner.run(spec)
+        assert out is not None and len(out) == n_tasks
+        expected = {float(PAYLOAD_BYTES * (i % 251)) for i in range(n_tasks)}
+        assert {t.checksum for t in out} == expected
+        # every batch ran remotely (the driver kept no worker)
+        assert all(t.produced_on.startswith("agent-") for t in out)
+        assert all(t.consumed_on.startswith("agent-") for t in out)
+
+        stats = runner.remote_stats
+        assert set(stats) == {"agent-a", "agent-b"}
+        data_bytes = n_tasks * PAYLOAD_BYTES  # >= 16 MiB moved between nodes
+        ctrl_bytes = sum(
+            s["ctrl_bytes_sent"] + s["ctrl_bytes_received"] for s in stats.values()
+        )
+        # THE property: the control socket carried refs, not payloads.
+        # StartWorker stage pickles + descriptors are far under one task's
+        # payload; materialized data through the driver would be >= 16 MiB.
+        assert ctrl_bytes < data_bytes / 8, (
+            f"driver control link moved {ctrl_bytes} bytes for "
+            f"{data_bytes} bytes of task data — payloads are riding the "
+            "control socket"
+        )
+    finally:
+        for a in agents:
+            a.terminate()
+        for a in agents:
+            try:
+                a.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                a.kill()
+
+
+@pytest.mark.slow
+def test_peer_fetch_between_agents(monkeypatch):
+    """When producer and consumer land on DIFFERENT nodes, the consumer
+    pulls the bytes from the producer's object server — visible as the
+    produced_on/consumed_on split with correct checksums, while the driver
+    link still stays O(refs)."""
+    port = _free_port()
+    monkeypatch.setenv("CURATE_ENGINE_TOKEN", "object-plane-secret")
+    monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", "2")
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+    monkeypatch.setenv("CURATE_PREWARM", "0")
+
+    # one cpu per agent and one worker per stage: the two stages CANNOT
+    # share a node, so stage-2's inputs must cross agent-to-agent
+    agents = [_spawn_agent(port, "agent-a", 1), _spawn_agent(port, "agent-b", 1)]
+    try:
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+        runner = StreamingRunner(poll_interval_s=0.01)
+        n_tasks = 4
+        spec = PipelineSpec(
+            input_data=[_DataTask(i) for i in range(n_tasks)],
+            stages=[
+                StageSpec(_ProduceStage(), num_workers=1),
+                StageSpec(_ConsumeStage(), num_workers=1),
+            ],
+            config=PipelineConfig(num_cpus=0.1, return_last_stage_outputs=True),
+        )
+        out = runner.run(spec)
+        assert out is not None and len(out) == n_tasks
+        produced = {t.produced_on for t in out}
+        consumed = {t.consumed_on for t in out}
+        assert produced and consumed and produced.isdisjoint(consumed), (
+            f"expected the stages on different nodes, got produce={produced} "
+            f"consume={consumed}"
+        )
+        # checksums prove the consumer saw the producer's actual bytes
+        assert {t.checksum for t in out} == {
+            float(PAYLOAD_BYTES * (i % 251)) for i in range(n_tasks)
+        }
+    finally:
+        for a in agents:
+            a.terminate()
+        for a in agents:
+            try:
+                a.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                a.kill()
