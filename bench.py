@@ -234,6 +234,11 @@ def main() -> int:
     if caption:
         record["caption_output_tokens_per_sec"] = caption["value"]
         record["caption_config"] = caption_cfg
+        if "caption_pipeline_efficiency" in caption:
+            # SPEED_OF_LIGHT.md:67-81 — in-pipeline ÷ standalone tok/s on
+            # identical requests through the same engine
+            record["caption_pipeline_efficiency"] = caption["caption_pipeline_efficiency"]
+            record["caption_pipeline_tokens_per_sec"] = caption["pipeline_tokens_per_sec"]
         if caption.get("backend") == "tpu":
             record["decode_mfu"] = caption.get("decode_mfu", 0.0)
         elif caption.get("backend") != backend:

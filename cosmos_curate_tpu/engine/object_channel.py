@@ -85,6 +85,8 @@ class ObjectServer:
         from cosmos_curate_tpu.engine.remote_plane import recv_msg
 
         try:
+            # a wedged/half-open peer must not pin this thread forever
+            sock.settimeout(30)
             req = recv_msg(sock, self._token, max_bytes=1 << 20)
             if (
                 isinstance(req, tuple)
@@ -195,10 +197,9 @@ def fetch_value(addr: tuple[str, int], token: bytes, ref: object_store.ObjectRef
     segment (final-sink materialization)."""
     sock, total, chunks = _open_get(addr, token, ref.shm_name)
     try:
-        data = b"".join(chunks)
-        if len(data) != total:
-            raise ConnectionError("object stream truncated")
-        return object_store.loads_segment(data)
+        # chunks() delivers exactly `total` bytes or raises (truncation and
+        # MAC failures surface from the generator)
+        return object_store.loads_segment(b"".join(chunks))
     finally:
         try:
             sock.close()

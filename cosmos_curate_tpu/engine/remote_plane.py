@@ -486,6 +486,10 @@ class RemoteWorkerManager:
         # tracks which agent owns every remote segment (shm_name -> link)
         self.object_server = ObjectServer(self.token)
         self._locations: dict[str, AgentLink] = {}
+        # releases addressed to a currently-dead link wait here (node_id ->
+        # segment names) and flush when that node rejoins — a transient blip
+        # must not leak the agent's segments for the rest of the run
+        self._pending_releases: dict[str, list] = {}
         self.run_id = os.urandom(16)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         # a restarted driver must rebind the well-known port: SO_REUSEADDR
@@ -589,6 +593,18 @@ class RemoteWorkerManager:
         )
         with self._lock:
             self.agents.append(link)
+            # a REJOIN after a link blip: the node kept its segments (same
+            # run_id), so re-point their location entries at the live link
+            # and flush releases that arrived during the outage
+            for name, old in list(self._locations.items()):
+                if old.node_id == hello.node_id and not old.alive:
+                    self._locations[name] = link
+            stale = self._pending_releases.pop(hello.node_id, [])
+        if stale:
+            with self._lock:
+                for name in stale:
+                    self._locations.pop(name, None)
+            self._send_q.put((link, "", ReleaseObjects(stale)))
         logger.info(
             "node agent joined: %s (%.0f cpus) from %s", hello.node_id, hello.num_cpus, addr
         )
@@ -673,14 +689,21 @@ class RemoteWorkerManager:
     def release_data(self, ref) -> None:
         """Location-aware delete: local segments unlink here; agent-owned
         segments release at their owner (via the control link's sender
-        thread — never the orchestration loop)."""
+        thread — never the orchestration loop). A dead link's releases are
+        parked and flushed when that node rejoins."""
         from cosmos_curate_tpu.engine import object_store
 
         with self._lock:
-            link = self._locations.pop(ref.shm_name, None)
+            link = self._locations.get(ref.shm_name)
+            if link is not None and not link.alive:
+                self._pending_releases.setdefault(link.node_id, []).append(
+                    ref.shm_name
+                )
+                return
+            self._locations.pop(ref.shm_name, None)
         if link is None:
             object_store.delete(ref)
-        elif link.alive:
+        else:
             self._send_q.put((link, "", ReleaseObjects([ref.shm_name])))
 
     # -- placement (all accounting in CPU units: a worker costs its

@@ -170,7 +170,9 @@ class StreamingRunner(RunnerInterface):
             else None
         )
         localize_done: queue.Queue = queue.Queue()
-        localizing: set[int] = set()
+        # batch_id -> _Batch while on the fetch pool: these are in neither
+        # `batches` nor any queue, so exception-exit cleanup must walk this
+        localizing: dict[int, _Batch] = {}
         self._final_fetches: list = []  # (stage_state, Future[(values, n_failed)])
         # Segments created by this run (and its workers) carry this pid.
         os.environ.setdefault("CURATE_STORE_OWNER", str(os.getpid()))
@@ -222,7 +224,7 @@ class StreamingRunner(RunnerInterface):
                     except queue.Empty:
                         break
                     progressed = True
-                    localizing.discard(lb.batch_id)
+                    localizing.pop(lb.batch_id, None)
                     stx = states[lb.stage_idx]
                     if err is None:
                         # inputs are local now: dispatch with priority
@@ -292,7 +294,7 @@ class StreamingRunner(RunnerInterface):
                             # a LOCAL consumer needs agent-owned bytes: pull
                             # them on the fetch pool, never this loop; the
                             # batch re-enters dispatch when done (1b above)
-                            localizing.add(batch.batch_id)
+                            localizing[batch.batch_id] = batch
                             self._fetch_pool.submit(
                                 self._localize_batch,
                                 batch, store, remote_mgr, localize_done,
@@ -345,6 +347,17 @@ class StreamingRunner(RunnerInterface):
             return outputs if cfg.return_last_stage_outputs else None
         finally:
             for batch in batches.values():  # in-flight on exception exit
+                for r in batch.refs:
+                    store.release(r)
+            # batches on (or finished with) the localize fetch pool are in
+            # neither `batches` nor any queue — walk them too
+            while True:
+                try:
+                    lb, _err = localize_done.get_nowait()
+                except queue.Empty:
+                    break
+                localizing.setdefault(lb.batch_id, lb)
+            for batch in localizing.values():
                 for r in batch.refs:
                     store.release(r)
             for st in states:

@@ -34,3 +34,20 @@ def test_synthetic_corpus_shape(tmp_path):
     files = sorted(vids.glob("*.mp4"))
     assert len(files) == 3
     assert all(f.stat().st_size > 0 for f in files)
+
+
+def test_caption_pipeline_efficiency_measured():
+    """VERDICT r4 #6: the caption bench must compute pipeline efficiency —
+    in-pipeline tok/s over standalone tok/s on identical requests through
+    one shared engine (SPEED_OF_LIGHT.md:67-81)."""
+    from cosmos_curate_tpu.models.vlm import CaptionEngine, VLM_TINY_TEST
+
+    from benchmarks.caption_benchmark import _pipeline_efficiency
+
+    engine = CaptionEngine(VLM_TINY_TEST, max_batch=4)
+    engine.setup()
+    args = argparse.Namespace(requests=3, max_new=8, batch=4, frames=4)
+    rec = _pipeline_efficiency(VLM_TINY_TEST, engine, args)
+    assert rec["standalone_tokens_per_sec"] > 0
+    assert rec["pipeline_tokens_per_sec"] > 0
+    assert rec["caption_pipeline_efficiency"] > 0
