@@ -222,6 +222,7 @@ def _pipeline_efficiency(cfg, engine, args) -> dict:
     # whichever pass runs first eats the XLA compile and the ratio inverts
     submit_all("warm-")
     engine.run_until_complete()
+    engine.reset_stats()  # decode_tokens is cumulative: zero it for (a)
     t0 = _time.monotonic()
     submit_all("")
     engine.run_until_complete()

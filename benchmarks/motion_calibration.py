@@ -68,12 +68,37 @@ def score_fixture(frames: np.ndarray) -> tuple[float, float]:
     return float(g), float(p)
 
 
-def calibrate(*, h: int = 240, w: int = 320, t: int = 48, seeds: int = 3) -> dict:
+def score_fixture_mv(frames: np.ndarray) -> tuple[float, float]:
+    """Codec-MV estimator scores (video/motion_vectors.py) through the same
+    encode roundtrip; (-1, -1) when no MVs are available."""
+    from cosmos_curate_tpu.video.encode import encode_frames
+    from cosmos_curate_tpu.video.motion_vectors import (
+        extract_mv_field,
+        mv_motion_scores,
+    )
+
+    data = encode_frames(frames, 24.0)
+    mv = extract_mv_field(data)
+    scores = mv_motion_scores(mv) if mv is not None else None
+    return scores if scores is not None else (-1.0, -1.0)
+
+
+def calibrate(
+    *, h: int = 240, w: int = 320, t: int = 48, seeds: int = 3, mv: bool = False
+) -> dict:
+    scorer = score_fixture_mv if mv else score_fixture
     per_kind: dict[str, list[float]] = {}
     for kind in STATIC_KINDS + MOVING_KINDS:
         per_kind[kind] = [
-            score_fixture(make_fixture(kind, s, h=h, w=w, t=t))[0] for s in range(seeds)
+            scorer(make_fixture(kind, s, h=h, w=w, t=t))[0] for s in range(seeds)
         ]
+        if mv and any(v < 0 for v in per_kind[kind]):
+            # the sentinel must not flow into the statistics: a garbage
+            # "calibration" with no error is worse than failing
+            raise RuntimeError(
+                f"codec-MV scoring unavailable for {kind!r} fixtures "
+                "(native binding or decoder missing); cannot calibrate --mv"
+            )
     static_max = max(v for k in STATIC_KINDS for v in per_kind[k])
     moving_min = min(v for k in MOVING_KINDS for v in per_kind[k])
     # geometric-style midpoint biased low: false-drops of real motion are
@@ -93,9 +118,12 @@ def main() -> int:
     ap.add_argument("--size", default="240x320")
     ap.add_argument("--frames", type=int, default=48)
     ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument(
+        "--mv", action="store_true", help="calibrate the codec-MV estimator"
+    )
     a = ap.parse_args()
     h, w = (int(x) for x in a.size.split("x"))
-    print(json.dumps(calibrate(h=h, w=w, t=a.frames, seeds=a.seeds), indent=2))
+    print(json.dumps(calibrate(h=h, w=w, t=a.frames, seeds=a.seeds, mv=a.mv), indent=2))
     return 0
 
 

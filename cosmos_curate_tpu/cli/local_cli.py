@@ -43,6 +43,13 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--multicam", action="store_true", help="input is <session>/<camera>.mp4 dirs")
     split.add_argument("--primary-camera", default="", help="primary camera filename stem")
     split.add_argument("--motion-filter", choices=["disable", "score-only", "enable"], default="disable")
+    split.add_argument(
+        "--motion-backend",
+        choices=["auto", "mv", "frame-diff"],
+        default="auto",
+        help="motion estimator: codec motion vectors, frame differences, "
+        "or auto (MVs with frame-diff fallback)",
+    )
     split.add_argument("--aesthetic-threshold", type=float, default=None)
     split.add_argument(
         "--embedding-model",
@@ -306,6 +313,7 @@ def _cmd_split(args: argparse.Namespace) -> int:
             multicam=args.multicam,
             primary_camera=args.primary_camera,
             motion_filter=args.motion_filter,
+            motion_backend=args.motion_backend,
             aesthetic_threshold=args.aesthetic_threshold,
             embedding_model=args.embedding_model,
             captioning=args.captioning,

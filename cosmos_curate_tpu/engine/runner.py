@@ -346,6 +346,11 @@ class StreamingRunner(RunnerInterface):
                     stx.errored_batches += 1  # once per batch, not per ref
             return outputs if cfg.return_last_stage_outputs else None
         finally:
+            # quiesce the fetch pool FIRST: a still-running _localize_batch
+            # mutates batch.refs and releases refs itself — walking
+            # `localizing` concurrently would double-release
+            if self._fetch_pool is not None:
+                self._fetch_pool.shutdown(wait=True)
             for batch in batches.values():  # in-flight on exception exit
                 for r in batch.refs:
                     store.release(r)
@@ -369,8 +374,6 @@ class StreamingRunner(RunnerInterface):
                 st.pool.shutdown()
             if prewarm is not None:
                 prewarm.shutdown()
-            if self._fetch_pool is not None:
-                self._fetch_pool.shutdown(wait=False)
             if remote_mgr is not None:
                 self.remote_stats = remote_mgr.stats()
                 remote_mgr.shutdown()

@@ -1,8 +1,14 @@
-"""ctypes bindings for the native runtime library (curate_native.cpp).
+"""ctypes bindings for the native runtime libraries.
 
-Compiled on demand with g++ (cached beside the source; rebuilt when the
-source changes). Absent a toolchain, callers fall back to the pure-Python
-paths — the native library is an accelerator, never a requirement.
+Compiled on demand (cached beside the source hash; rebuilt when a source
+changes). Absent a toolchain or the needed system libraries, callers fall
+back to the pure-Python paths — native code is an accelerator, never a
+requirement.
+
+Bindings:
+- curate_native.cpp — shared-memory object-store framing (cn_put).
+- h264_encoder.c — libx264 clip encoder over libavformat/libavcodec.
+- mv_extract.c — codec motion-vector extraction (libavcodec export_mvs).
 """
 
 from __future__ import annotations
@@ -13,15 +19,11 @@ import os
 import subprocess
 import threading
 from pathlib import Path
+from typing import Callable
 
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
-
-_SRC = Path(__file__).parent / "curate_native.cpp"
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_load_failed = False
 
 
 def _build_dir() -> Path:
@@ -39,93 +41,142 @@ def _build_dir() -> Path:
     return d
 
 
+class _Binding:
+    """One compile-once-and-load native library: shared lock / source-hash
+    cache / atomic-rename / prototype-configuration mechanics."""
+
+    def __init__(
+        self,
+        src_name: str,
+        *,
+        stem: str,
+        compiler: list[str],
+        libs: list[str],
+        configure: Callable[[ctypes.CDLL], None],
+        fallback_note: str,
+    ) -> None:
+        self.src = Path(__file__).parent / src_name
+        self.stem = stem
+        self.compiler = compiler
+        self.libs = libs
+        self.configure = configure
+        self.fallback_note = fallback_note
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._failed = False
+
+    def load(self) -> ctypes.CDLL | None:
+        if self._lib is not None or self._failed:
+            return self._lib
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            try:
+                tag = hashlib.sha256(self.src.read_bytes()).hexdigest()[:16]
+                so = _build_dir() / f"{self.stem}-{tag}.so"
+                if not so.exists():
+                    # build to a process-unique temp then atomically rename,
+                    # so concurrent workers can't observe a half-written .so
+                    tmp = so.with_name(f"{so.stem}.{os.getpid()}.tmp.so")
+                    cmd = [
+                        *self.compiler, "-O2", "-shared", "-fPIC",
+                        "-o", str(tmp), str(self.src), *self.libs,
+                    ]
+                    try:
+                        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                        tmp.replace(so)
+                    finally:
+                        tmp.unlink(missing_ok=True)  # failed builds must not litter
+                    logger.info("built native library %s", so.name)
+                lib = ctypes.CDLL(str(so))
+                self.configure(lib)
+                self._lib = lib
+            except Exception as e:
+                logger.warning("%s unavailable (%s); %s", self.stem, e, self.fallback_note)
+                self._failed = True
+        return self._lib
+
+
+def _configure_native(lib: ctypes.CDLL) -> None:
+    lib.cn_put.restype = ctypes.c_int
+    lib.cn_put.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+    ]
+
+
+def _configure_h264(lib: ctypes.CDLL) -> None:
+    lib.curate_h264_open.restype = ctypes.c_void_p
+    lib.curate_h264_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_double,
+        ctypes.c_int,
+        ctypes.c_char_p,
+    ]
+    lib.curate_h264_write.restype = ctypes.c_int
+    lib.curate_h264_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.curate_h264_close.restype = ctypes.c_int
+    lib.curate_h264_close.argtypes = [ctypes.c_void_p]
+
+
+def _configure_mv(lib: ctypes.CDLL) -> None:
+    lib.curate_mv_field.restype = ctypes.c_int
+    lib.curate_mv_field.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+    ]
+
+
+_AV_LIBS = ["-lavformat", "-lavcodec", "-lavutil"]
+
+_NATIVE = _Binding(
+    "curate_native.cpp",
+    stem="libcurate_native",
+    compiler=["g++", "-std=c++17"],
+    libs=["-lrt"],
+    configure=_configure_native,
+    fallback_note="using Python path",
+)
+_H264 = _Binding(
+    "h264_encoder.c",
+    stem="libcurate_h264",
+    compiler=["gcc"],
+    libs=[*_AV_LIBS, "-lswscale"],
+    configure=_configure_h264,
+    fallback_note="falling back to cv2",
+)
+_MV = _Binding(
+    "mv_extract.c",
+    stem="libcurate_mv",
+    compiler=["gcc"],
+    libs=[*_AV_LIBS, "-lm"],
+    configure=_configure_mv,
+    fallback_note="frame-diff fallback",
+)
+
+
 def load_native() -> ctypes.CDLL | None:
-    """Compile (if needed) and load the native library; None on failure."""
-    global _lib, _load_failed
-    if _lib is not None or _load_failed:
-        return _lib
-    with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        try:
-            src = _SRC.read_bytes()
-            tag = hashlib.sha256(src).hexdigest()[:16]
-            so = _build_dir() / f"libcurate_native-{tag}.so"
-            if not so.exists():
-                # build to a process-unique temp then atomically rename, so
-                # concurrent workers can't observe a half-written .so
-                tmp = so.with_name(f"{so.stem}.{os.getpid()}.tmp.so")
-                cmd = [
-                    "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                    "-o", str(tmp), str(_SRC), "-lrt",
-                ]
-                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-                tmp.replace(so)
-                logger.info("built native library %s", so.name)
-            lib = ctypes.CDLL(str(so))
-            lib.cn_put.restype = ctypes.c_int
-            lib.cn_put.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_char_p,
-                ctypes.c_uint64,
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.c_uint64,
-                ctypes.c_uint64,
-            ]
-            _lib = lib
-        except Exception as e:
-            logger.warning("native library unavailable (%s); using Python path", e)
-            _load_failed = True
-    return _lib
-
-
-_H264_SRC = Path(__file__).parent / "h264_encoder.c"
-_h264_lock = threading.Lock()
-_h264_lib: ctypes.CDLL | None = None
-_h264_failed = False
+    """Object-store framing accelerator; None -> Python path."""
+    return _NATIVE.load()
 
 
 def load_h264() -> ctypes.CDLL | None:
-    """Compile (if needed) and load the H264 encoder binding; None when the
-    toolchain or the ffmpeg dev libraries are absent (callers fall back to
-    cv2's negotiated codec)."""
-    global _h264_lib, _h264_failed
-    if _h264_lib is not None or _h264_failed:
-        return _h264_lib
-    with _h264_lock:
-        if _h264_lib is not None or _h264_failed:
-            return _h264_lib
-        try:
-            src = _H264_SRC.read_bytes()
-            tag = hashlib.sha256(src).hexdigest()[:16]
-            so = _build_dir() / f"libcurate_h264-{tag}.so"
-            if not so.exists():
-                tmp = so.with_name(f"{so.stem}.{os.getpid()}.tmp.so")
-                cmd = [
-                    "gcc", "-O2", "-shared", "-fPIC",
-                    "-o", str(tmp), str(_H264_SRC),
-                    "-lavformat", "-lavcodec", "-lswscale", "-lavutil",
-                ]
-                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-                tmp.replace(so)
-                logger.info("built H264 encoder library %s", so.name)
-            lib = ctypes.CDLL(str(so))
-            lib.curate_h264_open.restype = ctypes.c_void_p
-            lib.curate_h264_open.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_int,
-                ctypes.c_int,
-                ctypes.c_double,
-                ctypes.c_int,
-                ctypes.c_char_p,
-            ]
-            lib.curate_h264_write.restype = ctypes.c_int
-            lib.curate_h264_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-            lib.curate_h264_close.restype = ctypes.c_int
-            lib.curate_h264_close.argtypes = [ctypes.c_void_p]
-            _h264_lib = lib
-        except Exception as e:
-            logger.warning("H264 encoder unavailable (%s); falling back to cv2", e)
-            _h264_failed = True
-    return _h264_lib
+    """libx264 encoder binding; None -> cv2's negotiated codec."""
+    return _H264.load()
+
+
+def load_mv() -> ctypes.CDLL | None:
+    """Motion-vector extraction binding; None -> frame-diff estimator."""
+    return _MV.load()

@@ -57,9 +57,14 @@ class SplitPipelineArgs:
     extract_resize_hw: tuple[int, int] = (224, 224)
     # model stages (enabled as they come online)
     motion_filter: str = "disable"  # disable | score-only | enable
+    # estimator: auto (codec MVs with frame-diff fallback) | mv | frame-diff
+    motion_backend: str = "auto"
     # calibrated for the frame-diff estimator (see stages/motion_filter.py)
     motion_global_threshold: float = 0.004
     motion_patch_threshold: float = 0.0  # see motion_filter.py: opt-in criterion
+    # calibrated for the codec-MV estimator (|mv|/height scale)
+    motion_mv_global_threshold: float = 0.001
+    motion_mv_patch_threshold: float = 0.0
     aesthetic_threshold: float | None = None
     text_filter: str = "disable"  # disable | score-only | enable
     text_filter_threshold: float = 0.5
@@ -148,6 +153,9 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
                 score_only=args.motion_filter == "score-only",
                 global_threshold=args.motion_global_threshold,
                 per_patch_threshold=args.motion_patch_threshold,
+                backend=args.motion_backend,
+                mv_global_threshold=args.motion_mv_global_threshold,
+                mv_patch_threshold=args.motion_mv_patch_threshold,
             )
         )
     stages.append(

@@ -3,11 +3,20 @@
 Equivalent capability of the reference's motion filtering
 (cosmos_curate/pipelines/video/filtering/motion/motion_filter_stages.py:40,
 motion_vector_backend.py — codec motion vectors → global-mean and
-per-patch-min scores). cv2 exposes no codec motion vectors, so the TPU-first
-replacement computes the same two statistics from low-fps frame differences
-**on device in one jit**: normalized mean |Δframe| globally, and the minimum
-over 8×8 spatial patches (catches clips where only a corner moves). Same
-semantics (score-only vs filter; two thresholds), different estimator.
+per-patch-min scores). Two estimators behind one stage:
+
+- ``mv`` — REAL codec motion vectors via the native libavcodec binding
+  (video/motion_vectors.py, the same ``export_mvs`` mechanism the
+  reference's backend rides): per-frame mean |mv|/height globally and the
+  per-patch time-mean minimum. Directly comparable semantics — including
+  the shared caveat that intra-coded moving content carries no vectors.
+- ``frame-diff`` — the TPU-first replacement: the same two statistics from
+  low-fps frame differences on device in one jit.
+
+``backend="auto"`` (default) scores with motion vectors when the native
+binding and the clip's codec deliver them, frame-diff otherwise. The two
+estimators have DIFFERENT score scales, so each carries its own calibrated
+thresholds.
 """
 
 from __future__ import annotations
@@ -70,16 +79,52 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         per_patch_threshold: float = 0.0,
         sample_fps: float = 4.0,
         decode_resize_hw: tuple[int, int] = (128, 128),
+        # mv | frame-diff | auto (mv with frame-diff fallback)
+        backend: str = "auto",
+        # MV-scale thresholds (mean |mv| per frame / frame height): static
+        # encodes score exactly 0 (skip blocks carry no vectors); a 1 px/
+        # frame pan at ANY resolution scores 1/height (~0.01 at 96 px).
+        # 0.001 = a tenth of that — an order of magnitude above zero while
+        # still keeping slow motion (benchmarks/motion_calibration.py --mv).
+        mv_global_threshold: float = 0.001,
+        mv_patch_threshold: float = 0.0,
     ) -> None:
+        if backend not in ("auto", "mv", "frame-diff"):
+            raise ValueError(f"unknown motion backend {backend!r}")
         self.score_only = score_only
         self.global_threshold = global_threshold
         self.per_patch_threshold = per_patch_threshold
         self.sample_fps = sample_fps
         self.decode_resize_hw = decode_resize_hw
+        self.backend = backend
+        self.mv_global_threshold = mv_global_threshold
+        self.mv_patch_threshold = mv_patch_threshold
 
     @property
     def resources(self) -> Resources:
         return Resources(cpus=1.0, tpus=0.5 if not self.score_only else 0.25)
+
+    def _score_mv(self, clip) -> tuple[float, float] | None:
+        """Codec-MV scores, or None when the binding/codec yields none."""
+        from cosmos_curate_tpu.video.motion_vectors import (
+            extract_mv_field,
+            mv_motion_scores,
+        )
+
+        mv = extract_mv_field(clip.encoded_data)
+        if mv is None:
+            return None
+        return mv_motion_scores(mv)
+
+    def _score_frame_diff(self, clip) -> tuple[float, float] | None:
+        frames = extract_frames_at_fps(
+            clip.encoded_data, target_fps=self.sample_fps, resize_hw=self.decode_resize_hw
+        )
+        if frames.shape[0] < 2:
+            return None
+        padded, n = pad_batch(frames)
+        g, p = _motion_scores(padded, n)
+        return float(g), float(p)
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:
@@ -89,25 +134,36 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
                 if clip.encoded_data is None:
                     kept.append(clip)
                     continue
+                thresholds = (self.mv_global_threshold, self.mv_patch_threshold)
                 try:
-                    frames = extract_frames_at_fps(
-                        clip.encoded_data, target_fps=self.sample_fps, resize_hw=self.decode_resize_hw
-                    )
-                    if frames.shape[0] < 2:
-                        kept.append(clip)
+                    scores = None
+                    if self.backend in ("auto", "mv"):
+                        try:
+                            scores = self._score_mv(clip)
+                        except Exception as e:
+                            # in auto mode ANY MV-path failure (not just "no
+                            # vectors") falls through to frame-diff
+                            if self.backend == "mv":
+                                raise
+                            logger.warning(
+                                "MV scoring failed for %s (%s); frame-diff", clip.uuid, e
+                            )
+                    if scores is None and self.backend != "mv":
+                        # thresholds must match the estimator that scored
+                        thresholds = (self.global_threshold, self.per_patch_threshold)
+                        scores = self._score_frame_diff(clip)
+                    if scores is None:
+                        kept.append(clip)  # nothing scoreable: keep
                         continue
-                    padded, n = pad_batch(frames)
-                    g, p = _motion_scores(padded, n)
-                    clip.motion_score_global = float(g)
-                    clip.motion_score_per_patch_min = float(p)
+                    clip.motion_score_global, clip.motion_score_per_patch_min = scores
                 except Exception as e:
                     logger.warning("motion scoring failed for %s: %s", clip.uuid, e)
                     clip.errors["motion"] = str(e)
                     kept.append(clip)
                     continue
                 if self.score_only or (
-                    clip.motion_score_global >= self.global_threshold
-                    and clip.motion_score_per_patch_min >= self.per_patch_threshold
+                    clip.motion_score_global >= thresholds[0]
+                    and clip.motion_score_per_patch_min >= thresholds[1]
                 ):
                     kept.append(clip)
                 else:

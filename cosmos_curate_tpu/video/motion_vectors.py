@@ -1,0 +1,101 @@
+"""Codec motion-vector motion scores.
+
+Equivalent capability of the reference's motion-vector backend
+(cosmos_curate/pipelines/video/filtering/motion/motion_vector_backend.py —
+decoder-exported motion vectors -> global-mean and per-patch-min scores):
+the native binding (native/mv_extract.c, libavcodec ``export_mvs``)
+aggregates each inter frame's vectors into a ``grid x grid`` field of mean
+|mv| in pixels; this module normalizes the field into the two filter
+scores. Works for whatever codec the clip carries (mpeg4 from the cv2
+fallback, h264 from the native encoder) — a decode without any MV side
+data (all-intra stream, missing ffmpeg) reports ``None`` so the filter can
+fall back to the frame-diff estimator.
+
+Score scale: per-frame mean |mv| in PIXELS divided by frame height —
+resolution-independent fraction of the frame the content moves per frame.
+A static encode's skip blocks carry no vectors, so static clips score
+exactly 0 (same property the frame-diff estimator's calibration notes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import tempfile
+from dataclasses import dataclass
+
+import numpy as np
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+MV_PATCH_GRID = 8
+_MAX_FRAMES = 2048
+
+
+@dataclass
+class MVField:
+    """Per-frame mean-|mv| grids (pixels) for one clip's inter frames."""
+
+    field: np.ndarray  # float32 [T, grid, grid]
+    has_mv: np.ndarray  # bool [T]
+    width: int
+    height: int
+
+
+def extract_mv_field(
+    video_bytes: bytes, *, grid: int = MV_PATCH_GRID, max_frames: int = _MAX_FRAMES
+) -> MVField | None:
+    """Decode ``video_bytes`` and return the per-frame MV field, or None
+    when the native binding is unavailable or the stream yields no frames."""
+    from cosmos_curate_tpu.native import load_mv
+
+    lib = load_mv()
+    if lib is None:
+        return None
+    field = np.zeros((max_frames, grid, grid), np.float32)
+    has = np.zeros(max_frames, np.uint8)
+    w = ctypes.c_int(0)
+    h = ctypes.c_int(0)
+    # libavformat wants a path; /dev/shm keeps the copy in RAM
+    fd, path = tempfile.mkstemp(suffix=".mp4", dir="/dev/shm")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(video_bytes)
+        n = lib.curate_mv_field(
+            path.encode(),
+            grid,
+            field.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            has.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+            max_frames,
+            ctypes.byref(w),
+            ctypes.byref(h),
+        )
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    if n <= 0 or w.value <= 0 or h.value <= 0:
+        return None
+    return MVField(
+        field=field[:n], has_mv=has[:n].astype(bool), width=w.value, height=h.value
+    )
+
+
+def mv_motion_scores(mv: MVField) -> tuple[float, float] | None:
+    """(global_score, per_patch_min) from the MV field, or None when the
+    clip has no inter frames to score (single-frame / all-intra stream).
+
+    global: mean over inter frames of the frame's mean cell |mv| / height.
+    per_patch_min: min over grid cells of that cell's time-mean |mv| /
+    height — a clip where one region never moves scores ~0 here even if
+    something else moves (the reference's patch-min semantics)."""
+    inter = mv.field[mv.has_mv]
+    if inter.shape[0] == 0:
+        return None
+    norm = float(mv.height)
+    global_score = float(inter.mean()) / norm
+    per_patch = inter.mean(axis=0) / norm  # [grid, grid] time-mean per cell
+    return global_score, float(per_patch.min())

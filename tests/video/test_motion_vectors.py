@@ -1,0 +1,137 @@
+"""Codec motion-vector extraction golden tests (VERDICT r4 #7): real MVs
+from the encoded fixtures, score semantics matching the reference's
+motion-vector backend, and the filter-stage integration with frame-diff
+fallback."""
+
+from __future__ import annotations
+
+import cv2
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.video.motion_vectors import (
+    MV_PATCH_GRID,
+    extract_mv_field,
+    mv_motion_scores,
+)
+
+H, W = 96, 128
+PAN_PX = 3  # pixels/frame
+
+
+def _encode(frames: list[np.ndarray], tmp_path, fps: float = 24.0) -> bytes:
+    path = str(tmp_path / "clip.mp4")
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps, (W, H))
+    for f in frames:
+        w.write(f)
+    w.release()
+    return (tmp_path / "clip.mp4").read_bytes()
+
+
+def _texture(seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 255, (H, W, 3), np.uint8)
+
+
+@pytest.fixture(scope="module")
+def native_mv():
+    from cosmos_curate_tpu.native import load_mv
+
+    if load_mv() is None:
+        pytest.skip("native MV binding unavailable")
+
+
+class TestMVScores:
+    def test_static_clip_scores_exactly_zero(self, native_mv, tmp_path):
+        base = _texture()
+        data = _encode([base] * 48, tmp_path)
+        mv = extract_mv_field(data)
+        assert mv is not None and mv.has_mv.sum() > 0
+        g, pm = mv_motion_scores(mv)
+        # codecs skip static blocks -> no vectors at all
+        assert g == 0.0 and pm == 0.0
+
+    def test_pan_global_score_matches_truth(self, native_mv, tmp_path):
+        base = _texture()
+        data = _encode([np.roll(base, i * PAN_PX, axis=1) for i in range(48)], tmp_path)
+        mv = extract_mv_field(data)
+        g, pm = mv_motion_scores(mv)
+        truth = PAN_PX / H  # mean |mv|/height for a whole-frame pan
+        assert truth * 0.6 < g < truth * 1.4, f"global {g} vs truth {truth}"
+        # the whole frame moves: every patch carries motion
+        assert pm > truth * 0.3
+
+    def test_partial_motion_hits_patch_min(self, native_mv, tmp_path):
+        # textured band pans inside a static frame: global motion is real
+        # but some patches never move -> patch-min ~0 (the reference's
+        # patch-min semantics: 'only part of the frame moves')
+        base = _texture()
+        band = _texture(7)[:24]
+        frames = []
+        for i in range(48):
+            img = base.copy()
+            img[36:60] = np.roll(band, i * PAN_PX, axis=1)
+            frames.append(img)
+        mv = extract_mv_field(_encode(frames, tmp_path))
+        g, pm = mv_motion_scores(mv)
+        assert g > 0.0
+        assert pm < g / 4, f"static patches must pull patch-min down: {pm} vs {g}"
+
+    def test_field_shape_and_intra_flags(self, native_mv, tmp_path):
+        data = _encode([_texture(i % 3) for i in range(24)], tmp_path)
+        mv = extract_mv_field(data)
+        assert mv.field.shape[1:] == (MV_PATCH_GRID, MV_PATCH_GRID)
+        assert mv.width == W and mv.height == H
+        # the first frame is intra: no MV side data
+        assert not mv.has_mv[0]
+
+
+class TestStageIntegration:
+    def _clip_task(self, data):
+        from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+
+        clip = Clip(encoded_data=data, span=(0.0, 2.0))
+        return SplitPipeTask(video=Video(path="v.mp4", clips=[clip])), clip
+
+    def test_mv_backend_filters_static_keeps_pan(self, native_mv, tmp_path):
+        from cosmos_curate_tpu.pipelines.video.stages.motion_filter import (
+            MotionFilterStage,
+        )
+
+        base = _texture()
+        static = _encode([base] * 32, tmp_path)
+        pan = _encode([np.roll(base, i * PAN_PX, axis=1) for i in range(32)], tmp_path)
+        stage = MotionFilterStage(backend="mv")
+        t_static, c_static = self._clip_task(static)
+        t_pan, c_pan = self._clip_task(pan)
+        stage.process_data([t_static, t_pan])
+        assert c_static.filtered_by == "motion"
+        assert t_static.video.filtered_clips == [c_static]
+        assert c_pan.filtered_by == ""
+        assert t_pan.video.clips == [c_pan]
+        assert c_pan.motion_score_global > stage.mv_global_threshold
+
+    def test_auto_falls_back_to_frame_diff(self, tmp_path, monkeypatch):
+        """Binding unavailable -> the frame-diff estimator scores with ITS
+        thresholds (scales differ between the estimators)."""
+        import cosmos_curate_tpu.video.motion_vectors as mv_mod
+        from cosmos_curate_tpu.pipelines.video.stages.motion_filter import (
+            MotionFilterStage,
+        )
+
+        monkeypatch.setattr(mv_mod, "extract_mv_field", lambda *a, **k: None)
+        base = _texture()
+        pan = _encode([np.roll(base, i * PAN_PX, axis=1) for i in range(32)], tmp_path)
+        stage = MotionFilterStage(backend="auto")
+        task, clip = self._clip_task(pan)
+        stage.process_data([task])
+        assert clip.filtered_by == ""
+        assert clip.motion_score_global > stage.global_threshold  # frame-diff scale
+
+    def test_mv_backend_keeps_unscoreable_clips(self, native_mv):
+        from cosmos_curate_tpu.pipelines.video.stages.motion_filter import (
+            MotionFilterStage,
+        )
+
+        task, clip = self._clip_task(b"not a video at all")
+        MotionFilterStage(backend="mv").process_data([task])
+        assert clip.filtered_by == ""  # never drop what we couldn't score
