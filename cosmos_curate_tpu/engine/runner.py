@@ -173,7 +173,10 @@ class StreamingRunner(RunnerInterface):
         # batch_id -> _Batch while on the fetch pool: these are in neither
         # `batches` nor any queue, so exception-exit cleanup must walk this
         localizing: dict[int, _Batch] = {}
-        self._final_fetches: list = []  # (stage_state, Future[(values, n_failed)])
+        # (stage_state, batch, Future[list-of-values]): final-stage batches
+        # whose remote outputs are streaming in; inputs stay held until the
+        # future lands (failure re-executes the batch)
+        self._final_fetches: list = []
         # Segments created by this run (and its workers) carry this pid.
         os.environ.setdefault("CURATE_STORE_OWNER", str(os.getpid()))
 
@@ -230,16 +233,9 @@ class StreamingRunner(RunnerInterface):
                         # inputs are local now: dispatch with priority
                         stx.retry_queue.appendleft(lb)
                     else:
-                        logger.warning(
-                            "localizing batch %d inputs failed: %s", lb.batch_id, err
+                        _retry_or_drop(
+                            stx, lb, store, f"localizing inputs failed: {err}"
                         )
-                        lb.worker_deaths += 1  # infra failure, same budget
-                        if lb.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
-                            stx.retry_queue.append(lb)
-                        else:
-                            stx.errored_batches += 1
-                            for r in lb.refs:
-                                store.release(r)
                 if pending_setup_errors:
                     raise RuntimeError(
                         "stage worker setup failed:\n" + "\n".join(pending_setup_errors)
@@ -324,26 +320,37 @@ class StreamingRunner(RunnerInterface):
                     pending = st.pool.num_workers() - ready
                     self.metrics.set_pool_state(st.spec.name, ready, pending, len(st.in_queue))
                 self.metrics.set_store_bytes(store.used)
+                # 5b. settle finished final-output fetches: success frees
+                # the batch's held inputs; failure re-executes the batch
+                # (its outputs died with their owner)
+                if self._final_fetches:
+                    pending = []
+                    for stx, fb, fut in self._final_fetches:
+                        if not fut.done():
+                            pending.append((stx, fb, fut))
+                            continue
+                        progressed = True
+                        try:
+                            outputs.extend(fut.result())
+                        except Exception as e:
+                            _retry_or_drop(
+                                stx, fb, store,
+                                f"final outputs lost with their owner: {e}",
+                            )
+                            continue
+                        for r in fb.refs:
+                            store.release(r)
+                    self._final_fetches = pending
                 if (
                     inputs_exhausted
                     and not batches
                     and not localizing
+                    and not self._final_fetches
                     and all(not st.in_queue and not st.retry_queue for st in states)
                 ):
                     break
                 if not progressed:
                     time.sleep(self.poll_interval_s)
-            # gather remote final outputs fetched off-loop
-            for stx, fut in self._final_fetches:
-                try:
-                    values, n_failed = fut.result(timeout=120)
-                except Exception:
-                    logger.exception("final output fetch failed")
-                    stx.errored_batches += 1
-                    continue
-                outputs.extend(values)
-                if n_failed:
-                    stx.errored_batches += 1  # once per batch, not per ref
             return outputs if cfg.return_last_stage_outputs else None
         finally:
             # quiesce the fetch pool FIRST: a still-running _localize_batch
@@ -365,6 +372,10 @@ class StreamingRunner(RunnerInterface):
             for batch in localizing.values():
                 for r in batch.refs:
                     store.release(r)
+            for _stx, fb, _fut in self._final_fetches:  # inputs held for fetch
+                for r in fb.refs:
+                    store.release(r)
+            self._final_fetches = []
             for st in states:
                 for r in st.in_queue:
                     store.release(r)
@@ -413,20 +424,24 @@ class StreamingRunner(RunnerInterface):
             done_q.put((batch, e))
 
     @staticmethod
-    def _fetch_final_values(refs, remote_mgr) -> tuple[list, int]:
+    def _fetch_final_values(refs, remote_mgr) -> list:
         """Fetch-pool job: materialize one batch's remote final outputs and
-        release them at their owner. Returns (values, n_failed)."""
+        release them at their owner. ALL-OR-NOTHING: any failure raises so
+        the loop re-executes the whole batch — returning a partial list
+        would duplicate the fetched outputs on the re-run."""
         values = []
-        failed = 0
+        err: Exception | None = None
         for r in refs:
             try:
-                values.append(remote_mgr.fetch_value_if_remote(r))
-            except Exception:
-                logger.exception("final output %s lost (owner gone?)", r)
-                failed += 1
+                if err is None:
+                    values.append(remote_mgr.fetch_value_if_remote(r))
+            except Exception as e:  # keep releasing the rest
+                err = e
             finally:
                 remote_mgr.release_data(r)
-        return values, failed
+        if err is not None:
+            raise err
+        return values
 
     def _free_ref(self, ref) -> None:
         """Location-aware delete for refs OUTSIDE the store ledger (final
@@ -487,8 +502,6 @@ class StreamingRunner(RunnerInterface):
         self.metrics.observe_result(
             st.spec.name, msg.process_time_s, msg.deserialize_time_s, len(msg.out_refs)
         )
-        for r in batch.refs:
-            store.release(r)
         nxt = batch.stage_idx + 1
         final_remote: list = []
         for r in msg.out_refs:
@@ -512,14 +525,22 @@ class StreamingRunner(RunnerInterface):
                 outputs.append(object_store.get(r))
             object_store.delete(r)
         if final_remote:
+            # the batch's INPUTS stay held until its remote outputs are
+            # safely fetched: if the owning agent dies first, the loop
+            # re-executes the batch instead of losing completed work
+            # (found by tests/engine/test_agent_churn.py: 299/300 outputs)
             self._final_fetches.append(
                 (
                     st,
+                    batch,
                     self._fetch_pool.submit(
                         self._fetch_final_values, final_remote, self._remote_mgr
                     ),
                 )
             )
+            return
+        for r in batch.refs:
+            store.release(r)
 
     _MAX_SETUP_DEATHS = 3
 
@@ -535,10 +556,14 @@ class StreamingRunner(RunnerInterface):
                     logger.warning("worker %s died (exit %s)", w.worker_id, exitcode)
                     st.pool.workers.pop(w.worker_id, None)
                     st.pool.note_worker_gone(w)
-                    if not w.ready:
-                        # died before ReadyMsg: likely a setup crash. A cap
-                        # prevents an infinite respawn loop when setup is
-                        # deterministically broken (e.g. OOM loading weights).
+                    agent = getattr(proc, "_agent", None)
+                    if not w.ready and (agent is None or agent.alive):
+                        # died before ReadyMsg with its NODE alive: likely a
+                        # setup crash. A cap prevents an infinite respawn
+                        # loop when setup is deterministically broken (e.g.
+                        # OOM loading weights). A whole-agent death is node
+                        # churn, not a setup bug — it must not burn the cap
+                        # (found by tests/engine/test_agent_churn.py).
                         st.pool.setup_deaths += 1
                         if st.pool.setup_deaths >= self._MAX_SETUP_DEATHS:
                             raise RuntimeError(
@@ -611,6 +636,27 @@ class StreamingRunner(RunnerInterface):
             return max(1, len([d for d in jax.devices() if d.platform == "tpu"]))
         except Exception:
             return 1
+
+
+def _retry_or_drop(stx, batch: _Batch, store, reason: str) -> None:
+    """Infra-failure disposition shared by the localize, final-fetch and
+    (semantically) reaper paths: budget the failure against the batch's
+    worker-death cap; requeue under budget, else drop LOUDLY and release."""
+    batch.worker_deaths += 1
+    if batch.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
+        logger.warning(
+            "batch %d: %s; re-running (%d/%d infra failures)",
+            batch.batch_id, reason, batch.worker_deaths, MAX_WORKER_DEATHS_PER_BATCH,
+        )
+        stx.retry_queue.append(batch)
+        return
+    logger.error(
+        "batch %d dropped after %d infra failures (%s): %d tasks lost",
+        batch.batch_id, batch.worker_deaths, reason, len(batch.refs),
+    )
+    stx.errored_batches += 1
+    for r in batch.refs:
+        store.release(r)
 
 
 def _host_memory_bytes() -> int:

@@ -98,7 +98,8 @@ def main() -> int:
         jax.random.PRNGKey(a.seed),
         jnp.zeros((1, a.window, INPUT_H, INPUT_W, 3), jnp.uint8),
     )
-    opt = optax.adamw(a.lr)
+    # clipping keeps the higher escape-the-constant-basin LR stable
+    opt = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(a.lr))
     opt_state = opt.init(params)
 
     @jax.jit

@@ -90,13 +90,17 @@ class TestRemotePlane:
             from cosmos_curate_tpu.engine.runner import StreamingRunner
 
             runner = StreamingRunner(poll_interval_s=0.01)
-            n_tasks = 40  # 40 x 0.25 s of work >> worker startup latency
+            n_tasks = 40
             tasks = [_NodeStampTask(i) for i in range(n_tasks)]
             spec = PipelineSpec(
                 input_data=tasks,
                 stages=[StageSpec(_StampStage(), num_workers=3)],
                 config=PipelineConfig(
-                    num_cpus=1.0,  # local budget 1 -> workers 2..3 go remote
+                    # ~no local capacity: with the agent connected (the
+                    # WAIT_NODES gate), every worker places remotely —
+                    # remote execution is a completion requirement, not a
+                    # race against worker cold-start on a loaded box
+                    num_cpus=0.1,
                     return_last_stage_outputs=True,
                 ),
             )

@@ -1,0 +1,95 @@
+"""Output-layout golden for the split pipeline (VERDICT r4 #8): the tree a
+run produces is pinned against the reference's documented artifact layout
+(docs/curator/reference/VIDEO_PIPELINES.md:56-91 — clips/{uuid}.mp4,
+metas/v0/{uuid}.json, previews/, processed_videos/ records, summary.json).
+A layout drift breaks downstream consumers silently, so it must fail a
+test, not a user."""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid as uuid_mod
+
+import pytest
+
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+from tests.fixtures.media import make_scene_video
+
+UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$"
+)
+
+
+@pytest.fixture(scope="module")
+def split_run(tmp_path_factory):
+    src = tmp_path_factory.mktemp("layout_src")
+    out = tmp_path_factory.mktemp("layout_out")
+    make_scene_video(src / "alpha.mp4", scene_len_frames=24, num_scenes=2)
+    make_scene_video(src / "beta.mp4", scene_len_frames=24, num_scenes=1)
+    summary = run_split(
+        SplitPipelineArgs(
+            input_path=str(src),
+            output_path=str(out),
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            motion_filter="score-only",
+            previews=True,
+        ),
+        runner=SequentialRunner(),
+    )
+    return out, summary
+
+
+class TestOutputLayout:
+    def test_clip_files_named_by_uuid(self, split_run):
+        out, summary = split_run
+        clips = sorted((out / "clips").glob("*.mp4"))
+        assert len(clips) == summary["num_clips"] > 0
+        for c in clips:
+            assert UUID_RE.match(c.stem), f"clip name {c.name} is not a uuid"
+            assert c.stat().st_size > 0
+
+    def test_meta_per_clip_under_metas_v0(self, split_run):
+        """metas/v0/{clip-uuid}.json with scores included when enabled
+        (VIDEO_PIPELINES.md:73-74)."""
+        out, _ = split_run
+        clip_ids = {c.stem for c in (out / "clips").glob("*.mp4")}
+        meta_ids = {m.stem for m in (out / "metas" / "v0").glob("*.json")}
+        assert meta_ids == clip_ids
+        meta = json.loads(next((out / "metas" / "v0").glob("*.json")).read_text())
+        # identity + span + enabled scores ride the per-clip meta
+        assert UUID_RE.match(meta["uuid"]) and str(uuid_mod.UUID(meta["uuid"]))
+        assert meta["span_end"] > meta["span_start"] >= 0
+        assert meta["motion_score_global"] is not None  # score-only ran
+        assert "source_video" in meta
+
+    def test_previews_per_clip(self, split_run):
+        out, _ = split_run
+        clip_ids = {c.stem for c in (out / "clips").glob("*.mp4")}
+        webp_ids = {p.stem for p in (out / "previews").glob("*.webp")}
+        assert webp_ids == clip_ids
+
+    def test_processed_videos_resume_records(self, split_run):
+        """processed_videos/{video-id}/chunk-*.json — one complete record
+        set per input video (the resume contract, VIDEO_PIPELINES.md:88)."""
+        out, summary = split_run
+        records = sorted((out / "processed_videos").glob("*/chunk-*.json"))
+        assert len(records) >= summary["num_videos"] == 2
+        rec = json.loads(records[0].read_text())
+        assert rec["num_chunks"] >= 1
+
+    def test_summary_json_at_root(self, split_run):
+        out, summary = split_run
+        on_disk = json.loads((out / "summary.json").read_text())
+        assert on_disk["num_clips"] == summary["num_clips"]
+        assert on_disk["num_videos"] == summary["num_videos"]
+
+    def test_no_stray_top_level_entries(self, split_run):
+        """The top level holds ONLY the documented directories/files — new
+        artifacts must be added to the layout doc + this golden, not
+        scattered."""
+        out, _ = split_run
+        expected = {"clips", "metas", "previews", "processed_videos", "summary.json"}
+        assert {p.name for p in out.iterdir()} <= expected
