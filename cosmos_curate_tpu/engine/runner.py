@@ -338,6 +338,7 @@ class StreamingRunner(RunnerInterface):
                                 f"final outputs lost with their owner: {e}",
                             )
                             continue
+                        stx.completed += 1  # settled: count the logical batch
                         for r in fb.refs:
                             store.release(r)
                     self._final_fetches = pending
@@ -494,7 +495,9 @@ class StreamingRunner(RunnerInterface):
                 for r in batch.refs:
                     store.release(r)
             return
-        st.completed += 1
+        # throughput samples count per EXECUTION (the autoscaler sizes pools
+        # from them); st.completed counts per logical batch, so it is
+        # deferred to fetch-settlement when remote final outputs are pending
         st.pool.record_sample(msg.process_time_s)
         self.stage_times[st.spec.name] = (
             self.stage_times.get(st.spec.name, 0.0) + msg.process_time_s
@@ -539,6 +542,7 @@ class StreamingRunner(RunnerInterface):
                 )
             )
             return
+        st.completed += 1
         for r in batch.refs:
             store.release(r)
 
@@ -573,18 +577,10 @@ class StreamingRunner(RunnerInterface):
                             )
                     if w.busy_batch is not None and w.busy_batch in batches:
                         batch = batches.pop(w.busy_batch)
-                        batch.worker_deaths += 1
-                        if batch.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
-                            st.retry_queue.append(batch)
-                        else:
-                            logger.error(
-                                "stage %s batch %d dropped: %d workers died "
-                                "processing it (poison batch?)",
-                                st.spec.name, batch.batch_id, batch.worker_deaths,
-                            )
-                            st.errored_batches += 1
-                            for r in batch.refs:
-                                store.release(r)
+                        _retry_or_drop(
+                            st, batch, store,
+                            f"worker {w.worker_id} died processing it (poison batch?)",
+                        )
                     st.pool.start_worker()
                     progressed = True
         return progressed

@@ -69,7 +69,9 @@ def _spawn_agent(port: int, node_id: str, cpus: float) -> subprocess.Popen:
             "--num-cpus", str(cpus),
         ],
         env=env,
-        stdout=subprocess.PIPE,
+        # DEVNULL, not PIPE: nobody drains the pipe, and a chatty agent
+        # blocking on a full pipe buffer would hang the run mid-batch
+        stdout=subprocess.DEVNULL,
         stderr=subprocess.STDOUT,
         text=True,
     )

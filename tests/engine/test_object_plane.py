@@ -85,7 +85,8 @@ def _spawn_agent(port: int, node_id: str, cpus: float) -> subprocess.Popen:
             "--num-cpus", str(cpus),
         ],
         env=env,
-        stdout=subprocess.PIPE,
+        # DEVNULL, not PIPE: nobody drains the pipe (see test_agent_churn)
+        stdout=subprocess.DEVNULL,
         stderr=subprocess.STDOUT,
         text=True,
     )
