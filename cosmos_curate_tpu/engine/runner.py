@@ -352,6 +352,22 @@ class StreamingRunner(RunnerInterface):
                     break
                 if not progressed:
                     time.sleep(self.poll_interval_s)
+            # per-stage disposition summary: completed counts LOGICAL
+            # batches (a re-executed batch settles once), so completed +
+            # errored accounts for every dispatched batch exactly once
+            self.stage_counts = {
+                st.spec.name: {
+                    "dispatched": st.dispatched,
+                    "completed": st.completed,
+                    "errored": st.errored_batches,
+                }
+                for st in states
+            }
+            for name, c in self.stage_counts.items():
+                logger.info(
+                    "stage %s: %d dispatched, %d completed, %d errored",
+                    name, c["dispatched"], c["completed"], c["errored"],
+                )
             return outputs if cfg.return_last_stage_outputs else None
         finally:
             # quiesce the fetch pool FIRST: a still-running _localize_batch

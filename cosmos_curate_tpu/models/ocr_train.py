@@ -65,8 +65,44 @@ def _background(rng: np.random.Generator, h: int, w: int) -> np.ndarray:
 
 def _rand_text(rng: np.random.Generator, max_len: int = 10) -> str:
     n = int(rng.integers(1, max_len + 1))
-    chars = CHARSET[1:]  # skip leading space for cleaner CTC targets
-    return "".join(chars[rng.integers(0, len(chars))] for _ in range(n))
+    chars = CHARSET[1:]  # no leading/trailing spaces (cleaner CTC targets)
+    s = "".join(chars[rng.integers(0, len(chars))] for _ in range(n))
+    # interior spaces in ~half the samples: real overlays are multi-word
+    # ("BREAKING NEWS"), and a recognizer that never saw the space class
+    # cannot emit it (observed: 'HELLO 42' read as 'HELLO42')
+    if n >= 4 and rng.random() < 0.5:
+        k = int(rng.integers(1, n - 1))
+        s = s[:k] + " " + s[k + 1 :]
+    return s
+
+
+def golden_eval_frames() -> tuple[np.ndarray, np.ndarray]:
+    """(clean, texty) frames — the SINGLE definition the weights-gated
+    detector golden (tests/models/test_ocr.py) and the CPU trainer's
+    publish gate (scripts/train_ocr_cpu.py) both evaluate against, so the
+    gate cannot drift from the test."""
+    import cv2
+
+    clean = np.full((8, 240, 320, 3), 90, np.uint8)
+    for f in clean:  # non-text structure: rectangles
+        cv2.rectangle(f, (40, 60), (200, 180), (200, 180, 40), -1)
+    texty = clean.copy()
+    for f in texty:
+        cv2.putText(f, "BREAKING NEWS UPDATE", (10, 40),
+                    cv2.FONT_HERSHEY_SIMPLEX, 0.8, (255, 255, 255), 2, cv2.LINE_AA)
+        cv2.putText(f, "subscribe now!", (60, 220),
+                    cv2.FONT_HERSHEY_DUPLEX, 0.7, (0, 255, 255), 2, cv2.LINE_AA)
+    return clean, texty
+
+
+def golden_rec_sample(text: str = "HELLO 42") -> np.ndarray:
+    """Rendered recognizer sample shared by the golden test and the
+    trainer's publish gate."""
+    import cv2
+
+    img = np.full((32, 160, 3), 255, np.uint8)
+    cv2.putText(img, text, (6, 24), cv2.FONT_HERSHEY_SIMPLEX, 0.8, (0, 0, 0), 2)
+    return img
 
 
 def synthesize_detector_batch(

@@ -38,6 +38,10 @@ class TransNetConfig:
     # ~4x faster than (16, 32, 64). Checkpoints produced by
     # models/transnet_train.py use these defaults; a checkpoint staged with
     # other shapes falls back to random init with a warning (registry).
+    # ARCH REVISION (round 5): LayerNorm between block pairs — any
+    # checkpoint trained before it has a different tree and is rejected by
+    # the registry's shape validation (clear stale $CURATE_MODEL_WEIGHTS_DIR
+    # staging dirs; no pre-revision checkpoint was ever committed).
     filters: tuple[int, ...] = (8, 16, 32)
     dilations: tuple[int, ...] = (1, 2, 4, 8)
     head_dim: int = 128
@@ -81,6 +85,10 @@ class TransNet(nn.Module):
         for i, f in enumerate(self.cfg.filters):
             x = DDCNNBlock(f, self.cfg.dilations, dtype=self.dtype, name=f"dd{i}a")(x)
             x = DDCNNBlock(f, self.cfg.dilations, dtype=self.dtype, name=f"dd{i}b")(x)
+            # normalization between block pairs: without it the 6-conv
+            # stack optimizes glacially at small batch (the published
+            # TransNetV2 uses batch norm; layer norm is batch-size-free)
+            x = nn.LayerNorm(dtype=jnp.float32, name=f"ln{i}")(x)
             x = nn.avg_pool(x, (1, 2, 2), strides=(1, 2, 2))
         # per-frame spatial pooling -> [B, T, C]
         x = x.mean(axis=(2, 3))

@@ -27,29 +27,20 @@ STAGING = "/tmp/ocr_staging"
 
 
 def _eval_frames():
-    import cv2
+    # SHARED with the weights-gated golden test (single definition): see
+    # models/ocr_train.golden_eval_frames
+    from cosmos_curate_tpu.models.ocr_train import golden_eval_frames
 
-    clean = np.full((8, 240, 320, 3), 90, np.uint8)
-    for f in clean:
-        cv2.rectangle(f, (40, 60), (200, 180), (200, 180, 40), -1)
-    texty = clean.copy()
-    for f in texty:
-        cv2.putText(f, "BREAKING NEWS UPDATE", (10, 40),
-                    cv2.FONT_HERSHEY_SIMPLEX, 0.8, (255, 255, 255), 2, cv2.LINE_AA)
-        cv2.putText(f, "subscribe now!", (60, 220),
-                    cv2.FONT_HERSHEY_DUPLEX, 0.7, (0, 255, 255), 2, cv2.LINE_AA)
-    return clean, texty
+    return golden_eval_frames()
 
 
 def _rec_samples():
-    import cv2
+    from cosmos_curate_tpu.models.ocr_train import golden_rec_sample
 
-    out = []
-    for text in ("HELLO 42", "NEWS 7", "SALE NOW"):
-        img = np.full((32, 160, 3), 255, np.uint8)
-        cv2.putText(img, text, (6, 24), cv2.FONT_HERSHEY_SIMPLEX, 0.8, (0, 0, 0), 2)
-        out.append((img, text))
-    return out
+    return [
+        (golden_rec_sample(text), text)
+        for text in ("HELLO 42", "NEWS 7", "SALE NOW")
+    ]
 
 
 def _fresh_model():

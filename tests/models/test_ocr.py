@@ -69,21 +69,14 @@ needs_weights = pytest.mark.skipif(
 @needs_weights
 def test_trained_detector_separates_text_from_clean():
     """Functional golden test (runs once weights/ocr-*-tpu ship): rendered
-    overlay text must score well above a clean frame."""
-    import cv2
+    overlay text must score well above a clean frame. Fixtures are SHARED
+    with the CPU trainer's publish gate (scripts/train_ocr_cpu.py) so the
+    gate cannot drift from this test."""
+    from cosmos_curate_tpu.models.ocr_train import golden_eval_frames
 
     m = OcrModel()
     m.setup()
-    rng = np.random.default_rng(1)
-    clean = np.full((8, 240, 320, 3), 90, np.uint8)
-    for f in clean:  # non-text structure: rectangles
-        cv2.rectangle(f, (40, 60), (200, 180), (200, 180, 40), -1)
-    texty = clean.copy()
-    for f in texty:
-        cv2.putText(f, "BREAKING NEWS UPDATE", (10, 40),
-                    cv2.FONT_HERSHEY_SIMPLEX, 0.8, (255, 255, 255), 2, cv2.LINE_AA)
-        cv2.putText(f, "subscribe now!", (60, 220),
-                    cv2.FONT_HERSHEY_DUPLEX, 0.7, (0, 255, 255), 2, cv2.LINE_AA)
+    clean, texty = golden_eval_frames()
     cov_text = m.text_coverage(texty)
     cov_clean = m.text_coverage(clean)
     assert cov_text > 2 * max(cov_clean, 1e-4), (cov_text, cov_clean)
@@ -92,14 +85,13 @@ def test_trained_detector_separates_text_from_clean():
 
 @needs_weights
 def test_trained_recognizer_reads_rendered_text():
-    """CRNN must read most characters of clean Hershey-rendered text."""
-    import cv2
+    """CRNN must read most characters of clean Hershey-rendered text
+    (sample shared with the trainer's publish gate)."""
+    from cosmos_curate_tpu.models.ocr_train import golden_rec_sample
 
     m = OcrModel()
     m.setup()
-    img = np.full((32, 160, 3), 255, np.uint8)
-    cv2.putText(img, "HELLO 42", (6, 24), cv2.FONT_HERSHEY_SIMPLEX, 0.8, (0, 0, 0), 2)
-    (text,) = m.recognize(img[None])
+    (text,) = m.recognize(golden_rec_sample("HELLO 42")[None])
     # tolerance: a synthetic-trained CRNN won't be perfect; demand clear signal
     matches = sum(a == b for a, b in zip(text, "HELLO 42"))
     assert matches >= 5, f"read {text!r}"
