@@ -32,6 +32,20 @@ def register(sub: argparse._SubParsersAction) -> None:
     init.add_argument("--seed", type=int, default=0)
     init.set_defaults(func=_cmd_init_random)
 
+    pull = msub.add_parser(
+        "pull-hf",
+        help="download files from a Hugging Face repo (SDK-free, resumable) "
+        "for conversion by the converters",
+    )
+    pull.add_argument("repo_id", help="e.g. Qwen/Qwen2-VL-2B-Instruct")
+    pull.add_argument("files", nargs="+", help="repo-relative file names")
+    pull.add_argument("--revision", default="main")
+    pull.add_argument(
+        "--dest", default="", help="destination dir (default: hf/<repo_id> under the weights root)"
+    )
+    pull.add_argument("--sha256", default="", help="expected sha256 (single file only)")
+    pull.set_defaults(func=_cmd_pull_hf)
+
     models.set_defaults(func=lambda args: (models.print_help(), 2)[1])
 
 
@@ -60,6 +74,29 @@ def _cmd_stage(args: argparse.Namespace) -> int:
     dst.parent.mkdir(parents=True, exist_ok=True)
     shutil.copyfile(src, dst)
     print(f"staged {src} -> {dst}")
+    return 0
+
+
+def _cmd_pull_hf(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.models import registry
+    from cosmos_curate_tpu.models.hf_hub import HubDownloadError, pull_repo_files
+
+    dest_dir = Path(args.dest) if args.dest else registry.weights_root() / "hf" / args.repo_id
+    if args.sha256 and len(args.files) != 1:
+        print("error: --sha256 applies to a single file")
+        return 2
+    try:
+        for dest in pull_repo_files(
+            args.repo_id,
+            args.files,
+            dest_dir,
+            revision=args.revision,
+            expected_sha256={args.files[0]: args.sha256} if args.sha256 else None,
+        ):
+            print(f"pulled {dest}")
+    except HubDownloadError as e:
+        print(f"error: {e}")
+        return 1
     return 0
 
 
