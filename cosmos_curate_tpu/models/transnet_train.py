@@ -98,12 +98,26 @@ def train(
     *,
     steps: int = 600,
     batch: int = 8,
-    window: int = 48,
+    window: int | None = None,
     lr: float = 1e-3,
     pos_weight: float = 8.0,
     seed: int = 0,
     log_every: int = 100,
 ):
+    from cosmos_curate_tpu.models.transnetv2 import WINDOW
+
+    if window is None:
+        window = WINDOW
+    elif window != WINDOW:
+        # the dilated convs' SAME-padding stamps an edge signature on every
+        # in-window position: a model trained at one window length emits
+        # positional, content-free predictions under another (observed with
+        # 16-frame training at 100-frame inference) — staging such a
+        # checkpoint would ship a silently broken shot detector
+        raise ValueError(
+            f"train window {window} != inference WINDOW {WINDOW} "
+            "(transnetv2.py); train at the inference window"
+        )
     """Train on synthetic cuts; returns (params, final_loss)."""
     import jax
     import jax.numpy as jnp
@@ -161,7 +175,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description="Train TransNet on synthetic scene cuts")
     ap.add_argument("--steps", type=int, default=600)
     ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--window", type=int, default=48)
+    ap.add_argument("--window", type=int, default=None, help="default: the inference WINDOW")
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out-dir", default=None, help="e.g. <repo>/weights to commit the result")

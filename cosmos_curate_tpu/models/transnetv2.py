@@ -2,7 +2,8 @@
 
 Equivalent capability of the reference's TransNetV2
 (cosmos_curate/models/transnetv2.py:39-580, a torch DDCNN): per-frame shot
-transition probabilities over ~100-frame sliding windows on 48x27 inputs.
+transition probabilities over overlap-averaged sliding windows (``WINDOW``
+frames — 32 here; the reference uses 100) on 48x27 inputs.
 This is our own Flax implementation of the DDCNN idea (Soucek & Lokoc,
 TransNet V2, public architecture): blocks of parallel 3D convs with
 exponential temporal dilations, spatial pooling between stages, per-frame
@@ -28,8 +29,16 @@ from cosmos_curate_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 INPUT_H, INPUT_W = 27, 48
-WINDOW = 100
-STRIDE = 50  # middle-half evaluation like the published model
+# Inference windows MUST match the training window (transnet_train.train
+# enforces it):
+# the dilated temporal convs' SAME-padding gives every in-window position
+# an edge signature, so a model trained at one window length does not
+# transfer to another (observed: window-16 training produced positional,
+# content-free outputs under 100-frame windows). 32 keeps CPU training
+# affordable while overlap-averaging (stride = half) smooths edges exactly
+# as in training-time geometry.
+WINDOW = 32
+STRIDE = 16  # overlap-averaged halves, like the published model
 
 
 @dataclass(frozen=True)

@@ -5,22 +5,27 @@ The reference ships pretrained TransNetV2 weights
 (cosmos_curate/models/transnetv2.py:530); this image has no egress, so the
 committed checkpoint comes from the synthetic-cut trainer
 (models/transnet_train.py). A single CPU core makes full training
-expensive (~25 s/step at batch 2, window 24), so this script adds
-EVAL-BASED EARLY STOPPING: every ``--eval-every`` steps it scores the
-golden-test criteria (tests/models/test_transnet_golden.py — cut peak
-within ±2 frames, prob > threshold, separation over scene interiors, no
-false cuts in continuous clips) on a fixed held-out eval set, and stops as
-soon as every criterion passes with margin. Progress checkpoints land in
-``--out-dir`` each eval so a killed run still leaves the best-so-far.
+expensive (tens of seconds per step at the default batch 4 x the
+inference WINDOW — training at any other window is REJECTED, see
+transnet_train.train), so this script adds EVAL-BASED EARLY STOPPING:
+every ``--eval-every`` steps it scores the golden-test criteria
+(tests/models/test_transnet_golden.py — cut peak within ±2 frames, prob >
+threshold, separation over scene interiors, no false cuts in continuous
+clips) through the PRODUCTION windowed-inference path on a fixed held-out
+eval set, and stops as soon as every criterion passes with margin.
+Progress checkpoints land in a per-run /tmp staging dir (crash-resume);
+``--out-dir`` (the committed ``weights/`` tree) is written ONLY on a full
+eval pass — the goldens un-skip the moment the file exists.
 
 Run (low priority, background):
-    PYTHONPATH= JAX_PLATFORMS=cpu nice -n 19 python scripts/train_transnet_cpu.py \
-        --out-dir weights
+    PYTHONPATH=/root/repo JAX_PLATFORMS=cpu nice -n 19 \
+        python scripts/train_transnet_cpu.py --out-dir weights
 """
 
 from __future__ import annotations
 
 import argparse
+import tempfile
 import time
 
 import numpy as np
@@ -63,8 +68,9 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out-dir", default="weights")
     ap.add_argument("--max-steps", type=int, default=1200)
-    ap.add_argument("--batch", type=int, default=2)
-    ap.add_argument("--window", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=4)
+    # must equal transnetv2.WINDOW (enforced below)
+    ap.add_argument("--window", type=int, default=32)
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--eval-every", type=int, default=25)
@@ -78,6 +84,16 @@ def main() -> int:
     ap.add_argument("--false-cut", type=float, default=0.35)
     a = ap.parse_args()
 
+    import os
+
+    # evals run TransNetV2TPU through the registry against a PER-RUN
+    # staging dir (the production loading + windowed-inference path the
+    # golden tests use; unique per run so concurrent sweeps cannot score
+    # each other's checkpoints)
+    staging = tempfile.mkdtemp(prefix="transnet-staging-")
+    os.environ["CURATE_MODEL_WEIGHTS_DIR"] = staging
+    print(f"staging dir: {staging}", flush=True)
+
     import jax
     import jax.numpy as jnp
     import optax
@@ -87,9 +103,18 @@ def main() -> int:
     from cosmos_curate_tpu.models.transnetv2 import (
         INPUT_H,
         INPUT_W,
+        WINDOW,
         TransNet,
         TransNetConfig,
     )
+
+    if a.window != WINDOW:
+        raise SystemExit(
+            f"--window {a.window} != inference WINDOW {WINDOW} "
+            "(transnetv2.py): the dilated convs' edge signatures make "
+            "train/inference window mismatch produce positional, "
+            "content-free predictions — train at the inference window"
+        )
 
     cfg = TransNetConfig()
     model = TransNet(cfg)
@@ -113,18 +138,27 @@ def main() -> int:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    @jax.jit
-    def predict(params, frames):
-        return jax.nn.sigmoid(model.apply(params, frames[None]))[0]
-
     two_scene = [_two_scene_eval_clip(100 + i) for i in range(4)]
     continuous = [_continuous_eval_clip(200 + i) for i in range(2)]
 
-    def evaluate(params) -> tuple[bool, str]:
+    # ONE inference wrapper for all evals (its jitted apply compiles once);
+    # each eval swaps the live params in and ALSO stages them for
+    # crash-resume. The final publish re-verifies through a fresh
+    # registry-loaded model, so the production load path is still proven.
+    from cosmos_curate_tpu.models.transnetv2 import TransNetV2TPU
+
+    eval_model = TransNetV2TPU()
+    eval_model.setup()  # random init now; params swapped per eval
+
+    def evaluate(params, m=None) -> tuple[bool, str]:
+        registry.save_params("transnetv2-tpu", params, root=staging)
+        if m is None:
+            m = eval_model
+            m._params = params
         oks = []
         peaks = []
         for frames, cut in two_scene:
-            probs = np.asarray(predict(params, jnp.asarray(frames)))
+            probs = m.predict_transitions(frames)
             peak = int(np.argmax(probs))
             interior = np.concatenate([probs[5 : cut - 5], probs[cut + 5 : -5]])
             ok = (
@@ -136,7 +170,7 @@ def main() -> int:
             peaks.append(float(probs[peak]))
         false_max = 0.0
         for frames in continuous:
-            probs = np.asarray(predict(params, jnp.asarray(frames)))
+            probs = m.predict_transitions(frames)
             false_max = max(false_max, float(probs[4:-4].max()))
         oks.append(false_max < a.false_cut)
         msg = (
@@ -154,12 +188,11 @@ def main() -> int:
             params, opt_state, jnp.asarray(frames), jnp.asarray(labels)
         )
         if i % a.eval_every == 0:
+            # evaluate() stages into /tmp/transnet_staging itself; weights/
+            # is only published on a full eval pass — a committed tree must
+            # never hold a half-trained checkpoint (the golden tests
+            # un-skip the moment weights/transnetv2-tpu exists)
             passed, msg = evaluate(params)
-            # progress checkpoints go to a STAGING dir; weights/ is only
-            # published on a full eval pass — a committed tree must never
-            # hold a half-trained checkpoint (the golden tests un-skip the
-            # moment weights/transnetv2-tpu exists)
-            registry.save_params("transnetv2-tpu", params, root="/tmp/transnet_staging")
             print(
                 f"step {i}/{a.max_steps} loss {float(loss):.4f} "
                 f"[{(time.time() - t0) / 60:.1f} min] {msg}"
@@ -167,10 +200,18 @@ def main() -> int:
                 flush=True,
             )
             if passed:
+                # re-verify through a FRESH registry-loaded model (the
+                # exact production path) before touching the committed tree
+                fresh = TransNetV2TPU()
+                fresh.setup()
+                passed2, msg2 = evaluate(params, m=fresh)
+                if not passed2:
+                    print(f"registry-loaded re-check FAILED ({msg2}); continuing")
+                    continue
                 ckpt = registry.save_params("transnetv2-tpu", params, root=a.out_dir)
-                print(f"staged {ckpt}")
+                print(f"published {ckpt}")
                 return 0
-    print("max steps reached without a full eval pass; last kept in staging only")
+    print(f"max steps reached without a full eval pass; last kept in {staging} only")
     return 1
 
 
