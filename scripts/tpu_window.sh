@@ -36,8 +36,8 @@ chip = rec.get("backend") in (None, "tpu") and "caption_backend" not in rec
 sys.exit(0 if chip else 1)
 ' 2>/dev/null; then
     tail -1 /tmp/bench_tpu_$1.out > BENCH_TPU.json
-    cp BENCH_TPU.json BENCH_r04.json
-    git add BENCH_TPU.json BENCH_r04.json \
+    cp BENCH_TPU.json BENCH_r05.json
+    git add BENCH_TPU.json BENCH_r05.json \
       && git -c user.name=distsys-graft -c user.email=graft@local \
         commit -m "Chip-backed bench result ($1)" --no-verify || true
     return 0
@@ -82,7 +82,13 @@ for i in $(seq 1 700); do
   fi
   log "TPU alive at attempt $i"
   # Smallest first; each trainer commits its own weights on success.
-  train_one transnetv2-tpu cosmos_curate_tpu.models.transnet_train 3000 --steps 600 || { sleep 60; continue; }
+  # TransNet goes through the EVAL-GATED script (publishes into weights/
+  # only when the golden-margin criteria pass — a raw train_and_stage run
+  # would commit an unverified checkpoint and un-skip the goldens red).
+  if [ ! -f weights/transnetv2-tpu/params.msgpack ]; then
+    timeout 3000 python scripts/train_transnet_cpu.py --out-dir weights \
+      && commit_weights transnetv2-tpu || { sleep 60; continue; }
+  fi
   # First chip bench as soon as the canonical transnet config can activate.
   if [ $benched = 0 ] && run_bench after-transnet; then benched=1; fi
   train_one ocr-detector-tpu cosmos_curate_tpu.models.ocr_train 3600 || { sleep 60; continue; }
