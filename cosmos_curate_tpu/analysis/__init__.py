@@ -1,0 +1,32 @@
+"""Static analysis for cosmos-curate-tpu: build-time correctness tooling.
+
+Two complementary passes (both surfaced through ``cosmos-curate-tpu lint``
+and ``scripts/run_static_checks.sh``):
+
+- ``graph_lint``: semantic validation of a ``PipelineSpec`` before any
+  worker spawns — stage-to-stage task-type flow, duplicate stage names,
+  STREAMING-mode resource feasibility, contradictory resource requests.
+  Wired into ``run_pipeline`` as an on-by-default pre-flight.
+- ``ast_lint``: a rule-driven AST checker over the package source encoding
+  this repo's real hazard classes (lock discipline in the engine, stdlib
+  calls newer than the interpreter floor, host transfers under ``jax.jit``,
+  silent exception swallowing in worker loops). Rules live in
+  ``analysis/rules/`` and are configured via ``[tool.curate-lint]`` in
+  ``pyproject.toml``.
+"""
+
+from cosmos_curate_tpu.analysis.common import Finding, LintConfig, Severity
+from cosmos_curate_tpu.analysis.graph_lint import (
+    PipelineValidationError,
+    lint_pipeline_spec,
+    validate_pipeline_spec,
+)
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "Severity",
+    "PipelineValidationError",
+    "lint_pipeline_spec",
+    "validate_pipeline_spec",
+]

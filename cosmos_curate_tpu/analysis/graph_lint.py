@@ -1,0 +1,319 @@
+"""Pipeline-graph linter: semantic validation of a ``PipelineSpec``.
+
+A mis-wired pipeline — mismatched stage task types, an over-subscribed
+STREAMING TPU budget, a duplicate stage name — should be rejected before a
+single worker spawns, not hours into a petabyte-scale run. ``run_pipeline``
+calls :func:`validate_pipeline_spec` as an on-by-default pre-flight
+(``skip_validation=True`` is the escape hatch); ``cosmos-curate-tpu lint``
+exposes the same checks for ad-hoc use.
+
+Checks:
+
+- **type-flow**: via ``typing.get_type_hints`` on each stage's
+  ``process_data`` — every task type stage *k* emits must be accepted by
+  stage *k+1* (and the input tasks by stage 0). Untyped stages (e.g. the
+  observability wrappers' dynamic subclasses) are skipped, not failed.
+- **duplicate-stage**: two stages sharing a name would collide in metrics,
+  artifacts and the autoscaler's per-stage state.
+- **infeasible-streaming**: STREAMING keeps every pool live at once, so the
+  summed minimum TPU demand must fit the declared cluster shape
+  (``PipelineConfig.num_tpu_chips``); see ``ExecutionMode`` docs in
+  core/pipeline.py. Checked only when the shape is declared — discovery
+  happens at run time otherwise.
+- **nonsense-spec**: contradictory resource requests (``tpus > 0`` with
+  ``entire_tpu_host``, TPU stages with ``num_workers_per_node`` packing)
+  and out-of-range scheduling knobs.
+"""
+
+from __future__ import annotations
+
+import math
+import types
+import typing
+from typing import TYPE_CHECKING, Any
+
+from cosmos_curate_tpu.analysis.common import Finding, Severity
+from cosmos_curate_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:
+    from cosmos_curate_tpu.core.pipeline import PipelineSpec
+    from cosmos_curate_tpu.core.stage import StageSpec
+
+logger = get_logger(__name__)
+
+_SPEC_FILE = "<pipeline-spec>"
+
+
+class PipelineValidationError(ValueError):
+    """Raised by the ``run_pipeline`` pre-flight; carries all findings so a
+    mis-wired spec surfaces every problem at once, not one per run."""
+
+    def __init__(self, findings: list[Finding]) -> None:
+        self.findings = findings
+        lines = "\n".join(f"  - {f.render()}" for f in findings)
+        super().__init__(
+            f"pipeline spec failed pre-flight validation "
+            f"({len(findings)} error(s); pass skip_validation=True to bypass):\n{lines}"
+        )
+
+
+# -- type-flow --------------------------------------------------------------
+
+
+def _element_types(hint: Any) -> tuple[type, ...] | None:
+    """``list[X]`` / ``list[X] | None`` / ``Optional[list[X | Y]]`` -> the
+    element classes, or None when nothing checkable can be extracted
+    (missing hint, TypeVar, Any, unparameterized list)."""
+    if hint is None:
+        return None
+    origin = typing.get_origin(hint)
+    if origin is typing.Union or origin is types.UnionType:
+        for arm in typing.get_args(hint):
+            if arm is type(None):
+                continue
+            got = _element_types(arm)
+            if got is not None:
+                return got
+        return None
+    if origin not in (list, typing.List):
+        return None
+    args = typing.get_args(hint)
+    if not args:
+        return None
+    elems: list[type] = []
+    for a in args:
+        a_origin = typing.get_origin(a)
+        if a_origin is typing.Union or a_origin is types.UnionType:
+            members = [m for m in typing.get_args(a) if m is not type(None)]
+        else:
+            members = [a]
+        for m in members:
+            if not isinstance(m, type):  # TypeVar, Any, forward ref left over
+                return None
+            elems.append(m)
+    return tuple(elems) or None
+
+
+def _process_data_hints(stage: Any) -> tuple[tuple[type, ...] | None, tuple[type, ...] | None]:
+    """-> (accepted element types, emitted element types) for a stage's
+    ``process_data``, each None when unannotated/unresolvable."""
+    fn = getattr(type(stage), "process_data", None)
+    if fn is None:
+        return None, None
+    try:
+        hints = typing.get_type_hints(fn)
+    except Exception:  # unresolvable forward refs in user code: skip, don't fail
+        return None, None
+    params = [k for k in hints if k != "return"]
+    accepts = _element_types(hints[params[0]]) if params else None
+    emits = _element_types(hints.get("return"))
+    return accepts, emits
+
+
+def _compatible(emitted: tuple[type, ...], accepted: tuple[type, ...]) -> bool:
+    return all(any(issubclass(e, a) for a in accepted) for e in emitted)
+
+
+def _names(types_: tuple[type, ...]) -> str:
+    return " | ".join(t.__name__ for t in types_)
+
+
+def _check_type_flow(spec: "PipelineSpec", findings: list[Finding]) -> None:
+    stages = spec.stages
+    flows: list[tuple[str, tuple[type, ...] | None, tuple[type, ...] | None]] = [
+        (s.name, *_process_data_hints(s.stage)) for s in stages
+    ]
+    # input tasks -> first stage
+    if stages and spec.input_data:
+        accepts = flows[0][1]
+        if accepts is not None:
+            bad = {type(t) for t in spec.input_data if not isinstance(t, accepts)}
+            for t in sorted(bad, key=lambda c: c.__name__):
+                findings.append(
+                    Finding(
+                        _SPEC_FILE, 0, "type-flow",
+                        f"input tasks of type {t.__name__} are not accepted by first "
+                        f"stage '{flows[0][0]}' (accepts {_names(accepts)})",
+                    )
+                )
+    # stage k -> stage k+1
+    for (up_name, _, emits), (down_name, accepts, _) in zip(flows, flows[1:]):
+        if emits is None or accepts is None:
+            continue  # untyped end: nothing checkable
+        if not _compatible(emits, accepts):
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "type-flow",
+                    f"stage '{up_name}' emits {_names(emits)} but the next stage "
+                    f"'{down_name}' accepts {_names(accepts)}",
+                )
+            )
+
+
+# -- names ------------------------------------------------------------------
+
+
+def _check_duplicate_names(spec: "PipelineSpec", findings: list[Finding]) -> None:
+    seen: dict[str, int] = {}
+    for idx, s in enumerate(spec.stages):
+        if s.name in seen:
+            # the engine runs duplicate-named stages (pools key on index),
+            # but their metrics/artifacts/timings merge under one name —
+            # surface it without rejecting a functional spec
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "duplicate-stage",
+                    f"stage name '{s.name}' used by both stage {seen[s.name]} and "
+                    f"stage {idx}; their metrics, artifacts and autoscaler state "
+                    "will merge under one name",
+                    severity=Severity.WARNING,
+                )
+            )
+        else:
+            seen[s.name] = idx
+
+
+# -- resources --------------------------------------------------------------
+
+
+def _min_workers(s: "StageSpec") -> int:
+    if s.num_workers is not None:
+        return max(1, s.num_workers)
+    return max(1, s.min_workers)
+
+
+def _min_chip_demand(s: "StageSpec", host_chips: int) -> float:
+    res = s.stage.resources
+    if res.entire_tpu_host:
+        return float(host_chips) * _min_workers(s)
+    if res.tpus > 0:
+        return res.tpus * _min_workers(s)
+    return 0.0
+
+
+def _check_resources(spec: "PipelineSpec", findings: list[Finding]) -> None:
+    from cosmos_curate_tpu.core.pipeline import ExecutionMode
+
+    cfg = spec.config
+    for s in spec.stages:
+        res = s.stage.resources
+        if res.tpus > 0 and res.entire_tpu_host:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' requests both tpus={res.tpus} and "
+                    "entire_tpu_host=True; an entire-host claim already owns every "
+                    "local chip — drop one of the two",
+                )
+            )
+        if res.uses_tpu and s.num_workers_per_node is not None:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' is a TPU stage but sets "
+                    f"num_workers_per_node={s.num_workers_per_node}; per-node packing "
+                    "only applies to CPU stages (chips bind to one worker per host)",
+                )
+            )
+        if s.min_workers < 0:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' has min_workers={s.min_workers} < 0",
+                )
+            )
+        if s.max_workers is not None and s.max_workers < max(1, s.min_workers):
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' has max_workers={s.max_workers} below "
+                    f"min_workers={s.min_workers}",
+                )
+            )
+        if s.num_run_attempts < 1:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' has num_run_attempts={s.num_run_attempts}; "
+                    "at least one attempt is required",
+                )
+            )
+        if not 0.0 <= s.stage_save_sample_rate <= 1.0:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "nonsense-spec",
+                    f"stage '{s.name}' has stage_save_sample_rate="
+                    f"{s.stage_save_sample_rate} outside [0, 1]",
+                )
+            )
+
+    # Feasibility against a *declared* cluster shape only; an undeclared
+    # shape is discovered at run time (engine runner._discover_tpus).
+    chips = cfg.num_tpu_chips
+    if chips is not None:
+        demands = [(s, _min_chip_demand(s, chips)) for s in spec.stages]
+        for s, d in demands:
+            if d > chips:
+                findings.append(
+                    Finding(
+                        _SPEC_FILE, 0, "infeasible-streaming",
+                        f"stage '{s.name}' alone needs {_fmt(d)} TPU chip(s) at its "
+                        f"minimum worker count but the declared cluster has {chips}",
+                    )
+                )
+        if cfg.execution_mode is ExecutionMode.STREAMING:
+            total = sum(d for _, d in demands)
+            if total > chips and not any(d > chips for _, d in demands):
+                tpu_stages = ", ".join(
+                    f"'{s.name}'={_fmt(d)}" for s, d in demands if d > 0
+                )
+                findings.append(
+                    Finding(
+                        _SPEC_FILE, 0, "infeasible-streaming",
+                        f"STREAMING keeps every pool live simultaneously but the "
+                        f"summed minimum TPU demand {_fmt(total)} exceeds the declared "
+                        f"{chips} chip(s) ({tpu_stages}); use BATCH mode, shrink "
+                        "min_workers, or declare a larger cluster",
+                    )
+                )
+    if cfg.num_cpus is not None and cfg.execution_mode is ExecutionMode.STREAMING:
+        total_cpus = sum(
+            s.stage.resources.cpus * _min_workers(s) for s in spec.stages
+        )
+        if total_cpus > cfg.num_cpus:
+            findings.append(
+                Finding(
+                    _SPEC_FILE, 0, "infeasible-streaming",
+                    f"summed minimum CPU demand {_fmt(total_cpus)} exceeds the "
+                    f"declared {_fmt(cfg.num_cpus)} CPUs; the autoscaler cannot "
+                    "shrink below per-stage minimums",
+                    severity=Severity.WARNING,
+                )
+            )
+
+
+def _fmt(x: float) -> str:
+    return str(int(x)) if float(x).is_integer() and not math.isinf(x) else f"{x:g}"
+
+
+# -- entry points -----------------------------------------------------------
+
+
+def lint_pipeline_spec(spec: "PipelineSpec") -> list[Finding]:
+    """All findings (errors and warnings) for a pipeline spec."""
+    findings: list[Finding] = []
+    _check_duplicate_names(spec, findings)
+    _check_type_flow(spec, findings)
+    _check_resources(spec, findings)
+    return findings
+
+
+def validate_pipeline_spec(spec: "PipelineSpec") -> None:
+    """The ``run_pipeline`` pre-flight: raise on errors, log warnings."""
+    findings = lint_pipeline_spec(spec)
+    errors = [f for f in findings if f.severity is Severity.ERROR]
+    for f in findings:
+        if f.severity is not Severity.ERROR:
+            logger.warning("pipeline pre-flight: %s", f.render())
+    if errors:
+        raise PipelineValidationError(errors)

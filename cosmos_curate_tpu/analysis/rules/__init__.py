@@ -1,0 +1,48 @@
+"""Rule registry for the AST linter.
+
+A rule is an object with a ``rule_id``, a one-line ``description`` and a
+``check(ctx: RuleContext) -> list[Finding]`` method. Rules are registered
+explicitly here (no import-time magic): adding a rule means adding a module
+under ``analysis/rules/`` and listing it in :func:`all_rules`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.common import Finding, LintConfig
+
+
+@dataclass
+class RuleContext:
+    """Everything a rule may look at for one file."""
+
+    path: Path  # absolute path on disk
+    rel_path: str  # repo-relative, POSIX-style; used in findings and scoping
+    tree: ast.Module
+    source: str
+    config: LintConfig
+
+
+class Rule:
+    rule_id: str = ""
+    description: str = ""
+
+    def check(self, ctx: RuleContext) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def all_rules() -> list[Rule]:
+    from cosmos_curate_tpu.analysis.rules.jit_transfer import JitTransferRule
+    from cosmos_curate_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+    from cosmos_curate_tpu.analysis.rules.min_python import MinPythonRule
+    from cosmos_curate_tpu.analysis.rules.silent_swallow import SilentSwallowRule
+
+    return [
+        LockDisciplineRule(),
+        MinPythonRule(),
+        JitTransferRule(),
+        SilentSwallowRule(),
+    ]

@@ -1,0 +1,189 @@
+"""jit-transfer: host-device transfer smells inside jitted functions.
+
+``.item()``, ``float(x)`` / ``int(x)``, ``np.asarray``, ``jax.device_get``
+inside a function decorated with ``jax.jit``/``pjit`` either force a
+blocking device->host transfer per call or raise a ``TracerConversionError``
+at trace time — both are bugs you want at lint time, not on the TPU.
+
+The rule only inspects functions whose decorator list mentions ``jit`` or
+``pjit`` (directly, dotted, or wrapped in ``functools.partial``), so plain
+NumPy code is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cosmos_curate_tpu.analysis.common import Finding
+from cosmos_curate_tpu.analysis.rules import Rule, RuleContext
+
+_JIT_NAMES = {"jit", "pjit"}
+_TRANSFER_METHODS = {"item", "tolist", "numpy", "__array__"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_NUMPY_CONVERTERS = {"asarray", "array", "ascontiguousarray", "asanyarray"}
+
+
+def _mentions_jit(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in _JIT_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
+            return True
+    return False
+
+
+def _numpy_aliases(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("numpy", "numpy.ma"):
+                    names.add(a.asname or "numpy")
+    return names or {"np", "numpy", "onp"}
+
+
+def _jax_aliases(tree: ast.Module) -> set[str]:
+    names = {"jax"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    names.add(a.asname or "jax")
+    return names
+
+
+class JitTransferRule(Rule):
+    rule_id = "jit-transfer"
+    description = (
+        "host-device transfers (.item(), float()/int() on arrays, "
+        "np.asarray, jax.device_get) inside jax.jit/pjit-compiled functions"
+    )
+
+    def check(self, ctx: RuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        np_names = _numpy_aliases(ctx.tree)
+        jax_names = _jax_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_mentions_jit(d) for d in node.decorator_list):
+                continue
+            findings.extend(self._check_jit_body(ctx, node, np_names, jax_names))
+        return findings
+
+    def _check_jit_body(
+        self,
+        ctx: RuleContext,
+        fn: ast.AST,
+        np_names: set[str],
+        jax_names: set[str],
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        fn_name = getattr(fn, "name", "<fn>")
+        shape_names, traced_names = _classify_locals(fn)
+
+        def flag(lineno: int, what: str, why: str) -> None:
+            findings.append(
+                Finding(
+                    ctx.rel_path, lineno, self.rule_id,
+                    f"{what} inside jitted function '{fn_name}' {why}",
+                )
+            )
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _TRANSFER_METHODS and not isinstance(
+                    func.value, ast.Name
+                ):
+                    flag(node.lineno, f".{func.attr}()",
+                         "forces a blocking device->host transfer per call")
+                elif func.attr in _TRANSFER_METHODS and isinstance(func.value, ast.Name):
+                    # obj.item() — can't see the type, but in jit context the
+                    # receiver is almost always a traced array
+                    flag(node.lineno, f"{func.value.id}.{func.attr}()",
+                         "forces a blocking device->host transfer per call")
+                elif (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in np_names
+                    and func.attr in _NUMPY_CONVERTERS
+                ):
+                    flag(node.lineno, f"{func.value.id}.{func.attr}()",
+                         "materializes the traced array on the host "
+                         "(use jnp equivalents)")
+                elif (
+                    isinstance(func.value, ast.Name)
+                    and func.value.id in jax_names
+                    and func.attr == "device_get"
+                ):
+                    flag(node.lineno, f"{func.value.id}.device_get()",
+                         "pulls values to the host mid-computation")
+            elif isinstance(func, ast.Name) and func.id in _CAST_BUILTINS:
+                if (
+                    node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                    and _references_traced(node.args[0], shape_names, traced_names)
+                ):
+                    flag(node.lineno, f"{func.id}()",
+                         "concretizes a traced value (TracerConversionError "
+                         "at trace time, or a silent host sync)")
+        return findings
+
+
+def _is_shape_expr(expr: ast.expr) -> bool:
+    """Shape arithmetic yields static Python ints under tracing —
+    ``x.shape``, ``x.ndim``, ``len(x)`` — safe to cast."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim", "size"):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
+            return True
+    return False
+
+
+def _classify_locals(fn: ast.AST) -> tuple[set[str], set[str]]:
+    """-> (names bound from shape-ish expressions, names that may hold
+    traced arrays: parameters + every other local binding)."""
+    shape_names: set[str] = set()
+    traced: set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            traced.add(a.arg)
+        if args.vararg:
+            traced.add(args.vararg.arg)
+        if args.kwarg:
+            traced.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = list(node.targets), node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.For):
+            targets, value = [node.target], node.iter
+        else:
+            continue
+        bucket = shape_names if _is_shape_expr(value) else traced
+        for t in targets:
+            for el in t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]:
+                if isinstance(el, ast.Name):
+                    bucket.add(el.id)
+    return shape_names - traced, traced
+
+
+def _references_traced(expr: ast.expr, shape_names: set[str], traced: set[str]) -> bool:
+    """True when the expression touches a name that may be a traced array.
+    Names never bound locally (module constants) and shape-derived ints
+    don't count, so ``int(h * _BAND)`` with ``h`` from ``x.shape`` is
+    clean while ``int(loss)`` is flagged."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in traced and node.id not in shape_names:
+            return True
+    return False

@@ -1,0 +1,71 @@
+"""`cosmos-curate-tpu lint`: run the static-analysis rule set.
+
+Usage:
+
+    cosmos-curate-tpu lint                       # lint cosmos_curate_tpu/
+    cosmos-curate-tpu lint path/a.py dir/        # specific targets
+    cosmos-curate-tpu lint --rules min-python    # subset of rules
+    cosmos-curate-tpu lint --list-rules
+
+Exit status: 0 clean, 1 findings, 2 usage error. Findings print as
+``file:line rule-id message``; see docs/STATIC_ANALYSIS.md for the rule
+catalogue, the ``[tool.curate-lint]`` config section and suppression
+comments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def register(sub: "argparse._SubParsersAction") -> None:
+    lint = sub.add_parser(
+        "lint",
+        help="static analysis: engine lock discipline, interpreter-floor "
+        "APIs, jit transfer smells, silent exception swallows",
+    )
+    lint.add_argument(
+        "paths",
+        nargs="*",
+        default=["cosmos_curate_tpu"],
+        help="files or directories to lint (default: cosmos_curate_tpu/)",
+    )
+    lint.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all enabled in "
+        "[tool.curate-lint])",
+    )
+    lint.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue and exit"
+    )
+    lint.set_defaults(func=_cmd_lint)
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.analysis.ast_lint import run_lint
+    from cosmos_curate_tpu.analysis.rules import all_rules
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.rule_id:16s} {rule.description}")
+        return 0
+    rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    try:
+        findings = run_lint(args.paths, rule_ids=rule_ids)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    n_files = len(args.paths)
+    if findings:
+        print(
+            f"curate-lint: {len(findings)} finding(s) "
+            f"(suppress with '# curate-lint: disable=<rule>')",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"curate-lint: clean ({n_files} target(s))", file=sys.stderr)
+    return 0

@@ -67,6 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
     except ImportError:
         pass
     # no optional deps — an ImportError here would be a real defect, so no guard
+    from cosmos_curate_tpu.cli import lint_cli
+
+    lint_cli.register(sub)
     from cosmos_curate_tpu.cli import postgres_cli
 
     postgres_cli.register(sub)

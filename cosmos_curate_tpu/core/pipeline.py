@@ -80,6 +80,8 @@ def run_pipeline(
     stages: Sequence[Stage | StageSpec],
     config: PipelineConfig | None = None,
     runner: "RunnerInterface | None" = None,
+    *,
+    skip_validation: bool = False,
 ) -> list[PipelineTask] | None:
     """Run ``input_tasks`` through ``stages``; blocks until done.
 
@@ -87,6 +89,12 @@ def run_pipeline(
     one, SURVEY.md §4): tests inject a ``SequentialRunner`` to execute every
     stage in-process with zero infrastructure; production uses the streaming
     engine runner.
+
+    The spec is validated before any worker spawns (stage-to-stage task-type
+    flow, duplicate names, STREAMING resource feasibility — see
+    cosmos_curate_tpu/analysis/graph_lint.py); a mis-wired pipeline raises
+    ``PipelineValidationError`` immediately instead of failing deep into the
+    run. ``skip_validation=True`` bypasses the pre-flight.
     """
     from cosmos_curate_tpu.core.runner import default_runner
 
@@ -96,5 +104,9 @@ def run_pipeline(
         stages=_normalize_stages(stages),
         config=config,
     )
+    if not skip_validation:
+        from cosmos_curate_tpu.analysis.graph_lint import validate_pipeline_spec
+
+        validate_pipeline_spec(spec)
     active = runner if runner is not None else default_runner()
     return active.run(spec)

@@ -69,6 +69,8 @@ class ObjectServer:
         self._server = socket.create_server((host, 0))
         self.port = self._server.getsockname()[1]
         self._closed = False
+        # one request thread per GET: the counters are mutated concurrently
+        self._stats_lock = threading.Lock()
         self.gets_served = 0  # observability + tests
         self.bytes_served = 0
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -127,8 +129,9 @@ class ObjectServer:
                 mac.update(chunk)
                 sock.sendall(chunk)
             sock.sendall(mac.digest())
-        self.gets_served += 1
-        self.bytes_served += total
+        with self._stats_lock:
+            self.gets_served += 1
+            self.bytes_served += total
 
     def close(self) -> None:
         self._closed = True

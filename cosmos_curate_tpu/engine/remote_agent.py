@@ -79,6 +79,9 @@ class NodeAgent:
         self.node_id = node_id or f"{socket.gethostname()}-{os.getpid()}"
         self.num_cpus = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
         self.token = _token()
+        # guards `workers` and `inflight`: both are mutated from the recv
+        # loop, the result-relay thread and the watchdog thread
+        self._lock = threading.Lock()
         self.workers: dict[str, tuple[object, object]] = {}  # key -> (in_q, proc)
         # (worker_key, batch_id) -> input refs this agent FETCHED (local
         # copies of remote segments), deleted once the result is relayed (or
@@ -133,8 +136,9 @@ class NodeAgent:
         # each cycle gets its OWN stop event: a relay thread stuck in a
         # stalled send can never be revived by a later cycle's clear()
         self._stop = threading.Event()
-        self.workers.clear()
-        self.inflight.clear()
+        with self._lock:
+            self.workers.clear()
+            self.inflight.clear()
         deadline = time.monotonic() + connect_timeout_s
         while True:  # the driver may come up after the agents (srun races)
             try:
@@ -198,8 +202,8 @@ class NodeAgent:
             for key, (in_q, _proc) in list(self.workers.items()):
                 try:
                     in_q.put(ShutdownMsg())
-                except Exception:
-                    pass
+                except (OSError, ValueError):  # queue already closed/broken
+                    logger.debug("shutdown enqueue failed for %s", key, exc_info=True)
             time.sleep(0.2)
             for key, (_in_q, proc) in list(self.workers.items()):
                 if proc.is_alive():
@@ -216,7 +220,8 @@ class NodeAgent:
 
     def _handle(self, msg) -> None:
         if isinstance(msg, StartWorker):
-            stale = self.workers.pop(msg.worker_key, None)
+            with self._lock:
+                stale = self.workers.pop(msg.worker_key, None)
             if stale is not None:
                 # a driver retry re-sent StartWorker while the first process
                 # was still setting up: terminate it, or its results would
@@ -246,9 +251,11 @@ class NodeAgent:
             )
             proc.start()
             in_q.put(SetupMsg(msg.stage_pickle, msg.meta_pickle))
-            self.workers[msg.worker_key] = (in_q, proc)
+            with self._lock:
+                self.workers[msg.worker_key] = (in_q, proc)
         elif isinstance(msg, SubmitBatch):
-            entry = self.workers.get(msg.worker_key)
+            with self._lock:
+                entry = self.workers.get(msg.worker_key)
             if entry is None:
                 self._send(
                     AgentResult(
@@ -257,13 +264,31 @@ class NodeAgent:
                 )
                 return
             refs, fetched = self._resolve_specs(msg.refs)
-            self.inflight[(msg.worker_key, msg.batch_id)] = fetched
+            # the fetch above can take seconds: the worker may have died and
+            # been reaped by the watchdog meanwhile. Re-check under the same
+            # lock hold as the inflight insert — inserting for a reaped key
+            # would leak the fetched segments forever (the watchdog already
+            # scanned inflight and will never revisit this key).
+            with self._lock:
+                alive = msg.worker_key in self.workers
+                if alive:
+                    self.inflight[(msg.worker_key, msg.batch_id)] = fetched
+            if not alive:
+                # WorkerDied was already reported; the driver requeues the
+                # batch — just free this attempt's local copies
+                for r in fetched:
+                    try:
+                        object_store.delete(r)
+                    except OSError:
+                        pass
+                return
             entry[0].put(ProcessMsg(batch_id=msg.batch_id, refs=refs))
         elif isinstance(msg, ReleaseObjects):
             for name in msg.names:
                 object_store.delete(object_store.ObjectRef(name, 0, 0))
         elif isinstance(msg, StopWorker):
-            entry = self.workers.pop(msg.worker_key, None)
+            with self._lock:
+                entry = self.workers.pop(msg.worker_key, None)
             if entry is not None:
                 try:
                     entry[0].put(ShutdownMsg())
@@ -299,17 +324,18 @@ class NodeAgent:
             for r in fetched:
                 try:
                     object_store.delete(r)
-                except Exception:
-                    pass
+                except OSError:
+                    logger.debug("cleanup delete failed for %s", r.shm_name, exc_info=True)
             raise
         return refs, fetched
 
     def _release_inflight(self, worker_key: str, batch_id: int) -> None:
-        refs = self.inflight.pop((worker_key, batch_id), [])
+        with self._lock:
+            refs = self.inflight.pop((worker_key, batch_id), [])
         for r in refs:
             try:
                 object_store.delete(r)
-            except Exception:
+            except OSError:  # segment already unlinked: nothing to release
                 pass
 
     def _relay_results(self, stop: threading.Event) -> None:
@@ -361,7 +387,8 @@ class NodeAgent:
             for key, (_in_q, proc) in list(self.workers.items()):
                 if proc.is_alive():
                     continue
-                self.workers.pop(key, None)
+                with self._lock:
+                    self.workers.pop(key, None)
                 logger.warning("worker %s died on agent (exit %s)", key, proc.exitcode)
                 for wkey, batch_id in list(self.inflight):
                     if wkey == key:

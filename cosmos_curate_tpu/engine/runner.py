@@ -632,8 +632,8 @@ class StreamingRunner(RunnerInterface):
                     out.append(q_.get_nowait())
                 except queue.Empty:
                     break
-                except Exception:
-                    break
+                except (OSError, EOFError, ValueError):
+                    break  # queue torn down mid-drain (shutdown race)
         return out
 
     @staticmethod

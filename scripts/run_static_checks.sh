@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static-analysis gate: AST lint over the package + the analysis test suite.
+# CI and pre-merge hooks call this; it exits nonzero on any finding or test
+# failure. See docs/STATIC_ANALYSIS.md for the rule catalogue.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== curate-lint: AST rules over cosmos_curate_tpu/ =="
+# `cosmos-curate-tpu lint` when the console script is installed; module
+# invocation otherwise (dev checkouts without `pip install -e .`)
+if command -v cosmos-curate-tpu >/dev/null 2>&1; then
+  cosmos-curate-tpu lint cosmos_curate_tpu
+else
+  python -m cosmos_curate_tpu.cli.main lint cosmos_curate_tpu
+fi
+
+echo "== analysis test suite =="
+JAX_PLATFORMS=cpu python -m pytest tests/analysis -q
+
+echo "static checks passed"

@@ -1,0 +1,506 @@
+"""AST linter: one fixture snippet per rule, plus suppression handling and
+the config/floor plumbing."""
+
+import textwrap
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.ast_lint import lint_file, run_lint
+from cosmos_curate_tpu.analysis.common import (
+    LintConfig,
+    load_config,
+    parse_suppressions,
+)
+from cosmos_curate_tpu.analysis.rules import all_rules
+
+
+def _lint(tmp_path: Path, code: str, *, subdir: str = "engine", floor=(3, 10), rules=None):
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "snippet.py"
+    f.write_text(textwrap.dedent(code))
+    cfg = LintConfig(python_floor=floor)
+    selected = all_rules()
+    if rules:
+        selected = [r for r in selected if r.rule_id in rules]
+    return lint_file(f, cfg, selected, root=tmp_path)
+
+
+class TestLockDiscipline:
+    def test_mutation_inside_and_outside_lock_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def drop(self):
+                    self._items.pop()  # unguarded
+            """,
+        )
+        assert [f.rule for f in findings] == ["lock-discipline"]
+        assert "self._items" in findings[0].message
+
+    def test_cross_thread_unguarded_mutation_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Agent:
+                def __init__(self):
+                    self.workers = {}
+
+                def serve(self):
+                    threading.Thread(target=self._watchdog).start()
+                    self.workers["k"] = 1
+
+                def _watchdog(self):
+                    self.workers.pop("k", None)
+            """,
+        )
+        assert len(findings) == 2
+        assert all(f.rule == "lock-discipline" for f in findings)
+
+    def test_consistently_guarded_class_is_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def drop(self):
+                    with self._lock:
+                        self._items.pop()
+            """,
+        )
+        assert findings == []
+
+    def test_init_mutations_and_threadsafe_attrs_exempt(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+                    self._items = []
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._stop.clear()  # Event: thread-safe by design
+                    with self._lock:
+                        self._items.append(1)
+            """,
+        )
+        assert findings == []
+
+    def test_per_request_thread_in_loop_is_self_concurrent(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Server:
+                def __init__(self):
+                    self.served = 0
+
+                def accept_loop(self):
+                    while True:
+                        threading.Thread(target=self._serve_one).start()
+
+                def _serve_one(self):
+                    self.served += 1
+            """,
+        )
+        assert len(findings) == 1
+        assert "self.served" in findings[0].message
+
+    def test_outside_engine_not_scanned(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import threading
+
+            class Agent:
+                def __init__(self):
+                    self.workers = {}
+
+                def serve(self):
+                    threading.Thread(target=self._w).start()
+                    self.workers["k"] = 1
+
+                def _w(self):
+                    self.workers.pop("k", None)
+            """,
+            subdir="models",
+        )
+        assert findings == []
+
+
+class TestMinPython:
+    def test_new_stdlib_attr_flagged_under_310_floor(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import logging
+
+            def levels():
+                return logging.getLevelNamesMapping()
+            """,
+            subdir="utils",
+        )
+        assert [f.rule for f in findings] == ["min-python"]
+        assert "3.11" in findings[0].message
+
+    def test_clean_under_matching_floor(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import logging
+
+            def levels():
+                return logging.getLevelNamesMapping()
+            """,
+            subdir="utils",
+            floor=(3, 11),
+        )
+        assert findings == []
+
+    def test_from_import_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from enum import StrEnum
+            """,
+            subdir="utils",
+        )
+        assert [f.rule for f in findings] == ["min-python"]
+
+    def test_new_module_flagged_and_importerror_guard_exempts(self, tmp_path):
+        flagged = _lint(tmp_path, "import tomllib\n", subdir="utils")
+        assert [f.rule for f in flagged] == ["min-python"]
+        guarded = _lint(
+            tmp_path,
+            """
+            try:
+                import tomllib
+            except ImportError:
+                tomllib = None
+            """,
+            subdir="utils",
+        )
+        assert guarded == []
+
+    def test_hasattr_guard_exempts_aliased_import(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import logging as log
+
+            def levels():
+                if hasattr(log, "getLevelNamesMapping"):
+                    return log.getLevelNamesMapping()
+                return log._nameToLevel
+            """,
+            subdir="utils",
+        )
+        assert findings == []
+
+    def test_hasattr_guard_exempts_attribute_use(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import logging
+
+            def levels():
+                if hasattr(logging, "getLevelNamesMapping"):
+                    return logging.getLevelNamesMapping()
+                return logging._nameToLevel
+            """,
+            subdir="utils",
+        )
+        assert findings == []
+
+
+class TestJitTransfer:
+    def test_item_inside_jit_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+            """,
+            subdir="ops",
+        )
+        assert [f.rule for f in findings] == ["jit-transfer"]
+
+    def test_cast_of_traced_value_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=())
+            def f(x):
+                loss = x.mean()
+                return float(loss)
+            """,
+            subdir="ops",
+        )
+        assert [f.rule for f in findings] == ["jit-transfer"]
+
+    def test_shape_arithmetic_cast_is_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                t, h, w = x.shape
+                band = max(1, int(h * 0.2))
+                return x[:, :band]
+            """,
+            subdir="ops",
+        )
+        assert findings == []
+
+    def test_np_asarray_inside_jit_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+            """,
+            subdir="ops",
+        )
+        assert [f.rule for f in findings] == ["jit-transfer"]
+
+    def test_unjitted_function_not_scanned(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            def f(x):
+                return x.sum().item()
+            """,
+            subdir="ops",
+        )
+        assert findings == []
+
+
+class TestSilentSwallow:
+    def test_broad_except_pass_in_loop_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            def worker_loop(q):
+                while True:
+                    try:
+                        q.get()
+                    except Exception:
+                        pass
+            """,
+        )
+        assert [f.rule for f in findings] == ["silent-swallow"]
+
+    def test_logged_handler_is_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import logging
+            logger = logging.getLogger(__name__)
+
+            def worker_loop(q):
+                while True:
+                    try:
+                        q.get()
+                    except Exception:
+                        logger.exception("poisoned batch")
+            """,
+        )
+        assert findings == []
+
+    def test_narrow_handler_and_non_loop_are_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import queue
+
+            def drain(q):
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+
+            def once(q):
+                try:
+                    return q.get()
+                except Exception:
+                    pass
+            """,
+        )
+        assert findings == []
+
+    def test_captured_and_reraised_later_is_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            def release_all(refs):
+                err = None
+                for r in refs:
+                    try:
+                        r.release()
+                    except Exception as e:
+                        err = e
+                if err is not None:
+                    raise err
+            """,
+        )
+        assert findings == []
+
+
+class TestSuppressions:
+    CODE = """
+    def worker_loop(q):
+        while True:
+            try:
+                q.get()
+            except Exception:{comment}
+                pass
+    """
+
+    def test_same_line_suppression(self, tmp_path):
+        findings = _lint(
+            tmp_path, self.CODE.format(comment="  # curate-lint: disable=silent-swallow")
+        )
+        assert findings == []
+
+    def test_line_above_suppression(self, tmp_path):
+        code = """
+        def worker_loop(q):
+            while True:
+                try:
+                    q.get()
+                # curate-lint: disable=silent-swallow
+                except Exception:
+                    pass
+        """
+        assert _lint(tmp_path, code) == []
+
+    def test_file_wide_suppression(self, tmp_path):
+        code = "# curate-lint: disable-file=silent-swallow\n" + textwrap.dedent(
+            self.CODE.format(comment="")
+        )
+        assert _lint(tmp_path, code) == []
+
+    def test_disable_all(self, tmp_path):
+        findings = _lint(
+            tmp_path, self.CODE.format(comment="  # curate-lint: disable=all")
+        )
+        assert findings == []
+
+    def test_unrelated_rule_suppression_keeps_finding(self, tmp_path):
+        findings = _lint(
+            tmp_path, self.CODE.format(comment="  # curate-lint: disable=min-python")
+        )
+        assert [f.rule for f in findings] == ["silent-swallow"]
+
+    def test_parse_suppressions_shapes(self):
+        per_line, file_wide = parse_suppressions(
+            "x = 1  # curate-lint: disable=a,b\n"
+            "# curate-lint: disable=c\n"
+            "y = 2\n"
+            "# curate-lint: disable-file=d\n"
+        )
+        assert per_line[1] == {"a", "b"}
+        assert per_line[3] == {"c"}  # standalone comment covers the next line
+        assert file_wide == {"d"}
+
+
+class TestConfigAndDriver:
+    def test_run_lint_on_package_is_clean(self):
+        # the acceptance gate: the repo lints clean (fixes or suppressions)
+        repo_pkg = Path(__file__).resolve().parents[2] / "cosmos_curate_tpu"
+        findings = run_lint([repo_pkg])
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_config_reads_requires_python_floor(self, tmp_path):
+        py = tmp_path / "pyproject.toml"
+        py.write_text(
+            '[project]\nrequires-python = ">=3.10"\n'
+            "[tool.curate-lint]\n"
+            'disable = ["jit-transfer"]\n'
+            'exclude = ["tests/"]\n'
+        )
+        cfg = load_config(py)
+        assert cfg.python_floor == (3, 10)
+        assert not cfg.rule_enabled("jit-transfer")
+        assert cfg.rule_enabled("min-python")
+        assert "tests/" in cfg.exclude
+
+    def test_python_floor_override_wins(self, tmp_path):
+        py = tmp_path / "pyproject.toml"
+        py.write_text(
+            '[project]\nrequires-python = ">=3.10"\n'
+            "[tool.curate-lint]\n"
+            'python-floor = "3.12"\n'
+        )
+        assert load_config(py).python_floor == (3, 12)
+
+    def test_syntax_error_reported_as_finding(self, tmp_path):
+        f = tmp_path / "bad.py"
+        f.write_text("def broken(:\n")
+        cfg = LintConfig()
+        findings = lint_file(f, cfg, all_rules(), root=tmp_path)
+        assert [x.rule for x in findings] == ["parse-error"]
+
+    def test_unknown_rule_id_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown rule"):
+            run_lint(["."], rule_ids=["no-such-rule"])
+
+    def test_nonexistent_target_raises_instead_of_clean(self, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError, match="no such file"):
+            run_lint([tmp_path / "typo_dir"])
+        with pytest.raises(ValueError, match="not a Python file"):
+            f = tmp_path / "notes.txt"
+            f.write_text("hi")
+            run_lint([f])

@@ -1,0 +1,267 @@
+"""Pipeline-graph linter: type flow, duplicate names, resource feasibility,
+and the ``run_pipeline`` pre-flight wiring."""
+
+from dataclasses import dataclass
+
+import pytest
+
+from cosmos_curate_tpu.analysis.common import Severity
+from cosmos_curate_tpu.analysis.graph_lint import (
+    PipelineValidationError,
+    lint_pipeline_spec,
+    validate_pipeline_spec,
+)
+from cosmos_curate_tpu.core.pipeline import (
+    ExecutionMode,
+    PipelineConfig,
+    PipelineSpec,
+    _normalize_stages,
+    run_pipeline,
+)
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+
+@dataclass
+class AlphaTask(PipelineTask):
+    x: int = 0
+
+
+@dataclass
+class BetaTask(PipelineTask):
+    y: int = 0
+
+
+class AlphaStage(Stage[AlphaTask, AlphaTask]):
+    def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+        return tasks
+
+
+class AlphaStageTwo(Stage[AlphaTask, AlphaTask]):
+    def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+        return tasks
+
+
+class BetaStage(Stage[BetaTask, BetaTask]):
+    def process_data(self, tasks: list[BetaTask]) -> list[BetaTask]:
+        return tasks
+
+
+class UntypedStage(Stage):
+    def process_data(self, tasks):
+        return tasks
+
+
+class TpuChipStage(Stage[AlphaTask, AlphaTask]):
+    def __init__(self, name: str, chips: float) -> None:
+        self._display_name = name
+        self._chips = chips
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, tpus=self._chips)
+
+    def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+        return tasks
+
+
+def _spec(stages, config=None, inputs=None):
+    return PipelineSpec(
+        input_data=[AlphaTask()] if inputs is None else inputs,
+        stages=_normalize_stages(stages),
+        config=config or PipelineConfig(),
+    )
+
+
+def _errors(spec):
+    return [f for f in lint_pipeline_spec(spec) if f.severity is Severity.ERROR]
+
+
+class TestTypeFlow:
+    def test_mismatch_names_both_stages_and_types(self):
+        errs = _errors(_spec([AlphaStage(), BetaStage()]))
+        assert len(errs) == 1
+        msg = errs[0].message
+        assert "AlphaStage" in msg and "BetaStage" in msg
+        assert "AlphaTask" in msg and "BetaTask" in msg
+        assert errs[0].rule == "type-flow"
+
+    def test_happy_path_is_clean(self):
+        assert _errors(_spec([AlphaStage(), AlphaStageTwo()])) == []
+
+    def test_untyped_stage_is_skipped_not_failed(self):
+        assert _errors(_spec([AlphaStage(), UntypedStage()])) == []
+        assert _errors(_spec([UntypedStage(), BetaStage()])) == []
+
+    def test_input_tasks_checked_against_first_stage(self):
+        errs = _errors(_spec([BetaStage()], inputs=[AlphaTask()]))
+        assert len(errs) == 1
+        assert "AlphaTask" in errs[0].message and "BetaStage" in errs[0].message
+
+    def test_optional_list_return_still_checked(self):
+        class OptionalEmitter(Stage[AlphaTask, AlphaTask]):
+            def process_data(self, tasks: list[AlphaTask]) -> "list[AlphaTask] | None":
+                return tasks
+
+        assert _errors(_spec([OptionalEmitter(), AlphaStage()])) == []
+        errs = _errors(_spec([OptionalEmitter(), BetaStage()]))
+        assert len(errs) == 1 and "OptionalEmitter" in errs[0].message
+
+    def test_subclass_flow_is_compatible(self):
+        @dataclass
+        class AlphaChildTask(AlphaTask):
+            z: int = 0
+
+        class ChildEmitter(Stage[AlphaTask, AlphaChildTask]):
+            def process_data(self, tasks: list[AlphaTask]) -> list[AlphaChildTask]:
+                return [AlphaChildTask()]
+
+        # emits a subclass of what the next stage accepts: fine
+        errs = _errors(_spec([ChildEmitter(), AlphaStage()]))
+        assert errs == []
+
+
+class TestDuplicateNames:
+    def test_duplicate_stage_names_warn_but_do_not_reject(self):
+        findings = lint_pipeline_spec(_spec([AlphaStage(), AlphaStage()]))
+        dups = [f for f in findings if f.rule == "duplicate-stage"]
+        assert len(dups) == 1
+        assert dups[0].severity is Severity.WARNING
+        # a functional spec must still pass the pre-flight
+        validate_pipeline_spec(_spec([AlphaStage(), AlphaStage()]))
+
+    def test_distinct_names_ok(self):
+        findings = lint_pipeline_spec(_spec([AlphaStage(), AlphaStageTwo()]))
+        assert [f for f in findings if f.rule == "duplicate-stage"] == []
+
+
+class TestStreamingFeasibility:
+    def test_oversubscribed_streaming_budget_rejected(self):
+        cfg = PipelineConfig(num_tpu_chips=4)
+        spec = _spec(
+            [TpuChipStage("emb", 4.0), TpuChipStage("cap", 4.0)], config=cfg
+        )
+        errs = [f for f in _errors(spec) if f.rule == "infeasible-streaming"]
+        assert len(errs) == 1
+        assert "emb" in errs[0].message and "cap" in errs[0].message
+
+    def test_batch_mode_allows_serial_reuse(self):
+        cfg = PipelineConfig(
+            num_tpu_chips=4, execution_mode=ExecutionMode.BATCH
+        )
+        spec = _spec(
+            [TpuChipStage("emb", 4.0), TpuChipStage("cap", 4.0)], config=cfg
+        )
+        assert [f for f in _errors(spec) if f.rule == "infeasible-streaming"] == []
+
+    def test_single_stage_larger_than_cluster_rejected_even_in_batch(self):
+        cfg = PipelineConfig(num_tpu_chips=4, execution_mode=ExecutionMode.BATCH)
+        spec = _spec([TpuChipStage("huge", 8.0)], config=cfg)
+        errs = [f for f in _errors(spec) if f.rule == "infeasible-streaming"]
+        assert len(errs) == 1 and "huge" in errs[0].message
+
+    def test_undeclared_cluster_shape_skips_feasibility(self):
+        spec = _spec([TpuChipStage("emb", 4.0), TpuChipStage("cap", 4.0)])
+        assert _errors(spec) == []
+
+    def test_min_workers_multiply_demand(self):
+        cfg = PipelineConfig(num_tpu_chips=4)
+        spec = PipelineSpec(
+            input_data=[AlphaTask()],
+            stages=_normalize_stages(
+                [StageSpec(TpuChipStage("emb", 1.0), min_workers=8)]
+            ),
+            config=cfg,
+        )
+        errs = [f for f in _errors(spec) if f.rule == "infeasible-streaming"]
+        assert len(errs) == 1
+
+    def test_cpu_oversubscription_is_warning_not_error(self):
+        cfg = PipelineConfig(num_cpus=1.0)
+        spec = PipelineSpec(
+            input_data=[AlphaTask()],
+            stages=_normalize_stages(
+                [StageSpec(AlphaStage(), min_workers=8)]
+            ),
+            config=cfg,
+        )
+        findings = lint_pipeline_spec(spec)
+        warns = [f for f in findings if f.severity is Severity.WARNING]
+        assert any(f.rule == "infeasible-streaming" for f in warns)
+        assert _errors(spec) == []
+
+
+class TestNonsenseSpecs:
+    def test_tpus_with_entire_host_contradiction(self):
+        class Both(Stage[AlphaTask, AlphaTask]):
+            @property
+            def resources(self) -> Resources:
+                return Resources(cpus=1.0, tpus=1.0, entire_tpu_host=True)
+
+            def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+                return tasks
+
+        errs = [f for f in _errors(_spec([Both()])) if f.rule == "nonsense-spec"]
+        assert len(errs) == 1
+
+    def test_tpu_stage_with_per_node_packing(self):
+        spec = PipelineSpec(
+            input_data=[AlphaTask()],
+            stages=_normalize_stages(
+                [StageSpec(TpuChipStage("emb", 1.0), num_workers_per_node=4)]
+            ),
+            config=PipelineConfig(),
+        )
+        errs = [f for f in _errors(spec) if f.rule == "nonsense-spec"]
+        assert len(errs) == 1 and "num_workers_per_node" in errs[0].message
+
+    def test_bad_scheduling_knobs(self):
+        spec = PipelineSpec(
+            input_data=[AlphaTask()],
+            stages=_normalize_stages(
+                [
+                    StageSpec(
+                        AlphaStage(),
+                        min_workers=4,
+                        max_workers=2,
+                        num_run_attempts=0,
+                        stage_save_sample_rate=1.5,
+                    )
+                ]
+            ),
+            config=PipelineConfig(),
+        )
+        rules = [f.rule for f in _errors(spec)]
+        assert rules.count("nonsense-spec") == 3
+
+
+class TestRunPipelinePreflight:
+    def test_mistyped_pipeline_rejected_before_any_stage_runs(self):
+        ran = []
+
+        class Recorder(AlphaStage):
+            def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+                ran.append(1)
+                return tasks
+
+        with pytest.raises(PipelineValidationError) as ei:
+            run_pipeline(
+                [AlphaTask()], [Recorder(), BetaStage()], runner=SequentialRunner()
+            )
+        assert ran == []
+        assert "Recorder" in str(ei.value) and "BetaStage" in str(ei.value)
+        assert "AlphaTask" in str(ei.value) and "BetaTask" in str(ei.value)
+
+    def test_skip_validation_escape_hatch(self):
+        # mis-typed but duck-compatible: runs when validation is skipped
+        out = run_pipeline(
+            [AlphaTask()],
+            [AlphaStage(), BetaStage()],
+            runner=SequentialRunner(),
+            skip_validation=True,
+        )
+        assert len(out) == 1
+
+    def test_validate_pipeline_spec_passes_clean_spec(self):
+        validate_pipeline_spec(_spec([AlphaStage(), AlphaStageTwo()]))
