@@ -35,6 +35,7 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
+    from cosmos_curate_tpu.analysis.rules.ad_hoc_backoff import AdHocBackoffRule
     from cosmos_curate_tpu.analysis.rules.jit_transfer import JitTransferRule
     from cosmos_curate_tpu.analysis.rules.lock_discipline import LockDisciplineRule
     from cosmos_curate_tpu.analysis.rules.min_python import MinPythonRule
@@ -45,4 +46,5 @@ def all_rules() -> list[Rule]:
         MinPythonRule(),
         JitTransferRule(),
         SilentSwallowRule(),
+        AdHocBackoffRule(),
     ]

@@ -1,0 +1,60 @@
+"""Chaos fault-injection harness for the streaming engine.
+
+The engine's crash paths (dead-worker reaping, retry budgets, the DLQ)
+only earn trust if they can be exercised on demand. This package gives
+every interesting failure mode a *named injection site* — a single call
+embedded in production code — and a :class:`FaultPlan` that arms a subset
+of those sites with deterministic, seeded faults.
+
+Disabled is the default and costs one falsy module-attribute check per
+site (no env reads, no dict lookups, no IO on the hot path): ``fire()``
+returns immediately while no plan is installed. Plans are installed
+programmatically (:func:`install`) or from the ``CURATE_CHAOS`` env var
+(:func:`install_from_env`), which worker processes inherit so faults
+fire inside spawned workers too.
+
+See docs/FAULT_TOLERANCE.md for the site catalogue and how to write a
+chaos test.
+"""
+
+from cosmos_curate_tpu.chaos.harness import (
+    CHAOS_ENV,
+    SITE_OBJECT_CHANNEL_FETCH,
+    SITE_OBJECT_CHANNEL_SERVE,
+    SITE_REMOTE_PLANE_RECV,
+    SITE_REMOTE_PLANE_SEND,
+    SITE_STORAGE_REQUEST,
+    SITE_WORKER_CRASH,
+    SITE_WORKER_HANG,
+    ALL_SITES,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    enabled,
+    fire,
+    fire_count,
+    install,
+    install_from_env,
+    uninstall,
+)
+
+__all__ = [
+    "CHAOS_ENV",
+    "SITE_OBJECT_CHANNEL_FETCH",
+    "SITE_OBJECT_CHANNEL_SERVE",
+    "SITE_REMOTE_PLANE_RECV",
+    "SITE_REMOTE_PLANE_SEND",
+    "SITE_STORAGE_REQUEST",
+    "SITE_WORKER_CRASH",
+    "SITE_WORKER_HANG",
+    "ALL_SITES",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "enabled",
+    "fire",
+    "fire_count",
+    "install",
+    "install_from_env",
+    "uninstall",
+]

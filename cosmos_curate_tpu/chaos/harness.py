@@ -1,0 +1,249 @@
+"""Fault-plan model and the per-process injection runtime.
+
+Design constraints (ISSUE 2):
+
+- **Guaranteed no-op when disabled.** ``fire(site)`` is the only call on
+  production hot paths; while no plan is installed it is a single falsy
+  check on a module global. Env parsing happens once, at install time,
+  never per call.
+- **Deterministic.** Each armed rule owns a ``random.Random`` seeded from
+  ``(plan.seed, site)``, so a given plan produces the same fire/skip
+  sequence every run — chaos tests are reproducible, not flaky.
+- **Cross-process.** Plans serialize to JSON and ride the ``CURATE_CHAOS``
+  env var into spawned workers (engine/pool.py forwards it); each process
+  arms its own counters, so ``count`` bounds firings *per process*.
+
+Fault kinds:
+
+- ``crash``   — ``os._exit(exit_code)``: a worker death with no exception,
+  no cleanup (the reaper path, not the retry path).
+- ``hang``    — ``time.sleep(delay_s)``: a deadlocked decoder / stuck
+  socket stand-in. Pair with ``StageSpec.batch_timeout_s``.
+- ``error``   — raise :class:`InjectedFault` (a ``ConnectionError``
+  subclass, so connection-drop and storage-timeout handling paths treat
+  it exactly like the real thing).
+- ``delay``   — ``time.sleep(delay_s)`` then continue: injected latency
+  without failure (slow-network soak tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+CHAOS_ENV = "CURATE_CHAOS"
+
+# Named injection sites. Adding a site = embedding one fire() call and
+# listing the name here (tests assert the catalogue matches the docs).
+SITE_WORKER_CRASH = "worker.batch.crash"
+SITE_WORKER_HANG = "worker.batch.hang"
+SITE_OBJECT_CHANNEL_FETCH = "object_channel.fetch"
+SITE_OBJECT_CHANNEL_SERVE = "object_channel.serve"
+SITE_REMOTE_PLANE_SEND = "remote_plane.send"
+SITE_REMOTE_PLANE_RECV = "remote_plane.recv"
+SITE_STORAGE_REQUEST = "storage.request"
+
+ALL_SITES = (
+    SITE_WORKER_CRASH,
+    SITE_WORKER_HANG,
+    SITE_OBJECT_CHANNEL_FETCH,
+    SITE_OBJECT_CHANNEL_SERVE,
+    SITE_REMOTE_PLANE_SEND,
+    SITE_REMOTE_PLANE_RECV,
+    SITE_STORAGE_REQUEST,
+)
+
+_KINDS = ("crash", "hang", "error", "delay")
+
+
+class InjectedFault(ConnectionError):
+    """Raised by ``error``-kind rules.
+
+    Subclasses ``ConnectionError`` deliberately: the object channel, the
+    remote plane and the storage retry loops already handle connection
+    failures, and an injected fault must flow through those *production*
+    handlers, not a parallel test-only path.
+    """
+
+    def __init__(self, site: str) -> None:
+        super().__init__(f"chaos: injected fault at {site}")
+        self.site = site
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """Arm one site: fire with ``probability`` up to ``count`` times."""
+
+    site: str
+    kind: str = "error"
+    probability: float = 1.0
+    count: int | None = None  # max firings in this process; None = unlimited
+    delay_s: float = 0.0  # hang/delay duration
+    exit_code: int = 17  # crash exit code (distinguishable from real deaths)
+    # only fire in workers whose CURATE_WORKER_ID matches this regex ('' =
+    # all processes). Worker ids are deterministic (s<stage>-<name>-p<n>),
+    # so e.g. "-p0$" faults the FIRST worker and lets its replacement
+    # survive — the crash-then-recover shape most chaos tests want.
+    worker_re: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {self.probability}")
+        if self.count is not None and self.count < 0:
+            raise ValueError(f"count must be >= 0, got {self.count}")
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """A set of armed rules plus the seed that makes them deterministic."""
+
+    rules: tuple[FaultRule, ...] = ()
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "rules": [
+                    {
+                        "site": r.site,
+                        "kind": r.kind,
+                        "probability": r.probability,
+                        "count": r.count,
+                        "delay_s": r.delay_s,
+                        "exit_code": r.exit_code,
+                        "worker_re": r.worker_re,
+                    }
+                    for r in self.rules
+                ],
+            }
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        doc = json.loads(text)
+        return cls(
+            seed=int(doc.get("seed", 0)),
+            rules=tuple(FaultRule(**r) for r in doc.get("rules", ())),
+        )
+
+
+class _ArmedRule:
+    """Per-process mutable state for one rule (RNG + remaining budget)."""
+
+    def __init__(self, rule: FaultRule, seed: int) -> None:
+        import re
+
+        self.rule = rule
+        self.rng = random.Random(f"{seed}:{rule.site}")
+        self.remaining = rule.count  # None = unlimited
+        self.fired = 0
+        self.lock = threading.Lock()
+        self.worker_pat = re.compile(rule.worker_re) if rule.worker_re else None
+
+    def should_fire(self) -> bool:
+        if self.worker_pat is not None and not self.worker_pat.search(
+            os.environ.get("CURATE_WORKER_ID", "")
+        ):
+            return False
+        with self.lock:
+            if self.remaining is not None and self.remaining <= 0:
+                return False
+            if self.rule.probability < 1.0 and self.rng.random() >= self.rule.probability:
+                return False
+            if self.remaining is not None:
+                self.remaining -= 1
+            self.fired += 1
+            return True
+
+
+class _ActivePlan:
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+        self.by_site: dict[str, _ArmedRule] = {
+            r.site: _ArmedRule(r, plan.seed) for r in plan.rules
+        }
+
+    def fire(self, site: str) -> None:
+        armed = self.by_site.get(site)
+        if armed is None or not armed.should_fire():
+            return
+        rule = armed.rule
+        if rule.kind == "crash":
+            os._exit(rule.exit_code)
+            return  # only reachable when tests stub os._exit
+        if rule.kind in ("hang", "delay"):
+            time.sleep(rule.delay_s)
+            return
+        raise InjectedFault(site)
+
+
+# THE hot-path global: None while chaos is disabled. fire() below is the
+# only thing production code calls, and its disabled cost is one falsy
+# check — install()/uninstall() do all the work.
+_active: _ActivePlan | None = None
+
+
+def fire(site: str) -> None:
+    """Injection-site entry point; a no-op unless a plan arms ``site``."""
+    active = _active
+    if active is None:
+        return
+    active.fire(site)
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def fire_count(site: str) -> int:
+    """How many times ``site`` has fired in this process (tests/metrics)."""
+    active = _active
+    if active is None:
+        return 0
+    armed = active.by_site.get(site)
+    return armed.fired if armed is not None else 0
+
+
+def install(plan: FaultPlan, *, export_env: bool = False) -> None:
+    """Arm ``plan`` in this process. ``export_env=True`` additionally
+    writes it to ``CURATE_CHAOS`` so worker processes spawned *after* this
+    call inherit and arm the same plan."""
+    global _active
+    unknown = [r.site for r in plan.rules if r.site not in ALL_SITES]
+    if unknown:
+        raise ValueError(f"unknown chaos site(s): {unknown}; known: {list(ALL_SITES)}")
+    sites = [r.site for r in plan.rules]
+    dupes = sorted({s for s in sites if sites.count(s) > 1})
+    if dupes:
+        # one armed rule per site: silently keeping only the last rule
+        # would make a chaos test exercise less than it claims
+        raise ValueError(f"duplicate rule(s) for site(s): {dupes}")
+    _active = _ActivePlan(plan)
+    if export_env:
+        os.environ[CHAOS_ENV] = plan.to_json()
+
+
+def uninstall() -> None:
+    """Disarm; also clears ``CURATE_CHAOS`` from this environment."""
+    global _active
+    _active = None
+    os.environ.pop(CHAOS_ENV, None)
+
+
+def install_from_env() -> bool:
+    """Arm from ``CURATE_CHAOS`` if present; True when a plan was armed.
+
+    Called once at process bring-up (worker_main, agent main) — NOT on any
+    per-batch path."""
+    text = os.environ.get(CHAOS_ENV, "")
+    if not text:
+        return False
+    install(FaultPlan.from_json(text))
+    return True

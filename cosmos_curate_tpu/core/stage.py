@@ -144,6 +144,14 @@ class StageSpec(Generic[T, V]):
     min_workers: int = 1
     max_workers: int | None = None
     num_run_attempts: int = 1
+    # Wall-clock deadline for ONE batch execution (dispatch → result), in
+    # seconds; None disables. On expiry the engine kills the offending
+    # worker (a hung decoder/socket never returns on its own), charges the
+    # batch's worker-death budget and requeues it — see
+    # docs/FAULT_TOLERANCE.md. Enforced for process-pool workers (local via
+    # the runner, remote via the node agent's watchdog); in-process TPU
+    # workers cannot be killed and ignore it.
+    batch_timeout_s: float | None = None
     over_provision_factor: float | None = None
     # None = unset (heuristic defaults applied); 0 = never recycle.
     worker_max_lifetime_m: int | None = None

@@ -1,0 +1,191 @@
+"""Durable dead-letter queue for batches the engine gives up on.
+
+The reference engine's last resort for a poison batch is a log line — at
+petabyte scale that is silent data loss (engine/runner.py's ``drop
+LOUDLY`` path). The DLQ turns every permanent drop into a durable,
+inspectable, re-runnable artifact: before the batch's refs are released,
+its task payloads are materialized and persisted together with failure
+metadata.
+
+Layout (one directory per run, one per dead batch)::
+
+    <root>/<run_id>/
+        batch-<id>-<stage>/
+            meta.json     # stage, attempts, worker_deaths, reason, error tail
+            tasks.pkl     # cloudpickle list[PipelineTask]
+
+``root`` resolves from ``CURATE_DLQ_DIR`` (default
+``~/.cache/cosmos-curate-tpu/dlq``); set it to "" to disable persistence
+entirely. Directories are created lazily — a clean run writes nothing.
+
+Inspect and re-run with ``cosmos-curate-tpu dlq list|show|requeue``
+(cli/dlq_cli.py) or programmatically via :func:`list_entries` /
+:meth:`DlqEntry.load_tasks`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import uuid
+from dataclasses import dataclass
+from pathlib import Path
+
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DLQ_DIR_ENV = "CURATE_DLQ_DIR"
+_ERROR_TAIL = 4000  # chars of the failure traceback kept in meta.json
+
+
+def default_root() -> str:
+    """'' disables the DLQ (explicit empty env var)."""
+    if DLQ_DIR_ENV in os.environ:
+        return os.environ[DLQ_DIR_ENV]
+    return os.path.join(os.path.expanduser("~"), ".cache", "cosmos-curate-tpu", "dlq")
+
+
+@dataclass(frozen=True)
+class DlqEntry:
+    """One dead batch on disk."""
+
+    path: Path  # .../<run_id>/batch-<id>-<stage>
+    meta: dict
+
+    @property
+    def entry_id(self) -> str:
+        return f"{self.path.parent.name}/{self.path.name}"
+
+    def load_tasks(self) -> list:
+        import cloudpickle
+
+        with open(self.path / "tasks.pkl", "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def mark_requeued(self) -> None:
+        meta = dict(self.meta)
+        meta["requeued_at"] = time.time()
+        (self.path / "meta.json").write_text(json.dumps(meta, indent=2))
+
+
+class DeadLetterQueue:
+    """Run-scoped writer. Lazy: the run directory appears on first record.
+
+    Persistence must never turn a dropped batch into a crashed pipeline —
+    every failure in here degrades to the old log-only behavior.
+    """
+
+    def __init__(self, root: str | None = None, *, run_id: str | None = None) -> None:
+        self.root = default_root() if root is None else root
+        # the random suffix keeps two runs started in the same second (same
+        # service process) from sharing a dir and overwriting each other
+        self.run_id = run_id or (
+            f"run-{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        )
+        self.recorded = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.root)
+
+    @property
+    def run_dir(self) -> Path:
+        return Path(self.root) / self.run_id
+
+    def record(
+        self,
+        *,
+        stage_name: str,
+        batch_id: int,
+        tasks: list,
+        attempts: int,
+        worker_deaths: int,
+        reason: str,
+        error: str = "",
+        payload_errors: list[str] | None = None,
+    ) -> Path | None:
+        """Persist one dead batch; returns its directory (None = disabled
+        or failed — the caller's drop proceeds regardless)."""
+        if not self.enabled:
+            return None
+        import cloudpickle
+
+        # stage names are arbitrary user strings; path separators (or any
+        # exotic char) must not nest/escape the entry dir and break the CLI
+        safe_stage = re.sub(r"[^A-Za-z0-9._-]", "_", stage_name) or "stage"
+        entry = self.run_dir / f"batch-{batch_id}-{safe_stage}"
+        try:
+            entry.mkdir(parents=True, exist_ok=True)
+            with open(entry / "tasks.pkl", "wb") as f:
+                f.write(cloudpickle.dumps(tasks))
+            meta = {
+                "stage": stage_name,
+                "batch_id": batch_id,
+                "num_tasks": len(tasks),
+                "attempts": attempts,
+                "worker_deaths": worker_deaths,
+                "reason": reason,
+                "error_tail": error[-_ERROR_TAIL:] if error else "",
+                "dropped_at": time.time(),
+                "run_id": self.run_id,
+            }
+            if payload_errors:
+                # some payloads could not be materialized (e.g. their owner
+                # node died): the entry is partial, and says so
+                meta["payload_errors"] = payload_errors
+            (entry / "meta.json").write_text(json.dumps(meta, indent=2))
+        except Exception:
+            logger.exception(
+                "DLQ write failed for stage %s batch %d (dropping without record)",
+                stage_name, batch_id,
+            )
+            return None
+        self.recorded += 1
+        logger.error(
+            "stage %s batch %d dead-lettered to %s (%d tasks)",
+            stage_name, batch_id, entry, len(tasks),
+        )
+        return entry
+
+
+def list_entries(root: str | None = None, *, run_id: str | None = None) -> list[DlqEntry]:
+    """All entries under ``root`` (newest run first), or one run's."""
+    base = Path(default_root() if root is None else root)
+    if not base.is_dir():
+        return []
+    runs = (
+        [base / run_id]
+        if run_id
+        else sorted((p for p in base.iterdir() if p.is_dir()), reverse=True)
+    )
+    out: list[DlqEntry] = []
+    for run in runs:
+        if not run.is_dir():
+            continue
+        for entry in sorted(p for p in run.iterdir() if p.is_dir()):
+            meta_path = entry / "meta.json"
+            try:
+                meta = json.loads(meta_path.read_text())
+            except (OSError, ValueError):
+                meta = {"stage": "?", "batch_id": -1, "error_tail": "unreadable meta.json"}
+            out.append(DlqEntry(path=entry, meta=meta))
+    return out
+
+
+def find_entry(entry_id: str, root: str | None = None) -> DlqEntry:
+    """Resolve ``<run_id>/<batch-dir>`` (or a unique suffix of it)."""
+    entries = list_entries(root)
+    exact = [e for e in entries if e.entry_id == entry_id]
+    if not exact:
+        exact = [e for e in entries if e.entry_id.endswith(entry_id)]
+    if not exact:
+        raise FileNotFoundError(f"no DLQ entry matching {entry_id!r}")
+    if len(exact) > 1:
+        raise ValueError(
+            f"{entry_id!r} is ambiguous: "
+            + ", ".join(e.entry_id for e in exact[:5])
+        )
+    return exact[0]

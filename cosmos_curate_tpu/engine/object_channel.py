@@ -31,6 +31,7 @@ import struct
 import threading
 from typing import Iterator
 
+from cosmos_curate_tpu import chaos
 from cosmos_curate_tpu.engine import object_store
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -108,6 +109,9 @@ class ObjectServer:
                 pass
 
     def _serve_get(self, sock: socket.socket, name, nonce: bytes) -> None:
+        # kind=error: the connection resets before any bytes are served —
+        # consumers see a dropped transfer, exactly like a mid-GET peer death
+        chaos.fire(chaos.SITE_OBJECT_CHANNEL_SERVE)
         if not isinstance(name, str) or not object_store.valid_segment_name(name):
             sock.sendall(_DENIED + struct.pack(">Q", 0))
             return
@@ -146,6 +150,9 @@ def _open_get(
 ) -> tuple[socket.socket, int, "Iterator[bytes]"]:
     from cosmos_curate_tpu.engine.remote_plane import send_msg
 
+    # kind=error: the dial/transfer fails as a ConnectionError, flowing
+    # through the same localize/fetch retry paths a real drop would
+    chaos.fire(chaos.SITE_OBJECT_CHANNEL_FETCH)
     nonce = os.urandom(16)
     sock = socket.create_connection(addr, timeout=30)
     try:

@@ -117,7 +117,13 @@ class BasePool:
 
     def submit(self, w: WorkerHandle, batch_id: int, refs: list) -> None:
         w.busy_batch = batch_id
-        w.in_q.put(ProcessMsg(batch_id=batch_id, refs=refs))
+        w.in_q.put(
+            ProcessMsg(
+                batch_id=batch_id,
+                refs=refs,
+                timeout_s=self.spec.batch_timeout_s or 0.0,
+            )
+        )
 
     def reap_draining(self, *, force_after_s: float = 5.0) -> None:
         """Non-blocking cleanup of workers previously told to stop."""
@@ -164,6 +170,12 @@ def _base_worker_env() -> dict[str, str]:
 
     if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
         env["CURATE_TRACING"] = "1"
+    from cosmos_curate_tpu import chaos
+
+    if os.environ.get(chaos.CHAOS_ENV):
+        # fault plans follow workers: chaos tests arm crash/hang sites that
+        # live inside the spawned worker's task loop
+        env[chaos.CHAOS_ENV] = os.environ[chaos.CHAOS_ENV]
     return env
 
 

@@ -89,6 +89,11 @@ class NodeAgent:
         # input refs (this node's earlier outputs) are NOT tracked here —
         # the driver releases those via ReleaseObjects.
         self.inflight: dict[tuple[str, int], list] = {}
+        # (worker_key, batch_id) -> monotonic deadline (SubmitBatch.timeout_s
+        # > 0): the watchdog kills workers whose batch outlives it — hang
+        # detection for the driver's batch_timeout_s on REMOTE workers.
+        # Guarded by self._lock like inflight.
+        self.deadlines: dict[tuple[str, int], float] = {}
         self.results_q: mp.Queue = _MP.Queue()
         self._stop = threading.Event()
         # serves THIS node's segments to the driver and peer agents
@@ -139,6 +144,7 @@ class NodeAgent:
         with self._lock:
             self.workers.clear()
             self.inflight.clear()
+            self.deadlines.clear()
         deadline = time.monotonic() + connect_timeout_s
         while True:  # the driver may come up after the agents (srun races)
             try:
@@ -273,6 +279,12 @@ class NodeAgent:
                 alive = msg.worker_key in self.workers
                 if alive:
                     self.inflight[(msg.worker_key, msg.batch_id)] = fetched
+                    if getattr(msg, "timeout_s", 0.0) > 0:
+                        # the deadline starts AFTER the input fetch (which
+                        # can take seconds and is not the worker's fault)
+                        self.deadlines[(msg.worker_key, msg.batch_id)] = (
+                            time.monotonic() + msg.timeout_s
+                        )
             if not alive:
                 # WorkerDied was already reported; the driver requeues the
                 # batch — just free this attempt's local copies
@@ -332,6 +344,7 @@ class NodeAgent:
     def _release_inflight(self, worker_key: str, batch_id: int) -> None:
         with self._lock:
             refs = self.inflight.pop((worker_key, batch_id), [])
+            self.deadlines.pop((worker_key, batch_id), None)
         for r in refs:
             try:
                 object_store.delete(r)
@@ -381,9 +394,37 @@ class NodeAgent:
     def _watchdog(self, stop: threading.Event) -> None:
         """Detect remote worker PROCESS deaths (the driver can only see the
         link): report WorkerDied so the driver's reap requeues the batch,
-        and free the dead worker's in-flight input segments."""
+        and free the dead worker's in-flight input segments. Also enforces
+        per-batch deadlines (SubmitBatch.timeout_s): a worker whose batch
+        outlives its deadline is presumed hung, killed, and reported
+        through the same WorkerDied path as a real death."""
         while not stop.is_set():
             time.sleep(1.0)
+            now = time.monotonic()
+            with self._lock:
+                expired = [k for k, d in self.deadlines.items() if now >= d]
+            for key, batch_id in expired:
+                with self._lock:
+                    entry = self.workers.pop(key, None)
+                    self.deadlines.pop((key, batch_id), None)
+                if entry is None:
+                    continue  # already reaped as a death
+                logger.warning(
+                    "worker %s batch %d exceeded its deadline on agent; "
+                    "killing hung worker", key, batch_id,
+                )
+                try:
+                    entry[1].kill()  # SIGKILL: hung code may ignore SIGTERM
+                    entry[1].join(timeout=2.0)
+                except (OSError, AttributeError):
+                    logger.debug("kill failed for %s", key, exc_info=True)
+                for wkey, b_id in list(self.inflight):
+                    if wkey == key:
+                        self._release_inflight(wkey, b_id)
+                try:
+                    self._send(WorkerDied(key))
+                except OSError:
+                    return
             for key, (_in_q, proc) in list(self.workers.items()):
                 if proc.is_alive():
                     continue
@@ -405,6 +446,9 @@ def main(argv=None) -> int:
     ap.add_argument("--node-id", default=None)
     ap.add_argument("--num-cpus", type=float, default=None)
     args = ap.parse_args(argv)
+    from cosmos_curate_tpu import chaos
+
+    chaos.install_from_env()  # soak rigs arm agent-side faults via env
     return NodeAgent(args.driver, node_id=args.node_id, num_cpus=args.num_cpus).run()
 
 

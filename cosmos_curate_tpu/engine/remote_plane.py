@@ -41,6 +41,7 @@ from typing import Any, Callable
 
 import cloudpickle
 
+from cosmos_curate_tpu import chaos
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -94,6 +95,11 @@ class SubmitBatch:
     worker_key: str
     batch_id: int
     refs: list  # list[RefSpec]
+    # StageSpec.batch_timeout_s; 0 = no deadline. The AGENT's watchdog
+    # enforces it (the driver cannot signal a process on another host):
+    # an expired worker is killed and reported as WorkerDied, and the
+    # driver's normal reap requeues the batch.
+    timeout_s: float = 0.0
 
 
 @dataclass
@@ -310,6 +316,9 @@ class SecureChannel:
         self.bytes_received = 0
 
     def send(self, msg: Any) -> None:
+        # kind=error: the control link drops mid-send (InjectedFault is a
+        # ConnectionError, so the agent/driver reconnect paths engage)
+        chaos.fire(chaos.SITE_REMOTE_PLANE_SEND)
         with self._lock:
             self.bytes_sent += send_frame(
                 self.sock, self._token, self.sid, self._send_dir, self._send_seq, msg
@@ -317,6 +326,7 @@ class SecureChannel:
             self._send_seq += 1
 
     def recv(self, *, max_bytes: int = MAX_FRAME_BYTES) -> Any:
+        chaos.fire(chaos.SITE_REMOTE_PLANE_RECV)  # kind=error: link reset
         meta, payload = recv_msg_raw(self.sock, self._token, max_bytes=max_bytes)
         self.bytes_received += len(meta) + len(payload) + 44
         sid, direction, seq = _unpack_meta(meta)
@@ -546,7 +556,12 @@ class RemoteWorkerManager:
             # refs only — no payloads on the driver socket. The consumer
             # agent pulls each segment straight from its owner (this node's
             # ObjectServer, or a peer agent's) over the object channel.
-            agent.send(SubmitBatch(key, msg.batch_id, [self._spec_for(r) for r in msg.refs]))
+            agent.send(
+                SubmitBatch(
+                    key, msg.batch_id, [self._spec_for(r) for r in msg.refs],
+                    timeout_s=msg.timeout_s,
+                )
+            )
 
     def _spec_for(self, ref) -> RefSpec:
         with self._lock:

@@ -44,6 +44,11 @@ class _Batch:
     # counts exceptions only, stage_interface.py:197; Ray reschedules on
     # actor death). A cap still bounds poison batches that kill workers.
     worker_deaths: int = 0
+    # set at dispatch: which worker holds the batch, and (when the stage
+    # declares batch_timeout_s) the monotonic instant after which that
+    # worker is presumed hung and killed
+    worker_id: str = ""
+    deadline: float | None = None
 
 
 # A batch survives this many worker/node deaths before being dropped
@@ -61,6 +66,7 @@ class _StageState:
     dispatched: int = 0
     completed: int = 0
     errored_batches: int = 0
+    dead_lettered: int = 0  # dropped batches persisted to the DLQ
 
     def queue_limit(self, lower: int, mult: float) -> int:
         return max(lower, int(mult * max(1, self.pool.num_workers())))
@@ -73,6 +79,9 @@ class StreamingRunner(RunnerInterface):
         self._remote_mgr = None
         self._fetch_pool = None
         self._final_fetches: list = []
+        # run-scoped dead-letter queue (engine/dead_letter.py); created per
+        # run() so batch mode's stage-by-stage sub-runs share one run dir
+        self.dlq = None
         # stage name -> summed worker busy seconds (MFU accounting; the
         # sequential runner exposes the same attribute with wall time)
         self.stage_times: dict[str, float] = {}
@@ -81,6 +90,9 @@ class StreamingRunner(RunnerInterface):
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
         if not spec.stages:
             return list(spec.input_data) if spec.config.return_last_stage_outputs else None
+        from cosmos_curate_tpu.engine.dead_letter import DeadLetterQueue
+
+        self.dlq = DeadLetterQueue()  # lazy: writes nothing unless a drop happens
         if spec.config.execution_mode is ExecutionMode.BATCH:
             return self._run_batch(spec)
         return self._run_streaming(spec, spec.stages)
@@ -234,13 +246,17 @@ class StreamingRunner(RunnerInterface):
                         stx.retry_queue.appendleft(lb)
                     else:
                         _retry_or_drop(
-                            stx, lb, store, f"localizing inputs failed: {err}"
+                            stx, lb, store, f"localizing inputs failed: {err}",
+                            dead_letter=self._dead_letter,
                         )
                 if pending_setup_errors:
                     raise RuntimeError(
                         "stage worker setup failed:\n" + "\n".join(pending_setup_errors)
                     )
-                # 2. detect dead workers; reap draining ones (non-blocking)
+                # 2. detect dead workers; reap draining ones (non-blocking).
+                # 2a first kills workers whose batch blew its deadline, so
+                # the very next reap pass requeues the batch.
+                progressed |= self._expire_hung_batches(states, batches)
                 progressed |= self._reap_dead_workers(states, batches, store)
                 for st in states:
                     if isinstance(st.pool, ProcessPool):
@@ -297,6 +313,11 @@ class StreamingRunner(RunnerInterface):
                             )
                             progressed = True
                             continue
+                        batch.worker_id = w.worker_id
+                        timeout = st.spec.batch_timeout_s
+                        batch.deadline = (
+                            time.monotonic() + timeout if timeout else None
+                        )
                         batches[batch.batch_id] = batch
                         st.pool.submit(w, batch.batch_id, batch.refs)
                         st.dispatched += 1
@@ -336,6 +357,7 @@ class StreamingRunner(RunnerInterface):
                             _retry_or_drop(
                                 stx, fb, store,
                                 f"final outputs lost with their owner: {e}",
+                                dead_letter=self._dead_letter,
                             )
                             continue
                         stx.completed += 1  # settled: count the logical batch
@@ -360,13 +382,22 @@ class StreamingRunner(RunnerInterface):
                     "dispatched": st.dispatched,
                     "completed": st.completed,
                     "errored": st.errored_batches,
+                    "dead_lettered": st.dead_lettered,
                 }
                 for st in states
             }
             for name, c in self.stage_counts.items():
                 logger.info(
-                    "stage %s: %d dispatched, %d completed, %d errored",
+                    "stage %s: %d dispatched, %d completed, %d errored, "
+                    "%d dead-lettered",
                     name, c["dispatched"], c["completed"], c["errored"],
+                    c["dead_lettered"],
+                )
+            if self.dlq is not None and self.dlq.recorded:
+                logger.error(
+                    "%d dropped batch(es) persisted to the dead-letter queue: "
+                    "%s — inspect with `cosmos-curate-tpu dlq list`",
+                    self.dlq.recorded, self.dlq.run_dir,
                 )
             return outputs if cfg.return_last_stage_outputs else None
         finally:
@@ -508,6 +539,12 @@ class StreamingRunner(RunnerInterface):
                     st.spec.name, batch.batch_id, len(batch.refs), _tail(msg.error),
                 )
                 st.errored_batches += 1
+                # persist BEFORE releasing the refs (the payloads die with them)
+                self._dead_letter(
+                    st, batch,
+                    reason=f"num_run_attempts ({st.spec.num_run_attempts}) exhausted",
+                    error=msg.error,
+                )
                 for r in batch.refs:
                     store.release(r)
             return
@@ -564,6 +601,80 @@ class StreamingRunner(RunnerInterface):
 
     _MAX_SETUP_DEATHS = 3
 
+    def _expire_hung_batches(self, states, batches) -> bool:
+        """Hung-batch deadlines: a batch past its ``batch_timeout_s`` means
+        its worker is presumed deadlocked (stuck decoder, wedged socket) —
+        it will never return on its own, so the worker is SIGKILLed and the
+        normal dead-worker reap requeues the batch under the worker-death
+        budget. Local process workers only: remote ones are killed by their
+        node agent's watchdog (the driver can't signal across hosts), and
+        in-process TPU worker threads cannot be killed at all."""
+        now = time.monotonic()
+        progressed = False
+        for batch in batches.values():
+            if batch.deadline is None or now < batch.deadline:
+                continue
+            st = states[batch.stage_idx]
+            timeout = st.spec.batch_timeout_s or 0.0
+            # whatever happens below happens once per dispatch: the retry
+            # (if any) re-arms the deadline at its own dispatch time
+            batch.deadline = None
+            w = st.pool.workers.get(batch.worker_id)
+            if w is None or w.busy_batch != batch.batch_id:
+                continue  # worker already died/recycled; reap handles it
+            proc = w.proc
+            if proc is None:
+                logger.error(
+                    "stage %s batch %d exceeded batch_timeout_s=%.1fs on "
+                    "in-process worker %s; threads cannot be killed — waiting",
+                    st.spec.name, batch.batch_id, timeout, w.worker_id,
+                )
+                continue
+            if getattr(proc, "_agent", None) is not None:
+                continue  # agent watchdog owns remote deadlines
+            logger.warning(
+                "stage %s batch %d exceeded batch_timeout_s=%.1fs; killing "
+                "hung worker %s",
+                st.spec.name, batch.batch_id, timeout, w.worker_id,
+            )
+            self.metrics.observe_error(st.spec.name)
+            try:
+                proc.kill()  # SIGKILL: a hung worker may ignore SIGTERM
+            except (OSError, AttributeError):
+                logger.debug("kill failed for %s", w.worker_id, exc_info=True)
+            progressed = True
+        return progressed
+
+    def _dead_letter(self, stx, batch: _Batch, *, reason: str, error: str = "") -> None:
+        """Persist a permanently-dropped batch's payloads + metadata to the
+        DLQ. Must run BEFORE the batch's refs are released. Never raises —
+        DLQ failure degrades to the old log-only drop."""
+        dlq = self.dlq
+        if dlq is None or not dlq.enabled:
+            return
+        fetch = (
+            self._remote_mgr.fetch_value_if_remote
+            if self._remote_mgr is not None
+            else object_store.get
+        )
+        tasks, errs = [], []
+        for r in batch.refs:
+            try:
+                tasks.append(fetch(r))
+            except Exception as e:  # partial entries beat no entries
+                errs.append(f"{r.shm_name}: {e}")
+        if dlq.record(
+            stage_name=stx.spec.name,
+            batch_id=batch.batch_id,
+            tasks=tasks,
+            attempts=batch.attempts,
+            worker_deaths=batch.worker_deaths,
+            reason=reason,
+            error=error,
+            payload_errors=errs or None,
+        ):
+            stx.dead_lettered += 1
+
     def _reap_dead_workers(self, states, batches, store) -> bool:
         progressed = False
         for st in states:
@@ -596,6 +707,7 @@ class StreamingRunner(RunnerInterface):
                         _retry_or_drop(
                             st, batch, store,
                             f"worker {w.worker_id} died processing it (poison batch?)",
+                            dead_letter=self._dead_letter,
                         )
                     st.pool.start_worker()
                     progressed = True
@@ -650,10 +762,12 @@ class StreamingRunner(RunnerInterface):
             return 1
 
 
-def _retry_or_drop(stx, batch: _Batch, store, reason: str) -> None:
+def _retry_or_drop(stx, batch: _Batch, store, reason: str, *, dead_letter=None) -> None:
     """Infra-failure disposition shared by the localize, final-fetch and
-    (semantically) reaper paths: budget the failure against the batch's
-    worker-death cap; requeue under budget, else drop LOUDLY and release."""
+    reaper paths: budget the failure against the batch's worker-death cap;
+    requeue under budget, else drop LOUDLY — persisting the batch to the
+    dead-letter queue first (``dead_letter`` is the runner's recorder) so
+    the drop is recoverable, then release the refs."""
     batch.worker_deaths += 1
     if batch.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
         logger.warning(
@@ -667,6 +781,8 @@ def _retry_or_drop(stx, batch: _Batch, store, reason: str) -> None:
         batch.batch_id, batch.worker_deaths, reason, len(batch.refs),
     )
     stx.errored_batches += 1
+    if dead_letter is not None:
+        dead_letter(stx, batch, reason=reason)
     for r in batch.refs:
         store.release(r)
 

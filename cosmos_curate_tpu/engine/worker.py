@@ -22,6 +22,7 @@ from typing import Any
 
 import cloudpickle
 
+from cosmos_curate_tpu import chaos
 from cosmos_curate_tpu.engine import object_store
 
 
@@ -38,6 +39,11 @@ class SetupMsg:
 class ProcessMsg:
     batch_id: int
     refs: list[object_store.ObjectRef]
+    # per-batch execution deadline (StageSpec.batch_timeout_s); 0 = none.
+    # Enforced by whoever can kill the worker — the runner locally, the
+    # node agent's watchdog remotely — never by the worker itself (a hung
+    # worker can't run its own timer).
+    timeout_s: float = 0.0
 
 
 @dataclass
@@ -67,6 +73,9 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
     from cosmos_curate_tpu.observability.tracing import setup_tracing_from_env, traced_span
 
     setup_tracing_from_env()
+    # arm fault injection once at bring-up; per-batch cost while disarmed is
+    # a single falsy check inside chaos.fire()
+    chaos.install_from_env()
     stage = None
     meta = None
     worker_id = env.get("CURATE_WORKER_ID", "worker-?")
@@ -108,6 +117,9 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
                         os.environ.update(msg.env)
                         worker_id = msg.env.get("CURATE_WORKER_ID", worker_id)
                         setup_tracing_from_env()
+                        # adopted prewarm spare: the adopter's env may arm
+                        # chaos that the generic spare was spawned without
+                        chaos.install_from_env()
                     stage = cloudpickle.loads(msg.stage_pickle)
                     meta = cloudpickle.loads(msg.worker_meta_pickle)
                     stage.setup_on_node(meta.node, meta)
@@ -124,6 +136,8 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
                 continue
             t0 = time.monotonic()
             try:
+                chaos.fire(chaos.SITE_WORKER_CRASH)  # kind=crash: os._exit
+                chaos.fire(chaos.SITE_WORKER_HANG)  # kind=hang: stuck batch
                 with traced_span(
                     f"stage.{type(stage).__name__}.process", batch_size=len(tasks)
                 ):

@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 
+from cosmos_curate_tpu.storage.retry import sleep_backoff
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -677,7 +678,7 @@ class PostgresAVStateDB(_GenericTablesMixin):
                 if e.fields.get("C") not in self._TRANSIENT_SQLSTATES:
                     raise
                 last = e
-            time.sleep(min(0.2 * 2**attempt, 2.0))
+            sleep_backoff(attempt, base=0.2, cap=2.0)
         raise last  # type: ignore[misc]
 
     def _retry_execute(self, sql: str, params: tuple = ()):

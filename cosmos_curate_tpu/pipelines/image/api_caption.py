@@ -14,13 +14,13 @@ from __future__ import annotations
 
 import base64
 import json
-import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.pipelines.image.annotate import ImageTask
+from cosmos_curate_tpu.storage.retry import sleep_backoff
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -118,7 +118,7 @@ class ImageApiCaptionStage(Stage[ImageTask, ImageTask]):
             ) as e:
                 last = e
             if attempt + 1 < self.max_retries:
-                time.sleep(min(2.0**attempt * 0.2, 5.0))
+                sleep_backoff(attempt)
         task.errors["api_caption"] = repr(last)
 
     def process_data(self, tasks: list[ImageTask]) -> list[ImageTask]:

@@ -24,7 +24,6 @@ Endpoint resolution: explicit ``endpoint_url`` (config or
 from __future__ import annotations
 
 import base64
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -33,6 +32,11 @@ from typing import Iterator
 
 from cosmos_curate_tpu.storage.azure_shared_key import AzureCredentials, sign_request
 from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+from cosmos_curate_tpu.storage.retry import (
+    chaos_storage_fault,
+    is_retryable_status,
+    sleep_backoff,
+)
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -153,11 +157,12 @@ class AzureRestClient(StorageClient):
             for k, v in headers.items():
                 req.add_header(k, v)
             try:
+                chaos_storage_fault()
                 with urllib.request.urlopen(req, timeout=120) as resp:
                     return resp.status, resp.read(), dict(resp.headers)
             except urllib.error.HTTPError as e:
                 body = e.read()
-                if e.code in (500, 502, 503, 504) and retryable and attempt + 1 < _RETRIES:
+                if is_retryable_status(e.code) and retryable and attempt + 1 < _RETRIES:
                     last = e
                 else:
                     return e.code, body, dict(e.headers or {})
@@ -165,7 +170,7 @@ class AzureRestClient(StorageClient):
                 if not retryable or attempt + 1 == _RETRIES:
                     raise
                 last = e
-            time.sleep(min(2.0**attempt * 0.2, 5.0))
+            sleep_backoff(attempt)
         raise RuntimeError(f"Azure {context or method} exhausted retries: {last}")
 
     # -- StorageClient -----------------------------------------------------

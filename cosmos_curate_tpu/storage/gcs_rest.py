@@ -14,13 +14,17 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterator
 
 from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+from cosmos_curate_tpu.storage.retry import (
+    chaos_storage_fault,
+    is_retryable_status,
+    sleep_backoff,
+)
 
 _RETRIES = 4
 
@@ -77,11 +81,12 @@ class GcsRestClient(StorageClient):
             if data:
                 req.add_header("content-type", content_type)
             try:
+                chaos_storage_fault()
                 with urllib.request.urlopen(req, timeout=120) as resp:
                     return resp.status, resp.read()
             except urllib.error.HTTPError as e:
                 body = e.read()
-                if e.code in (429, 500, 502, 503, 504) and attempt + 1 < _RETRIES:
+                if is_retryable_status(e.code) and attempt + 1 < _RETRIES:
                     last = e
                 else:
                     return e.code, body
@@ -89,7 +94,7 @@ class GcsRestClient(StorageClient):
                 if attempt + 1 == _RETRIES:
                     raise
                 last = e
-            time.sleep(min(2.0**attempt * 0.2, 5.0))
+            sleep_backoff(attempt)
         raise RuntimeError(f"GCS {context or method} exhausted retries: {last}")
 
     def _obj_url(self, bucket: str, key: str, **params: str) -> str:

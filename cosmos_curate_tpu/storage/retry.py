@@ -1,0 +1,71 @@
+"""Shared HTTP retry policy for the storage backends.
+
+One place for the backoff schedule and the retryable-status set that were
+previously copy-pasted across ``s3_rest.py``, ``azure_rest.py``,
+``gcs_rest.py`` and ``zip_transport.py`` (each with drifting behavior:
+S3/Azure failed fast on HTTP 429 — the one status that explicitly asks
+for a retry).
+
+Backoff is exponential with **full jitter** (AWS architecture-blog
+recipe): ``sleep ~ U(0, min(cap, base * 2**attempt))``. Without jitter a
+fleet of workers that all saw the same outage retries in lockstep and
+re-creates the thundering herd every ``base * 2**k`` seconds; full jitter
+spreads the herd across the whole window.
+
+The chaos harness's ``storage.request`` site lives in
+:func:`chaos_storage_fault` so every backend inherits fault injection by
+calling it at the top of its request attempt loop (a no-op single check
+when chaos is disarmed).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from cosmos_curate_tpu import chaos
+
+# 429 (throttling) and the transient 5xx family. 501/505 etc. are
+# deterministic and excluded — retrying them only delays the error.
+RETRYABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+
+DEFAULT_BASE_S = 0.2
+DEFAULT_CAP_S = 5.0
+
+
+def is_retryable_status(status: int) -> bool:
+    return status in RETRYABLE_STATUSES
+
+
+def backoff_s(
+    attempt: int,
+    *,
+    base: float = DEFAULT_BASE_S,
+    cap: float = DEFAULT_CAP_S,
+    rng: random.Random | None = None,
+) -> float:
+    """Full-jitter backoff for the ``attempt``-th failure (0-based)."""
+    ceiling = min(cap, base * (2.0**attempt))
+    return (rng or random).uniform(0.0, ceiling)
+
+
+def sleep_backoff(
+    attempt: int,
+    *,
+    base: float = DEFAULT_BASE_S,
+    cap: float = DEFAULT_CAP_S,
+    rng: random.Random | None = None,
+) -> float:
+    """Sleep the jittered backoff; returns the slept duration (for logs)."""
+    d = backoff_s(attempt, base=base, cap=cap, rng=rng)
+    time.sleep(d)
+    return d
+
+
+def chaos_storage_fault() -> None:
+    """The storage backends' shared injection site: an armed
+    ``storage.request`` rule raises :class:`~cosmos_curate_tpu.chaos.InjectedFault`
+    (a ``ConnectionError``), which the callers' attempt loops treat exactly
+    like a real network failure/timeout — retried with backoff, surfaced
+    after the budget."""
+    chaos.fire(chaos.SITE_STORAGE_REQUEST)

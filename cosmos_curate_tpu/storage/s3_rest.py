@@ -17,7 +17,6 @@ region.
 from __future__ import annotations
 
 import os
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -25,6 +24,11 @@ import xml.etree.ElementTree as ET
 from typing import Iterator
 
 from cosmos_curate_tpu.storage.client import ObjectInfo, StorageClient
+from cosmos_curate_tpu.storage.retry import (
+    chaos_storage_fault,
+    is_retryable_status,
+    sleep_backoff,
+)
 from cosmos_curate_tpu.storage.sigv4 import Credentials, payload_hash, sign_request
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -131,11 +135,12 @@ class S3RestClient(StorageClient):
                 if k != "host":
                     req.add_header(k, v)
             try:
+                chaos_storage_fault()
                 with urllib.request.urlopen(req, timeout=120) as resp:
                     return resp.status, resp.read(), dict(resp.headers)
             except urllib.error.HTTPError as e:
                 body = e.read()
-                if e.code in (500, 502, 503, 504) and retryable and attempt + 1 < _RETRIES:
+                if is_retryable_status(e.code) and retryable and attempt + 1 < _RETRIES:
                     last = e
                 else:
                     return e.code, body, dict(e.headers or {})
@@ -143,7 +148,7 @@ class S3RestClient(StorageClient):
                 if not retryable or attempt + 1 == _RETRIES:
                     raise
                 last = e
-            time.sleep(min(2.0**attempt * 0.2, 5.0))
+            sleep_backoff(attempt)
         raise RuntimeError(f"S3 {context or method} exhausted retries: {last}")
 
     # -- StorageClient -----------------------------------------------------

@@ -18,12 +18,12 @@ from __future__ import annotations
 
 import io
 import os
-import time
 import zipfile
 from dataclasses import dataclass, field
 from pathlib import Path
 
 from cosmos_curate_tpu.storage.client import read_bytes, write_bytes
+from cosmos_curate_tpu.storage.retry import chaos_storage_fault, sleep_backoff
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -201,6 +201,7 @@ def _http_request(
     last: Exception | None = None
     for attempt in range(retries):
         try:
+            chaos_storage_fault()
             req = urllib.request.Request(url, data=data, method=method)
             if method == "PUT":
                 req.add_header("Content-Type", "application/zip")
@@ -211,7 +212,9 @@ def _http_request(
         except Exception as e:  # noqa: BLE001
             last = e
             if attempt + 1 < retries:
-                time.sleep(min(2**attempt, 8))
+                # keep this transport's slower schedule (presigned uploads
+                # are long calls), now with full jitter like the rest
+                sleep_backoff(attempt, base=1.0, cap=8.0)
     raise RuntimeError(f"{method} {_redact(url)} failed after {retries} attempts: {last}")
 
 

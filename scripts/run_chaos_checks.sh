@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos / fault-tolerance gate: the fast chaos unit suites (also part of
+# tier-1) plus the slow end-to-end fault-injection tests that spawn real
+# worker pools (crash→requeue, hang→deadline-kill, exhausted→DLQ→requeue,
+# and the mixed-fault soak). See docs/FAULT_TOLERANCE.md.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== chaos unit suites (fast; tier-1 subset) =="
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/chaos \
+  tests/storage/test_retry.py \
+  tests/engine/test_dead_letter.py \
+  tests/analysis/test_ad_hoc_backoff.py \
+  -q -p no:randomly
+
+echo "== chaos end-to-end + soak (spawns real worker pools) =="
+# -m '' overrides the default marker filter so the @slow suites run here
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/engine/test_chaos_faults.py -q -p no:randomly -m ''
+
+echo "chaos checks passed"

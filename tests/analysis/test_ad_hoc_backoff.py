@@ -1,0 +1,111 @@
+"""ad-hoc-backoff rule: hand-rolled exponential sleeps vs the shared
+jittered helper (storage/retry.py)."""
+
+import textwrap
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.ast_lint import lint_file
+from cosmos_curate_tpu.analysis.common import LintConfig
+from cosmos_curate_tpu.analysis.rules import all_rules
+
+
+def _lint(tmp_path: Path, code: str, *, rel: str = "storage/snippet.py"):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    rules = [r for r in all_rules() if r.rule_id == "ad-hoc-backoff"]
+    return lint_file(f, LintConfig(), rules, root=tmp_path)
+
+
+def test_classic_backoff_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import time
+
+        def fetch():
+            for attempt in range(4):
+                time.sleep(min(2.0**attempt * 0.2, 5.0))
+        """,
+    )
+    assert [f.rule for f in findings] == ["ad-hoc-backoff"]
+    assert "sleep_backoff" in findings[0].message
+
+
+def test_bare_sleep_name_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        from time import sleep
+
+        def fetch(attempt):
+            sleep(2**attempt)
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_plain_sleep_not_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import time
+
+        def poll():
+            time.sleep(0.2)
+            time.sleep(1 + 2)
+        """,
+    )
+    assert findings == []
+
+
+def test_retry_helper_itself_exempt(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import time
+
+        def sleep_backoff(attempt):
+            time.sleep(2.0**attempt)
+        """,
+        rel="storage/retry.py",
+    )
+    assert findings == []
+
+
+def test_tests_exempt(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import time
+
+        def test_x(attempt):
+            time.sleep(2**attempt)
+        """,
+        rel="tests/test_x.py",
+    )
+    assert findings == []
+
+
+def test_non_time_sleep_attr_not_flagged(tmp_path):
+    # driver.sleep(2**attempt) is some other API, not a backoff sleep
+    findings = _lint(
+        tmp_path,
+        """
+        def f(driver, attempt):
+            driver.sleep(2**attempt)
+        """,
+    )
+    assert findings == []
+
+
+def test_package_is_clean():
+    """The production tree itself must carry no ad-hoc backoff loops (the
+    four seed copies were migrated to storage/retry.py)."""
+    from cosmos_curate_tpu.analysis.ast_lint import run_lint
+
+    pkg = Path(__file__).resolve().parents[2] / "cosmos_curate_tpu"
+    findings = [
+        f for f in run_lint([pkg], rule_ids=["ad-hoc-backoff"])
+    ]
+    assert findings == []

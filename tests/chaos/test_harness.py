@@ -1,0 +1,202 @@
+"""Chaos harness semantics (fast, tier-1): plan model, determinism,
+activation plumbing, and the disabled-path guarantee."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.chaos import harness
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _plan(*rules, seed=0):
+    return chaos.FaultPlan(rules=tuple(rules), seed=seed)
+
+
+class TestPlanModel:
+    def test_json_round_trip(self):
+        plan = _plan(
+            chaos.FaultRule(
+                site=chaos.SITE_WORKER_CRASH, kind="crash", probability=0.5,
+                count=3, delay_s=1.5, exit_code=9, worker_re="-p0$",
+            ),
+            chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST),
+            seed=42,
+        )
+        assert chaos.FaultPlan.from_json(plan.to_json()) == plan
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, kind="explode")
+
+    def test_bad_probability_rejected(self):
+        with pytest.raises(ValueError, match="probability"):
+            chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, probability=1.5)
+
+    def test_unknown_site_rejected_at_install(self):
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.install(_plan(chaos.FaultRule(site="no.such.site")))
+
+    def test_duplicate_site_rules_rejected_at_install(self):
+        # one armed rule per site: silently keeping only the last would
+        # make a chaos test exercise less than it claims
+        with pytest.raises(ValueError, match="duplicate rule"):
+            chaos.install(
+                _plan(
+                    chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, kind="crash"),
+                    chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, probability=0.1),
+                )
+            )
+
+    def test_site_catalogue_is_complete(self):
+        # every SITE_* constant must be registered in ALL_SITES (install
+        # validation and the docs both key off the catalogue)
+        consts = {
+            v for k, v in vars(harness).items() if k.startswith("SITE_")
+        }
+        assert consts == set(chaos.ALL_SITES)
+
+
+class TestDisabled:
+    def test_fire_is_noop_without_plan(self):
+        assert not chaos.enabled()
+        for site in chaos.ALL_SITES:
+            chaos.fire(site)  # must not raise, hang, or exit
+
+    def test_disabled_path_reads_no_env(self, monkeypatch):
+        # the no-op guarantee: fire() must not consult the environment
+        class Booby(dict):
+            def get(self, *a, **kw):  # pragma: no cover - failure path
+                raise AssertionError("fire() read os.environ while disabled")
+
+        monkeypatch.setattr(os, "environ", Booby())
+        chaos.fire(chaos.SITE_WORKER_CRASH)
+
+    def test_fire_count_zero_when_disabled(self):
+        assert chaos.fire_count(chaos.SITE_WORKER_CRASH) == 0
+
+
+class TestFiring:
+    def test_error_kind_raises_injected_fault(self):
+        chaos.install(_plan(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST)))
+        with pytest.raises(chaos.InjectedFault) as ei:
+            chaos.fire(chaos.SITE_STORAGE_REQUEST)
+        assert ei.value.site == chaos.SITE_STORAGE_REQUEST
+        assert isinstance(ei.value, ConnectionError)  # rides production handlers
+
+    def test_count_bounds_firings(self):
+        chaos.install(
+            _plan(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, count=2))
+        )
+        fired = 0
+        for _ in range(10):
+            try:
+                chaos.fire(chaos.SITE_STORAGE_REQUEST)
+            except chaos.InjectedFault:
+                fired += 1
+        assert fired == 2
+        assert chaos.fire_count(chaos.SITE_STORAGE_REQUEST) == 2
+
+    def test_unarmed_site_never_fires(self):
+        chaos.install(_plan(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST)))
+        chaos.fire(chaos.SITE_WORKER_HANG)  # different site: no-op
+
+    def test_probability_is_deterministic_per_seed(self):
+        def sequence(seed):
+            chaos.install(
+                _plan(
+                    chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, probability=0.5),
+                    seed=seed,
+                )
+            )
+            out = []
+            for _ in range(32):
+                try:
+                    chaos.fire(chaos.SITE_STORAGE_REQUEST)
+                    out.append(0)
+                except chaos.InjectedFault:
+                    out.append(1)
+            return out
+
+        a, b, c = sequence(1), sequence(1), sequence(2)
+        assert a == b  # same seed -> same fire/skip sequence
+        assert a != c  # different seed -> different sequence
+        assert 0 < sum(a) < 32  # actually probabilistic
+
+    def test_delay_kind_sleeps_then_continues(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(harness.time, "sleep", slept.append)
+        chaos.install(
+            _plan(
+                chaos.FaultRule(site=chaos.SITE_WORKER_HANG, kind="hang", delay_s=7.5)
+            )
+        )
+        chaos.fire(chaos.SITE_WORKER_HANG)  # must not raise
+        assert slept == [7.5]
+
+    def test_crash_kind_exits(self, monkeypatch):
+        codes = []
+        monkeypatch.setattr(os, "_exit", codes.append)
+        chaos.install(
+            _plan(
+                chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, kind="crash", exit_code=9)
+            )
+        )
+        chaos.fire(chaos.SITE_WORKER_CRASH)
+        assert codes == [9]
+
+    def test_worker_re_selects_processes(self, monkeypatch):
+        chaos.install(
+            _plan(
+                chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, worker_re="-p0$")
+            )
+        )
+        monkeypatch.setenv("CURATE_WORKER_ID", "s0-Stage-p1")
+        chaos.fire(chaos.SITE_STORAGE_REQUEST)  # replacement worker: no fault
+        monkeypatch.setenv("CURATE_WORKER_ID", "s0-Stage-p0")
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fire(chaos.SITE_STORAGE_REQUEST)
+
+
+class TestEnvActivation:
+    def test_install_from_env_round_trip(self, monkeypatch):
+        plan = _plan(
+            chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, count=1), seed=3
+        )
+        monkeypatch.setenv(chaos.CHAOS_ENV, plan.to_json())
+        assert chaos.install_from_env()
+        assert chaos.enabled()
+        with pytest.raises(chaos.InjectedFault):
+            chaos.fire(chaos.SITE_STORAGE_REQUEST)
+
+    def test_install_from_env_absent(self, monkeypatch):
+        monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+        assert not chaos.install_from_env()
+        assert not chaos.enabled()
+
+    def test_install_export_env(self):
+        chaos.install(
+            _plan(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST)), export_env=True
+        )
+        assert os.environ.get(chaos.CHAOS_ENV)
+        chaos.uninstall()
+        assert chaos.CHAOS_ENV not in os.environ
+
+    def test_worker_env_forwards_plan(self):
+        from cosmos_curate_tpu.engine.pool import _base_worker_env
+
+        chaos.install(
+            _plan(chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, kind="crash")),
+            export_env=True,
+        )
+        env = _base_worker_env()
+        assert chaos.FaultPlan.from_json(env[chaos.CHAOS_ENV]).rules[0].kind == "crash"

@@ -26,6 +26,15 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _dlq_in_tmp(monkeypatch, tmp_path):
+    """Point the engine's dead-letter queue at a throwaway dir: suites that
+    exercise drop paths (poison batches, chaos faults) must not accumulate
+    entries under the developer's ~/.cache. Tests that care set their own
+    CURATE_DLQ_DIR on top of this."""
+    monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "_dlq"))
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,265 @@
+"""Chaos-driven end-to-end tests for the engine's fault-tolerance layer
+(ISSUE 2 acceptance): crash→requeue, hang→deadline-kill→retry, and
+retry-budget-exhausted→DLQ→requeue.
+
+Real spawned worker pools (hence @slow, like the other engine integration
+suites); scripts/run_chaos_checks.sh runs this file explicitly. Worker ids
+are deterministic (``s<stage>-<Name>-p<n>``), so ``worker_re`` pins faults
+to the FIRST worker(s) and lets replacements survive — each scenario has
+exactly one scripted outcome, no flaky probabilities.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import pytest
+
+from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, StreamingSpec, run_pipeline
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.engine import dead_letter
+from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+
+@dataclass
+class CItem(PipelineTask):
+    value: int = 0
+    trail: list = field(default_factory=list)
+
+
+class BumpStage(Stage):
+    @property
+    def resources(self):
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks):
+        return [CItem(value=t.value + 1, trail=t.trail + ["bump"]) for t in tasks]
+
+
+def fast_config(**kw) -> PipelineConfig:
+    return PipelineConfig(
+        streaming=StreamingSpec(
+            autoscale_interval_s=3600.0, max_queued_lower_bound=4
+        ),
+        **kw,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _chaos_env(monkeypatch, tmp_path):
+    """Every test gets a clean chaos state and a throwaway DLQ root."""
+    chaos.uninstall()
+    monkeypatch.setenv(dead_letter.DLQ_DIR_ENV, str(tmp_path / "dlq"))
+    yield
+    chaos.uninstall()
+
+
+def _crash_rule(worker_re=""):
+    return chaos.FaultRule(
+        site=chaos.SITE_WORKER_CRASH, kind="crash", worker_re=worker_re
+    )
+
+
+@pytest.mark.slow
+class TestChaosEndToEnd:
+    def test_worker_crash_requeues_batch_exactly_once(self, tmp_path):
+        # p0 crashes on every batch it touches; its replacement (p1) is
+        # clean — so the killed batch is requeued exactly once and the run
+        # completes with nothing lost.
+        chaos.install(
+            chaos.FaultPlan(rules=(_crash_rule(worker_re="-p0$"),)), export_env=True
+        )
+        runner = StreamingRunner()
+        out = run_pipeline(
+            [CItem(value=i) for i in range(3)],
+            [StageSpec(BumpStage(), num_workers=1)],
+            config=fast_config(),
+            runner=runner,
+        )
+        assert sorted(t.value for t in out) == [1, 2, 3]
+        counts = runner.stage_counts["BumpStage"]
+        assert counts["completed"] == 3
+        assert counts["errored"] == 0
+        assert counts["dead_lettered"] == 0
+        # the crashed batch was dispatched twice (original + one requeue)
+        assert counts["dispatched"] == 4
+        assert not dead_letter.list_entries()  # nothing was dropped
+
+    def test_hung_worker_killed_at_deadline_and_batch_retried(self, tmp_path):
+        # p0 wedges (60 s sleep ≫ the 1.5 s deadline): the runner must kill
+        # it, charge the worker-death budget, requeue the batch and finish
+        # on the replacement worker.
+        chaos.install(
+            chaos.FaultPlan(
+                rules=(
+                    chaos.FaultRule(
+                        site=chaos.SITE_WORKER_HANG, kind="hang",
+                        delay_s=60.0, worker_re="-p0$",
+                    ),
+                )
+            ),
+            export_env=True,
+        )
+        runner = StreamingRunner()
+        t0 = time.monotonic()
+        out = run_pipeline(
+            [CItem(value=i) for i in range(3)],
+            [StageSpec(BumpStage(), num_workers=1, batch_timeout_s=1.5)],
+            config=fast_config(),
+            runner=runner,
+        )
+        elapsed = time.monotonic() - t0
+        assert sorted(t.value for t in out) == [1, 2, 3]
+        # the run finished by KILLING the hung worker, not by waiting out
+        # its 60 s sleep
+        assert elapsed < 45.0
+        counts = runner.stage_counts["BumpStage"]
+        assert counts["completed"] == 3
+        assert counts["errored"] == 0
+
+    def test_exhausted_batch_lands_in_dlq_and_requeue_round_trips(self, tmp_path):
+        # EVERY worker crashes: the batch burns its full worker-death budget
+        # and must land in the DLQ with its payloads and failure metadata —
+        # then a chaos-free re-run of the recovered tasks completes.
+        chaos.install(chaos.FaultPlan(rules=(_crash_rule(),)), export_env=True)
+        runner = StreamingRunner()
+        out = run_pipeline(
+            [CItem(value=41)],
+            [StageSpec(BumpStage(), num_workers=1)],
+            config=fast_config(),
+            runner=runner,
+        )
+        assert out == []  # the only batch was dropped...
+        counts = runner.stage_counts["BumpStage"]
+        assert counts["errored"] == 1
+        assert counts["dead_lettered"] == 1
+
+        (entry,) = dead_letter.list_entries()
+        assert entry.meta["stage"] == "BumpStage"
+        assert entry.meta["worker_deaths"] == 4  # budget (3) + the final straw
+        assert entry.meta["num_tasks"] == 1
+        assert "died processing it" in entry.meta["reason"]
+        tasks = entry.load_tasks()
+        assert [t.value for t in tasks] == [41]
+
+        # ...and is re-runnable once the fault is gone (dlq requeue)
+        chaos.uninstall()
+        entry.mark_requeued()
+        out2 = run_pipeline(
+            tasks,
+            [StageSpec(BumpStage(), num_workers=1)],
+            config=fast_config(),
+            runner=StreamingRunner(),
+        )
+        assert [t.value for t in out2] == [42]
+        assert dead_letter.list_entries()[0].meta["requeued_at"]
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_soak_crash_and_hang_together(self, tmp_path):
+        """Longer mixed-fault run: the first worker crashes, the second
+        wedges and is deadline-killed, and the full input set still comes
+        out the other end."""
+        chaos.install(
+            chaos.FaultPlan(
+                rules=(
+                    chaos.FaultRule(
+                        site=chaos.SITE_WORKER_CRASH, kind="crash",
+                        count=1, worker_re="-p0$",
+                    ),
+                    chaos.FaultRule(
+                        site=chaos.SITE_WORKER_HANG, kind="hang",
+                        delay_s=60.0, count=1, worker_re="-p1$",
+                    ),
+                )
+            ),
+            export_env=True,
+        )
+        runner = StreamingRunner()
+        n = 24
+        out = run_pipeline(
+            [CItem(value=i) for i in range(n)],
+            [StageSpec(BumpStage(), num_workers=2, batch_timeout_s=2.0)],
+            config=fast_config(),
+            runner=runner,
+        )
+        assert sorted(t.value for t in out) == list(range(1, n + 1))
+        counts = runner.stage_counts["BumpStage"]
+        assert counts["completed"] == n
+        assert counts["errored"] == 0
+        assert counts["dead_lettered"] == 0
+
+
+class TestAgentDeadlineWatchdog:
+    def test_agent_kills_worker_past_deadline(self, monkeypatch):
+        """remote_agent hang detection: a worker whose batch outlives its
+        SubmitBatch deadline is killed and reported as WorkerDied (unit
+        level — no driver socket; the watchdog thread runs for real)."""
+        import multiprocessing as mp
+        import threading
+
+        from cosmos_curate_tpu.engine.remote_agent import NodeAgent
+        from cosmos_curate_tpu.engine.remote_plane import WorkerDied
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "test-secret")
+        agent = NodeAgent("127.0.0.1:1", node_id="test-node")
+        try:
+            proc = mp.get_context("spawn").Process(target=time.sleep, args=(60,))
+            proc.start()
+            sent: list = []
+            monkeypatch.setattr(agent, "_send", sent.append)
+            with agent._lock:
+                agent.workers["w-hung"] = (None, proc)
+                agent.inflight[("w-hung", 5)] = []
+                agent.deadlines[("w-hung", 5)] = time.monotonic() - 0.1
+            stop = threading.Event()
+            t = threading.Thread(target=agent._watchdog, args=(stop,), daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not sent:
+                time.sleep(0.05)
+            stop.set()
+            assert sent and isinstance(sent[0], WorkerDied)
+            assert sent[0].worker_key == "w-hung"
+            proc.join(timeout=5.0)
+            assert not proc.is_alive()  # actually killed, not just reported
+            assert "w-hung" not in agent.workers
+            assert ("w-hung", 5) not in agent.deadlines
+            assert ("w-hung", 5) not in agent.inflight
+        finally:
+            agent.object_server.close()
+
+    def test_submit_batch_records_deadline_after_fetch(self, monkeypatch):
+        """The deadline clock starts when the worker gets the batch, not
+        when the fetch of remote inputs begins."""
+        import queue as _q
+
+        from cosmos_curate_tpu.engine.remote_agent import NodeAgent
+        from cosmos_curate_tpu.engine.remote_plane import SubmitBatch
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "test-secret")
+        agent = NodeAgent("127.0.0.1:1", node_id="test-node")
+        try:
+            in_q: _q.Queue = _q.Queue()
+
+            class _AliveProc:
+                def is_alive(self):
+                    return True
+
+            with agent._lock:
+                agent.workers["w1"] = (in_q, _AliveProc())
+            agent._handle(SubmitBatch("w1", 9, [], timeout_s=30.0))
+            assert ("w1", 9) in agent.deadlines
+            assert agent.deadlines[("w1", 9)] > time.monotonic() + 25.0
+            # result relay clears it
+            agent._release_inflight("w1", 9)
+            assert ("w1", 9) not in agent.deadlines
+            # no-timeout batches never arm the watchdog
+            agent._handle(SubmitBatch("w1", 10, [], timeout_s=0.0))
+            assert ("w1", 10) not in agent.deadlines
+        finally:
+            agent.object_server.close()

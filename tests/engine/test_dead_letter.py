@@ -1,0 +1,171 @@
+"""Dead-letter queue unit tests (fast, tier-1): record/list/find, the
+requeue round trip, and the CLI surface."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cosmos_curate_tpu.cli.main import main as cli_main
+from cosmos_curate_tpu.engine import dead_letter
+
+
+@pytest.fixture()
+def dlq_root(tmp_path):
+    return str(tmp_path / "dlq")
+
+
+def _record(root, *, batch_id=1, stage="StageA", tasks=None, **kw):
+    q = dead_letter.DeadLetterQueue(root, run_id="run-t")
+    kw.setdefault("attempts", 2)
+    kw.setdefault("worker_deaths", 4)
+    kw.setdefault("reason", "retry budget exhausted")
+    return q, q.record(
+        stage_name=stage, batch_id=batch_id, tasks=tasks or ["t1", "t2"], **kw
+    )
+
+
+class TestRecord:
+    def test_record_persists_tasks_and_meta(self, dlq_root):
+        q, path = _record(dlq_root, error="Traceback: boom")
+        assert path is not None and path.is_dir()
+        assert q.recorded == 1
+        (entry,) = dead_letter.list_entries(dlq_root)
+        assert entry.meta["stage"] == "StageA"
+        assert entry.meta["batch_id"] == 1
+        assert entry.meta["num_tasks"] == 2
+        assert entry.meta["attempts"] == 2
+        assert entry.meta["worker_deaths"] == 4
+        assert entry.meta["reason"] == "retry budget exhausted"
+        assert "boom" in entry.meta["error_tail"]
+        assert entry.load_tasks() == ["t1", "t2"]
+
+    def test_error_tail_is_clipped(self, dlq_root):
+        _, _ = _record(dlq_root, error="x" * 100_000)
+        (entry,) = dead_letter.list_entries(dlq_root)
+        assert len(entry.meta["error_tail"]) == dead_letter._ERROR_TAIL
+
+    def test_partial_payload_errors_recorded(self, dlq_root):
+        _record(dlq_root, payload_errors=["seg-1: owner died"])
+        (entry,) = dead_letter.list_entries(dlq_root)
+        assert entry.meta["payload_errors"] == ["seg-1: owner died"]
+
+    def test_disabled_by_empty_root(self):
+        q = dead_letter.DeadLetterQueue("", run_id="run-t")
+        assert not q.enabled
+        assert q.record(
+            stage_name="S", batch_id=0, tasks=[], attempts=1,
+            worker_deaths=0, reason="r",
+        ) is None
+        assert q.recorded == 0
+
+    def test_env_empty_disables_default_root(self, monkeypatch):
+        monkeypatch.setenv(dead_letter.DLQ_DIR_ENV, "")
+        assert dead_letter.default_root() == ""
+
+    def test_env_sets_default_root(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(dead_letter.DLQ_DIR_ENV, str(tmp_path))
+        assert dead_letter.default_root() == str(tmp_path)
+
+    def test_lazy_no_dir_until_first_record(self, dlq_root):
+        import os
+
+        q = dead_letter.DeadLetterQueue(dlq_root, run_id="run-t")
+        assert not os.path.exists(dlq_root)
+        q.record(
+            stage_name="S", batch_id=0, tasks=["x"], attempts=1,
+            worker_deaths=0, reason="r",
+        )
+        assert q.run_dir.is_dir()
+
+    def test_stage_name_is_sanitized_for_paths(self, dlq_root):
+        # stage names are arbitrary user strings: a '/' must not nest the
+        # entry a level deeper than list/show/requeue scan
+        _record(dlq_root, stage="video/decode")
+        (entry,) = dead_letter.list_entries(dlq_root)
+        assert entry.meta["stage"] == "video/decode"  # meta keeps the truth
+        assert entry.path.name == "batch-1-video_decode"
+        assert entry.load_tasks() == ["t1", "t2"]
+
+    def test_default_run_ids_are_unique_within_a_second(self):
+        ids = {dead_letter.DeadLetterQueue("x").run_id for _ in range(20)}
+        assert len(ids) == 20
+
+    def test_record_failure_degrades_to_drop(self):
+        # an unwritable root must degrade to the old log-only drop, never
+        # crash the pipeline's drop path
+        q = dead_letter.DeadLetterQueue("/proc/definitely-not-writable", run_id="r")
+        assert q.record(
+            stage_name="S", batch_id=0, tasks=["x"], attempts=1,
+            worker_deaths=0, reason="r",
+        ) is None
+        assert q.recorded == 0
+
+
+class TestLookup:
+    def test_find_entry_by_suffix(self, dlq_root):
+        _record(dlq_root, batch_id=7, stage="Enc")
+        e = dead_letter.find_entry("batch-7-Enc", dlq_root)
+        assert e.meta["batch_id"] == 7
+
+    def test_find_entry_missing(self, dlq_root):
+        with pytest.raises(FileNotFoundError):
+            dead_letter.find_entry("nope", dlq_root)
+
+    def test_find_entry_ambiguous(self, dlq_root):
+        q = dead_letter.DeadLetterQueue(dlq_root, run_id="run-t")
+        for b in (1, 11):
+            q.record(
+                stage_name="S", batch_id=b, tasks=[], attempts=1,
+                worker_deaths=0, reason="r",
+            )
+        with pytest.raises(ValueError, match="ambiguous"):
+            dead_letter.find_entry("-S", dlq_root)
+
+    def test_list_entries_empty_root(self, tmp_path):
+        assert dead_letter.list_entries(str(tmp_path / "missing")) == []
+
+    def test_mark_requeued(self, dlq_root):
+        _record(dlq_root)
+        e = dead_letter.find_entry("batch-1-StageA", dlq_root)
+        e.mark_requeued()
+        assert dead_letter.find_entry("batch-1-StageA", dlq_root).meta["requeued_at"]
+
+
+class TestCli:
+    def test_list_empty(self, dlq_root, capsys):
+        assert cli_main(["dlq", "list", "--dlq-dir", dlq_root]) == 0
+        assert "empty" in capsys.readouterr().out
+
+    def test_list_and_show(self, dlq_root, capsys):
+        _record(dlq_root, batch_id=3, stage="Enc")
+        assert cli_main(["dlq", "list", "--dlq-dir", dlq_root]) == 0
+        out = capsys.readouterr().out
+        assert "batch-3-Enc" in out and "worker_deaths=4" in out
+        assert cli_main(["dlq", "show", "batch-3-Enc", "--dlq-dir", dlq_root]) == 0
+        out = capsys.readouterr().out
+        assert "retry budget exhausted" in out and "[0] str" in out
+
+    def test_list_json(self, dlq_root, capsys):
+        _record(dlq_root)
+        assert cli_main(["dlq", "list", "--dlq-dir", dlq_root, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc[0]["stage"] == "StageA"
+
+    def test_requeue_round_trip(self, dlq_root, tmp_path, capsys):
+        import cloudpickle
+
+        _record(dlq_root, tasks=[{"v": 1}, {"v": 2}])
+        out_file = tmp_path / "requeue.pkl"
+        assert cli_main(
+            ["dlq", "requeue", "batch-1-StageA", "--dlq-dir", dlq_root,
+             "--out", str(out_file)]
+        ) == 0
+        with open(out_file, "rb") as f:
+            assert cloudpickle.loads(f.read()) == [{"v": 1}, {"v": 2}]
+        # entry is stamped so operators can tell what was already re-run
+        assert dead_letter.find_entry("batch-1-StageA", dlq_root).meta["requeued_at"]
+
+    def test_show_missing_entry(self, dlq_root, capsys):
+        assert cli_main(["dlq", "show", "ghost", "--dlq-dir", dlq_root]) == 2

@@ -26,7 +26,8 @@ class FakeAzureState:
         self.blobs: dict[tuple[str, str], bytes] = {}
         self.blocks: dict[tuple[str, str], dict[str, bytes]] = {}
         self.lock = threading.Lock()
-        self.fail_next = 0
+        self.fail_next = 0  # respond fail_status to this many requests
+        self.fail_status = 503
         self.verify_signatures = True
         self.auth_failures: list[str] = []
 
@@ -104,7 +105,7 @@ def _handler(state: FakeAzureState):
             with state.lock:
                 if state.fail_next > 0:
                     state.fail_next -= 1
-                    self._reply(503, b"server busy")
+                    self._reply(state.fail_status, b"server busy")
                     return True
             return False
 

@@ -31,7 +31,8 @@ class FakeS3State:
         self.upload_keys: dict[str, tuple[str, str]] = {}
         self.next_upload = 0
         self.lock = threading.Lock()
-        self.fail_next = 0  # respond 503 to this many requests (retry testing)
+        self.fail_next = 0  # respond fail_status to this many requests (retry testing)
+        self.fail_status = 503
         self.verify_signatures = True
         self.auth_failures: list[str] = []
 
@@ -119,7 +120,7 @@ def _handler(state: FakeS3State):
             with state.lock:
                 if state.fail_next > 0:
                     state.fail_next -= 1
-                    self.send_response(503)
+                    self.send_response(state.fail_status)
                     self.end_headers()
                     self.wfile.write(b"slow down")
                     return True

@@ -80,6 +80,14 @@ def test_retry_on_503(client, server):
     assert client.read_bytes("az://cont/retry.bin") == b"ok"
 
 
+def test_retry_on_429(client, server):
+    # throttling must be retried, not failed fast (ISSUE 2 satellite)
+    server.state.fail_status = 429
+    server.state.fail_next = 2
+    client.write_bytes("az://cont/throttle.bin", b"ok")
+    assert client.read_bytes("az://cont/throttle.bin") == b"ok"
+
+
 def test_block_list_upload(client, server, monkeypatch):
     monkeypatch.setattr(azure_rest, "BLOCK_THRESHOLD", 1024)
     monkeypatch.setattr(azure_rest, "BLOCK_CHUNK", 400)

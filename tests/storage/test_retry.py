@@ -1,0 +1,50 @@
+"""Shared retry policy (storage/retry.py): jitter bounds, status set."""
+
+from __future__ import annotations
+
+import random
+
+from cosmos_curate_tpu.storage import retry
+
+
+def test_retryable_statuses_include_throttling():
+    assert 429 in retry.RETRYABLE_STATUSES
+    for s in (500, 502, 503, 504):
+        assert retry.is_retryable_status(s)
+    for s in (200, 301, 400, 403, 404, 501):
+        assert not retry.is_retryable_status(s)
+
+
+def test_backoff_full_jitter_bounds():
+    rng = random.Random(0)
+    for attempt in range(8):
+        ceiling = min(5.0, 0.2 * 2**attempt)
+        for _ in range(50):
+            d = retry.backoff_s(attempt, rng=rng)
+            assert 0.0 <= d <= ceiling
+
+
+def test_backoff_respects_cap():
+    rng = random.Random(1)
+    samples = [retry.backoff_s(30, rng=rng) for _ in range(100)]
+    assert max(samples) <= 5.0
+
+
+def test_backoff_is_jittered_not_fixed():
+    rng = random.Random(2)
+    samples = {retry.backoff_s(4, rng=rng) for _ in range(20)}
+    assert len(samples) > 1  # lockstep retries were the bug
+
+
+def test_custom_schedule():
+    rng = random.Random(3)
+    for attempt in range(6):
+        d = retry.backoff_s(attempt, base=1.0, cap=8.0, rng=rng)
+        assert d <= min(8.0, 2.0**attempt)
+
+
+def test_sleep_backoff_sleeps_the_returned_duration(monkeypatch):
+    slept = []
+    monkeypatch.setattr(retry.time, "sleep", slept.append)
+    d = retry.sleep_backoff(3)
+    assert slept == [d]

@@ -75,6 +75,46 @@ def test_retry_on_503(client, server):
     assert client.read_bytes("s3://bkt/retry.bin") == b"ok"
 
 
+def test_retry_on_429(client, server):
+    # throttling is the one status that explicitly asks for a retry; the
+    # client used to fail fast on it (ISSUE 2 satellite)
+    server.state.fail_status = 429
+    server.state.fail_next = 2
+    client.write_bytes("s3://bkt/throttle.bin", b"ok")
+    assert client.read_bytes("s3://bkt/throttle.bin") == b"ok"
+
+
+def test_chaos_injected_storage_fault_is_retried(client, server):
+    from cosmos_curate_tpu import chaos
+
+    chaos.install(
+        chaos.FaultPlan(
+            rules=(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, kind="error", count=2),)
+        )
+    )
+    try:
+        client.write_bytes("s3://bkt/chaos.bin", b"ok")
+        assert client.read_bytes("s3://bkt/chaos.bin") == b"ok"
+        assert chaos.fire_count(chaos.SITE_STORAGE_REQUEST) == 2
+    finally:
+        chaos.uninstall()
+
+
+def test_chaos_unlimited_storage_fault_exhausts_retries(client, server):
+    from cosmos_curate_tpu import chaos
+
+    chaos.install(
+        chaos.FaultPlan(
+            rules=(chaos.FaultRule(site=chaos.SITE_STORAGE_REQUEST, kind="error"),)
+        )
+    )
+    try:
+        with pytest.raises(chaos.InjectedFault):
+            client.read_bytes("s3://bkt/never.bin")
+    finally:
+        chaos.uninstall()
+
+
 def test_multipart_upload(client, server, monkeypatch):
     monkeypatch.setattr(s3_rest, "MULTIPART_THRESHOLD", 1024)
     monkeypatch.setattr(s3_rest, "MULTIPART_CHUNK", 400)
