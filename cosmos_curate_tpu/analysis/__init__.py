@@ -1,18 +1,26 @@
 """Static analysis for cosmos-curate-tpu: build-time correctness tooling.
 
-Two complementary passes (both surfaced through ``cosmos-curate-tpu lint``
+Three complementary passes (all surfaced through ``cosmos-curate-tpu lint``
 and ``scripts/run_static_checks.sh``):
 
 - ``graph_lint``: semantic validation of a ``PipelineSpec`` before any
   worker spawns — stage-to-stage task-type flow, duplicate stage names,
-  STREAMING-mode resource feasibility, contradictory resource requests.
-  Wired into ``run_pipeline`` as an on-by-default pre-flight.
+  STREAMING-mode resource feasibility, contradictory resource requests,
+  and mesh-divisibility of stage-declared ``MeshSpec``\\ s against
+  ``ClusterShape.num_tpu_chips``. Wired into ``run_pipeline`` as an
+  on-by-default pre-flight.
 - ``ast_lint``: a rule-driven AST checker over the package source encoding
   this repo's real hazard classes (lock discipline in the engine, stdlib
   calls newer than the interpreter floor, host transfers under ``jax.jit``,
-  silent exception swallowing in worker loops). Rules live in
-  ``analysis/rules/`` and are configured via ``[tool.curate-lint]`` in
-  ``pyproject.toml``.
+  silent exception swallowing in worker loops, mesh-axis literals outside
+  the parallel/axes.py registry, hardcoded device counts,
+  with_sharding_constraint outside jit). Rules live in ``analysis/rules/``
+  and are configured via ``[tool.curate-lint]`` in ``pyproject.toml``.
+- ``shard_check``: device-free verification of the TPU sharding layer —
+  ``jax.eval_shape`` over an ``AbstractMesh`` validates every registered
+  sharded entry point's ``PartitionSpec``/``shard_map`` axis names,
+  divisibility and replicated-params HBM budget with zero device
+  allocation (``lint --shard-check``).
 """
 
 from cosmos_curate_tpu.analysis.common import Finding, LintConfig, Severity
@@ -21,12 +29,24 @@ from cosmos_curate_tpu.analysis.graph_lint import (
     lint_pipeline_spec,
     validate_pipeline_spec,
 )
+from cosmos_curate_tpu.analysis.shard_check import (
+    AbstractInput,
+    ShardContract,
+    mesh_tiling_errors,
+    parse_mesh_spec,
+    run_shard_check,
+)
 
 __all__ = [
+    "AbstractInput",
     "Finding",
     "LintConfig",
     "Severity",
     "PipelineValidationError",
+    "ShardContract",
     "lint_pipeline_spec",
+    "mesh_tiling_errors",
+    "parse_mesh_spec",
+    "run_shard_check",
     "validate_pipeline_spec",
 ]

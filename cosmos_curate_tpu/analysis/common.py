@@ -88,6 +88,12 @@ class LintConfig:
     exclude: list[str] = field(default_factory=list)
     # (major, minor) interpreter floor for the min-python rule.
     python_floor: tuple[int, int] = (3, 10)
+    # shardcheck defaults (analysis/shard_check.py): the mesh extents the
+    # pass resolves specs against ("data=2,seq=2"; unnamed axes = 1) and
+    # the per-device HBM budget for the replicated-params estimate
+    # (0 disables the budget warning).
+    shard_mesh: str = ""
+    shard_hbm_gb: float = 0.0
 
     def rule_enabled(self, rule_id: str) -> bool:
         if rule_id in self.disable:
@@ -190,4 +196,17 @@ def load_config(pyproject: Path | None = None) -> LintConfig:
     override = _parse_floor(str(section.get("python-floor", "")))
     if override:
         cfg.python_floor = override
+    cfg.shard_mesh = str(section.get("shard-mesh", cfg.shard_mesh))
+    try:
+        cfg.shard_hbm_gb = float(section.get("shard-hbm-gb", cfg.shard_hbm_gb))
+    except (TypeError, ValueError):
+        # leaving 0.0 would silently disable the budget check the user
+        # explicitly configured — say why
+        from cosmos_curate_tpu.utils.logging import get_logger
+
+        get_logger(__name__).warning(
+            "[tool.curate-lint] shard-hbm-gb=%r is not a number; "
+            "HBM-budget check disabled",
+            section.get("shard-hbm-gb"),
+        )
     return cfg

@@ -23,6 +23,9 @@ Checks:
 - **nonsense-spec**: contradictory resource requests (``tpus > 0`` with
   ``entire_tpu_host``, TPU stages with ``num_workers_per_node`` packing)
   and out-of-range scheduling knobs.
+- **mesh-divisibility**: a stage-declared ``Stage.mesh_spec`` whose
+  ``MeshSpec`` cannot tile ``ClusterShape.num_tpu_chips`` (shared
+  arithmetic with the shardcheck pass, analysis/shard_check.py).
 """
 
 from __future__ import annotations
@@ -249,8 +252,29 @@ def _check_resources(spec: "PipelineSpec", findings: list[Finding]) -> None:
 
     # Feasibility against a *declared* cluster shape only; an undeclared
     # shape is discovered at run time (engine runner._discover_tpus).
-    chips = cfg.num_tpu_chips
+    cluster = cfg.cluster_shape
+    chips = cluster.num_tpu_chips
     if chips is not None:
+        # mesh-divisibility: a TPU stage's declared MeshSpec must tile the
+        # cluster. A mesh larger than the cluster cannot run at all; a
+        # non-dividing one technically runs on a device subset but strands
+        # the declared remainder (sp_size=3 on a 4-chip host silently idles
+        # a chip you paid for) — both are spec bugs to fix before any
+        # worker spawns, with skip_validation as the escape hatch.
+        from cosmos_curate_tpu.analysis.shard_check import mesh_tiling_errors
+
+        for s in spec.stages:
+            mesh_spec = getattr(s.stage, "mesh_spec", None)
+            if mesh_spec is None:
+                continue
+            for msg in mesh_tiling_errors(mesh_spec, chips):
+                findings.append(
+                    Finding(
+                        _SPEC_FILE, 0, "mesh-divisibility",
+                        f"stage '{s.name}' declares a device mesh that does "
+                        f"not tile the declared cluster: {msg}",
+                    )
+                )
         demands = [(s, _min_chip_demand(s, chips)) for s in spec.stages]
         for s, d in demands:
             if d > chips:
@@ -276,16 +300,16 @@ def _check_resources(spec: "PipelineSpec", findings: list[Finding]) -> None:
                         "min_workers, or declare a larger cluster",
                     )
                 )
-    if cfg.num_cpus is not None and cfg.execution_mode is ExecutionMode.STREAMING:
+    if cluster.num_cpus is not None and cfg.execution_mode is ExecutionMode.STREAMING:
         total_cpus = sum(
             s.stage.resources.cpus * _min_workers(s) for s in spec.stages
         )
-        if total_cpus > cfg.num_cpus:
+        if total_cpus > cluster.num_cpus:
             findings.append(
                 Finding(
                     _SPEC_FILE, 0, "infeasible-streaming",
                     f"summed minimum CPU demand {_fmt(total_cpus)} exceeds the "
-                    f"declared {_fmt(cfg.num_cpus)} CPUs; the autoscaler cannot "
+                    f"declared {_fmt(cluster.num_cpus)} CPUs; the autoscaler cannot "
                     "shrink below per-stage minimums",
                     severity=Severity.WARNING,
                 )

@@ -36,9 +36,14 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from cosmos_curate_tpu.analysis.rules.ad_hoc_backoff import AdHocBackoffRule
+    from cosmos_curate_tpu.analysis.rules.device_count import HardcodedDeviceCountRule
     from cosmos_curate_tpu.analysis.rules.jit_transfer import JitTransferRule
     from cosmos_curate_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+    from cosmos_curate_tpu.analysis.rules.mesh_axis_literal import MeshAxisLiteralRule
     from cosmos_curate_tpu.analysis.rules.min_python import MinPythonRule
+    from cosmos_curate_tpu.analysis.rules.sharding_constraint import (
+        ShardingConstraintOutsideJitRule,
+    )
     from cosmos_curate_tpu.analysis.rules.silent_swallow import SilentSwallowRule
 
     return [
@@ -47,4 +52,7 @@ def all_rules() -> list[Rule]:
         JitTransferRule(),
         SilentSwallowRule(),
         AdHocBackoffRule(),
+        MeshAxisLiteralRule(),
+        HardcodedDeviceCountRule(),
+        ShardingConstraintOutsideJitRule(),
     ]

@@ -1,0 +1,113 @@
+"""hardcoded-device-count: global device discovery baked into shapes.
+
+Code that derives array shapes or mesh geometry from ``len(jax.devices())``
+/ ``jax.device_count()`` — or slices the raw device list — silently changes
+meaning with the topology it happens to run on: a batch sized on the v5e-8
+dev pod is wrong on the v5p-256 production slice, and a sliced device list
+ignores the mesh the rest of the pipeline agreed on. Device counts belong
+in ONE place: ``parallel/mesh.py`` (``MeshSpec.resolve`` / the mesh
+constructors) and the cluster shape the pipeline declares
+(``ClusterShape.num_tpu_chips``). Everything else should read extents off
+the mesh (``mesh.shape[axis]``).
+
+Flagged outside ``parallel/``:
+
+- ``jax.device_count()`` / ``jax.local_device_count()``;
+- ``len(jax.devices())`` / ``len(jax.local_devices())``;
+- slicing the device list (``jax.devices()[:n]``) — build the mesh with
+  ``parallel.mesh`` helpers (``seq_mesh``, ``local_mesh``) instead.
+
+``jax.devices()[0].platform`` (the constant-index platform probe) and
+filtered discovery (``[d for d in jax.devices() if ...]`` in the engine's
+resource discovery) stay clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cosmos_curate_tpu.analysis.common import Finding
+from cosmos_curate_tpu.analysis.rules import Rule, RuleContext
+
+_DEVICE_LIST_FNS = {"devices", "local_devices"}
+_DEVICE_COUNT_FNS = {"device_count", "local_device_count"}
+_EXEMPT_PATH = "parallel/"
+
+
+def _is_device_list_call(node: ast.expr, jax_names: set[str]) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _DEVICE_LIST_FNS
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in jax_names
+    )
+
+
+class HardcodedDeviceCountRule(Rule):
+    rule_id = "hardcoded-device-count"
+    description = (
+        "device counts baked into shapes: len(jax.devices()), "
+        "jax.device_count(), or slicing the raw device list outside "
+        "parallel/mesh.py"
+    )
+
+    def check(self, ctx: RuleContext) -> list[Finding]:
+        if _EXEMPT_PATH in ctx.rel_path:
+            return []
+        jax_names = {"jax"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        jax_names.add(a.asname or "jax")
+        findings: list[Finding] = []
+
+        def flag(lineno: int, what: str, fix: str) -> None:
+            findings.append(
+                Finding(
+                    ctx.rel_path, lineno, self.rule_id,
+                    f"{what}: {fix} (device counts belong to parallel/mesh.py "
+                    "and the declared ClusterShape, not call sites)",
+                )
+            )
+
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _DEVICE_COUNT_FNS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in jax_names
+            ):
+                flag(
+                    node.lineno,
+                    f"{node.func.value.id}.{node.func.attr}()",
+                    "read the extent off the mesh (mesh.shape[axis] / "
+                    "MeshSpec.resolve)",
+                )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+                and node.args
+                and _is_device_list_call(node.args[0], jax_names)
+            ):
+                flag(
+                    node.lineno,
+                    "len(jax.devices())",
+                    "read the extent off the mesh (mesh.shape[axis] / "
+                    "MeshSpec.resolve)",
+                )
+            elif (
+                isinstance(node, ast.Subscript)
+                and _is_device_list_call(node.value, jax_names)
+                and isinstance(node.slice, ast.Slice)
+            ):
+                flag(
+                    node.lineno,
+                    "slicing jax.devices()",
+                    "build the mesh via parallel.mesh helpers "
+                    "(seq_mesh/local_mesh/best_effort_mesh)",
+                )
+        return findings

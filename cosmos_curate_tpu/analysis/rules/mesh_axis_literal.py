@@ -1,0 +1,113 @@
+"""mesh-axis-literal: axis-name strings scattered outside the registry.
+
+Mesh axis names are load-bearing strings: a typo'd axis in a
+``PartitionSpec`` / ``shard_map`` spec / ``Mesh(axis_names=...)`` fails
+minutes into a run on real chips with an opaque trace error. The canonical
+registry (``parallel/axes.py``) exists so axis names flow from ONE place —
+this rule flags any string literal used as an axis name:
+
+- arguments of ``PartitionSpec(...)`` (including its ubiquitous ``P``
+  alias), ``named_sharding``, ``batch_sharding`` and ``local_mesh`` calls
+  (strings nested in tuples/lists included);
+- ``axis_names=`` / ``axis_name=`` / ``seq_axis=`` / ``batch_axes=``
+  keyword values on any call (``Mesh``, collectives, shard_map helpers);
+- defaults of function parameters named like axis parameters
+  (``axis_name``, ``*_axis``, ``*_axes``).
+
+Literals that are not even canonical axis names get a sharper message —
+that is the typo this rule exists for. The registry module itself is
+exempt (it defines the strings).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cosmos_curate_tpu.analysis.common import Finding
+from cosmos_curate_tpu.analysis.rules import Rule, RuleContext
+from cosmos_curate_tpu.parallel.axes import MESH_AXES
+
+_SPEC_CALLS = {"PartitionSpec", "named_sharding", "batch_sharding", "local_mesh"}
+_AXIS_KWARGS = {"axis_names", "axis_name", "seq_axis", "batch_axes"}
+_REGISTRY_FILE = "parallel/axes.py"
+
+
+def _partition_spec_aliases(tree: ast.Module) -> set[str]:
+    """Names ``PartitionSpec`` is imported as (the ``P`` idiom)."""
+    names = set(_SPEC_CALLS)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec" and a.asname:
+                    names.add(a.asname)
+    return names
+
+
+def _axis_param(name: str) -> bool:
+    return name in _AXIS_KWARGS or name.endswith(("_axis", "_axes"))
+
+
+def _string_constants(expr: ast.expr) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append((node.lineno, node.value))
+    return out
+
+
+class MeshAxisLiteralRule(Rule):
+    rule_id = "mesh-axis-literal"
+    description = (
+        "mesh axis names as raw string literals in PartitionSpec/shard_map/"
+        "Mesh specs instead of the parallel/axes.py registry"
+    )
+
+    def check(self, ctx: RuleContext) -> list[Finding]:
+        if ctx.rel_path.endswith(_REGISTRY_FILE):
+            return []
+        findings: list[Finding] = []
+        spec_calls = _partition_spec_aliases(ctx.tree)
+
+        def flag(lineno: int, value: str, where: str) -> None:
+            if value in MESH_AXES:
+                const = value.upper()
+                msg = (
+                    f"axis literal '{value}' in {where}: use "
+                    f"cosmos_curate_tpu.parallel.axes.{const} (the canonical "
+                    "mesh-axis registry)"
+                )
+            else:
+                msg = (
+                    f"'{value}' in {where} is not a canonical mesh axis "
+                    f"(registry: {', '.join(MESH_AXES)} — parallel/axes.py)"
+                )
+            findings.append(Finding(ctx.rel_path, lineno, self.rule_id, msg))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                callee = (
+                    func.id
+                    if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute) else ""
+                )
+                if callee in spec_calls:
+                    for arg in node.args:
+                        for lineno, value in _string_constants(arg):
+                            flag(lineno, value, f"{callee}(...)")
+                for kw in node.keywords:
+                    if kw.arg and kw.arg in _AXIS_KWARGS:
+                        for lineno, value in _string_constants(kw.value):
+                            flag(lineno, value, f"{callee or 'call'}({kw.arg}=...)")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                pos = args.posonlyargs + args.args
+                for param, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+                    if _axis_param(param.arg):
+                        for lineno, value in _string_constants(default):
+                            flag(lineno, value, f"default of parameter '{param.arg}'")
+                for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None and _axis_param(param.arg):
+                        for lineno, value in _string_constants(default):
+                            flag(lineno, value, f"default of parameter '{param.arg}'")
+        return findings

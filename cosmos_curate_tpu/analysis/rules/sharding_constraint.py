@@ -1,0 +1,87 @@
+"""sharding-constraint-outside-jit: a layout annotation that does nothing.
+
+``with_sharding_constraint`` tells XLA where an intermediate value must
+live *inside a compiled computation*. Outside ``jax.jit`` there is no
+compiler to constrain: depending on JAX version the call is an eager
+device_put (a surprise blocking transfer) or an error — either way the
+author's intent ("annotate the layout mid-computation") silently did not
+happen, and the real resharding cost appears somewhere else.
+
+The rule flags calls to ``with_sharding_constraint`` (bare, dotted, or
+``jax.lax.``-qualified) whose enclosing function is not jit-compiled.
+"Jit-compiled" means: decorated with ``jit``/``pjit`` (directly, dotted,
+or via ``functools.partial``), wrapped by name in a ``jax.jit(...)`` call
+anywhere in the file, or nested inside such a function (inner defs are
+traced with the outer). Module-level calls are always flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cosmos_curate_tpu.analysis.common import Finding
+from cosmos_curate_tpu.analysis.rules import Rule, RuleContext
+from cosmos_curate_tpu.analysis.rules.jit_transfer import _mentions_jit
+
+_TARGET = "with_sharding_constraint"
+
+
+def _jit_wrapped_names(tree: ast.Module) -> set[str]:
+    """Function names passed to a jit/pjit call somewhere in the file
+    (``fwd = jax.jit(fwd)``, ``jax.jit(shard_map(step, ...))``)."""
+    wrapped: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _mentions_jit(node.func):
+            for arg in ast.walk(node):
+                if isinstance(arg, ast.Name):
+                    wrapped.add(arg.id)
+    return wrapped
+
+
+def _is_target_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id == _TARGET
+    return isinstance(func, ast.Attribute) and func.attr == _TARGET
+
+
+class ShardingConstraintOutsideJitRule(Rule):
+    rule_id = "sharding-constraint-outside-jit"
+    description = (
+        "with_sharding_constraint outside a jit-compiled function "
+        "(no compiler to constrain: eager transfer or error)"
+    )
+
+    def check(self, ctx: RuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        wrapped = _jit_wrapped_names(ctx.tree)
+
+        def visit(node: ast.AST, inside_jit: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    child_jitted = (
+                        inside_jit
+                        or any(_mentions_jit(d) for d in child.decorator_list)
+                        or child.name in wrapped
+                    )
+                    visit(child, child_jitted)
+                    continue
+                if (
+                    not inside_jit
+                    and isinstance(child, ast.Call)
+                    and _is_target_call(child)
+                ):
+                    findings.append(
+                        Finding(
+                            ctx.rel_path, child.lineno, self.rule_id,
+                            "with_sharding_constraint outside a jit-compiled "
+                            "function has no compile-time effect — move it "
+                            "inside the jitted computation, or use "
+                            "jax.device_put with a NamedSharding for eager "
+                            "placement",
+                        )
+                    )
+                visit(child, inside_jit)
+
+        visit(ctx.tree, False)
+        return findings

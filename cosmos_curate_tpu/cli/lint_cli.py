@@ -1,16 +1,24 @@
-"""`cosmos-curate-tpu lint`: run the static-analysis rule set.
+"""`cosmos-curate-tpu lint`: run the static-analysis passes.
 
 Usage:
 
-    cosmos-curate-tpu lint                       # lint cosmos_curate_tpu/
+    cosmos-curate-tpu lint                       # AST rules over cosmos_curate_tpu/
     cosmos-curate-tpu lint path/a.py dir/        # specific targets
     cosmos-curate-tpu lint --rules min-python    # subset of rules
+    cosmos-curate-tpu lint --shard-check         # + sharding/shape contracts
+    cosmos-curate-tpu lint --shard-check --mesh data=2,seq=2 --hbm-gb 16
     cosmos-curate-tpu lint --list-rules
 
-Exit status: 0 clean, 1 findings, 2 usage error. Findings print as
-``file:line rule-id message``; see docs/STATIC_ANALYSIS.md for the rule
-catalogue, the ``[tool.curate-lint]`` config section and suppression
-comments.
+``--shard-check`` adds the device-free shardcheck pass
+(analysis/shard_check.py): every registered sharded entry point is
+eval_shape'd against the declared mesh (default from ``[tool.curate-lint]``
+``shard-mesh``) with zero device allocation — run it under
+``JAX_PLATFORMS=cpu`` anywhere.
+
+Exit status: 0 clean, 1 error findings, 2 usage error. Warnings print but
+do not fail the gate. Findings print as ``file:line rule-id message``; see
+docs/STATIC_ANALYSIS.md for the rule catalogue, the ``[tool.curate-lint]``
+config section and suppression comments.
 """
 
 from __future__ import annotations
@@ -23,7 +31,8 @@ def register(sub: "argparse._SubParsersAction") -> None:
     lint = sub.add_parser(
         "lint",
         help="static analysis: engine lock discipline, interpreter-floor "
-        "APIs, jit transfer smells, silent exception swallows",
+        "APIs, jit transfer smells, silent exception swallows, mesh-axis "
+        "hygiene; --shard-check adds device-free sharding contracts",
     )
     lint.add_argument(
         "paths",
@@ -38,6 +47,32 @@ def register(sub: "argparse._SubParsersAction") -> None:
         "[tool.curate-lint])",
     )
     lint.add_argument(
+        "--shard-check",
+        action="store_true",
+        help="also run the sharding/shape contract pass (device-free: "
+        "jax.eval_shape over an AbstractMesh, no TPUs needed)",
+    )
+    lint.add_argument(
+        "--mesh",
+        default=None,
+        help='mesh extents for --shard-check, e.g. "data=2,seq=2" '
+        "(unnamed axes = 1; default from [tool.curate-lint] shard-mesh)",
+    )
+    lint.add_argument(
+        "--devices",
+        type=int,
+        default=None,
+        help="device count a -1 mesh axis absorbs (default: the product of "
+        "the fixed extents — still zero device discovery)",
+    )
+    lint.add_argument(
+        "--hbm-gb",
+        type=float,
+        default=None,
+        help="per-device HBM budget in GiB for the replicated-params "
+        "estimate (default from [tool.curate-lint] shard-hbm-gb; 0 skips)",
+    )
+    lint.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue and exit"
     )
     lint.set_defaults(func=_cmd_lint)
@@ -45,11 +80,14 @@ def register(sub: "argparse._SubParsersAction") -> None:
 
 def _cmd_lint(args: argparse.Namespace) -> int:
     from cosmos_curate_tpu.analysis.ast_lint import run_lint
+    from cosmos_curate_tpu.analysis.common import Severity
     from cosmos_curate_tpu.analysis.rules import all_rules
 
     if args.list_rules:
         for rule in all_rules():
-            print(f"{rule.rule_id:16s} {rule.description}")
+            print(f"{rule.rule_id:32s} {rule.description}")
+        print(f"{'(pass) shard-check':32s} device-free sharding/shape contracts "
+              "(--shard-check; rule ids shard-*)")
         return 0
     rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules else None
     try:
@@ -57,15 +95,33 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.shard_check:
+        from cosmos_curate_tpu.analysis.shard_check import parse_mesh_spec, run_shard_check
+
+        try:
+            mesh_spec = parse_mesh_spec(args.mesh) if args.mesh else None
+            findings.extend(
+                run_shard_check(
+                    mesh_spec, num_devices=args.devices, hbm_gb=args.hbm_gb
+                )
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     for f in findings:
         print(f.render())
+    errors = [f for f in findings if f.severity is Severity.ERROR]
     n_files = len(args.paths)
-    if findings:
+    if errors:
         print(
-            f"curate-lint: {len(findings)} finding(s) "
-            f"(suppress with '# curate-lint: disable=<rule>')",
+            f"curate-lint: {len(errors)} error(s), "
+            f"{len(findings) - len(errors)} warning(s) "
+            f"(suppress AST rules with '# curate-lint: disable=<rule>')",
             file=sys.stderr,
         )
         return 1
+    if findings:
+        print(f"curate-lint: {len(findings)} warning(s), no errors", file=sys.stderr)
+        return 0
     print(f"curate-lint: clean ({n_files} target(s))", file=sys.stderr)
     return 0

@@ -46,6 +46,17 @@ class StreamingSpec:
     object_store_fraction: float = 0.3
 
 
+@dataclass(frozen=True)
+class ClusterShape:
+    """Declared cluster geometry (totals across hosts) that the pre-flight
+    validates specs against — STREAMING feasibility and each TPU stage's
+    declared ``MeshSpec`` tiling (analysis/graph_lint.py). ``None`` fields
+    are discovered at run time instead of validated."""
+
+    num_cpus: float | None = None
+    num_tpu_chips: int | None = None
+
+
 @dataclass
 class PipelineConfig:
     execution_mode: ExecutionMode = ExecutionMode.STREAMING
@@ -56,6 +67,10 @@ class PipelineConfig:
     # Total resources; None = discover from the local host.
     num_cpus: float | None = None
     num_tpu_chips: int | None = None
+
+    @property
+    def cluster_shape(self) -> ClusterShape:
+        return ClusterShape(num_cpus=self.num_cpus, num_tpu_chips=self.num_tpu_chips)
 
 
 @dataclass

@@ -22,10 +22,13 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field, replace
-from typing import Generic, TypeVar
+from typing import TYPE_CHECKING, Generic, TypeVar
 
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.tasks import PipelineTask
+
+if TYPE_CHECKING:
+    from cosmos_curate_tpu.parallel.mesh import MeshSpec
 
 T = TypeVar("T", bound=PipelineTask)
 V = TypeVar("V", bound=PipelineTask)
@@ -104,6 +107,16 @@ class Stage(Generic[T, V], abc.ABC):
     def env_name(self) -> str:
         """Advisory execution-environment tag (see module docstring)."""
         return "default"
+
+    @property
+    def mesh_spec(self) -> "MeshSpec | None":
+        """Declared device-mesh geometry this stage's model builds
+        (parallel/mesh.py); ``None`` = no mesh, or discovered at run time.
+        Declaring it lets the ``run_pipeline`` pre-flight reject a mesh
+        that cannot tile ``ClusterShape.num_tpu_chips`` before any worker
+        spawns (and ``lint --shard-check`` validate axis names and
+        divisibility device-free)."""
+        return None
 
     @property
     def batch_size(self) -> int:

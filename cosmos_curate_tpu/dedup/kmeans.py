@@ -68,14 +68,9 @@ def kmeans_fit(
     data = embeddings / np.maximum(np.linalg.norm(embeddings, axis=1, keepdims=True), 1e-8)
     valid = n
     if mesh is not None:
-        from cosmos_curate_tpu.parallel.sharding import batch_sharding
+        from cosmos_curate_tpu.parallel.sharding import shard_batch
 
-        sharding = batch_sharding(mesh)
-        n_shards = int(np.prod([mesh.shape[a] for a in ("dcn", "data") if a in mesh.axis_names]))
-        pad = (-n) % n_shards
-        if pad:
-            data = np.concatenate([data, np.zeros((pad, d), data.dtype)], axis=0)
-        data = jax.device_put(jnp.asarray(data, jnp.float32), sharding)
+        data, _pad = shard_batch(mesh, data.astype(np.float32))
     else:
         data = jnp.asarray(data, jnp.float32)
 

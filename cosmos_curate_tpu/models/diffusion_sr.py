@@ -37,6 +37,9 @@ import numpy as np
 
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.parallel import axes
+from cosmos_curate_tpu.parallel.mesh import seq_mesh
+from cosmos_curate_tpu.parallel.sharding import shard_map
 
 
 @dataclass(frozen=True)
@@ -222,16 +225,15 @@ class DiffusionSRModel(ModelInterface):
             )
 
         if self.sp_size > 1:
-            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
-            devs = np.array(jax.devices()[: self.sp_size])
-            mesh = Mesh(devs, axis_names=("seq",))
+            mesh = seq_mesh(self.sp_size)
             self._sample = jax.jit(
-                jax.shard_map(
+                shard_map(
                     sample_chunks,
                     mesh=mesh,
-                    in_specs=(P(), P("seq"), P("seq")),
-                    out_specs=P("seq"),
+                    in_specs=(P(), P(axes.SEQ), P(axes.SEQ)),
+                    out_specs=P(axes.SEQ),
                     check_vma=False,
                 )
             )

@@ -19,9 +19,9 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-Dtype = Any
+from cosmos_curate_tpu.parallel.axes import MODEL as MODEL_AXIS
 
-MODEL_AXIS = "model"
+Dtype = Any
 
 
 def dense(features: int, shard: str | None, name: str | None = None, use_bias: bool = True, dtype=jnp.bfloat16):

@@ -21,6 +21,9 @@ import numpy as np
 
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
+from cosmos_curate_tpu.parallel import axes
+from cosmos_curate_tpu.parallel.mesh import seq_mesh
+from cosmos_curate_tpu.parallel.sharding import shard_map
 
 
 @dataclass(frozen=True)
@@ -96,20 +99,19 @@ class SuperResolutionModel(ModelInterface):
 
         self._params = registry.load_params(self.MODEL_ID, init)
         if self.sp_size > 1:
-            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.sharding import PartitionSpec as P
 
-            devs = np.array(jax.devices()[: self.sp_size])
-            mesh = Mesh(devs, axis_names=("seq",))
+            mesh = seq_mesh(self.sp_size)
 
             def fwd(params, frames):
                 return model.apply(params, frames)
 
             self._apply = jax.jit(
-                jax.shard_map(
+                shard_map(
                     fwd,
                     mesh=mesh,
-                    in_specs=(P(), P("seq", None, None, None)),
-                    out_specs=P("seq", None, None, None),
+                    in_specs=(P(), P(axes.SEQ, None, None, None)),
+                    out_specs=P(axes.SEQ, None, None, None),
                     check_vma=False,
                 )
             )

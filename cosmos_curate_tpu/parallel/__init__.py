@@ -1,21 +1,36 @@
+from cosmos_curate_tpu.parallel.axes import BATCH_AXES, DATA, DCN, MESH_AXES, MODEL, SEQ
 from cosmos_curate_tpu.parallel.mesh import (
     MeshSpec,
     best_effort_mesh,
     local_mesh,
+    seq_mesh,
 )
 from cosmos_curate_tpu.parallel.sharding import (
+    batch_shard_count,
     batch_sharding,
     named_sharding,
     replicated,
     shard_batch,
+    shard_map,
+    unshard_batch,
 )
 
 __all__ = [
+    "BATCH_AXES",
+    "DATA",
+    "DCN",
+    "MESH_AXES",
+    "MODEL",
+    "SEQ",
     "MeshSpec",
+    "batch_shard_count",
     "batch_sharding",
     "best_effort_mesh",
     "local_mesh",
     "named_sharding",
     "replicated",
+    "seq_mesh",
     "shard_batch",
+    "shard_map",
+    "unshard_batch",
 ]

@@ -1,0 +1,33 @@
+"""Canonical mesh-axis registry: the single source of truth for axis names.
+
+Every collective plane in this codebase is a ``jax.sharding.Mesh`` over the
+same four logical axes (parallel/mesh.py, scaling-book convention):
+
+  ``DCN``   — across hosts/slices (data-parallel only; rides DCN)
+  ``DATA``  — batch shards within a slice
+  ``MODEL`` — tensor/expert-parallel shards (rides ICI)
+  ``SEQ``   — sequence/context-parallel shards (ring attention, Ulysses,
+              windowed SR sequence parallelism)
+
+A typo'd axis name in a ``PartitionSpec`` or ``shard_map`` spec only fails
+minutes into a run on real chips — so axis names flow from here, never from
+scattered string literals. The ``mesh-axis-literal`` lint rule
+(analysis/rules/mesh_axis_literal.py) enforces this, and the shardcheck
+pass (analysis/shard_check.py) validates specs against these axes with zero
+device allocation. T5X-style logical-axis-name partitioning is the prior
+art for centralizing the vocabulary (SNIPPETS [2]).
+"""
+
+from __future__ import annotations
+
+DCN = "dcn"
+DATA = "data"
+MODEL = "model"
+SEQ = "seq"
+
+# Axis order matches MeshSpec / best_effort_mesh device reshaping.
+MESH_AXES: tuple[str, ...] = (DCN, DATA, MODEL, SEQ)
+
+# Axes a leading [B, ...] batch dimension shards over (shard_batch /
+# batch_sharding in parallel/sharding.py).
+BATCH_AXES: tuple[str, ...] = (DCN, DATA)

@@ -20,6 +20,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from cosmos_curate_tpu.parallel import axes
+from cosmos_curate_tpu.parallel.sharding import shard_map
+
 
 def _online_softmax_step(o, m, l, s, v_cur):
     """Fold one score block into the running (output, max, normalizer)."""
@@ -77,7 +80,7 @@ def ring_attention(
     v: jax.Array,
     mesh,
     *,
-    seq_axis: str = "seq",
+    seq_axis: str = axes.SEQ,
     causal: bool = False,
     sm_scale: float | None = None,
 ) -> jax.Array:
@@ -95,7 +98,7 @@ def ring_attention(
     fn = functools.partial(
         _ring_attention_sharded, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
 

@@ -15,6 +15,9 @@ import functools
 import jax
 from jax import lax
 
+from cosmos_curate_tpu.parallel import axes
+from cosmos_curate_tpu.parallel.sharding import shard_map
+
 
 def _ulysses_sharded(q, k, v, *, axis_name: str, causal: bool, sm_scale: float | None):
     from cosmos_curate_tpu.parallel.ring_attention import attention_reference
@@ -37,7 +40,7 @@ def ulysses_attention(
     v: jax.Array,
     mesh,
     *,
-    seq_axis: str = "seq",
+    seq_axis: str = axes.SEQ,
     causal: bool = False,
     sm_scale: float | None = None,
 ) -> jax.Array:
@@ -50,6 +53,6 @@ def ulysses_attention(
         raise ValueError(f"heads ({q.shape[1]}) must divide by mesh axis {seq_axis}={n}")
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(_ulysses_sharded, axis_name=seq_axis, causal=causal, sm_scale=sm_scale)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)

@@ -15,6 +15,7 @@ from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.core.stage import Resources, Stage
 from cosmos_curate_tpu.data.model import SplitPipeTask
 from cosmos_curate_tpu.models.super_resolution import SR_BASE, SRConfig, SuperResolutionModel
+from cosmos_curate_tpu.parallel.mesh import MeshSpec
 from cosmos_curate_tpu.utils.logging import get_logger
 from cosmos_curate_tpu.video.decode import decode_frames, extract_video_metadata
 from cosmos_curate_tpu.video.encode import encode_frames
@@ -85,6 +86,16 @@ class SuperResolutionStage(Stage[SplitPipeTask, SplitPipeTask]):
     @property
     def resources(self) -> Resources:
         return Resources(cpus=1.0, entire_tpu_host=True)
+
+    @property
+    def mesh_spec(self) -> MeshSpec | None:
+        """Sequence-parallel plane (models build a seq-only mesh over
+        ``sp_size`` chips); declared so the pre-flight rejects an sp_size
+        the cluster cannot tile before any worker spawns."""
+        sp = getattr(self._model, "sp_size", 1)
+        if sp <= 1:
+            return None
+        return MeshSpec(dcn=1, data=1, model=1, seq=sp)
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:

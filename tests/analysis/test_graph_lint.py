@@ -265,3 +265,90 @@ class TestRunPipelinePreflight:
 
     def test_validate_pipeline_spec_passes_clean_spec(self):
         validate_pipeline_spec(_spec([AlphaStage(), AlphaStageTwo()]))
+
+
+class MeshedTpuStage(Stage[AlphaTask, AlphaTask]):
+    """A TPU stage declaring its device-mesh geometry (like the SR stage's
+    seq-parallel plane sized by sp_size)."""
+
+    def __init__(self, name: str, seq: int) -> None:
+        self._display_name = name
+        self._seq = seq
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0, entire_tpu_host=True)
+
+    @property
+    def mesh_spec(self):
+        from cosmos_curate_tpu.parallel.mesh import MeshSpec
+
+        return MeshSpec(dcn=1, data=1, model=1, seq=self._seq)
+
+    def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+        return tasks
+
+
+class TestMeshDivisibility:
+    def test_mesh_that_tiles_the_cluster_passes(self):
+        spec = _spec(
+            [MeshedTpuStage("sr", seq=2)], PipelineConfig(num_tpu_chips=4)
+        )
+        assert [f for f in _errors(spec) if f.rule == "mesh-divisibility"] == []
+
+    def test_non_dividing_mesh_rejected(self):
+        spec = _spec(
+            [MeshedTpuStage("sr", seq=3)], PipelineConfig(num_tpu_chips=4)
+        )
+        errs = [f for f in _errors(spec) if f.rule == "mesh-divisibility"]
+        assert len(errs) == 1
+        assert "'sr'" in errs[0].message and "cannot tile" in errs[0].message
+
+    def test_mesh_larger_than_cluster_rejected(self):
+        spec = _spec(
+            [MeshedTpuStage("sr", seq=16)], PipelineConfig(num_tpu_chips=8)
+        )
+        errs = [f for f in _errors(spec) if f.rule == "mesh-divisibility"]
+        assert len(errs) == 1
+        assert "needs 16" in errs[0].message
+
+    def test_undeclared_cluster_skips_the_check(self):
+        spec = _spec([MeshedTpuStage("sr", seq=3)], PipelineConfig())
+        assert [f for f in _errors(spec) if f.rule == "mesh-divisibility"] == []
+
+    def test_preflight_rejects_before_any_worker(self):
+        ran = []
+
+        class Recorder(MeshedTpuStage):
+            def process_data(self, tasks: list[AlphaTask]) -> list[AlphaTask]:
+                ran.append(1)
+                return tasks
+
+        with pytest.raises(PipelineValidationError) as ei:
+            run_pipeline(
+                [AlphaTask()],
+                [Recorder("sr", seq=5)],
+                PipelineConfig(num_tpu_chips=8),
+                runner=SequentialRunner(),
+            )
+        assert ran == []
+        assert "mesh-divisibility" in str(ei.value)
+
+    def test_sr_stage_declares_its_seq_plane(self):
+        from cosmos_curate_tpu.pipelines.video.stages.super_resolution import (
+            SuperResolutionStage,
+        )
+
+        stage = SuperResolutionStage(sp_size=4)
+        assert stage.mesh_spec is not None
+        assert stage.mesh_spec.seq == 4
+        assert SuperResolutionStage(sp_size=1).mesh_spec is None
+
+
+class TestClusterShape:
+    def test_config_builds_cluster_shape(self):
+        from cosmos_curate_tpu.core.pipeline import ClusterShape
+
+        cfg = PipelineConfig(num_cpus=12.0, num_tpu_chips=8)
+        assert cfg.cluster_shape == ClusterShape(num_cpus=12.0, num_tpu_chips=8)
+        assert PipelineConfig().cluster_shape == ClusterShape()

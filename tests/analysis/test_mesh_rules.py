@@ -1,0 +1,279 @@
+"""The sharding-hygiene AST rules: mesh-axis-literal, hardcoded-device-count,
+sharding-constraint-outside-jit. Fixture snippets per behavior (flagged,
+clean, suppressed), following tests/analysis/test_ast_lint.py."""
+
+import textwrap
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.ast_lint import lint_file
+from cosmos_curate_tpu.analysis.common import LintConfig
+from cosmos_curate_tpu.analysis.rules import all_rules
+
+
+def _lint(tmp_path: Path, code: str, rules, *, subdir: str = "models"):
+    d = tmp_path / subdir
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "snippet.py"
+    f.write_text(textwrap.dedent(code))
+    selected = [r for r in all_rules() if r.rule_id in rules]
+    return lint_file(f, LintConfig(), selected, root=tmp_path)
+
+
+class TestMeshAxisLiteral:
+    RULE = ["mesh-axis-literal"]
+
+    def test_partition_spec_literal_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, None, "seq", None)
+            """,
+            self.RULE,
+        )
+        assert [f.rule for f in findings] == ["mesh-axis-literal"]
+        assert "axes.SEQ" in findings[0].message
+
+    def test_typo_gets_registry_message(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec
+
+            spec = PartitionSpec("sec")
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 1
+        assert "not a canonical mesh axis" in findings[0].message
+
+    def test_mesh_axis_names_kwarg_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.sharding import Mesh
+
+            mesh = Mesh(devs, axis_names=("dcn", "data"))
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 2
+
+    def test_axis_param_default_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            def ring(q, mesh, seq_axis="seq", batch_axes=("dcn", "data")):
+                return q
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 3
+
+    def test_constants_are_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            from cosmos_curate_tpu.parallel import axes
+
+            spec = P(None, None, axes.SEQ, None)
+
+            def ring(q, mesh, seq_axis=axes.SEQ, batch_axes=axes.BATCH_AXES):
+                return q
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+    def test_non_axis_strings_untouched(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            def lookup(name="weights", mode="append"):
+                return {"data": 1}["data"]
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+    def test_registry_module_exempt(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            DCN = "dcn"
+
+            def validate_axis(axis_name="dcn"):
+                return axis_name
+            """,
+            self.RULE,
+            subdir="parallel",
+        )
+        # the snippet is parallel/snippet.py, not the registry itself
+        assert len(findings) == 1
+        d = tmp_path / "parallel"
+        f = d / "axes.py"
+        f.write_text("def check(axis_name='dcn'):\n    return axis_name\n")
+        assert lint_file(f, LintConfig(), [r for r in all_rules() if r.rule_id in self.RULE], root=tmp_path) == []
+
+    def test_suppression(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.sharding import PartitionSpec as P
+
+            spec = P("seq")  # curate-lint: disable=mesh-axis-literal
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+
+class TestHardcodedDeviceCount:
+    RULE = ["hardcoded-device-count"]
+
+    def test_len_devices_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            n = len(jax.devices())
+            """,
+            self.RULE,
+        )
+        assert [f.rule for f in findings] == ["hardcoded-device-count"]
+
+    def test_device_count_calls_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            shape = (jax.device_count(), jax.local_device_count())
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 2
+
+    def test_device_list_slice_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            devs = jax.devices()[: sp_size]
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 1
+        assert "parallel.mesh" in findings[0].message
+
+    def test_platform_probe_and_filtered_discovery_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            platform = jax.devices()[0].platform
+            tpus = len([d for d in jax.devices() if d.platform == "tpu"])
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+    def test_parallel_modules_exempt(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            n = len(jax.devices())
+            """,
+            self.RULE,
+            subdir="parallel",
+        )
+        assert findings == []
+
+
+class TestShardingConstraintOutsideJit:
+    RULE = ["sharding-constraint-outside-jit"]
+
+    def test_outside_jit_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            def forward(x, sharding):
+                return jax.lax.with_sharding_constraint(x, sharding)
+            """,
+            self.RULE,
+        )
+        assert [f.rule for f in findings] == ["sharding-constraint-outside-jit"]
+
+    def test_module_level_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            from jax.lax import with_sharding_constraint
+
+            y = with_sharding_constraint(x, s)
+            """,
+            self.RULE,
+        )
+        assert len(findings) == 1
+
+    def test_jit_decorated_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import functools
+
+            import jax
+
+            @jax.jit
+            def forward(x, sharding):
+                return jax.lax.with_sharding_constraint(x, sharding)
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def topk(x, sharding, k):
+                return jax.lax.with_sharding_constraint(x, sharding)
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+    def test_jit_wrapped_by_name_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            def step(x, sharding):
+                return jax.lax.with_sharding_constraint(x, sharding)
+
+            step_c = jax.jit(step)
+            """,
+            self.RULE,
+        )
+        assert findings == []
+
+    def test_nested_inside_jitted_clean(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            """
+            import jax
+
+            @jax.jit
+            def outer(x, sharding):
+                def inner(y):
+                    return jax.lax.with_sharding_constraint(y, sharding)
+
+                return inner(x)
+            """,
+            self.RULE,
+        )
+        assert findings == []

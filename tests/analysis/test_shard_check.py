@@ -1,0 +1,273 @@
+"""shardcheck: device-free sharding/shape contract verification.
+
+Everything here runs under the suite's JAX_PLATFORMS=cpu with zero device
+allocation — the acceptance contract of the pass (jax.eval_shape +
+AbstractMesh, never a real Mesh).
+"""
+
+import pytest
+
+from cosmos_curate_tpu.analysis.common import LintConfig, Severity
+from cosmos_curate_tpu.analysis.shard_check import (
+    AbstractInput,
+    ShardContract,
+    check_contract,
+    default_contracts,
+    mesh_tiling_errors,
+    parse_mesh_spec,
+    run_shard_check,
+)
+from cosmos_curate_tpu.parallel.axes import DATA, SEQ
+from cosmos_curate_tpu.parallel.mesh import MeshSpec
+
+MESH_2x2 = {"dcn": 1, "data": 2, "model": 1, "seq": 2}
+
+
+class TestParseMeshSpec:
+    def test_parses_extents_defaulting_to_one(self):
+        spec = parse_mesh_spec("data=2,seq=4")
+        assert spec == MeshSpec(dcn=1, data=2, model=1, seq=4)
+
+    def test_rejects_unknown_axis(self):
+        with pytest.raises(ValueError, match="dcn, data, model, seq"):
+            parse_mesh_spec("sec=2")
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_mesh_spec("data=two")
+        with pytest.raises(ValueError):
+            parse_mesh_spec("data")
+
+
+class TestMeshTiling:
+    def test_exact_and_subset_tilings_pass(self):
+        assert mesh_tiling_errors(MeshSpec(dcn=1, data=2, model=1, seq=2), 4) == []
+        # a host-local mesh smaller than the cluster is fine as long as it divides
+        assert mesh_tiling_errors(MeshSpec(dcn=1, data=1, model=1, seq=2), 8) == []
+
+    def test_too_large_and_non_dividing_fail(self):
+        errs = mesh_tiling_errors(MeshSpec(dcn=1, data=1, model=1, seq=16), 8)
+        assert errs and "needs 16" in errs[0]
+        errs = mesh_tiling_errors(MeshSpec(dcn=1, data=1, model=1, seq=3), 8)
+        assert errs and "cannot tile" in errs[0]
+
+    def test_multiple_free_axes_fail(self):
+        errs = mesh_tiling_errors(MeshSpec(dcn=-1, data=-1, model=1, seq=1), 8)
+        assert errs and "-1" in errs[0]
+
+    def test_free_axis_allowed_when_fixed_divides(self):
+        assert mesh_tiling_errors(MeshSpec(dcn=1, data=-1, model=2, seq=1), 8) == []
+
+
+class TestStaticSpecChecks:
+    def test_unknown_axis_in_partition_spec(self):
+        contract = ShardContract(
+            name="bad", inputs=(AbstractInput((8, 4), "float32", ("sec",)),)
+        )
+        findings = check_contract(contract, MESH_2x2)
+        assert [f.rule for f in findings] == ["shard-unknown-axis"]
+        assert "nor the canonical registry" in findings[0].message
+
+    def test_batch_not_divisible_by_data_extent(self):
+        contract = ShardContract(
+            name="bad", inputs=(AbstractInput((5, 4), "float32", (DATA,)),)
+        )
+        findings = check_contract(contract, MESH_2x2)
+        assert [f.rule for f in findings] == ["shard-indivisible"]
+        assert "size 5" in findings[0].message
+
+    def test_pads_batch_downgrades_to_warning(self):
+        contract = ShardContract(
+            name="padded",
+            inputs=(AbstractInput((5, 4), "float32", (DATA,)),),
+            pads_batch=True,
+        )
+        findings = check_contract(contract, MESH_2x2)
+        assert [f.rule for f in findings] == ["shard-pad-waste"]
+        assert findings[0].severity is Severity.WARNING
+
+    def test_duplicate_axis_and_rank_mismatch(self):
+        dup = ShardContract(
+            name="dup", inputs=(AbstractInput((4, 4), "float32", (DATA, DATA)),)
+        )
+        assert [f.rule for f in check_contract(dup, MESH_2x2)] == [
+            "shard-duplicate-axis"
+        ]
+        rank = ShardContract(
+            name="rank", inputs=(AbstractInput((4,), "float32", (DATA, None, SEQ)),)
+        )
+        assert [f.rule for f in check_contract(rank, MESH_2x2)] == [
+            "shard-rank-mismatch"
+        ]
+
+    def test_multi_axis_dim_uses_extent_product(self):
+        # (dcn, data) over dim 0: extent 2 — 6 divides, 7 does not
+        ok = ShardContract(
+            name="ok", inputs=(AbstractInput((6, 4), "float32", (("dcn", "data"),)),)
+        )
+        assert check_contract(ok, MESH_2x2) == []
+        bad = ShardContract(
+            name="bad", inputs=(AbstractInput((7, 4), "float32", (("dcn", "data"),)),)
+        )
+        assert [f.rule for f in check_contract(bad, MESH_2x2)] == ["shard-indivisible"]
+
+
+class TestAbstractFlow:
+    def test_shard_map_axis_absent_from_mesh(self):
+        """The acceptance case: a shard_map spec naming an axis the declared
+        MeshSpec does not have — caught by JAX's own tracing over an
+        AbstractMesh, no devices."""
+        from cosmos_curate_tpu.parallel.ring_attention import ring_attention
+
+        contract = ShardContract(
+            name="ring",
+            inputs=tuple(
+                AbstractInput((1, 4, 16, 8), "float32") for _ in ("q", "k", "v")
+            ),
+            forward=lambda mesh, q, k, v: ring_attention(q, k, v, mesh),
+            needs_mesh=True,
+        )
+        findings = check_contract(contract, {"dcn": 1, "data": 2})
+        assert [f.rule for f in findings] == ["shard-unknown-axis"]
+        assert "'seq'" in findings[0].message
+
+    def test_shape_flow_error_surfaces(self):
+        def broken(x):
+            import jax.numpy as jnp
+
+            return x @ jnp.zeros((3, 3), x.dtype)  # 4x4 @ 3x3: rank mismatch
+
+        contract = ShardContract(
+            name="broken",
+            inputs=(AbstractInput((4, 4), "float32"),),
+            forward=broken,
+        )
+        findings = check_contract(contract, MESH_2x2)
+        assert [f.rule for f in findings] == ["shard-shape-flow"]
+
+    def test_hbm_budget_warning(self):
+        import jax.numpy as jnp
+
+        def init():
+            return {"w": jnp.zeros((1024, 1024), jnp.float32)}  # 4 MiB
+
+        contract = ShardContract(
+            name="fat", inputs=(), init=init, forward=None
+        )
+        findings = check_contract(contract, MESH_2x2, hbm_gb=0.001)
+        assert [f.rule for f in findings] == ["shard-hbm-budget"]
+        assert findings[0].severity is Severity.WARNING
+        assert check_contract(contract, MESH_2x2, hbm_gb=1.0) == []
+
+
+class TestRepoContracts:
+    def test_repo_contracts_clean_on_default_mesh(self):
+        """The dogfood acceptance: the repo's own sharded entry points pass
+        against the pyproject-declared mesh (no suppressions — migration)."""
+        findings = run_shard_check()
+        assert findings == [], [f.render() for f in findings]
+
+    def test_contracts_adapt_to_seq_extent(self):
+        findings = run_shard_check(parse_mesh_spec("data=2,seq=4"))
+        errors = [f for f in findings if f.severity is Severity.ERROR]
+        assert errors == [], [f.render() for f in errors]
+
+    def test_mesh_with_unresolvable_free_axis(self):
+        findings = run_shard_check(MeshSpec(dcn=1, data=-1, model=1, seq=1))
+        assert [f.rule for f in findings] == ["shard-mesh-spec"]
+
+    def test_fully_specified_mesh_may_cover_device_subset(self):
+        """--devices larger than the mesh product is fine as long as the
+        mesh tiles it (a host-local mesh on a bigger cluster)."""
+        spec = parse_mesh_spec("data=2,seq=2")  # product 4
+        assert run_shard_check(spec, num_devices=8) == []
+        findings = run_shard_check(spec, num_devices=6)  # 4 does not divide 6
+        assert [f.rule for f in findings] == ["shard-mesh-spec"]
+
+    def test_free_axis_absorbs_explicit_device_count(self):
+        findings = run_shard_check(
+            parse_mesh_spec("data=-1,seq=2"), num_devices=8
+        )
+        errors = [f for f in findings if f.severity is Severity.ERROR]
+        assert errors == [], [f.render() for f in errors]
+
+    def test_default_contracts_cover_known_entry_points(self):
+        names = {c.name for c in default_contracts(MESH_2x2)}
+        assert {
+            "super-resolution-tpu",
+            "diffusion-sr-tpu",
+            "ring-attention",
+            "ulysses-attention",
+            "shard-batch",
+        } <= names
+
+
+class TestLintCliShardCheck:
+    def _run(self, argv, monkeypatch=None, contracts=None):
+        import cosmos_curate_tpu.analysis.shard_check as sc
+        from cosmos_curate_tpu.cli.main import main
+
+        if contracts is not None:
+            monkeypatch.setattr(sc, "default_contracts", lambda mesh: contracts)
+        return main(argv)
+
+    def test_shard_check_clean_exit_zero(self, capsys):
+        assert self._run(["lint", "--shard-check", "cosmos_curate_tpu/parallel/axes.py"]) == 0
+
+    def test_shard_check_catches_unknown_axis(self, capsys, monkeypatch):
+        bad = ShardContract(
+            name="typo", inputs=(AbstractInput((8, 4), "float32", ("sec",)),)
+        )
+        rc = self._run(
+            ["lint", "--shard-check", "cosmos_curate_tpu/parallel/axes.py"],
+            monkeypatch, [bad],
+        )
+        assert rc == 1
+        assert "shard-unknown-axis" in capsys.readouterr().out
+
+    def test_shard_check_catches_indivisible_batch(self, capsys, monkeypatch):
+        bad = ShardContract(
+            name="ragged", inputs=(AbstractInput((5, 4), "float32", (DATA,)),)
+        )
+        rc = self._run(
+            ["lint", "--shard-check", "--mesh", "data=2",
+             "cosmos_curate_tpu/parallel/axes.py"],
+            monkeypatch, [bad],
+        )
+        assert rc == 1
+        assert "shard-indivisible" in capsys.readouterr().out
+
+    def test_shard_check_catches_shard_map_missing_axis(self, capsys, monkeypatch):
+        """A shard_map whose specs name an axis the declared MeshSpec does
+        not have (a user kernel's ad-hoc 'heads' axis): JAX's AbstractMesh
+        tracing raises, the pass reports shard-unknown-axis."""
+
+        def fwd(mesh, x):
+            from jax.sharding import PartitionSpec as P
+
+            from cosmos_curate_tpu.parallel.sharding import shard_map
+
+            return shard_map(
+                lambda y: y, mesh=mesh, in_specs=P("heads"), out_specs=P("heads")
+            )(x)
+
+        contract = ShardContract(
+            name="custom-kernel",
+            inputs=(AbstractInput((8, 4), "float32"),),
+            forward=fwd,
+            needs_mesh=True,
+        )
+        rc = self._run(
+            ["lint", "--shard-check", "--mesh", "data=2",
+             "cosmos_curate_tpu/parallel/axes.py"],
+            monkeypatch, [contract],
+        )
+        assert rc == 1
+        assert "shard-unknown-axis" in capsys.readouterr().out
+
+    def test_bad_mesh_arg_is_usage_error(self, capsys):
+        rc = self._run(
+            ["lint", "--shard-check", "--mesh", "bogus=2",
+             "cosmos_curate_tpu/parallel/axes.py"]
+        )
+        assert rc == 2

@@ -21,15 +21,18 @@ def test_psum_over_mesh(cpu_mesh):
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from cosmos_curate_tpu.parallel.axes import DATA, MODEL
+    from cosmos_curate_tpu.parallel.sharding import shard_map
+
     x = jnp.arange(16.0).reshape(8, 2)
-    xs = jax.device_put(x, NamedSharding(cpu_mesh, P(("data", "model"), None)))
+    xs = jax.device_put(x, NamedSharding(cpu_mesh, P((DATA, MODEL), None)))
 
     def f(v):
-        return jax.lax.psum(v.sum(), axis_name=("data", "model"))
+        return jax.lax.psum(v.sum(), axis_name=(DATA, MODEL))
 
     out = jax.jit(
-        jax.shard_map(
-            f, mesh=cpu_mesh, in_specs=P(("data", "model"), None), out_specs=P()
+        shard_map(
+            f, mesh=cpu_mesh, in_specs=P((DATA, MODEL), None), out_specs=P()
         )
     )(xs)
     np.testing.assert_allclose(np.asarray(out), x.sum())

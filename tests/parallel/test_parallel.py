@@ -105,3 +105,109 @@ class TestUlysses:
         q = jnp.zeros((1, 3, 16, 8))
         with pytest.raises(ValueError, match="heads"):
             ulysses_attention(q, q, q, seq_mesh)
+
+
+class TestMeshSpecResolve:
+    def test_free_axis_absorbs_remaining_devices(self):
+        assert MeshSpec(dcn=1, data=-1, model=2, seq=1).resolve(8) == {
+            "dcn": 1, "data": 4, "model": 2, "seq": 1,
+        }
+        assert MeshSpec(dcn=2, data=2, model=2, seq=1).resolve(8)["model"] == 2
+
+    def test_non_divisible_device_count_raises(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            MeshSpec(dcn=1, data=-1, model=3, seq=1).resolve(8)
+        with pytest.raises(ValueError, match="!= 8 devices"):
+            MeshSpec(dcn=1, data=3, model=1, seq=1).resolve(8)
+
+    def test_two_free_axes_raise(self):
+        with pytest.raises(ValueError, match="at most one"):
+            MeshSpec(dcn=-1, data=-1, model=1, seq=1).resolve(8)
+
+    def test_zero_or_negative_extents_raise(self):
+        with pytest.raises(ValueError, match="positive or -1"):
+            MeshSpec(dcn=1, data=0, model=1, seq=1).resolve(8)
+        with pytest.raises(ValueError, match="positive or -1"):
+            MeshSpec(dcn=1, data=-2, model=1, seq=1).resolve(8)
+
+    def test_best_effort_mesh_uses_resolve(self):
+        mesh = best_effort_mesh(MeshSpec(dcn=1, data=-1, model=2, seq=2))
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["model"] == 2
+
+
+class TestSeqMesh:
+    def test_builds_seq_only_mesh(self):
+        from cosmos_curate_tpu.parallel.mesh import seq_mesh
+
+        mesh = seq_mesh(4)
+        assert mesh.axis_names == ("seq",)
+        assert mesh.shape["seq"] == 4
+
+    def test_rejects_oversubscription(self):
+        from cosmos_curate_tpu.parallel.mesh import seq_mesh
+
+        with pytest.raises(ValueError, match="needs 16"):
+            seq_mesh(16)
+
+
+class TestBatchSharding:
+    def test_falls_back_to_replication_without_data_axes(self):
+        from cosmos_curate_tpu.parallel.sharding import batch_sharding, batch_shard_count
+
+        devs = np.array(jax.devices()).reshape(2, 4)
+        mesh = Mesh(devs, axis_names=("model", "seq"))  # no dcn/data anywhere
+        sharding = batch_sharding(mesh)
+        assert sharding.spec == P(None)
+        assert batch_shard_count(mesh) == 1
+        x = np.ones((3, 4), np.float32)
+        placed = jax.device_put(x, sharding)
+        assert placed.sharding.is_fully_replicated
+
+    def test_uses_present_data_axes_only(self):
+        from cosmos_curate_tpu.parallel.sharding import batch_shard_count
+
+        mesh = best_effort_mesh(MeshSpec(dcn=2, data=4, model=1, seq=1))
+        assert batch_shard_count(mesh) == 8
+
+
+class TestShardBatchContract:
+    def test_pad_unpad_round_trip(self):
+        from cosmos_curate_tpu.parallel.sharding import unshard_batch
+
+        mesh = best_effort_mesh()
+        tree = {
+            "a": np.arange(5 * 3, dtype=np.float32).reshape(5, 3),
+            "b": np.arange(5, dtype=np.int32),
+        }
+        sharded, pad = shard_batch(mesh, tree)
+        assert pad == 3
+        assert sharded["a"].shape == (8, 3)
+        back = unshard_batch(sharded, pad)
+        np.testing.assert_array_equal(back["a"], tree["a"])
+        np.testing.assert_array_equal(back["b"], tree["b"])
+
+    def test_unshard_noop_when_unpadded(self):
+        from cosmos_curate_tpu.parallel.sharding import unshard_batch
+
+        mesh = best_effort_mesh()
+        x = np.ones((8, 2), np.float32)
+        sharded, pad = shard_batch(mesh, x)
+        assert pad == 0
+        np.testing.assert_array_equal(unshard_batch(sharded, pad), x)
+
+    def test_empty_pytree_raises(self):
+        mesh = best_effort_mesh()
+        with pytest.raises(ValueError, match="empty pytree"):
+            shard_batch(mesh, {})
+
+    def test_mismatched_leading_dims_raise(self):
+        mesh = best_effort_mesh()
+        tree = {"a": np.ones((5, 2)), "b": np.ones((6, 2))}
+        with pytest.raises(ValueError, match=r"leading batch dim: \[5, 6\]"):
+            shard_batch(mesh, tree)
+
+    def test_scalar_leaf_raises(self):
+        mesh = best_effort_mesh()
+        with pytest.raises(ValueError, match="scalar leaf"):
+            shard_batch(mesh, {"a": np.float32(1.0)})
