@@ -11,10 +11,19 @@ end-to-end clips/sec (model compile excluded via warmup; fixture synthesis
 excluded). ``vs_baseline`` compares against the recorded value in
 BENCH_REF.json (first recorded round = 1.0); the reference repo publishes no
 absolute numbers to compare against directly (BASELINE.md).
+
+The split+annotate measurement runs TWICE and the second (warm-cache) pass
+is the headline: r03→r05 drifted 0.215→0.182 on identical code paths, which
+is warmup noise (first-touch page faults, lazy imports, allocator growth)
+that must not be recorded as signal. The cold pass rides along as
+``value_cold``. Per-dispatch device timings (models/device_pipeline.py) are
+summarized per pipeline; ``dispatch_gap_frac`` < 0.2 on the embed pipeline
+is the acceptance bar that H2D/compute actually overlap.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -128,23 +137,30 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         log(f"bench: caption benchmark failed ({e}); clips/s still valid")
 
-    # Warm up the embedder compile outside the timed window (all power-of-2
-    # batch shapes the run will hit).
+    # Warm up the embedder compile outside the timed window. The device
+    # pipeline dispatches pow2 BUCKET micro-batches (cap-sized chunks plus
+    # a pow2 remainder, models/device_pipeline.py:plan_micro_batches), so
+    # the compiled-shape universe for any run batch is exactly {pow2 <=
+    # cap}: warm all of them, or a remainder bucket compiles inside the
+    # timed window and masquerades as throughput loss.
     log("bench: warming up embedder compiles")
     warm = VideoEmbedder(VIDEO_EMBED_BASE)
     warm.setup()
     expected_clips_per_video = int(NUM_SCENES * SCENE_FRAMES / 24.0 / STRIDE_S)
     from cosmos_curate_tpu.models.batching import next_pow2
+    from cosmos_curate_tpu.models.device_pipeline import micro_batch_cap
 
     from cosmos_curate_tpu.pipelines.video.stages.embedding import EMBED_STAGE_TASK_BATCH
 
-    # The embed stage batches across tasks, so the run hits pow2 shapes
-    # between one video's clips and a full task-batch's.
+    # The embed stage batches across tasks, so the run hits bucket shapes
+    # up to min(cap, full task-batch clip count).
     full = next_pow2(expected_clips_per_video * min(EMBED_STAGE_TASK_BATCH, NUM_VIDEOS))
-    single = next_pow2(expected_clips_per_video)
-    b = single
+    cap = micro_batch_cap()
+    # every pow2 <= min(full, cap): when full > cap the loop's last
+    # iteration is cap itself, the only chunk shape used beyond it
     shapes = set()
-    while b <= full:
+    b = 1
+    while b <= min(full, cap):
         shapes.add(b)
         b *= 2
     for b in sorted(shapes):
@@ -177,23 +193,53 @@ def main() -> int:
     choice = os.environ.get("BENCH_RUNNER", "auto")
     cores = os.cpu_count() or 1
     use_engine = choice == "engine" or (choice == "auto" and cores >= 4)
-    if use_engine:
-        from cosmos_curate_tpu.engine.runner import StreamingRunner
 
-        runner = StreamingRunner()
-    else:
-        runner = SequentialRunner()
-    log(f"bench: running split+annotate ({'engine' if use_engine else 'sequential'}, {cores} cores)")
-    t0 = time.monotonic()
-    summary = run_split(args, runner=runner)
-    elapsed = time.monotonic() - t0
+    def make_runner():
+        if use_engine:
+            from cosmos_curate_tpu.engine.runner import StreamingRunner
 
+            return StreamingRunner()
+        return SequentialRunner()
+
+    from cosmos_curate_tpu.observability.stage_timer import (
+        DISPATCH_DUMP_DIR_ENV,
+        dispatch_summaries,
+        load_dumped_summaries,
+        reset_dispatch_stats,
+    )
+
+    # Two passes over identical inputs: pass 1 absorbs residual warmup
+    # (page faults, lazy imports, allocator growth — the r03→r05 drift);
+    # pass 2 (warm) is the headline. Fresh runner + output dir per pass.
+    passes = []
+    for label in ("cold", "warm"):
+        runner = make_runner()
+        pass_args = dataclasses.replace(args, output_path=str(tmp / f"out_{label}"))
+        reset_dispatch_stats()  # per-dispatch stats reflect ONE pass
+        # engine mode runs stages in spawned workers: have each worker dump
+        # its dispatch aggregates at exit so the warm pass still reports
+        os.environ[DISPATCH_DUMP_DIR_ENV] = str(tmp / f"dispatch_{label}")
+        log(
+            f"bench: running split+annotate [{label}] "
+            f"({'engine' if use_engine else 'sequential'}, {cores} cores)"
+        )
+        t0 = time.monotonic()
+        summary = run_split(pass_args, runner=runner)
+        elapsed = time.monotonic() - t0
+        passes.append((summary, elapsed, runner))
+        log(
+            f"bench[{label}]: {summary['num_clips']} clips "
+            f"({summary['num_with_embeddings']} embedded) in {elapsed:.1f}s; "
+            f"video_hours_per_day_per_chip={summary['video_hours_per_day_per_chip']:.1f}"
+        )
+
+    cold_summary, cold_elapsed, _ = passes[0]
+    summary, elapsed, runner = passes[1]
     clips = summary["num_clips"]
     embedded = summary["num_with_embeddings"]
     value = clips / elapsed if elapsed > 0 else 0.0
-    log(
-        f"bench: {clips} clips ({embedded} embedded) in {elapsed:.1f}s; "
-        f"video_hours_per_day_per_chip={summary['video_hours_per_day_per_chip']:.1f}"
+    value_cold = (
+        cold_summary["num_clips"] / cold_elapsed if cold_elapsed > 0 else 0.0
     )
 
     ref_path = REPO / "BENCH_REF.json"
@@ -211,22 +257,46 @@ def main() -> int:
     record = {
         "metric": "clips_per_sec_split_annotate",
         "value": round(value, 3),
+        "value_cold": round(value_cold, 3),
+        "passes": 2,
         "unit": "clips/s",
         "vs_baseline": round(vs, 3),
         "config": config_name,
     }
-    # MFU for the embed stage (reference SPEED_OF_LIGHT.md's efficiency
-    # method, translated to TPU peak via models/flops.py). Only meaningful
-    # against a TPU peak, so suppressed on a CPU-fallback run — a number
-    # computed against v5e peak while running on CPU invites misreading.
+    # MFU + embed-stage wall for the warm pass (reference SPEED_OF_LIGHT.md's
+    # efficiency method via models/flops.py). Reported on EVERY backend —
+    # r02 carried these fields, then they vanished behind a TPU-only gate and
+    # the regressions hid with them. A CPU-fallback run is machine-detectable
+    # via "backend", and its mfu (computed against the TPU peak) reads as
+    # ~0 — flagged, not misleading.
     from cosmos_curate_tpu.models.flops import chip_peak_flops, mfu, video_embed_forward_flops
 
     embed_s = getattr(runner, "stage_times", {}).get("ClipEmbeddingStage", 0.0)
-    if embedded and embed_s > 0 and backend == "tpu":
+    if embedded and embed_s > 0:
         flops = embedded * video_embed_forward_flops(VIDEO_EMBED_BASE)
         record["mfu"] = round(mfu(flops, embed_s), 4)
         record["embed_stage_s"] = round(embed_s, 2)
         record["peak_flops"] = chip_peak_flops()
+    # Per-dispatch device-pipeline timings (warm pass): gap_frac ≈ 0 means
+    # H2D/compute/readback actually overlapped; the acceptance bar is the
+    # embed pipeline's dispatch gap < 20% of its device window. In-process
+    # stats (sequential runner) merge with any worker dumps (engine mode).
+    dispatch = dispatch_summaries()
+    for name, agg in load_dumped_summaries(str(tmp / "dispatch_warm")).items():
+        dispatch.setdefault(name, agg)
+    embed_pipes = {k: v for k, v in dispatch.items() if k.startswith("embed/")}
+    if embed_pipes:
+        gap = sum(v["gap_s"] for v in embed_pipes.values())
+        busy = sum(v["gap_s"] + v["compute_s"] for v in embed_pipes.values())
+        record["dispatch_gap_s"] = round(gap, 3)
+        record["dispatch_gap_frac"] = round(gap / busy, 4) if busy > 0 else 0.0
+        record["dispatches"] = sum(v["dispatches"] for v in embed_pipes.values())
+    if dispatch:
+        log("bench: per-dispatch timings (warm pass): " + json.dumps(dispatch))
+    elif use_engine:
+        # no worker dump landed (workers killed before atexit, or a stage
+        # never dispatched) — nothing to report this pass
+        log("bench: no dispatch stats collected from engine workers")
     if backend != "tpu":
         # degraded run (dead TPU tunnel fallback) must be machine-detectable
         record["backend"] = backend

@@ -45,6 +45,7 @@ def all_rules() -> list[Rule]:
         ShardingConstraintOutsideJitRule,
     )
     from cosmos_curate_tpu.analysis.rules.silent_swallow import SilentSwallowRule
+    from cosmos_curate_tpu.analysis.rules.sync_readback import SyncReadbackRule
 
     return [
         LockDisciplineRule(),
@@ -55,4 +56,5 @@ def all_rules() -> list[Rule]:
         MeshAxisLiteralRule(),
         HardcodedDeviceCountRule(),
         ShardingConstraintOutsideJitRule(),
+        SyncReadbackRule(),
     ]

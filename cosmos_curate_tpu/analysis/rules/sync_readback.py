@@ -1,0 +1,168 @@
+"""sync-readback: blocking host readback directly on a jit result.
+
+``np.asarray(jit_fn(...))`` (and ``jax.device_get`` on a jit call) in
+model/stage code serializes the four engines the async device pipeline
+exists to overlap: the host blocks until the device finishes AND the D2H
+transfer completes before it can even start preparing the next batch.
+The device-pipeline PR (models/device_pipeline.py) removed every instance
+from the hot paths; this rule keeps the pattern from creeping back.
+
+Scope: ``cosmos_curate_tpu/models/`` and ``pipelines/*/stages/`` — the
+code that drives devices. ``models/device_pipeline.py`` itself is exempt:
+its drain IS the one sanctioned readback point.
+
+Detection is name-based, not type-based: a name counts as jit-derived
+when the file binds it (directly or via ``self.``) from
+
+- a ``jax.jit(...)``/``pjit(...)`` call (walked through wrappers like
+  ``shard_map``), or
+- a call to a same-file function whose body contains ``jax.jit``
+  (the ``_jitted_apply``-factory idiom), or
+- it matches the repo's jit-holder naming convention (``_apply``,
+  ``_sample``, ``_jitted*`` attributes).
+
+Flagged: ``np.asarray(<jit-name>(...))`` / ``np.array(...)`` /
+``jax.device_get(...)`` on such a call. ``np.asarray(x)`` on a plain
+variable is not flagged (the dispatch already happened; the rule targets
+the call-and-block-inline idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from cosmos_curate_tpu.analysis.common import Finding
+from cosmos_curate_tpu.analysis.rules import Rule, RuleContext
+
+_NUMPY_CONVERTERS = {"asarray", "array", "ascontiguousarray", "asanyarray"}
+_JIT_HOLDER_CONVENTION = re.compile(r"^_(jitted\w*|apply|sample)$")
+_EXEMPT = ("models/device_pipeline.py",)
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if any(rel.endswith(e) for e in _EXEMPT):
+        return False
+    if "cosmos_curate_tpu/models/" in rel or rel.startswith("models/"):
+        return True
+    return "/stages/" in rel and "pipelines/" in rel
+
+
+def _numpy_aliases(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    names.add(a.asname or "numpy")
+    return names or {"np", "numpy"}
+
+
+def _contains_jax_jit(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr in ("jit", "pjit"):
+                return True
+            if isinstance(f, ast.Name) and f.id in ("jit", "pjit"):
+                return True
+    return False
+
+
+def _collect_jit_names(tree: ast.Module) -> set[str]:
+    """Names (bare or ``self.<attr>`` attrs) bound from jit-producing
+    expressions, including through same-file jit factories."""
+    factories: set[str] = {
+        node.name
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _contains_jax_jit(node)
+    }
+
+    def value_is_jitty(value: ast.expr) -> bool:
+        if _contains_jax_jit(value):
+            return True
+        for n in ast.walk(value):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in factories
+            ):
+                return True
+        return False
+
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None or not value_is_jitty(value):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.add(t.attr)
+    return names
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class SyncReadbackRule(Rule):
+    rule_id = "sync-readback"
+    description = (
+        "np.asarray / jax.device_get blocking directly on a jit call in "
+        "model/stage code — dispatch through models/device_pipeline.py "
+        "(submit + deferred drain) instead"
+    )
+
+    def check(self, ctx: RuleContext) -> list[Finding]:
+        if not _in_scope(ctx.rel_path):
+            return []
+        np_names = _numpy_aliases(ctx.tree)
+        jit_names = _collect_jit_names(ctx.tree)
+
+        def is_jit_call(expr: ast.expr) -> bool:
+            if not isinstance(expr, ast.Call):
+                return False
+            name = _callee_name(expr)
+            if name is None:
+                return False
+            return name in jit_names or bool(_JIT_HOLDER_CONVENTION.match(name))
+
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) or not isinstance(f.value, ast.Name):
+                continue
+            owner, attr = f.value.id, f.attr
+            flagged = None
+            if owner in np_names and attr in _NUMPY_CONVERTERS:
+                if node.args and is_jit_call(node.args[0]):
+                    flagged = f"{owner}.{attr}(<jit call>)"
+            elif owner == "jax" and attr == "device_get":
+                # device_get has no deferred form at all — flag any use in
+                # device-driving code, jit call or not
+                flagged = "jax.device_get(...)"
+            if flagged:
+                findings.append(
+                    Finding(
+                        ctx.rel_path, node.lineno, self.rule_id,
+                        f"{flagged} blocks the host on device compute + D2H "
+                        "inline; submit through DevicePipeline and drain "
+                        "(models/device_pipeline.py) so transfer, compute, "
+                        "and readback overlap",
+                    )
+                )
+        return findings

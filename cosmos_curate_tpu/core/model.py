@@ -6,6 +6,15 @@ Equivalent of the reference's ``ModelInterface``
 (model/model_utils.py:139 in the reference); ``setup()`` runs inside the
 worker and must leave the model ready for inference (for JAX models: params
 loaded on device, forward jitted or ready to jit).
+
+Device-dispatch contract: JAX models do NOT block on readback inline
+(``np.asarray(jit_fn(...))`` — the sync-readback lint rule rejects it).
+``setup()`` constructs a ``models.device_pipeline.DevicePipeline`` around
+the jitted apply and inference entry points dispatch through it, so H2D
+transfer, device compute, and D2H readback overlap across micro-batches.
+Models with a submit/drain surface (the SR family) expose
+``submit_window``/``drain_windows`` on top of the same pipeline;
+``device_pipeline`` below gives stages and diagnostics uniform access.
 """
 
 from __future__ import annotations
@@ -20,6 +29,14 @@ class ModelInterface(abc.ABC):
     def env_name(self) -> str:
         """Advisory execution-environment tag (see core.stage docstring)."""
         return "default"
+
+    @property
+    def device_pipeline(self):
+        """The model's DevicePipeline after ``setup()``, else None.
+
+        None also for models whose device work runs elsewhere (the caption
+        engine's continuous-batching loop is its own dispatch point)."""
+        return getattr(self, "_pipeline", None)
 
     @property
     @abc.abstractmethod

@@ -19,10 +19,15 @@ _SINGLETON: "EngineMetrics | None" = None
 
 def get_metrics(port: int | None = None) -> "EngineMetrics":
     """Process-wide singleton: prometheus collectors register globally, so a
-    second EngineMetrics in the same process would collide."""
+    second EngineMetrics in the same process would collide. A port passed
+    after the singleton exists still starts the exporter — the device
+    pipeline may record dispatches (creating the singleton portless)
+    before the runner asks for the HTTP server."""
     global _SINGLETON
     if _SINGLETON is None:
         _SINGLETON = EngineMetrics(port)
+    elif port is not None:
+        _SINGLETON.ensure_server(port)
     return _SINGLETON
 
 
@@ -30,7 +35,7 @@ class EngineMetrics:
     def __init__(self, port: int | None = None) -> None:
         self.enabled = False
         try:
-            from prometheus_client import Counter, Gauge, start_http_server
+            from prometheus_client import Counter, Gauge
         except ImportError:
             return
         labels = ["stage"]
@@ -45,13 +50,44 @@ class EngineMetrics:
         self.tasks_total = Counter("pipeline_tasks_processed_total", "tasks out", labels)
         self.errors_total = Counter("pipeline_task_errors_total", "batch errors", labels)
         self.store_bytes = Gauge("pipeline_object_store_bytes", "object store usage", [])
-        if port is not None:
-            try:
-                start_http_server(port)
-                logger.info("prometheus metrics on :%d", port)
-            except OSError as e:
-                logger.warning("metrics server failed to start: %s", e)
+        # Per-dispatch device-pipeline signal (models/device_pipeline.py):
+        # gap = device idle between micro-batches. The autoscaler's tuning
+        # target is gap ≈ 0 (host prep keeps the device fed); a rising
+        # gap/compute ratio on a stage means it needs more CPU prep workers,
+        # not more device workers.
+        self.dispatches_total = Counter(
+            "pipeline_device_dispatches_total", "device micro-batch dispatches", labels
+        )
+        self.dispatch_gap_total = Counter(
+            "pipeline_device_dispatch_gap_seconds_total",
+            "device idle between micro-batches", labels,
+        )
+        self.dispatch_compute_total = Counter(
+            "pipeline_device_compute_seconds_total", "device busy seconds", labels
+        )
+        self.dispatch_h2d_total = Counter(
+            "pipeline_device_h2d_seconds_total", "host->device transfer seconds", labels
+        )
+        self.dispatch_d2h_total = Counter(
+            "pipeline_device_d2h_seconds_total", "device->host readback seconds", labels
+        )
+        self._server_started = False
         self.enabled = True
+        if port is not None:
+            self.ensure_server(port)
+
+    def ensure_server(self, port: int) -> None:
+        """Start the exporter once; safe to call after construction."""
+        if not self.enabled or self._server_started:
+            return
+        from prometheus_client import start_http_server
+
+        try:
+            start_http_server(port)
+            self._server_started = True
+            logger.info("prometheus metrics on :%d", port)
+        except OSError as e:
+            logger.warning("metrics server failed to start: %s", e)
 
     def observe_result(self, stage: str, process_s: float, deser_s: float, n_out: int) -> None:
         if not self.enabled:
@@ -63,6 +99,18 @@ class EngineMetrics:
     def observe_error(self, stage: str) -> None:
         if self.enabled:
             self.errors_total.labels(stage).inc()
+
+    def observe_dispatch(
+        self, stage: str, *, gap_s: float, compute_s: float = 0.0,
+        h2d_s: float = 0.0, d2h_s: float = 0.0,
+    ) -> None:
+        if not self.enabled:
+            return
+        self.dispatches_total.labels(stage).inc()
+        self.dispatch_gap_total.labels(stage).inc(max(gap_s, 0.0))
+        self.dispatch_compute_total.labels(stage).inc(max(compute_s, 0.0))
+        self.dispatch_h2d_total.labels(stage).inc(max(h2d_s, 0.0))
+        self.dispatch_d2h_total.labels(stage).inc(max(d2h_s, 0.0))
 
     def set_pool_state(self, stage: str, ready: int, pending: int, queued: int) -> None:
         if not self.enabled:

@@ -19,14 +19,36 @@ def next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def pad_to(x: np.ndarray, target: int) -> np.ndarray:
+    """Pad x's leading dim to exactly ``target`` rows by repeating the last
+    row (padded rows stay in-distribution). The pad block is a broadcast
+    VIEW of the last row — the only materialization is the concat output
+    itself, so peak host memory is output + input, not output + input +
+    an np.repeat copy of the pad rows (~2x lower for near-pow2 batches)."""
+    n = x.shape[0]
+    if target < n:
+        raise ValueError(f"pad_to target {target} < batch size {n}")
+    if target == n:
+        return x
+    pad = np.broadcast_to(x[-1:], (target - n, *x.shape[1:]))
+    return np.concatenate([x, pad], axis=0)
+
+
 def pad_batch(x: np.ndarray, *, max_pad_to: int = 4096) -> tuple[np.ndarray, int]:
     """Pad x's leading dim to the next power of two (repeating the last row,
-    so padded rows stay in-distribution). Returns (padded, original_n)."""
+    so padded rows stay in-distribution). Returns (padded, original_n).
+
+    A batch already past ``max_pad_to`` is returned unpadded: the cap
+    exists to bound pad waste at huge sizes, not to truncate work — the
+    caller's batch shape becomes the compiled shape."""
     n = x.shape[0]
     if n == 0:
         return x, 0
+    if max_pad_to < 1:
+        raise ValueError(f"max_pad_to must be >= 1, got {max_pad_to}")
+    if n >= max_pad_to:
+        return x, n
     target = min(next_pow2(n), max_pad_to)
     if target <= n:
         return x, n
-    reps = np.repeat(x[-1:], target - n, axis=0)
-    return np.concatenate([x, reps], axis=0), n
+    return pad_to(x, target), n

@@ -20,8 +20,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cosmos_curate_tpu.models.batching import pad_batch
-
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
 from cosmos_curate_tpu.models.vit import VIT_B_16, VIT_L_14, VIT_TINY_TEST, ViT, ViTConfig, preprocess_frames
@@ -62,18 +60,20 @@ class AestheticMLP(nn.Module):
 
 @functools.lru_cache(maxsize=8)
 def _jitted_embed(cfg: ViTConfig):
-    """Compiled embed shared across instances (see embedder._jitted_apply)."""
+    """Compiled embed shared across instances (see embedder._jitted_apply).
+    Frames (arg 1) donated on TPU/GPU — no result alias, just HBM churn."""
+    from cosmos_curate_tpu.models.device_pipeline import donate_kwargs
+
     model = ViT(cfg)
     size = cfg.image_size
 
-    @jax.jit
     def embed(params, frames_u8):
         pixels = preprocess_frames(frames_u8, image_size=size, mode=cfg.preprocess)
         pooled, _ = model.apply(params, pixels)
         pooled = pooled.astype(jnp.float32)
         return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
 
-    return embed
+    return jax.jit(embed, **donate_kwargs(1))
 
 
 class CLIPImageEmbeddings(ModelInterface):
@@ -86,6 +86,7 @@ class CLIPImageEmbeddings(ModelInterface):
         self.cfg = _CONFIGS[variant]
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -108,16 +109,18 @@ class CLIPImageEmbeddings(ModelInterface):
 
         self._params = registry.load_params(self.variant, init)
         self._apply = _jitted_embed(self.cfg)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipeline = DevicePipeline(f"clip/{self.variant}", self._apply)
 
     def encode_frames(self, frames_u8: np.ndarray) -> np.ndarray:
         """uint8 [N, H, W, 3] -> float32 [N, P] L2-normalized.
 
-        Batches are padded to power-of-two sizes so XLA compiles a handful
-        of shapes instead of one per distinct clip count."""
-        if self._apply is None:
+        Dispatched through the shared DevicePipeline: pow2 bucket
+        micro-batches overlap H2D transfer, compute, and readback."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
-        padded, n = pad_batch(frames_u8)
-        return np.asarray(self._apply(self._params, padded))[:n]
+        return self._pipeline.run(self._params, frames_u8)
 
 
 class AestheticScorer(ModelInterface):
@@ -129,6 +132,7 @@ class AestheticScorer(ModelInterface):
         self.embedding_dim = embedding_dim
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -141,13 +145,15 @@ class AestheticScorer(ModelInterface):
             return model.init(jax.random.PRNGKey(seed), jnp.zeros((1, self.embedding_dim)))
 
         self._params = registry.load_params(self.MODEL_ID, init)
-        self._apply = jax.jit(model.apply)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline, donate_kwargs
+
+        self._apply = jax.jit(model.apply, **donate_kwargs(1))
+        self._pipeline = DevicePipeline("aesthetic-mlp", self._apply)
 
     def score(self, embeddings: np.ndarray) -> np.ndarray:
-        if self._apply is None:
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
-        padded, n = pad_batch(embeddings)
-        return np.asarray(self._apply(self._params, padded))[:n]
+        return self._pipeline.run(self._params, embeddings)
 
 
 class CLIPAestheticScorer(ModelInterface):

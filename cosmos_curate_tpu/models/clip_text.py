@@ -103,6 +103,7 @@ class CLIPTextEmbeddings(ModelInterface):
         self.cfg = self._CONFIGS[variant]
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -120,21 +121,21 @@ class CLIPTextEmbeddings(ModelInterface):
 
         self._params = registry.load_params(self.variant, init)
 
-        @jax.jit
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline, donate_kwargs
+
         def embed(params, ids):
             pooled, _ = model.apply(params, ids)
             return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
 
-        self._apply = embed
+        self._apply = jax.jit(embed, **donate_kwargs(1))
+        self._pipeline = DevicePipeline(f"clip-text/{self.variant}", self._apply)
 
     def encode_ids(self, ids: np.ndarray) -> np.ndarray:
-        """int32 [N, T] (EOT appended, pad after) -> float32 [N, P]."""
-        if self._apply is None:
+        """int32 [N, T] (EOT appended, pad after) -> float32 [N, P].
+        Dispatched through the shared DevicePipeline."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
-        from cosmos_curate_tpu.models.batching import pad_batch
-
-        padded, n = pad_batch(np.asarray(ids, np.int32))
-        return np.asarray(self._apply(self._params, padded))[:n]
+        return self._pipeline.run(self._params, np.asarray(ids, np.int32))
 
 
 registry.register_model("clip-text-b-tpu", "CLIP text tower, ViT-B width (Flax)")

@@ -202,6 +202,7 @@ class DiffusionSRModel(ModelInterface):
         self.sp_size = sp_size  # window chunks sharded over 'seq' when > 1
         self._sample = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -228,24 +229,46 @@ class DiffusionSRModel(ModelInterface):
             from jax.sharding import PartitionSpec as P
 
             mesh = seq_mesh(self.sp_size)
-            self._sample = jax.jit(
-                shard_map(
-                    sample_chunks,
-                    mesh=mesh,
-                    in_specs=(P(), P(axes.SEQ), P(axes.SEQ)),
-                    out_specs=P(axes.SEQ),
-                    check_vma=False,
-                )
+            inner = shard_map(
+                sample_chunks,
+                mesh=mesh,
+                in_specs=(P(), P(axes.SEQ), P(axes.SEQ)),
+                out_specs=P(axes.SEQ),
+                check_vma=False,
             )
         else:
-            self._sample = jax.jit(sample_chunks)
+            inner = sample_chunks
 
-    def upscale_window(self, frames: np.ndarray) -> np.ndarray:
-        """uint8 [T, H, W, 3] -> uint8 [T, H*scale, W*scale, 3]."""
-        if self._sample is None:
+        def upscale(params, frames_u8, seeds):
+            """The whole window path under ONE jit — bilinear base, chunked
+            DDIM sampling, residual combine, uint8 quantize — so a window
+            is a single async dispatch through the DevicePipeline instead
+            of eager device ops bracketing a jitted core."""
+            t_pad, h, w = frames_u8.shape[:3]
+            base = jax.image.resize(
+                frames_u8.astype(jnp.float32) / 255.0,
+                (t_pad, h * cfg.scale, w * cfg.scale, 3),
+                "bilinear",
+            )
+            n_chunk = t_pad // cfg.window
+            conds = base.reshape(n_chunk, cfg.window, h * cfg.scale, w * cfg.scale, 3)
+            keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            residual = inner(params, conds, keys)
+            out = jnp.clip(conds + residual, 0.0, 1.0)
+            out = out.reshape(t_pad, h * cfg.scale, w * cfg.scale, 3)
+            return (out * 255.0).astype(jnp.uint8)
+
+        self._sample = jax.jit(upscale)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipeline = DevicePipeline("sr/diffusion", self._sample)
+
+    def submit_window(self, frames: np.ndarray) -> None:
+        """Queue one window; results resolve in order at drain_windows()."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
         cfg = self.cfg
-        t, h, w = frames.shape[:3]
+        t = frames.shape[0]
         # fixed-shape chunking: pad the frame axis to a window multiple
         # (and to the sp shard multiple), one compiled program per shape
         n_chunk = -(-t // cfg.window)
@@ -253,15 +276,18 @@ class DiffusionSRModel(ModelInterface):
             n_chunk += (-n_chunk) % self.sp_size
         t_pad = n_chunk * cfg.window
         if t_pad != t:
-            frames = np.concatenate([frames, np.repeat(frames[-1:], t_pad - t, 0)])
-        base = jax.image.resize(
-            jnp.asarray(frames, jnp.float32) / 255.0,
-            (t_pad, h * cfg.scale, w * cfg.scale, 3),
-            "bilinear",
-        )
-        conds = base.reshape(n_chunk, cfg.window, h * cfg.scale, w * cfg.scale, 3)
+            from cosmos_curate_tpu.models.batching import pad_to
+
+            frames = pad_to(frames, t_pad)
         # per-chunk FIXED seeds: identical input -> identical output
-        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(n_chunk, dtype=jnp.uint32))
-        residual = self._sample(self._params, conds, keys)
-        out = jnp.clip(conds + residual, 0.0, 1.0).reshape(t_pad, h * cfg.scale, w * cfg.scale, 3)
-        return np.asarray((out * 255.0).astype(jnp.uint8))[:t]
+        seeds = np.arange(n_chunk, dtype=np.uint32)
+        self._pipeline.submit(self._params, frames, seeds, n_valid=t)
+
+    def drain_windows(self) -> list[np.ndarray]:
+        return self._pipeline.drain()
+
+    def upscale_window(self, frames: np.ndarray) -> np.ndarray:
+        """uint8 [T, H, W, 3] -> uint8 [T, H*scale, W*scale, 3]
+        (synchronous single-window path)."""
+        self.submit_window(frames)
+        return self.drain_windows()[0]

@@ -99,9 +99,13 @@ class VideoEmbedModel(nn.Module):
 def _jitted_apply(cfg: VideoEmbedConfig):
     """Compiled apply shared across instances of the same config — jit
     caches are per function object, so per-instance jits would recompile
-    (and defeat warmup) every time a stage constructs its own model."""
+    (and defeat warmup) every time a stage constructs its own model.
+    The frame batch (arg 1) is donated on TPU/GPU: HBM churn, not a
+    result alias (uint8 in, f32 out)."""
+    from cosmos_curate_tpu.models.device_pipeline import donate_kwargs
+
     model = VideoEmbedModel(cfg)
-    return jax.jit(model.apply)
+    return jax.jit(model.apply, **donate_kwargs(1))
 
 
 class VideoEmbedder(ModelInterface):
@@ -114,6 +118,7 @@ class VideoEmbedder(ModelInterface):
         self.model_id = model_id or self.MODEL_ID
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -133,6 +138,9 @@ class VideoEmbedder(ModelInterface):
 
         self._params = registry.load_params(self.model_id, init)
         self._apply = _jitted_apply(self.cfg)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipeline = DevicePipeline(f"embed/{self.model_id}", self._apply)
 
     def sample_frame_indices(self, total: int) -> np.ndarray:
         """Uniform temporal sampling to cfg.num_frames indices."""
@@ -143,10 +151,8 @@ class VideoEmbedder(ModelInterface):
 
     def encode_clips(self, clips_frames: np.ndarray) -> np.ndarray:
         """uint8 [B, T, H, W, 3] -> float32 [B, output_dim] normalized.
-        Batch padded to power-of-two sizes (bounded compile count)."""
-        if self._apply is None:
+        Dispatched through the shared DevicePipeline: pow2 bucket
+        micro-batches, H2D/compute/D2H overlapped, readback deferred."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
-        from cosmos_curate_tpu.models.batching import pad_batch
-
-        padded, n = pad_batch(clips_frames)
-        return np.asarray(self._apply(self._params, padded))[:n]
+        return self._pipeline.run(self._params, clips_frames)

@@ -285,12 +285,15 @@ _APPLY_CACHE: dict[tuple, object] = {}
 
 def _jitted_apply(cfg: IV2Config, dtype):
     """One compiled apply per (config, dtype) — shared across stage
-    instances so warmup survives stage construction."""
+    instances so warmup survives stage construction. The clip batch
+    (arg 1) is donated on TPU/GPU."""
     key = (cfg, str(dtype))
     fn = _APPLY_CACHE.get(key)
     if fn is None:
+        from cosmos_curate_tpu.models.device_pipeline import donate_kwargs
+
         model = InternVideo2Tower(cfg, dtype=dtype)
-        fn = jax.jit(model.apply)
+        fn = jax.jit(model.apply, **donate_kwargs(1))
         _APPLY_CACHE[key] = fn
     return fn
 
@@ -312,6 +315,7 @@ class IV2Embedder(ModelInterface):
         self.dtype = dtype
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -335,6 +339,9 @@ class IV2Embedder(ModelInterface):
             self.model_id, init, require=self.require_weights
         )
         self._apply = _jitted_apply(self.cfg, self.dtype)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipeline = DevicePipeline(f"iv2/{self.model_id}", self._apply)
 
     def sample_frame_indices(self, total: int) -> np.ndarray:
         """Uniform temporal sampling to cfg.num_frames (the reference
@@ -359,10 +366,10 @@ class IV2Embedder(ModelInterface):
         return out
 
     def encode_clips(self, clips_frames: np.ndarray) -> np.ndarray:
-        """uint8 [B, T, H, W, 3] -> float32 [B, proj_dim] l2-normalized."""
-        if self._apply is None:
+        """uint8 [B, T, H, W, 3] -> float32 [B, proj_dim] l2-normalized.
+        Dispatched through the shared DevicePipeline (bucket micro-batches,
+        overlapped transfer/compute/readback)."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
-        from cosmos_curate_tpu.models.batching import pad_batch
-
-        padded, n = pad_batch(self._resize(clips_frames))
-        return np.asarray(self._apply(self._params, padded))[:n].astype(np.float32)
+        emb = self._pipeline.run(self._params, self._resize(clips_frames))
+        return emb.astype(np.float32)

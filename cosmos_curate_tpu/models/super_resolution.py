@@ -86,6 +86,7 @@ class SuperResolutionModel(ModelInterface):
         self.sp_size = sp_size  # frames sharded over 'seq' when > 1
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -116,15 +117,31 @@ class SuperResolutionModel(ModelInterface):
                 )
             )
         else:
-            self._apply = jax.jit(model.apply)
+            from cosmos_curate_tpu.models.device_pipeline import donate_kwargs
 
-    def upscale_window(self, frames: np.ndarray) -> np.ndarray:
-        if self._apply is None:
+            self._apply = jax.jit(model.apply, **donate_kwargs(1))
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipeline = DevicePipeline("sr/srnet", self._apply)
+
+    def submit_window(self, frames: np.ndarray) -> None:
+        """Queue one window for upscaling; results resolve in submission
+        order at drain_windows(). The SR stage submits every window of a
+        clip before reading any back, so H2D, compute, and D2H pipeline
+        across the window loop."""
+        if self._pipeline is None:
             raise RuntimeError("call setup() first")
         t = frames.shape[0]
         if self.sp_size > 1:  # pad frame count to the sp shard multiple
-            pad = (-t) % self.sp_size
-            if pad:
-                frames = np.concatenate([frames, np.repeat(frames[-1:], pad, 0)])
-        out = np.asarray(self._apply(self._params, frames))
-        return out[:t]
+            from cosmos_curate_tpu.models.batching import pad_to
+
+            frames = pad_to(frames, t + (-t) % self.sp_size)
+        self._pipeline.submit(self._params, frames, n_valid=t)
+
+    def drain_windows(self) -> list[np.ndarray]:
+        return self._pipeline.drain()
+
+    def upscale_window(self, frames: np.ndarray) -> np.ndarray:
+        """Synchronous single-window path (tests, ad-hoc callers)."""
+        self.submit_window(frames)
+        return self.drain_windows()[0]

@@ -24,7 +24,6 @@ import numpy as np
 
 from cosmos_curate_tpu.core.model import ModelInterface
 from cosmos_curate_tpu.models import registry
-from cosmos_curate_tpu.models.batching import pad_batch
 from cosmos_curate_tpu.models.layers import dense
 
 
@@ -208,6 +207,7 @@ class T5EncoderTPU(ModelInterface):
         self.tokenizer = tokenizer
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     def _resolve_tokenizer(self):
         """Staged ``tokenizer.json`` (exact T5 ids) wins; the byte fallback
@@ -266,7 +266,10 @@ class T5EncoderTPU(ModelInterface):
             return model.init(jax.random.PRNGKey(seed), ids, jnp.ones((1, 8), bool))
 
         self._params = registry.load_params(self.MODEL_ID, init)
-        self._apply = jax.jit(model.apply)
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline, donate_kwargs
+
+        self._apply = jax.jit(model.apply, **donate_kwargs(1, 2))
+        self._pipeline = DevicePipeline("t5-encode", self._apply)
 
     def encode(self, texts: list[str]) -> list[EncodedSample]:
         if self._apply is None:
@@ -295,9 +298,10 @@ class T5EncoderTPU(ModelInterface):
         for i, e in enumerate(encoded):
             ids[i, : len(e)] = e
             mask[i, : len(e)] = True
-        ids_p, n = pad_batch(ids)
-        mask_p, _ = pad_batch(mask)
-        emb = np.asarray(self._apply(self._params, ids_p, mask_p))[:n]
+        n = len(texts)
+        # batch axis bucketing + async dispatch via the shared pipeline
+        # (ids and mask pad together along axis 0)
+        emb = self._pipeline.run(self._params, ids, mask)
         return [
             EncodedSample(
                 text=texts[i],

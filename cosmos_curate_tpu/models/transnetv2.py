@@ -119,6 +119,7 @@ class TransNetV2TPU(ModelInterface):
         self.cfg = cfg
         self._apply = None
         self._params = None
+        self._pipeline = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -132,7 +133,12 @@ class TransNetV2TPU(ModelInterface):
             return model.init(jax.random.PRNGKey(seed), dummy)
 
         self._params = registry.load_params(self.MODEL_ID, init)
-        self._apply = jax.jit(lambda p, x: jax.nn.sigmoid(model.apply(p, x)))
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline, donate_kwargs
+
+        self._apply = jax.jit(
+            lambda p, x: jax.nn.sigmoid(model.apply(p, x)), **donate_kwargs(1)
+        )
+        self._pipeline = DevicePipeline("transnet", self._apply)
 
     def predict_transitions(self, frames: np.ndarray) -> np.ndarray:
         """frames: uint8 [T, H, W, 3] (any H/W; resized on host) -> [T]
@@ -157,9 +163,12 @@ class TransNetV2TPU(ModelInterface):
                 windows[i, len(chunk):] = chunk[-1]
         probs_sum = np.zeros(t, np.float64)
         probs_cnt = np.zeros(t, np.float64)
+        # submit every window batch before reading any back: H2D of batch
+        # k+1 and compute of k overlap, readback resolves at drain
         for i in range(0, len(starts), self.batch_windows):
-            batch = windows[i : i + self.batch_windows]
-            out = np.asarray(self._apply(self._params, batch))
+            self._pipeline.submit(self._params, windows[i : i + self.batch_windows])
+        outs = self._pipeline.drain()
+        for i, out in zip(range(0, len(starts), self.batch_windows), outs):
             for j, s in enumerate(starts[i : i + self.batch_windows]):
                 end = min(s + WINDOW, t)
                 probs_sum[s:end] += out[j, : end - s]

@@ -1,15 +1,27 @@
-"""Per-task stage timing statistics.
+"""Per-task stage timing statistics + per-dispatch device timings.
 
 Equivalent capability of the reference's ``StageTimer``
 (cosmos_curate/core/utils/infra/performance_utils.py — per-task wall/idle
 stats behind ``--perf-profile``, feeding the summary and spans).
+
+``DispatchRecord``/``record_dispatch`` carry the finer-grained signal the
+async device pipeline (models/device_pipeline.py) emits per micro-batch:
+H2D transfer, device compute, D2H readback, and — the number that proves
+or disproves overlap — the *dispatch gap*, the wall time the device sat
+idle between finishing micro-batch k and receiving k+1. A synchronous
+dispatch loop shows gap ≈ host batch-prep time; a pipelined one shows ~0.
+The per-stage aggregates feed bench.py and engine/metrics.py (autoscaler
+and tuning read the exported gauges).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import numpy as np
 
@@ -47,3 +59,139 @@ class StageTimer:
             "max_s": float(arr.max()),
             "idle_s": self.idle_s,
         }
+
+
+@dataclass(frozen=True)
+class DispatchRecord:
+    """One device micro-batch dispatch, as observed from the host."""
+
+    h2d_s: float  # jax.device_put of the host micro-batch
+    compute_s: float  # device busy time (after the previous batch finished)
+    d2h_s: float  # deferred np.asarray readback at drain
+    gap_s: float  # device idle between previous completion and this dispatch
+    rows: int  # valid rows in the micro-batch
+    padded_rows: int  # rows actually dispatched (bucket size)
+
+
+# Aggregates per pipeline name — NOT a record log: a long-lived engine
+# worker dispatches millions of micro-batches over a run, so per-record
+# retention would grow without bound for data nothing reads (the prometheus
+# counters already carry the stream).
+_DISPATCH_LOCK = threading.Lock()
+_DISPATCH: dict[str, dict] = {}
+
+# When set, every process that recorded dispatches writes its aggregate
+# summaries to <dir>/dispatch-<pid>.json at exit — how engine WORKERS get
+# their stats back to a parent (bench.py) that wants one merged view.
+DISPATCH_DUMP_DIR_ENV = "CURATE_DISPATCH_DUMP_DIR"
+_DUMP_REGISTERED = False
+
+
+def _new_agg() -> dict:
+    return {
+        "dispatches": 0, "rows": 0, "padded_rows": 0,
+        "h2d_s": 0.0, "compute_s": 0.0, "d2h_s": 0.0, "gap_s": 0.0,
+    }
+
+
+def record_dispatch(name: str, rec: DispatchRecord) -> None:
+    """Fold one dispatch into the per-name aggregate and forward the
+    gap/compute signal to the engine's prometheus gauges (no-op when the
+    exporter is absent)."""
+    with _DISPATCH_LOCK:
+        agg = _DISPATCH.setdefault(name, _new_agg())
+        agg["dispatches"] += 1
+        agg["rows"] += rec.rows
+        agg["padded_rows"] += rec.padded_rows
+        agg["h2d_s"] += rec.h2d_s
+        agg["compute_s"] += rec.compute_s
+        agg["d2h_s"] += rec.d2h_s
+        agg["gap_s"] += rec.gap_s
+    _maybe_register_dump()
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_dispatch(
+            name, gap_s=rec.gap_s, compute_s=rec.compute_s,
+            h2d_s=rec.h2d_s, d2h_s=rec.d2h_s,
+        )
+    except Exception:  # metrics must never take down a dispatch path
+        pass
+
+
+def _maybe_register_dump() -> None:
+    global _DUMP_REGISTERED
+    if _DUMP_REGISTERED or not os.environ.get(DISPATCH_DUMP_DIR_ENV):
+        return
+    import atexit
+
+    # resolve the env var at EXIT time, not registration time: a process
+    # spanning several phases (bench's cold/warm passes) must dump where
+    # the var points when it dies, not where it pointed at first dispatch
+    atexit.register(_dump_summaries, None)
+    _DUMP_REGISTERED = True
+
+
+def _dump_summaries(path: str | None) -> None:
+    try:
+        import json
+
+        path = path or os.environ.get(DISPATCH_DUMP_DIR_ENV)
+        if not path:
+            return
+        d = Path(path)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / f"dispatch-{os.getpid()}.json").write_text(json.dumps(dispatch_summaries()))
+    except Exception:  # a failed dump must never break process exit
+        pass
+
+
+def load_dumped_summaries(path: str) -> dict[str, dict]:
+    """Merge dispatch summaries dumped by other processes (engine workers)
+    under ``path`` into one name -> aggregate view."""
+    import json
+
+    merged: dict[str, dict] = {}
+    d = Path(path)
+    if not d.is_dir():
+        return merged
+    for f in sorted(d.glob("dispatch-*.json")):
+        try:
+            data = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        for name, agg in data.items():
+            into = merged.setdefault(name, _new_agg())
+            for k in into:
+                into[k] += agg.get(k, 0)
+    for agg in merged.values():
+        busy = agg["gap_s"] + agg["compute_s"]
+        agg["gap_frac"] = round(agg["gap_s"] / busy, 4) if busy > 0 else 0.0
+    return merged
+
+
+def reset_dispatch_stats() -> None:
+    with _DISPATCH_LOCK:
+        _DISPATCH.clear()
+
+
+def dispatch_summaries() -> dict[str, dict]:
+    """name -> aggregate per-dispatch timings. ``gap_frac`` is device idle
+    over total device-relevant wall (gap + compute): < 0.2 means the host
+    kept the device fed for >80% of the stage's device window."""
+    out: dict[str, dict] = {}
+    with _DISPATCH_LOCK:
+        items = {k: dict(v) for k, v in _DISPATCH.items()}
+    for name, agg in items.items():
+        busy = agg["gap_s"] + agg["compute_s"]
+        out[name] = {
+            "dispatches": agg["dispatches"],
+            "rows": agg["rows"],
+            "padded_rows": agg["padded_rows"],
+            "h2d_s": round(agg["h2d_s"], 4),
+            "compute_s": round(agg["compute_s"], 4),
+            "d2h_s": round(agg["d2h_s"], 4),
+            "gap_s": round(agg["gap_s"], 4),
+            "gap_frac": round(agg["gap_s"] / busy, 4) if busy > 0 else 0.0,
+        }
+    return out

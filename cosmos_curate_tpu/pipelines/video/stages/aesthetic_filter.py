@@ -3,8 +3,12 @@
 Equivalent capability of the reference's ``AestheticFilterStage``
 (cosmos_curate/pipelines/video/filtering/aesthetics/
 aesthetic_filter_stages.py:41). The batch across *all clips in the task* is
-scored in one device call — the TPU-first replacement for fractional-GPU
-packing (SURVEY.md §7): aggregate batches, not fractional devices.
+scored in one logical device call — the TPU-first replacement for
+fractional-GPU packing (SURVEY.md §7): aggregate batches, not fractional
+devices. Both the CLIP tower and the MLP head dispatch through the shared
+``DevicePipeline`` (models/device_pipeline.py): pow2 bucket micro-batches
+with overlapped H2D/compute/readback instead of a blocking ``np.asarray``
+per call.
 """
 
 from __future__ import annotations

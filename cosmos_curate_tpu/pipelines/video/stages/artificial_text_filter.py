@@ -66,6 +66,7 @@ class ArtificialTextFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.mode = mode
         self.learned_threshold = learned_threshold
         self._ocr = None
+        self._pipe = None  # DevicePipeline for the heuristic jit, per worker
 
     @property
     def resources(self) -> Resources:
@@ -94,35 +95,73 @@ class ArtificialTextFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
             self._ocr = ocr
         # auto with no staged checkpoint: stay on the heuristic path
 
+    def _pipeline(self):
+        if self._pipe is None:
+            from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+            self._pipe = DevicePipeline("text-filter", _text_likeness)
+        return self._pipe
+
+    def _score_learned(self, frames) -> tuple[float, float]:
+        """Learned-detector score (synchronous; OcrModel owns its jits)."""
+        # fixed 4-frame sample: one batch shape -> one XLA compile
+        idx = np.linspace(0, len(frames) - 1, 4).astype(int)
+        return self._ocr.text_coverage(frames[idx]), self.learned_threshold
+
     def _score(self, frames) -> tuple[float, float]:
-        """-> (score, effective_threshold) under the active detector."""
+        """Synchronous single-clip (score, threshold) under the active
+        detector — the submit-everything path in process_data is the hot
+        loop; this is for tests and ad-hoc callers."""
         if self._ocr is not None:
-            # fixed 4-frame sample: one batch shape -> one XLA compile
-            idx = np.linspace(0, len(frames) - 1, 4).astype(int)
-            return (
-                self._ocr.text_coverage(frames[idx]),
-                self.learned_threshold,
-            )
+            return self._score_learned(frames)
         padded, n = pad_batch(frames)
-        return float(_text_likeness(padded, n)), self.threshold
+        self._pipeline().submit(padded, n)
+        return float(self._pipeline().drain()[-1]), self.threshold
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         key = self.extraction.key()
+        # Phase 1 — dispatch every heuristic score through the
+        # DevicePipeline before reading any back (learned OCR scores stay
+        # synchronous); one drain resolves them in submission order.
+        scores: dict[int, float] = {}
+        thresholds: dict[int, float] = {}
+        tracker = self._pipeline().track()
         for task in tasks:
-            kept = []
             for clip in task.video.clips:
                 frames = clip.extracted_frames.get(key)
                 if frames is None or frames.shape[0] == 0:
-                    kept.append(clip)
                     continue
                 try:
-                    clip.artificial_text_score, threshold = self._score(frames)
+                    if self._ocr is not None:
+                        scores[id(clip)], thresholds[id(clip)] = self._score_learned(frames)
+                    else:
+                        padded, n = pad_batch(frames)
+                        tracker.submit(clip, padded, n)
+                        thresholds[id(clip)] = self.threshold
                 except Exception as e:
                     logger.warning("text scoring failed for %s: %s", clip.uuid, e)
                     clip.errors["artificial_text"] = str(e)
-                    kept.append(clip)
+                    for lost in tracker.lost_to_abort():
+                        # pipeline aborted: in-flight scores are gone; error
+                        # those clips rather than misalign the drain zip
+                        lost.errors["artificial_text"] = f"in-flight score lost to abort: {e}"
+        if len(tracker):
+            try:
+                for clip, score in tracker.drain():
+                    scores[id(clip)] = float(score)
+            except Exception as e:
+                logger.warning("text scoring drain failed: %s", e)
+                for clip in tracker.lost_to_abort():
+                    clip.errors["artificial_text"] = str(e)
+        # Phase 2 — threshold in original clip order.
+        for task in tasks:
+            kept = []
+            for clip in task.video.clips:
+                if id(clip) not in scores:
+                    kept.append(clip)  # unscoreable or errored: keep
                     continue
-                if self.score_only or clip.artificial_text_score < threshold:
+                clip.artificial_text_score = scores[id(clip)]
+                if self.score_only or clip.artificial_text_score < thresholds[id(clip)]:
                     kept.append(clip)
                 else:
                     clip.filtered_by = "text"

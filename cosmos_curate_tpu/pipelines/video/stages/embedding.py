@@ -5,7 +5,10 @@ Equivalent capability of the reference's embedding stages
 cosmos_embed1_stages.py:43/190 — a CPU frame-prep stage feeding a device
 embed stage). The same deliberate CPU/device split: frame prep happens in
 ``ClipFrameExtractionStage``; this stage batches all clips in a task into
-one fixed-shape device call.
+shape-grouped batches that the embedders dispatch through the shared
+``DevicePipeline`` (models/device_pipeline.py) — pow2 bucket micro-batches,
+double-buffered H2D/compute, readback deferred to the drain — so the MXU
+stays fed while the host assembles the next group.
 """
 
 from __future__ import annotations

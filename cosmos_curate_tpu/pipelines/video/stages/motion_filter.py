@@ -99,6 +99,7 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.backend = backend
         self.mv_global_threshold = mv_global_threshold
         self.mv_patch_threshold = mv_patch_threshold
+        self._pipe = None  # DevicePipeline, created lazily in the worker
 
     @property
     def resources(self) -> Resources:
@@ -116,23 +117,37 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
             return None
         return mv_motion_scores(mv)
 
-    def _score_frame_diff(self, clip) -> tuple[float, float] | None:
+    def _submit_frame_diff(self, tracker, clip) -> None:
+        """Decode + dispatch one clip's frame-diff scoring; result resolves
+        at the tracker drain. No-op when there is nothing to score (fewer
+        than two frames)."""
         frames = extract_frames_at_fps(
             clip.encoded_data, target_fps=self.sample_fps, resize_hw=self.decode_resize_hw
         )
         if frames.shape[0] < 2:
-            return None
+            return
         padded, n = pad_batch(frames)
-        g, p = _motion_scores(padded, n)
-        return float(g), float(p)
+        # scalar outputs: no n_valid trim; decode of the NEXT clip overlaps
+        # this clip's device compute (the whole point of deferring readback)
+        tracker.submit(clip, padded, n)
+
+    def _pipeline(self):
+        if getattr(self, "_pipe", None) is None:
+            from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+            self._pipe = DevicePipeline("motion-filter", _motion_scores)
+        return self._pipe
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        # Phase 1 — score: MV scores resolve synchronously (CPU); frame-diff
+        # scores are dispatched through the DevicePipeline as clips decode,
+        # then drained once, so per-clip decode and device compute overlap
+        # instead of ping-ponging.
+        tracker = self._pipeline().track()
+        decisions: dict[int, tuple[tuple[float, float] | None, tuple[float, float]]] = {}
         for task in tasks:
-            video = task.video
-            kept = []
-            for clip in video.clips:
+            for clip in task.video.clips:
                 if clip.encoded_data is None:
-                    kept.append(clip)
                     continue
                 thresholds = (self.mv_global_threshold, self.mv_patch_threshold)
                 try:
@@ -151,20 +166,37 @@ class MotionFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
                     if scores is None and self.backend != "mv":
                         # thresholds must match the estimator that scored
                         thresholds = (self.global_threshold, self.per_patch_threshold)
-                        scores = self._score_frame_diff(clip)
-                    if scores is None:
-                        kept.append(clip)  # nothing scoreable: keep
-                        continue
-                    clip.motion_score_global, clip.motion_score_per_patch_min = scores
+                        self._submit_frame_diff(tracker, clip)
+                    decisions[id(clip)] = (scores, thresholds)
                 except Exception as e:
                     logger.warning("motion scoring failed for %s: %s", clip.uuid, e)
                     clip.errors["motion"] = str(e)
-                    kept.append(clip)
+                    for lost in tracker.lost_to_abort():
+                        # the pipeline aborted: in-flight scores are gone;
+                        # error those clips rather than misalign survivors
+                        lost.errors["motion"] = f"in-flight score lost to abort: {e}"
+        if len(tracker):
+            try:
+                for clip, (g, p) in tracker.drain():
+                    scores, thresholds = decisions[id(clip)]
+                    decisions[id(clip)] = ((float(g), float(p)), thresholds)
+            except Exception as e:
+                logger.warning("motion scoring drain failed: %s", e)
+                for clip in tracker.lost_to_abort():
+                    clip.errors["motion"] = str(e)
+                    decisions.pop(id(clip), None)
+        # Phase 2 — filter: apply thresholds in original clip order.
+        for task in tasks:
+            video = task.video
+            kept = []
+            for clip in video.clips:
+                entry = decisions.get(id(clip))
+                if entry is None or entry[0] is None:
+                    kept.append(clip)  # nothing scoreable (or errored): keep
                     continue
-                if self.score_only or (
-                    clip.motion_score_global >= thresholds[0]
-                    and clip.motion_score_per_patch_min >= thresholds[1]
-                ):
+                (g, p), thresholds = entry
+                clip.motion_score_global, clip.motion_score_per_patch_min = g, p
+                if self.score_only or (g >= thresholds[0] and p >= thresholds[1]):
                     kept.append(clip)
                 else:
                     clip.filtered_by = "motion"

@@ -6,6 +6,12 @@ Equivalent capability of the reference's semantic filtering
 type classifier, served by vLLM or API backends). Here both run on the
 caption engine: a prompt per clip (first-window frames), the decoded answer
 parsed as yes/no or as a class label.
+
+Device dispatch note: this scorer's device work happens inside the caption
+engine's continuous-batching loop (models/vlm/engine.py), which already
+amortizes readback to one host sync per decode group — the engine is this
+stage's DevicePipeline equivalent, so it is exempt from the per-call
+micro-batch migration the other scorers went through.
 """
 
 from __future__ import annotations

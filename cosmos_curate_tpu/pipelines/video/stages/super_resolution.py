@@ -110,12 +110,24 @@ class SuperResolutionStage(Stage[SplitPipeTask, SplitPipeTask]):
                     spans = overlapping_windows(
                         frames.shape[0], window_len=self.window_len, overlap=self.overlap
                     )
+                    # submit the whole tile loop before reading anything
+                    # back: window k+1's H2D overlaps window k's compute,
+                    # readback resolves in order at drain (DevicePipeline)
+                    for a, b in spans:
+                        self._model.submit_window(frames[a:b])
                     upscaled = [
-                        (a, b, self._model.upscale_window(frames[a:b])) for a, b in spans
+                        (a, b, out)
+                        for (a, b), out in zip(spans, self._model.drain_windows())
                     ]
                     blended = blend_windows(upscaled, frames.shape[0])
                     clip.encoded_data = encode_frames(blended, fps=meta.fps or 24.0)
                 except Exception as e:
                     logger.warning("SR failed for %s: %s", clip.uuid, e)
                     clip.errors["super_resolution"] = str(e)
+                    # a failure after partial submits must not leave windows
+                    # in flight: the NEXT clip's drain would zip the leftover
+                    # results onto its own spans (silent corruption)
+                    pipe = self._model.device_pipeline
+                    if pipe is not None:
+                        pipe.abort()
         return tasks

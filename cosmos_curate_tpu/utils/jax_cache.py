@@ -17,8 +17,30 @@ import threading
 _LOCK = threading.Lock()
 _ENABLED = False
 
+# Primary knob: CURATE_COMPILE_CACHE = "0"/"off" disables the persistent
+# cache entirely, "1"/"on" enables it at the default (or legacy-env) path,
+# any other value is the cache base directory itself. Unset = enabled at
+# the default path (compiles are paid once per machine, not per process).
+COMPILE_CACHE_ENV = "CURATE_COMPILE_CACHE"
+# Legacy path-only override, kept for existing deployments.
 CACHE_DIR_ENV = "CURATE_JAX_CACHE_DIR"
 DEFAULT_CACHE_DIR = "/tmp/curate_jax_cache"
+
+
+def resolve_cache_base(path: str | None = None) -> str | None:
+    """The cache base dir per the knobs, or None when disabled.
+
+    Precedence: explicit ``path`` arg > CURATE_COMPILE_CACHE (off/on/path)
+    > CURATE_JAX_CACHE_DIR > the default. An explicit arg wins even over
+    an env-level "off" — the caller asked for a specific cache."""
+    if path:
+        return path
+    knob = os.environ.get(COMPILE_CACHE_ENV, "").strip()
+    if knob.lower() in ("0", "off", "false", "no"):
+        return None
+    if knob and knob.lower() not in ("1", "on", "true", "yes"):
+        return knob  # a path
+    return os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
 
 
 def _host_fingerprint() -> str:
@@ -65,15 +87,18 @@ def _host_fingerprint() -> str:
     return hashlib.sha256(bits.encode()).hexdigest()[:10]
 
 
-def enable_persistent_cache(path: str | None = None) -> str:
+def enable_persistent_cache(path: str | None = None) -> str | None:
     """Idempotently point jax at a persistent compilation cache directory.
 
     Must run before the first compile to capture it; callers at natural
-    chokepoints (registry.load_params, bench, dryrun) make that true for
-    every model path. Returns the cache dir in use.
+    chokepoints (registry.load_params, DevicePipeline construction, bench,
+    dryrun) make that true for every model path. Returns the cache dir in
+    use, or None when CURATE_COMPILE_CACHE disables the cache.
     """
     global _ENABLED
-    base = path or os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
+    base = resolve_cache_base(path)
+    if base is None:
+        return None
     cache_dir = os.path.join(base, _host_fingerprint())
     with _LOCK:
         if _ENABLED:

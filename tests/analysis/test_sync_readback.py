@@ -1,0 +1,171 @@
+"""sync-readback rule: blocking np.asarray/jax.device_get directly on a
+jit call in model/stage code (the pattern the DevicePipeline PR removed)."""
+
+import textwrap
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.ast_lint import lint_file
+from cosmos_curate_tpu.analysis.common import LintConfig
+from cosmos_curate_tpu.analysis.rules import all_rules
+
+
+def _lint(tmp_path: Path, code: str, *, rel: str = "cosmos_curate_tpu/models/snippet.py"):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    rules = [r for r in all_rules() if r.rule_id == "sync-readback"]
+    return lint_file(f, LintConfig(), rules, root=tmp_path)
+
+
+def test_asarray_on_direct_jit_name_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: x)
+
+        def encode(x):
+            return np.asarray(fn(x))
+        """,
+    )
+    assert [f.rule for f in findings] == ["sync-readback"]
+    assert "DevicePipeline" in findings[0].message
+
+
+def test_asarray_on_self_attr_from_factory_flagged(tmp_path):
+    """The repo's _jitted_apply-factory idiom: self._apply bound from a
+    same-file function whose body contains jax.jit."""
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def _jitted_apply(cfg):
+            return jax.jit(lambda p, x: x)
+
+        class M:
+            def setup(self):
+                self._apply = _jitted_apply(None)
+
+            def encode(self, params, padded, n):
+                return np.asarray(self._apply(params, padded))[:n]
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_jit_holder_naming_convention_flagged(tmp_path):
+    """A cross-file jit holder we cannot trace still matches the _apply/
+    _sample convention."""
+    findings = _lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        class M:
+            def encode(self, x):
+                return np.asarray(self._apply(self._params, x))
+        """,
+    )
+    assert len(findings) == 1
+
+
+def test_device_get_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+
+        def fetch(y):
+            return jax.device_get(y)
+        """,
+        rel="cosmos_curate_tpu/pipelines/video/stages/snippet.py",
+    )
+    assert len(findings) == 1
+
+
+def test_asarray_on_plain_name_not_flagged(tmp_path):
+    """Readback of an already-dispatched result held in a variable is the
+    deferred pattern itself — not flagged."""
+    findings = _lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        def drain(results):
+            return [np.asarray(r) for r in results]
+
+        def coerce(self, ids):
+            return np.asarray(ids, np.int32)
+        """,
+    )
+    assert findings == []
+
+
+def test_non_jit_call_not_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import numpy as np
+
+        def build(frames):
+            return np.asarray(frames.tolist())
+        """,
+    )
+    # .tolist() is a Call but not a jit name / convention match
+    assert findings == []
+
+
+def test_device_pipeline_itself_exempt(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: x)
+
+        def drain(x):
+            return np.asarray(fn(x))
+        """,
+        rel="cosmos_curate_tpu/models/device_pipeline.py",
+    )
+    assert findings == []
+
+
+def test_out_of_scope_not_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        fn = jax.jit(lambda x: x)
+
+        def helper(x):
+            return np.asarray(fn(x))
+        """,
+        rel="cosmos_curate_tpu/dedup/snippet.py",
+    )
+    assert findings == []
+
+
+def test_repo_model_and_stage_code_clean():
+    """Acceptance bar: zero sync-readback findings (and zero suppressions)
+    across the real models/ and stage dirs after the migration."""
+    repo = Path(__file__).resolve().parents[2]
+    rules = [r for r in all_rules() if r.rule_id == "sync-readback"]
+    targets = [repo / "cosmos_curate_tpu" / "models", repo / "cosmos_curate_tpu" / "pipelines"]
+    findings = []
+    for t in targets:
+        for f in sorted(t.rglob("*.py")):
+            findings.extend(lint_file(f, LintConfig(), rules, root=repo))
+    assert findings == [], [f.render() for f in findings]
+    # zero suppressions: the rule id never appears in a disable comment
+    for t in targets:
+        for f in sorted(t.rglob("*.py")):
+            assert "disable=sync-readback" not in f.read_text()
+            assert "disable-file=sync-readback" not in f.read_text()
