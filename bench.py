@@ -18,7 +18,11 @@ is warmup noise (first-touch page faults, lazy imports, allocator growth)
 that must not be recorded as signal. The cold pass rides along as
 ``value_cold``. Per-dispatch device timings (models/device_pipeline.py) are
 summarized per pipeline; ``dispatch_gap_frac`` < 0.2 on the embed pipeline
-is the acceptance bar that H2D/compute actually overlap.
+is the acceptance bar that H2D/compute actually overlap. With the default
+pipelined runner (core/pipelined_runner.py) the record also carries
+``pipeline_overlap_frac`` — the fraction of summed host-stage work hidden
+behind other stages; > 0 proves decode/transcode ran concurrently with the
+embed stage instead of in lockstep.
 """
 
 from __future__ import annotations
@@ -187,18 +191,34 @@ def main() -> int:
         extract_resize_hw=(224, 224),
         embedding_model="video",
     )
-    # The streaming engine wins when decode can fan out across cores; on a
-    # 1-2 core box its worker-spawn overhead dominates, so fall back to the
-    # in-process runner there. BENCH_RUNNER=sequential|engine overrides.
+    # Runner selection (BENCH_RUNNER=sequential|pipelined|engine). The
+    # single-host default is the pipelined runner: stage worker-thread
+    # pools overlap CPU decode/transcode with the device embed stage
+    # (core/pipelined_runner.py) without the engine's worker-spawn
+    # overhead, which dominates on small boxes. The streaming engine stays
+    # opt-in here — its process pools pay off when decode fans out across
+    # many real cores or across hosts.
     choice = os.environ.get("BENCH_RUNNER", "auto")
     cores = os.cpu_count() or 1
-    use_engine = choice == "engine" or (choice == "auto" and cores >= 4)
+    if choice not in ("auto", "sequential", "pipelined", "engine"):
+        # a typo must not silently bench the wrong runner under the typo's
+        # name in the JSON record (same guard default_runner applies)
+        raise SystemExit(f"unknown BENCH_RUNNER={choice!r}")
+    if choice == "auto":
+        choice = "pipelined"
+    use_engine = choice == "engine"
 
     def make_runner():
-        if use_engine:
+        if choice == "engine":
             from cosmos_curate_tpu.engine.runner import StreamingRunner
 
             return StreamingRunner()
+        if choice == "pipelined":
+            from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+
+            # production semantics (engine parity): a dropped batch shows up
+            # as missing clips in the summary, not as an aborted bench
+            return PipelinedRunner(raise_on_error=False)
         return SequentialRunner()
 
     from cosmos_curate_tpu.observability.stage_timer import (
@@ -206,6 +226,8 @@ def main() -> int:
         dispatch_summaries,
         load_dumped_summaries,
         reset_dispatch_stats,
+        reset_stage_flow,
+        stage_flow_summaries,
     )
 
     # Two passes over identical inputs: pass 1 absorbs residual warmup
@@ -216,13 +238,11 @@ def main() -> int:
         runner = make_runner()
         pass_args = dataclasses.replace(args, output_path=str(tmp / f"out_{label}"))
         reset_dispatch_stats()  # per-dispatch stats reflect ONE pass
+        reset_stage_flow()  # per-stage queue/busy aggregates too
         # engine mode runs stages in spawned workers: have each worker dump
         # its dispatch aggregates at exit so the warm pass still reports
         os.environ[DISPATCH_DUMP_DIR_ENV] = str(tmp / f"dispatch_{label}")
-        log(
-            f"bench: running split+annotate [{label}] "
-            f"({'engine' if use_engine else 'sequential'}, {cores} cores)"
-        )
+        log(f"bench: running split+annotate [{label}] ({choice}, {cores} cores)")
         t0 = time.monotonic()
         summary = run_split(pass_args, runner=runner)
         elapsed = time.monotonic() - t0
@@ -262,7 +282,17 @@ def main() -> int:
         "unit": "clips/s",
         "vs_baseline": round(vs, 3),
         "config": config_name,
+        "runner": choice,
     }
+    # Stage-overlap signal (pipelined runner): fraction of summed host
+    # stage work hidden behind other stages — 0 means lockstep (sequential
+    # behavior), >0 means decode/transcode ran while the device embedded.
+    overlap = getattr(runner, "overlap_frac", None)
+    if overlap is not None:
+        record["pipeline_overlap_frac"] = round(overlap, 4)
+    flow = stage_flow_summaries()
+    if flow:
+        log("bench: stage flow (warm pass): " + json.dumps(flow))
     # MFU + embed-stage wall for the warm pass (reference SPEED_OF_LIGHT.md's
     # efficiency method via models/flops.py). Reported on EVERY backend —
     # r02 carried these fields, then they vanished behind a TPU-only gate and

@@ -83,10 +83,11 @@ def register(sub: argparse._SubParsersAction) -> None:
     split.add_argument("--sequential", action="store_true", help="run in-process (no engine)")
     split.add_argument(
         "--runner",
-        choices=["auto", "sequential", "streaming", "map"],
+        choices=["auto", "sequential", "pipelined", "streaming", "map"],
         default="auto",
-        help="execution backend: streaming engine, in-process sequential, "
-        "or barrier map over a process pool",
+        help="execution backend: stage-overlapped thread pools (pipelined; "
+        "the single-host default), streaming engine, in-process "
+        "sequential, or barrier map over a process pool",
     )
     split.add_argument("--profile-cpu", action="store_true")
     split.add_argument("--profile-memory", action="store_true")
@@ -347,6 +348,12 @@ def _cmd_split(args: argparse.Namespace) -> int:
         choice = "sequential"
     if choice == "sequential":
         runner = SequentialRunner()
+    elif choice == "pipelined":
+        from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+
+        # same poison-batch semantics as `auto` (default_runner) and the
+        # streaming engine: exhausted batches dead-letter, the run continues
+        runner = PipelinedRunner(raise_on_error=False)
     elif choice == "map":
         from cosmos_curate_tpu.core.map_runner import MapRunner
 

@@ -39,6 +39,19 @@ class ModelInterface(abc.ABC):
         return getattr(self, "_pipeline", None)
 
     @property
+    def pin_to_single_worker(self) -> bool:
+        """Stages driving this model must dispatch from ONE worker thread.
+
+        ``DevicePipeline`` state (the bounded in-flight window, bucket
+        reuse, submission-order drain) is deliberately single-threaded —
+        concurrent submit/drain from several threads would interleave
+        micro-batches and misalign results. The pipelined runner
+        (core/pipelined_runner.py) reads this marker and pins model stages
+        to a single worker; a model whose dispatch really is thread-safe
+        may override to allow fan-out."""
+        return True
+
+    @property
     @abc.abstractmethod
     def model_id_names(self) -> list[str]:
         """Weight-registry ids this model needs staged locally."""

@@ -10,8 +10,10 @@ jobs on a single host without the streaming engine.
 from __future__ import annotations
 
 import abc
+import os
 import time
 
+from cosmos_curate_tpu import chaos
 from cosmos_curate_tpu.core.pipeline import PipelineSpec
 from cosmos_curate_tpu.core.stage import NodeInfo, WorkerMetadata
 from cosmos_curate_tpu.core.tasks import PipelineTask
@@ -41,8 +43,16 @@ class SequentialRunner(RunnerInterface):
         # stage name -> wall seconds of the last run (MFU accounting reads
         # this; benchmarks/split_benchmark.py)
         self.stage_times: dict[str, float] = {}
+        # DLQ parity with the engine: permanently dropped batches persist
+        # (engine/dead_letter.py); lazy — a clean run creates nothing
+        self.dlq = None
+        self.dead_lettered = 0
 
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        # fresh run-scoped DLQ state (run_id is fixed at DLQ construction,
+        # so reusing one across runs would file run 2's drops under run 1)
+        self.dlq = None
+        self.dead_lettered = 0
         node = NodeInfo(node_id="local")
         tasks: list[PipelineTask] = list(spec.input_data)
         for stage_spec in spec.stages:
@@ -66,6 +76,8 @@ class SequentialRunner(RunnerInterface):
                     batch = tasks[i : i + bs]
                     for attempt in range(max(1, stage_spec.num_run_attempts)):
                         try:
+                            chaos.fire(chaos.SITE_WORKER_CRASH)  # kind=crash: os._exit
+                            chaos.fire(chaos.SITE_WORKER_HANG)  # kind=hang: stuck batch
                             with traced_span(
                                 f"stage.{stage.name}.process", batch_size=len(batch)
                             ):
@@ -78,6 +90,7 @@ class SequentialRunner(RunnerInterface):
                                 logger.exception(
                                     "stage %s failed on batch %d; dropping", stage.name, i
                                 )
+                                self._dead_letter(stage.name, i, batch, attempt + 1)
                                 result = None
                     if result is None:
                         continue
@@ -97,16 +110,83 @@ class SequentialRunner(RunnerInterface):
             tasks = out
         return tasks if spec.config.return_last_stage_outputs else None
 
+    def _dead_letter(self, stage_name: str, batch_id: int, tasks: list, attempts: int) -> None:
+        """Persist a dropped batch to the durable DLQ — local runs get the
+        same recoverability the streaming engine's drop path has. Never
+        raises: DLQ failure degrades to the log-only drop above."""
+        import traceback
+
+        try:
+            from cosmos_curate_tpu.engine.dead_letter import (
+                DeadLetterQueue,
+                record_exhausted_batch,
+            )
+        except ImportError:
+            return
+        if self.dlq is None:
+            self.dlq = DeadLetterQueue()
+        if record_exhausted_batch(
+            self.dlq,
+            stage_name=stage_name,
+            batch_id=batch_id,
+            tasks=tasks,
+            attempts=attempts,
+            error=traceback.format_exc(),
+        ):
+            self.dead_lettered += 1
+
 
 def default_runner() -> RunnerInterface:
-    """The production runner: streaming engine if usable, else sequential."""
-    try:
-        from cosmos_curate_tpu.engine.runner import StreamingRunner
-    except ImportError as e:
-        # Only the engine itself being absent may degrade; a broken engine
-        # module must surface, not silently fall back to 1/N throughput.
-        if e.name is None or not e.name.startswith("cosmos_curate_tpu.engine"):
-            raise
-        logger.warning("streaming engine unavailable; using SequentialRunner")
+    """Production runner selection.
+
+    ``CURATE_RUNNER=sequential|pipelined|engine`` forces a backend. Without
+    the override: multi-host runs (a remote data plane is configured via
+    ``CURATE_ENGINE_DRIVER_PORT``) use the streaming engine, whose process
+    pools span node agents; single-host runs default to the
+    ``PipelinedRunner`` — stage-overlapped thread pools that keep the device
+    fed by host stages without the engine's worker-spawn overhead.
+    """
+    choice = os.environ.get("CURATE_RUNNER", "").strip().lower()
+    known = ("", "auto", "sequential", "pipelined", "engine", "streaming", "map")
+    if choice not in known:
+        # a typo must not silently land on the multi-threaded default —
+        # an operator forcing `sequential` to debug threading needs to
+        # KNOW when the override didn't take
+        raise ValueError(
+            f"unknown CURATE_RUNNER={choice!r}; expected one of {known[1:]}"
+        )
+    if choice == "sequential":
         return SequentialRunner()
-    return StreamingRunner()
+    if choice == "map":
+        from cosmos_curate_tpu.core.map_runner import MapRunner
+
+        return MapRunner()
+    if choice in ("engine", "streaming") or (
+        choice in ("", "auto") and os.environ.get("CURATE_ENGINE_DRIVER_PORT")
+    ):
+        try:
+            from cosmos_curate_tpu.engine.runner import StreamingRunner
+        except ImportError as e:
+            # Only the engine itself being absent may degrade; a broken
+            # engine module must surface, not silently lose throughput.
+            if e.name is None or not e.name.startswith("cosmos_curate_tpu.engine"):
+                raise
+            logger.warning("streaming engine unavailable; using SequentialRunner")
+            return SequentialRunner()
+        return StreamingRunner()
+    try:
+        # the pipelined runner reuses the engine's autoscaler/metrics/DLQ,
+        # so engine absence degrades it too
+        from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+    except ImportError as e:
+        if e.name is None or not e.name.startswith(
+            ("cosmos_curate_tpu.engine", "cosmos_curate_tpu.core.pipelined_runner")
+        ):
+            raise
+        logger.warning("pipelined runner unavailable; using SequentialRunner")
+        return SequentialRunner()
+    # production semantics match the streaming engine: an exhausted batch is
+    # dead-lettered and the run CONTINUES — one poison batch must not void
+    # hours of curation. Tests wanting fail-fast construct the runner
+    # directly (raise_on_error defaults to True there, like SequentialRunner).
+    return PipelinedRunner(raise_on_error=False)

@@ -123,6 +123,17 @@ class Stage(Generic[T, V], abc.ABC):
         """How many tasks ``process_data`` receives per call."""
         return 1
 
+    @property
+    def thread_safe(self) -> bool:
+        """True when concurrent ``process_data`` calls on DISJOINT batches
+        are safe — no cross-call mutable state on ``self`` (per-task mutation
+        is fine; every batch owns its tasks). The pipelined runner
+        (core/pipelined_runner.py) only fans a stage out across worker
+        threads when this is declared; process-pool runners are unaffected
+        (each worker process owns a private stage copy). Default False:
+        an unannotated stage runs single-worker."""
+        return False
+
     def setup_on_node(self, node: NodeInfo, worker: WorkerMetadata) -> None:
         """Once per host before any worker setup (e.g. weight download)."""
 

@@ -39,6 +39,24 @@ class Budget:
     tpus: float
 
 
+def discover_tpu_chips(cfg, stage_specs: list[StageSpec]) -> int:
+    """Local TPU chip count for the budget, shared by the streaming and
+    pipelined runners. Only probes devices when some stage actually
+    requests TPU resources — a jax import can hang on a dead TPU tunnel,
+    so pure-CPU pipelines never pay it. An explicit
+    ``PipelineConfig.num_tpu_chips`` wins outright."""
+    if cfg.num_tpu_chips is not None:
+        return cfg.num_tpu_chips
+    if not any(s.stage.resources.uses_tpu for s in stage_specs):
+        return 0
+    try:
+        import jax
+
+        return max(1, len([d for d in jax.devices() if d.platform == "tpu"]))
+    except Exception:
+        return 1
+
+
 def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
     """Target worker counts per stage (same order as input)."""
     n = len(stages)

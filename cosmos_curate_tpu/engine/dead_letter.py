@@ -151,6 +151,41 @@ class DeadLetterQueue:
         return entry
 
 
+def record_exhausted_batch(
+    dlq: "DeadLetterQueue | None",
+    *,
+    stage_name: str,
+    batch_id: int,
+    tasks: list,
+    attempts: int,
+    error: str = "",
+) -> bool:
+    """Shared drop path for the in-process runners (SequentialRunner,
+    PipelinedRunner): persist a batch whose ``num_run_attempts`` budget is
+    exhausted. Keeps both runners' DLQ records in lockstep with each other
+    (reason string, worker_deaths=0) so the ``dlq`` CLI treats them
+    identically. Returns True when an entry was written; never raises —
+    the caller's drop proceeds regardless."""
+    if dlq is None or not dlq.enabled:
+        return False
+    try:
+        return (
+            dlq.record(
+                stage_name=stage_name,
+                batch_id=batch_id,
+                tasks=tasks,
+                attempts=attempts,
+                worker_deaths=0,
+                reason=f"num_run_attempts ({attempts}) exhausted",
+                error=error,
+            )
+            is not None
+        )
+    except Exception:
+        logger.exception("DLQ record failed; batch dropped without record")
+        return False
+
+
 def list_entries(root: str | None = None, *, run_id: str | None = None) -> list[DlqEntry]:
     """All entries under ``root`` (newest run first), or one run's."""
     base = Path(default_root() if root is None else root)

@@ -71,6 +71,16 @@ class EngineMetrics:
         self.dispatch_d2h_total = Counter(
             "pipeline_device_d2h_seconds_total", "device->host readback seconds", labels
         )
+        # Pipelined-runner flow signal (core/pipelined_runner.py): fraction
+        # of the sampling window a stage's worker threads spent inside
+        # process_data. ≈1 marks the bottleneck stage (give it workers);
+        # ≈0 with a deep input queue downstream means starved/over-
+        # provisioned. Queue depth rides the existing
+        # pipeline_input_queue_size gauge.
+        self.stage_busy_frac = Gauge(
+            "pipeline_stage_busy_fraction",
+            "worker busy fraction over the last sampling window", labels,
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -111,6 +121,10 @@ class EngineMetrics:
         self.dispatch_compute_total.labels(stage).inc(max(compute_s, 0.0))
         self.dispatch_h2d_total.labels(stage).inc(max(h2d_s, 0.0))
         self.dispatch_d2h_total.labels(stage).inc(max(d2h_s, 0.0))
+
+    def set_stage_busy(self, stage: str, frac: float) -> None:
+        if self.enabled:
+            self.stage_busy_frac.labels(stage).set(min(max(frac, 0.0), 1.0))
 
     def set_pool_state(self, stage: str, ready: int, pending: int, queued: int) -> None:
         if not self.enabled:

@@ -750,16 +750,9 @@ class StreamingRunner(RunnerInterface):
 
     @staticmethod
     def _discover_tpus(cfg, stage_specs: list[StageSpec]) -> int:
-        if cfg.num_tpu_chips is not None:
-            return cfg.num_tpu_chips
-        if not any(s.stage.resources.uses_tpu for s in stage_specs):
-            return 0
-        try:
-            import jax
+        from cosmos_curate_tpu.engine.autoscaler import discover_tpu_chips
 
-            return max(1, len([d for d in jax.devices() if d.platform == "tpu"]))
-        except Exception:
-            return 1
+        return discover_tpu_chips(cfg, stage_specs)
 
 
 def _retry_or_drop(stx, batch: _Batch, store, reason: str, *, dead_letter=None) -> None:

@@ -175,6 +175,83 @@ def reset_dispatch_stats() -> None:
         _DISPATCH.clear()
 
 
+# ---------------------------------------------------------------------------
+# Per-stage flow aggregates from the pipelined runner (core/
+# pipelined_runner.py): batch busy time folds in per process_data call,
+# queue-depth/busy-fraction snapshots per runner tick. Bounded aggregates,
+# not a log — the prometheus gauges carry the stream.
+_FLOW_LOCK = threading.Lock()
+_FLOW: dict[str, dict] = {}
+
+
+def _new_flow() -> dict:
+    return {
+        "batches": 0, "busy_s": 0.0, "ticks": 0,
+        "queue_depth": 0, "queue_depth_peak": 0,
+        "busy_frac": 0.0, "busy_frac_sum": 0.0, "workers": 0,
+    }
+
+
+def record_stage_busy(name: str, busy_s: float) -> None:
+    """Fold one completed ``process_data`` call into the stage's aggregate."""
+    with _FLOW_LOCK:
+        agg = _FLOW.setdefault(name, _new_flow())
+        agg["batches"] += 1
+        agg["busy_s"] += busy_s
+
+
+def record_stage_flow(
+    name: str, *, queue_depth: int, busy_frac: float, workers: int
+) -> None:
+    """Fold one runner-tick snapshot (input-queue depth, worker busy
+    fraction over the tick window, live workers) into the aggregate and
+    forward it to the engine's gauges (no-op when the exporter is absent)."""
+    with _FLOW_LOCK:
+        agg = _FLOW.setdefault(name, _new_flow())
+        agg["ticks"] += 1
+        agg["queue_depth"] = queue_depth
+        agg["queue_depth_peak"] = max(agg["queue_depth_peak"], queue_depth)
+        agg["busy_frac"] = busy_frac
+        agg["busy_frac_sum"] += busy_frac
+        agg["workers"] = workers
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        m = get_metrics()
+        m.set_stage_busy(name, busy_frac)
+        m.set_pool_state(name, workers, 0, queue_depth)
+    except Exception:  # metrics must never take down the runner loop
+        pass
+
+
+def stage_flow_summaries() -> dict[str, dict]:
+    """name -> busy/queue aggregate. ``busy_frac_mean`` is the average
+    worker-busy fraction across ticks: ≈1 means the stage's workers were
+    saturated (the bottleneck); ≈0 with a deep queue downstream means the
+    stage is starved or over-provisioned."""
+    out: dict[str, dict] = {}
+    with _FLOW_LOCK:
+        items = {k: dict(v) for k, v in _FLOW.items()}
+    for name, agg in items.items():
+        out[name] = {
+            "batches": agg["batches"],
+            "busy_s": round(agg["busy_s"], 4),
+            "queue_depth": agg["queue_depth"],
+            "queue_depth_peak": agg["queue_depth_peak"],
+            "busy_frac": round(agg["busy_frac"], 4),
+            "busy_frac_mean": (
+                round(agg["busy_frac_sum"] / agg["ticks"], 4) if agg["ticks"] else 0.0
+            ),
+            "workers": agg["workers"],
+        }
+    return out
+
+
+def reset_stage_flow() -> None:
+    with _FLOW_LOCK:
+        _FLOW.clear()
+
+
 def dispatch_summaries() -> dict[str, dict]:
     """name -> aggregate per-dispatch timings. ``gap_frac`` is device idle
     over total device-relevant wall (gap + compute): < 0.2 means the host

@@ -36,6 +36,10 @@ class FixedStrideExtractorStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.stride_s = stride_s
         self.min_clip_len_s = min_clip_len_s
 
+    @property
+    def thread_safe(self) -> bool:
+        return True  # pure span math on the batch's own tasks
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:
             video = task.video
@@ -84,6 +88,12 @@ class ClipTranscodingStage(Stage[SplitPipeTask, SplitPipeTask]):
     @property
     def resources(self) -> Resources:
         return Resources(cpus=float(self.num_threads))
+
+    @property
+    def thread_safe(self) -> bool:
+        # each call builds its own thread pool over the batch's own videos;
+        # no cross-call state on self
+        return True
 
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         # One sequential decode pass per video (transcode_clips decodes each

@@ -27,6 +27,12 @@ class VideoDownloadStage(Stage[SplitPipeTask, SplitPipeTask]):
     def resources(self) -> Resources:
         return Resources(cpus=0.25)
 
+    @property
+    def thread_safe(self) -> bool:
+        # pure fetch+probe on the batch's own tasks; storage clients are
+        # stateless per call — the pipelined runner may fan this out
+        return True
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:
             # multicam sessions fetch every camera; single-cam = [video]

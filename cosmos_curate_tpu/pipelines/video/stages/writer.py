@@ -75,6 +75,12 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
     def resources(self) -> Resources:
         return Resources(cpus=0.5)
 
+    @property
+    def thread_safe(self) -> bool:
+        # every write targets clip-uuid / chunk-index-scoped paths, so
+        # concurrent batches touch disjoint files; stats live on the task
+        return True
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         for task in tasks:
             video = task.video

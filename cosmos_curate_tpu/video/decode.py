@@ -20,7 +20,7 @@ from typing import Iterator
 import cv2
 import numpy as np
 
-from cosmos_curate_tpu.data.model import VideoMetadata
+from cosmos_curate_tpu.data.model import FrameExtractionSignature, VideoMetadata
 from cosmos_curate_tpu.utils.memfd import buffer_as_path
 
 
@@ -127,6 +127,59 @@ def decode_frame_ids(
     return np.stack([out[i] for i in targets if i in out])
 
 
+def extract_frames_multi(
+    source: str | bytes,
+    signatures: tuple[FrameExtractionSignature, ...] | list[FrameExtractionSignature],
+    *,
+    resize_hw: tuple[int, int] | None = None,
+) -> dict[str, np.ndarray]:
+    """Serve every ``FrameExtractionSignature`` from ONE decode pass.
+
+    The per-signature path re-opens the container (a fresh memfd copy for
+    byte sources) and rolls the decoder forward once per signature — k
+    signatures cost k full decodes of the same bytes. Here the capture opens
+    once, every source frame is decoded at most once, and resize + BGR→RGB
+    conversion run once per retrieved frame, shared by every signature that
+    samples it. Returns ``{sig.key(): [T, H, W, 3] uint8}`` — an empty
+    ``(0, 0, 0, 3)`` array for signatures nothing decoded for (the same
+    convention as the single-signature path). Duplicate keys collapse.
+    """
+    sigs = list(signatures)
+    empty = np.zeros((0, 0, 0, 3), np.uint8)
+    if not sigs:
+        return {}
+    frames: dict[str, list[np.ndarray]] = {}
+    for s in sigs:
+        frames.setdefault(s.key(), [])
+    try:
+        with _open_capture(source) as cap:
+            fps = float(cap.get(cv2.CAP_PROP_FPS))
+            if fps <= 0:
+                return {k: empty for k in frames}
+            stride = {s.key(): max(1, round(fps / s.target_fps)) for s in sigs}
+            wanted = {k: 0 for k in frames}
+            idx = 0
+            while True:
+                ok = cap.grab()
+                if not ok:
+                    break
+                takers = [k for k, w in wanted.items() if w == idx]
+                if takers:
+                    ok, bgr = cap.retrieve()
+                    if not ok:
+                        break
+                    if resize_hw is not None:
+                        bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
+                    rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+                    for k in takers:
+                        frames[k].append(rgb)
+                        wanted[k] += stride[k]
+                idx += 1
+    except ValueError:
+        return {k: empty for k in frames}
+    return {k: (np.stack(v) if v else empty) for k, v in frames.items()}
+
+
 def extract_frames_at_fps(
     source: str | bytes,
     *,
@@ -138,34 +191,10 @@ def extract_frames_at_fps(
 
     Single decoder open: the source fps is read off the already-open capture
     (a second probe open would double the memfd copies on the hot CPU path).
+    Thin wrapper over the multi-signature pass so the two never diverge.
     """
-    frames: list[np.ndarray] = []
-    try:
-        with _open_capture(source) as cap:
-            fps = float(cap.get(cv2.CAP_PROP_FPS))
-            if fps <= 0:
-                return np.zeros((0, 0, 0, 3), np.uint8)
-            stride = max(1, round(fps / target_fps))
-            idx = 0
-            wanted = 0
-            while True:
-                ok = cap.grab()
-                if not ok:
-                    break
-                if idx == wanted:
-                    ok, bgr = cap.retrieve()
-                    if not ok:
-                        break
-                    if resize_hw is not None:
-                        bgr = cv2.resize(bgr, (resize_hw[1], resize_hw[0]), interpolation=cv2.INTER_AREA)
-                    frames.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
-                    wanted += stride
-                idx += 1
-    except ValueError:
-        return np.zeros((0, 0, 0, 3), np.uint8)
-    if not frames:
-        return np.zeros((0, 0, 0, 3), np.uint8)
-    return np.stack(frames)
+    sig = FrameExtractionSignature("fps", target_fps)
+    return extract_frames_multi(source, (sig,), resize_hw=resize_hw)[sig.key()]
 
 
 def get_frame_timestamps(source: str | bytes) -> np.ndarray:

@@ -15,6 +15,13 @@ JAX_PLATFORMS=cpu python -m pytest \
   tests/analysis/test_ad_hoc_backoff.py \
   -q -p no:randomly
 
+echo "== pipelined-runner chaos + smoke (in-process, fast) =="
+# crash-site coverage, retry/drop->DLQ, and the 2-stage CPU smoke for the
+# stage-overlapped runner (core/pipelined_runner.py)
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/core/test_pipelined_runner.py \
+  -q -p no:randomly
+
 echo "== chaos end-to-end + soak (spawns real worker pools) =="
 # -m '' overrides the default marker filter so the @slow suites run here
 JAX_PLATFORMS=cpu python -m pytest \

@@ -1,0 +1,608 @@
+"""PipelinedRunner: stage-overlapped single-host execution.
+
+Locks the contracts ISSUE 5 demands: output-set equivalence with the
+SequentialRunner (toy pipelines AND the split-pipeline fixtures, with and
+without injected batch crashes), retry/drop semantics with DLQ parity,
+bounded-queue backpressure, device-stage pinning vs CPU fan-out, chaos
+site coverage, and clean destroy on mid-run failure. Everything here is
+fast (tier-1); scripts/run_chaos_checks.sh runs this file as the
+pipelined-runner chaos gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+
+class Num(PipelineTask):
+    def __init__(self, v: int) -> None:
+        self.v = v
+
+    @property
+    def weight(self) -> float:
+        return 1.0
+
+
+class Add(Stage):
+    def __init__(
+        self,
+        delta: int = 1,
+        *,
+        fail_values: tuple[int, ...] = (),
+        sleep_s: float = 0.0,
+        cpus: float = 0.5,
+        bs: int = 2,
+    ) -> None:
+        self.delta = delta
+        self.fail_values = fail_values
+        self.sleep_s = sleep_s
+        self.cpus = cpus
+        self.bs = bs
+        self.threads: set[int] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return f"add{self.delta}"
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=self.cpus)
+
+    @property
+    def thread_safe(self) -> bool:
+        return True
+
+    @property
+    def batch_size(self) -> int:
+        return self.bs
+
+    def process_data(self, tasks):
+        with self._lock:
+            self.threads.add(threading.get_ident())
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        for t in tasks:
+            if t.v in self.fail_values:
+                raise RuntimeError(f"injected failure on {t.v}")
+            t.v += self.delta
+        return tasks
+
+
+class Expand(Stage):
+    """Dynamic chunking: one task in, two out."""
+
+    @property
+    def name(self) -> str:
+        return "expand"
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5)
+
+    @property
+    def thread_safe(self) -> bool:
+        return True
+
+    def process_data(self, tasks):
+        return [Num(t.v) for t in tasks for _ in range(2)]
+
+
+class PinnedStage(Stage):
+    """TPU resources -> the runner must pin it to exactly one thread."""
+
+    def __init__(self) -> None:
+        self.threads: set[int] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return "pinned"
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.5, tpus=1.0)
+
+    def process_data(self, tasks):
+        with self._lock:
+            self.threads.add(threading.get_ident())
+        time.sleep(0.01)
+        return tasks
+
+
+class Lifecycle(Stage):
+    """Records setup/destroy counts; optionally fails on a value."""
+
+    def __init__(self, name: str, fail_values: tuple[int, ...] = ()) -> None:
+        self._name = name
+        self.fail_values = fail_values
+        self.setups = 0
+        self.destroys = 0
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=0.25)
+
+    @property
+    def thread_safe(self) -> bool:
+        return True
+
+    def setup(self, worker):
+        self.setups += 1
+
+    def process_data(self, tasks):
+        for t in tasks:
+            if t.v in self.fail_values:
+                raise RuntimeError(f"boom on {t.v}")
+        return tasks
+
+    def destroy(self):
+        self.destroys += 1
+
+
+def test_end_to_end_matches_sequential():
+    seq = run_pipeline(
+        [Num(i) for i in range(7)], [Add(1), Expand(), Add(10)],
+        runner=SequentialRunner(),
+    )
+    pipe_runner = PipelinedRunner()
+    piped = run_pipeline(
+        [Num(i) for i in range(7)], [Add(1), Expand(), Add(10)],
+        runner=pipe_runner,
+    )
+    assert sorted(t.v for t in piped) == sorted(t.v for t in seq)
+    assert pipe_runner.stage_times["add1"] >= 0
+    counts = pipe_runner.stage_counts
+    assert counts["expand"]["completed"] == counts["expand"]["dispatched"]
+    assert counts["add10"]["errored"] == 0
+
+
+def test_smoke_two_stage_pipeline():
+    """The fast 2-stage smoke run_chaos_checks.sh leans on."""
+    out = run_pipeline(
+        [Num(i) for i in range(5)], [Add(1), Add(10)], runner=PipelinedRunner()
+    )
+    assert sorted(t.v for t in out) == [11 + i for i in range(5)]
+
+
+def test_empty_input_runs_lifecycle():
+    stages = [Lifecycle("a"), Lifecycle("b")]
+    out = run_pipeline([], stages, runner=PipelinedRunner(), skip_validation=True)
+    assert out == []
+    for st in stages:
+        assert st.setups == 1  # exactly once per stage, even with no tasks
+        assert st.destroys == 1
+
+
+def test_retries_then_drop_with_dlq(tmp_path, monkeypatch):
+    monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+    tasks = [Num(i) for i in range(4)]
+    stage = StageSpec(Add(1, fail_values=(2,)), num_run_attempts=2)
+    runner = PipelinedRunner(raise_on_error=False)
+    out = run_pipeline(tasks, [stage], runner=runner)
+    # the batch containing v=2 drops after both attempts; the rest pass
+    survivors = sorted(t.v for t in out)
+    assert 3 not in survivors  # v=2 never incremented
+    assert len(survivors) < 4
+    assert runner.stage_counts["add1"]["errored"] == 1
+    assert runner.stage_counts["add1"]["dead_lettered"] == 1
+    from cosmos_curate_tpu.engine.dead_letter import list_entries
+
+    (entry,) = list_entries(str(tmp_path / "dlq"))
+    assert entry.meta["stage"] == "add1"
+    assert entry.meta["attempts"] == 2
+    assert "injected failure" in entry.meta["error_tail"]
+    dropped = entry.load_tasks()
+    assert any(t.v == 2 for t in dropped)
+
+
+def test_sequential_runner_dlq_parity(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: SequentialRunner's 'failed; dropping' path lands
+    in the DLQ like the streaming engine's."""
+    monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+    tasks = [Num(i) for i in range(4)]
+    stage = StageSpec(Add(1, fail_values=(2,)), num_run_attempts=2)
+    runner = SequentialRunner(raise_on_error=False)
+    run_pipeline(tasks, [stage], runner=runner)
+    assert runner.dead_lettered == 1
+    from cosmos_curate_tpu.engine.dead_letter import list_entries
+
+    (entry,) = list_entries(str(tmp_path / "dlq"))
+    assert entry.meta["stage"] == "add1"
+    assert any(t.v == 2 for t in entry.load_tasks())
+
+
+def test_raise_on_error_propagates():
+    with pytest.raises(RuntimeError, match="injected failure"):
+        run_pipeline(
+            [Num(2)], [StageSpec(Add(1, fail_values=(2,)))],
+            runner=PipelinedRunner(),
+        )
+
+
+def test_non_list_return_always_raises():
+    """Contract violations surface regardless of raise_on_error
+    (SequentialRunner parity) instead of burning retries into the DLQ."""
+
+    class Bad(Stage):
+        @property
+        def resources(self):
+            return Resources(cpus=0.25)
+
+        def process_data(self, tasks):
+            return "nope"
+
+    with pytest.raises(TypeError, match="must return"):
+        run_pipeline(
+            [Num(1)], [StageSpec(Bad(), num_run_attempts=3)],
+            runner=PipelinedRunner(raise_on_error=False),
+            skip_validation=True,
+        )
+
+
+def test_clean_destroy_on_midrun_failure():
+    stages = [Lifecycle("a"), Lifecycle("b", fail_values=(1,)), Lifecycle("c")]
+    with pytest.raises(RuntimeError, match="boom"):
+        run_pipeline(
+            [Num(i) for i in range(4)], stages,
+            runner=PipelinedRunner(), skip_validation=True,
+        )
+    for st in stages:
+        if st.setups:  # every stage that was set up is destroyed
+            assert st.destroys == 1
+
+
+def test_backpressure_bounded_queue():
+    """A slow consumer must block the producer at the queue bound."""
+    lead = []
+    lock = threading.Lock()
+    produced = [0]
+    consumed = [0]
+
+    class Producer(Stage):
+        @property
+        def name(self):
+            return "producer"
+
+        @property
+        def thread_safe(self):
+            return True
+
+        @property
+        def resources(self):
+            return Resources(cpus=0.25)
+
+        def process_data(self, tasks):
+            with lock:
+                produced[0] += len(tasks)
+            return tasks
+
+    class SlowConsumer(Stage):
+        @property
+        def name(self):
+            return "consumer"
+
+        def process_data(self, tasks):
+            with lock:
+                consumed[0] += len(tasks)
+                lead.append(produced[0] - consumed[0])
+            time.sleep(0.02)
+            return tasks
+
+    cap = 2
+    out = run_pipeline(
+        [Num(i) for i in range(24)],
+        # one producer worker: the bound below counts its single in-hand batch
+        [StageSpec(Producer(), num_workers=1), SlowConsumer()],
+        runner=PipelinedRunner(queue_capacity=cap, batch_linger_s=0.0),
+        skip_validation=True,
+    )
+    assert len(out) == 24
+    # producer can run at most: queue(cap) + consumer's in-hand batch +
+    # its own finished-but-blocked batch ahead of the consumer
+    assert max(lead) <= cap + 2, f"producer ran {max(lead)} tasks ahead"
+
+
+def test_device_stage_pinned_to_one_thread():
+    stage = PinnedStage()
+    out = run_pipeline(
+        [Num(i) for i in range(8)], [stage],
+        runner=PipelinedRunner(), skip_validation=True,
+    )
+    assert len(out) == 8
+    assert len(stage.threads) == 1  # jit/bucket state stays single-threaded
+
+
+def test_cpu_stage_fans_out_across_threads():
+    stage = Add(1, sleep_s=0.02, cpus=0.25, bs=1)
+    out = run_pipeline(
+        [Num(i) for i in range(16)], [stage],
+        runner=PipelinedRunner(), skip_validation=True,
+    )
+    assert sorted(t.v for t in out) == [i + 1 for i in range(16)]
+    assert len(stage.threads) > 1, "thread-safe CPU stage did not fan out"
+
+
+def test_non_thread_safe_stage_stays_single_worker():
+    class Unsafe(Add):
+        @property
+        def thread_safe(self):
+            return False
+
+    stage = Unsafe(1, sleep_s=0.01, cpus=0.25, bs=1)
+    run_pipeline(
+        [Num(i) for i in range(8)], [stage],
+        runner=PipelinedRunner(), skip_validation=True,
+    )
+    assert len(stage.threads) == 1
+
+
+def test_chaos_crash_site_fires_and_retry_recovers():
+    """The worker.batch.crash site fires per batch attempt under the
+    pipelined runner; an error-kind fault consumes one attempt and the
+    retry produces the full output set."""
+    plan = chaos.FaultPlan(
+        rules=(chaos.FaultRule(site=chaos.SITE_WORKER_CRASH, kind="error", count=1),),
+        seed=7,
+    )
+    chaos.install(plan)
+    try:
+        out = run_pipeline(
+            [Num(i) for i in range(6)],
+            [StageSpec(Add(1), num_run_attempts=2)],
+            runner=PipelinedRunner(),
+        )
+        assert chaos.fire_count(chaos.SITE_WORKER_CRASH) == 1
+    finally:
+        chaos.uninstall()
+    assert sorted(t.v for t in out) == [i + 1 for i in range(6)]
+
+
+# ---------------------------------------------------------------------------
+# split-pipeline fixture equivalence
+
+
+@pytest.fixture(scope="module")
+def split_inputs(tmp_path_factory):
+    from tests.fixtures.media import make_scene_video
+
+    d = tmp_path_factory.mktemp("videos")
+    for i in range(3):
+        make_scene_video(d / f"video_{i}.mp4", scene_len_frames=24, num_scenes=2)
+    return d
+
+
+def _run_split(input_dir, out_dir, runner):
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+
+    args = SplitPipelineArgs(
+        input_path=str(input_dir),
+        output_path=str(out_dir),
+        fixed_stride_len_s=1.0,
+        min_clip_len_s=0.5,
+        clip_chunk_size=2,  # force dynamic chunking through the runner
+        extract_fps=(2.0, 4.0),  # two signatures through the multi decode
+        extract_resize_hw=(64, 64),
+    )
+    return run_split(args, runner=runner)
+
+
+def _output_sets(out_dir):
+    clips = sorted(p.name for p in (out_dir / "clips").glob("*.mp4"))
+    metas = sorted(p.name for p in (out_dir / "metas" / "v0").glob("*.json"))
+    return clips, metas
+
+
+def test_split_pipeline_output_equivalence(split_inputs, tmp_path):
+    seq_summary = _run_split(split_inputs, tmp_path / "seq", SequentialRunner())
+    pipe_summary = _run_split(split_inputs, tmp_path / "pipe", PipelinedRunner())
+    for key in ("num_videos", "num_clips", "num_transcoded", "num_errors"):
+        assert pipe_summary[key] == seq_summary[key], key
+    assert _output_sets(tmp_path / "seq") == _output_sets(tmp_path / "pipe")
+    # both runs extracted both signatures: spot-check one meta exists and
+    # the summary agrees on the clip count from the fixtures
+    assert seq_summary["num_clips"] == 6
+
+
+def test_split_equivalence_under_injected_crash(split_inputs, tmp_path):
+    """One injected batch failure per run (site worker.batch.crash,
+    kind=error) must be absorbed by num_run_attempts and leave the output
+    set identical to the crash-free run."""
+    from cosmos_curate_tpu.pipelines.video.input_discovery import discover_split_tasks
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, assemble_stages
+
+    def run_with_chaos(out_dir, runner):
+        args = SplitPipelineArgs(
+            input_path=str(split_inputs),
+            output_path=str(out_dir),
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            clip_chunk_size=2,
+            extract_fps=(2.0,),
+            extract_resize_hw=(64, 64),
+        )
+        stages = [
+            s if isinstance(s, StageSpec) else StageSpec(stage=s, num_run_attempts=2)
+            for s in assemble_stages(args)
+        ]
+        tasks = discover_split_tasks(args.input_path, args.output_path)
+        chaos.install(
+            chaos.FaultPlan(
+                rules=(
+                    chaos.FaultRule(
+                        site=chaos.SITE_WORKER_CRASH, kind="error", count=1
+                    ),
+                ),
+                seed=11,
+            )
+        )
+        try:
+            run_pipeline(tasks, stages, runner=runner)
+            assert chaos.fire_count(chaos.SITE_WORKER_CRASH) == 1
+        finally:
+            chaos.uninstall()
+
+    run_with_chaos(tmp_path / "seq", SequentialRunner())
+    run_with_chaos(tmp_path / "pipe", PipelinedRunner())
+    assert _output_sets(tmp_path / "seq") == _output_sets(tmp_path / "pipe")
+    clips, metas = _output_sets(tmp_path / "seq")
+    assert len(clips) == 6 and len(metas) == 6  # nothing lost to the fault
+
+
+def test_overlap_frac_and_flow_metrics():
+    from cosmos_curate_tpu.observability.stage_timer import (
+        reset_stage_flow,
+        stage_flow_summaries,
+    )
+
+    reset_stage_flow()
+    runner = PipelinedRunner()
+    run_pipeline(
+        [Num(i) for i in range(12)],
+        [Add(1, sleep_s=0.01, bs=1), Add(10, sleep_s=0.01, bs=1)],
+        runner=runner,
+        skip_validation=True,
+    )
+    assert runner.pipeline_wall_s > 0
+    assert 0.0 <= runner.overlap_frac < 1.0
+    flow = stage_flow_summaries()
+    assert "add1" in flow and "add10" in flow
+    assert flow["add1"]["batches"] == 12
+    assert flow["add1"]["busy_s"] > 0
+    reset_stage_flow()
+
+
+def test_default_runner_selection(monkeypatch):
+    from cosmos_curate_tpu.core.runner import default_runner
+
+    monkeypatch.delenv("CURATE_ENGINE_DRIVER_PORT", raising=False)
+    monkeypatch.setenv("CURATE_RUNNER", "")
+    default = default_runner()
+    assert isinstance(default, PipelinedRunner)
+    # production semantics = streaming-engine semantics: an exhausted batch
+    # dead-letters and the run continues, it does not abort
+    assert default.raise_on_error is False
+    monkeypatch.setenv("CURATE_RUNNER", "sequential")
+    assert isinstance(default_runner(), SequentialRunner)
+    monkeypatch.setenv("CURATE_RUNNER", "pipelined")
+    assert isinstance(default_runner(), PipelinedRunner)
+    monkeypatch.setenv("CURATE_RUNNER", "engine")
+    from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+    assert isinstance(default_runner(), StreamingRunner)
+    monkeypatch.setenv("CURATE_RUNNER", "map")
+    from cosmos_curate_tpu.core.map_runner import MapRunner
+
+    assert isinstance(default_runner(), MapRunner)
+    # a typo must fail loudly, never silently land on the threaded default
+    monkeypatch.setenv("CURATE_RUNNER", "sequental")
+    with pytest.raises(ValueError, match="unknown CURATE_RUNNER"):
+        default_runner()
+
+
+def test_overlap_frac_is_per_run():
+    """A reused runner must not mix one run's wall with both runs' busy."""
+    runner = PipelinedRunner()
+    for _ in range(2):
+        run_pipeline(
+            [Num(i) for i in range(6)],
+            [StageSpec(Add(1, sleep_s=0.01, bs=1), num_workers=1)],
+            runner=runner,
+            skip_validation=True,
+        )
+    # one single-worker stage: busy can never exceed wall, so a correctly
+    # scoped overlap is ~0; the cross-run bug would report ~0.5
+    assert runner.overlap_frac < 0.2
+
+
+def test_multi_signature_single_pass_matches_per_signature(tmp_path):
+    """extract_frames_multi serves every signature identically to the
+    one-reopen-per-signature path it replaces."""
+    import numpy as np
+
+    from cosmos_curate_tpu.data.model import FrameExtractionSignature
+    from cosmos_curate_tpu.video.decode import extract_frames_at_fps, extract_frames_multi
+    from tests.fixtures.media import make_scene_video
+
+    path = tmp_path / "v.mp4"
+    make_scene_video(path, scene_len_frames=24, num_scenes=2)
+    data = path.read_bytes()
+    sigs = (
+        FrameExtractionSignature("fps", 2.0),
+        FrameExtractionSignature("fps", 4.0),
+        FrameExtractionSignature("fps", 24.0),
+    )
+    multi = extract_frames_multi(data, sigs, resize_hw=(32, 32))
+    assert set(multi) == {s.key() for s in sigs}
+    for sig in sigs:
+        single = extract_frames_at_fps(
+            data, target_fps=sig.target_fps, resize_hw=(32, 32)
+        )
+        np.testing.assert_array_equal(multi[sig.key()], single)
+    # degenerate inputs keep the empty-array convention
+    bad = extract_frames_multi(b"garbage", sigs)
+    assert all(v.shape == (0, 0, 0, 3) for v in bad.values())
+    assert extract_frames_multi(data, ()) == {}
+
+
+def test_frame_extraction_stage_parallel_decode(tmp_path):
+    """ClipFrameExtractionStage honors num_cpus with a real executor and
+    produces the same frames as serial decode."""
+    from cosmos_curate_tpu.core.stage import WorkerMetadata
+    from cosmos_curate_tpu.data.model import (
+        Clip,
+        FrameExtractionSignature,
+        SplitPipeTask,
+        Video,
+    )
+    from cosmos_curate_tpu.pipelines.video.stages.frame_extraction import (
+        ClipFrameExtractionStage,
+    )
+    from tests.fixtures.media import make_scene_video
+
+    path = tmp_path / "v.mp4"
+    make_scene_video(path, scene_len_frames=24, num_scenes=1)
+    data = path.read_bytes()
+    sig = FrameExtractionSignature("fps", 4.0)
+
+    def task():
+        return SplitPipeTask(
+            video=Video(
+                path="v.mp4", clips=[Clip(encoded_data=data) for _ in range(4)]
+            )
+        )
+
+    stage = ClipFrameExtractionStage(signatures=(sig,), num_cpus=2)
+    stage.setup(WorkerMetadata())
+    assert stage._pool is not None
+    t = task()
+    stage.process_data([t])
+    stage.destroy()
+    assert stage._pool is None
+    # serial fallback (no setup) must agree
+    serial_stage = ClipFrameExtractionStage(signatures=(sig,), num_cpus=2)
+    t2 = task()
+    serial_stage.process_data([t2])
+    for a, b in zip(t.video.clips, t2.video.clips):
+        import numpy as np
+
+        np.testing.assert_array_equal(
+            a.extracted_frames[sig.key()], b.extracted_frames[sig.key()]
+        )
+        assert not a.errors
