@@ -236,7 +236,16 @@ def main() -> int:
     passes = []
     for label in ("cold", "warm"):
         runner = make_runner()
-        pass_args = dataclasses.replace(args, output_path=str(tmp / f"out_{label}"))
+        # the warm (headline) pass runs traced: spans are a boolean check +
+        # buffered NDJSON appends, and the flight recorder turns them into
+        # report/run_report.json — the artifact every BENCH row references
+        # (`cosmos-curate-tpu report <path>` renders the critical path).
+        # A bench-scale run emits a few dozen spans, far below measurement
+        # noise, but value/vs_baseline do carry that overhead vs pre-trace
+        # baselines and vs the untraced cold pass
+        pass_args = dataclasses.replace(
+            args, output_path=str(tmp / f"out_{label}"), tracing=label == "warm"
+        )
         reset_dispatch_stats()  # per-dispatch stats reflect ONE pass
         reset_stage_flow()  # per-stage queue/busy aggregates too
         # engine mode runs stages in spawned workers: have each worker dump
@@ -330,6 +339,22 @@ def main() -> int:
     if backend != "tpu":
         # degraded run (dead TPU tunnel fallback) must be machine-detectable
         record["backend"] = backend
+
+    # flight-recorder artifact for the warm pass (written by run_split's
+    # finalize since the pass ran with tracing): every BENCH row points at
+    # the report that explains its number
+    from cosmos_curate_tpu.observability.flight_recorder import report_path
+
+    rp = report_path(str(tmp / "out_warm"))
+    if Path(rp).exists():
+        record["run_report"] = rp
+        try:
+            rep = json.loads(Path(rp).read_text())
+            record["trace_connected"] = bool(rep.get("connected"))
+        except Exception as e:  # noqa: BLE001
+            log(f"bench: unreadable run report {rp}: {e}")
+    else:
+        log("bench: warm pass produced no run report")
 
     if caption:
         record["caption_output_tokens_per_sec"] = caption["value"]

@@ -65,10 +65,11 @@ def _cmd_list(args: argparse.Namespace) -> int:
     for e in entries:
         m = e.meta
         requeued = " [requeued]" if m.get("requeued_at") else ""
+        trace = f" trace={m['trace_id']}" if m.get("trace_id") else ""
         print(
             f"{e.entry_id}: stage={m.get('stage')} tasks={m.get('num_tasks')} "
             f"attempts={m.get('attempts')} worker_deaths={m.get('worker_deaths')} "
-            f"reason={m.get('reason', '')!r}{requeued}"
+            f"reason={m.get('reason', '')!r}{trace}{requeued}"
         )
     return 0
 

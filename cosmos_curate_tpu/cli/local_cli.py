@@ -185,6 +185,36 @@ def _cmd_merge_summaries(args: argparse.Namespace) -> int:
             f"artifacts: {report.files} files from nodes {report.nodes}"
             + (f"; ERRORS: {report.errors}" if report.errors else "")
         )
+    # multi-node flight recorder: every node's spans are collected now, so
+    # the merged run report (one trace across hosts) is built here.
+    # require_spans: an untraced run must not gain an empty report; the
+    # guard matches run_split's — a recorder failure never fails the merge.
+    try:
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            load_node_stats,
+            load_report,
+            report_path,
+            write_run_report,
+        )
+
+        # runner-sourced sections (dead-letter counts, stage times,
+        # dispatch/flow aggregates) live in the ORIGINAL drivers' memory,
+        # not this process: source them from the per-node sidecars every
+        # multi-node run_split finalize writes, falling back to a
+        # previously-written report (single-node re-merge) — never
+        # overwrite them with empties
+        prior = load_node_stats(args.output_path) or load_report(
+            report_path(args.output_path)
+        )
+        run_report = write_run_report(args.output_path, prior=prior, require_spans=True)
+        if run_report["span_count"]:
+            print(
+                f"run report: {run_report['span_count']} spans, "
+                f"{len(run_report['trace_ids'])} trace(s) -> "
+                f"{run_report['report_path']}"
+            )
+    except Exception as e:  # noqa: BLE001 - report is best-effort here
+        print(f"flight recorder failed (merge unaffected): {e}", file=sys.stderr)
     print(json.dumps(merged, indent=2))
     return 0
 

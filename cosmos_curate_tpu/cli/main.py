@@ -79,6 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
     from cosmos_curate_tpu.cli import dlq_cli
 
     dlq_cli.register(sub)
+    from cosmos_curate_tpu.cli import report_cli
+
+    report_cli.register(sub)
 
     agent = sub.add_parser(
         "agent",

@@ -1,0 +1,94 @@
+"""`cosmos-curate-tpu report …` — render a run's flight-recorder report.
+
+The flight recorder (observability/flight_recorder.py) writes
+``<output>/report/run_report.json`` at run finalize for traced runs. This
+sub-app renders it: trace connectivity (ONE trace id = cross-process
+propagation held), the span-tree critical path, and the per-stage /
+device-dispatch / flow time breakdowns.
+
+``RUN`` is the pipeline output root (or a direct path to a
+``run_report.json``). ``--rebuild`` regenerates the report from the run's
+collected trace artifacts — useful after copying a run directory around or
+when the run predates the recorder.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    rep = sub.add_parser(
+        "report",
+        help="render a run's flight-recorder report (critical path, "
+        "per-stage time, trace connectivity)",
+    )
+    rep.add_argument("run", help="pipeline output root (or a run_report.json path)")
+    rep.add_argument("--json", action="store_true", dest="as_json", help="raw JSON")
+    rep.add_argument(
+        "--rebuild",
+        action="store_true",
+        help="regenerate the report from the run's trace artifacts first",
+    )
+    rep.set_defaults(func=_cmd_report)
+
+
+def _cmd_report(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.observability.flight_recorder import (
+        REPORT_REL,
+        load_report,
+        render_report,
+        report_path,
+        write_run_report,
+    )
+
+    run = args.run
+    suffix = f"/{REPORT_REL}"
+    if run.endswith(".json"):
+        path = run
+        # the run root is only derivable when the json sits at its
+        # canonical in-run location; a bare copied file has no root
+        root = run[: -len(suffix)] if run.endswith(suffix) and len(run) > len(suffix) else None
+    else:
+        path = report_path(run)
+        root = run
+    existing: dict | None = None
+    try:
+        existing = load_report(path, strict=True)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        if not args.rebuild:
+            return 2
+    if args.rebuild or existing is None:
+        if root is None:
+            print(
+                f"error: cannot rebuild from {run!r} — pass the run's "
+                "output root instead of a detached json file",
+                file=sys.stderr,
+            )
+            return 2
+        if not args.rebuild:
+            print(
+                f"no report at {path}; rebuilding from trace artifacts",
+                file=sys.stderr,
+            )
+        # `prior` carries over the sections only the original driver could
+        # source (dispatch/flow aggregates, runner stage times) — a rebuild
+        # refreshes the span analysis without degrading the artifact
+        report = write_run_report(root, prior=existing, require_spans=True)
+        if not report["span_count"]:
+            print(
+                f"error: no trace spans under {root}/profile — was the run "
+                "traced (--tracing)?",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        report = existing
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_report(report))
+    return 0

@@ -46,6 +46,7 @@ individual worker threads.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
@@ -256,8 +257,19 @@ class PipelinedRunner(RunnerInterface):
 
     # ------------------------------------------------------------------
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
         if not spec.stages:
             return list(spec.input_data) if spec.config.return_last_stage_outputs else None
+        # the run-root span rides the contextvar stack; worker threads are
+        # started under contextvars.copy_context() (see _start_worker), so
+        # their batch spans parent onto it across the thread-pool hop
+        with traced_span(
+            "pipeline.run", runner="pipelined", stages=len(spec.stages)
+        ):
+            return self._run_pipelined(spec)
+
+    def _run_pipelined(self, spec: PipelineSpec) -> list[PipelineTask] | None:
         t_start = time.monotonic()
         self._abort.clear()
         self._abort_exc = None
@@ -553,8 +565,15 @@ class PipelinedRunner(RunnerInterface):
             allocation=rt.stage.resources,
         )
         w = _Worker(meta=meta)
+        # carry the caller's context (the run-root trace span) into the
+        # worker thread: contextvars survive this hop, threading.local
+        # would not
+        ctx = contextvars.copy_context()
         w.thread = threading.Thread(
-            target=self._worker_loop, args=(rt, w), daemon=True, name=meta.worker_id
+            target=ctx.run,
+            args=(self._worker_loop, rt, w),
+            daemon=True,
+            name=meta.worker_id,
         )
         rt.workers.append(w)
         w.thread.start()
@@ -619,6 +638,9 @@ class PipelinedRunner(RunnerInterface):
                 rt.busy_s,
                 rt.next_worker_idx,
             )
+        # export the stage-overlap headline as a real gauge (bench used to
+        # be the only reader of this number)
+        self.metrics.set_overlap_frac(self.overlap_frac)
         if self.dlq is not None and self.dlq.recorded:
             logger.error(
                 "%d dropped batch(es) persisted to the dead-letter queue: "

@@ -49,27 +49,37 @@ class SequentialRunner(RunnerInterface):
         self.dead_lettered = 0
 
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
         # fresh run-scoped DLQ state (run_id is fixed at DLQ construction,
         # so reusing one across runs would file run 2's drops under run 1)
         self.dlq = None
         self.dead_lettered = 0
         node = NodeInfo(node_id="local")
         tasks: list[PipelineTask] = list(spec.input_data)
-        for stage_spec in spec.stages:
-            stage = stage_spec.stage
-            meta = WorkerMetadata(
-                worker_id=f"{stage.name}-seq-0",
-                stage_name=stage.name,
-                node=node,
-                allocation=stage.resources,
-            )
-            t0 = time.monotonic()
-            from cosmos_curate_tpu.observability.tracing import traced_span
+        with traced_span(
+            "pipeline.run", runner="sequential", stages=len(spec.stages)
+        ):
+            for stage_spec in spec.stages:
+                tasks = self._run_stage(stage_spec, node, tasks)
+        return tasks if spec.config.return_last_stage_outputs else None
 
+    def _run_stage(self, stage_spec, node, tasks: list) -> list:
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
+        stage = stage_spec.stage
+        meta = WorkerMetadata(
+            worker_id=f"{stage.name}-seq-0",
+            stage_name=stage.name,
+            node=node,
+            allocation=stage.resources,
+        )
+        t0 = time.monotonic()
+        out: list[PipelineTask] = []
+        with traced_span(f"stage.{stage.name}", stage=stage.name):
             with traced_span(f"stage.{stage.name}.setup"):
                 stage.setup_on_node(node, meta)
                 stage.setup(meta)
-            out: list[PipelineTask] = []
             bs = max(1, stage.batch_size)
             try:
                 for i in range(0, len(tasks), bs):
@@ -102,13 +112,12 @@ class SequentialRunner(RunnerInterface):
                     out.extend(result)
             finally:
                 stage.destroy()
-            stage_s = time.monotonic() - t0
-            self.stage_times[stage.name] = self.stage_times.get(stage.name, 0.0) + stage_s
-            logger.info(
-                "stage %s: %d -> %d tasks in %.2fs", stage.name, len(tasks), len(out), stage_s
-            )
-            tasks = out
-        return tasks if spec.config.return_last_stage_outputs else None
+        stage_s = time.monotonic() - t0
+        self.stage_times[stage.name] = self.stage_times.get(stage.name, 0.0) + stage_s
+        logger.info(
+            "stage %s: %d -> %d tasks in %.2fs", stage.name, len(tasks), len(out), stage_s
+        )
+        return out
 
     def _dead_letter(self, stage_name: str, batch_id: int, tasks: list, attempts: int) -> None:
         """Persist a dropped batch to the durable DLQ — local runs get the

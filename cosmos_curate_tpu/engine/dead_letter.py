@@ -106,12 +106,23 @@ class DeadLetterQueue:
         reason: str,
         error: str = "",
         payload_errors: list[str] | None = None,
+        trace_id: str = "",
     ) -> Path | None:
         """Persist one dead batch; returns its directory (None = disabled
-        or failed — the caller's drop proceeds regardless)."""
+        or failed — the caller's drop proceeds regardless).
+
+        ``trace_id`` links the entry back to its distributed trace; when
+        omitted, the recorder captures the current context's trace id (the
+        runners record drops from inside their run span), so `dlq show`
+        can answer "which trace dropped this batch"."""
         if not self.enabled:
             return None
         import cloudpickle
+
+        if not trace_id:
+            from cosmos_curate_tpu.observability.tracing import current_trace_id
+
+            trace_id = current_trace_id() or ""
 
         # stage names are arbitrary user strings; path separators (or any
         # exotic char) must not nest/escape the entry dir and break the CLI
@@ -131,6 +142,7 @@ class DeadLetterQueue:
                 "error_tail": error[-_ERROR_TAIL:] if error else "",
                 "dropped_at": time.time(),
                 "run_id": self.run_id,
+                "trace_id": trace_id,
             }
             if payload_errors:
                 # some payloads could not be materialized (e.g. their owner

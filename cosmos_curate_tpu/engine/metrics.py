@@ -81,6 +81,15 @@ class EngineMetrics:
             "pipeline_stage_busy_fraction",
             "worker busy fraction over the last sampling window", labels,
         )
+        # Stage-overlap headline (core/pipelined_runner.py): fraction of
+        # summed host-stage work hidden behind other stages over the LAST
+        # run — 0 = lockstep, →1-max/sum = perfect overlap. Was a
+        # bench-only log line; now a scrapeable gauge.
+        self.overlap_frac = Gauge(
+            "pipeline_overlap_frac",
+            "fraction of summed stage busy time hidden by stage overlap "
+            "(last completed run)", [],
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -121,6 +130,25 @@ class EngineMetrics:
         self.dispatch_compute_total.labels(stage).inc(max(compute_s, 0.0))
         self.dispatch_h2d_total.labels(stage).inc(max(h2d_s, 0.0))
         self.dispatch_d2h_total.labels(stage).inc(max(d2h_s, 0.0))
+
+    def observe_dispatch_aggregate(self, stage: str, agg: dict) -> None:
+        """Fold a worker-dumped dispatch AGGREGATE (stage_timer dump schema)
+        into the counters — the finalize-time path that completes the
+        ``pipeline_device_*`` series for spawned engine workers, which have
+        no exporter of their own."""
+        if not self.enabled:
+            return
+        self.dispatches_total.labels(stage).inc(max(0, int(agg.get("dispatches", 0))))
+        self.dispatch_gap_total.labels(stage).inc(max(0.0, float(agg.get("gap_s", 0.0))))
+        self.dispatch_compute_total.labels(stage).inc(
+            max(0.0, float(agg.get("compute_s", 0.0)))
+        )
+        self.dispatch_h2d_total.labels(stage).inc(max(0.0, float(agg.get("h2d_s", 0.0))))
+        self.dispatch_d2h_total.labels(stage).inc(max(0.0, float(agg.get("d2h_s", 0.0))))
+
+    def set_overlap_frac(self, frac: float) -> None:
+        if self.enabled:
+            self.overlap_frac.set(min(max(frac, 0.0), 1.0))
 
     def set_stage_busy(self, stage: str, frac: float) -> None:
         if self.enabled:

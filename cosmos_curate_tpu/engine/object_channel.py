@@ -11,7 +11,9 @@ channel is read-only.
 
 Wire protocol (per connection, authenticated with the cluster token):
 - request: one MAC'd control frame (remote_plane.send_msg) —
-  ``("get", shm_name, nonce16)``.
+  ``("get", shm_name, nonce16)``, optionally extended with the caller's
+  W3C traceparent (``("get", shm_name, nonce16, traceparent)``) so the
+  OWNER's serve span joins the fetcher's trace instead of fragmenting.
 - response: ``status u8 | total u64 | data stream | hmac-sha256`` where
   the MAC covers ``shm_name || nonce || data`` — binding the stream to
   THIS request, so a recorded stream of a different segment (or an old
@@ -23,6 +25,7 @@ Wire protocol (per connection, authenticated with the cluster token):
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import hmac
 import os
@@ -93,11 +96,12 @@ class ObjectServer:
             req = recv_msg(sock, self._token, max_bytes=1 << 20)
             if (
                 isinstance(req, tuple)
-                and len(req) == 3
+                and len(req) in (3, 4)
                 and req[0] == "get"
                 and isinstance(req[2], bytes)
             ):
-                self._serve_get(sock, req[1], req[2])
+                tp = req[3] if len(req) == 4 and isinstance(req[3], str) else ""
+                self._serve_get(sock, req[1], req[2], tp)
         except (ConnectionError, OSError):
             pass
         except Exception:
@@ -108,22 +112,44 @@ class ObjectServer:
             except OSError:
                 pass
 
-    def _serve_get(self, sock: socket.socket, name, nonce: bytes) -> None:
+    def _serve_get(
+        self, sock: socket.socket, name, nonce: bytes, traceparent: str = ""
+    ) -> None:
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
         # kind=error: the connection resets before any bytes are served —
         # consumers see a dropped transfer, exactly like a mid-GET peer death
         chaos.fire(chaos.SITE_OBJECT_CHANNEL_SERVE)
         if not isinstance(name, str) or not object_store.valid_segment_name(name):
             sock.sendall(_DENIED + struct.pack(">Q", 0))
             return
-        try:
-            f = open(object_store.segment_path(name), "rb")
-        except FileNotFoundError:
-            sock.sendall(_MISSING + struct.pack(">Q", 0))
-            return
-        with f:
+        # serve threads have no ambient context, so an un-traced peer's pull
+        # records nothing (a span without the incoming traceparent could
+        # only start a one-span fragment). The span opens BEFORE the
+        # segment lookup: a missing segment (release race, premature
+        # eviction) is exactly the serve outcome worth tracing
+        with contextlib.ExitStack() as stack:
+            if traceparent:
+                span = stack.enter_context(
+                    traced_span(
+                        "object_channel.serve", traceparent=traceparent, segment=name
+                    )
+                )
+            else:
+                span = None
+            try:
+                f = open(object_store.segment_path(name), "rb")
+            except FileNotFoundError:
+                if span is not None:
+                    span.set_attribute("result", "missing")
+                sock.sendall(_MISSING + struct.pack(">Q", 0))
+                return
+            stack.enter_context(f)
             f.seek(0, 2)
             total = f.tell()
             f.seek(0)
+            if span is not None:
+                span.set_attribute("bytes", total)
             sock.sendall(_OK + struct.pack(">Q", total))
             mac = _stream_mac(self._token, name, nonce)
             while True:
@@ -149,6 +175,7 @@ def _open_get(
     addr: tuple[str, int], token: bytes, name: str
 ) -> tuple[socket.socket, int, "Iterator[bytes]"]:
     from cosmos_curate_tpu.engine.remote_plane import send_msg
+    from cosmos_curate_tpu.observability.tracing import format_traceparent
 
     # kind=error: the dial/transfer fails as a ConnectionError, flowing
     # through the same localize/fetch retry paths a real drop would
@@ -156,7 +183,16 @@ def _open_get(
     nonce = os.urandom(16)
     sock = socket.create_connection(addr, timeout=30)
     try:
-        send_msg(sock, ("get", name, nonce), token)
+        # the traceparent rides the request so the OWNER's serve span joins
+        # this fetch's trace (the caller's fetch span is ambient here).
+        # Untraced requests keep the legacy 3-tuple: a peer still running
+        # the pre-traceparent server rejects 4-tuples outright, so tracing
+        # off must stay wire-identical across version skew. Tracing ON
+        # requires same-version peers (documented in docs/OBSERVABILITY.md);
+        # a silent 3-tuple fallback here would mask real connection errors
+        tp = format_traceparent()
+        req = ("get", name, nonce, tp) if tp else ("get", name, nonce)
+        send_msg(sock, req, token)
         head = _recv_exact(sock, 1 + 8)
         status = head[:1]
         (total,) = struct.unpack(">Q", head[1:])
@@ -192,26 +228,42 @@ def fetch_object(
     local ref. Constant-memory streaming; the request-bound trailing MAC
     authenticates the whole stream. The .tmp-then-rename in put_raw_chunks
     means a truncated/forged transfer never becomes a visible segment."""
-    sock, total, chunks = _open_get(addr, token, ref.shm_name)
-    try:
-        return object_store.put_raw_chunks(chunks, total, ref.num_buffers)
-    finally:
+    from cosmos_curate_tpu.observability.tracing import traced_span
+
+    with traced_span(
+        "object_channel.fetch",
+        segment=ref.shm_name,
+        owner=f"{addr[0]}:{addr[1]}",
+    ) as span:
+        sock, total, chunks = _open_get(addr, token, ref.shm_name)
+        span.set_attribute("bytes", total)
         try:
-            sock.close()
-        except OSError:
-            pass
+            return object_store.put_raw_chunks(chunks, total, ref.num_buffers)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 def fetch_value(addr: tuple[str, int], token: bytes, ref: object_store.ObjectRef):
     """Pull a segment and reconstruct the object WITHOUT creating a local
     segment (final-sink materialization)."""
-    sock, total, chunks = _open_get(addr, token, ref.shm_name)
-    try:
-        # chunks() delivers exactly `total` bytes or raises (truncation and
-        # MAC failures surface from the generator)
-        return object_store.loads_segment(b"".join(chunks))
-    finally:
+    from cosmos_curate_tpu.observability.tracing import traced_span
+
+    with traced_span(
+        "object_channel.fetch_value",
+        segment=ref.shm_name,
+        owner=f"{addr[0]}:{addr[1]}",
+    ) as span:
+        sock, total, chunks = _open_get(addr, token, ref.shm_name)
+        span.set_attribute("bytes", total)
         try:
-            sock.close()
-        except OSError:
-            pass
+            # chunks() delivers exactly `total` bytes or raises (truncation
+            # and MAC failures surface from the generator)
+            return object_store.loads_segment(b"".join(chunks))
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -66,6 +66,9 @@ class BasePool:
         self.stage = spec.stage
         self.node = node
         self.workers: dict[str, WorkerHandle] = {}
+        # W3C traceparent of the driver-side span submitted batches parent
+        # onto (the runner sets it per stage); '' = tracing off
+        self.trace_context: str = ""
         self._next_id = 0
         # recent (finish_time, process_time_s) samples for the autoscaler
         self.samples: list[tuple[float, float]] = []
@@ -122,6 +125,7 @@ class BasePool:
                 batch_id=batch_id,
                 refs=refs,
                 timeout_s=self.spec.batch_timeout_s or 0.0,
+                traceparent=self.trace_context,
             )
         )
 
@@ -166,10 +170,20 @@ def _base_worker_env() -> dict[str, str]:
         # (see object_store.put): recycled workers leave live data behind
         "CURATE_STORE_OWNER": os.environ.get("CURATE_STORE_OWNER", str(os.getpid())),
     }
-    from cosmos_curate_tpu.observability.tracing import tracing_enabled
+    from cosmos_curate_tpu.observability.tracing import (
+        TRACEPARENT_ENV,
+        format_traceparent,
+        tracing_enabled,
+    )
 
     if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
         env["CURATE_TRACING"] = "1"
+        # the driver's ambient span (the run root, when workers start from
+        # the orchestration loop) becomes the worker's process-level parent,
+        # so its setup/idle spans join this trace too
+        tp = format_traceparent() or os.environ.get(TRACEPARENT_ENV, "")
+        if tp:
+            env[TRACEPARENT_ENV] = tp
     from cosmos_curate_tpu import chaos
 
     if os.environ.get(chaos.CHAOS_ENV):
@@ -381,9 +395,21 @@ class InProcessPool(BasePool):
                 break
             t0 = time.monotonic()
             try:
+                from cosmos_curate_tpu.observability.tracing import traced_span
+
                 tasks = [object_store.get(r) for r in msg.refs]
                 dt = time.monotonic() - t0
-                with self._lock:
+                # span OUTSIDE the lock: exiting a span can flush 200
+                # buffered records through the storage backend — doing that
+                # while holding the pool-wide lock would stall every other
+                # in-process worker on trace IO. The span therefore includes
+                # lock wait, matching process_time_s (also t0-based)
+                with traced_span(
+                    f"stage.{self.name}.process",
+                    traceparent=getattr(msg, "traceparent", "") or None,
+                    batch_size=len(tasks),
+                    worker_id=handle.worker_id,
+                ), self._lock:
                     result = stage.process_data(tasks)
                 if result is not None and not isinstance(result, list):
                     raise TypeError(

@@ -269,7 +269,20 @@ class NodeAgent:
                     )
                 )
                 return
-            refs, fetched = self._resolve_specs(msg.refs)
+            # the agent's own hop in the trace: input resolution (peer/
+            # driver fetches over the object channel) parents onto the
+            # driver's stage span via the frame's traceparent. No-op
+            # unless the agent runs with CURATE_TRACING=1.
+            from cosmos_curate_tpu.observability.tracing import traced_span
+
+            with traced_span(
+                "agent.resolve_inputs",
+                traceparent=getattr(msg, "traceparent", "") or None,
+                worker=msg.worker_key,
+                batch_id=msg.batch_id,
+                node=self.node_id,
+            ):
+                refs, fetched = self._resolve_specs(msg.refs)
             # the fetch above can take seconds: the worker may have died and
             # been reaped by the watchdog meanwhile. Re-check under the same
             # lock hold as the inflight insert — inserting for a reaped key
@@ -294,7 +307,13 @@ class NodeAgent:
                     except OSError:
                         pass
                 return
-            entry[0].put(ProcessMsg(batch_id=msg.batch_id, refs=refs))
+            entry[0].put(
+                ProcessMsg(
+                    batch_id=msg.batch_id,
+                    refs=refs,
+                    traceparent=getattr(msg, "traceparent", ""),
+                )
+            )
         elif isinstance(msg, ReleaseObjects):
             for name in msg.names:
                 object_store.delete(object_store.ObjectRef(name, 0, 0))
@@ -447,8 +466,10 @@ def main(argv=None) -> int:
     ap.add_argument("--num-cpus", type=float, default=None)
     args = ap.parse_args(argv)
     from cosmos_curate_tpu import chaos
+    from cosmos_curate_tpu.observability.tracing import setup_tracing_from_env
 
     chaos.install_from_env()  # soak rigs arm agent-side faults via env
+    setup_tracing_from_env()  # CURATE_TRACING=1 joins the agent to the trace
     return NodeAgent(args.driver, node_id=args.node_id, num_cpus=args.num_cpus).run()
 
 

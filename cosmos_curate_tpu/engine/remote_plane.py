@@ -100,6 +100,10 @@ class SubmitBatch:
     # an expired worker is killed and reported as WorkerDied, and the
     # driver's normal reap requeues the batch.
     timeout_s: float = 0.0
+    # W3C trace context of the driver-side stage span: crosses the control
+    # socket so the remote worker's spans parent onto the driver's trace
+    # instead of starting a per-host fragment. '' = tracing off.
+    traceparent: str = ""
 
 
 @dataclass
@@ -560,6 +564,7 @@ class RemoteWorkerManager:
                 SubmitBatch(
                     key, msg.batch_id, [self._spec_for(r) for r in msg.refs],
                     timeout_s=msg.timeout_s,
+                    traceparent=getattr(msg, "traceparent", ""),
                 )
             )
 

@@ -12,6 +12,7 @@ runs stage-by-stage, letting each use the whole budget.
 
 from __future__ import annotations
 
+import contextvars
 import multiprocessing as mp
 import os
 import queue
@@ -91,11 +92,33 @@ class StreamingRunner(RunnerInterface):
         if not spec.stages:
             return list(spec.input_data) if spec.config.return_last_stage_outputs else None
         from cosmos_curate_tpu.engine.dead_letter import DeadLetterQueue
+        from cosmos_curate_tpu.observability.tracing import traced_span
 
         self.dlq = DeadLetterQueue()  # lazy: writes nothing unless a drop happens
-        if spec.config.execution_mode is ExecutionMode.BATCH:
-            return self._run_batch(spec)
-        return self._run_streaming(spec, spec.stages)
+        try:
+            with traced_span(
+                "pipeline.run", runner="streaming", stages=len(spec.stages)
+            ):
+                if spec.config.execution_mode is ExecutionMode.BATCH:
+                    return self._run_batch(spec)
+                return self._run_streaming(spec, spec.stages)
+        finally:
+            # workers only surface dispatch aggregates via their at-exit
+            # dump: fold whatever landed during pool shutdown into THIS
+            # process's aggregates + prometheus counters, so engine runs
+            # report complete pipeline_device_* series
+            self._merge_worker_dispatch_stats()
+
+    @staticmethod
+    def _merge_worker_dispatch_stats() -> None:
+        from cosmos_curate_tpu.observability.stage_timer import (
+            DISPATCH_DUMP_DIR_ENV,
+            merge_new_dumped_summaries,
+        )
+
+        path = os.environ.get(DISPATCH_DUMP_DIR_ENV)
+        if path:
+            merge_new_dumped_summaries(path)
 
     # ------------------------------------------------------------------
     def _run_batch(self, spec: PipelineSpec) -> list[PipelineTask] | None:
@@ -207,6 +230,21 @@ class StreamingRunner(RunnerInterface):
         last_autoscale = time.monotonic()
         pending_setup_errors: list[str] = []
 
+        # one driver-side span per stage (child of the ambient pipeline.run
+        # span); every batch this stage dispatches — local process worker,
+        # in-process TPU thread, or a worker on a remote agent — carries its
+        # traceparent, so worker spans parent onto the driver's stage span.
+        # Created immediately before the try whose finally ends them: a
+        # setup failure in between would leak never-exported spans, and the
+        # collected trace would blame propagation for a setup error
+        from cosmos_curate_tpu.observability import tracing
+
+        stage_spans = []
+        for st in states:
+            span = tracing.start_span(f"stage.{st.spec.name}", stage=st.spec.name)
+            stage_spans.append(span)
+            st.pool.trace_context = tracing.format_traceparent(span)
+
         try:
             while True:
                 progressed = False
@@ -305,9 +343,12 @@ class StreamingRunner(RunnerInterface):
                         ):
                             # a LOCAL consumer needs agent-owned bytes: pull
                             # them on the fetch pool, never this loop; the
-                            # batch re-enters dispatch when done (1b above)
+                            # batch re-enters dispatch when done (1b above).
+                            # copy_context: the fetch spans must parent onto
+                            # the ambient run span, not fragment the trace
                             localizing[batch.batch_id] = batch
                             self._fetch_pool.submit(
+                                contextvars.copy_context().run,
                                 self._localize_batch,
                                 batch, store, remote_mgr, localize_done,
                             )
@@ -436,6 +477,11 @@ class StreamingRunner(RunnerInterface):
             if remote_mgr is not None:
                 self.remote_stats = remote_mgr.stats()
                 remote_mgr.shutdown()
+            for st, span in zip(states, stage_spans):
+                span.set_attribute("dispatched", st.dispatched)
+                span.set_attribute("completed", st.completed)
+                span.set_attribute("errored", st.errored_batches)
+                tracing.end_span(span)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -590,7 +636,8 @@ class StreamingRunner(RunnerInterface):
                     st,
                     batch,
                     self._fetch_pool.submit(
-                        self._fetch_final_values, final_remote, self._remote_mgr
+                        contextvars.copy_context().run,
+                        self._fetch_final_values, final_remote, self._remote_mgr,
                     ),
                 )
             )

@@ -44,6 +44,10 @@ class ProcessMsg:
     # node agent's watchdog remotely — never by the worker itself (a hung
     # worker can't run its own timer).
     timeout_s: float = 0.0
+    # W3C trace context of the driver-side stage span this batch belongs
+    # to: the worker's process span parents onto it, so one trace spans
+    # driver -> (agent ->) worker. '' when tracing is off.
+    traceparent: str = ""
 
 
 @dataclass
@@ -138,8 +142,15 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
             try:
                 chaos.fire(chaos.SITE_WORKER_CRASH)  # kind=crash: os._exit
                 chaos.fire(chaos.SITE_WORKER_HANG)  # kind=hang: stuck batch
+                # Stage.name, not type(...).__name__: observability wrappers
+                # subclass dynamically, and the flight recorder attributes
+                # time by span name — every wrapped stage collapsing to
+                # "ProfiledStage" would merge them all into one bucket
                 with traced_span(
-                    f"stage.{type(stage).__name__}.process", batch_size=len(tasks)
+                    f"stage.{getattr(stage, 'name', type(stage).__name__)}.process",
+                    traceparent=msg.traceparent or None,
+                    batch_size=len(tasks),
+                    worker_id=worker_id,
                 ):
                     result = stage.process_data(tasks)
                 if result is not None and not isinstance(result, list):

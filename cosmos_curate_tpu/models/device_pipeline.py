@@ -256,14 +256,28 @@ class DevicePipeline:
         the D2H of batch k runs while batches k+1.. still compute. Records
         per-dispatch timings. On ANY failure the pipeline aborts (state
         fully cleared) before the exception propagates."""
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
         # take ownership up front: a failure partway must not leave stale
         # results behind to misalign the NEXT drain's zip
         burst = self._settled + self._pending
         self._settled, self._pending = [], []
         out: list[Any] = []
+        if not burst:
+            return out
+        with traced_span(
+            f"device.{self.name}.drain",
+            dispatches=len(burst),
+            rows=sum(inf.rows for inf in burst),
+        ):
+            out = self._drain_burst(burst)
+        return out
+
+    def _drain_burst(self, burst: list) -> list[Any]:
         # gap accounting is local to this submit..drain burst: carrying it
         # across drains would book unrelated stage work (decode, IO between
         # process_data calls) as device idle
+        out: list[Any] = []
         last_done: float | None = None
         try:
             for inf in burst:

@@ -79,6 +79,11 @@ class DispatchRecord:
 # counters already carry the stream).
 _DISPATCH_LOCK = threading.Lock()
 _DISPATCH: dict[str, dict] = {}
+# Aggregates folded in from OTHER processes' dump files
+# (merge_new_dumped_summaries). Kept separate from _DISPATCH so this
+# process's own at-exit dump never re-exports them — a later merge over
+# the same dump dir would count every worker's stats twice.
+_FOLDED: dict[str, dict] = {}
 
 # When set, every process that recorded dispatches writes its aggregate
 # summaries to <dir>/dispatch-<pid>.json at exit — how engine WORKERS get
@@ -141,38 +146,91 @@ def _dump_summaries(path: str | None) -> None:
             return
         d = Path(path)
         d.mkdir(parents=True, exist_ok=True)
-        (d / f"dispatch-{os.getpid()}.json").write_text(json.dumps(dispatch_summaries()))
+        # dump this process's OWN dispatches only: aggregates merged in
+        # from other processes' dumps (_FOLDED) are already on disk in
+        # THEIR files, and re-exporting them would double-count on the
+        # next merge over this dir
+        with _DISPATCH_LOCK:
+            items = {k: dict(v) for k, v in _DISPATCH.items()}
+        (d / f"dispatch-{os.getpid()}.json").write_text(json.dumps(_summarize(items)))
     except Exception:  # a failed dump must never break process exit
         pass
+
+
+def _iter_dumps(path: str):
+    """Yield ``(file, parsed dict)`` for every readable dispatch-*.json
+    dump under ``path`` — the one parser both merge entry points share."""
+    import json
+
+    d = Path(path)
+    if not d.is_dir():
+        return
+    for f in sorted(d.glob("dispatch-*.json")):
+        try:
+            yield f, json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+
+
+def _fold(into: dict, agg: dict) -> None:
+    for k in into:
+        into[k] += agg.get(k, 0)
 
 
 def load_dumped_summaries(path: str) -> dict[str, dict]:
     """Merge dispatch summaries dumped by other processes (engine workers)
     under ``path`` into one name -> aggregate view."""
-    import json
-
     merged: dict[str, dict] = {}
-    d = Path(path)
-    if not d.is_dir():
-        return merged
-    for f in sorted(d.glob("dispatch-*.json")):
-        try:
-            data = json.loads(f.read_text())
-        except (OSError, ValueError):
-            continue
+    for _f, data in _iter_dumps(path):
         for name, agg in data.items():
-            into = merged.setdefault(name, _new_agg())
-            for k in into:
-                into[k] += agg.get(k, 0)
+            _fold(merged.setdefault(name, _new_agg()), agg)
     for agg in merged.values():
         busy = agg["gap_s"] + agg["compute_s"]
         agg["gap_frac"] = round(agg["gap_s"] / busy, 4) if busy > 0 else 0.0
     return merged
 
 
+# dump files already folded into THIS process's aggregates (path strings):
+# a driver that runs several engine pipelines against the same dump dir
+# must not double-count a worker's aggregate on the second merge
+_MERGED_DUMPS: set[str] = set()
+
+
+def merge_new_dumped_summaries(path: str) -> dict[str, dict]:
+    """Fold worker-dumped dispatch aggregates into THIS process's in-memory
+    aggregates AND its prometheus counters, each dump file at most once.
+
+    This is how the driver completes its ``pipeline_device_*`` series on
+    engine runs: spawned workers cannot serve their own exporter, so their
+    at-exit dumps (``CURATE_DISPATCH_DUMP_DIR``) are merged at finalize.
+    Returns what was newly merged (name -> aggregate)."""
+    merged: dict[str, dict] = {}
+    own = f"dispatch-{os.getpid()}.json"  # never re-ingest our own dump
+    for f, data in _iter_dumps(path):
+        key = str(f)
+        if key in _MERGED_DUMPS or f.name == own:
+            continue
+        _MERGED_DUMPS.add(key)
+        for name, agg in data.items():
+            _fold(merged.setdefault(name, _new_agg()), agg)
+            with _DISPATCH_LOCK:
+                _fold(_FOLDED.setdefault(name, _new_agg()), agg)
+    if merged:
+        try:
+            from cosmos_curate_tpu.engine.metrics import get_metrics
+
+            m = get_metrics()
+            for name, agg in merged.items():
+                m.observe_dispatch_aggregate(name, agg)
+        except Exception:  # metrics must never take down finalize
+            pass
+    return merged
+
+
 def reset_dispatch_stats() -> None:
     with _DISPATCH_LOCK:
         _DISPATCH.clear()
+        _FOLDED.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -253,12 +311,19 @@ def reset_stage_flow() -> None:
 
 
 def dispatch_summaries() -> dict[str, dict]:
-    """name -> aggregate per-dispatch timings. ``gap_frac`` is device idle
-    over total device-relevant wall (gap + compute): < 0.2 means the host
-    kept the device fed for >80% of the stage's device window."""
-    out: dict[str, dict] = {}
+    """name -> aggregate per-dispatch timings, including aggregates merged
+    in from worker dump files. ``gap_frac`` is device idle over total
+    device-relevant wall (gap + compute): < 0.2 means the host kept the
+    device fed for >80% of the stage's device window."""
     with _DISPATCH_LOCK:
         items = {k: dict(v) for k, v in _DISPATCH.items()}
+        for name, agg in _FOLDED.items():
+            _fold(items.setdefault(name, _new_agg()), agg)
+    return _summarize(items)
+
+
+def _summarize(items: dict[str, dict]) -> dict[str, dict]:
+    out: dict[str, dict] = {}
     for name, agg in items.items():
         busy = agg["gap_s"] + agg["compute_s"]
         out[name] = {

@@ -9,14 +9,32 @@ per-worker NDJSON export). Spans are recorded to one NDJSON file per process
 real collector over OTLP/HTTP JSON — encoded directly against the public
 OTLP schema, no opentelemetry SDK needed. Disabled = zero-cost: every call
 path short-circuits on one boolean.
+
+Cross-boundary propagation uses the W3C trace-context wire format
+(``00-<32 hex trace_id>-<16 hex span_id>-01``):
+
+- ``format_traceparent()`` / ``parse_traceparent()`` — the header itself;
+- ``traced_span(..., traceparent=...)`` — restore an incoming context as
+  the span's parent (how a worker's per-batch span parents onto the
+  driver's stage span across a ``SubmitBatch`` frame);
+- ``attach_traceparent()`` — process-level base parent (how a SPAWNED
+  worker's setup spans parent onto the driver's run span: the driver
+  stamps ``CURATE_TRACEPARENT`` into the worker env, and
+  ``setup_tracing_from_env`` attaches it);
+- the active-span stack lives in a ``contextvars.ContextVar`` of
+  immutable tuples, so ``contextvars.copy_context()`` carries it across
+  thread-pool hops (the pipelined runner's worker threads), which
+  ``threading.local`` cannot.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import functools
 import json
 import os
+import re
 import threading
 import time
 import uuid
@@ -25,7 +43,21 @@ from typing import Any, Callable, Iterator
 
 _enabled = False
 _backends: list = []
-_local = threading.local()
+# Innermost-last active spans for the CURRENT context. Immutable tuple:
+# copied contexts (thread hops) must never share a mutable stack.
+_stack: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "curate_trace_stack", default=()
+)
+# True while exporting/flushing spans: a storage.write span created by the
+# NDJSON backend's own flush would deadlock on the backend lock (and spam
+# the trace with self-referential spans).
+_suppress: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
+    "curate_trace_suppress", default=False
+)
+# Process-level base parent (trace_id, span_id) restored from an incoming
+# traceparent: spans opened with an empty stack parent onto it, so every
+# span a spawned worker emits joins the driver's trace.
+_process_parent: tuple[str, str] | None = None
 
 
 @dataclass
@@ -49,13 +81,23 @@ class TracedSpan:
 class _NdjsonBackend:
     """Buffers span records and flushes through the storage layer, so a
     remote output root (s3://, gs://) receives traces like every other
-    artifact instead of a bogus local directory."""
+    artifact instead of a bogus local directory.
+
+    Storage backends cannot append, so each flush writes its buffered
+    chunk to a NEW part file (``t.ndjson``, ``t.part1.ndjson``, ...) and
+    drops the buffer: memory stays bounded at FLUSH_EVERY records and
+    every byte is uploaded once, instead of rewriting an ever-growing
+    file per flush. Consumers (flight recorder, artifact collector,
+    e2e tests) glob ``*.ndjson``, so part files are collected the same
+    as the base file; traces under FLUSH_EVERY spans stay single-file."""
 
     FLUSH_EVERY = 200
 
     def __init__(self, path: str) -> None:
         self.path = path
         self._lines: list[str] = []
+        self._parts = 0
+        self._flush_errors = 0
         self._lock = threading.Lock()
 
     def export(self, span: TracedSpan) -> None:
@@ -72,18 +114,57 @@ class _NdjsonBackend:
         }
         with self._lock:
             self._lines.append(json.dumps(record))
-            if len(self._lines) % self.FLUSH_EVERY == 0:
+            if len(self._lines) >= self.FLUSH_EVERY:
                 self._flush_locked()
+
+    def _part_path(self) -> str:
+        if self._parts == 0:
+            return self.path
+        if self.path.endswith(".ndjson"):
+            return f"{self.path[:-len('.ndjson')]}.part{self._parts}.ndjson"
+        return f"{self.path}.part{self._parts}"
 
     def _flush_locked(self) -> None:
         from cosmos_curate_tpu.storage.client import write_bytes
 
-        write_bytes(self.path, ("\n".join(self._lines) + "\n").encode())
+        # the storage layer is itself traced: exporting a span for THIS
+        # write would re-enter export() under self._lock
+        try:
+            with suppress_tracing():
+                write_bytes(
+                    self._part_path(), ("\n".join(self._lines) + "\n").encode()
+                )
+        except Exception as e:
+            # a flush failure must never surface inside the instrumented
+            # operation (export() runs in end_span, inside the caller's
+            # try/finally — raising here would fail/dead-letter real work
+            # over trace IO, and disable_tracing()'s close() would fail the
+            # run AFTER its output was written). Drop the chunk so memory
+            # stays bounded when storage stays down; the OTLP backend
+            # swallows its errors the same way.
+            self._flush_errors += 1
+            if self._flush_errors == 1:
+                from cosmos_curate_tpu.utils.logging import get_logger
+
+                get_logger(__name__).warning(
+                    "trace flush to %s failed (%r); dropping %d span(s) "
+                    "(further flush failures logged at close)",
+                    self._part_path(), e, len(self._lines),
+                )
+        self._parts += 1
+        self._lines = []
 
     def close(self) -> None:
         with self._lock:
             if self._lines:
                 self._flush_locked()
+            if self._flush_errors > 1:
+                from cosmos_curate_tpu.utils.logging import get_logger
+
+                get_logger(__name__).warning(
+                    "trace backend for %s dropped spans on %d failed flushes",
+                    self.path, self._flush_errors,
+                )
 
 
 class _OtlpHttpBackend:
@@ -237,12 +318,27 @@ def default_staging_dir() -> str:
     return os.environ.get("CURATE_TRACE_DIR", f"/tmp/curate_traces/run-{run}")
 
 
+_ATEXIT_REGISTERED = False
+
+
+def _flush_backends_at_exit() -> None:
+    """Close (flush) whatever backends are live when the process exits.
+    Spawned workers never call disable_tracing(), and the NDJSON backend
+    buffers — without this, a worker emitting fewer spans than the flush
+    threshold would lose its entire trace file."""
+    for b in _backends:
+        try:
+            b.close()
+        except Exception:  # a failed flush must never break process exit
+            pass
+
+
 def enable_tracing(
     output_path: str | None = None, *, otlp_endpoint: str | None = None
 ) -> str:
     """Turn tracing on for this process; returns the NDJSON path. An OTLP
     collector endpoint (argument or env) adds a second export backend."""
-    global _enabled, _backends
+    global _enabled, _backends, _ATEXIT_REGISTERED
     path = output_path or os.environ.get(
         "CURATE_TRACE_PATH", f"{default_staging_dir()}/trace-{os.getpid()}.ndjson"
     )
@@ -252,13 +348,19 @@ def enable_tracing(
     endpoint = otlp_endpoint or otlp_endpoint_from_env()
     if endpoint:
         _backends.append(_OtlpHttpBackend(endpoint))
+    if not _ATEXIT_REGISTERED:
+        import atexit
+
+        atexit.register(_flush_backends_at_exit)
+        _ATEXIT_REGISTERED = True
     _enabled = True
     return path
 
 
 def disable_tracing() -> None:
-    global _enabled, _backends
+    global _enabled, _backends, _process_parent
     _enabled = False
+    _process_parent = None
     for b in _backends:
         b.close()
     _backends = []
@@ -268,39 +370,158 @@ def tracing_enabled() -> bool:
     return _enabled
 
 
-def _current_stack() -> list[TracedSpan]:
-    if not hasattr(_local, "stack"):
-        _local.stack = []
-    return _local.stack
+# -- W3C trace-context propagation ------------------------------------------
+
+_TRACEPARENT_RE = re.compile(r"^00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """``00-<trace_id>-<span_id>-<flags>`` -> (trace_id, span_id), or None
+    for anything malformed (including the all-zero ids W3C forbids)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    trace_id, span_id = m.group(1), m.group(2)
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(span: "TracedSpan | None" = None) -> str:
+    """The W3C traceparent of ``span`` (default: the current innermost span,
+    falling back to the process-level parent). '' when tracing is disabled
+    or there is no active context — callers stamp it into frames verbatim,
+    so disabled tracing costs one boolean and an empty field."""
+    if not _enabled:
+        return ""
+    if span is None:
+        stack = _stack.get()
+        if stack:
+            span = stack[-1]
+        elif _process_parent is not None:
+            return f"00-{_process_parent[0]}-{_process_parent[1]}-01"
+        else:
+            return ""
+    if span is _NOOP_SPAN:
+        return ""
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def attach_traceparent(header: str | None) -> bool:
+    """Adopt an incoming traceparent as this PROCESS's base parent: spans
+    opened with no enclosing span parent onto it. Returns True when a valid
+    header was attached. Spawned workers call this at startup with the
+    driver-stamped ``CURATE_TRACEPARENT``."""
+    global _process_parent
+    parsed = parse_traceparent(header)
+    if parsed is None:
+        return False
+    _process_parent = parsed
+    return True
+
+
+def current_span() -> "TracedSpan | None":
+    """The innermost active span of this context, or None (disabled,
+    suppressed, or empty). Lets helpers deep in a call tree (e.g. the
+    storage retry loop) annotate the span their caller opened without
+    threading it through."""
+    if not _enabled or _suppress.get():
+        return None
+    stack = _stack.get()
+    return stack[-1] if stack else None
+
+
+def current_trace_id() -> str | None:
+    """Trace id of the current context (innermost span, else the process
+    parent), or None. The DLQ stamps this into dead-batch metadata."""
+    if not _enabled:
+        return None
+    stack = _stack.get()
+    if stack:
+        return stack[-1].trace_id
+    return _process_parent[0] if _process_parent is not None else None
 
 
 @contextlib.contextmanager
-def traced_span(name: str, **attributes: Any) -> Iterator[TracedSpan]:
-    """Context manager span; cheap no-op (yields a dummy) when disabled."""
-    if not _enabled:
-        yield _NOOP_SPAN
-        return
-    stack = _current_stack()
-    parent = stack[-1] if stack else None
-    span = TracedSpan(
+def suppress_tracing() -> Iterator[None]:
+    """No spans are recorded inside this block (export paths use it to keep
+    their own storage writes out of the trace — and out of deadlocks)."""
+    token = _suppress.set(True)
+    try:
+        yield
+    finally:
+        _suppress.reset(token)
+
+
+# -- span lifecycle ----------------------------------------------------------
+
+
+def start_span(
+    name: str, *, traceparent: str | None = None, **attributes: Any
+) -> TracedSpan:
+    """Manually-managed span (exported by :func:`end_span`); the noop span
+    when disabled. Does NOT alter the ambient context — for long-lived
+    driver spans (per-stage spans in the streaming runner) whose lifetime
+    crosses loop iterations. Parent resolution: explicit ``traceparent`` >
+    current stack > process-level parent > fresh trace."""
+    if not _enabled or _suppress.get():
+        return _NOOP_SPAN
+    parent_ctx = parse_traceparent(traceparent) if traceparent else None
+    if parent_ctx is None:
+        stack = _stack.get()
+        if stack:
+            parent_ctx = (stack[-1].trace_id, stack[-1].span_id)
+        elif _process_parent is not None:
+            parent_ctx = _process_parent
+    if parent_ctx is not None:
+        trace_id, parent_id = parent_ctx
+    else:
+        trace_id, parent_id = uuid.uuid4().hex, None
+    return TracedSpan(
         name=name,
-        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+        trace_id=trace_id,
         span_id=uuid.uuid4().hex[:16],
-        parent_id=parent.span_id if parent else None,
+        parent_id=parent_id,
         start_s=time.time(),
         attributes=dict(attributes),
     )
-    stack.append(span)
+
+
+def end_span(span: TracedSpan) -> None:
+    """Finish and export a :func:`start_span` span (noop spans pass through)."""
+    if span is _NOOP_SPAN or not _enabled:
+        return
+    if span.end_s is None:
+        span.end_s = time.time()
+    for b in _backends:
+        b.export(span)
+
+
+@contextlib.contextmanager
+def traced_span(
+    name: str, *, traceparent: str | None = None, **attributes: Any
+) -> Iterator[TracedSpan]:
+    """Context manager span; cheap no-op (yields a dummy) when disabled.
+
+    ``traceparent`` restores an incoming W3C context as the parent — the
+    cross-process hop. Without it the span parents onto the contextvar
+    stack (surviving ``contextvars.copy_context()`` thread hops), then the
+    process-level parent."""
+    if not _enabled or _suppress.get():
+        yield _NOOP_SPAN
+        return
+    span = start_span(name, traceparent=traceparent, **attributes)
+    token = _stack.set(_stack.get() + (span,))
     try:
         yield span
     except Exception as e:
         span.attributes["error"] = repr(e)
         raise
     finally:
-        span.end_s = time.time()
-        stack.pop()
-        for b in _backends:
-            b.export(span)
+        _stack.reset(token)
+        end_span(span)
 
 
 class _NoopSpan(TracedSpan):
@@ -329,8 +550,14 @@ def traced(fn: Callable | None = None, *, name: str | None = None):
     return deco(fn) if fn is not None else deco
 
 
+TRACEPARENT_ENV = "CURATE_TRACEPARENT"
+
+
 def setup_tracing_from_env() -> None:
     """Worker startup hook (reference tracing_hook.setup_tracing): enables
-    tracing when CURATE_TRACING=1 is in the environment."""
+    tracing when CURATE_TRACING=1 is in the environment, and adopts the
+    driver-stamped ``CURATE_TRACEPARENT`` so this process's spans join the
+    driver's trace instead of starting fragments of their own."""
     if os.environ.get("CURATE_TRACING") == "1":
         enable_tracing()
+        attach_traceparent(os.environ.get(TRACEPARENT_ENV))

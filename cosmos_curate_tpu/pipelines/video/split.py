@@ -270,16 +270,63 @@ def run_split(
         if not alive:
             logger.warning("health gate: TPU unhealthy — running this job on CPU")
             _os.environ["JAX_PLATFORMS"] = "cpu"
-    if args.tracing:
-        from cosmos_curate_tpu.observability.tracing import enable_tracing
+    if runner is None:
+        # resolve the default HERE, not inside run_pipeline: the finalize
+        # path hands the flight recorder the instance that actually ran,
+        # so runner-sourced report sections (dead-letter counts, stage
+        # times, overlap) reflect this run instead of falling to empties
+        from cosmos_curate_tpu.core.runner import default_runner
 
-        enable_tracing(f"{args.output_path.rstrip('/')}/profile/traces/driver.ndjson")
+        runner = default_runner()
     from cosmos_curate_tpu.parallel.distributed import (
         maybe_initialize_distributed,
         partition_tasks_for_node,
     )
 
     maybe_initialize_distributed()
+    # work-stealing runs call run_pipeline() once per stolen batch, and each
+    # run() resets the runner's DLQ accounting — accumulate drops here so
+    # finalize reports the whole node, not the last batch
+    steal_dead: dict = {"count": 0, "dirs": []}
+    run_root = None
+    # tracing setup sits immediately before the try whose finally tears it
+    # down: anything risky in between (runner resolution, distributed init)
+    # raising would otherwise leave tracing enabled with an unexported root
+    if args.tracing:
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            clear_trace_artifacts,
+        )
+        from cosmos_curate_tpu.observability.tracing import (
+            TRACEPARENT_ENV,
+            attach_traceparent,
+            enable_tracing,
+            format_traceparent,
+            start_span,
+        )
+        from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
+
+        rank, num_nodes = node_rank_and_count()
+        # a re-run into the same output root must start from a clean trace:
+        # stale rotation parts / collected worker files / node-stats
+        # sidecars carry the old run's trace ids and drop counts. Multi-node
+        # scopes the clear to this rank's own files (peers may already be
+        # writing to the shared root)
+        clear_trace_artifacts(
+            args.output_path, rank=rank if num_nodes > 1 else None
+        )
+        name = "driver.ndjson" if num_nodes <= 1 else f"driver-n{rank}.ndjson"
+        enable_tracing(f"{args.output_path.rstrip('/')}/profile/traces/{name}")
+        # join an orchestrator-stamped trace when present, then root every
+        # span this node emits on ONE run span: work-stealing calls
+        # runner.run() once per claim batch, and each run() opens its own
+        # pipeline.run span — without a shared parent a multi-batch run
+        # fragments into N trace ids and the flight recorder (and bench's
+        # trace_connected) reports a disconnected trace. The root rides the
+        # process-level parent, not the contextvar stack, so it survives
+        # any thread hop between claim batches.
+        attach_traceparent(_os.environ.get(TRACEPARENT_ENV))
+        run_root = start_span("run.split", output_path=args.output_path)
+        attach_traceparent(format_traceparent(run_root))
     try:
         if args.multicam:
             from cosmos_curate_tpu.pipelines.video.input_discovery import (
@@ -329,10 +376,24 @@ def run_split(
                     done_cache["ts"] = now
                 return video_record_id(t.video.path) in done_cache["ids"]
 
+            def _run_batch(batch):
+                res = run_pipeline(batch, stages, config=config, runner=runner)
+                dlq = getattr(runner, "dlq", None)
+                n = int(
+                    getattr(runner, "dead_lettered", 0)
+                    or getattr(dlq, "recorded", 0)
+                    or 0
+                )
+                if n:
+                    steal_dead["count"] += n
+                    if dlq is not None and getattr(dlq, "recorded", 0):
+                        steal_dead["dirs"].append(str(dlq.run_dir))
+                return res
+
             out = run_with_stealing(
                 tasks,
                 args.output_path,
-                lambda batch: run_pipeline(batch, stages, config=config, runner=runner),
+                _run_batch,
                 record_id=lambda t: video_record_id(t.video.path),
                 is_done=_task_done,
             )
@@ -343,8 +404,13 @@ def run_split(
             out = run_pipeline(tasks, stages, config=config, runner=runner) or []
     finally:
         if args.tracing:
-            from cosmos_curate_tpu.observability.tracing import disable_tracing
+            from cosmos_curate_tpu.observability.tracing import (
+                disable_tracing,
+                end_span,
+            )
 
+            if run_root is not None:
+                end_span(run_root)
             disable_tracing()  # flushes buffered spans through storage
         if args.tracing or args.profile_cpu or args.profile_memory:
             from cosmos_curate_tpu.observability.artifacts import (
@@ -355,11 +421,48 @@ def run_split(
 
             collect_artifacts(args.output_path)
             rank, count = node_rank_and_count()
+            extra = None
+            if steal_dead["count"]:
+                # the last stolen batch's drops are already in the
+                # accumulator, so this replaces (not adds to) the
+                # runner's last-run()-scoped accounting
+                extra = {"dead_lettered": steal_dead["count"]}
+                if steal_dead["dirs"]:
+                    extra["dlq_run_dir"] = ",".join(dict.fromkeys(steal_dead["dirs"]))
             if count == 1:
                 # single node: this process is also the delivery driver.
                 # Multi-node runs finalize from the merge-summaries step
                 # (cli/local_cli.py), once every node has collected.
                 finalize_delivery(args.output_path)
+                if args.tracing:
+                    # flight recorder: merge spans + dispatch/flow aggregates
+                    # + DLQ counts into report/run_report.json (render with
+                    # `cosmos-curate-tpu report <output>`)
+                    try:
+                        from cosmos_curate_tpu.observability.flight_recorder import (
+                            write_run_report,
+                        )
+
+                        write_run_report(args.output_path, runner=runner, extra=extra)
+                    except Exception:
+                        logger.exception(
+                            "flight recorder failed (run output unaffected)"
+                        )
+            elif args.tracing:
+                # multi-node: the merged report is built at merge-summaries
+                # time, when this runner's memory is gone — persist the
+                # runner-sourced sections (dead-letter counts, stage times,
+                # dispatch/flow aggregates) as a per-node sidecar now
+                try:
+                    from cosmos_curate_tpu.observability.flight_recorder import (
+                        write_node_stats,
+                    )
+
+                    write_node_stats(args.output_path, rank, runner, extra=extra)
+                except Exception:
+                    logger.exception(
+                        "node stats sidecar failed (run output unaffected)"
+                    )
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
     summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)

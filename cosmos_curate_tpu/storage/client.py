@@ -15,13 +15,14 @@ are written against `StorageClient`, so enabling a backend is dependency-only.
 from __future__ import annotations
 
 import abc
+import contextvars
 import os
 import queue
 import shutil
 import threading
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Iterator
+from typing import Any, Iterator
 
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -208,20 +209,48 @@ def get_storage_client(path: str | os.PathLike[str]) -> StorageClient:
     return _LOCAL
 
 
+def backend_name(path: str | os.PathLike[str]) -> str:
+    s = str(path)
+    for scheme in _REMOTE_SCHEMES:
+        if s.startswith(scheme):
+            return scheme[:-3]  # "s3://" -> "s3"
+    return "local"
+
+
 def read_bytes(path: str | os.PathLike[str]) -> bytes:
-    return get_storage_client(path).read_bytes(str(path))
+    """Read with one trace span per request (backend/path/bytes attributes;
+    the backends' retry loops annotate ``attempt`` onto it via
+    storage/retry.py). Zero-cost when tracing is off."""
+    from cosmos_curate_tpu.observability.tracing import traced_span
+
+    p = str(path)
+    with traced_span("storage.read", backend=backend_name(p), path=p) as span:
+        data = get_storage_client(p).read_bytes(p)
+        span.set_attribute("bytes", len(data))
+        return data
 
 
 def write_bytes(path: str | os.PathLike[str], data: bytes) -> None:
-    get_storage_client(path).write_bytes(str(path), data)
+    """Write with one trace span per request (see :func:`read_bytes`)."""
+    from cosmos_curate_tpu.observability.tracing import traced_span
+
+    p = str(path)
+    with traced_span(
+        "storage.write", backend=backend_name(p), path=p, bytes=len(data)
+    ):
+        get_storage_client(p).write_bytes(p, data)
 
 
 class BackgroundUploader:
     """Queue writes to a background thread so the hot loop never blocks on
-    storage (reference ``BackgroundUploader``, storage_client.py)."""
+    storage (reference ``BackgroundUploader``, storage_client.py). Each
+    write runs under the SUBMITTER's contextvars context, so its storage
+    span parents onto the submitting stage's trace instead of fragmenting."""
 
     def __init__(self, max_queue: int = 64) -> None:
-        self._q: queue.Queue[tuple[str, bytes] | None] = queue.Queue(maxsize=max_queue)
+        self._q: queue.Queue[tuple[str, bytes, Any] | None] = queue.Queue(
+            maxsize=max_queue
+        )
         self._errors: list[tuple[str, Exception]] = []
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -231,15 +260,15 @@ class BackgroundUploader:
             item = self._q.get()
             if item is None:
                 return
-            path, data = item
+            path, data, ctx = item
             try:
-                write_bytes(path, data)
+                ctx.run(write_bytes, path, data)
             except Exception as e:
                 logger.exception("background upload failed: %s", path)
                 self._errors.append((path, e))
 
     def submit(self, path: str, data: bytes) -> None:
-        self._q.put((path, data))
+        self._q.put((path, data, contextvars.copy_context()))
 
     def close(self) -> list[tuple[str, Exception]]:
         """Drain, stop, and return any failures."""

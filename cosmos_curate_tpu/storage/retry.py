@@ -56,8 +56,23 @@ def sleep_backoff(
     cap: float = DEFAULT_CAP_S,
     rng: random.Random | None = None,
 ) -> float:
-    """Sleep the jittered backoff; returns the slept duration (for logs)."""
+    """Sleep the jittered backoff; returns the slept duration (for logs).
+
+    Also annotates the enclosing ``storage.*`` trace span (the one the
+    client layer opened) with the retry count and summed backoff, so a slow
+    storage span is attributable to retries vs a slow backend. Non-storage
+    callers of this helper (API caption, state db) leave their ambient
+    stage spans untouched — stamping retry attributes on an unrelated span
+    would misattribute the wait."""
     d = backoff_s(attempt, base=base, cap=cap, rng=rng)
+    from cosmos_curate_tpu.observability.tracing import current_span
+
+    span = current_span()
+    if span is not None and span.name.startswith("storage."):
+        span.set_attribute("attempt", attempt + 2)  # the one about to run
+        span.set_attribute(
+            "backoff_s", round(float(span.attributes.get("backoff_s", 0.0)) + d, 4)
+        )
     time.sleep(d)
     return d
 

@@ -20,4 +20,59 @@ fi
 echo "== analysis test suite =="
 JAX_PLATFORMS=cpu python -m pytest tests/analysis -q
 
+echo "== tracing smoke: 2-stage traced run -> one connected trace + run report =="
+# The programmatic equivalent of a `--tracing` run: two trivial stages
+# through the pipelined runner (thread-pool hop included), then the flight
+# recorder must see exactly ONE trace id and write a well-formed
+# report/run_report.json that the report CLI can render.
+JAX_PLATFORMS=cpu python - <<'PY'
+import json, tempfile
+from pathlib import Path
+
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+from cosmos_curate_tpu.core.stage import Stage
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.observability import tracing
+from cosmos_curate_tpu.observability.flight_recorder import render_report, write_run_report
+
+
+class Tok(PipelineTask):
+    def __init__(self, v):
+        self.v = v
+
+
+class Inc(Stage):
+    thread_safe = True
+
+    def process_data(self, tasks):
+        return [Tok(t.v + 1) for t in tasks]
+
+
+class Dbl(Stage):
+    thread_safe = True
+
+    def process_data(self, tasks):
+        return [Tok(t.v * 2) for t in tasks]
+
+
+out = tempfile.mkdtemp(prefix="trace_smoke_")
+tracing.enable_tracing(f"{out}/profile/traces/driver.ndjson")
+runner = PipelinedRunner()
+res = run_pipeline([Tok(i) for i in range(8)], [Inc(), Dbl()], runner=runner)
+tracing.disable_tracing()
+assert sorted(t.v for t in res) == [(i + 1) * 2 for i in range(8)]
+
+report = write_run_report(out, runner=runner)
+assert report["connected"] and len(report["trace_ids"]) == 1, (
+    f"trace fragments: {report['trace_ids']}"
+)
+data = json.loads(Path(out, "report", "run_report.json").read_text())
+assert data["span_count"] >= 4 and data["critical_path"], data
+assert data["critical_path"][0]["name"] == "pipeline.run"
+assert "stage_times" in data and "dead_lettered" in data
+render_report(data)  # must not raise
+print(f"tracing smoke ok: {data['span_count']} spans, one connected trace")
+PY
+
 echo "static checks passed"

@@ -1,0 +1,161 @@
+"""E2E distributed-trace acceptance: ONE trace id spanning
+driver -> remote node agent -> spawned worker -> device-pipeline drain,
+with the worker's spans parented onto the driver's stage span.
+
+Same harness as tests/engine/test_remote_plane.py: a real node-agent
+subprocess joins the driver's plane with ~no local CPU budget, so the
+stage's workers place remotely and every batch crosses the SubmitBatch
+boundary the traceparent rides."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, PipelineSpec
+from cosmos_curate_tpu.core.stage import Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.observability import tracing
+
+
+class _TraceTask(PipelineTask):
+    def __init__(self, value: int) -> None:
+        self.value = value
+
+
+class _DeviceEchoStage(Stage):
+    """CPU-placeable stage that drives a real DevicePipeline per batch, so
+    the remote worker emits a ``device.*.drain`` span under its process
+    span."""
+
+    def setup(self, meta) -> None:
+        import jax
+
+        from cosmos_curate_tpu.models.device_pipeline import DevicePipeline
+
+        self._pipe = DevicePipeline("e2etrace", jax.jit(lambda x: x + 1))
+
+    def process_data(self, tasks):
+        import numpy as np
+
+        batch = np.asarray([[float(t.value)] for t in tasks], np.float32)
+        self._pipe.submit(batch, n_valid=len(tasks))
+        (out,) = self._pipe.drain()
+        return [_TraceTask(int(v[0])) for v, _t in zip(out, tasks)]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _load_spans(trace_dir: Path) -> list[dict]:
+    spans = []
+    for p in sorted(trace_dir.glob("*.ndjson")):
+        for line in p.read_text().splitlines():
+            if line.strip():
+                spans.append(json.loads(line))
+    return spans
+
+
+@pytest.mark.slow
+def test_one_trace_spans_driver_agent_worker_device(monkeypatch, tmp_path):
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    port = _free_port()
+    monkeypatch.setenv("CURATE_ENGINE_TOKEN", "trace-secret")
+    monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", "1")
+    monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+    monkeypatch.setenv("CURATE_PREWARM", "0")
+    # spawned workers (agent side) resolve their NDJSON path from this
+    monkeypatch.setenv("CURATE_TRACE_DIR", str(trace_dir))
+
+    env = {
+        **os.environ,
+        "CURATE_ENGINE_TOKEN": "trace-secret",
+        "JAX_PLATFORMS": "cpu",
+        "CURATE_TRACING": "1",  # the agent itself joins the trace
+        "CURATE_TRACE_DIR": str(trace_dir),
+        "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+    }
+    agent = subprocess.Popen(
+        [
+            sys.executable, "-m", "cosmos_curate_tpu.engine.remote_agent",
+            "--driver", f"127.0.0.1:{port}",
+            "--node-id", "trace-agent", "--num-cpus", "2",
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    tracing.enable_tracing(str(trace_dir / "driver.ndjson"))
+    try:
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+        runner = StreamingRunner(poll_interval_s=0.01)
+        n_tasks = 6
+        spec = PipelineSpec(
+            input_data=[_TraceTask(i) for i in range(n_tasks)],
+            stages=[StageSpec(_DeviceEchoStage(), num_workers=1)],
+            config=PipelineConfig(
+                # ~no local capacity: with the agent connected, the worker
+                # places remotely — the trace MUST cross the control plane
+                num_cpus=0.1,
+                return_last_stage_outputs=True,
+            ),
+        )
+        out = runner.run(spec)
+        assert out is not None and sorted(t.value for t in out) == [
+            i + 1 for i in range(n_tasks)
+        ]
+    finally:
+        tracing.disable_tracing()
+        # the driver's shutdown sent Bye: let the agent exit NORMALLY so its
+        # atexit span flush runs (SIGTERM would drop its buffered spans)
+        try:
+            agent.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            agent.kill()
+            agent.wait(timeout=10)
+
+    spans = _load_spans(trace_dir)
+    by_name: dict[str, list[dict]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+
+    root = by_name["pipeline.run"][0]
+    stage_driver = by_name["stage._DeviceEchoStage"][0]
+    worker_process = by_name["stage._DeviceEchoStage.process"]
+    drains = by_name["device.e2etrace.drain"]
+    assert worker_process and drains
+
+    # ONE trace id across driver + remote worker processes
+    assert {s["trace_id"] for s in spans} == {root["trace_id"]}
+    # driver stage span parents onto the run root
+    assert stage_driver["parent_id"] == root["span_id"]
+    # worker-side batch spans (emitted in the agent's spawned worker — a
+    # different PROCESS on the "remote" node) parent onto the driver's
+    # stage span, across the SubmitBatch frame
+    worker_pids = {s["pid"] for s in worker_process}
+    assert root["pid"] not in worker_pids, "batch ran locally; not an e2e hop"
+    for s in worker_process:
+        assert s["parent_id"] == stage_driver["span_id"]
+    # the device-pipeline drain span nests under its batch's process span
+    process_ids = {s["span_id"] for s in worker_process}
+    for s in drains:
+        assert s["parent_id"] in process_ids
+    # the agent's own hop (input resolution) also parents onto the
+    # driver's stage span — the remote-agent link in the chain
+    agent_spans = by_name.get("agent.resolve_inputs", [])
+    assert agent_spans, "agent emitted no resolve_inputs spans"
+    for s in agent_spans:
+        assert s["parent_id"] == stage_driver["span_id"]
+        assert s["trace_id"] == root["trace_id"]

@@ -1,0 +1,375 @@
+"""Flight recorder: span merge, critical path, report rendering, the
+`report` CLI, and the DLQ trace_id link."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import pytest
+
+from cosmos_curate_tpu.core.pipeline import run_pipeline
+from cosmos_curate_tpu.core.runner import SequentialRunner
+from cosmos_curate_tpu.core.stage import Stage
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.observability import tracing
+from cosmos_curate_tpu.observability.flight_recorder import (
+    build_run_report,
+    render_report,
+    write_run_report,
+)
+
+
+@dataclass
+class Tok(PipelineTask):
+    value: int = 0
+
+
+class AddOne(Stage):
+    def process_data(self, tasks):
+        return [Tok(value=t.value + 1) for t in tasks]
+
+
+class Double(Stage):
+    def process_data(self, tasks):
+        return [Tok(value=t.value * 2) for t in tasks]
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    yield
+    tracing.disable_tracing()
+
+
+def _traced_run(tmp_path):
+    out = tmp_path / "run"
+    tracing.enable_tracing(f"{out}/profile/traces/driver.ndjson")
+    runner = SequentialRunner()
+    run_pipeline([Tok(value=i) for i in range(4)], [AddOne(), Double()], runner=runner)
+    tracing.disable_tracing()
+    return str(out), runner
+
+
+class TestRunReport:
+    def test_report_written_connected_and_renderable(self, tmp_path):
+        out, runner = _traced_run(tmp_path)
+        report = write_run_report(out, runner=runner)
+        assert report["connected"] and len(report["trace_ids"]) == 1
+        assert report["span_count"] >= 5
+        on_disk = json.loads((tmp_path / "run" / "report" / "run_report.json").read_text())
+        assert on_disk["trace_ids"] == report["trace_ids"]
+        assert on_disk["critical_path"][0]["name"] == "pipeline.run"
+        assert set(on_disk["stage_times"]) == {"AddOne", "Double"}
+        text = render_report(on_disk)
+        assert "CONNECTED" in text
+        assert "critical path" in text
+        assert "AddOne" in text
+
+    def test_disconnected_fragments_detected(self, tmp_path):
+        out, runner = _traced_run(tmp_path)
+        # a second, unrelated trace fragment (a worker that missed the
+        # traceparent) must flip the connectivity verdict
+        tracing.enable_tracing(f"{out}/profile/traces/orphan.ndjson")
+        with tracing.traced_span("orphan.process"):
+            pass
+        tracing.disable_tracing()
+        report = build_run_report(out, runner=runner)
+        assert not report["connected"]
+        assert len(report["trace_ids"]) == 2
+        assert "DISCONNECTED" in render_report(report)
+
+    def test_report_without_tracing_is_wellformed(self, tmp_path):
+        runner = SequentialRunner()
+        run_pipeline([Tok(value=1)], [AddOne()], runner=runner)
+        report = write_run_report(str(tmp_path / "untraced"), runner=runner)
+        assert report["span_count"] == 0 and not report["connected"]
+        assert "no spans" in render_report(report)
+
+    def test_stage_times_fall_back_to_spans(self, tmp_path):
+        out, _runner = _traced_run(tmp_path)
+        report = build_run_report(out)  # no runner handed in
+        assert set(report["stage_times"]) == {"AddOne", "Double"}
+
+    def test_prior_report_sections_carry_over(self, tmp_path):
+        """Rebuild paths running outside the original driver (report
+        --rebuild, merge-summaries) lack its in-memory aggregates; passing
+        the existing report as ``prior`` must keep those sections instead
+        of overwriting them with empties."""
+        from cosmos_curate_tpu.observability import stage_timer
+
+        stage_timer.reset_dispatch_stats()
+        out, _ = _traced_run(tmp_path)
+        prior = {
+            "dispatch": {"embed/x": {"dispatches": 5}},
+            "pipeline_overlap_frac": 0.41,
+            "stage_counts": {"AddOne": {"completed": 4}},
+        }
+        report = build_run_report(out, prior=prior)
+        assert report["dispatch"] == prior["dispatch"]
+        assert report["pipeline_overlap_frac"] == 0.41
+        assert report["stage_counts"] == prior["stage_counts"]
+
+    def test_clear_trace_artifacts_unfragments_rerun(self, tmp_path):
+        """A traced re-run into the same output root must not inherit the
+        prior run's span files (stale rotation parts / worker files would
+        yield a false DISCONNECTED verdict)."""
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            clear_trace_artifacts,
+        )
+
+        out, _ = _traced_run(tmp_path)
+        # simulate leftovers a second run cannot overwrite: a rotated part
+        # file and a collected worker file from the first run
+        traces = tmp_path / "run" / "profile" / "traces"
+        (traces / "driver.part1.ndjson").write_text(
+            json.dumps({"name": "old", "trace_id": "a" * 32, "span_id": "b" * 16}) + "\n"
+        )
+        (traces / "trace-12345.ndjson").write_text(
+            json.dumps({"name": "old2", "trace_id": "c" * 32, "span_id": "d" * 16}) + "\n"
+        )
+        assert not build_run_report(out)["connected"]  # fragments seen
+        assert clear_trace_artifacts(out) == 3
+        out2, runner2 = _traced_run(tmp_path)  # same root, fresh trace
+        report = build_run_report(out2, runner=runner2)
+        assert report["connected"] and len(report["trace_ids"]) == 1
+
+    def test_clear_trace_artifacts_rank_scoped(self, tmp_path):
+        """Multi-node re-runs clear only the caller rank's own stale files:
+        its driver parts, its collected worker spans, and its node-stats
+        sidecar — never a peer's live files."""
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            clear_trace_artifacts,
+        )
+
+        out = str(tmp_path / "run")
+        traces = tmp_path / "run" / "profile" / "traces"
+        traces.mkdir(parents=True)
+        span = json.dumps({"name": "s", "trace_id": "a" * 32, "span_id": "b" * 16})
+        mine = [
+            traces / "driver-n1.ndjson",
+            traces / "driver-n1.part1.ndjson",
+        ]
+        theirs = [
+            traces / "driver-n0.ndjson",
+            traces / "driver-n0.part2.ndjson",
+        ]
+        collected = tmp_path / "run" / "profile" / "collected"
+        (collected / "node1").mkdir(parents=True)
+        (collected / "node0").mkdir(parents=True)
+        mine.append(collected / "node1" / "trace-111.ndjson")
+        theirs.append(collected / "node0" / "trace-222.ndjson")
+        for p in mine + theirs:
+            p.write_text(span + "\n")
+        report_dir = tmp_path / "run" / "report"
+        report_dir.mkdir()
+        (report_dir / "node-stats-1.json").write_text("{}")
+        (report_dir / "node-stats-0.json").write_text("{}")
+
+        assert clear_trace_artifacts(out, rank=1) == len(mine) + 1
+        for p in mine:
+            assert not p.exists()
+        assert not (report_dir / "node-stats-1.json").exists()
+        for p in theirs:
+            assert p.exists()
+        assert (report_dir / "node-stats-0.json").exists()
+        # full clear (single node) removes everything left, sidecar included
+        assert clear_trace_artifacts(out) == len(theirs) + 1
+
+
+class TestReportCli:
+    def test_report_command_renders(self, tmp_path, capsys):
+        out, runner = _traced_run(tmp_path)
+        write_run_report(out, runner=runner)
+        from cosmos_curate_tpu.cli.main import main
+
+        assert main(["report", out]) == 0
+        text = capsys.readouterr().out
+        assert "critical path" in text and "CONNECTED" in text
+
+    def test_report_command_rebuilds_when_missing(self, tmp_path, capsys):
+        out, _runner = _traced_run(tmp_path)  # no report written
+        from cosmos_curate_tpu.cli.main import main
+
+        assert main(["report", out, "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["connected"]
+
+    def test_report_command_errors_on_untraced_dir(self, tmp_path):
+        from cosmos_curate_tpu.cli.main import main
+
+        assert main(["report", str(tmp_path / "nothing-here")]) == 2
+
+
+class TestWorkerDispatchMerge:
+    def test_dumped_aggregates_merge_once(self, tmp_path):
+        """Worker at-exit dumps fold into THIS process's aggregates exactly
+        once (the driver-side path that completes pipeline_device_* series
+        on engine runs)."""
+        from cosmos_curate_tpu.observability import stage_timer
+
+        stage_timer.reset_dispatch_stats()
+        dump = {
+            "embed/test": {
+                "dispatches": 3, "rows": 12, "padded_rows": 16,
+                "h2d_s": 0.1, "compute_s": 0.9, "d2h_s": 0.05, "gap_s": 0.2,
+            }
+        }
+        (tmp_path / "dispatch-99999.json").write_text(json.dumps(dump))
+        merged = stage_timer.merge_new_dumped_summaries(str(tmp_path))
+        assert merged["embed/test"]["dispatches"] == 3
+        summaries = stage_timer.dispatch_summaries()
+        assert summaries["embed/test"]["dispatches"] == 3
+        assert summaries["embed/test"]["compute_s"] == pytest.approx(0.9)
+        # idempotent: the same dump file never double-counts
+        assert stage_timer.merge_new_dumped_summaries(str(tmp_path)) == {}
+        assert stage_timer.dispatch_summaries()["embed/test"]["dispatches"] == 3
+        stage_timer.reset_dispatch_stats()
+
+    def test_own_dump_excludes_merged_worker_aggregates(self, tmp_path):
+        """The driver's own at-exit dump must not re-export aggregates it
+        merged from worker dumps — a later merge over the same dump dir
+        would count every worker's stats twice."""
+        import os
+
+        from cosmos_curate_tpu.observability import stage_timer
+
+        stage_timer.reset_dispatch_stats()
+        try:
+            dump = {
+                "embed/worker": {
+                    "dispatches": 2, "rows": 8, "padded_rows": 8,
+                    "h2d_s": 0.1, "compute_s": 0.5, "d2h_s": 0.01, "gap_s": 0.0,
+                }
+            }
+            (tmp_path / "dispatch-11111.json").write_text(json.dumps(dump))
+            stage_timer.merge_new_dumped_summaries(str(tmp_path))
+            # merged view includes the worker; the process's OWN dump not
+            assert stage_timer.dispatch_summaries()["embed/worker"]["dispatches"] == 2
+            stage_timer._dump_summaries(str(tmp_path))
+            own = json.loads(
+                (tmp_path / f"dispatch-{os.getpid()}.json").read_text()
+            )
+            assert "embed/worker" not in own
+        finally:
+            stage_timer.reset_dispatch_stats()
+
+
+class TestMultiNodeStats:
+    def test_node_stats_sidecars_merge_into_prior(self, tmp_path):
+        """Multi-node finalize persists per-node runner stats; the merge
+        step folds them so the merged report keeps real dead-letter counts
+        and stage times instead of empties."""
+        from cosmos_curate_tpu.observability import stage_timer
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            build_run_report,
+            load_node_stats,
+            write_node_stats,
+        )
+
+        stage_timer.reset_dispatch_stats()
+
+        class Node0Runner:
+            stage_times = {"A": 1.5}
+            stage_counts = {"A": {"completed": 4, "dead_lettered": 1}}
+            dead_lettered = 1
+            dlq = None
+            pipeline_wall_s = 10.0
+            overlap_frac = 0.2
+
+        class Node1Runner:
+            stage_times = {"A": 0.5}
+            stage_counts = {"A": {"completed": 2, "dead_lettered": 2}}
+            dead_lettered = 2
+            dlq = None
+            pipeline_wall_s = 14.0
+            overlap_frac = 0.4
+
+        out, _ = _traced_run(tmp_path)  # real spans exist in this root
+        write_node_stats(out, 0, Node0Runner())
+        write_node_stats(out, 1, Node1Runner())
+        prior = load_node_stats(out)
+        assert prior["dead_lettered"] == 3
+        assert prior["stage_times"]["A"] == 2.0
+        assert prior["stage_counts"]["A"] == {"completed": 6, "dead_lettered": 3}
+        # wall = slowest node (nodes run concurrently); overlap = node mean
+        assert prior["wall_s"] == 14.0
+        assert prior["pipeline_overlap_frac"] == 0.3
+        # the merge process has no runner: prior must carry the sections —
+        # and its runner-sourced stage_times (which include setup time)
+        # must beat the span-derived fallback
+        report = build_run_report(out, prior=prior)
+        assert report["dead_lettered"] == 3
+        assert report["stage_times"] == {"A": 2.0}
+        assert report["wall_s"] == 14.0
+        assert report["pipeline_overlap_frac"] == 0.3
+
+    def test_node_stats_extra_overrides_last_run_accounting(self, tmp_path):
+        """Work-stealing nodes run the pipeline once per stolen batch and
+        run() resets DLQ accounting — the caller's accumulated totals
+        (passed via ``extra``) must replace the runner's last-run view."""
+        from cosmos_curate_tpu.observability import stage_timer
+        from cosmos_curate_tpu.observability.flight_recorder import (
+            load_node_stats,
+            write_node_stats,
+        )
+
+        stage_timer.reset_dispatch_stats()
+
+        class LastBatchRunner:  # last stolen batch was clean
+            stage_times = {"A": 3.0}
+            dead_lettered = 0
+            dlq = None
+
+        out, _ = _traced_run(tmp_path)
+        write_node_stats(
+            out,
+            0,
+            LastBatchRunner(),
+            extra={"dead_lettered": 2, "dlq_run_dir": str(tmp_path / "dlq" / "r1")},
+        )
+        prior = load_node_stats(out)
+        assert prior["dead_lettered"] == 2
+        assert prior["dlq_run_dir"] == str(tmp_path / "dlq" / "r1")
+        # non-overridden sections still come from the runner
+        assert prior["stage_times"]["A"] == 3.0
+
+    def test_load_node_stats_absent(self, tmp_path):
+        from cosmos_curate_tpu.observability.flight_recorder import load_node_stats
+
+        assert load_node_stats(str(tmp_path / "nothing")) is None
+
+
+class TestDlqTraceLink:
+    def test_dead_letter_carries_trace_id(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+        from cosmos_curate_tpu.engine.dead_letter import (
+            DeadLetterQueue,
+            list_entries,
+            record_exhausted_batch,
+        )
+
+        tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        dlq = DeadLetterQueue()
+        with tracing.traced_span("pipeline.run") as root:
+            assert record_exhausted_batch(
+                dlq, stage_name="S", batch_id=3, tasks=[Tok(value=9)],
+                attempts=2, error="boom",
+            )
+        tracing.disable_tracing()
+        entries = list_entries(str(tmp_path / "dlq"))
+        assert len(entries) == 1
+        assert entries[0].meta["trace_id"] == root.trace_id
+
+    def test_dlq_list_prints_trace(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+        from cosmos_curate_tpu.engine.dead_letter import DeadLetterQueue
+
+        dlq = DeadLetterQueue()
+        dlq.record(
+            stage_name="S", batch_id=1, tasks=[], attempts=1,
+            worker_deaths=0, reason="r", trace_id="f" * 32,
+        )
+        from cosmos_curate_tpu.cli.main import main
+
+        assert main(["dlq", "list", "--dlq-dir", str(tmp_path / "dlq")]) == 0
+        assert f"trace={'f' * 32}" in capsys.readouterr().out

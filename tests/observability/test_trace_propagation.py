@@ -1,0 +1,264 @@
+"""Cross-boundary trace-context propagation (W3C traceparent).
+
+Covers the wire format itself, the contextvars thread hop the pipelined
+runner relies on, process-level parent attach, the disabled-tracing
+zero-overhead short-circuit, and parent restoration inside a REAL spawned
+worker process (engine/worker.py ``worker_main``)."""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import multiprocessing as mp
+import re
+import threading
+
+import pytest
+
+from cosmos_curate_tpu.observability import tracing
+
+_W3C = re.compile(r"^00-[0-9a-f]{32}-[0-9a-f]{16}-01$")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    yield
+    tracing.disable_tracing()
+
+
+class TestTraceparentFormat:
+    def test_header_is_w3c(self, tmp_path):
+        tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        with tracing.traced_span("root") as span:
+            tp = tracing.format_traceparent()
+            assert _W3C.match(tp), tp
+            assert tp == f"00-{span.trace_id}-{span.span_id}-01"
+            assert len(span.trace_id) == 32 and len(span.span_id) == 16
+
+    def test_parse_round_trip(self, tmp_path):
+        tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        with tracing.traced_span("root") as span:
+            parsed = tracing.parse_traceparent(tracing.format_traceparent())
+        assert parsed == (span.trace_id, span.span_id)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-zz-yy-01",
+            "00-" + "0" * 32 + "-" + "a" * 16 + "-01",  # all-zero trace id
+            "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+            "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        ],
+    )
+    def test_parse_rejects_malformed(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+
+class TestDisabledShortCircuit:
+    def test_zero_overhead_when_disabled(self):
+        assert not tracing.tracing_enabled()
+        assert tracing.format_traceparent() == ""
+        assert tracing.current_trace_id() is None
+        assert tracing.current_span() is None
+        # restoring a context with tracing off must be a no-op, not an error
+        with tracing.traced_span(
+            "x", traceparent="00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+        ) as span:
+            span.set_attribute("ignored", 1)
+        assert span.name == "noop"
+        assert span.attributes == {}
+        assert tracing.start_span("y") is span  # the shared noop singleton
+        tracing.end_span(span)  # must not export anything
+
+
+class TestContextPropagation:
+    def test_survives_thread_hop_via_copy_context(self, tmp_path):
+        """The pipelined runner starts worker threads under
+        contextvars.copy_context(); the run-root span must be their parent."""
+        path = tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        got = {}
+        with tracing.traced_span("pipeline.run") as root:
+            ctx = contextvars.copy_context()
+
+            def worker():
+                with tracing.traced_span("stage.work.process") as s:
+                    got["ids"] = (s.trace_id, s.parent_id)
+
+            t = threading.Thread(target=ctx.run, args=(worker,))
+            t.start()
+            t.join()
+        tracing.disable_tracing()
+        assert got["ids"] == (root.trace_id, root.span_id)
+        records = [json.loads(line) for line in open(path)]
+        assert len({r["trace_id"] for r in records}) == 1
+
+    def test_plain_thread_falls_back_to_process_parent(self, tmp_path):
+        """A thread started WITHOUT context copy still joins the trace when
+        a process-level parent is attached (the spawned-worker model)."""
+        tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        with tracing.traced_span("driver.root") as root:
+            tp = tracing.format_traceparent()
+        assert tracing.attach_traceparent(tp)
+        got = {}
+
+        def worker():
+            with tracing.traced_span("worker.setup") as s:
+                got["ids"] = (s.trace_id, s.parent_id)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert got["ids"] == (root.trace_id, root.span_id)
+
+    def test_explicit_traceparent_beats_stack(self, tmp_path):
+        tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+        remote_tp = "00-" + "c" * 32 + "-" + "d" * 16 + "-01"
+        with tracing.traced_span("local.parent"):
+            with tracing.traced_span("restored", traceparent=remote_tp) as s:
+                assert s.trace_id == "c" * 32
+                assert s.parent_id == "d" * 16
+
+
+def test_ndjson_backend_rotates_part_files(tmp_path):
+    """Long traces flush in bounded part files (every byte written once)
+    instead of rewriting one ever-growing file; no span may be lost."""
+    from cosmos_curate_tpu.observability.tracing import _NdjsonBackend
+
+    n = _NdjsonBackend.FLUSH_EVERY * 2 + 50
+    tracing.enable_tracing(str(tmp_path / "t.ndjson"))
+    for i in range(n):
+        with tracing.traced_span("tick", i=i):
+            pass
+    tracing.disable_tracing()  # flushes the 50-span remainder
+    names = sorted(f.name for f in tmp_path.glob("*.ndjson"))
+    assert names == ["t.ndjson", "t.part1.ndjson", "t.part2.ndjson"]
+    records = [
+        json.loads(line)
+        for f in tmp_path.glob("*.ndjson")
+        for line in f.read_text().splitlines()
+    ]
+    assert len(records) == n
+    assert {r["attributes"]["i"] for r in records} == set(range(n))
+
+
+def test_ndjson_flush_failure_never_raises(tmp_path, monkeypatch):
+    """A storage failure during the NDJSON flush must be swallowed: it
+    happens inside end_span (the caller's try/finally), where raising would
+    fail real pipeline work — and fail disable_tracing after a run already
+    wrote its outputs. The chunk is dropped so memory stays bounded."""
+    from cosmos_curate_tpu.observability.tracing import _NdjsonBackend
+
+    backend = _NdjsonBackend(str(tmp_path / "t.ndjson"))
+
+    def boom(path, data):
+        raise OSError("disk full")
+
+    monkeypatch.setattr("cosmos_curate_tpu.storage.client.write_bytes", boom)
+    span = tracing.TracedSpan("s", "a" * 32, "b" * 16, None, 0.0, end_s=1.0)
+    for _ in range(_NdjsonBackend.FLUSH_EVERY + 1):
+        backend.export(span)  # crosses the flush threshold: must not raise
+    backend.close()  # final flush of the remainder: must not raise
+    assert backend._flush_errors == 2
+    assert backend._lines == []  # dropped, not accumulated
+
+
+# -- spawned worker process round-trip ---------------------------------------
+
+
+class _EchoStage:
+    """Minimal stage contract for worker_main (setup_on_node/setup/
+    process_data/destroy). Module-level: the spawned child imports it."""
+
+    name = "echo"
+
+    def setup_on_node(self, node, meta):
+        pass
+
+    def setup(self, meta):
+        pass
+
+    def process_data(self, tasks):
+        return list(tasks)
+
+    def destroy(self):
+        pass
+
+
+class _Meta:
+    node = None
+
+
+def test_spawned_worker_restores_parent(tmp_path):
+    """End-to-end over a REAL spawned worker process: the driver-side stage
+    traceparent stamped into ProcessMsg must become the parent of the
+    worker's process span, and the run-root CURATE_TRACEPARENT must parent
+    its other spans — one trace id across both processes."""
+    import cloudpickle
+
+    from cosmos_curate_tpu.engine import object_store, worker
+
+    trace_dir = tmp_path / "traces"
+    driver_path = tracing.enable_tracing(str(trace_dir / "driver.ndjson"))
+    with tracing.traced_span("pipeline.run") as root:
+        run_tp = tracing.format_traceparent()
+        stage_span = tracing.start_span("stage.echo")
+        stage_tp = tracing.format_traceparent(stage_span)
+
+        ctx = mp.get_context("spawn")
+        in_q, out_q = ctx.Queue(), ctx.Queue()
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "CURATE_TRACING": "1",
+            "CURATE_TRACEPARENT": run_tp,
+            "CURATE_TRACE_DIR": str(trace_dir),
+            "CURATE_WORKER_ID": "echo-w0",
+        }
+        proc = ctx.Process(target=worker.worker_main, args=(in_q, out_q, env))
+        proc.start()
+        try:
+            in_q.put(
+                worker.SetupMsg(
+                    cloudpickle.dumps(_EchoStage()), cloudpickle.dumps(_Meta())
+                )
+            )
+            ready = out_q.get(timeout=60)
+            assert ready.error is None, ready.error
+            ref = object_store.put({"v": 1})
+            try:
+                in_q.put(
+                    worker.ProcessMsg(batch_id=0, refs=[ref], traceparent=stage_tp)
+                )
+                result = out_q.get(timeout=60)
+                assert result.error is None, result.error
+                for r in result.out_refs:
+                    object_store.delete(r)
+            finally:
+                object_store.delete(ref)
+            in_q.put(worker.ShutdownMsg())
+            proc.join(timeout=30)
+        finally:
+            if proc.is_alive():
+                proc.terminate()
+        tracing.end_span(stage_span)
+    tracing.disable_tracing()
+
+    worker_files = [p for p in trace_dir.glob("trace-*.ndjson")]
+    assert worker_files, "spawned worker flushed no trace file at exit"
+    worker_spans = [
+        json.loads(line) for p in worker_files for line in p.read_text().splitlines()
+    ]
+    driver_spans = [json.loads(line) for line in open(driver_path)]
+    # the span carries the stage's DISPLAY name (Stage.name — "echo", same
+    # vocabulary as the driver's stage.echo span), not the class name:
+    # observability wrappers subclass dynamically and must not collapse
+    # every wrapped stage into one span-name bucket
+    process_spans = [s for s in worker_spans if s["name"] == "stage.echo.process"]
+    assert process_spans, [s["name"] for s in worker_spans]
+    # worker's batch span parents onto the DRIVER's stage span
+    assert process_spans[0]["parent_id"] == stage_span.span_id
+    # one trace id across both processes
+    all_ids = {s["trace_id"] for s in worker_spans + driver_spans}
+    assert all_ids == {root.trace_id}
