@@ -364,6 +364,18 @@ def main() -> int:
             # identical requests through the same engine
             record["caption_pipeline_efficiency"] = caption["caption_pipeline_efficiency"]
             record["caption_pipeline_tokens_per_sec"] = caption["pipeline_tokens_per_sec"]
+        # decomposition of the caption number: per-phase seconds (prep /
+        # vision-encode / prefill / decode / idle) + shared-prefix KV cache
+        # traffic for the in-pipeline pass
+        if "pipeline_phases" in caption:
+            record["caption_phase_breakdown"] = caption["pipeline_phases"]
+        for key in (
+            "prefill_tokens",
+            "prefix_cache_hits",
+            "prefix_tokens_saved",
+        ):
+            if f"pipeline_{key}" in caption:
+                record[f"caption_{key}"] = caption[f"pipeline_{key}"]
         if caption.get("backend") == "tpu":
             record["decode_mfu"] = caption.get("decode_mfu", 0.0)
         elif caption.get("backend") != backend:

@@ -90,6 +90,27 @@ class EngineMetrics:
             "fraction of summed stage busy time hidden by stage overlap "
             "(last completed run)", [],
         )
+        # Caption-engine phase breakdown (models/vlm/engine.py via
+        # stage_timer.record_caption_phases): seconds per phase per caption
+        # stage, plus shared-prefix KV cache traffic. idle rising against
+        # prefill+decode means the stage is starving the engine between
+        # batches; hits/(hits+misses) ≈ 1 means the prefix cache is doing
+        # its job (every request after the first skips the prefix prefill).
+        self.caption_phase_total = Counter(
+            "caption_phase_seconds_total",
+            "caption engine seconds by phase", labels + ["phase"],
+        )
+        self.caption_prefix_hits = Counter(
+            "caption_prefix_cache_hits_total", "shared-prefix KV cache hits", labels
+        )
+        self.caption_prefix_misses = Counter(
+            "caption_prefix_cache_misses_total",
+            "shared-prefix KV cache misses (builds)", labels,
+        )
+        self.caption_prefix_saved = Counter(
+            "caption_prefix_tokens_saved_total",
+            "prefill tokens skipped via shared-prefix hits", labels,
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -145,6 +166,25 @@ class EngineMetrics:
         )
         self.dispatch_h2d_total.labels(stage).inc(max(0.0, float(agg.get("h2d_s", 0.0))))
         self.dispatch_d2h_total.labels(stage).inc(max(0.0, float(agg.get("d2h_s", 0.0))))
+
+    def observe_caption_phases(self, stage: str, phases: dict) -> None:
+        """Fold one caption-engine drive's phase/cache deltas (the
+        stage_timer.record_caption_phases schema) into the counters."""
+        if not self.enabled:
+            return
+        for phase in ("prep_s", "vision_encode_s", "prefill_s", "decode_s", "idle_s"):
+            self.caption_phase_total.labels(stage, phase[:-2]).inc(
+                max(0.0, float(phases.get(phase, 0.0)))
+            )
+        self.caption_prefix_hits.labels(stage).inc(
+            max(0, int(phases.get("prefix_cache_hits", 0)))
+        )
+        self.caption_prefix_misses.labels(stage).inc(
+            max(0, int(phases.get("prefix_cache_misses", 0)))
+        )
+        self.caption_prefix_saved.labels(stage).inc(
+            max(0, int(phases.get("prefix_tokens_saved", 0)))
+        )
 
     def set_overlap_frac(self, frac: float) -> None:
         if self.enabled:

@@ -16,12 +16,26 @@ vllm_async_stage.py). TPU-first re-design:
   for zero recompiles).
 - **tokens/s** is tracked per engine — THE caption-throughput metric
   (reference docs/curator/design/SPEED_OF_LIGHT.md).
+- **shared-prefix KV cache**: every caption request in a run opens with the
+  same system-prompt/template text (SGLang RadixAttention's core insight,
+  Zheng et al. 2024 — and the caption workload is its best case: the prefix
+  is identical across ALL requests of a (flavor, prompt_variant)). The
+  prefix prefills ONCE into a small K/V block, which is device-copied into
+  each slot's cache rows at admission; per-request prefill then starts at
+  the prefix boundary with absolute rope positions, producing byte-identical
+  greedy output while skipping ``len(prefix) x (requests - 1)`` prefill
+  tokens.
+- **prep/decode overlap** (``async_prep=True``): a background thread runs
+  vision encoding + token embedding for waiting requests while the caller's
+  ``step()`` loop decodes, so frame prep of request N+1 hides behind decode
+  of request N instead of serializing with it.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable
@@ -62,6 +76,15 @@ class CaptionRequest:
     # set by add_request: which caller's run_until_complete owns this request
     # (several caption-family stages share one engine; see run_until_complete)
     owner: Any = None
+    # Whether this request's text prefix may be served from / inserted into
+    # the shared-prefix KV cache. Stages set False for one-shot prefixes
+    # (the refinement pass bakes the stage-1 caption into its prefix, so
+    # caching it would only thrash the LRU).
+    share_prefix: bool = True
+    # Encoded vision-tower output reused across passes of the SAME frames
+    # (the engine fills this after the first encode; a refinement follow-up
+    # carrying the identical frames array inherits it automatically).
+    vision_features: Any = field(default=None, repr=False)
 
 
 @dataclass
@@ -101,6 +124,52 @@ class CaptionResult:
 
 
 @dataclass
+class _VisionFeatures:
+    """One window's encoded vision-tower output, cached on the request so a
+    refinement follow-up over the SAME frames skips the tower entirely."""
+
+    embeds: Any  # [T_vis, D] device array
+    ds: np.ndarray | None  # qwen3 deepstack levels [L_ds, T_vis, D]
+    grid: tuple[int, int, int] | None
+    eff_fps: float | None
+    n_tokens: int
+
+
+@dataclass
+class _Prepared:
+    """A request after host/vision prep, ready for admission.
+
+    ``embeds`` hold only the SUFFIX (everything after the shared text
+    prefix) when ``base > 0``: the prefix's K/V come from the shared-prefix
+    cache and are device-copied into the slot's cache rows at admission, so
+    prefill starts at cache position ``base`` (rope positions stay
+    absolute — ``rope`` rows are the suffix slice of the full layout)."""
+
+    request: CaptionRequest
+    embeds: np.ndarray  # [T_suffix, D] float32
+    t_suffix: int
+    rope: np.ndarray  # [T_suffix] or [T_suffix, 3]
+    next_rope: int
+    ds: np.ndarray | None  # [L_ds, T_suffix, D] deepstack (suffix-aligned)
+    base: int = 0  # cached prefix length already in the KV cache
+    prefix_key: tuple | None = None
+
+    @property
+    def total(self) -> int:
+        return self.base + self.t_suffix
+
+
+@dataclass
+class _PrefixEntry:
+    """Prefilled K/V of one shared text prefix: ``[L, Tp, Hkv, Dh]`` device
+    arrays, device-copied into a slot's cache rows at admission."""
+
+    k: Any
+    v: Any
+    length: int
+
+
+@dataclass
 class _PendingPrefill:
     """A slot whose prompt is being prefilled chunk by chunk.
 
@@ -112,7 +181,7 @@ class _PendingPrefill:
     per-row write_index), so chunking adds zero recompiles."""
 
     request: CaptionRequest
-    embeds: np.ndarray  # [T, D] full prompt embeds
+    embeds: np.ndarray  # [T, D] prompt embeds (suffix-only when base > 0)
     t_valid: int
     rope_pos: np.ndarray  # [T] or [T, 3]
     next_rope: int
@@ -120,6 +189,9 @@ class _PendingPrefill:
     # qwen3 deepstack visual features [L_ds, T, D] (zeros at text
     # positions), chunk-sliced alongside embeds; None otherwise
     ds: np.ndarray | None = None
+    # cache offset where this prompt's writes start (= cached shared-prefix
+    # length; chunk k writes at base + progress)
+    base: int = 0
 
 
 @dataclass
@@ -158,6 +230,11 @@ class CaptionEngine:
         tokenizer: ByteTokenizer | None = None,
         prefill_chunk: int = 256,
         kv_lanes: tuple[tuple[int, int], ...] | None = None,
+        async_prep: bool = False,
+        enable_prefix_cache: bool = True,
+        prefix_cache_size: int = 8,
+        min_prefix_len: int = 4,
+        admission_linger_s: float = 0.05,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
@@ -185,6 +262,49 @@ class CaptionEngine:
         # batch (static shapes); rows without an active slot are wasted.
         # utilization = tokens produced / rows executed
         self._decode_rows = 0
+        # per-phase accounting (seconds): host+vision prep, vision-tower
+        # share of prep, prefill programs (incl. shared-prefix builds),
+        # decode is _decode_time above. Feeds stage_timer caption phases.
+        # _stats_lock guards every counter '+=': the prep thread (prep /
+        # vision / prefix-build counters) and the step thread (prefill /
+        # decode counters) would otherwise lose updates racing on the same
+        # attributes — and prefill_tokens is the acceptance metric.
+        self._stats_lock = threading.Lock()
+        self._prep_time = 0.0
+        self._vision_time = 0.0
+        self._prefill_time = 0.0
+        self._prefill_tokens = 0  # prompt tokens pushed through prefill
+        self._vision_encodes = 0
+        self._vision_reuses = 0
+        # shared-prefix KV cache: LRU over prefix token tuples. Entries are
+        # small ([L, Tp, Hkv, Dh] per prefix) next to the lane caches.
+        self.enable_prefix_cache = enable_prefix_cache
+        self.prefix_cache_size = prefix_cache_size
+        self.min_prefix_len = min_prefix_len
+        self._prefix_cache: "OrderedDict[tuple, _PrefixEntry]" = OrderedDict()
+        self._prefix_lock = threading.Lock()
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_evictions = 0
+        self._prefix_tokens_saved = 0
+        # async prep: a background thread runs vision encode + embedding for
+        # waiting requests while the caller's step() loop decodes — prep of
+        # request N+1 overlaps decode of request N (the caption stage's
+        # prep/decode stall was ~70% of its engine budget). Sync mode
+        # (default) preps inline at admission: the round-5 behavior,
+        # deterministic step() semantics for tests.
+        self.async_prep = async_prep
+        self._ready: "deque[_Prepared]" = deque()
+        self._prep_inflight: CaptionRequest | None = None
+        self._prep_thread: threading.Thread | None = None
+        self._prep_stop = False
+        # admission linger: when EVERY lane is idle and a burst is still
+        # prepping, opening a lane for the first ready request decodes it
+        # solo (full-batch rows for one token). Hold admission up to this
+        # long so fast preps pack a batch; slow preps (vision-heavy real
+        # configs) blow the deadline and overlap decode instead.
+        self.admission_linger_s = admission_linger_s
+        self._linger_until: float | None = None
         self._built = False
         # One engine is shared by every caption-family stage in a pipeline
         # (weights + KV cache are too big to duplicate). Stages run in
@@ -193,6 +313,10 @@ class CaptionEngine:
         # lock serializes all engine mutation; completions are owner-tagged
         # so one stage's run cannot steal another stage's results.
         self._lock = threading.RLock()
+        # signaled when prep lands a ready request / a follow-up is queued;
+        # run_until_complete waits on it instead of spinning when the only
+        # outstanding work is an in-flight background prep
+        self._work_cv = threading.Condition(self._lock)
 
     # read-only aggregate views over the lanes (public slot id = lane.base
     # + lane-local index, unique across lanes)
@@ -308,12 +432,54 @@ class CaptionEngine:
             greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
             return greedy, step_logits, ck, cv
 
+        @jax.jit
+        def prefix_prefill(params, embeds, rope_pos, t_valid):
+            """Prefill ONE text prefix into a scratch cache and return its
+            K/V block [L, Sp, Hkv, Dh] (sliced to the true length by the
+            caller). embeds: [1, Sp, D] (pow2-padded); t_valid: scalar.
+            Compiled once per Sp bucket — prefixes are per (flavor,
+            prompt_variant), so this runs once per variant, not per
+            request."""
+            ck, cv = init_cache(cfg, 1, length=embeds.shape[1])
+            _logits, nk, nv = model.apply(
+                params,
+                embeds,
+                ck,
+                cv,
+                rope_pos,
+                jnp.zeros((1,), jnp.int32),
+                jnp.full((1,), t_valid, jnp.int32),
+            )
+            return nk[:, 0], nv[:, 0]
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_prefix(cache_k, cache_v, pk, pv, slot):
+            """Device-copy a cached prefix K/V block into one slot's cache
+            rows [0, Tp) — per-request prefill then starts at cache
+            position Tp. Compiled once per (lane shape, Tp)."""
+            zero = jnp.zeros((), jnp.int32)
+            idx = (zero, slot, zero, zero, zero)
+            ck = jax.lax.dynamic_update_slice(
+                cache_k, pk.astype(cache_k.dtype)[:, None], idx
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cache_v, pv.astype(cache_v.dtype)[:, None], idx
+            )
+            return ck, cv
+
         self._host_rng = np.random.default_rng(seed)
         self._encode_images = encode_images
         self._embed_tokens = embed_tokens
         self._prefill_batch = prefill_batch
         self._decode = decode_step
+        self._prefix_prefill = prefix_prefill
+        self._insert_prefix = insert_prefix
         self._built = True
+        if self.async_prep:
+            # requests may already be waiting (queued before setup)
+            with self._work_cv:
+                self._start_prep_thread()
+                self._work_cv.notify_all()
 
     # -- public API -----------------------------------------------------
     @property
@@ -333,15 +499,33 @@ class CaptionEngine:
             raise ValueError("stop strings must be non-empty")
         if request.owner is None:
             request.owner = owner if owner is not None else threading.get_ident()
-        with self._lock:
+        with self._work_cv:
             self.waiting.append(request)
+            # only a BUILT engine may prep (the thread calls the jitted
+            # encoders setup() creates); requests queued before setup()
+            # wait — setup() starts the thread for them, and the sync
+            # step() path keeps raising 'call setup() first'
+            if self.async_prep and self._built:
+                self._start_prep_thread()
+            self._work_cv.notify_all()
+
+    def _prep_requests(self) -> list[CaptionRequest]:
+        """Requests past ``waiting`` but not yet admitted (prepared or
+        mid-prep in the background thread). Lock held by caller."""
+        reqs = [p.request for p in self._ready]
+        if self._prep_inflight is not None:
+            reqs.append(self._prep_inflight)
+        return reqs
 
     def has_work(self, owner: Any = None) -> bool:
         with self._lock:
             if owner is None:
-                return bool(self.waiting or self.slots or self.pending)
+                return bool(
+                    self.waiting or self._prep_requests() or self.slots or self.pending
+                )
             return (
                 any(r.owner == owner for r in self.waiting)
+                or any(r.owner == owner for r in self._prep_requests())
                 or any(s.request.owner == owner for s in self.slots.values())
                 or any(p.request.owner == owner for p in self.pending.values())
             )
@@ -362,11 +546,22 @@ class CaptionEngine:
             # Lock per step, not across the drain: another stage's
             # add_request must be able to slip in between decode steps so
             # its requests actually join the continuous batch.
-            with self._lock:
+            with self._work_cv:
                 if not self.has_work(owner):
                     mine = [r for r in self.completed if r.owner == owner]
                     self.completed = [r for r in self.completed if r.owner != owner]
                     return mine
+                steppable = (
+                    bool(self._ready)
+                    or (not self.async_prep and bool(self.waiting))
+                    or any(l.slots or l.pending for l in self.lanes)
+                )
+                if not steppable or self._should_linger():
+                    # only background prep is outstanding (or admission is
+                    # lingering for the burst's prep to pack a batch) —
+                    # sleep until it lands instead of spinning empty steps
+                    self._work_cv.wait(0.02)
+                    continue
                 self.step()
 
     @property
@@ -381,12 +576,89 @@ class CaptionEngine:
     def decode_time_s(self) -> float:
         return self._decode_time
 
+    @property
+    def prefill_tokens(self) -> int:
+        """Prompt tokens pushed through prefill programs (bucket, chunk,
+        and shared-prefix builds; cache-inserted prefix copies are NOT
+        prefill). With the shared-prefix cache, n requests sharing a
+        Tp-token prefix prefill Tp fewer tokens each after the first."""
+        return self._prefill_tokens
+
+    @property
+    def prefix_cache_hits(self) -> int:
+        return self._prefix_hits
+
+    @property
+    def prefix_cache_misses(self) -> int:
+        return self._prefix_misses
+
+    @property
+    def prefix_cache_evictions(self) -> int:
+        return self._prefix_evictions
+
+    @property
+    def prefix_tokens_saved(self) -> int:
+        """Prefill tokens NOT recomputed thanks to shared-prefix hits."""
+        return self._prefix_tokens_saved
+
+    @property
+    def vision_encodes(self) -> int:
+        return self._vision_encodes
+
+    @property
+    def vision_reuses(self) -> int:
+        return self._vision_reuses
+
+    @property
+    def phase_seconds(self) -> dict[str, float]:
+        """Cumulative per-phase seconds: ``prep`` (host prep incl. the
+        vision share), ``vision_encode`` (vision-tower subset of prep),
+        ``prefill`` (prefill programs + host sync), ``decode`` (decode
+        steps + host sync). Wall minus (prefill + decode) over a drive
+        window is the engine's idle/stall time."""
+        return {
+            "prep_s": self._prep_time,
+            "vision_encode_s": self._vision_time,
+            "prefill_s": self._prefill_time,
+            "decode_s": self._decode_time,
+        }
+
     def reset_stats(self) -> None:
         """Zero the throughput counters (e.g. after benchmark warmup) —
-        the counter set and its reset stay in one place."""
-        self._decode_tokens = 0
-        self._decode_time = 0.0
-        self._decode_rows = 0
+        the counter set and its reset stay in one place. Shared-prefix
+        cache CONTENTS survive (only the hit/miss counters reset)."""
+        with self._stats_lock:
+            self._decode_tokens = 0
+            self._decode_time = 0.0
+            self._decode_rows = 0
+            self._prep_time = 0.0
+            self._vision_time = 0.0
+            self._prefill_time = 0.0
+            self._prefill_tokens = 0
+            self._vision_encodes = 0
+            self._vision_reuses = 0
+            self._prefix_hits = 0
+            self._prefix_misses = 0
+            self._prefix_evictions = 0
+            self._prefix_tokens_saved = 0
+
+    def shutdown(self) -> None:
+        """Stop the background prep thread (tests; long-lived engines just
+        let the daemon thread die with the process)."""
+        with self._work_cv:
+            self._prep_stop = True
+            self._work_cv.notify_all()
+        t = self._prep_thread
+        if t is not None:
+            t.join(timeout=5)
+            if t.is_alive():
+                # mid-encode and past the grace: leave the stop flag SET so
+                # the thread exits at its next loop check instead of
+                # resuming work beside a future replacement thread
+                logger.warning("caption prep thread still running after 5s grace")
+                return
+            self._prep_thread = None
+        self._prep_stop = False
 
     @property
     def decode_slot_utilization(self) -> float:
@@ -397,18 +669,111 @@ class CaptionEngine:
 
     # -- engine internals ----------------------------------------------
     def step(self) -> None:
-        """Admit waiting requests, advance chunked prefills by ONE chunk,
-        then one decode step per active lane — so a long prompt never blocks
-        the in-flight batch's decode for more than a chunk's latency."""
+        """Admit ready requests, advance chunked prefills, then one decode
+        step per active lane — so a long prompt never blocks the in-flight
+        batch's decode for more than a chunk's latency.
+
+        Chunk admission is tuned against decode occupancy (the live signal
+        behind ``decode_slot_utilization``): chunking exists to protect
+        in-flight decode from a long prefill stall, so while NO lane is
+        decoding, pending chunks run back to back instead of one per step —
+        an idle engine prefills at full speed."""
         if not self._built:
             raise RuntimeError("call setup() first")
-        with self._lock:
+        with self._work_cv:
             self._admit()
             for lane in self.lanes:
                 if lane.pending:
                     self._prefill_chunk_step(lane)
+                    while lane.pending and not any(l.slots for l in self.lanes):
+                        self._prefill_chunk_step(lane)
                 if lane.slots:
                     self._decode_once(lane)
+            self._work_cv.notify_all()  # ready-queue space may have freed
+
+    # -- request prep (sync inline, or the background overlap thread) ---
+    def _start_prep_thread(self) -> None:
+        if self._prep_thread is not None and self._prep_thread.is_alive():
+            return
+        # a shutdown() whose join grace expired leaves _prep_stop latched;
+        # a fresh thread must not read the stale flag and die instantly
+        self._prep_stop = False
+        self._prep_thread = threading.Thread(
+            target=self._prep_loop, name="caption-prep", daemon=True
+        )
+        self._prep_thread.start()
+
+    def _prep_ahead_limit(self) -> int:
+        # bound host memory for prepared-but-unadmitted embeds: enough to
+        # keep every slot fed one wave ahead, no more
+        return max(2, 2 * sum(l.n_slots for l in self.lanes))
+
+    def _prep_loop(self) -> None:
+        """Background prep: vision encode + token embedding for waiting
+        requests, FIFO, overlapping the caller's decode loop. Device
+        compute runs OUTSIDE the engine lock — the lock only guards queue
+        hops, so a decode step never waits on a vision encode and vice
+        versa (device-side serialization is the hardware's business)."""
+        while True:
+            with self._work_cv:
+                while not self._prep_stop and (
+                    not self.waiting or len(self._ready) >= self._prep_ahead_limit()
+                ):
+                    self._work_cv.wait(0.1)
+                if self._prep_stop:
+                    return
+                req = self.waiting.pop(0)
+                self._prep_inflight = req
+            prep = self._safe_prepare(req)  # no lock: overlaps decode
+            with self._work_cv:
+                self._prep_inflight = None
+                if prep is not None:
+                    self._ready.append(prep)
+                self._work_cv.notify_all()
+
+    def _safe_prepare(self, req: CaptionRequest) -> "_Prepared | None":
+        t0 = time.monotonic()
+        try:
+            return self._prepare(req)
+        except Exception:
+            logger.exception("prefill prep failed for %s; dropping", req.request_id)
+            return None
+        finally:
+            with self._stats_lock:
+                self._prep_time += time.monotonic() - t0
+
+    def _should_linger(self) -> bool:
+        """True while admission should hold for the in-flight burst's prep:
+        every lane idle, ready requests waiting, more of the burst still
+        prepping, and the linger deadline not yet blown. Lock held by
+        caller."""
+        if not self.async_prep or self.admission_linger_s <= 0:
+            return False
+        if not self._ready or any(l.slots or l.pending for l in self.lanes):
+            self._linger_until = None
+            return False
+        incoming = len(self.waiting) + (1 if self._prep_inflight is not None else 0)
+        free = sum(l.n_slots for l in self.lanes)
+        if not incoming or len(self._ready) >= free:
+            self._linger_until = None
+            return False
+        now = time.monotonic()
+        if self._linger_until is None:
+            self._linger_until = now + self.admission_linger_s
+        return now < self._linger_until
+
+    def _next_prepared(self) -> "_Prepared | None":
+        """Next admission candidate in FIFO order: the ready queue first; in
+        sync mode fall through to inline prep of the waiting queue."""
+        if self._ready:
+            return self._ready.popleft()
+        if not self.async_prep:
+            while self.waiting:
+                req = self.waiting.pop(0)
+                prep = self._safe_prepare(req)
+                if prep is not None:
+                    return prep
+        return None
 
     def _route(self, need: int) -> _Lane | None:
         """Pick the lane for a request needing ``need`` positions.
@@ -446,53 +811,81 @@ class CaptionEngine:
         return first_idle
 
     def _prompt_len_estimate(self, req: CaptionRequest) -> int:
-        """Prompt length WITHOUT running the encoders (used for routing).
-        Must use the exact per-variant token count: an under-estimate
-        routes to a too-short lane and the multimodal guard then drops the
-        request instead of serving it from a longer lane."""
+        """Prompt length WITHOUT running the encoders. Routing now sees the
+        prepared request's ACTUAL total (prep precedes admission), so this
+        is a planning utility: callers sizing a request against the lanes
+        (fit_max_new_tokens, capacity tooling) without paying an encode."""
         n = len(req.prefix_ids) + len(req.prompt_ids)
         if req.frames is not None:
             n += self._vision_token_count(req.frames.shape[0])
         return min(n, self._max_len - req.sampling.max_new_tokens - 1)
 
     def _admit(self) -> None:
+        if self._should_linger():
+            return
         groups: dict[tuple[int, int], list[tuple]] = {}
-        while self.waiting:
-            req = self.waiting[0]
-            need = self._prompt_len_estimate(req) + req.sampling.max_new_tokens + 1
+        while True:
+            prep = self._next_prepared()
+            if prep is None:
+                break
+            req = prep.request
+            need = prep.total + req.sampling.max_new_tokens + 1
             lane = self._route(min(need, self._max_len))
             if lane is None:
-                break  # head-of-line waits for a slot to free (FIFO)
-            self.waiting.pop(0)
-            try:
-                embeds, t_valid, rope_pos, next_rope, ds = self._prepare_embeds(req)
-            except Exception:
-                logger.exception("prefill prep failed for %s; dropping", req.request_id)
-                continue
+                # head-of-line waits for a slot to free (FIFO); the prep
+                # work is kept, not redone
+                self._ready.appendleft(prep)
+                break
             lane_budget = lane.length - req.sampling.max_new_tokens - 1
-            if t_valid > lane_budget:  # estimate was off
+            if prep.total > lane_budget:  # routed lane too short after all
                 if req.frames is not None:
                     # never slice a vision block (see _fit_frames_to_budget):
-                    # re-route on the ACTUAL token count — _prepare_embeds
-                    # guarantees t_valid fits the longest lane, so a lane
+                    # re-route on the ACTUAL token count — _prepare
+                    # guarantees the total fits the longest lane, so a lane
                     # exists; None only means it is busy, so requeue at the
                     # head and wait instead of dropping a servable request
-                    lane2 = self._route(t_valid + req.sampling.max_new_tokens + 1)
+                    lane2 = self._route(prep.total + req.sampling.max_new_tokens + 1)
                     if lane2 is None:
-                        self.waiting.insert(0, req)
+                        self._ready.appendleft(prep)
                         break
                     logger.info(
                         "%s: multimodal prompt re-routed %d -> %d lane "
                         "(estimate %d, actual %d tokens)",
                         req.request_id, lane.length, lane2.length,
-                        lane_budget, t_valid,
+                        lane_budget, prep.total,
                     )
                     lane = lane2
                     lane_budget = lane.length - req.sampling.max_new_tokens - 1
                 else:
-                    embeds = embeds[-lane_budget:]
-                    rope_pos = rope_pos[-lane_budget:]
-                    t_valid = lane_budget
+                    if prep.base:
+                        # tail-keep truncation may cut into the prefix
+                        # region: fold the prefix back in first
+                        prep = self._materialize_full(prep)
+                    prep.embeds = prep.embeds[-lane_budget:]
+                    prep.rope = prep.rope[-lane_budget:]
+                    if prep.ds is not None:
+                        prep.ds = prep.ds[:, -lane_budget:]
+                    prep.t_suffix = lane_budget
+            # Shared-prefix placement feasibility in THIS lane: a bucketed
+            # group prefill writes a [bucket]-length chunk at offset base,
+            # which must stay inside the lane. Chunked prefill places
+            # exactly (its final chunk shifts back), so prefer it when the
+            # suffix is chunkable; otherwise fold the prefix back in.
+            group_ok = (
+                prep.base + min(next_pow2(prep.t_suffix), lane.length) <= lane.length
+            )
+            if not group_ok and prep.t_suffix <= self.prefill_chunk:
+                prep = self._materialize_full(prep)
+                group_ok = True
+            # Chunk admission tuned against decode occupancy (the live
+            # signal behind decode_slot_utilization): chunking protects
+            # in-flight decode from a long prefill stall — with no lane
+            # decoding there is nothing to protect, so admit the whole
+            # prompt as one bucketed prefill and skip the per-step drip.
+            decode_active = any(l.slots for l in self.lanes)
+            chunked = prep.t_suffix > self.prefill_chunk and (
+                decode_active or not group_ok
+            )
             slot_idx = next(
                 i
                 for i in range(lane.n_slots)
@@ -500,20 +893,38 @@ class CaptionEngine:
                 and i not in lane.pending
                 and i not in lane.reserved
             )
-            if t_valid > self.prefill_chunk:
+            if prep.base:
+                try:
+                    self._insert_prefix_into(lane, slot_idx, prep)
+                except Exception:
+                    logger.exception(
+                        "prefix insert failed for %s; dropping", req.request_id
+                    )
+                    continue
+            if chunked:
                 # long prompt: prefill in chunks interleaved with decode
                 lane.pending[slot_idx] = _PendingPrefill(
                     request=req,
-                    embeds=np.asarray(embeds, np.float32),
-                    t_valid=t_valid,
-                    rope_pos=np.asarray(rope_pos),
-                    next_rope=next_rope,
-                    ds=ds,
+                    embeds=prep.embeds,
+                    t_valid=prep.t_suffix,
+                    rope_pos=prep.rope,
+                    next_rope=prep.next_rope,
+                    ds=prep.ds,
+                    base=prep.base,
                 )
                 continue
-            bucket = min(next_pow2(t_valid), lane.length)
+            bucket = min(next_pow2(prep.t_suffix), lane.length)
             groups.setdefault((self.lanes.index(lane), bucket), []).append(
-                (slot_idx, req, embeds, t_valid, rope_pos, next_rope, ds)
+                (
+                    slot_idx,
+                    req,
+                    prep.embeds,
+                    prep.t_suffix,
+                    prep.rope,
+                    prep.next_rope,
+                    prep.ds,
+                    prep.base,
+                )
             )
             # reserve the slot so this loop's later iterations see it taken
             lane.reserved.add(slot_idx)
@@ -542,43 +953,77 @@ class CaptionEngine:
                             "prefill failed for %s; dropping", item[1].request_id
                         )
 
-    def _prepare_embeds(self, req: CaptionRequest):
+    def _prepare(self, req: CaptionRequest, allow_prefix: bool = True) -> _Prepared:
         """Vision encode + token embed for one request.
 
-        Returns ([T, D] embeds, t_valid, [T(,3)] rope positions, next_rope).
-        Under m-rope the rope positions come from build_mrope_positions over
-        the [prefix][vision][prompt] layout; otherwise they are arange."""
+        When the request's text prefix is shareable (``share_prefix``, long
+        enough, cache enabled, no truncation needed), only the SUFFIX
+        (vision + prompt text) is embedded — the prefix's K/V come from the
+        shared-prefix cache and ``base`` marks where suffix prefill starts.
+        Rope positions stay absolute over the full [prefix][vision][prompt]
+        layout either way, so cached and uncached prefills write identical
+        cache contents (greedy parity). Under m-rope the positions come
+        from build_mrope_positions; otherwise they are arange."""
         from cosmos_curate_tpu.models.vlm.model import build_mrope_positions
 
-        frames, eff_fps = self._fit_frames_to_budget(req)
-        parts = []
-        grid_merged = None
+        budget = self._max_len - req.sampling.max_new_tokens - 1
+        n_pre = len(req.prefix_ids)
+        vis_embeds = None
         ds_vis = None
-        if req.prefix_ids:
+        grid_merged = None
+        eff_fps = None
+        if req.frames is not None:
+            vf = req.vision_features
+            n_text = n_pre + len(req.prompt_ids)
+            if vf is not None and n_text + vf.n_tokens <= budget:
+                # refinement pass over the SAME frames: reuse the encoded
+                # vision features instead of re-running the tower
+                vis_embeds, ds_vis = vf.embeds, vf.ds
+                grid_merged, eff_fps = vf.grid, vf.eff_fps
+                with self._stats_lock:
+                    self._vision_reuses += 1
+            else:
+                frames, eff_fps = self._fit_frames_to_budget(req)
+                t0 = time.monotonic()
+                vis = self._encode_images(self.params, jnp.asarray(frames)[None])
+                if isinstance(vis, tuple):  # qwen3: (embeds, deepstack levels)
+                    vis, ds_levels = vis
+                    ds_vis = np.asarray(ds_levels[:, 0], np.float32)  # [L_ds, T_vis, D]
+                vis_embeds = vis[0]
+                jax.block_until_ready(vis_embeds)
+                with self._stats_lock:
+                    self._vision_time += time.monotonic() - t0
+                    self._vision_encodes += 1
+                if self.cfg.vision_variant in ("qwen2", "qwen3"):
+                    grid_merged = self.cfg.qwen_vision.merged_grid(frames.shape[0])
+                req.vision_features = _VisionFeatures(
+                    embeds=vis_embeds,
+                    ds=ds_vis,
+                    grid=grid_merged,
+                    eff_fps=eff_fps,
+                    n_tokens=int(vis_embeds.shape[0]),
+                )
+        n_vis = 0 if vis_embeds is None else int(vis_embeds.shape[0])
+        total = n_pre + n_vis + len(req.prompt_ids)
+        use_prefix = (
+            allow_prefix
+            and self.enable_prefix_cache
+            and req.share_prefix
+            and n_pre >= self.min_prefix_len
+            and n_vis + len(req.prompt_ids) > 0  # suffix must be non-empty
+            and total <= budget  # tail-keep truncation cuts into the prefix
+        )
+        parts = []
+        if n_pre and not use_prefix:
             pre = jnp.asarray(req.prefix_ids, jnp.int32)
             parts.append(self._embed_tokens(self.params, pre[None])[0])
-        if frames is not None:
-            vis = self._encode_images(self.params, jnp.asarray(frames)[None])
-            if isinstance(vis, tuple):  # qwen3: (embeds, deepstack levels)
-                vis, ds_levels = vis
-                ds_vis = np.asarray(ds_levels[:, 0], np.float32)  # [L_ds, T_vis, D]
-            parts.append(vis[0])
-            if self.cfg.vision_variant in ("qwen2", "qwen3"):
-                grid_merged = self.cfg.qwen_vision.merged_grid(frames.shape[0])
-        ids = jnp.asarray(req.prompt_ids, jnp.int32)
-        parts.append(self._embed_tokens(self.params, ids[None])[0])
+        if vis_embeds is not None:
+            parts.append(vis_embeds)
+        if req.prompt_ids:
+            ids = jnp.asarray(req.prompt_ids, jnp.int32)
+            parts.append(self._embed_tokens(self.params, ids[None])[0])
         embeds = jnp.concatenate(parts, axis=0)
-        t_valid = embeds.shape[0]
-        ds = None
-        if ds_vis is not None:
-            # deepstack buffer over the FULL prompt: zeros at text
-            # positions, the merger levels at the vision span (text-only
-            # requests carry ds=None — the prefill buffers read as zeros)
-            ds = np.zeros((self._ds_levels, t_valid, embeds.shape[-1]), np.float32)
-            off = len(req.prefix_ids)
-            ds[:, off : off + ds_vis.shape[1]] = ds_vis
         if self.cfg.mrope_section is not None:
-            n_vis = t_valid - len(req.prefix_ids) - len(req.prompt_ids)
             if grid_merged is None and n_vis:
                 # vit-variant vision tokens: treat as a 1 x 1 x n_vis row
                 grid_merged = (1, 1, n_vis)
@@ -596,14 +1041,41 @@ class CaptionEngine:
             ):
                 t_scale = qv.tokens_per_second * qv.temporal_patch_size / eff_fps
             rope_pos, next_rope = build_mrope_positions(
-                len(req.prefix_ids), grid_merged, len(req.prompt_ids), t_scale
+                n_pre, grid_merged, len(req.prompt_ids), t_scale
             )
         else:
-            rope_pos = np.arange(t_valid, dtype=np.int32)
-            next_rope = t_valid
-        budget = self._max_len - req.sampling.max_new_tokens - 1
+            rope_pos = np.arange(total, dtype=np.int32)
+            next_rope = total
+        ds = None
+        if ds_vis is not None and self._ds_levels:
+            # deepstack buffer: zeros at text positions, the merger levels
+            # at the vision span (text-only requests carry ds=None — the
+            # prefill buffers read as zeros); suffix-aligned when the
+            # prefix is cached
+            off = 0 if use_prefix else n_pre
+            t_len = (total - n_pre) if use_prefix else total
+            ds = np.zeros((self._ds_levels, t_len, embeds.shape[-1]), np.float32)
+            ds[:, off : off + ds_vis.shape[1]] = ds_vis
+        if use_prefix:
+            key = tuple(req.prefix_ids)
+            _entry, hit = self._ensure_prefix(key)
+            if hit:
+                with self._stats_lock:
+                    self._prefix_tokens_saved += n_pre
+            return _Prepared(
+                request=req,
+                embeds=np.asarray(embeds, np.float32),
+                t_suffix=total - n_pre,
+                rope=np.asarray(rope_pos)[n_pre:],
+                next_rope=next_rope,
+                ds=ds,
+                base=n_pre,
+                prefix_key=key,
+            )
+        t_valid = total
+        rope_pos = np.asarray(rope_pos)
         if t_valid > budget:
-            if frames is not None:
+            if req.frames is not None:
                 # _fit_frames_to_budget guarantees multimodal prompts fit;
                 # slicing here would cut the vision block mid-grid and
                 # corrupt the prompt silently
@@ -618,7 +1090,108 @@ class CaptionEngine:
             if ds is not None:
                 ds = ds[:, -budget:]
             t_valid = budget
-        return embeds, t_valid, rope_pos, next_rope, ds
+        return _Prepared(
+            request=req,
+            embeds=np.asarray(embeds, np.float32),
+            t_suffix=t_valid,
+            rope=rope_pos,
+            next_rope=next_rope,
+            ds=ds,
+        )
+
+    def _prepare_embeds(self, req: CaptionRequest):
+        """Legacy full-layout prep view (no prefix cache): ([T, D] embeds,
+        t_valid, [T(,3)] rope positions, next_rope, ds)."""
+        p = self._prepare(req, allow_prefix=False)
+        return p.embeds, p.t_suffix, p.rope, p.next_rope, p.ds
+
+    def _materialize_full(self, prep: _Prepared) -> _Prepared:
+        """Fold the cached prefix back into a prepared request (host-side):
+        the fallback when a routed lane cannot place a bucketed suffix at
+        offset ``base``, or when tail-keep truncation must see the whole
+        layout. Produces the exact uncached prefill inputs."""
+        req = prep.request
+        n_pre = len(req.prefix_ids)
+        pre = jnp.asarray(req.prefix_ids, jnp.int32)
+        pre_emb = np.asarray(self._embed_tokens(self.params, pre[None])[0], np.float32)
+        t = np.arange(n_pre, dtype=np.int32)
+        pre_rope = np.stack([t, t, t], axis=-1) if prep.rope.ndim == 2 else t
+        ds = prep.ds
+        if ds is not None:
+            ds = np.concatenate(
+                [np.zeros((ds.shape[0], n_pre, ds.shape[-1]), np.float32), ds], axis=1
+            )
+        return _Prepared(
+            request=req,
+            embeds=np.concatenate([pre_emb, prep.embeds], axis=0),
+            t_suffix=n_pre + prep.t_suffix,
+            rope=np.concatenate([pre_rope, prep.rope], axis=0),
+            next_rope=prep.next_rope,
+            ds=ds,
+        )
+
+    def _ensure_prefix(self, key: tuple, count: bool = True) -> tuple[_PrefixEntry, bool]:
+        """(entry, was_hit) for one shared text prefix, building and
+        LRU-inserting the K/V block on first use. Runs under the prefix
+        lock only — the build touches no lane state, so the prep thread
+        can build a prefix while the decode loop holds the engine lock.
+        ``count=False`` skips the hit counter (the admission-time re-lookup
+        must not double-count the prep-time hit); rebuild misses always
+        count — an eviction-rebuild is real recompute."""
+        with self._prefix_lock:
+            entry = self._prefix_cache.get(key)
+            if entry is not None:
+                self._prefix_cache.move_to_end(key)
+                if count:
+                    with self._stats_lock:
+                        self._prefix_hits += 1
+                return entry, True
+            with self._stats_lock:
+                self._prefix_misses += 1
+            tp = len(key)
+            sp = next_pow2(tp)
+            emb = np.zeros((1, sp, self.cfg.dim), np.float32)
+            emb[0, :tp] = np.asarray(
+                self._embed_tokens(self.params, jnp.asarray(key, jnp.int32)[None])[0],
+                np.float32,
+            )
+            pos = np.zeros((1, sp), np.int32)
+            pos[0, :tp] = np.arange(tp, dtype=np.int32)
+            if self.cfg.mrope_section is not None:
+                # text prefix: all three m-rope components equal
+                pos = np.broadcast_to(pos[..., None], (1, sp, 3))
+            t0 = time.monotonic()
+            k, v = self._prefix_prefill(
+                self.params,
+                jnp.asarray(emb),
+                jnp.asarray(pos),
+                jnp.asarray(tp, jnp.int32),
+            )
+            k, v = k[:, :tp], v[:, :tp]
+            jax.block_until_ready(v)
+            with self._stats_lock:
+                self._prefill_time += time.monotonic() - t0
+                self._prefill_tokens += tp
+            entry = _PrefixEntry(k=k, v=v, length=tp)
+            self._prefix_cache[key] = entry
+            while len(self._prefix_cache) > self.prefix_cache_size:
+                self._prefix_cache.popitem(last=False)
+                with self._stats_lock:
+                    self._prefix_evictions += 1
+            return entry, False
+
+    def _insert_prefix_into(self, lane: _Lane, slot_idx: int, prep: _Prepared) -> None:
+        """Device-copy the shared prefix K/V into the slot's cache rows
+        [0, base). Re-ensures the entry — it may have been evicted between
+        prep and admission under a small cache with many variants."""
+        entry, _hit = self._ensure_prefix(prep.prefix_key, count=False)
+        lane.cache_k, lane.cache_v = self._insert_prefix(
+            lane.cache_k,
+            lane.cache_v,
+            entry.k,
+            entry.v,
+            jnp.asarray(slot_idx, jnp.int32),
+        )
 
     def fit_max_new_tokens(
         self,
@@ -691,6 +1264,7 @@ class CaptionEngine:
         embeds = np.zeros((n_pad, bucket, dim), np.float32)
         slots_arr = np.zeros(n_pad, np.int32)
         t_valids = np.ones(n_pad, np.int32)
+        bases = np.zeros(n_pad, np.int32)
         mrope = self.cfg.mrope_section is not None
         rope_shape = (n_pad, bucket, 3) if mrope else (n_pad, bucket)
         rope_buf = np.zeros(rope_shape, np.int32)
@@ -699,10 +1273,13 @@ class CaptionEngine:
             if self._ds_levels
             else None
         )
-        for j, (slot_idx, _req, emb, t_valid, rope_pos, _next, ds) in enumerate(items):
+        for j, (slot_idx, _req, emb, t_valid, rope_pos, _next, ds, base) in enumerate(
+            items
+        ):
             embeds[j, :t_valid] = np.asarray(emb, np.float32)[:t_valid]
             slots_arr[j] = slot_idx
             t_valids[j] = t_valid
+            bases[j] = base  # shared-prefix rows start past their cached K/V
             rope_buf[j, :t_valid] = rope_pos[:t_valid]
             if ds_buf is not None and ds is not None:
                 ds_buf[:, j, :t_valid] = ds[:, :t_valid]
@@ -710,23 +1287,30 @@ class CaptionEngine:
             embeds[j] = embeds[0]
             slots_arr[j] = slots_arr[0]
             t_valids[j] = t_valids[0]
+            bases[j] = bases[0]
             rope_buf[j] = rope_buf[0]
             if ds_buf is not None:
                 ds_buf[:, j] = ds_buf[:, 0]
+        t0 = time.monotonic()
         logits, lane.cache_k, lane.cache_v = self._prefill_batch(
             self.params,
             lane.cache_k,
             lane.cache_v,
             jnp.asarray(embeds),
             jnp.asarray(slots_arr),
-            jnp.zeros(n_pad, jnp.int32),
+            jnp.asarray(bases),
             jnp.asarray(t_valids),
             jnp.asarray(rope_buf),
             None if ds_buf is None else jnp.asarray(ds_buf),
         )
         logits_np = np.asarray(logits)  # one host sync for the whole group
-        for j, (slot_idx, req, _emb, t_valid, _rope, next_rope, _ds) in enumerate(items):
-            self._start_slot(lane, slot_idx, req, t_valid, next_rope, logits_np[j])
+        with self._stats_lock:
+            self._prefill_time += time.monotonic() - t0
+            self._prefill_tokens += int(sum(it[3] for it in items))
+        for j, (slot_idx, req, _emb, t_valid, _rope, next_rope, _ds, base) in enumerate(
+            items
+        ):
+            self._start_slot(lane, slot_idx, req, base + t_valid, next_rope, logits_np[j])
 
     def _start_slot(
         self,
@@ -801,15 +1385,26 @@ class CaptionEngine:
             if self._ds_levels
             else None
         )
+        new_tokens = 0
         for j, (slot_idx, p) in enumerate(items):
             take = min(C, p.t_valid - p.progress)
-            embeds[j, :take] = p.embeds[p.progress : p.progress + take]
+            start = p.progress
+            if take < C:
+                # final partial chunk: shift back so the C-length buffer
+                # ends exactly at the prompt end. The overlapped rows
+                # rewrite identical K/V (same embeds, same rope, correct
+                # causal mask), and dynamic_update_slice stays in bounds
+                # for shared-prefix bases > 0 and for lane lengths that are
+                # not a multiple of the chunk size.
+                start = p.t_valid - C
+            new_tokens += take
+            embeds[j] = p.embeds[start : start + C]
             slots_arr[j] = slot_idx
-            write_idx[j] = p.progress
-            chunk_valid[j] = take
-            rope_buf[j, :take] = p.rope_pos[p.progress : p.progress + take]
+            write_idx[j] = p.base + start
+            chunk_valid[j] = C if start < p.progress else take
+            rope_buf[j] = p.rope_pos[start : start + C]
             if ds_buf is not None and p.ds is not None:
-                ds_buf[:, j, :take] = p.ds[:, p.progress : p.progress + take]
+                ds_buf[:, j] = p.ds[:, start : start + C]
         for j in range(n, n_pad):  # duplicate row 0 (identical writes: safe)
             embeds[j] = embeds[0]
             slots_arr[j] = slots_arr[0]
@@ -818,6 +1413,7 @@ class CaptionEngine:
             rope_buf[j] = rope_buf[0]
             if ds_buf is not None:
                 ds_buf[:, j] = ds_buf[:, 0]
+        t0 = time.monotonic()
         logits, lane.cache_k, lane.cache_v = self._prefill_batch(
             self.params,
             lane.cache_k,
@@ -838,7 +1434,13 @@ class CaptionEngine:
             logits_np = np.asarray(logits)
             for j, slot_idx, p in finished:
                 del lane.pending[slot_idx]
-                self._start_slot(lane, slot_idx, p.request, p.t_valid, p.next_rope, logits_np[j])
+                self._start_slot(
+                    lane, slot_idx, p.request, p.base + p.t_valid, p.next_rope,
+                    logits_np[j],
+                )
+        with self._stats_lock:
+            self._prefill_time += time.monotonic() - t0
+            self._prefill_tokens += new_tokens
 
     def _decode_once(self, lane: _Lane) -> None:
         tokens = np.full(lane.n_slots, self.tokenizer.pad_id, np.int32)
@@ -848,10 +1450,12 @@ class CaptionEngine:
         # write mask), so idle rows' write positions must be harmless.
         # Fully-free rows hold no valid data — position 0 is fine — but a
         # row mid-chunked-prefill holds real prompt K/V: point its write at
-        # p.progress, the exact cell the NEXT chunk overwrites anyway,
-        # so the pad-token garbage can never survive into attention reads.
+        # base + progress, a cell the NEXT chunk overwrites anyway (the
+        # shifted final chunk covers [t_valid - C, t_valid), which contains
+        # it), so the pad-token garbage can never survive into attention
+        # reads.
         for i, p in lane.pending.items():
-            positions[i] = p.progress
+            positions[i] = p.base + p.progress
         for i, slot in lane.slots.items():
             tokens[i] = slot.generated[-1]
             positions[i] = slot.position
@@ -866,9 +1470,10 @@ class CaptionEngine:
             jnp.asarray(rope_positions),
         )
         greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
-        self._decode_time += time.monotonic() - t0
-        self._decode_tokens += len(lane.slots)
-        self._decode_rows += lane.n_slots
+        with self._stats_lock:
+            self._decode_time += time.monotonic() - t0
+            self._decode_tokens += len(lane.slots)
+            self._decode_rows += lane.n_slots
         # the device argmax suffices only for pure-greedy rows with no
         # penalties and min_tokens already satisfied
         needs_logits = any(
@@ -944,6 +1549,16 @@ class CaptionEngine:
             if follow_up is not None:
                 if follow_up.owner is None:
                     follow_up.owner = req.owner
+                if (
+                    follow_up.frames is not None
+                    and follow_up.frames is req.frames
+                    and follow_up.vision_features is None
+                ):
+                    # refinement over the SAME frames array: hand the
+                    # already-encoded vision features to the follow-up so
+                    # the tower doesn't run twice per window
+                    follow_up.vision_features = req.vision_features
                 self.waiting.append(follow_up)
+                self._work_cv.notify_all()  # wake the prep thread
                 return  # result superseded by the refinement pass
         self.completed.append(result)

@@ -229,6 +229,7 @@ def runner_stats(runner: Any) -> dict:
     source: runner accounting plus this process's in-memory dispatch/flow
     aggregates. ``runner=None`` yields the aggregate-only skeleton."""
     from cosmos_curate_tpu.observability.stage_timer import (
+        caption_phase_summaries,
         dispatch_summaries,
         stage_flow_summaries,
     )
@@ -236,6 +237,7 @@ def runner_stats(runner: Any) -> dict:
     stats: dict[str, Any] = {
         "dispatch": dispatch_summaries(),
         "stage_flow": stage_flow_summaries(),
+        "caption_phases": caption_phase_summaries(),
         "stage_times": dict(getattr(runner, "stage_times", None) or {}),
     }
     wall = getattr(runner, "pipeline_wall_s", 0.0)
@@ -297,8 +299,8 @@ def load_node_stats(output_path: str) -> dict | None:
     except Exception:
         return None
     merged: dict[str, Any] = {
-        "dispatch": {}, "stage_flow": {}, "stage_times": {},
-        "stage_counts": {}, "dead_lettered": 0,
+        "dispatch": {}, "stage_flow": {}, "caption_phases": {},
+        "stage_times": {}, "stage_counts": {}, "dead_lettered": 0,
     }
     dlq_dirs: list[str] = []
     overlaps: list[float] = []
@@ -312,7 +314,7 @@ def load_node_stats(output_path: str) -> dict | None:
             continue
         found = True
         rank = stats.get("node_rank", "?")
-        for key in ("dispatch", "stage_flow"):
+        for key in ("dispatch", "stage_flow", "caption_phases"):
             for name, agg in (stats.get(key) or {}).items():
                 merged[key][f"n{rank}/{name}"] = agg
         for name, s in (stats.get("stage_times") or {}).items():
@@ -377,6 +379,7 @@ def build_run_report(
     stats = runner_stats(runner)
     report["dispatch"] = stats["dispatch"]
     report["stage_flow"] = stats["stage_flow"]
+    report["caption_phases"] = stats["caption_phases"]
     # precedence: live runner accounting > prior/sidecar accounting (it
     # includes setup time spans don't book to the stage) > span-derived
     report["stage_times"] = (
@@ -399,7 +402,7 @@ def build_run_report(
         # stage_times/wall_s are handled above (they have span-derived
         # fallbacks that would always win this not-set check)
         for key in (
-            "dispatch", "stage_flow", "stage_counts",
+            "dispatch", "stage_flow", "caption_phases", "stage_counts",
             "dead_lettered", "dlq_run_dir",
         ):
             if not report.get(key) and prior.get(key):
@@ -496,6 +499,17 @@ def render_report(report: dict) -> str:
                 f"  {name:<40} busy {agg.get('busy_s', 0.0):8.2f}s  "
                 f"busy_frac_mean {agg.get('busy_frac_mean', 0.0):.3f}  "
                 f"queue_peak {agg.get('queue_depth_peak', 0)}"
+            )
+    caption = report.get("caption_phases") or {}
+    if caption:
+        lines.append("caption engine phases:")
+        for name, agg in sorted(caption.items()):
+            lines.append(
+                f"  {name:<40} prep {agg.get('prep_s', 0.0):7.2f}s  "
+                f"prefill {agg.get('prefill_s', 0.0):7.2f}s  "
+                f"decode {agg.get('decode_s', 0.0):7.2f}s  "
+                f"idle_frac {agg.get('idle_frac', 0.0):.3f}  "
+                f"prefix_hits {agg.get('prefix_cache_hits', 0)}"
             )
     dead = report.get("dead_lettered", 0)
     if dead:

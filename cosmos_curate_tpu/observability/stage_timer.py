@@ -310,6 +310,75 @@ def reset_stage_flow() -> None:
         _FLOW.clear()
 
 
+# ---------------------------------------------------------------------------
+# Caption-engine phase aggregates (pipelines/video/stages/captioning.py et
+# al.): per-stage prep / vision-encode / prefill / decode / idle seconds per
+# engine drive, plus shared-prefix cache traffic. Bounded per-stage
+# aggregates; the caption benchmark and flight recorder read them to
+# attribute the caption critical path.
+_CAPTION_LOCK = threading.Lock()
+_CAPTION: dict[str, dict] = {}
+
+_CAPTION_PHASE_KEYS = (
+    "prep_s", "vision_encode_s", "prefill_s", "decode_s", "idle_s", "wall_s",
+)
+_CAPTION_COUNT_KEYS = (
+    "requests", "prefill_tokens", "prefix_cache_hits", "prefix_cache_misses",
+    "prefix_tokens_saved", "vision_encodes", "vision_reuses",
+)
+
+
+def _new_caption() -> dict:
+    agg = {k: 0.0 for k in _CAPTION_PHASE_KEYS}
+    agg.update({k: 0 for k in _CAPTION_COUNT_KEYS})
+    agg["drives"] = 0
+    return agg
+
+
+def record_caption_phases(name: str, phases: dict) -> None:
+    """Fold one engine drive's phase/cache deltas into the stage's
+    aggregate and forward them to the engine's metrics exporter (no-op when
+    absent). ``idle_s`` is wall minus device phases (prefill + decode):
+    the engine-stall signal the prep/decode overlap exists to shrink."""
+    with _CAPTION_LOCK:
+        agg = _CAPTION.setdefault(name, _new_caption())
+        agg["drives"] += 1
+        for k in _CAPTION_PHASE_KEYS:
+            agg[k] += float(phases.get(k, 0.0))
+        for k in _CAPTION_COUNT_KEYS:
+            agg[k] += int(phases.get(k, 0))
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_caption_phases(name, phases)
+    except Exception:  # metrics must never take down the caption path
+        pass
+
+
+def caption_phase_summaries() -> dict[str, dict]:
+    """name -> caption phase aggregate. ``idle_frac`` is engine idle over
+    wall for the stage's drives: ≈0 means the engine was prefilling or
+    decoding for the whole window (prep fully hidden); large values mean
+    the stage starved the engine between batches."""
+    out: dict[str, dict] = {}
+    with _CAPTION_LOCK:
+        items = {k: dict(v) for k, v in _CAPTION.items()}
+    for name, agg in items.items():
+        wall = agg["wall_s"]
+        out[name] = {
+            **{k: round(agg[k], 4) for k in _CAPTION_PHASE_KEYS},
+            **{k: agg[k] for k in _CAPTION_COUNT_KEYS},
+            "drives": agg["drives"],
+            "idle_frac": round(agg["idle_s"] / wall, 4) if wall > 0 else 0.0,
+        }
+    return out
+
+
+def reset_caption_phases() -> None:
+    with _CAPTION_LOCK:
+        _CAPTION.clear()
+
+
 def dispatch_summaries() -> dict[str, dict]:
     """name -> aggregate per-dispatch timings, including aggregates merged
     in from worker dump files. ``gap_frac`` is device idle over total

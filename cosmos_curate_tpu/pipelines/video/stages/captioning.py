@@ -12,6 +12,8 @@ pool) and streams every window of every clip through continuous batching.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from cosmos_curate_tpu.core.model import ModelInterface
@@ -171,7 +173,10 @@ class _CaptionVLM(ModelInterface):
     ) -> tuple[list[int], list[int]]:
         """(prefix_ids, prompt_ids) for a CaptionRequest in this flavor's
         prompt format: the checkpoint's chat template for hf_chat flavors
-        (vision embeddings splice between the two), a raw encode otherwise.
+        (vision embeddings splice between the two); repo-native flavors put
+        the instruction text in the PREFIX (before the vision block) — the
+        cache-friendly layout: the engine's shared-prefix KV cache prefills
+        it once per (flavor, prompt_variant) instead of once per window.
         Memoized — stages call this per window/clip/event with identical
         text."""
         if has_vision and self.text_only:
@@ -194,7 +199,11 @@ class _CaptionVLM(ModelInterface):
                     specials=self.specials or None,
                 )
             else:
-                hit = [], self.tokenizer.encode(user_text)
+                # all text before the vision block: for a text-only request
+                # the token sequence is identical either way, and for a
+                # vision request the shared instruction prefix becomes
+                # positionally cacheable across windows
+                hit = self.tokenizer.encode(user_text), []
             if len(self._prompt_cache) < 4096:  # bound memory on unique texts
                 self._prompt_cache[key] = hit
         # copies: requests must not alias the cached lists
@@ -215,6 +224,9 @@ class _CaptionVLM(ModelInterface):
                 max_batch=self.max_batch,
                 tokenizer=tokenizer,
                 kv_lanes=self.kv_lanes,
+                # production engines prep in the background so vision
+                # encoding of window N+1 overlaps decode of window N
+                async_prep=True,
             )
             engine.setup()
 
@@ -268,6 +280,7 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         max_new_tokens: int = 128,
         refine: bool = False,
         model_flavor: str | None = None,
+        stage_batch_size: int = 32,
     ) -> None:
         self.prompt_variant = prompt_variant
         self.prompt_text = get_caption_prompt(prompt_variant)
@@ -279,6 +292,17 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         if self.max_new_tokens >= self._model.cfg.max_seq // 2:
             self.max_new_tokens = self._model.cfg.max_seq // 2
         self._refined_ids: set[str] = set()  # stage-2 bookkeeping (not user data)
+        # Deep batches feed the continuous batch: with the runner default of
+        # one task per process_data call, every window decoded SOLO — the
+        # engine never saw a full slot batch and pipeline tok/s sat at ~30%
+        # of standalone. Admission still paces itself (waiting/ready queues
+        # + background prep), so a deep batch costs queue memory, not stalls.
+        self._stage_batch_size = max(1, stage_batch_size)
+        # loop-invariant per-request pieces, resolved once per stage (the
+        # prompt encode is also memoized model-side; this skips even the
+        # memo lookup and the SamplingConfig rebuild per window)
+        self._encoded_prompt: tuple[list[int], list[int]] | None = None
+        self._sampling = SamplingConfig(max_new_tokens=self.max_new_tokens)
 
     @property
     def model(self) -> ModelInterface:
@@ -288,40 +312,106 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
     def resources(self) -> Resources:
         return Resources(cpus=1.0, entire_tpu_host=True)
 
+    @property
+    def batch_size(self) -> int:
+        return self._stage_batch_size
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        from cosmos_curate_tpu.observability import stage_timer
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
         engine = self._model.engine
         assert engine is not None, "setup() not called"
+        t_start = time.monotonic()
+        phases0 = engine.phase_seconds
+        stats0 = self._engine_counts(engine)
         windows: dict[str, Window] = {}
-        for t_i, task in enumerate(tasks):
-            for clip in task.video.clips:
-                for w_i, win in enumerate(clip.windows):
-                    if win.frames is None:
-                        continue
-                    rid = f"{clip.uuid}-{w_i}"
-                    windows[rid] = win
-                    engine.add_request(self._make_request(rid, win))
+        with traced_span("caption.submit", stage=self.name):
+            for task in tasks:
+                for clip in task.video.clips:
+                    for w_i, win in enumerate(clip.windows):
+                        if win.frames is None:
+                            continue
+                        rid = f"{clip.uuid}-{w_i}"
+                        windows[rid] = win
+                        # non-blocking: the engine preps (vision encode +
+                        # embedding) in its background thread while the
+                        # run_until_complete loop below decodes — prep of
+                        # window N+1 overlaps decode of window N
+                        engine.add_request(self._make_request(rid, win))
         if not windows:
             return tasks
-        results = engine.run_until_complete()
+        with traced_span("caption.engine", stage=self.name) as span:
+            results = engine.run_until_complete()
+            wall = time.monotonic() - t_start
+            phases = self._phase_delta(engine, phases0, stats0, wall)
+            phases["requests"] = len(results)
+            for k, v in phases.items():
+                span.set_attribute(f"caption.{k}", round(v, 4) if isinstance(v, float) else v)
+        stage_timer.record_caption_phases(self.name, phases)
         for res in results:
             win = windows.get(res.request_id)
             if win is None:
                 continue
             win.caption[self.prompt_variant] = res.text
         logger.info(
-            "captioned %d windows at %.1f output tok/s",
+            "captioned %d windows at %.1f output tok/s "
+            "(prefill %.2fs decode %.2fs idle %.2fs; prefix hits %d, "
+            "%d prefill tokens saved)",
             len(results),
             engine.tokens_per_second,
+            phases["prefill_s"],
+            phases["decode_s"],
+            phases["idle_s"],
+            phases["prefix_cache_hits"],
+            phases["prefix_tokens_saved"],
         )
         for task in tasks:
             task.stage_perf["caption_tokens_per_s"] = engine.tokens_per_second
+            task.stage_perf["caption_prefix_cache_hits"] = phases["prefix_cache_hits"]
+            task.stage_perf["caption_engine_idle_s"] = round(phases["idle_s"], 4)
         return tasks
 
+    @staticmethod
+    def _engine_counts(engine: CaptionEngine) -> dict:
+        return {
+            "requests": 0,
+            "prefill_tokens": engine.prefill_tokens,
+            "prefix_cache_hits": engine.prefix_cache_hits,
+            "prefix_cache_misses": engine.prefix_cache_misses,
+            "prefix_tokens_saved": engine.prefix_tokens_saved,
+            "vision_encodes": engine.vision_encodes,
+            "vision_reuses": engine.vision_reuses,
+        }
+
+    def _phase_delta(
+        self, engine: CaptionEngine, phases0: dict, stats0: dict, wall: float
+    ) -> dict:
+        """Per-phase/cache deltas over this drive. Counters are engine-wide
+        — under a shared engine another stage's concurrent drive bleeds in,
+        so treat per-stage attribution as approximate there. ``idle_s`` is
+        wall minus device phases: the engine-stall time the overlap rework
+        exists to shrink."""
+        phases = {
+            k: engine.phase_seconds[k] - phases0[k] for k in engine.phase_seconds
+        }
+        now = self._engine_counts(engine)
+        counts = {k: now[k] - stats0[k] for k in now}
+        busy = phases["prefill_s"] + phases["decode_s"]
+        return {
+            **phases,
+            **counts,
+            "wall_s": wall,
+            "idle_s": max(0.0, wall - busy),
+        }
+
     def _make_request(self, rid: str, win: Window) -> CaptionRequest:
-        prefix_ids, prompt_ids = self._model.encode_prompt(
-            self.prompt_text, has_vision=True
-        )
-        sampling = SamplingConfig(max_new_tokens=self.max_new_tokens)
+        if self._encoded_prompt is None:
+            self._encoded_prompt = self._model.encode_prompt(
+                self.prompt_text, has_vision=True
+            )
+        prefix_ids, prompt_ids = self._encoded_prompt
+        sampling = self._sampling
         on_complete = None
         if self.refine:
             def on_complete(text: str, _rid=rid, _win=win) -> CaptionRequest | None:
@@ -339,11 +429,15 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                     frame_fps=_win.frame_fps,
                     sampling=sampling,
                     on_complete=on_complete,
+                    # the stage-2 prefix bakes in the window's own caption —
+                    # unique per window, so caching it would thrash the
+                    # shared-prefix LRU without ever hitting
+                    share_prefix=False,
                 )
         return CaptionRequest(
             request_id=rid,
-            prefix_ids=prefix_ids,
-            prompt_ids=prompt_ids,
+            prefix_ids=list(prefix_ids),
+            prompt_ids=list(prompt_ids),
             frames=win.frames,
             frame_fps=win.frame_fps,
             sampling=sampling,

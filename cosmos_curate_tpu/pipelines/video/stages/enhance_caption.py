@@ -44,6 +44,12 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
     def resources(self) -> Resources:
         return Resources(cpus=1.0, entire_tpu_host=True)
 
+    @property
+    def batch_size(self) -> int:
+        # deep batches keep the engine's continuous batch full across
+        # clips (one task per call = every rewrite decoded solo)
+        return 16
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         engine = self._model.engine
         assert engine is not None, "setup() not called"

@@ -69,6 +69,13 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
     def resources(self) -> Resources:
         return Resources(cpus=1.0, entire_tpu_host=True)
 
+    @property
+    def batch_size(self) -> int:
+        # deep batches keep the engine's continuous batch full across
+        # clips; the shared filter-question prefix then hits the engine's
+        # prefix KV cache on every request after the first
+        return 16
+
     def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
         engine = self._model.engine
         assert engine is not None, "setup() not called"

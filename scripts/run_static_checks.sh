@@ -75,4 +75,31 @@ render_report(data)  # must not raise
 print(f"tracing smoke ok: {data['span_count']} spans, one connected trace")
 PY
 
+echo "== caption-bench smoke: tiny engine, 2 requests -> efficiency + prefix-cache hits =="
+# Tiny end-to-end caption serving check: the benchmark must compute
+# pipeline efficiency AND the shared-prefix KV cache must actually fire
+# (every request after the warmup's first shares the instruction prefix).
+JAX_PLATFORMS=cpu python - <<'PY'
+import json, subprocess, sys
+
+proc = subprocess.run(
+    [sys.executable, "-m", "benchmarks.caption_benchmark",
+     "--config", "tiny", "--requests", "2", "--max-new", "8",
+     "--batch", "2", "--frames", "2", "--uniform"],
+    capture_output=True, text=True, timeout=1200,
+)
+assert proc.returncode == 0, proc.stderr[-2000:]
+rec = json.loads(proc.stdout.strip().splitlines()[-1])
+assert "caption_pipeline_efficiency" in rec, rec
+assert rec["caption_pipeline_efficiency"] > 0, rec
+assert rec["prefix_cache_hits"] > 0, rec
+assert rec["prefill_tokens"] > 0 and rec["prefix_tokens_saved"] > 0, rec
+assert "caption_phases" in rec and rec["caption_phases"]["decode_s"] > 0, rec
+print(
+    f"caption smoke ok: efficiency {rec['caption_pipeline_efficiency']}, "
+    f"{rec['prefix_cache_hits']} prefix hits, "
+    f"{rec['prefix_tokens_saved']} prefill tokens saved"
+)
+PY
+
 echo "static checks passed"

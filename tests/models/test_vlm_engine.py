@@ -250,14 +250,25 @@ class TestChunkedPrefill:
         eng.setup()
         return eng
 
-    def test_long_prompt_completes_via_chunks(self, cengine):
-        tok = ByteTokenizer()
-        long_text = "a " * 40  # ~80 prompt tokens -> ~10 chunks of 8
-        cengine.add_request(_req("c0", text=long_text, max_new=4))
+    def test_long_prompt_chunked_only_while_decoding(self, cengine):
+        # idle engine: nothing is decoding, so chunking would only slow the
+        # prompt down — it prefills as one bucketed program (admission is
+        # tuned against decode occupancy)
+        cengine.add_request(_req("c0", text="a " * 40, max_new=4))
         cengine.step()
-        assert cengine.pending, "long prompt should be admitted as chunked"
+        assert not cengine.pending, "idle engine should skip the chunk drip"
         results = cengine.run_until_complete()
         assert [r.request_id for r in results] == ["c0"]
+        # busy engine: an in-flight decode forces the chunked path so the
+        # long prefill cannot stall it for more than a chunk's latency
+        cengine.add_request(_req("s0", text="hi", max_new=30))
+        cengine.step()
+        assert cengine.slots and not cengine.pending
+        cengine.add_request(_req("c1", text="b " * 40, max_new=4))
+        cengine.step()
+        assert cengine.pending, "long prompt should chunk while decode is active"
+        results = cengine.run_until_complete()
+        assert sorted(r.request_id for r in results) == ["c1", "s0"]
 
     def test_decode_progresses_during_long_prefill(self, cengine):
         tok = ByteTokenizer()

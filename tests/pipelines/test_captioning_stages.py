@@ -67,6 +67,51 @@ def test_tokens_per_second_recorded(captioned_output):
     assert all(t.stage_perf.get("caption_tokens_per_s", 0) > 0 for t in done)
 
 
+def test_phase_breakdown_recorded(captioned_output):
+    """The caption stage stamps the engine phase/prefix stats per task and
+    folds them into the stage_timer caption aggregates (the flight
+    recorder's caption_phases section reads the same source)."""
+    from cosmos_curate_tpu.observability.stage_timer import caption_phase_summaries
+
+    _, done = captioned_output
+    for t in done:
+        assert "caption_prefix_cache_hits" in t.stage_perf
+        assert "caption_engine_idle_s" in t.stage_perf
+    agg = caption_phase_summaries().get("CaptionStage")
+    assert agg is not None and agg["drives"] >= 1
+    assert agg["decode_s"] > 0 and agg["wall_s"] > 0
+    # every window after the first hits the shared instruction prefix
+    assert agg["prefix_cache_hits"] >= 1
+
+
+def test_prompt_encoded_once_across_windows(monkeypatch):
+    """Satellite: _make_request must not re-tokenize the identical prompt
+    per window — the encode runs once per stage, then requests copy the
+    cached ids."""
+    from cosmos_curate_tpu.data.model import Window
+
+    stage = CaptionStage(cfg=VLM_TINY_TEST, max_batch=2, max_new_tokens=4)
+    calls = {"n": 0}
+    real = stage._model.encode_prompt
+
+    def counting(text, *, has_vision):
+        calls["n"] += 1
+        return real(text, has_vision=has_vision)
+
+    monkeypatch.setattr(stage._model, "encode_prompt", counting)
+    import numpy as np
+
+    reqs = []
+    for i in range(5):
+        win = Window(start_frame=0, end_frame=8)
+        win.frames = np.zeros((2, 32, 32, 3), np.uint8)
+        reqs.append(stage._make_request(f"w{i}", win))
+    assert calls["n"] == 1
+    # requests must not alias the cached id lists
+    assert reqs[0].prefix_ids == reqs[1].prefix_ids
+    assert reqs[0].prefix_ids is not reqs[1].prefix_ids
+
+
 def test_flavored_stage_runs_laned_with_high_utilization(
     tmp_path_factory, monkeypatch
 ):
@@ -107,6 +152,9 @@ def test_flavored_stage_runs_laned_with_high_utilization(
             for win in clip.windows:
                 assert "default" in win.caption
     # admission packs active lanes: the decode dead-work fraction stays
-    # bounded (all 4 concurrent windows share lanes instead of spreading)
-    assert engine.decode_slot_utilization >= 0.4, engine.decode_slot_utilization
+    # bounded. With prep/decode overlap the engine starts decoding window 1
+    # while later windows are still vision-encoding (prep-bound on CPU), so
+    # early steps run partially-filled batches — dead rows traded for wall
+    # time. Lane-packing itself is asserted by TestUtilizationAwareRouting.
+    assert engine.decode_slot_utilization >= 0.15, engine.decode_slot_utilization
     _ENGINES.clear()
