@@ -38,14 +38,48 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
-NUM_VIDEOS = int(os.environ.get("BENCH_NUM_VIDEOS", "8"))
+NUM_VIDEOS = int(os.environ.get("BENCH_NUM_VIDEOS", "64"))
 SCENE_FRAMES = 48
 NUM_SCENES = 2  # 4 s per video at 24 fps
 STRIDE_S = 1.0
+# 720p: flat 320x240 color cards made decode/transcode look free — real
+# corpora make the CPU stages earn their allocation (ROADMAP item #2)
+FRAME_W, FRAME_H = 1280, 720
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _scene_frames(rng, vid_idx: int, scene_idx: int):
+    """One scene's frames: a moving diagonal gradient (global motion a
+    codec cannot collapse to a still) over a per-scene noise texture
+    (spatial detail that survives resize), plus a tracked high-contrast
+    block. Vectorized per frame; deterministic per (video, scene)."""
+    import cv2
+    import numpy as np
+
+    # per-scene palette and motion parameters from the seeded rng only —
+    # regenerating the corpus yields byte-comparable content per video
+    c0 = rng.integers(0, 255, 3).astype(np.float32)
+    c1 = rng.integers(0, 255, 3).astype(np.float32)
+    angle = rng.uniform(0, 2 * np.pi)
+    speed = rng.uniform(2.0, 8.0)  # gradient pixels/frame
+    # quarter-res noise field upscaled: texture without a 720p RNG bill
+    noise = rng.integers(0, 60, (FRAME_H // 4, FRAME_W // 4, 3), dtype=np.uint8)
+    noise = cv2.resize(noise, (FRAME_W, FRAME_H), interpolation=cv2.INTER_LINEAR)
+    yy, xx = np.mgrid[0:FRAME_H, 0:FRAME_W]
+    proj = (np.cos(angle) * xx + np.sin(angle) * yy).astype(np.float32)
+    span = float(proj.max() - proj.min()) or 1.0
+    bx = int(rng.integers(0, FRAME_W - 160))
+    bvx = int(rng.integers(3, 11)) * (1 if scene_idx % 2 == 0 else -1)
+    for f in range(SCENE_FRAMES):
+        phase = ((proj + f * speed) % span) / span
+        frame = (c0[None, None] * (1 - phase[..., None]) + c1[None, None] * phase[..., None])
+        frame = np.clip(frame + noise.astype(np.float32) - 30.0, 0, 255).astype(np.uint8)
+        x = (bx + f * bvx) % (FRAME_W - 160)
+        frame[280:440, x : x + 160] = (255 - c0).astype(np.uint8)
+        yield frame
 
 
 def make_corpus(root: Path) -> Path:
@@ -54,16 +88,16 @@ def make_corpus(root: Path) -> Path:
 
     vids = root / "videos"
     vids.mkdir(parents=True, exist_ok=True)
-    rng = np.random.default_rng(0)
     for i in range(NUM_VIDEOS):
+        # one rng per video, seeded by index: adding videos never reshuffles
+        # earlier ones, so BENCH rows stay comparable across corpus sizes
+        rng = np.random.default_rng(1000 + i)
         path = vids / f"bench_{i}.mp4"
-        w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (320, 240))
+        w = cv2.VideoWriter(
+            str(path), cv2.VideoWriter_fourcc(*"mp4v"), 24.0, (FRAME_W, FRAME_H)
+        )
         for s in range(NUM_SCENES):
-            base = rng.integers(0, 255, 3)
-            for f in range(SCENE_FRAMES):
-                frame = np.full((240, 320, 3), base, np.uint8)
-                x = (f * 7 + i * 13) % 280
-                frame[60:120, x : x + 40] = 255 - base
+            for frame in _scene_frames(rng, i, s):
                 w.write(frame)
         w.release()
     return vids

@@ -124,6 +124,17 @@ class Stage(Generic[T, V], abc.ABC):
         return 1
 
     @property
+    def node_affinity(self) -> str | None:
+        """Cross-host placement hint for the per-node planner
+        (engine/autoscaler.plan_node_allocation). ``None`` (default) lets
+        the planner fan workers across any node with CPU budget;
+        ``"driver"`` pins every worker to the driver node — for stages
+        whose side effects must land driver-local (e.g. a writer flushing
+        to a driver-mounted path). TPU stages are implicitly driver-pinned
+        (chips belong to the engine process) and need no hint."""
+        return None
+
+    @property
     def thread_safe(self) -> bool:
         """True when concurrent ``process_data`` calls on DISJOINT batches
         are safe — no cross-call mutable state on ``self`` (per-task mutation

@@ -12,6 +12,11 @@ same execution semantics from scratch:
 - dynamic chunking (a stage may emit any number of tasks)
 - STREAMING (all stages live) and BATCH (stage-by-stage) modes
 - worker recycling, per-stage retries, prometheus `pipeline_*` gauges
+- cross-host: a per-node water-filling planner places CPU stages across
+  connected node agents (remote_agent.py), a stage-affinity router keeps
+  stage k's outputs on stage k+1's node, and push-ahead prefetch moves
+  the remaining inter-node bytes behind compute (docs/PERFORMANCE.md,
+  "Cross-host scheduling")
 
 Device ownership (TPU-first): chips belong to ONE process per host — the
 engine process — so stages with TPU resources execute on an in-process

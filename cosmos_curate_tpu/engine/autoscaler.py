@@ -16,11 +16,22 @@ wins — and a drained stage (empty queue, known rate) stops receiving extra
 workers beyond its minimum, so budget flows to starved stages after a
 throughput shift (reference ARCHITECTURE.md:83-93 solves the same balanced-
 throughput-under-backpressure problem).
+
+Cross-host: ``plan_node_allocation`` lifts the same water-fill to **per-node
+budgets** (one ``NodeBudget`` per connected agent plus the driver). The
+per-stage totals come from the flat solver over the aggregate budget — so a
+single-node plan is bit-identical to ``plan_allocation`` — and a placement
+pass then pins device stages to TPU-bearing nodes, honors explicit
+``Stage.node_affinity`` hints, and fans CPU workers across nodes weighted by
+each node's measured per-worker throughput for that stage, with a
+co-location bias toward the previous stage's node so inter-stage bytes stay
+on-node (the T5X data/model-axis split: data-parallel CPU pools scale out
+across hosts, the model mesh stays whole on its host).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from cosmos_curate_tpu.core.stage import StageSpec
 
@@ -31,12 +42,43 @@ class StageScaleState:
     current_workers: int
     throughput_per_worker: float | None  # batches/s; None = unknown yet
     queued: int
+    # node_id -> measured per-worker batches/s ON that node. Empty when the
+    # run is single-node or no per-node samples landed yet; the per-node
+    # placement pass biases CPU fan-out toward faster nodes with it.
+    node_rates: dict[str, float] = field(default_factory=dict)
 
 
 @dataclass(frozen=True)
 class Budget:
     cpus: float
     tpus: float
+
+
+@dataclass(frozen=True)
+class NodeBudget:
+    """One schedulable host: the driver (``node_id=""``, matching the
+    runner's worker-node convention) or a connected agent from
+    ``engine/remote_agent.py``."""
+
+    node_id: str
+    cpus: float
+    tpu_chips: int = 0
+    memory_gb: float = 0.0
+
+
+@dataclass
+class NodeAllocation:
+    """``plan_node_allocation`` output.
+
+    ``targets[i]`` is stage i's total worker count (identical to
+    ``plan_allocation`` over the aggregate budget); ``per_node[i]`` splits
+    it across nodes; ``preferred_node[i]`` is the node holding the
+    plurality of stage i's workers — the router's affinity key (stage k's
+    outputs should land where stage k+1's workers live)."""
+
+    targets: list[int]
+    per_node: list[dict[str, int]]
+    preferred_node: list[str]
 
 
 def discover_tpu_chips(cfg, stage_specs: list[StageSpec]) -> int:
@@ -132,3 +174,115 @@ def plan_allocation(stages: list[StageScaleState], budget: Budget) -> list[int]:
             break
         grant(best)
     return alloc
+
+
+def plan_node_allocation(
+    stages: list[StageScaleState], nodes: list[NodeBudget]
+) -> NodeAllocation:
+    """Per-node × per-stage worker allocation.
+
+    Totals come from ``plan_allocation`` over the aggregate budget (so one
+    node reproduces today's plan exactly); placement then assigns each
+    worker to a node:
+
+    - TPU stages go to TPU-bearing nodes only (in this engine that is the
+      driver — chips belong to the engine process, pool.py invariant).
+    - ``Stage.node_affinity`` pins a stage outright (``"driver"`` → the
+      driver node).
+    - CPU stages water-fill across nodes: each grant goes to the fitting
+      node with the best (measured stage rate, co-location with the
+      previous stage's preferred node, free CPUs) score — so a
+      decode-heavy CPU node systematically feeds a TPU embed node instead
+      of competing with it for driver cores.
+    """
+    if not nodes:
+        nodes = [NodeBudget("", cpus=1.0)]
+    budget = Budget(
+        cpus=sum(n.cpus for n in nodes),
+        tpus=float(sum(n.tpu_chips for n in nodes)),
+    )
+    targets = plan_allocation(stages, budget)
+    cpu_left = {n.node_id: n.cpus for n in nodes}
+    chips_left = {n.node_id: float(n.tpu_chips) for n in nodes}
+    # memory budget participates in the CPU fit check only where BOTH the
+    # node declares capacity and the stage declares demand (0 = unknown,
+    # fit on CPUs alone — the pre-memory behavior)
+    mem_left = {n.node_id: n.memory_gb for n in nodes}
+    driver_id = nodes[0].node_id  # runner convention: nodes[0] is the driver
+    per_node: list[dict[str, int]] = []
+    preferred: list[str] = []
+    prev_pref = driver_id
+    for i, (st, want) in enumerate(zip(stages, targets)):
+        res = st.spec.stage.resources
+        affinity = getattr(st.spec.stage, "node_affinity", None)
+        counts: dict[str, int] = {}
+        for _ in range(want):
+            if affinity == "driver":
+                chosen = driver_id
+            elif res.uses_tpu:
+                # device stages pin to TPU-bearing nodes; with none visible
+                # (CPU-fallback dev boxes) the driver hosts the in-process
+                # worker exactly as the flat path does
+                cands = [n.node_id for n in nodes if n.tpu_chips > 0] or [driver_id]
+                chosen = max(cands, key=lambda nid: chips_left[nid])
+                chips_left[chosen] -= (
+                    res.tpus if not res.entire_tpu_host else chips_left[chosen]
+                )
+            else:
+                ccost = res.cpus if res.cpus > 0 else 0.25
+                chosen = _best_cpu_node(
+                    st, nodes, cpu_left, ccost, prev_pref,
+                    mem_left=mem_left, mem_cost=res.memory_gb,
+                )
+            counts[chosen] = counts.get(chosen, 0) + 1
+            cpu_left[chosen] -= res.cpus if res.cpus > 0 else 0.25
+            mem_left[chosen] -= res.memory_gb
+        per_node.append(counts)
+        # plurality node; deterministic tie-break by node order, so the
+        # router's affinity key is stable across replans with equal splits
+        order = {n.node_id: j for j, n in enumerate(nodes)}
+        pref = (
+            max(counts, key=lambda nid: (counts[nid], -order.get(nid, 0)))
+            if counts
+            else prev_pref
+        )
+        preferred.append(pref)
+        prev_pref = pref
+    return NodeAllocation(targets=targets, per_node=per_node, preferred_node=preferred)
+
+
+def _best_cpu_node(
+    st: StageScaleState,
+    nodes: list[NodeBudget],
+    cpu_left: dict[str, float],
+    ccost: float,
+    prev_pref: str,
+    *,
+    mem_left: dict[str, float] | None = None,
+    mem_cost: float = 0.0,
+) -> str:
+    """One CPU-worker grant: fitting nodes first, then measured per-worker
+    rate on that node (a node that decodes 2× faster per worker earns the
+    worker), then co-location with the upstream stage's node (inter-stage
+    bytes stay local), then free CPUs (balance). A node with no samples
+    yet ranks at the MEAN measured rate — neutral exploration — so an
+    unmeasured late joiner neither outranks every measured-but-slow node
+    nor starves, and the co-location bias stays decisive between
+    rate-equivalent nodes. Nothing fits → least oversubscribed node,
+    mirroring the flat planner's unconditional min-viable grant."""
+    measured = [r for r in st.node_rates.values() if r > 0]
+    neutral = sum(measured) / len(measured) if measured else 1.0
+
+    def key(n: NodeBudget):
+        fits = cpu_left[n.node_id] + 1e-9 >= ccost
+        if fits and mem_cost > 0 and n.memory_gb > 0 and mem_left is not None:
+            fits = mem_left[n.node_id] + 1e-9 >= mem_cost
+        rate = st.node_rates.get(n.node_id)
+        return (
+            fits,
+            rate if rate is not None else neutral,
+            1 if n.node_id == prev_pref else 0,
+            cpu_left[n.node_id],
+        )
+
+    return max(nodes, key=key).node_id

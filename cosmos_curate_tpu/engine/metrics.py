@@ -111,6 +111,47 @@ class EngineMetrics:
             "caption_prefix_tokens_saved_total",
             "prefill tokens skipped via shared-prefix hits", labels,
         )
+        # Cross-host object-plane signal (engine/object_channel.py via
+        # stage_timer.record_object_plane): bytes moved between nodes, how
+        # long consumers waited for them, and whether push-ahead prefetch
+        # hid the transfer. Healthy cross-host pipelining reads as
+        # prefetch hits ≈ transfers and wait_seconds{kind="prefetch_hit"}
+        # ≈ 0 while bytes_total keeps climbing — transfers overlap compute
+        # instead of serializing against it.
+        node_labels = ["node"]
+        self.object_plane_transfers = Counter(
+            "pipeline_object_plane_transfers_total",
+            "cross-node segment transfers", node_labels + ["kind"],
+        )
+        self.object_plane_bytes = Counter(
+            "pipeline_object_plane_bytes_total",
+            "cross-node bytes moved", node_labels + ["kind"],
+        )
+        self.object_plane_wait = Counter(
+            "pipeline_object_plane_wait_seconds_total",
+            "seconds consumers waited on object-plane transfers",
+            node_labels + ["kind"],
+        )
+        self.object_plane_prefetch_hits = Counter(
+            "pipeline_object_plane_prefetch_hits_total",
+            "batch inputs already local when demanded (push-ahead worked)",
+            node_labels,
+        )
+        self.object_plane_prefetch_misses = Counter(
+            "pipeline_object_plane_prefetch_misses_total",
+            "batch inputs demand-fetched (no prefetch landed first)",
+            node_labels,
+        )
+        # Per-node flow (engine/runner.py metrics tick): workers placed on
+        # and CPU units used per connected node — the per-node counterpart
+        # of pipeline_actor_count, so a merged dashboard shows which host
+        # is starved instead of one flat pool number.
+        self.node_workers = Gauge(
+            "pipeline_node_workers", "stage workers placed per node", node_labels
+        )
+        self.node_cpus_used = Gauge(
+            "pipeline_node_cpus_used", "CPU units in use per node", node_labels
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -185,6 +226,40 @@ class EngineMetrics:
         self.caption_prefix_saved.labels(stage).inc(
             max(0, int(phases.get("prefix_tokens_saved", 0)))
         )
+
+    def observe_object_plane(self, node: str, deltas: dict) -> None:
+        """Fold one object-plane delta set (stage_timer.OBJECT_PLANE_KEYS
+        schema) into the counters under ``node``."""
+        if not self.enabled:
+            return
+        for kind, (n_key, b_key, w_key) in {
+            "fetch": ("fetches", "fetch_bytes", "fetch_wait_s"),
+            "prefetch": ("prefetches", "prefetch_bytes", "prefetch_transfer_s"),
+            "store_read": ("store_reads", "store_read_bytes", "store_read_wait_s"),
+        }.items():
+            self.object_plane_transfers.labels(node, kind).inc(
+                max(0.0, float(deltas.get(n_key, 0)))
+            )
+            self.object_plane_bytes.labels(node, kind).inc(
+                max(0.0, float(deltas.get(b_key, 0)))
+            )
+            self.object_plane_wait.labels(node, kind).inc(
+                max(0.0, float(deltas.get(w_key, 0.0)))
+            )
+        self.object_plane_wait.labels(node, "prefetch_hit").inc(
+            max(0.0, float(deltas.get("prefetch_hit_wait_s", 0.0)))
+        )
+        self.object_plane_prefetch_hits.labels(node).inc(
+            max(0.0, float(deltas.get("prefetch_hits", 0)))
+        )
+        self.object_plane_prefetch_misses.labels(node).inc(
+            max(0.0, float(deltas.get("prefetch_misses", 0)))
+        )
+
+    def set_node_state(self, node: str, workers: int, cpus_used: float) -> None:
+        if self.enabled:
+            self.node_workers.labels(node).set(workers)
+            self.node_cpus_used.labels(node).set(cpus_used)
 
     def set_overlap_frac(self, frac: float) -> None:
         if self.enabled:

@@ -70,8 +70,9 @@ class BasePool:
         # onto (the runner sets it per stage); '' = tracing off
         self.trace_context: str = ""
         self._next_id = 0
-        # recent (finish_time, process_time_s) samples for the autoscaler
-        self.samples: list[tuple[float, float]] = []
+        # recent (finish_time, process_time_s, node_id) samples for the
+        # autoscaler; node_id '' = locally placed worker (driver node)
+        self.samples: list[tuple[float, float, str]] = []
         # workers told to shut down, awaiting reap (never blocks the loop)
         self.draining: list[tuple[WorkerHandle, float]] = []
         # workers that died before ever becoming ready (setup-crash guard)
@@ -87,9 +88,23 @@ class BasePool:
     def num_workers(self) -> int:
         return len(self.workers)
 
-    def record_sample(self, process_time_s: float) -> None:
+    @staticmethod
+    def worker_node(w: WorkerHandle) -> str:
+        """'' for locally placed workers, else the owning agent's node id
+        (remote handles carry _RemoteProc with an ``_agent``)."""
+        agent = getattr(w.proc, "_agent", None)
+        return agent.node_id if agent is not None else ""
+
+    def workers_by_node(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for w in self.workers.values():
+            node = self.worker_node(w)
+            counts[node] = counts.get(node, 0) + 1
+        return counts
+
+    def record_sample(self, process_time_s: float, node_id: str = "") -> None:
         now = time.monotonic()
-        self.samples.append((now, process_time_s))
+        self.samples.append((now, process_time_s, node_id))
         cutoff = now - 600.0
         while self.samples and self.samples[0][0] < cutoff:
             self.samples.pop(0)
@@ -97,18 +112,36 @@ class BasePool:
     def throughput_per_worker(self, window_s: float) -> float | None:
         """Batches/sec one worker achieves, from recent samples."""
         now = time.monotonic()
-        recent = [p for (t, p) in self.samples if t >= now - window_s]
+        recent = [p for (t, p, _n) in self.samples if t >= now - window_s]
         if not recent:
             return None
         mean_t = sum(recent) / len(recent)
         return 1.0 / mean_t if mean_t > 0 else None
 
+    def node_throughputs(self, window_s: float) -> dict[str, float]:
+        """Per-node batches/sec one worker achieves — the per-node planner
+        biases CPU fan-out toward nodes that measurably process this stage
+        faster (e.g. less-contended cores, faster local disks)."""
+        now = time.monotonic()
+        by_node: dict[str, list[float]] = {}
+        for t, p, node in self.samples:
+            if t >= now - window_s:
+                by_node.setdefault(node, []).append(p)
+        out: dict[str, float] = {}
+        for node, ps in by_node.items():
+            mean_t = sum(ps) / len(ps)
+            if mean_t > 0:
+                out[node] = 1.0 / mean_t
+        return out
+
     def lifetime_expired(self, w: WorkerHandle) -> bool:
         lim = self.spec.worker_max_lifetime_m or 0
         return lim > 0 and (time.monotonic() - w.started_at) > lim * 60
 
-    # subclass API
-    def start_worker(self) -> WorkerHandle:  # pragma: no cover - abstract
+    # subclass API. ``node_id`` is the per-node planner's placement pin:
+    # None = legacy least-loaded placement, '' = the driver node, anything
+    # else = that agent (falling back when it died since the plan).
+    def start_worker(self, node_id: str | None = None) -> WorkerHandle:  # pragma: no cover - abstract
         raise NotImplementedError
 
     def stop_worker(self, w: WorkerHandle) -> None:  # pragma: no cover
@@ -276,13 +309,16 @@ class ProcessPool(BasePool):
     def _cpu_cost(self) -> float:
         return self.stage.resources.cpus
 
-    def start_worker(self) -> WorkerHandle:
+    def start_worker(self, node_id: str | None = None) -> WorkerHandle:
         wid = f"s{self.pool_id}-{self.name}-p{self._next_id}"
         self._next_id += 1
         env = dict(_base_worker_env(), CURATE_WORKER_ID=wid)
-        agent = (
-            self.remote_mgr.place(self._cpu_cost) if self.remote_mgr is not None else None
-        )
+        if self.remote_mgr is None:
+            agent = None
+        elif node_id is None:
+            agent = self.remote_mgr.place(self._cpu_cost)
+        else:
+            agent = self.remote_mgr.place_for(node_id, self._cpu_cost)
         if agent is not None:
             meta = WorkerMetadata(
                 worker_id=wid,
@@ -353,7 +389,7 @@ class InProcessPool(BasePool):
         self.results_q = results_q
         self._lock = threading.Lock()  # device stages run one batch at a time
 
-    def start_worker(self) -> WorkerHandle:
+    def start_worker(self, node_id: str | None = None) -> WorkerHandle:  # noqa: ARG002 - TPU workers are always driver-local
         if self.workers:
             # One in-process worker per TPU stage: threads would share the
             # same stage instance (double setup, destroy-while-in-use).
@@ -372,12 +408,23 @@ class InProcessPool(BasePool):
         return handle
 
     def _thread_main(self, handle: WorkerHandle) -> None:
+        import concurrent.futures
+
+        from cosmos_curate_tpu.engine.worker import _fetch_batch
+
         stage = self.stage
         meta = WorkerMetadata(
             worker_id=handle.worker_id,
             stage_name=self.name,
             node=self.node,
             allocation=stage.resources,
+        )
+        # same bounded concurrent input fetch the spawned workers use —
+        # device stages take the largest batches, so sequential ref-by-ref
+        # deserialization is the worst here; owned (and shut down) by this
+        # worker thread
+        fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"{handle.worker_id}-fetch"
         )
         try:
             with self._lock:
@@ -397,7 +444,7 @@ class InProcessPool(BasePool):
             try:
                 from cosmos_curate_tpu.observability.tracing import traced_span
 
-                tasks = [object_store.get(r) for r in msg.refs]
+                tasks = _fetch_batch(msg.refs, fetch_pool)
                 dt = time.monotonic() - t0
                 # span OUTSIDE the lock: exiting a span can flush 200
                 # buffered records through the storage backend — doing that
@@ -434,6 +481,7 @@ class InProcessPool(BasePool):
                         worker_id=handle.worker_id,
                     )
                 )
+        fetch_pool.shutdown(wait=False)
         try:
             stage.destroy()
         except Exception:

@@ -20,6 +20,7 @@ peer-to-peer (ARCHITECTURE.md:70-81).
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import multiprocessing as mp
 import os
 import queue
@@ -31,8 +32,10 @@ from cosmos_curate_tpu.engine import object_channel, object_store
 from cosmos_curate_tpu.engine.remote_plane import (
     AgentReady,
     AgentResult,
+    AgentStats,
     Bye,
     Hello,
+    PrefetchObjects,
     ReleaseObjects,
     StartWorker,
     StopWorker,
@@ -54,6 +57,25 @@ from cosmos_curate_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _MP = mp.get_context("spawn")
+
+# bounded concurrency for object-channel pulls (demand resolution AND
+# push-ahead prefetch share the pool, so prefetch can never starve the
+# demand path of sockets — it only uses slots the demand path left idle)
+FETCH_CONCURRENCY_ENV = "CURATE_OBJECT_FETCH_CONCURRENCY"
+# entries the push-ahead cache may hold before evicting oldest-first; each
+# entry is a whole segment in /dev/shm, so the cap bounds prefetch memory
+PREFETCH_CACHE_ENV = "CURATE_PREFETCH_CACHE_ENTRIES"
+
+
+def _host_memory_gb() -> float:
+    """This host's RAM in GiB for the Hello (0.0 = unknown; the planner
+    then fits on CPUs alone)."""
+    try:
+        import psutil
+
+        return psutil.virtual_memory().total / (1 << 30)
+    except Exception:
+        return 0.0
 
 
 def _delete_segments_with_prefix(prefix: str) -> int:
@@ -100,6 +122,35 @@ class NodeAgent:
         self.object_server = object_channel.ObjectServer(self.token)
         self.driver_object_addr: tuple[str, int] = ("", 0)
         self._last_run_id: bytes | None = None
+        # this process and every worker it spawns attribute their dispatch/
+        # flow/object-plane aggregates to this node
+        os.environ["CURATE_NODE_ID"] = self.node_id
+        # batch-level input resolution runs here, NOT on the recv loop: a
+        # slow multi-segment fetch must not block StartWorker/StopWorker/
+        # Release handling, and resolving batch N+1 while the worker chews
+        # batch N is exactly the input-prefetch overlap the engine wants
+        self._resolve_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="agent-resolve"
+        )
+        # segment-level pulls (demand + push-ahead prefetch), bounded
+        n_fetch = int(os.environ.get(FETCH_CONCURRENCY_ENV, "4"))
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, n_fetch), thread_name_prefix="agent-fetch"
+        )
+        # push-ahead cache: shm_name -> local copy ref, insertion-ordered
+        # for oldest-first eviction. The condition guards cache + in-flight
+        # set (recv loop, resolve pool and fetch pool all touch them) and
+        # lets a resolver WAIT on an in-flight prefetch instead of opening
+        # a duplicate transfer for the same segment.
+        self._cache_cv = threading.Condition()
+        self._prefetched: dict[str, object_store.ObjectRef] = {}
+        self._prefetching: set[str] = set()
+        self._prefetch_cap = max(1, int(os.environ.get(PREFETCH_CACHE_ENV, "64")))
+        # last AgentStats snapshot (totals), so each frame ships deltas;
+        # relay + watchdog threads both flush, hence the dedicated lock
+        self._op_lock = threading.Lock()
+        self._op_prev: dict | None = None
+        self._last_op_flush = 0.0
 
     def run(self, *, connect_timeout_s: float = 60.0, reconnect: bool = True) -> int:
         """Serve the driver until it says Bye.
@@ -131,6 +182,8 @@ class NodeAgent:
         # process stays alive, so the stale-segment janitor never would
         for key, batch_id in list(self.inflight):
             self._release_inflight(key, batch_id)
+        # the previous session's push-ahead copies are unreferenced too
+        self._clear_prefetch_cache()
         # stale worker results must not leak into the NEW session (the
         # driver would see results for workers it never started)
         try:
@@ -160,7 +213,11 @@ class NodeAgent:
         # direction) into this one (see SecureChannel/connect_channel)
         self.chan, ack = connect_channel(
             sock, self.token,
-            Hello(self.node_id, self.num_cpus, object_port=self.object_server.port),
+            Hello(
+                self.node_id, self.num_cpus,
+                object_port=self.object_server.port,
+                memory_gb=_host_memory_gb(),
+            ),
         )
         self.driver_object_addr = (self.addr[0], ack.driver_object_port)
         # output segments from a PREVIOUS run are unreferenced dead weight;
@@ -205,6 +262,11 @@ class NodeAgent:
             logger.warning("driver link lost: %s", e)
         finally:
             self._stop.set()
+            # best-effort final flush: a short run can finish inside one
+            # watchdog cadence, and its transfers still belong in the
+            # driver's per-node accounting
+            if said_bye:
+                self._flush_op_stats(force=True)
             for key, (in_q, _proc) in list(self.workers.items()):
                 try:
                     in_q.put(ShutdownMsg())
@@ -249,6 +311,8 @@ class NodeAgent:
             env = dict(msg.env)
             env["CURATE_WORKER_ID"] = msg.worker_key
             env["CURATE_STORE_OWNER"] = str(os.getpid())  # agent owns segments
+            # dispatch/flow dumps from this worker attribute to THIS node
+            env["CURATE_NODE_ID"] = self.node_id
             proc = _MP.Process(
                 target=worker_main,
                 args=(in_q, self.results_q, env),
@@ -269,54 +333,20 @@ class NodeAgent:
                     )
                 )
                 return
-            # the agent's own hop in the trace: input resolution (peer/
-            # driver fetches over the object channel) parents onto the
-            # driver's stage span via the frame's traceparent. No-op
-            # unless the agent runs with CURATE_TRACING=1.
-            from cosmos_curate_tpu.observability.tracing import traced_span
-
-            with traced_span(
-                "agent.resolve_inputs",
-                traceparent=getattr(msg, "traceparent", "") or None,
-                worker=msg.worker_key,
-                batch_id=msg.batch_id,
-                node=self.node_id,
-            ):
-                refs, fetched = self._resolve_specs(msg.refs)
-            # the fetch above can take seconds: the worker may have died and
-            # been reaped by the watchdog meanwhile. Re-check under the same
-            # lock hold as the inflight insert — inserting for a reaped key
-            # would leak the fetched segments forever (the watchdog already
-            # scanned inflight and will never revisit this key).
-            with self._lock:
-                alive = msg.worker_key in self.workers
-                if alive:
-                    self.inflight[(msg.worker_key, msg.batch_id)] = fetched
-                    if getattr(msg, "timeout_s", 0.0) > 0:
-                        # the deadline starts AFTER the input fetch (which
-                        # can take seconds and is not the worker's fault)
-                        self.deadlines[(msg.worker_key, msg.batch_id)] = (
-                            time.monotonic() + msg.timeout_s
-                        )
-            if not alive:
-                # WorkerDied was already reported; the driver requeues the
-                # batch — just free this attempt's local copies
-                for r in fetched:
-                    try:
-                        object_store.delete(r)
-                    except OSError:
-                        pass
-                return
-            entry[0].put(
-                ProcessMsg(
-                    batch_id=msg.batch_id,
-                    refs=refs,
-                    traceparent=getattr(msg, "traceparent", ""),
-                )
-            )
+            # input resolution runs on the bounded resolve pool, never this
+            # recv loop: while the worker processes batch N, batch N+1's
+            # refs stream in concurrently (the cross-host analogue of the
+            # worker's own fetch/process overlap)
+            self._resolve_pool.submit(self._resolve_and_dispatch, msg, entry)
+        elif isinstance(msg, PrefetchObjects):
+            for spec in msg.refs:
+                self._start_prefetch(spec)
         elif isinstance(msg, ReleaseObjects):
             for name in msg.names:
                 object_store.delete(object_store.ObjectRef(name, 0, 0))
+            # released segments can never be named by a future batch: any
+            # push-ahead copies of them are dead weight in the cache
+            self._clear_prefetch_cache(msg.names)
         elif isinstance(msg, StopWorker):
             with self._lock:
                 entry = self.workers.pop(msg.worker_key, None)
@@ -326,30 +356,162 @@ class NodeAgent:
                 except Exception:
                     entry[1].terminate()
 
+    def _resolve_and_dispatch(self, msg: SubmitBatch, entry) -> None:
+        """Resolve-pool job: pull the batch's inputs (bounded concurrency,
+        prefetch-cache hits first), then hand the batch to its worker.
+        Failures report as AgentResult errors — exactly what the inline
+        path used to raise into _serve_once's handler."""
+        from cosmos_curate_tpu.observability.tracing import traced_span
+
+        try:
+            # the agent's own hop in the trace: input resolution (peer/
+            # driver fetches over the object channel) parents onto the
+            # driver's stage span via the frame's traceparent. No-op
+            # unless the agent runs with CURATE_TRACING=1.
+            with traced_span(
+                "agent.resolve_inputs",
+                traceparent=getattr(msg, "traceparent", "") or None,
+                worker=msg.worker_key,
+                batch_id=msg.batch_id,
+                node=self.node_id,
+            ):
+                refs, fetched = self._resolve_specs(msg.refs)
+        except Exception:
+            import traceback
+
+            try:
+                self._send(
+                    AgentResult(
+                        msg.worker_key, msg.batch_id, error=traceback.format_exc()
+                    )
+                )
+            except OSError:
+                logger.debug("result send failed after resolve error", exc_info=True)
+            return
+        # the fetch above can take seconds: the worker may have died and
+        # been reaped by the watchdog meanwhile. Re-check under the same
+        # lock hold as the inflight insert — inserting for a reaped key
+        # would leak the fetched segments forever (the watchdog already
+        # scanned inflight and will never revisit this key).
+        with self._lock:
+            alive = msg.worker_key in self.workers
+            if alive:
+                self.inflight[(msg.worker_key, msg.batch_id)] = fetched
+                if getattr(msg, "timeout_s", 0.0) > 0:
+                    # the deadline starts AFTER the input fetch (which
+                    # can take seconds and is not the worker's fault)
+                    self.deadlines[(msg.worker_key, msg.batch_id)] = (
+                        time.monotonic() + msg.timeout_s
+                    )
+        if not alive:
+            # WorkerDied was already reported; the driver requeues the
+            # batch — just free this attempt's local copies
+            for r in fetched:
+                try:
+                    object_store.delete(r)
+                except OSError:
+                    logger.debug("stale-copy delete failed", exc_info=True)
+            return
+        entry[0].put(
+            ProcessMsg(
+                batch_id=msg.batch_id,
+                refs=refs,
+                traceparent=getattr(msg, "traceparent", ""),
+            )
+        )
+
+    def _fetch_one(self, s) -> object_store.ObjectRef:
+        """One demand pull over the object channel, with wait accounting
+        (the consumer is blocked for exactly this long)."""
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+
+        if s.owner_node == "":  # driver-owned: dial the control host
+            addr = self.driver_object_addr
+        else:
+            addr = (s.owner_host, s.owner_port)
+        local = object_store.ObjectRef(s.shm_name, s.total_size, s.num_buffers)
+        t0 = time.monotonic()
+        copy = object_channel.fetch_object(addr, self.token, local)
+        record_object_plane(
+            fetches=1, fetch_bytes=s.total_size,
+            fetch_wait_s=time.monotonic() - t0,
+        )
+        return copy
+
     def _resolve_specs(self, specs) -> tuple[list, list]:
         """RefSpecs -> local ObjectRefs. Segments this node already owns
-        are used in place (node affinity: zero bytes moved); everything
-        else streams from its owner — the driver's store or a PEER agent —
-        over the object channel, never through the driver's control socket.
-        Returns (refs_for_worker, fetched_local_copies)."""
-        refs: list = []
+        are used in place (node affinity: zero bytes moved); push-ahead
+        cache hits are consumed with ~zero wait; everything else streams
+        from its owner — the driver's store or a PEER agent — through the
+        bounded fetch pool, never through the driver's control socket and
+        never ref-by-ref sequentially. Returns (refs_for_worker,
+        fetched_local_copies)."""
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+
+        refs: list = [None] * len(specs)
         fetched: list = []
-        try:
-            for s in specs:
-                local = object_store.ObjectRef(s.shm_name, s.total_size, s.num_buffers)
-                if s.owner_node == self.node_id and os.path.exists(
-                    object_store.segment_path(s.shm_name)
-                ):
-                    refs.append(local)  # ours already; driver releases it later
-                    continue
-                if s.owner_node == "":  # driver-owned: dial the control host
-                    addr = self.driver_object_addr
-                else:
-                    addr = (s.owner_host, s.owner_port)
-                copy = object_channel.fetch_object(addr, self.token, local)
-                refs.append(copy)
-                fetched.append(copy)
-        except BaseException:
+        futures: list[tuple[int, concurrent.futures.Future]] = []
+        deferred: list[tuple[int, object]] = []
+        for i, s in enumerate(specs):
+            local = object_store.ObjectRef(s.shm_name, s.total_size, s.num_buffers)
+            if s.owner_node == self.node_id and os.path.exists(
+                object_store.segment_path(s.shm_name)
+            ):
+                refs[i] = local  # ours already; driver releases it later
+                continue
+            with self._cache_cv:
+                pending = (
+                    s.shm_name in self._prefetched or s.shm_name in self._prefetching
+                )
+            if pending:
+                # cached or streaming in: settle AFTER the demand futures
+                # are submitted, so waiting on one never delays the others
+                deferred.append((i, s))
+            else:
+                record_object_plane(prefetch_misses=1)
+                # copy_context: the fetch spans must parent onto the
+                # ambient agent.resolve_inputs span, not fragment the trace
+                # from a bare pool thread
+                import contextvars
+
+                futures.append(
+                    (
+                        i,
+                        self._fetch_pool.submit(
+                            contextvars.copy_context().run, self._fetch_one, s
+                        ),
+                    )
+                )
+        err: BaseException | None = None
+        for i, s in deferred:
+            t0 = time.monotonic()
+            hit = self._take_prefetched(s.shm_name)
+            if hit is not None:
+                record_object_plane(
+                    prefetch_hits=1, prefetch_hit_wait_s=time.monotonic() - t0
+                )
+                refs[i] = hit
+                fetched.append(hit)
+                continue
+            # the in-flight prefetch failed (owner died, segment released):
+            # fall back to a demand pull, which reports the real error
+            record_object_plane(prefetch_misses=1)
+            try:
+                copy = self._fetch_one(s)
+            except BaseException as e:
+                err = err or e
+                continue
+            refs[i] = copy
+            fetched.append(copy)
+        for i, fut in futures:
+            try:
+                copy = fut.result()
+            except BaseException as e:  # keep draining: every future must settle
+                err = err or e
+                continue
+            refs[i] = copy
+            fetched.append(copy)
+        if err is not None:
             # partial failure must not orphan the copies already written
             # (retries would leak a fresh set each attempt)
             for r in fetched:
@@ -357,8 +519,100 @@ class NodeAgent:
                     object_store.delete(r)
                 except OSError:
                     logger.debug("cleanup delete failed for %s", r.shm_name, exc_info=True)
-            raise
+            raise err
         return refs, fetched
+
+    # -- push-ahead prefetch -------------------------------------------
+    def _take_prefetched(
+        self, name: str, wait_s: float = 30.0
+    ) -> object_store.ObjectRef | None:
+        """Consume a cached push-ahead copy. When the transfer is still IN
+        FLIGHT, wait for it rather than racing a duplicate demand fetch —
+        the residual wait is strictly shorter than a fresh transfer, and
+        the caller's hit-wait accounting captures exactly that residue."""
+        deadline = time.monotonic() + wait_s
+        with self._cache_cv:
+            while True:
+                if name in self._prefetched:
+                    return self._prefetched.pop(name)
+                if name not in self._prefetching:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cache_cv.wait(remaining)
+
+    def _start_prefetch(self, spec) -> None:
+        """Begin pulling one pushed-ahead segment into the cache unless it
+        is already local, cached, or in flight."""
+        if spec.owner_node == self.node_id:
+            return
+        with self._cache_cv:
+            if spec.shm_name in self._prefetched or spec.shm_name in self._prefetching:
+                return
+            self._prefetching.add(spec.shm_name)
+        self._fetch_pool.submit(self._prefetch_one, spec)
+
+    def _prefetch_one(self, spec) -> None:
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+        from cosmos_curate_tpu.observability.tracing import suppress_tracing
+
+        evicted: list = []
+        try:
+            addr = (
+                self.driver_object_addr
+                if spec.owner_node == ""
+                else (spec.owner_host, spec.owner_port)
+            )
+            ref = object_store.ObjectRef(
+                spec.shm_name, spec.total_size, spec.num_buffers
+            )
+            t0 = time.monotonic()
+            # background traffic with no batch to parent onto: an untraced
+            # pull keeps the run's trace connected (the object-plane
+            # counters carry the prefetch signal)
+            with suppress_tracing():
+                copy = object_channel.fetch_object(addr, self.token, ref)
+            record_object_plane(
+                prefetches=1, prefetch_bytes=spec.total_size,
+                prefetch_transfer_s=time.monotonic() - t0,
+            )
+            with self._cache_cv:
+                self._prefetched[spec.shm_name] = copy
+                while len(self._prefetched) > self._prefetch_cap:
+                    evicted.append(self._prefetched.pop(next(iter(self._prefetched))))
+        except (ConnectionError, OSError, FileNotFoundError) as e:
+            # advisory: the demand pull will retry from the owner; a
+            # released-before-prefetch segment is a normal race
+            logger.debug("prefetch of %s failed: %s", spec.shm_name, e)
+        finally:
+            with self._cache_cv:
+                self._prefetching.discard(spec.shm_name)
+                self._cache_cv.notify_all()
+        for r in evicted:
+            try:
+                object_store.delete(r)
+            except OSError:
+                logger.debug("evicted-prefetch delete failed", exc_info=True)
+
+    def _clear_prefetch_cache(self, names=None) -> None:
+        """Drop cached push-ahead copies (all of them, or just ``names`` —
+        e.g. segments the driver released, which no future batch can
+        name)."""
+        with self._cache_cv:
+            if names is None:
+                dead, self._prefetched = list(self._prefetched.values()), {}
+            else:
+                dead = [
+                    self._prefetched.pop(n)
+                    for n in names
+                    if n in self._prefetched
+                ]
+        for r in dead:
+            try:
+                object_store.delete(r)
+            except OSError:
+                logger.debug("prefetch-cache delete failed", exc_info=True)
 
     def _release_inflight(self, worker_key: str, batch_id: int) -> None:
         with self._lock:
@@ -369,6 +623,27 @@ class NodeAgent:
                 object_store.delete(r)
             except OSError:  # segment already unlinked: nothing to release
                 pass
+
+    def _flush_op_stats(
+        self, *, min_interval_s: float = 1.0, force: bool = False
+    ) -> None:
+        """Ship object-plane DELTAS to the driver, throttled (relay thread
+        after results, watchdog on cadence, teardown forced)."""
+        from cosmos_curate_tpu.observability.stage_timer import (
+            object_plane_snapshot_delta,
+        )
+
+        with self._op_lock:
+            now = time.monotonic()
+            if not force and now - self._last_op_flush < min_interval_s:
+                return
+            self._last_op_flush = now
+            self._op_prev, delta = object_plane_snapshot_delta(self._op_prev)
+        if delta:
+            try:
+                self._send(AgentStats(object_plane=delta))
+            except OSError:
+                logger.debug("stats flush failed (link down?)", exc_info=True)
 
     def _relay_results(self, stop: threading.Event) -> None:
         while not stop.is_set():
@@ -407,6 +682,9 @@ class NodeAgent:
                             deserialize_time_s=msg.deserialize_time_s,
                         )
                     )
+                    # piggyback transfer stats on result traffic so even a
+                    # run shorter than the watchdog cadence reports
+                    self._flush_op_stats()
             except OSError:
                 return
 
@@ -420,6 +698,10 @@ class NodeAgent:
         while not stop.is_set():
             time.sleep(1.0)
             now = time.monotonic()
+            # relay object-plane deltas so the driver's per-node counters
+            # and run report cover this node's transfers even while no
+            # results flow (e.g. a long prefetch burst before dispatch)
+            self._flush_op_stats(min_interval_s=3.0)
             with self._lock:
                 expired = [k for k, d in self.deadlines.items() if now >= d]
             for key, batch_id in expired:

@@ -64,6 +64,9 @@ class Hello:
     # the agent's ObjectServer port (engine/object_channel.py): peers pull
     # this node's segments directly from here
     object_port: int = 0
+    # host RAM in GiB for the per-node planner's memory fit check
+    # (0 = unknown: the planner then fits on CPUs alone)
+    memory_gb: float = 0.0
 
 
 @dataclass
@@ -118,6 +121,30 @@ class ReleaseObjects:
     segments, forwarded to the owner)."""
 
     names: list  # list[str]
+
+
+@dataclass
+class PrefetchObjects:
+    """Driver → agent push-ahead: the router has decided the NEXT stage's
+    batches will run on this node, so start pulling these segments from
+    their owners NOW — into the agent's bounded prefetch cache — instead
+    of waiting for the demand pull inside SubmitBatch input resolution.
+    The transfer overlaps the node's current compute; a later SubmitBatch
+    naming these segments resolves them as cache hits with ~zero wait.
+    Purely advisory: a dropped or evicted prefetch degrades to the demand
+    fetch, never to an error."""
+
+    refs: list  # list[RefSpec]
+
+
+@dataclass
+class AgentStats:
+    """Agent → driver (periodic, from the watchdog thread): object-plane
+    transfer DELTAS since the last frame (stage_timer.OBJECT_PLANE_KEYS
+    schema). Deltas, not totals, so the driver's per-node fold stays
+    correct across link blips and reconnects."""
+
+    object_plane: dict
 
 
 @dataclass
@@ -457,6 +484,7 @@ class AgentLink:
     num_cpus: float
     sock: socket.socket
     token: bytes
+    memory_gb: float = 0.0
     chan: "SecureChannel | None" = None
     alive: bool = True
     # the agent's ObjectServer endpoint (peer IP from the control socket +
@@ -500,6 +528,12 @@ class RemoteWorkerManager:
         # tracks which agent owns every remote segment (shm_name -> link)
         self.object_server = ObjectServer(self.token)
         self._locations: dict[str, AgentLink] = {}
+        # shm_name -> EVERY node a push-ahead copy was sent to (a replan
+        # can redirect a stage mid-run, pushing the same segment to a
+        # second target): release must purge every target's prefetch
+        # cache, or never-adopted copies sit in /dev/shm until cap
+        # eviction (bounded; cleared wholesale past the cap)
+        self._pushed_to: dict[str, list[AgentLink]] = {}
         # releases addressed to a currently-dead link wait here (node_id ->
         # segment names) and flush when that node rejoins — a transient blip
         # must not leak the agent's segments for the rest of the run
@@ -552,7 +586,7 @@ class RemoteWorkerManager:
                 with self._lock:
                     agent.worker_costs.pop(key, None)
                 continue
-            if isinstance(msg, ReleaseObjects):
+            if isinstance(msg, (ReleaseObjects, PrefetchObjects)):
                 agent.send(msg)
                 continue
             if not isinstance(msg, ProcessMsg):
@@ -609,6 +643,7 @@ class RemoteWorkerManager:
             return
         link = AgentLink(
             hello.node_id, hello.num_cpus, sock, self.token, chan=chan,
+            memory_gb=getattr(hello, "memory_gb", 0.0),
             object_addr=(addr[0], hello.object_port),
         )
         with self._lock:
@@ -640,7 +675,17 @@ class RemoteWorkerManager:
         from cosmos_curate_tpu.engine import object_store
         from cosmos_curate_tpu.engine.worker import ReadyMsg, ResultMsg
 
-        if isinstance(msg, WorkerDied):
+        if isinstance(msg, AgentStats):
+            # fold the agent's object-plane deltas under its node id — the
+            # driver is the only process with a metrics exporter, so the
+            # pipeline_object_plane_* series covers every node's traffic
+            from cosmos_curate_tpu.observability.stage_timer import (
+                record_node_object_plane,
+            )
+
+            if msg.object_plane:
+                record_node_object_plane(link.node_id, msg.object_plane)
+        elif isinstance(msg, WorkerDied):
             with self._lock:
                 link.dead_workers.add(msg.worker_key)
                 link.worker_costs.pop(msg.worker_key, None)
@@ -674,6 +719,58 @@ class RemoteWorkerManager:
                     worker_id=msg.worker_key,
                 )
             )
+
+    def push_ahead(self, refs: list, node_id: str) -> int:
+        """Ask ``node_id``'s agent to prefetch these segments from their
+        owners (router push-ahead: the consumer node starts pulling while
+        the producer's compute continues). Segments the target already
+        owns are skipped. Returns how many were requested; 0 when the
+        target is unknown/dead (the demand pull still works)."""
+        with self._lock:
+            link = next(
+                (a for a in self.agents if a.alive and a.node_id == node_id), None
+            )
+        if link is None:
+            return 0
+        specs = [
+            self._spec_for(r) for r in refs if self.owner_node(r) != node_id
+        ]
+        if specs:
+            with self._lock:
+                if len(self._pushed_to) > 65536:
+                    self._pushed_to.clear()  # worst case: one missed purge
+                for s in specs:
+                    targets = self._pushed_to.setdefault(s.shm_name, [])
+                    if link not in targets:
+                        targets.append(link)
+            self._send_q.put((link, "", PrefetchObjects(specs)))
+        return len(specs)
+
+    def node_budgets(self) -> list:
+        """Live agents as ``(node_id, num_cpus, memory_gb)`` for the
+        per-node planner (the driver's own NodeBudget is the runner's to
+        build)."""
+        with self._lock:
+            return [
+                (a.node_id, a.num_cpus, a.memory_gb)
+                for a in self.agents
+                if a.alive
+            ]
+
+    def place_for(self, node_id: str, cpu_cost: float) -> "AgentLink | None":
+        """Planner-directed placement: ``node_id == ''`` places locally;
+        otherwise the named agent, falling back to the legacy least-loaded
+        ``place`` when that agent is gone (an allocation plan must not
+        wedge worker startup on a node that just died)."""
+        if node_id == "":
+            return None  # local; start_worker books note_local_start
+        with self._lock:
+            link = next(
+                (a for a in self.agents if a.alive and a.node_id == node_id), None
+            )
+        if link is not None:
+            return link
+        return self.place(cpu_cost)
 
     # -- P2P data plane -------------------------------------------------
     def owner_node(self, ref) -> str:
@@ -721,6 +818,14 @@ class RemoteWorkerManager:
                 )
                 return
             self._locations.pop(ref.shm_name, None)
+            pushed = self._pushed_to.pop(ref.shm_name, None) or []
+        for target in pushed:
+            if target is not link and target.alive:
+                # a push-ahead target that never consumed its copy (the
+                # batch was routed elsewhere, or a replan superseded the
+                # target): purge its prefetch cache too — the name can
+                # never be demanded again
+                self._send_q.put((target, "", ReleaseObjects([ref.shm_name])))
         if link is None:
             object_store.delete(ref)
         else:
@@ -801,12 +906,19 @@ class RemoteWorkerManager:
                 for a in self.agents
             }
 
-    def shutdown(self) -> None:
+    def shutdown(self, *, drain_s: float = 0.5) -> None:
         self._closed = True
         with self._lock:
             agents = list(self.agents)
         for a in agents:
             a.send(Bye())
+        if agents and drain_s > 0:
+            # agents answer Bye with a forced final AgentStats flush; keep
+            # their sockets open long enough for the per-agent recv threads
+            # to fold those last object-plane deltas — closing immediately
+            # would systematically drop every run's tail-window transfers
+            time.sleep(drain_s)
+        for a in agents:
             if a.sock is not None:
                 try:
                     a.sock.close()

@@ -25,7 +25,13 @@ from cosmos_curate_tpu.core.runner import RunnerInterface
 from cosmos_curate_tpu.core.stage import NodeInfo, StageSpec
 from cosmos_curate_tpu.core.tasks import PipelineTask
 from cosmos_curate_tpu.engine import object_store
-from cosmos_curate_tpu.engine.autoscaler import Budget, StageScaleState, plan_allocation
+from cosmos_curate_tpu.engine.autoscaler import (
+    Budget,
+    NodeBudget,
+    StageScaleState,
+    plan_allocation,
+    plan_node_allocation,
+)
 from cosmos_curate_tpu.engine.metrics import get_metrics
 from cosmos_curate_tpu.engine.pool import BasePool, ProcessPool, make_pool
 from cosmos_curate_tpu.engine.worker import ReadyMsg, ResultMsg
@@ -57,6 +63,11 @@ class _Batch:
 # touches it must not respawn workers forever).
 MAX_WORKER_DEATHS_PER_BATCH = 3
 
+# driver-side prefetch-ahead: how many agent-owned segments may stream
+# toward the driver concurrently while their consumer batch is still queued
+# (bounded so prefetch can never monopolize the fetch pool or /dev/shm)
+PREFETCH_INFLIGHT_LIMIT = 6
+
 
 @dataclass
 class _StageState:
@@ -86,6 +97,18 @@ class StreamingRunner(RunnerInterface):
         # stage name -> summed worker busy seconds (MFU accounting; the
         # sequential runner exposes the same attribute with wall time)
         self.stage_times: dict[str, float] = {}
+        # per-node planner state (cross-host runs): preferred node per
+        # stage (the router's affinity key) and the last emitted
+        # stage -> {node -> workers} plan, exposed for tests/reports
+        self._pref_node: list[str] | None = None
+        self.node_plan: dict[str, dict[str, int]] = {}
+        # driver-side prefetch-ahead bookkeeping (remote-owned segments
+        # whose consumer stage runs on the driver): remote shm_name ->
+        # LOCAL accounted copy, plus what's still streaming in
+        self._prefetched: dict[str, object] = {}
+        self._prefetch_inflight: set[str] = set()
+        # (target_node, shm_name) push-ahead requests already issued
+        self._pushed: set[tuple[str, str]] = set()
 
     # ------------------------------------------------------------------
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
@@ -208,6 +231,15 @@ class StreamingRunner(RunnerInterface):
         # batch_id -> _Batch while on the fetch pool: these are in neither
         # `batches` nor any queue, so exception-exit cleanup must walk this
         localizing: dict[int, _Batch] = {}
+        # prefetch-ahead completions: (remote_ref, local_ref|None, err, s)
+        prefetch_done: queue.Queue = queue.Queue()
+        self._prefetch_done = prefetch_done
+        # per-run reset: batch mode reuses this runner for stage-by-stage
+        # sub-runs, and stale push-ahead dedup would suppress real pushes
+        self._prefetched.clear()
+        self._prefetch_inflight.clear()
+        self._pushed.clear()
+        self._pref_node = None
         # (stage_state, batch, Future[list-of-values]): final-stage batches
         # whose remote outputs are streaming in; inputs stay held until the
         # future lands (failure re-executes the batch)
@@ -221,8 +253,8 @@ class StreamingRunner(RunnerInterface):
         pending_inputs = iter(spec.input_data)
         inputs_exhausted = not spec.input_data
 
-        # initial allocation
-        self._apply_allocation(states, budget, cfg)
+        # initial allocation (per-node plan when agents are connected)
+        self._apply_allocation(states, budget, cfg, remote_mgr=remote_mgr, local_node=node)
 
         batches: dict[int, _Batch] = {}
         next_batch_id = 0
@@ -262,6 +294,9 @@ class StreamingRunner(RunnerInterface):
                         ref = object_store.put(task)
                         store.account(ref)
                         states[0].in_queue.append(ref)
+                        # push seeded inputs toward stage 0's planned node
+                        # while earlier batches still process there
+                        self._maybe_prefetch(0, [ref], store)
                         progressed = True
                 # 1. drain results
                 for msg in self._drain(mp_results, thread_results):
@@ -286,6 +321,33 @@ class StreamingRunner(RunnerInterface):
                         _retry_or_drop(
                             stx, lb, store, f"localizing inputs failed: {err}",
                             dead_letter=self._dead_letter,
+                        )
+                # 1c. drain finished prefetch-aheads into the local cache
+                while True:
+                    try:
+                        pref_ref, local_ref, perr, transfer_s = prefetch_done.get_nowait()
+                    except queue.Empty:
+                        break
+                    progressed = True
+                    self._prefetch_inflight.discard(pref_ref.shm_name)
+                    if perr is not None or local_ref is None:
+                        # advisory: the demand localize path still works;
+                        # losing the race to a release is a normal outcome
+                        logger.debug("prefetch of %s failed: %s", pref_ref.shm_name, perr)
+                        continue
+                    store.account(local_ref)
+                    self._prefetched[pref_ref.shm_name] = local_ref
+                    self._record_object_plane(
+                        prefetches=1,
+                        prefetch_bytes=pref_ref.total_size,
+                        prefetch_transfer_s=transfer_s,
+                    )
+                    # oldest-first eviction: a copy whose batch got routed
+                    # to the owner node instead is never adopted — it must
+                    # not pin store budget for the rest of the run
+                    while len(self._prefetched) > 64:
+                        store.release(
+                            self._prefetched.pop(next(iter(self._prefetched)))
                         )
                 if pending_setup_errors:
                     raise RuntimeError(
@@ -314,8 +376,13 @@ class StreamingRunner(RunnerInterface):
                     idle = []
                     for w in st.pool.idle_workers():
                         if st.pool.lifetime_expired(w) and w.busy_batch is None:
+                            # recycle in place: the replacement inherits the
+                            # expiring worker's node, or the per-node plan
+                            # and reality drift apart and the next replan
+                            # pays a stop/start churn to reconcile them
+                            node_id = st.pool.worker_node(w)
                             st.pool.stop_worker(w)
-                            st.pool.start_worker()
+                            st.pool.start_worker(node_id=node_id)
                             continue
                         idle.append(w)
                     while idle:
@@ -330,12 +397,26 @@ class StreamingRunner(RunnerInterface):
                             next_batch_id += 1
                         else:
                             break
-                        # node affinity: of the idle workers, prefer the one
-                        # whose node already holds the most input bytes
+                        # stage-affinity routing: of the idle workers,
+                        # prefer the one whose node already holds the most
+                        # input bytes
                         # (reference ARCHITECTURE.md:70-81 — node-local
-                        # deserialization preferred)
-                        w = self._pick_worker(idle, batch.refs, remote_mgr)
+                        # deserialization preferred), with a tiebreak
+                        # toward the NEXT stage's planned node so this
+                        # batch's outputs land where their consumer's
+                        # workers live
+                        next_pref = (
+                            self._pref_node[i + 1]
+                            if self._pref_node is not None and i + 1 < len(self._pref_node)
+                            else None
+                        )
+                        w = self._pick_worker(idle, batch.refs, remote_mgr, next_pref)
                         idle.remove(w)
+                        if remote_mgr is not None and not self._worker_node(w):
+                            # prefetch-ahead already copied some (or all) of
+                            # these inputs into the driver store: adopt the
+                            # local copies before deciding to localize
+                            self._adopt_prefetched(batch, store)
                         if (
                             remote_mgr is not None
                             and not self._worker_node(w)
@@ -363,19 +444,32 @@ class StreamingRunner(RunnerInterface):
                         st.pool.submit(w, batch.batch_id, batch.refs)
                         st.dispatched += 1
                         progressed = True
-                # 4. autoscale
+                # 4. autoscale. The per-node path re-derives its NodeBudget
+                # list from the live agents each replan, so a dead agent's
+                # capacity stops being planned for (and a late joiner's
+                # starts being used) without re-basing a flat budget.
                 now = time.monotonic()
                 if now - last_autoscale >= cfg.streaming.autoscale_interval_s:
-                    if remote_mgr is not None:
-                        # agents join/leave mid-run: re-base the budget so a
-                        # dead agent's capacity stops being planned for (and
-                        # a late joiner's starts being used)
-                        budget = Budget(
-                            cpus=node.num_cpus + remote_mgr.remote_cpus(),
-                            tpus=budget.tpus,
-                        )
-                    self._apply_allocation(states, budget, cfg)
+                    self._apply_allocation(
+                        states, budget, cfg, remote_mgr=remote_mgr, local_node=node
+                    )
                     last_autoscale = now
+                    if remote_mgr is not None:
+                        for nid, s in remote_mgr.stats().items():
+                            self.metrics.set_node_state(
+                                nid, s["workers"], s["cpus_used"]
+                            )
+                        # the driver is a node too: without this the
+                        # per-node panels omit every driver-placed worker
+                        # (always the TPU stages) and hide driver
+                        # saturation
+                        driver_workers = sum(
+                            st.pool.workers_by_node().get("", 0)
+                            for st in states
+                        )
+                        self.metrics.set_node_state(
+                            "driver", driver_workers, remote_mgr.local_cpus_used
+                        )
                 # 5. metrics + completion
                 for st in states:
                     ready = len([w for w in st.pool.workers.values() if w.ready])
@@ -447,6 +541,20 @@ class StreamingRunner(RunnerInterface):
             # `localizing` concurrently would double-release
             if self._fetch_pool is not None:
                 self._fetch_pool.shutdown(wait=True)
+            # prefetch-ahead copies nobody adopted: completions still on the
+            # queue (pool is quiesced, so this drain is final), then the
+            # cache itself
+            while True:
+                try:
+                    pref_ref, local_ref, _perr, _s = prefetch_done.get_nowait()
+                except queue.Empty:
+                    break
+                if local_ref is not None:
+                    store.release(local_ref)
+            for local_ref in self._prefetched.values():
+                store.release(local_ref)
+            self._prefetched.clear()
+            self._prefetch_inflight.clear()
             for batch in batches.values():  # in-flight on exception exit
                 for r in batch.refs:
                     store.release(r)
@@ -476,7 +584,17 @@ class StreamingRunner(RunnerInterface):
                 prewarm.shutdown()
             if remote_mgr is not None:
                 self.remote_stats = remote_mgr.stats()
+                # shutdown's Bye triggers each agent's FORCED final stats
+                # flush and drains it before closing sockets — snapshot the
+                # per-node object-plane view after, or the tail window's
+                # transfers would be missing from runner.object_plane and
+                # the run report
                 remote_mgr.shutdown()
+                from cosmos_curate_tpu.observability.stage_timer import (
+                    object_plane_summaries,
+                )
+
+                self.object_plane = object_plane_summaries()
             for st, span in zip(states, stage_spans):
                 span.set_attribute("dispatched", st.dispatched)
                 span.set_attribute("completed", st.completed)
@@ -486,30 +604,142 @@ class StreamingRunner(RunnerInterface):
     # ------------------------------------------------------------------
     @staticmethod
     def _worker_node(w) -> str:
-        """'' for locally placed workers, else the agent's node id."""
-        agent = getattr(w.proc, "_agent", None)
-        return agent.node_id if agent is not None else ""
+        """'' for locally placed workers, else the agent's node id (the
+        single implementation lives on BasePool — one place owns the
+        remote-handle convention)."""
+        return BasePool.worker_node(w)
 
-    def _pick_worker(self, idle, refs, remote_mgr):
+    def _pick_worker(self, idle, refs, remote_mgr, next_pref: str | None = None):
+        """Stage-affinity router. Primary signal: input-byte locality (the
+        worker whose node owns the most input bytes moves the least data
+        to START the batch). Secondary: a bonus of half the batch's bytes
+        for the NEXT stage's planned node — so when input locality doesn't
+        clearly favor another node, the batch runs where its outputs will
+        be consumed and the inter-stage hop disappears entirely. Inputs
+        already prefetched into the driver store count as driver-local."""
         if remote_mgr is None or len(idle) == 1:
             return idle[0]
         owned_bytes: dict[str, int] = {}
+        total = 0
         for r in refs:
-            node = remote_mgr.owner_node(r)
+            node = (
+                "" if r.shm_name in self._prefetched else remote_mgr.owner_node(r)
+            )
             owned_bytes[node] = owned_bytes.get(node, 0) + r.total_size
-        return max(idle, key=lambda w: owned_bytes.get(self._worker_node(w), 0))
+            total += r.total_size
+        bonus = total // 2 + 1
+
+        def score(w) -> int:
+            node = self._worker_node(w)
+            s = owned_bytes.get(node, 0)
+            if next_pref is not None and node == next_pref:
+                s += bonus
+            return s
+
+        return max(idle, key=score)
+
+    def _adopt_prefetched(self, batch: _Batch, store) -> None:
+        """Swap a local-bound batch's prefetched inputs for their cached
+        driver-store copies: the remote originals release at their owner
+        and the demand-localize hop is skipped (a prefetch HIT — the
+        transfer already happened behind compute)."""
+        hits = 0
+        for j, r in enumerate(batch.refs):
+            local = self._prefetched.pop(r.shm_name, None)
+            if local is None:
+                continue
+            store.release(r)  # routes the delete to the owning agent
+            batch.refs[j] = local
+            hits += 1
+        if hits:
+            self._record_object_plane(prefetch_hits=hits)
+
+    def _maybe_prefetch(self, stage_idx: int, refs, store) -> None:
+        """Start moving ``refs`` toward the node the planner assigned to
+        ``stage_idx`` BEFORE any batch is formed: agent targets get a
+        PrefetchObjects push-ahead over the control link; a driver target
+        pulls on the fetch pool into the local cache. Bounded, deduped,
+        advisory — every skipped prefetch degrades to the demand pull."""
+        remote_mgr = self._remote_mgr
+        if remote_mgr is None or self._pref_node is None:
+            return
+        if not 0 <= stage_idx < len(self._pref_node):
+            return
+        pref = self._pref_node[stage_idx]
+        if len(self._pushed) > 65536:
+            # dedup memory stays bounded on corpus-scale runs; a pruned
+            # entry can at worst cause one redundant advisory push, which
+            # the agent's own cache/in-flight dedup absorbs
+            self._pushed.clear()
+        import contextvars
+
+        to_push: list = []
+        for r in refs:
+            owner = remote_mgr.owner_node(r)
+            if owner == pref:
+                continue  # already where the consumer will run
+            key = (pref, r.shm_name)
+            if key in self._pushed:
+                continue
+            if pref != "":
+                self._pushed.add(key)
+                to_push.append(r)  # one control frame for the whole batch
+                continue
+            # consumer runs on the driver: bounded pull-ahead into the
+            # local store, never on this loop
+            if (
+                len(self._prefetch_inflight) >= PREFETCH_INFLIGHT_LIMIT
+                or not store.has_headroom()
+                or r.shm_name in self._prefetched
+                or r.shm_name in self._prefetch_inflight
+            ):
+                continue
+            self._pushed.add(key)
+            self._prefetch_inflight.add(r.shm_name)
+            self._fetch_pool.submit(
+                contextvars.copy_context().run,
+                self._prefetch_local, r, remote_mgr, self._prefetch_done,
+            )
+        if to_push:
+            remote_mgr.push_ahead(to_push, pref)
+
+    @staticmethod
+    def _prefetch_local(ref, remote_mgr, done_q) -> None:
+        """Fetch-pool job: pull one agent-owned segment into the driver
+        store ahead of demand. Completion (or failure — advisory) lands on
+        ``done_q`` for the loop to account."""
+        t0 = time.monotonic()
+        try:
+            local = remote_mgr.localize(ref)
+            done_q.put((ref, local, None, time.monotonic() - t0))
+        except Exception as e:
+            done_q.put((ref, None, e, time.monotonic() - t0))
+
+    @staticmethod
+    def _record_object_plane(**deltas) -> None:
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+
+        record_object_plane(**deltas)
 
     @staticmethod
     def _localize_batch(batch, store, remote_mgr, done_q) -> None:
         """Fetch-pool job: pull a batch's agent-owned inputs into the
         driver store (remote workers resolve their own inputs agent-side).
         The batch is invisible to dispatch while here, so mutating its refs
-        is race-free."""
+        is race-free. Every pull here is a DEMAND fetch the consumer waits
+        on — a prefetch miss in the object-plane accounting."""
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+
         try:
             for j, r in enumerate(batch.refs):
                 if not remote_mgr.owner_node(r):
                     continue
+                t0 = time.monotonic()
                 local = remote_mgr.localize(r)
+                record_object_plane(
+                    fetches=1, fetch_bytes=r.total_size,
+                    fetch_wait_s=time.monotonic() - t0, prefetch_misses=1,
+                )
                 store.account(local)
                 store.release(r)  # routes the delete to the owning agent
                 batch.refs[j] = local
@@ -523,12 +753,19 @@ class StreamingRunner(RunnerInterface):
         release them at their owner. ALL-OR-NOTHING: any failure raises so
         the loop re-executes the whole batch — returning a partial list
         would duplicate the fetched outputs on the re-run."""
+        from cosmos_curate_tpu.observability.stage_timer import record_object_plane
+
         values = []
         err: Exception | None = None
         for r in refs:
             try:
                 if err is None:
+                    t0 = time.monotonic()
                     values.append(remote_mgr.fetch_value_if_remote(r))
+                    record_object_plane(
+                        fetches=1, fetch_bytes=r.total_size,
+                        fetch_wait_s=time.monotonic() - t0,
+                    )
             except Exception as e:  # keep releasing the rest
                 err = e
             finally:
@@ -595,9 +832,13 @@ class StreamingRunner(RunnerInterface):
                     store.release(r)
             return
         # throughput samples count per EXECUTION (the autoscaler sizes pools
-        # from them); st.completed counts per logical batch, so it is
-        # deferred to fetch-settlement when remote final outputs are pending
-        st.pool.record_sample(msg.process_time_s)
+        # from them, per node); st.completed counts per logical batch, so it
+        # is deferred to fetch-settlement when remote final outputs are
+        # pending
+        st.pool.record_sample(
+            msg.process_time_s,
+            node_id=self._worker_node(w) if w is not None else "",
+        )
         self.stage_times[st.spec.name] = (
             self.stage_times.get(st.spec.name, 0.0) + msg.process_time_s
         )
@@ -606,10 +847,12 @@ class StreamingRunner(RunnerInterface):
         )
         nxt = batch.stage_idx + 1
         final_remote: list = []
+        forward: list = []
         for r in msg.out_refs:
             if nxt < len(states):
                 store.account(r)  # queue bounds + input gating provide backpressure
                 states[nxt].in_queue.append(r)
+                forward.append(r)
                 continue
             # Final-stage outputs must NOT enter the admission ledger: they
             # are only freed at run end, so accounting them would eventually
@@ -626,6 +869,11 @@ class StreamingRunner(RunnerInterface):
             if cfg.return_last_stage_outputs:
                 outputs.append(object_store.get(r))
             object_store.delete(r)
+        if forward:
+            # push-ahead: start moving these outputs toward the node the
+            # planner chose for the NEXT stage while this loop keeps
+            # orchestrating — by dispatch time the bytes are (mostly) there
+            self._maybe_prefetch(nxt, forward, store)
         if final_remote:
             # the batch's INPUTS stay held until its remote outputs are
             # safely fetched: if the owning agent dies first, the loop
@@ -756,30 +1004,76 @@ class StreamingRunner(RunnerInterface):
                             f"worker {w.worker_id} died processing it (poison batch?)",
                             dead_letter=self._dead_letter,
                         )
-                    st.pool.start_worker()
+                    # replace on the dead worker's node (plan-consistent);
+                    # place_for falls back to least-loaded when that whole
+                    # node died with it
+                    st.pool.start_worker(node_id=st.pool.worker_node(w))
                     progressed = True
         return progressed
 
-    def _apply_allocation(self, states, budget: Budget, cfg) -> None:
+    def _apply_allocation(
+        self, states, budget: Budget, cfg, remote_mgr=None, local_node=None
+    ) -> None:
+        window = cfg.streaming.speed_estimation_window_s
         scale_states = [
             StageScaleState(
                 spec=st.spec,
                 current_workers=st.pool.num_workers(),
-                throughput_per_worker=st.pool.throughput_per_worker(
-                    cfg.streaming.speed_estimation_window_s
-                ),
+                throughput_per_worker=st.pool.throughput_per_worker(window),
                 queued=len(st.in_queue),
+                node_rates=st.pool.node_throughputs(window),
             )
             for st in states
         ]
-        targets = plan_allocation(scale_states, budget)
-        for st, target in zip(states, targets):
-            cur = st.pool.num_workers()
-            for _ in range(max(0, target - cur)):
-                st.pool.start_worker()
-            if target < cur:
-                # scale down idle workers only
-                for w in st.pool.idle_workers()[: cur - target]:
+        if remote_mgr is None:
+            targets = plan_allocation(scale_states, budget)
+            self._pref_node = [""] * len(states)
+            for st, target in zip(states, targets):
+                cur = st.pool.num_workers()
+                for _ in range(max(0, target - cur)):
+                    st.pool.start_worker()
+                if target < cur:
+                    # scale down idle workers only
+                    for w in st.pool.idle_workers()[: cur - target]:
+                        st.pool.stop_worker(w)
+            return
+        # cross-host: one NodeBudget per live host, re-derived every replan
+        # so churned agents fall out of the plan and joiners enter it
+        nodes = [
+            NodeBudget(
+                "",
+                cpus=local_node.num_cpus if local_node is not None else budget.cpus,
+                tpu_chips=(
+                    local_node.num_tpu_chips if local_node is not None else 0
+                ),
+                memory_gb=_host_memory_bytes() / (1 << 30),
+            )
+        ] + [
+            NodeBudget(nid, cpus=cpus, memory_gb=mem)
+            for nid, cpus, mem in remote_mgr.node_budgets()
+        ]
+        plan = plan_node_allocation(scale_states, nodes)
+        self._pref_node = plan.preferred_node
+        self.node_plan = {
+            st.spec.name: dict(pn) for st, pn in zip(states, plan.per_node)
+        }
+        for st, counts in zip(states, plan.per_node):
+            cur = st.pool.workers_by_node()
+            for nid, want in counts.items():
+                for _ in range(max(0, want - cur.get(nid, 0))):
+                    st.pool.start_worker(node_id=nid)
+            # scale down idle workers on nodes over their per-node target
+            # (a node absent from the plan has target 0 there)
+            for nid, have in cur.items():
+                want = counts.get(nid, 0)
+                if have <= want:
+                    continue
+                surplus = [
+                    w
+                    for w in st.pool.idle_workers()
+                    if st.pool.worker_node(w) == nid
+                ]
+                for w in surplus[: have - want]:
                     st.pool.stop_worker(w)
 
     @staticmethod

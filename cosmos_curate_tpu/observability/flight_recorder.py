@@ -231,6 +231,7 @@ def runner_stats(runner: Any) -> dict:
     from cosmos_curate_tpu.observability.stage_timer import (
         caption_phase_summaries,
         dispatch_summaries,
+        object_plane_summaries,
         stage_flow_summaries,
     )
 
@@ -238,8 +239,15 @@ def runner_stats(runner: Any) -> dict:
         "dispatch": dispatch_summaries(),
         "stage_flow": stage_flow_summaries(),
         "caption_phases": caption_phase_summaries(),
+        # cross-host transfers per node (driver's own + relayed agent
+        # deltas); the engine runner also snapshots this as
+        # ``runner.object_plane`` at finalize
+        "object_plane": object_plane_summaries(),
         "stage_times": dict(getattr(runner, "stage_times", None) or {}),
     }
+    node_plan = getattr(runner, "node_plan", None)
+    if node_plan:
+        stats["node_plan"] = node_plan
     wall = getattr(runner, "pipeline_wall_s", 0.0)
     if wall:
         stats["wall_s"] = round(float(wall), 4)
@@ -300,7 +308,8 @@ def load_node_stats(output_path: str) -> dict | None:
         return None
     merged: dict[str, Any] = {
         "dispatch": {}, "stage_flow": {}, "caption_phases": {},
-        "stage_times": {}, "stage_counts": {}, "dead_lettered": 0,
+        "object_plane": {}, "stage_times": {}, "stage_counts": {},
+        "dead_lettered": 0,
     }
     dlq_dirs: list[str] = []
     overlaps: list[float] = []
@@ -317,6 +326,14 @@ def load_node_stats(output_path: str) -> dict | None:
         for key in ("dispatch", "stage_flow", "caption_phases"):
             for name, agg in (stats.get(key) or {}).items():
                 merged[key][f"n{rank}/{name}"] = agg
+        # object-plane aggregates are already keyed per node: sum numeric
+        # fields when two sidecars report the same node (driver rank saw
+        # agent deltas AND the agent rank dumped its own totals)
+        for node, agg in (stats.get("object_plane") or {}).items():
+            into = merged["object_plane"].setdefault(node, {})
+            for k, v in agg.items():
+                if isinstance(v, (int, float)):
+                    into[k] = round(into.get(k, 0) + v, 4)
         for name, s in (stats.get("stage_times") or {}).items():
             merged["stage_times"][name] = round(
                 merged["stage_times"].get(name, 0.0) + float(s), 4
@@ -380,6 +397,9 @@ def build_run_report(
     report["dispatch"] = stats["dispatch"]
     report["stage_flow"] = stats["stage_flow"]
     report["caption_phases"] = stats["caption_phases"]
+    report["object_plane"] = stats["object_plane"]
+    if stats.get("node_plan"):
+        report["node_plan"] = stats["node_plan"]
     # precedence: live runner accounting > prior/sidecar accounting (it
     # includes setup time spans don't book to the stage) > span-derived
     report["stage_times"] = (
@@ -402,8 +422,8 @@ def build_run_report(
         # stage_times/wall_s are handled above (they have span-derived
         # fallbacks that would always win this not-set check)
         for key in (
-            "dispatch", "stage_flow", "caption_phases", "stage_counts",
-            "dead_lettered", "dlq_run_dir",
+            "dispatch", "stage_flow", "caption_phases", "object_plane",
+            "node_plan", "stage_counts", "dead_lettered", "dlq_run_dir",
         ):
             if not report.get(key) and prior.get(key):
                 report[key] = prior[key]
@@ -500,6 +520,26 @@ def render_report(report: dict) -> str:
                 f"busy_frac_mean {agg.get('busy_frac_mean', 0.0):.3f}  "
                 f"queue_peak {agg.get('queue_depth_peak', 0)}"
             )
+    plane = report.get("object_plane") or {}
+    if plane:
+        lines.append("object plane (per node):")
+        for node, agg in sorted(plane.items()):
+            moved = agg.get("fetch_bytes", 0) + agg.get("prefetch_bytes", 0)
+            lines.append(
+                f"  {node:<24} moved {moved / 1e6:9.2f}MB  "
+                f"demand-wait {agg.get('fetch_wait_s', 0.0):7.2f}s  "
+                f"prefetch {agg.get('prefetches', 0)} "
+                f"(hits {agg.get('prefetch_hits', 0)}, "
+                f"misses {agg.get('prefetch_misses', 0)})"
+            )
+    node_plan = report.get("node_plan") or {}
+    if node_plan:
+        lines.append("node plan (stage -> workers per node):")
+        for stage, counts in node_plan.items():
+            placed = ", ".join(
+                f"{nid or 'driver'}={n}" for nid, n in sorted(counts.items())
+            )
+            lines.append(f"  {stage:<40} {placed}")
     caption = report.get("caption_phases") or {}
     if caption:
         lines.append("caption engine phases:")

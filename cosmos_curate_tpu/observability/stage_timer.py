@@ -99,6 +99,17 @@ def _new_agg() -> dict:
     }
 
 
+NODE_ID_ENV = "CURATE_NODE_ID"
+
+
+def node_id() -> str:
+    """Which node THIS process runs on, for per-node attribution in
+    dispatch/flow/object-plane summaries. Node agents stamp the env into
+    every worker they spawn; the driver and its local workers default to
+    ``driver``."""
+    return os.environ.get(NODE_ID_ENV) or "driver"
+
+
 def record_dispatch(name: str, rec: DispatchRecord) -> None:
     """Fold one dispatch into the per-name aggregate and forward the
     gap/compute signal to the engine's prometheus gauges (no-op when the
@@ -137,6 +148,13 @@ def _maybe_register_dump() -> None:
     _DUMP_REGISTERED = True
 
 
+# Reserved dump key carrying a process's object-plane aggregate alongside
+# its dispatch summaries (spawned workers have no exporter and no control
+# link of their own — the dump is their only way home for store_read
+# telemetry). Never a stage name: stages are class names.
+OBJECT_PLANE_DUMP_KEY = "__object_plane__"
+
+
 def _dump_summaries(path: str | None) -> None:
     try:
         import json
@@ -152,7 +170,12 @@ def _dump_summaries(path: str | None) -> None:
         # next merge over this dir
         with _DISPATCH_LOCK:
             items = {k: dict(v) for k, v in _DISPATCH.items()}
-        (d / f"dispatch-{os.getpid()}.json").write_text(json.dumps(_summarize(items)))
+        out = _summarize(items)
+        with _OP_LOCK:
+            op = {k: _OP.get(k, 0.0) for k in OBJECT_PLANE_KEYS if _OP.get(k)}
+        if op:
+            out[OBJECT_PLANE_DUMP_KEY] = {**op, "node": node_id()}
+        (d / f"dispatch-{os.getpid()}.json").write_text(json.dumps(out))
     except Exception:  # a failed dump must never break process exit
         pass
 
@@ -174,7 +197,13 @@ def _iter_dumps(path: str):
 
 def _fold(into: dict, agg: dict) -> None:
     for k in into:
-        into[k] += agg.get(k, 0)
+        if isinstance(into[k], (int, float)):
+            into[k] += agg.get(k, 0)
+    # per-node attribution survives the merge: one source node passes
+    # through; aggregates folded across nodes say so instead of lying
+    node = agg.get("node")
+    if node:
+        into["node"] = node if into.get("node") in (None, node) else "mixed"
 
 
 def load_dumped_summaries(path: str) -> dict[str, dict]:
@@ -183,6 +212,8 @@ def load_dumped_summaries(path: str) -> dict[str, dict]:
     merged: dict[str, dict] = {}
     for _f, data in _iter_dumps(path):
         for name, agg in data.items():
+            if name == OBJECT_PLANE_DUMP_KEY:
+                continue  # not a dispatch stage (merge_new_* folds it)
             _fold(merged.setdefault(name, _new_agg()), agg)
     for agg in merged.values():
         busy = agg["gap_s"] + agg["compute_s"]
@@ -212,6 +243,16 @@ def merge_new_dumped_summaries(path: str) -> dict[str, dict]:
             continue
         _MERGED_DUMPS.add(key)
         for name, agg in data.items():
+            if name == OBJECT_PLANE_DUMP_KEY:
+                # a spawned worker's store_read (and any other object-plane)
+                # telemetry comes home through its dump: fold it under the
+                # worker's node id so per-node summaries and the
+                # pipeline_object_plane_* counters stay complete
+                record_node_object_plane(
+                    agg.get("node") or node_id(),
+                    {k: v for k, v in agg.items() if k in OBJECT_PLANE_KEYS},
+                )
+                continue
             _fold(merged.setdefault(name, _new_agg()), agg)
             with _DISPATCH_LOCK:
                 _fold(_FOLDED.setdefault(name, _new_agg()), agg)
@@ -301,6 +342,7 @@ def stage_flow_summaries() -> dict[str, dict]:
                 round(agg["busy_frac_sum"] / agg["ticks"], 4) if agg["ticks"] else 0.0
             ),
             "workers": agg["workers"],
+            "node": node_id(),
         }
     return out
 
@@ -379,6 +421,104 @@ def reset_caption_phases() -> None:
         _CAPTION.clear()
 
 
+# ---------------------------------------------------------------------------
+# Object-plane transfer aggregates (engine/object_channel.py consumers): how
+# many bytes crossed hosts, how long consumers WAITED for them, and whether
+# push-ahead prefetch hid the transfer behind compute. Bounded per-process
+# aggregates; node agents relay theirs to the driver over the control link
+# (remote_plane.AgentStats), which folds them per node here.
+_OP_LOCK = threading.Lock()
+_OP: dict[str, float] = {}
+# driver-side fold of AgentStats deltas: node_id -> aggregate
+_OP_NODES: dict[str, dict] = {}
+
+OBJECT_PLANE_KEYS = (
+    # demand fetches: the consumer BLOCKED on the transfer (wait == transfer)
+    "fetches", "fetch_bytes", "fetch_wait_s",
+    # push-ahead transfers: moved in the background while compute ran
+    "prefetches", "prefetch_bytes", "prefetch_transfer_s",
+    # consumer-side cache outcomes: a hit's wait is ~0 (the bytes were
+    # already local); prefetch working == hits > 0 and
+    # prefetch_hit_wait_s << prefetch_transfer_s
+    "prefetch_hits", "prefetch_hit_wait_s", "prefetch_misses",
+    # local store reads on the worker fetch pool (shm, not network)
+    "store_reads", "store_read_bytes", "store_read_wait_s",
+)
+
+
+def _new_op() -> dict:
+    return {k: 0.0 for k in OBJECT_PLANE_KEYS}
+
+
+def record_object_plane(**deltas: float) -> None:
+    """Fold object-plane deltas (any subset of OBJECT_PLANE_KEYS) into this
+    process's aggregate and forward them to the prometheus counters under
+    this process's node id (no-op without an exporter)."""
+    with _OP_LOCK:
+        for k, v in deltas.items():
+            if k in OBJECT_PLANE_KEYS:
+                _OP[k] = _OP.get(k, 0.0) + float(v)
+    # a CPU worker may record store_reads without ever dispatching to a
+    # device — it still owes the parent a dump at exit
+    _maybe_register_dump()
+    _forward_object_plane(node_id(), deltas)
+
+
+def record_node_object_plane(node: str, deltas: dict) -> None:
+    """Driver-side fold of one agent's relayed object-plane DELTAS."""
+    with _OP_LOCK:
+        agg = _OP_NODES.setdefault(node, _new_op())
+        for k in OBJECT_PLANE_KEYS:
+            agg[k] += float(deltas.get(k, 0.0))
+    _forward_object_plane(node, deltas)
+
+
+def _forward_object_plane(node: str, deltas: dict) -> None:
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_object_plane(node, deltas)
+    except Exception:  # metrics must never take down a transfer path
+        pass
+
+
+def object_plane_summaries() -> dict[str, dict]:
+    """node_id -> object-plane aggregate: this process's own traffic under
+    its node id, plus every agent's relayed aggregate. Integer-valued
+    counters render as ints for readability."""
+    out: dict[str, dict] = {}
+    with _OP_LOCK:
+        own = dict(_OP)
+        nodes = {n: dict(a) for n, a in _OP_NODES.items()}
+    if any(own.get(k) for k in OBJECT_PLANE_KEYS):
+        nodes.setdefault(node_id(), _new_op())
+        for k in OBJECT_PLANE_KEYS:
+            nodes[node_id()][k] += own.get(k, 0.0)
+    for node, agg in nodes.items():
+        out[node] = {
+            k: round(agg[k], 4) if k.endswith("_s") else int(agg[k])
+            for k in OBJECT_PLANE_KEYS
+        }
+    return out
+
+
+def object_plane_snapshot_delta(prev: dict | None) -> tuple[dict, dict]:
+    """(current_totals, delta_since_prev) of this process's own aggregate —
+    what a node agent ships in each AgentStats frame (deltas, so driver-side
+    folding is idempotent across reconnects)."""
+    with _OP_LOCK:
+        cur = {k: _OP.get(k, 0.0) for k in OBJECT_PLANE_KEYS}
+    prev = prev or {}
+    delta = {k: cur[k] - float(prev.get(k, 0.0)) for k in OBJECT_PLANE_KEYS}
+    return cur, {k: v for k, v in delta.items() if v}
+
+
+def reset_object_plane() -> None:
+    with _OP_LOCK:
+        _OP.clear()
+        _OP_NODES.clear()
+
+
 def dispatch_summaries() -> dict[str, dict]:
     """name -> aggregate per-dispatch timings, including aggregates merged
     in from worker dump files. ``gap_frac`` is device idle over total
@@ -404,5 +544,9 @@ def _summarize(items: dict[str, dict]) -> dict[str, dict]:
             "d2h_s": round(agg["d2h_s"], 4),
             "gap_s": round(agg["gap_s"], 4),
             "gap_frac": round(agg["gap_s"] / busy, 4) if busy > 0 else 0.0,
+            # merged multi-node reports attribute dispatch gaps per node,
+            # not just per stage — dumps from an agent's workers carry the
+            # agent's node id (NODE_ID_ENV rides StartWorker env)
+            "node": agg.get("node") or node_id(),
         }
     return out

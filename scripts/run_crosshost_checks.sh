@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Cross-host scheduling gate: a local driver plus a loopback node agent run
+# a real split pipeline end to end — CPU stages placed on the agent node by
+# the per-node planner, the embed stage in-process on the driver — and the
+# run must produce ONE connected trace plus object-plane evidence that
+# push-ahead prefetch overlapped compute (prefetch wait < transfer time,
+# pipeline_object_plane_bytes_total > 0). See docs/PERFORMANCE.md
+# ("Cross-host scheduling") for the model this validates.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== fast units: per-node planner + router =="
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/engine/test_node_planner.py \
+  tests/engine/test_autoscaler.py \
+  -q -p no:randomly
+
+echo "== two-agent e2e: routing + prefetch (spawns real agents) =="
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/engine/test_cross_host_routing.py \
+  -q -p no:randomly -m ''
+
+echo "== loopback soak: split pipeline across driver + 1 agent =="
+# a real script file, not a heredoc: the driver's local workers are
+# spawned processes that re-import __main__, and '<stdin>' has no path
+JAX_PLATFORMS=cpu python scripts/crosshost_soak.py
+
+echo "cross-host checks passed"

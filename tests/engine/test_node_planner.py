@@ -1,0 +1,235 @@
+"""Per-node water-filling planner units (engine/autoscaler.py).
+
+The cross-host planner must (a) reproduce today's flat plan exactly when
+there is one node — the engine's single-host behavior is load-bearing —
+and (b) under heterogeneous budgets pin device stages to TPU-bearing
+nodes while fanning CPU stages across whatever cores exist anywhere.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.engine.autoscaler import (
+    Budget,
+    NodeBudget,
+    StageScaleState,
+    plan_allocation,
+    plan_node_allocation,
+)
+
+
+class _Stage(Stage):
+    def __init__(self, name: str, resources: Resources, affinity: str | None = None) -> None:
+        self._name = name
+        self._resources = resources
+        self._affinity = affinity
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def resources(self) -> Resources:
+        return self._resources
+
+    @property
+    def node_affinity(self) -> str | None:
+        return self._affinity
+
+    def process_data(self, tasks):
+        return tasks
+
+
+def _state(
+    name: str,
+    *,
+    cpus: float = 1.0,
+    tpus: float = 0.0,
+    rate: float | None = None,
+    queued: int = 0,
+    node_rates: dict | None = None,
+    affinity: str | None = None,
+    **spec_kw,
+) -> StageScaleState:
+    spec = StageSpec(
+        stage=_Stage(name, Resources(cpus=cpus, tpus=tpus), affinity), **spec_kw
+    )
+    return StageScaleState(
+        spec=spec,
+        current_workers=1,
+        throughput_per_worker=rate,
+        queued=queued,
+        node_rates=node_rates or {},
+    )
+
+
+DRIVER = ""  # runner convention: '' is the driver node
+
+
+class TestSingleNodeParity:
+    def test_matches_flat_plan_exactly(self):
+        """Acceptance: with exactly one node, emitted allocations match
+        today's plan_allocation output on the same inputs."""
+        cases = [
+            ([_state("a", rate=10.0, queued=2), _state("b", rate=1.0, queued=9)], 8, 0),
+            ([_state("io", cpus=0.0, queued=100)], 4, 0),
+            (
+                [
+                    _state("dl", cpus=0.5, queued=5),
+                    _state("dec", rate=1.0, queued=9),
+                    _state("emb", tpus=1.0, rate=2.0),
+                ],
+                8,
+                4,
+            ),
+            ([_state("fixed", rate=0.1, queued=99, num_workers=2), _state("auto", rate=5.0)], 8, 0),
+            ([_state("drained", rate=2.0, queued=0), _state("starved", rate=2.0, queued=50)], 6, 0),
+        ]
+        for stages, cpus, tpus in cases:
+            flat = plan_allocation(stages, Budget(cpus=cpus, tpus=tpus))
+            plan = plan_node_allocation(
+                stages, [NodeBudget(DRIVER, cpus=cpus, tpu_chips=tpus)]
+            )
+            assert plan.targets == flat
+            # and every worker lands on the only node
+            for counts, total in zip(plan.per_node, plan.targets):
+                assert counts == {DRIVER: total}
+            assert plan.preferred_node == [DRIVER] * len(stages)
+
+
+class TestHeterogeneousBudgets:
+    def test_tpu_stage_pins_to_tpu_node_cpu_stage_fans_out(self):
+        stages = [
+            _state("decode", cpus=1.0, rate=1.0, queued=30),
+            _state("embed", tpus=1.0, rate=4.0, queued=2),
+        ]
+        plan = plan_node_allocation(
+            stages,
+            [NodeBudget(DRIVER, cpus=2, tpu_chips=4), NodeBudget("cpu-node", cpus=8)],
+        )
+        # device stage: every worker on the TPU-bearing driver
+        assert set(plan.per_node[1]) == {DRIVER}
+        # CPU stage: fans onto the CPU-only node (which has most free cores)
+        assert plan.per_node[0].get("cpu-node", 0) > 0
+        assert plan.preferred_node[0] == "cpu-node"
+        # totals respect the aggregate budget (min-viable aside)
+        assert sum(plan.per_node[0].values()) == plan.targets[0]
+
+    def test_per_node_cpu_budgets_respected(self):
+        stages = [_state("work", cpus=2.0, rate=1.0, queued=100)]
+        plan = plan_node_allocation(
+            stages, [NodeBudget(DRIVER, cpus=4), NodeBudget("small", cpus=2)]
+        )
+        # 4/2 = 2 workers fit on the driver, 1 on the small node; the
+        # min-viable first grant can oversubscribe but not here (6 cpus)
+        assert plan.per_node[0].get(DRIVER, 0) <= 2
+        assert plan.per_node[0].get("small", 0) <= 1
+
+    def test_node_rate_bias_prefers_faster_node(self):
+        stages = [
+            _state(
+                "decode", cpus=1.0, rate=1.0, queued=50, max_workers=3,
+                node_rates={"fast": 4.0, "slow": 0.5},
+            )
+        ]
+        plan = plan_node_allocation(
+            stages,
+            [NodeBudget(DRIVER, cpus=0.0), NodeBudget("fast", cpus=3), NodeBudget("slow", cpus=3)],
+        )
+        counts = plan.per_node[0]
+        assert counts.get("fast", 0) > counts.get("slow", 0)
+
+    def test_driver_affinity_hint_pins_stage(self):
+        stages = [
+            _state("upload", cpus=1.0, rate=1.0, queued=10, affinity="driver"),
+            _state("decode", cpus=1.0, rate=1.0, queued=10),
+        ]
+        plan = plan_node_allocation(
+            stages, [NodeBudget(DRIVER, cpus=2), NodeBudget("agent", cpus=8)]
+        )
+        assert set(plan.per_node[0]) == {DRIVER}
+        assert plan.preferred_node[0] == DRIVER
+
+    def test_colocation_bias_keeps_consecutive_stages_together(self):
+        # two equal-rate CPU stages, two identical nodes: the second stage
+        # should prefer the first stage's node over a blind round-robin
+        stages = [
+            _state("a", cpus=1.0, rate=1.0, queued=4, min_workers=1, max_workers=1),
+            _state("b", cpus=1.0, rate=1.0, queued=4, min_workers=1, max_workers=1),
+        ]
+        plan = plan_node_allocation(
+            stages, [NodeBudget(DRIVER, cpus=0.0), NodeBudget("n1", cpus=4), NodeBudget("n2", cpus=4)]
+        )
+        assert plan.preferred_node[0] == plan.preferred_node[1]
+
+    def test_no_nodes_degenerates_to_one_local(self):
+        stages = [_state("only", rate=1.0, queued=1)]
+        plan = plan_node_allocation(stages, [])
+        assert sum(plan.per_node[0].values()) == plan.targets[0]
+
+
+class _FakeAgent:
+    def __init__(self, node_id: str) -> None:
+        self.node_id = node_id
+
+
+class _FakeProc:
+    def __init__(self, node_id: str) -> None:
+        if node_id:
+            self._agent = _FakeAgent(node_id)
+
+
+class _FakeWorker:
+    def __init__(self, node_id: str) -> None:
+        self.proc = _FakeProc(node_id)
+        self.node = node_id
+
+
+class _FakeRef:
+    def __init__(self, name: str, size: int) -> None:
+        self.shm_name = name
+        self.total_size = size
+
+
+class _FakeMgr:
+    def __init__(self, owners: dict[str, str]) -> None:
+        self._owners = owners
+
+    def owner_node(self, ref) -> str:
+        return self._owners.get(ref.shm_name, "")
+
+
+class TestStageAffinityRouter:
+    """StreamingRunner._pick_worker scoring: byte locality primary,
+    next-stage planned node as the tiebreak bonus."""
+
+    def _runner(self):
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+        return StreamingRunner()
+
+    def test_input_byte_locality_wins(self):
+        r = self._runner()
+        idle = [_FakeWorker("n1"), _FakeWorker("n2")]
+        refs = [_FakeRef("x", 1000)]
+        mgr = _FakeMgr({"x": "n2"})
+        w = r._pick_worker(idle, refs, mgr, next_pref="n1")
+        # n2 owns ALL input bytes; the half-batch next-stage bonus on n1
+        # must not outweigh full locality
+        assert w.node == "n2"
+
+    def test_next_stage_bonus_breaks_ties(self):
+        r = self._runner()
+        idle = [_FakeWorker("n1"), _FakeWorker("n2")]
+        refs = [_FakeRef("x", 1000)]
+        mgr = _FakeMgr({})  # driver-owned: neither worker node has bytes
+        assert r._pick_worker(idle, refs, mgr, next_pref="n2").node == "n2"
+        assert r._pick_worker(idle, refs, mgr, next_pref="n1").node == "n1"
+
+    def test_prefetched_inputs_count_as_driver_local(self):
+        r = self._runner()
+        r._prefetched["x"] = object()  # cached locally by prefetch-ahead
+        idle = [_FakeWorker(""), _FakeWorker("n2")]
+        refs = [_FakeRef("x", 1000)]
+        mgr = _FakeMgr({"x": "n2"})  # owner says n2, but the copy is local
+        assert r._pick_worker(idle, refs, mgr, next_pref=None).node == ""
