@@ -374,6 +374,86 @@ def main() -> int:
         # degraded run (dead TPU tunnel fallback) must be machine-detectable
         record["backend"] = backend
 
+    # Corpus-index bench (dedup/corpus_index.py): the scenario the index
+    # exists for — one run's clips arriving against an already-indexed
+    # corpus ≥10x the run's size (BENCH_INDEX_CORPUS_MULT, default 20x —
+    # production corpora dwarf one run). Measures fragment-add and query
+    # rates plus the headline comparison: incremental dedup via index
+    # queries vs a full `semantic_dedup` re-cluster over corpus+run (the
+    # acceptance bar is ≥5x). The run's REAL embeddings (warm pass parquet
+    # output) are the query batch; the corpus is synthesized AROUND them —
+    # half jittered copies of the run's content, half interpolations
+    # between run vectors — the continuum structure real curated corpora
+    # have (new clips resemble old ones; cluster boundaries are ambiguous,
+    # so Lloyd pays its real iteration count instead of snapping in 3).
+    try:
+        from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex, incremental_dedup
+        from cosmos_curate_tpu.dedup.kmeans import semantic_dedup
+        from cosmos_curate_tpu.pipelines.video.dedup import load_embeddings
+
+        run_ids, run_vecs, emb_model = load_embeddings(str(tmp / "out_warm"))
+        rng = np.random.default_rng(11)
+        run_n, dim = run_vecs.shape
+        mult = max(10, int(os.environ.get("BENCH_INDEX_CORPUS_MULT", "20")))
+        corpus_n = max(mult * run_n, 640)
+        half = corpus_n // 2
+        similar = (
+            np.repeat(run_vecs, (half + run_n - 1) // run_n, 0)[:half]
+            + 0.2 * rng.standard_normal((half, dim))
+        ).astype(np.float32)
+        a = rng.integers(0, run_n, corpus_n - half)
+        b = rng.integers(0, run_n, corpus_n - half)
+        alpha = rng.uniform(0, 1, (corpus_n - half, 1)).astype(np.float32)
+        between = (
+            alpha * run_vecs[a] + (1 - alpha) * run_vecs[b]
+            + 0.25 * rng.standard_normal((corpus_n - half, dim))
+        ).astype(np.float32)
+        corpus_vecs = np.concatenate([similar, between])
+        corpus_ids = [f"corpus-{i}" for i in range(corpus_n)]
+        log(
+            f"bench: index bench — {len(run_ids)} run clips vs "
+            f"{corpus_n}-vector corpus (dim {run_vecs.shape[1]})"
+        )
+        index = CorpusIndex.build(
+            str(tmp / "bench_index"), corpus_ids, corpus_vecs,
+            model=emb_model, metrics_name="bench_index",
+        )
+        # Both paths warm once outside their timed windows (bench policy:
+        # compile excluded via warmup; the persistent compile cache makes
+        # production compiles disk hits). Incremental runs on the pre-built
+        # index BEFORE the run is added — the production scenario is "new
+        # clips arrive against the existing corpus".
+        incremental_dedup(index, run_ids, run_vecs, eps=0.07)
+        t0 = time.monotonic()
+        inc = incremental_dedup(index, run_ids, run_vecs, eps=0.07)
+        inc_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        index.query(run_vecs)
+        query_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        index.add(run_ids, run_vecs)
+        add_s = time.monotonic() - t0
+        full_input = np.concatenate([corpus_vecs, run_vecs])
+        full_ids = corpus_ids + run_ids
+        semantic_dedup(full_input, full_ids, eps=0.07)  # warm the Lloyd jits
+        t0 = time.monotonic()
+        semantic_dedup(full_input, full_ids, eps=0.07)
+        full_s = time.monotonic() - t0
+        record["index_add_clips_per_sec"] = round(len(run_ids) / add_s, 1) if add_s > 0 else 0.0
+        record["index_queries_per_sec"] = round(len(run_ids) / query_s, 1) if query_s > 0 else 0.0
+        record["dedup_incremental_s"] = round(inc_s, 3)
+        record["dedup_full_recluster_s"] = round(full_s, 3)
+        record["dedup_speedup"] = round(full_s / inc_s, 1) if inc_s > 0 else 0.0
+        record["dedup_corpus_size"] = corpus_n
+        log(
+            f"bench: incremental dedup {inc_s:.2f}s vs full re-cluster "
+            f"{full_s:.2f}s ({record['dedup_speedup']}x); "
+            f"add {record['index_add_clips_per_sec']} clips/s, "
+            f"query {record['index_queries_per_sec']} q/s"
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"bench: index bench failed ({e}); clips/s still valid")
+
     # flight-recorder artifact for the warm pass (written by run_split's
     # finalize since the pass ran with tracing): every BENCH row points at
     # the report that explains its number

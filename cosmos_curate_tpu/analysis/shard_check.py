@@ -480,6 +480,25 @@ def default_contracts(mesh: dict[str, int]) -> list[ShardContract]:
             pads_batch=True,
         )
     )
+
+    # dedup/corpus_index.py — the IVF query matmul: queries sharded over the
+    # batch axes (shard_batch pad contract), the probed corpus shard
+    # replicated; the real shard_map call site is traced abstractly
+    from cosmos_curate_tpu.dedup.corpus_index import query_matmul
+
+    contracts.append(
+        ShardContract(
+            name="ivf-query",
+            where="dedup/corpus_index.py",
+            inputs=(
+                AbstractInput((32, 64), "float32", (BATCH_AXES,), name="queries"),
+                AbstractInput((128, 64), "float32", (), name="corpus"),
+            ),
+            forward=lambda amesh, q, c: query_matmul(amesh, q, c, top_k=4),
+            needs_mesh=True,
+            pads_batch=True,
+        )
+    )
     return contracts
 
 

@@ -1,0 +1,202 @@
+"""`cosmos-curate-tpu index …` — manage the persistent corpus embedding index.
+
+The operational surface over dedup/corpus_index.py:
+
+    index build  --input-path <split-root>   train centroids + shard a run's
+                                             embeddings (also folds any
+                                             pending in-pipeline fragments)
+    index add    --input-path <split-root>   route another run into an
+                                             existing index (no re-cluster)
+    index query  --input-path <split-root>   dedup-query a run against the
+                                             index; optional CSV in the
+                                             dedup_summary format `local
+                                             shard --dedup-csv` consumes
+    index stats  --index-path <root>         meta + shard/pending counts
+
+``--index-path`` defaults to ``<input>/index`` — the same root
+``local split --corpus-index`` writes in-pipeline fragments to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def register(sub: argparse._SubParsersAction) -> None:
+    index = sub.add_parser(
+        "index", help="persistent corpus embedding index (IVF dedup queries)"
+    )
+    isub = index.add_subparsers(dest="subcommand", metavar="action")
+
+    def _common(p: argparse.ArgumentParser, needs_input: bool = True) -> None:
+        if needs_input:
+            p.add_argument(
+                "--input-path", required=True,
+                help="split output root (with embeddings/<model>/)",
+            )
+        p.add_argument(
+            "--index-path", default="",
+            help="index root (default: <input>/index)",
+        )
+        p.add_argument("--embedding-model", default="", help='"" = first found')
+        p.add_argument("--no-mesh", action="store_true")
+
+    build = isub.add_parser(
+        "build", help="train centroids and shard a run's embeddings"
+    )
+    _common(build)
+    build.add_argument("--k", type=int, default=0, help="clusters (0 = sqrt(N))")
+    build.add_argument("--iters", type=int, default=20)
+    build.set_defaults(func=_cmd_build)
+
+    add = isub.add_parser("add", help="route a run's embeddings into an existing index")
+    _common(add)
+    add.set_defaults(func=_cmd_add)
+
+    query = isub.add_parser(
+        "query", help="dedup-query a run's embeddings against the index"
+    )
+    _common(query)
+    query.add_argument("--eps", type=float, default=0.07)
+    query.add_argument("--nprobe", type=int, default=0, help="0 = index default")
+    query.add_argument("--top-k", type=int, default=8)
+    query.add_argument(
+        "--output-csv", default="",
+        help="write a dedup_summary CSV (consumable by `local shard --dedup-csv`)",
+    )
+    query.set_defaults(func=_cmd_query)
+
+    stats = isub.add_parser("stats", help="index metadata + shard/pending counts")
+    stats.add_argument("--index-path", required=True)
+    stats.set_defaults(func=_cmd_stats)
+
+    index.set_defaults(func=lambda args: (index.print_help(), 2)[1])
+
+
+def _index_root(args: argparse.Namespace) -> str:
+    return (
+        args.index_path or f"{args.input_path.rstrip('/')}/index"
+    ).rstrip("/")
+
+
+def _mesh(args: argparse.Namespace):
+    if getattr(args, "no_mesh", False):
+        return None
+    try:
+        from cosmos_curate_tpu.parallel.mesh import best_effort_mesh
+
+        return best_effort_mesh()
+    except Exception as e:
+        print(f"no mesh available ({e}); single device", file=sys.stderr)
+        return None
+
+
+def _cmd_build(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+    from cosmos_curate_tpu.dedup.index_store import IndexStore
+    from cosmos_curate_tpu.pipelines.video.dedup import load_embeddings
+
+    root = _index_root(args)
+    mesh = _mesh(args)
+    ids, vecs, model = load_embeddings(args.input_path, args.embedding_model)
+    index = CorpusIndex.build(
+        root, ids, vecs, model=model,
+        k=args.k or None, iters=args.iters, mesh=mesh, metrics_name="index_cli",
+    )
+    # Pending in-pipeline fragments at this root (a --corpus-index run that
+    # skipped driver consolidation, e.g. multi-node) hold the SAME rows the
+    # writer also wrote to embeddings parquet — the build above already
+    # ingested them, so consolidating the fragments too would double every
+    # clip. Clear them instead.
+    cleared = IndexStore(root).clear_pending()
+    print(json.dumps({**index.stats(), "pending_cleared": cleared}, indent=2))
+    return 0
+
+
+def _cmd_add(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+    from cosmos_curate_tpu.pipelines.video.dedup import load_embeddings
+
+    root = _index_root(args)
+    index = CorpusIndex.open(root, mesh=_mesh(args), metrics_name="index_cli")
+    ids, vecs, model = load_embeddings(args.input_path, args.embedding_model)
+    if index.meta.get("model") and model != index.meta["model"]:
+        print(
+            f"error: run embeddings are from {model!r} but the index holds "
+            f"{index.meta['model']!r} — one embedding space per index",
+            file=sys.stderr,
+        )
+        return 2
+    added = index.add(ids, vecs)
+    print(json.dumps({**index.stats(), "added": added}, indent=2))
+    return 0
+
+
+def _cmd_query(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex, incremental_dedup
+    from cosmos_curate_tpu.pipelines.video.dedup import load_embeddings
+
+    root = _index_root(args)
+    index = CorpusIndex.open(root, mesh=_mesh(args), metrics_name="index_cli")
+    ids, vecs, model = load_embeddings(args.input_path, args.embedding_model)
+    result = incremental_dedup(
+        index, ids, vecs,
+        eps=args.eps, nprobe=args.nprobe or None, top_k=args.top_k,
+    )
+    if args.output_csv:
+        from cosmos_curate_tpu.storage.writers import write_csv
+
+        rows = [
+            {
+                "clip_uuid": cid,
+                "action": "removed",
+                "duplicate_of": result["duplicate_of"].get(cid, ""),
+            }
+            for cid in result["removed"]
+        ] + [
+            {"clip_uuid": cid, "action": "kept", "duplicate_of": ""}
+            for cid in result["kept"]
+        ]
+        write_csv(args.output_csv, rows, ["clip_uuid", "action", "duplicate_of"])
+    print(
+        json.dumps(
+            {
+                "index_path": root,
+                "embedding_model": model,
+                "eps": args.eps,
+                "num_queries": len(ids),
+                "num_kept": len(result["kept"]),
+                "num_removed": len(result["removed"]),
+                "duplicate_of": result["duplicate_of"],
+                "output_csv": args.output_csv,
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def _cmd_stats(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+    from cosmos_curate_tpu.dedup.index_store import IndexStore
+
+    root = args.index_path.rstrip("/")
+    store = IndexStore(root)
+    if not store.exists():
+        pending = len(store.list_pending())
+        print(
+            json.dumps(
+                {
+                    "index_path": root,
+                    "exists": False,
+                    "pending_fragments": pending,
+                    "hint": "run `index build` (or a --corpus-index split) first",
+                },
+                indent=2,
+            )
+        )
+        return 0 if pending else 2
+    print(json.dumps(CorpusIndex.open(root).stats(), indent=2))
+    return 0

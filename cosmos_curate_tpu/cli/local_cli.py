@@ -56,6 +56,27 @@ def register(sub: argparse._SubParsersAction) -> None:
         choices=["", "clip", "video", "video-512", "video-256", "iv2", "iv2-tiny-test"],
         default="",
     )
+    split.add_argument(
+        "--corpus-index",
+        action="store_true",
+        help="append clip embeddings to the persistent corpus index "
+        "in-pipeline (consolidated at end of run)",
+    )
+    split.add_argument(
+        "--index-path", default="", help="corpus index root (default <output>/index)"
+    )
+    split.add_argument(
+        "--incremental-dedup",
+        choices=["disable", "score-only", "enable"],
+        default="disable",
+        help="query the corpus index as clips flow; enable drops duplicates "
+        "before captioning/writing",
+    )
+    split.add_argument("--dedup-eps", type=float, default=0.07)
+    split.add_argument(
+        "--dedup-nprobe", type=int, default=0,
+        help="clusters probed per incremental-dedup query (0 = index default)",
+    )
     split.add_argument("--captioning", action="store_true")
     # static list (kept in sync with VLM_FLAVORS by a test): importing the
     # model module here would pull jax into --help, which can hang when the
@@ -146,6 +167,15 @@ def register(sub: argparse._SubParsersAction) -> None:
     dedup.add_argument("--embedding-model", default="")
     dedup.add_argument("--eps", type=float, default=0.07)
     dedup.add_argument("--n-clusters", type=int, default=0)
+    dedup.add_argument(
+        "--no-index",
+        action="store_true",
+        help="force full re-clustering even when a corpus index exists",
+    )
+    dedup.add_argument(
+        "--index-path", default="", help="corpus index root (default <input>/index)"
+    )
+    dedup.add_argument("--nprobe", type=int, default=0, help="0 = index default")
     dedup.set_defaults(func=_cmd_dedup)
 
     shard = lsub.add_parser("shard", help="pack curated clips into webdataset tars")
@@ -301,6 +331,9 @@ def _cmd_dedup(args: argparse.Namespace) -> int:
             embedding_model=args.embedding_model,
             eps=args.eps,
             n_clusters=args.n_clusters,
+            use_index=not args.no_index,
+            index_path=args.index_path,
+            nprobe=args.nprobe,
         )
     )
     print(json.dumps(summary, indent=2))
@@ -347,6 +380,11 @@ def _cmd_split(args: argparse.Namespace) -> int:
             motion_backend=args.motion_backend,
             aesthetic_threshold=args.aesthetic_threshold,
             embedding_model=args.embedding_model,
+            corpus_index=args.corpus_index,
+            index_path=args.index_path,
+            incremental_dedup=args.incremental_dedup,
+            dedup_eps=args.dedup_eps,
+            dedup_nprobe=args.dedup_nprobe,
             captioning=args.captioning,
             caption_model=args.caption_model,
             enhance_captions=args.enhance_captions,

@@ -104,6 +104,7 @@ class ClipStats:
     num_filtered_by_aesthetic: int = 0
     num_filtered_by_text: int = 0
     num_filtered_by_semantic: int = 0
+    num_filtered_by_dedup: int = 0
     num_transcoded: int = 0
     num_with_embeddings: int = 0
     num_with_captions: int = 0
@@ -117,6 +118,7 @@ class ClipStats:
         self.num_filtered_by_aesthetic += other.num_filtered_by_aesthetic
         self.num_filtered_by_text += other.num_filtered_by_text
         self.num_filtered_by_semantic += other.num_filtered_by_semantic
+        self.num_filtered_by_dedup += other.num_filtered_by_dedup
         self.num_transcoded += other.num_transcoded
         self.num_with_embeddings += other.num_with_embeddings
         self.num_with_captions += other.num_with_captions
@@ -158,6 +160,9 @@ class Clip:
     event_captions: list[str] = field(default_factory=list)  # parallel to tracks
     annotated_mp4: bytes | None = None
     filtered_by: str = ""  # which filter removed this clip ("" = kept)
+    # set by incremental dedup: the indexed clip this one duplicates
+    # (within eps cosine distance); empty = no duplicate found / not checked
+    duplicate_of: str = ""
     errors: dict[str, str] = field(default_factory=dict)
 
     @property

@@ -1,0 +1,467 @@
+"""Persistent sharded IVF corpus index: dedup queries instead of per-run
+k-means.
+
+The per-run dedup (dedup/kmeans.py + pipelines/video/dedup.py) re-clusters
+every run against itself — O(N·K·iters) each time, the wrong asymptotics
+once every new clip must dedup against **all previously curated
+embeddings** (ROADMAP item 5). This module turns the existing pjit k-means
+into an IVF trainer and makes similarity search a first-class, device-
+parallel pipeline surface:
+
+- **centroids** come from :func:`~cosmos_curate_tpu.dedup.kmeans.kmeans_fit`
+  (replicated centroids, mesh-sharded points — the trainer is unchanged);
+- **corpus vectors** live in per-cluster shards (dedup/index_store.py:
+  lance fragments when pylance imports, parquet fallback), appended
+  in-pipeline by ``ClipWriterStage`` and consolidated at end of run;
+- **queries** are batched, routed to the top-``nprobe`` clusters by one
+  centroid matmul, then scored as ONE MXU matmul per probed shard via
+  :func:`query_matmul` — a ``shard_map`` over the mesh's batch axes
+  (``parallel/axes.py``), queries sharded, the shard replicated, exactly
+  the SNIPPETS [3] naive-batch-sharding shape. Query groups pad to pow2
+  buckets so the compiled-shape universe stays bounded.
+
+Query cost is O(probed shards) per batch instead of O(N·K·iters) per run;
+``incremental_dedup`` reproduces ``semantic_dedup``'s greedy keep-first
+semantics against the index (batch-internal duplicates included). Every
+add/query records ``pipeline_index_*`` metrics through
+``observability/stage_timer.record_index_ops``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import numpy as np
+
+from cosmos_curate_tpu.dedup.index_store import IndexStore, allow_random_provenance, normalize_rows
+from cosmos_curate_tpu.models.batching import next_pow2
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_NPROBE = 8
+DEFAULT_TOP_K = 8
+# Loaded cluster shards cached per index instance (id list + matrix); the
+# cap bounds host memory on wide probe patterns. Must comfortably exceed
+# the typical probe UNION (≈ min(Q·nprobe, K)) or every query batch
+# re-reads its shards from storage — cache thrash, not caching.
+CLUSTER_CACHE_ENTRIES_ENV = "CURATE_INDEX_CACHE_SHARDS"
+DEFAULT_CLUSTER_CACHE_ENTRIES = 512
+
+
+def _cluster_cache_entries() -> int:
+    import os
+
+    return max(
+        1, int(os.environ.get(CLUSTER_CACHE_ENTRIES_ENV, "") or DEFAULT_CLUSTER_CACHE_ENTRIES)
+    )
+
+
+def query_matmul(mesh, queries, corpus, *, top_k: int):
+    """Score a query batch against one corpus shard: ``[Q, D] @ [D, N]`` +
+    per-row top-k, shard_map'd so the query batch shards over the mesh's
+    batch axes while the corpus shard is replicated — the similarity search
+    rides the MXU device-parallel like every other hot path. Accepts an
+    ``AbstractMesh`` too, so shardcheck's ``ivf-query`` contract traces this
+    exact call site device-free (analysis/shard_check.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from cosmos_curate_tpu.parallel.axes import BATCH_AXES
+    from cosmos_curate_tpu.parallel.sharding import shard_map
+
+    axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    qspec = P(axes) if axes else P(None)
+
+    def _local(q, c):
+        # unpack/re-pack: top_k's raw output is a list pytree in some jax
+        # versions, which would mismatch the tuple out_specs
+        vals, idxs = jax.lax.top_k(q @ c.T, top_k)
+        return vals, idxs
+
+    return shard_map(
+        _local, mesh=mesh, in_specs=(qspec, P()), out_specs=(qspec, qspec)
+    )(queries, corpus)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_single(q, c, k: int):
+    """Single-device fallback of :func:`query_matmul` (no mesh attached)."""
+    return jax.lax.top_k(q @ c.T, k)
+
+
+class CorpusIndex:
+    """One opened index: centroids + meta in memory, cluster shards loaded
+    (and cached) on demand. Construction is cheap; ``build`` / ``open`` are
+    the entry points."""
+
+    def __init__(
+        self,
+        store: IndexStore,
+        meta: dict,
+        centroids: np.ndarray,
+        *,
+        mesh=None,
+        metrics_name: str = "corpus_index",
+    ) -> None:
+        self.store = store
+        self.meta = meta
+        self.centroids = np.asarray(centroids, np.float32)
+        self.mesh = mesh if mesh is not None and getattr(mesh, "size", 1) > 1 else None
+        self.metrics_name = metrics_name
+        self._cluster_cache: dict[int, tuple[list[str], np.ndarray]] = {}
+        self._mesh_jit: dict[int, object] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @staticmethod
+    def exists(root: str) -> bool:
+        return IndexStore(root).exists()
+
+    @classmethod
+    def open(cls, root: str, *, mesh=None, metrics_name: str = "corpus_index") -> "CorpusIndex":
+        store = IndexStore(root)
+        if not store.exists():
+            raise FileNotFoundError(f"no corpus index at {root} (run `index build` first)")
+        return cls(
+            store, store.load_meta(), store.load_centroids(),
+            mesh=mesh, metrics_name=metrics_name,
+        )
+
+    @classmethod
+    def build(
+        cls,
+        root: str,
+        ids: list[str],
+        vecs: np.ndarray,
+        *,
+        model: str = "",
+        k: int | None = None,
+        iters: int = 20,
+        seed: int = 0,
+        mesh=None,
+        provenance: str = "",
+        backend: str | None = None,
+        metrics_name: str = "corpus_index",
+    ) -> "CorpusIndex":
+        """Train centroids on ``vecs`` (reusing the pjit k-means) and write
+        the initial per-cluster shards."""
+        from cosmos_curate_tpu.dedup.kmeans import kmeans_fit
+
+        if len(ids) == 0:
+            raise ValueError("cannot build an index from zero vectors")
+        t0 = time.monotonic()
+        normed = normalize_rows(vecs)
+        k = k or max(1, int(np.sqrt(len(ids))))
+        centroids, assign = kmeans_fit(normed, k, iters=iters, seed=seed, mesh=mesh)
+        store = IndexStore(root, backend=backend)
+        store.save_centroids(centroids)
+        for cid in np.unique(assign):
+            members = np.flatnonzero(assign == cid)
+            store.append_cluster(
+                int(cid), [ids[m] for m in members], normed[members]
+            )
+        meta = {
+            "version": 1,
+            "model": model,
+            "dim": int(normed.shape[1]),
+            "k": int(centroids.shape[0]),
+            "num_vectors": len(ids),
+            "nprobe_default": DEFAULT_NPROBE,
+            "provenance": provenance,
+        }
+        store.save_meta(meta)
+        _record_index_ops(metrics_name, adds=len(ids), add_s=time.monotonic() - t0)
+        logger.info(
+            "built corpus index at %s: %d vectors, %d clusters, dim %d",
+            root, len(ids), meta["k"], meta["dim"],
+        )
+        return cls(store, store.load_meta(), centroids, mesh=mesh, metrics_name=metrics_name)
+
+    # -- writes --------------------------------------------------------------
+
+    def add(self, ids: list[str], vecs: np.ndarray, *, normalized: bool = False) -> int:
+        """Route ``vecs`` to their nearest centroids and append per-cluster
+        fragments — O(N·K) routing + append IO, no re-clustering."""
+        if len(ids) == 0:
+            return 0
+        t0 = time.monotonic()
+        normed = np.asarray(vecs, np.float32) if normalized else normalize_rows(vecs)
+        if normed.shape[1] != self.meta["dim"]:
+            raise ValueError(
+                f"vector dim {normed.shape[1]} != index dim {self.meta['dim']}"
+            )
+        assign = np.argmax(normed @ self.centroids.T, axis=1)
+        for cid in np.unique(assign):
+            members = np.flatnonzero(assign == cid)
+            self.store.append_cluster(
+                int(cid), [ids[m] for m in members], normed[members]
+            )
+            self._cluster_cache.pop(int(cid), None)  # shard grew; reload on demand
+        self.meta["num_vectors"] = int(self.meta.get("num_vectors", 0)) + len(ids)
+        self.store.save_meta(self.meta)
+        _record_index_ops(self.metrics_name, adds=len(ids), add_s=time.monotonic() - t0)
+        return len(ids)
+
+    # -- queries -------------------------------------------------------------
+
+    def _load_cluster(self, cid: int) -> tuple[list[str], np.ndarray]:
+        cached = self._cluster_cache.get(cid)
+        if cached is not None:
+            return cached
+        ids, vecs = self.store.read_cluster(cid)
+        if len(self._cluster_cache) >= _cluster_cache_entries():
+            self._cluster_cache.pop(next(iter(self._cluster_cache)))
+        self._cluster_cache[cid] = (ids, vecs)
+        return ids, vecs
+
+    def _device_topk(self, q: np.ndarray, corpus: np.ndarray, k: int):
+        """One scoring matmul on the device plane: shard_map over the mesh's
+        batch axes when a multi-device mesh is attached, plain jit otherwise.
+        Returns host (vals, idxs)."""
+        if self.mesh is not None:
+            from cosmos_curate_tpu.parallel.sharding import shard_batch, unshard_batch
+
+            fn = self._mesh_jit.get(k)
+            if fn is None:
+                fn = jax.jit(functools.partial(query_matmul, self.mesh, top_k=k))
+                self._mesh_jit[k] = fn
+            placed, pad = shard_batch(self.mesh, q)
+            vals, idxs = fn(placed, corpus)
+            return unshard_batch(jax.device_get((vals, idxs)), pad)
+        return jax.device_get(_topk_single(q, corpus, k))
+
+    def query(
+        self,
+        vecs: np.ndarray,
+        *,
+        top_k: int = DEFAULT_TOP_K,
+        nprobe: int | None = None,
+        normalized: bool = False,
+    ) -> list[list[tuple[str, float]]]:
+        """Batched ANN search: per query, the ``top_k`` most-similar indexed
+        vectors (id, cosine similarity), sorted descending, drawn from the
+        union of every query's top-``nprobe`` centroid clusters. Each
+        probed shard costs one device matmul over the pow2-padded subset
+        of queries that probed it."""
+        n = len(vecs)
+        if n == 0:
+            return []
+        t0 = time.monotonic()
+        q = np.asarray(vecs, np.float32) if normalized else normalize_rows(vecs)
+        k_clusters = self.centroids.shape[0]
+        nprobe = min(nprobe or int(self.meta.get("nprobe_default", DEFAULT_NPROBE)), k_clusters)
+        cent_sims = q @ self.centroids.T  # [Q, K] — the routing matmul
+        probed = np.argpartition(cent_sims, -nprobe, axis=1)[:, -nprobe:]
+        by_cluster: dict[int, list[int]] = {}
+        for qi in range(n):
+            for cid in probed[qi]:
+                by_cluster.setdefault(int(cid), []).append(qi)
+        loaded = []
+        for cid in sorted(by_cluster):
+            cids, mat = self._load_cluster(cid)
+            if cids:
+                loaded.append((cid, cids, mat))
+        # per-QUERY probe count (Σ over queries of non-empty probed shards,
+        # ≈ n·nprobe), not the batch's deduplicated union — the metric's
+        # ratio to queries must read as the effective nprobe
+        probes = sum(len(by_cluster[cid]) for cid, _cids, _mat in loaded)
+        if not loaded:
+            results: list[list[tuple[str, float]]] = [[] for _ in range(n)]
+        else:
+            results = self._query_per_shard(q, by_cluster, loaded, top_k)
+        _record_index_ops(
+            self.metrics_name,
+            queries=n, probes=probes, query_s=time.monotonic() - t0,
+        )
+        return results
+
+    def _query_per_shard(
+        self, q: np.ndarray, by_cluster: dict, loaded: list, top_k: int
+    ) -> list[list[tuple[str, float]]]:
+        """One matmul per probed shard over the pow2-padded subset of
+        queries that probed it; candidates merge on the host as arrays
+        (per-element python dict folding was the query path's second
+        bottleneck after shard loads)."""
+        n = len(q)
+        per_q_vals: list[list[np.ndarray]] = [[] for _ in range(n)]
+        per_q_ids: list[list[np.ndarray]] = [[] for _ in range(n)]
+        for cid, cids, mat in loaded:
+            qidx = by_cluster[cid]
+            sub = q[qidx]
+            # pow2 pad: bounds the compiled-shape universe to {pow2 <= Q}
+            # per shard size instead of one compile per ragged subset
+            target = next_pow2(len(qidx))
+            if target > len(qidx):
+                sub = np.concatenate(
+                    [sub, np.zeros((target - len(qidx), sub.shape[1]), np.float32)]
+                )
+            kk = min(top_k, len(cids))
+            vals, idxs = self._device_topk(sub, mat, kk)
+            vals, idxs = vals[: len(qidx)], idxs[: len(qidx)]
+            hit_ids = np.asarray(cids, object)[idxs]  # [m, kk] of id strings
+            for row, qi in enumerate(qidx):
+                per_q_vals[qi].append(vals[row])
+                per_q_ids[qi].append(hit_ids[row])
+        results: list[list[tuple[str, float]]] = []
+        for qi in range(n):
+            if not per_q_vals[qi]:
+                results.append([])
+                continue
+            v = np.concatenate(per_q_vals[qi])
+            ids_q = np.concatenate(per_q_ids[qi])
+            row: list[tuple[str, float]] = []
+            seen: set[str] = set()  # an id can surface from several shards
+            for j in np.argsort(-v):
+                hid = ids_q[j]
+                if hid in seen:
+                    continue
+                seen.add(hid)
+                row.append((str(hid), float(v[j])))
+                if len(row) == top_k:
+                    break
+            results.append(row)
+        return results
+
+    def stats(self) -> dict:
+        frags = self.store.cluster_fragment_counts()
+        return {
+            **self.meta,
+            "index_path": self.store.root,
+            "backend": self.store.backend,
+            "clusters_with_data": len(frags),
+            "fragments": int(sum(frags.values())),
+            "pending_fragments": len(self.store.list_pending()),
+        }
+
+
+# -- dedup on top of the index ------------------------------------------------
+
+
+def incremental_dedup(
+    index: CorpusIndex,
+    ids: list[str],
+    vecs: np.ndarray,
+    *,
+    eps: float = 0.07,
+    nprobe: int | None = None,
+    top_k: int = DEFAULT_TOP_K,
+) -> dict:
+    """SemDeDup-style pruning of a NEW batch against the indexed corpus —
+    the O(probed shards) replacement for re-running ``semantic_dedup`` over
+    corpus+batch. Same greedy keep-first semantics: a batch item is a
+    duplicate when an eligible neighbor sits within ``eps`` cosine distance;
+    eligible means an indexed corpus item, or an EARLIER batch item that was
+    itself kept (batch-internal duplicates are caught by an exact pass over
+    the kept set, so the result matches ``semantic_dedup`` on well-separated
+    data). Returns the ``semantic_dedup`` result shape."""
+    n = len(ids)
+    if n == 0:
+        return {"kept": [], "removed": [], "duplicate_of": {}}
+    normed = normalize_rows(vecs)
+    hits = index.query(normed, top_k=top_k, nprobe=nprobe, normalized=True)
+    threshold = 1.0 - eps
+    pos = {cid: i for i, cid in enumerate(ids)}
+    kept: list[str] = []
+    removed: list[str] = []
+    duplicate_of: dict[str, str] = {}
+    removed_set: set[str] = set()
+    kept_rows: list[int] = []
+    for i, qid in enumerate(ids):
+        dup = None
+        for hid, sim in hits[i]:
+            if sim <= threshold:
+                break  # hits sorted descending — nothing closer follows
+            if hid == qid or hid in removed_set:
+                continue
+            if pos.get(hid, -1) > i:
+                # a LATER batch item (the index may already contain this
+                # very batch, e.g. the in-pipeline writer ran first):
+                # keep-first semantics say IT defers to US, not vice versa
+                continue
+            dup = hid
+            break
+        if dup is None and kept_rows:
+            # exact batch-internal pass over the kept set: IVF top-k against
+            # the corpus cannot see batch items that are not indexed yet
+            sims = normed[kept_rows] @ normed[i]
+            j = int(np.argmax(sims))
+            if float(sims[j]) > threshold:
+                dup = ids[kept_rows[j]]
+        if dup is None:
+            kept.append(qid)
+            kept_rows.append(i)
+        else:
+            removed.append(qid)
+            duplicate_of[qid] = dup
+            removed_set.add(qid)
+    _record_index_ops(index.metrics_name, duplicates=len(removed))
+    return {"kept": kept, "removed": removed, "duplicate_of": duplicate_of}
+
+
+def consolidate_index(
+    root: str,
+    *,
+    k: int | None = None,
+    iters: int = 20,
+    mesh=None,
+    metrics_name: str = "consolidate",
+) -> dict:
+    """End-of-run consolidation: fold the pending fragments ClipWriterStage
+    appended during the run into per-cluster shards. Trains centroids via
+    the pjit k-means when the index does not exist yet; routes against the
+    existing centroids otherwise. Rows whose provenance is "random" are
+    refused (counted in the result) unless ``CURATE_INDEX_ALLOW_RANDOM``
+    opts in — noise embeddings must never become corpus memory."""
+    store = IndexStore(root)
+    ids, vecs, models, provs = store.read_pending()
+    skipped = 0
+    if ids and not allow_random_provenance():
+        keep = [i for i, p in enumerate(provs) if p != "random"]
+        skipped = len(ids) - len(keep)
+        if skipped:
+            logger.warning(
+                "index consolidation: refusing %d random-provenance vectors "
+                "(set %s=1 to override)", skipped, "CURATE_INDEX_ALLOW_RANDOM",
+            )
+        ids = [ids[i] for i in keep]
+        models = [models[i] for i in keep]
+        vecs = vecs[keep] if len(keep) else np.zeros((0, 0), np.float32)
+    result = {"consolidated": 0, "skipped_random": skipped, "pending_cleared": 0}
+    if not ids:
+        result["pending_cleared"] = store.clear_pending() if skipped else 0
+        return result
+    # one embedding space per index: mixing models would compare
+    # incompatible vectors (same rule as pipelines/video/dedup.py). An
+    # existing index pins the model; otherwise the fragments elect it.
+    model = store.load_meta().get("model") or next((m for m in models if m), "")
+    if model:
+        keep = [i for i, m in enumerate(models) if m in (model, "")]
+        if len(keep) != len(ids):
+            logger.warning(
+                "index consolidation: dropping %d rows from other embedding "
+                "models (index model: %s)", len(ids) - len(keep), model,
+            )
+        ids = [ids[i] for i in keep]
+        vecs = vecs[keep]
+    if store.exists():
+        index = CorpusIndex.open(root, mesh=mesh, metrics_name=metrics_name)
+        index.add(ids, vecs, normalized=True)
+    else:
+        CorpusIndex.build(
+            root, ids, vecs, model=model, k=k, iters=iters, mesh=mesh,
+            metrics_name=metrics_name,
+        )
+    result["consolidated"] = len(ids)
+    result["pending_cleared"] = store.clear_pending()
+    return result
+
+
+def _record_index_ops(name: str, **deltas) -> None:
+    try:
+        from cosmos_curate_tpu.observability.stage_timer import record_index_ops
+
+        record_index_ops(name, **deltas)
+    except Exception:  # metrics must never take down an index operation
+        logger.debug("index metrics recording failed", exc_info=True)

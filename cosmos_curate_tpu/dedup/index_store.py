@@ -1,0 +1,309 @@
+"""Persistent storage layer for the sharded corpus embedding index.
+
+Layout (mirroring the reference's lance fragment flow —
+``write_lance_fragments`` staged per chunk, consolidated at end of run,
+storage/lance_export.py docstring):
+
+    <root>/meta.json                   index metadata (model, dim, k, counts)
+    <root>/centroids.npy               [K, D] float32 L2-normalized centroids
+    <root>/pending/<tag>.(parquet|lance)    in-pipeline fragment appends
+    <root>/clusters/c<cid>/<frag>.(parquet|lance)   per-cluster vector shards
+
+``ClipWriterStage`` appends *pending* fragments during a run (cheap,
+append-only, no coordination); the end-of-run consolidation step routes
+them into per-cluster shards against the trained centroids
+(dedup/corpus_index.py). Fragments are **lance** datasets when ``pylance``
+imports and the root is a local path, **parquet** otherwise (VERDICT #7 —
+the lance wheel is absent from this image, so parquet is the tested
+default and lance is driven through the same ``write_dataset`` /
+``dataset`` call shape the export tool uses).
+
+Vectors are stored L2-normalized (cosine geometry, matching
+dedup/kmeans.py) with a ``provenance`` column per row — "random" rows
+(embeddings from unstaged random-init weights, models/registry.py
+``weights_provenance``) are refused at consolidation so they can never
+poison the corpus.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from cosmos_curate_tpu.storage.client import (
+    get_storage_client,
+    is_remote_path,
+    read_bytes,
+    write_bytes,
+)
+from cosmos_curate_tpu.storage.writers import write_json, write_npy, write_parquet
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ALLOW_RANDOM_ENV = "CURATE_INDEX_ALLOW_RANDOM"
+
+
+def allow_random_provenance() -> bool:
+    """Opt-in escape hatch: index vectors whose weights provenance is
+    "random" anyway (integration tests, architecture-only runs). Production
+    default is to refuse — a corpus index of noise silently dedups real
+    clips against garbage."""
+    return os.environ.get(ALLOW_RANDOM_ENV, "").lower() in ("1", "true", "on")
+
+
+def _lance_module():
+    try:
+        import lance  # noqa: PLC0415
+
+        return lance
+    except ImportError:
+        return None
+
+
+def _decode_embedding_column(column, n: int) -> np.ndarray:
+    """list<float> column -> [N, D] float32 via the arrow values buffer —
+    per-row ``to_pylist`` conversion is ~100x slower and was the query
+    path's shard-load bottleneck. Falls back to the slow path for chunk
+    layouts without a flat values buffer."""
+    if n == 0:
+        return np.zeros((0, 0), np.float32)
+    try:
+        arr = column.combine_chunks() if hasattr(column, "combine_chunks") else column
+        flat = np.asarray(arr.values, dtype=np.float32)
+        return flat.reshape(n, -1)
+    except (AttributeError, ValueError, TypeError):
+        return np.asarray(
+            [np.asarray(v, np.float32) for v in column.to_pylist()], np.float32
+        ).reshape(n, -1)
+
+
+def normalize_rows(vecs: np.ndarray) -> np.ndarray:
+    vecs = np.asarray(vecs, np.float32)
+    return vecs / np.maximum(np.linalg.norm(vecs, axis=1, keepdims=True), 1e-8)
+
+
+class IndexStore:
+    """Fragment-level IO for one index root; backend resolved once per
+    instance (pinned by ``meta.json`` when the index exists, so readers and
+    writers of one index always agree)."""
+
+    def __init__(self, root: str, *, backend: str | None = None) -> None:
+        self.root = str(root).rstrip("/")
+        meta = self.load_meta()
+        if backend is None:
+            backend = meta.get("backend") if meta else None
+        if backend is None:
+            backend = (
+                "lance"
+                if _lance_module() is not None and not is_remote_path(self.root)
+                else "parquet"
+            )
+        if backend not in ("lance", "parquet"):
+            raise ValueError(f"unknown index backend {backend!r}")
+        if backend == "lance" and (
+            _lance_module() is None or is_remote_path(self.root)
+        ):
+            logger.warning(
+                "lance backend unavailable for %s; falling back to parquet", self.root
+            )
+            backend = "parquet"
+        self.backend = backend
+
+    # -- paths ---------------------------------------------------------------
+
+    @property
+    def meta_path(self) -> str:
+        return f"{self.root}/meta.json"
+
+    @property
+    def centroids_path(self) -> str:
+        return f"{self.root}/centroids.npy"
+
+    def _fragment_path(self, *parts: str) -> str:
+        ext = "lance" if self.backend == "lance" else "parquet"
+        return f"{self.root}/{'/'.join(parts)}.{ext}"
+
+    @staticmethod
+    def cluster_dir(cid: int) -> str:
+        return f"c{cid:05d}"
+
+    # -- meta / centroids ----------------------------------------------------
+
+    def exists(self) -> bool:
+        client = get_storage_client(self.root)
+        return client.exists(self.meta_path) and client.exists(self.centroids_path)
+
+    def load_meta(self) -> dict:
+        client = get_storage_client(self.root)
+        if not client.exists(f"{self.root}/meta.json"):
+            return {}
+        try:
+            return json.loads(client.read_bytes(f"{self.root}/meta.json"))
+        except (OSError, ValueError) as e:
+            raise RuntimeError(f"unreadable index meta at {self.root}: {e}") from e
+
+    def save_meta(self, meta: dict) -> None:
+        write_json(self.meta_path, {**meta, "backend": self.backend})
+
+    def load_centroids(self) -> np.ndarray:
+        return np.load(io.BytesIO(read_bytes(self.centroids_path)))
+
+    def save_centroids(self, centroids: np.ndarray) -> None:
+        write_npy(self.centroids_path, np.asarray(centroids, np.float32))
+
+    # -- fragment IO ---------------------------------------------------------
+
+    def _write_rows(
+        self,
+        path: str,
+        ids: list[str],
+        vecs: np.ndarray,
+        *,
+        model: str = "",
+        provenance: str = "",
+    ) -> None:
+        columns = {
+            "clip_uuid": [str(i) for i in ids],
+            "embedding": [v.tolist() for v in np.asarray(vecs, np.float32)],
+            "model": [model] * len(ids),
+            "provenance": [provenance] * len(ids),
+        }
+        if self.backend == "lance":
+            import pyarrow as pa
+
+            # overwrite: fragment names are content-derived, so a re-run of
+            # the same consolidation replaces its own fragment idempotently
+            _lance_module().write_dataset(pa.table(columns), path, mode="overwrite")
+        else:
+            write_parquet(path, columns)
+
+    def _read_rows(self, path: str) -> tuple[list[str], np.ndarray, list[str], list[str]]:
+        if self.backend == "lance":
+            table = _lance_module().dataset(path).to_table()
+        else:
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(io.BytesIO(read_bytes(path)))
+        ids = [str(u) for u in table.column("clip_uuid").to_pylist()]
+        vecs = _decode_embedding_column(table.column("embedding"), len(ids))
+        names = table.column_names
+        models = table.column("model").to_pylist() if "model" in names else [""] * len(ids)
+        provs = (
+            table.column("provenance").to_pylist()
+            if "provenance" in names
+            else [""] * len(ids)
+        )
+        return ids, vecs, models, provs
+
+    def _list_fragments(self, subdir: str) -> list[str]:
+        """Fragment paths under ``<root>/<subdir>`` for this backend. Lance
+        datasets are directories, so they are found by probing the parent
+        listing for ``.lance`` path components rather than file suffixes."""
+        base = f"{self.root}/{subdir}"
+        if self.backend == "lance":
+            p = Path(base)
+            if not p.is_dir():
+                return []
+            return sorted(str(d) for d in p.iterdir() if d.name.endswith(".lance"))
+        client = get_storage_client(base)
+        return sorted(
+            info.path for info in client.list_files(base, suffixes=(".parquet",))
+        )
+
+    def _delete_fragment(self, path: str) -> None:
+        get_storage_client(path).delete(path)
+
+    # -- pending fragments (in-pipeline appends) -----------------------------
+
+    def write_pending_fragment(
+        self,
+        tag: str,
+        ids: list[str],
+        vecs: np.ndarray,
+        *,
+        model: str = "",
+        provenance: str = "",
+    ) -> str:
+        """One append-only fragment under ``pending/`` — the in-pipeline
+        write path (``ClipWriterStage``). Tags are chunk-scoped, so
+        concurrent writer workers touch disjoint files. Vectors are
+        normalized at write so every reader shares cosine geometry."""
+        path = self._fragment_path("pending", tag)
+        self._write_rows(
+            path, ids, normalize_rows(vecs), model=model, provenance=provenance
+        )
+        return path
+
+    def list_pending(self) -> list[str]:
+        return self._list_fragments("pending")
+
+    def read_pending(self) -> tuple[list[str], np.ndarray, list[str], list[str]]:
+        """All pending rows concatenated: (ids, vecs [N, D], models, provs)."""
+        ids: list[str] = []
+        chunks: list[np.ndarray] = []
+        models: list[str] = []
+        provs: list[str] = []
+        for path in self.list_pending():
+            i, v, m, p = self._read_rows(path)
+            ids.extend(i)
+            chunks.append(v)
+            models.extend(m)
+            provs.extend(p)
+        vecs = np.concatenate(chunks) if chunks else np.zeros((0, 0), np.float32)
+        return ids, vecs, models, provs
+
+    def clear_pending(self) -> int:
+        n = 0
+        for path in self.list_pending():
+            self._delete_fragment(path)
+            n += 1
+        return n
+
+    # -- per-cluster shards --------------------------------------------------
+
+    def append_cluster(self, cid: int, ids: list[str], vecs: np.ndarray) -> str:
+        """Append one fragment to cluster ``cid``'s shard. Fragment names are
+        content-derived, so re-running a consolidation over the same rows
+        overwrites rather than duplicates."""
+        tag = hashlib.sha256("|".join(map(str, ids)).encode()).hexdigest()[:16]
+        path = self._fragment_path("clusters", self.cluster_dir(cid), tag)
+        self._write_rows(path, ids, normalize_rows(vecs))
+        return path
+
+    def read_cluster(self, cid: int) -> tuple[list[str], np.ndarray]:
+        ids: list[str] = []
+        chunks: list[np.ndarray] = []
+        for path in self._list_fragments(f"clusters/{self.cluster_dir(cid)}"):
+            i, v, _m, _p = self._read_rows(path)
+            ids.extend(i)
+            chunks.append(v)
+        vecs = np.concatenate(chunks) if chunks else np.zeros((0, 0), np.float32)
+        return ids, vecs
+
+    def cluster_fragment_counts(self) -> dict[int, int]:
+        """cid -> fragment count for clusters that have any data."""
+        base = f"{self.root}/clusters"
+        out: dict[int, int] = {}
+        if self.backend == "lance":
+            root = Path(base)
+            dirs = sorted(d.name for d in root.iterdir() if d.is_dir()) if root.is_dir() else []
+            for name in dirs:
+                if name.startswith("c") and name[1:].isdigit():
+                    n = len(self._list_fragments(f"clusters/{name}"))
+                    if n:
+                        out[int(name[1:])] = n
+            return out
+        client = get_storage_client(base)
+        for info in client.list_files(base, suffixes=(".parquet",)):
+            rel = info.path[len(base) :].lstrip("/")
+            head = rel.split("/", 1)[0]
+            if head.startswith("c") and head[1:].isdigit():
+                cid = int(head[1:])
+                out[cid] = out.get(cid, 0) + 1
+        return out

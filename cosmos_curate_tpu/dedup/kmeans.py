@@ -25,9 +25,32 @@ logger = get_logger(__name__)
 
 @functools.partial(jax.jit, static_argnames=("k", "valid"))
 def _init_centroids(data: jax.Array, k: int, seed: int, valid: int) -> jax.Array:
-    # sample only real rows: mesh padding appends zero rows past ``valid``
-    idx = jax.random.choice(jax.random.PRNGKey(seed), valid, (k,), replace=False)
-    return data[idx]
+    """Greedy k-means++-style seeding: first centroid sampled from the real
+    rows, each next one the point FURTHEST (lowest max cosine similarity)
+    from every centroid chosen so far. Uniform sampling of all k seeds made
+    the result hinge on the PRNG's whims — two seeds landing in one true
+    cluster is a bad local minimum Lloyd never escapes, and which seeds you
+    get varies across jax versions/platforms (the tier-1 environment
+    sensitivity this replaced). Rows beyond ``valid`` are mesh padding and
+    masked out."""
+    n = data.shape[0]
+    mask = jnp.arange(n) < valid
+    i0 = jax.random.choice(
+        jax.random.PRNGKey(seed), n, p=mask / jnp.maximum(mask.sum(), 1)
+    )
+    cents = jnp.zeros((k, data.shape[1]), data.dtype).at[0].set(data[i0])
+    best = data @ data[i0]  # max similarity to any chosen centroid
+
+    def body(carry, j):
+        cents, best = carry
+        idx = jnp.argmin(jnp.where(mask, best, jnp.inf))
+        c = data[idx]
+        cents = cents.at[j].set(c)
+        best = jnp.maximum(best, data @ c)
+        return (cents, best), None
+
+    (cents, _), _ = jax.lax.scan(body, (cents, best), jnp.arange(1, k))
+    return cents
 
 
 @jax.jit
@@ -67,10 +90,21 @@ def kmeans_fit(
     k = min(k, n)
     data = embeddings / np.maximum(np.linalg.norm(embeddings, axis=1, keepdims=True), 1e-8)
     valid = n
+    # Degrade cleanly instead of crashing the dedup run: a 1-device mesh
+    # (the CPU tier-1 environment) adds nothing but sharding overhead, and a
+    # mesh the batch cannot ride (device-put failure, dead backend) must
+    # fall back to the single-device path — same numerics either way.
+    if mesh is not None and getattr(mesh, "size", 1) <= 1:
+        mesh = None
     if mesh is not None:
         from cosmos_curate_tpu.parallel.sharding import shard_batch
 
-        data, _pad = shard_batch(mesh, data.astype(np.float32))
+        try:
+            data, _pad = shard_batch(mesh, data.astype(np.float32))
+        except Exception as e:
+            logger.warning("mesh sharding unavailable (%s); single-device kmeans", e)
+            mesh = None
+            data = jnp.asarray(data, jnp.float32)
     else:
         data = jnp.asarray(data, jnp.float32)
 

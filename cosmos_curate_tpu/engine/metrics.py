@@ -142,6 +142,39 @@ class EngineMetrics:
             "batch inputs demand-fetched (no prefetch landed first)",
             node_labels,
         )
+        # Corpus-index signal (dedup/corpus_index.py via
+        # stage_timer.record_index_ops): vectors entering the persistent
+        # index, query traffic, probe fan-out, and time spent on each side.
+        # Healthy incremental dedup reads as queries tracking clip flow with
+        # query_seconds << what a full re-cluster would cost; probes rising
+        # against queries means nprobe (recall) is being bought with extra
+        # shard matmuls. skipped_random > 0 flags a run whose embeddings
+        # were refused for random-weight provenance.
+        self.index_adds = Counter(
+            "pipeline_index_adds_total", "vectors added to the corpus index", labels
+        )
+        self.index_add_seconds = Counter(
+            "pipeline_index_add_seconds_total",
+            "seconds spent appending/consolidating index fragments", labels,
+        )
+        self.index_queries = Counter(
+            "pipeline_index_queries_total", "index query vectors", labels
+        )
+        self.index_query_seconds = Counter(
+            "pipeline_index_query_seconds_total",
+            "seconds spent in index query batches", labels,
+        )
+        self.index_probes = Counter(
+            "pipeline_index_probes_total", "cluster shards probed by queries", labels
+        )
+        self.index_duplicates = Counter(
+            "pipeline_index_duplicates_total",
+            "query vectors flagged duplicate of an indexed neighbor", labels,
+        )
+        self.index_skipped_random = Counter(
+            "pipeline_index_skipped_random_total",
+            "vectors refused for random-weight provenance", labels,
+        )
         # Per-node flow (engine/runner.py metrics tick): workers placed on
         # and CPU units used per connected node — the per-node counterpart
         # of pipeline_actor_count, so a merged dashboard shows which host
@@ -226,6 +259,22 @@ class EngineMetrics:
         self.caption_prefix_saved.labels(stage).inc(
             max(0, int(phases.get("prefix_tokens_saved", 0)))
         )
+
+    def observe_index(self, stage: str, deltas: dict) -> None:
+        """Fold one corpus-index operation's deltas (the
+        stage_timer.INDEX_OP_KEYS schema) into the counters."""
+        if not self.enabled:
+            return
+        for counter, key in (
+            (self.index_adds, "adds"),
+            (self.index_add_seconds, "add_s"),
+            (self.index_queries, "queries"),
+            (self.index_query_seconds, "query_s"),
+            (self.index_probes, "probes"),
+            (self.index_duplicates, "duplicates"),
+            (self.index_skipped_random, "skipped_random"),
+        ):
+            counter.labels(stage).inc(max(0.0, float(deltas.get(key, 0))))
 
     def observe_object_plane(self, node: str, deltas: dict) -> None:
         """Fold one object-plane delta set (stage_timer.OBJECT_PLANE_KEYS

@@ -311,6 +311,40 @@ def load_params(
     return init_fn(seed)
 
 
+# checkpoint digests cached per (path, mtime): hashing a multi-GB
+# checkpoint once per process is fine, once per written chunk is not
+_PROVENANCE_CACHE: dict[tuple[str, int], str] = {}
+
+
+def weights_provenance(model_id: str) -> str:
+    """Where ``model_id``'s weights would come from RIGHT NOW:
+    ``"checkpoint:<sha256-12>"`` when a checkpoint is staged/committed,
+    ``"random"`` otherwise (the seeded-init fallback ``load_params`` warns
+    about). Downstream consumers use this to refuse noise — e.g. the corpus
+    index (dedup/index_store.py) never ingests random-provenance
+    embeddings. Only positive results are cached (keyed by path + mtime),
+    so weights staged later in-process are picked up."""
+    ckpt = find_checkpoint(model_id)
+    if ckpt is None:
+        return "random"
+    try:
+        key = (str(ckpt), ckpt.stat().st_mtime_ns)
+    except OSError:
+        return "random"
+    cached = _PROVENANCE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import hashlib
+
+    digest = hashlib.sha256()
+    with ckpt.open("rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 22), b""):
+            digest.update(chunk)
+    prov = f"checkpoint:{digest.hexdigest()[:12]}"
+    _PROVENANCE_CACHE[key] = prov
+    return prov
+
+
 def save_params(model_id: str, params: Any, *, root: Path | str | None = None) -> Path:
     """Write staged weights into the registry location (or under ``root``
     — e.g. the repo's committed weights/ tree). Single source of truth for

@@ -231,6 +231,7 @@ def runner_stats(runner: Any) -> dict:
     from cosmos_curate_tpu.observability.stage_timer import (
         caption_phase_summaries,
         dispatch_summaries,
+        index_op_summaries,
         object_plane_summaries,
         stage_flow_summaries,
     )
@@ -239,6 +240,9 @@ def runner_stats(runner: Any) -> dict:
         "dispatch": dispatch_summaries(),
         "stage_flow": stage_flow_summaries(),
         "caption_phases": caption_phase_summaries(),
+        # corpus-index traffic (adds/queries/probe fan-out per recorder
+        # name) — the pipeline_index_* counters' end-of-run snapshot
+        "index_ops": index_op_summaries(),
         # cross-host transfers per node (driver's own + relayed agent
         # deltas); the engine runner also snapshots this as
         # ``runner.object_plane`` at finalize
@@ -307,7 +311,7 @@ def load_node_stats(output_path: str) -> dict | None:
     except Exception:
         return None
     merged: dict[str, Any] = {
-        "dispatch": {}, "stage_flow": {}, "caption_phases": {},
+        "dispatch": {}, "stage_flow": {}, "caption_phases": {}, "index_ops": {},
         "object_plane": {}, "stage_times": {}, "stage_counts": {},
         "dead_lettered": 0,
     }
@@ -323,7 +327,7 @@ def load_node_stats(output_path: str) -> dict | None:
             continue
         found = True
         rank = stats.get("node_rank", "?")
-        for key in ("dispatch", "stage_flow", "caption_phases"):
+        for key in ("dispatch", "stage_flow", "caption_phases", "index_ops"):
             for name, agg in (stats.get(key) or {}).items():
                 merged[key][f"n{rank}/{name}"] = agg
         # object-plane aggregates are already keyed per node: sum numeric
@@ -397,6 +401,7 @@ def build_run_report(
     report["dispatch"] = stats["dispatch"]
     report["stage_flow"] = stats["stage_flow"]
     report["caption_phases"] = stats["caption_phases"]
+    report["index_ops"] = stats["index_ops"]
     report["object_plane"] = stats["object_plane"]
     if stats.get("node_plan"):
         report["node_plan"] = stats["node_plan"]
@@ -422,8 +427,9 @@ def build_run_report(
         # stage_times/wall_s are handled above (they have span-derived
         # fallbacks that would always win this not-set check)
         for key in (
-            "dispatch", "stage_flow", "caption_phases", "object_plane",
-            "node_plan", "stage_counts", "dead_lettered", "dlq_run_dir",
+            "dispatch", "stage_flow", "caption_phases", "index_ops",
+            "object_plane", "node_plan", "stage_counts", "dead_lettered",
+            "dlq_run_dir",
         ):
             if not report.get(key) and prior.get(key):
                 report[key] = prior[key]
@@ -540,6 +546,17 @@ def render_report(report: dict) -> str:
                 f"{nid or 'driver'}={n}" for nid, n in sorted(counts.items())
             )
             lines.append(f"  {stage:<40} {placed}")
+    index_ops = report.get("index_ops") or {}
+    if index_ops:
+        lines.append("corpus index:")
+        for name, agg in sorted(index_ops.items()):
+            lines.append(
+                f"  {name:<40} adds {agg.get('adds', 0):7d}  "
+                f"queries {agg.get('queries', 0):7d}  "
+                f"dupes {agg.get('duplicates', 0):6d}  "
+                f"probe_fanout {agg.get('probe_fanout_mean', 0.0):.2f}  "
+                f"query {agg.get('query_s', 0.0):.2f}s"
+            )
     caption = report.get("caption_phases") or {}
     if caption:
         lines.append("caption engine phases:")

@@ -422,6 +422,75 @@ def reset_caption_phases() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Corpus-index aggregates (dedup/corpus_index.py + the writer's in-pipeline
+# fragment appends): vectors added, query batches, probe fan-out, and the
+# wall time each side cost. Bounded per-name aggregates like the rest of
+# this module; the ``pipeline_index_*`` prometheus counters carry the
+# stream and the flight recorder snapshots the summary into run_report.
+_INDEX_LOCK = threading.Lock()
+_INDEX: dict[str, dict] = {}
+
+INDEX_OP_KEYS = (
+    "adds", "add_s", "queries", "query_s", "probes", "duplicates",
+    "skipped_random",
+)
+
+
+def _new_index_agg() -> dict:
+    return {k: 0.0 for k in INDEX_OP_KEYS}
+
+
+def record_index_ops(name: str, **deltas: float) -> None:
+    """Fold corpus-index operation deltas (any subset of INDEX_OP_KEYS)
+    into ``name``'s aggregate and forward them to the engine's
+    ``pipeline_index_*`` counters (no-op without an exporter)."""
+    with _INDEX_LOCK:
+        agg = _INDEX.setdefault(name, _new_index_agg())
+        for k, v in deltas.items():
+            if k in INDEX_OP_KEYS:
+                agg[k] += float(v)
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_index(name, deltas)
+    except Exception:  # metrics must never take down an index operation
+        pass
+
+
+def index_op_summaries() -> dict[str, dict]:
+    """name -> index aggregate. ``probe_fanout_mean`` is non-empty probed
+    shards per query vector (≈ the effective nprobe) — the knob-vs-recall
+    signal (raise nprobe, pay more shard matmuls); ``queries_per_sec`` is
+    the headline the bench row carries."""
+    out: dict[str, dict] = {}
+    with _INDEX_LOCK:
+        items = {k: dict(v) for k, v in _INDEX.items()}
+    for name, agg in items.items():
+        out[name] = {
+            "adds": int(agg["adds"]),
+            "add_s": round(agg["add_s"], 4),
+            "queries": int(agg["queries"]),
+            "query_s": round(agg["query_s"], 4),
+            "probes": int(agg["probes"]),
+            "duplicates": int(agg["duplicates"]),
+            "skipped_random": int(agg["skipped_random"]),
+            "probe_fanout_mean": (
+                round(agg["probes"] / agg["queries"], 4) if agg["queries"] else 0.0
+            ),
+            "queries_per_sec": (
+                round(agg["queries"] / agg["query_s"], 2) if agg["query_s"] > 0 else 0.0
+            ),
+            "node": node_id(),
+        }
+    return out
+
+
+def reset_index_ops() -> None:
+    with _INDEX_LOCK:
+        _INDEX.clear()
+
+
+# ---------------------------------------------------------------------------
 # Object-plane transfer aggregates (engine/object_channel.py consumers): how
 # many bytes crossed hosts, how long consumers WAITED for them, and whether
 # push-ahead prefetch hid the transfer behind compute. Bounded per-process

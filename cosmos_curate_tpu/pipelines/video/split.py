@@ -71,6 +71,16 @@ class SplitPipelineArgs:
     semantic_filter: str = "disable"  # disable | score-only | enable
     semantic_filter_prompt: str = "default"
     embedding_model: str = ""  # "" | "clip" | "video"
+    # persistent corpus index (dedup/corpus_index.py): write pending index
+    # fragments in-pipeline (ClipWriterStage) and consolidate them into
+    # per-cluster shards at end of run
+    corpus_index: bool = False
+    index_path: str = ""  # "" = <output>/index
+    # incremental dedup against that index as clips flow (disable |
+    # score-only | enable); enable drops duplicates before the writer
+    incremental_dedup: str = "disable"
+    dedup_eps: float = 0.07
+    dedup_nprobe: int = 0  # 0 = index default
     # multicam sessions: input_path holds <session>/<camera>.mp4 dirs;
     # spans come from the primary camera, aux cameras split time-aligned
     multicam: bool = False
@@ -198,6 +208,26 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
         from cosmos_curate_tpu.pipelines.video.stages.embedding import ClipEmbeddingStage
 
         stages.append(ClipEmbeddingStage(variant=args.embedding_model, extraction=primary_sig))
+    if args.incremental_dedup != "disable":
+        from cosmos_curate_tpu.pipelines.video.stages.dedup_stage import (
+            IncrementalDedupStage,
+        )
+
+        if not args.embedding_model:
+            raise ValueError(
+                "--incremental-dedup needs an --embedding-model: dedup "
+                "queries the corpus index with this run's clip embeddings"
+            )
+        # directly after embedding: duplicates are flagged/dropped before
+        # captioning, previews, and the writer's embedding/index writes
+        stages.append(
+            IncrementalDedupStage(
+                resolve_index_path(args),
+                eps=args.dedup_eps,
+                nprobe=args.dedup_nprobe,
+                score_only=args.incremental_dedup == "score-only",
+            )
+        )
     if args.captioning:
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (
             CaptionPrepStage,
@@ -238,8 +268,19 @@ def assemble_stages(args: SplitPipelineArgs) -> list[Stage | StageSpec]:
 
         stages.append(PerEventCaptionStage(model_flavor=args.caption_model))
     stages.extend(args.extra_stages)
-    stages.append(ClipWriterStage(args.output_path))
+    stages.append(
+        ClipWriterStage(
+            args.output_path,
+            index_path=resolve_index_path(args) if args.corpus_index else "",
+        )
+    )
     return stages
+
+
+def resolve_index_path(args: SplitPipelineArgs) -> str:
+    """The corpus-index root this run writes fragments to / queries:
+    explicit ``index_path`` or ``<output>/index``."""
+    return (args.index_path or f"{args.output_path.rstrip('/')}/index").rstrip("/")
 
 
 def run_split(
@@ -288,6 +329,7 @@ def run_split(
     # run() resets the runner's DLQ accounting — accumulate drops here so
     # finalize reports the whole node, not the last batch
     steal_dead: dict = {"count": 0, "dirs": []}
+    index_extra: dict = {}
     run_root = None
     # tracing setup sits immediately before the try whose finally tears it
     # down: anything risky in between (runner resolution, distributed init)
@@ -402,6 +444,10 @@ def run_split(
             # data parallelism; resume records keep re-runs consistent)
             tasks = partition_tasks_for_node(tasks)
             out = run_pipeline(tasks, stages, config=config, runner=runner) or []
+        if args.corpus_index and n_nodes == 1:
+            # end-of-run consolidation, BEFORE finalize so its
+            # pipeline_index_* aggregates land in run_report.json
+            index_extra = _consolidate_corpus_index(args)
     finally:
         if args.tracing:
             from cosmos_curate_tpu.observability.tracing import (
@@ -465,10 +511,12 @@ def run_split(
                     )
     elapsed = time.monotonic() - t0
     num_chips = args.num_chips or _discover_num_chips()
-    summary = build_summary(out, pipeline_run_time_s=elapsed, num_chips=num_chips)
     from cosmos_curate_tpu.parallel.distributed import node_rank_and_count
 
     rank, _ = node_rank_and_count()
+    summary = build_summary(
+        out, pipeline_run_time_s=elapsed, num_chips=num_chips, extra=index_extra or None
+    )
     name = "summary.json" if rank == 0 else f"summary-node{rank}.json"
     write_summary(f"{args.output_path.rstrip('/')}/{name}", summary)
     logger.info(
@@ -476,6 +524,33 @@ def run_split(
         summary["num_videos"], summary["num_clips"], elapsed,
     )
     return summary
+
+
+def _consolidate_corpus_index(args: SplitPipelineArgs) -> dict:
+    """Fold the writer's pending index fragments into per-cluster shards
+    (training centroids on the first run). Single-node only: concurrent
+    per-node consolidations would race on centroids/meta — multi-node runs
+    leave pending fragments for `cosmos-curate-tpu index build` after
+    merge. Failures never fail the run."""
+    try:
+        from cosmos_curate_tpu.dedup.corpus_index import consolidate_index
+
+        mesh = None
+        try:
+            from cosmos_curate_tpu.parallel.mesh import best_effort_mesh
+
+            mesh = best_effort_mesh()
+        except Exception as e:
+            logger.warning("no mesh for index consolidation (%s)", e)
+        cstats = consolidate_index(resolve_index_path(args), mesh=mesh)
+        logger.info(
+            "corpus index consolidated: %d vectors in (%d random-provenance refused)",
+            cstats["consolidated"], cstats["skipped_random"],
+        )
+        return {"corpus_index": {**cstats, "path": resolve_index_path(args)}}
+    except Exception:
+        logger.exception("index consolidation failed (run output unaffected)")
+        return {}
 
 
 def _apply_observability_wrappers(

@@ -1,0 +1,128 @@
+"""Incremental dedup stage: query the persistent corpus index as clips flow.
+
+The batch dedup pipeline (pipelines/video/dedup.py) runs AFTER a split run
+and re-clusters everything; this stage moves dedup INTO the split pipeline —
+each task's freshly-embedded clips are queried against the corpus index
+(dedup/corpus_index.py) and clips within ``eps`` cosine distance of an
+indexed neighbor are flagged (score-only) or dropped (enable) **before**
+the writer persists their embeddings — a duplicate costs an index query
+instead of captioning, preview, and parquet/index writes downstream.
+
+Weights-provenance gate: when the run's embedding weights are random init
+(models/registry.weights_provenance), similarity against the index is
+noise — the stage refuses to flag anything (and warns once) unless
+``CURATE_INDEX_ALLOW_RANDOM`` opts in, mirroring the writer's refusal to
+index random embeddings.
+"""
+
+from __future__ import annotations
+
+from cosmos_curate_tpu.core.stage import Resources, Stage
+from cosmos_curate_tpu.data.model import SplitPipeTask
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class IncrementalDedupStage(Stage[SplitPipeTask, SplitPipeTask]):
+    """Flags/drops clips that duplicate an indexed corpus neighbor.
+
+    ``index_path`` names an existing corpus index; when none exists yet
+    (first run into a fresh output root) the stage passes everything
+    through — the end-of-run consolidation builds the index this run's
+    successor will query.
+    """
+
+    def __init__(
+        self,
+        index_path: str,
+        *,
+        eps: float = 0.07,
+        nprobe: int = 0,  # 0 = index default
+        score_only: bool = False,
+    ) -> None:
+        self.index_path = index_path.rstrip("/")
+        self.eps = eps
+        self.nprobe = nprobe
+        self.score_only = score_only
+        self._index = None
+        self._gate_logged = False
+
+    @property
+    def resources(self) -> Resources:
+        return Resources(cpus=1.0)
+
+    def setup(self, worker) -> None:
+        from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+
+        if not CorpusIndex.exists(self.index_path):
+            logger.warning(
+                "no corpus index at %s yet — incremental dedup passes "
+                "everything through this run (the end-of-run consolidation "
+                "builds it)", self.index_path,
+            )
+            return
+        mesh = None
+        try:
+            from cosmos_curate_tpu.parallel.mesh import best_effort_mesh
+
+            mesh = best_effort_mesh()
+        except Exception as e:
+            logger.warning("no mesh for index queries (%s); single device", e)
+        self._index = CorpusIndex.open(
+            self.index_path, mesh=mesh, metrics_name=self.name
+        )
+
+    def _provenance_ok(self, model: str) -> bool:
+        from cosmos_curate_tpu.dedup.index_store import allow_random_provenance
+        from cosmos_curate_tpu.models.registry import weights_provenance
+
+        if weights_provenance(model) != "random" or allow_random_provenance():
+            return True
+        if not self._gate_logged:
+            self._gate_logged = True
+            logger.warning(
+                "incremental dedup disabled: %s weights are random init — "
+                "similarity to the index would be noise (stage a checkpoint "
+                "or set CURATE_INDEX_ALLOW_RANDOM=1)", model,
+            )
+        return False
+
+    def process_data(self, tasks: list[SplitPipeTask]) -> list[SplitPipeTask]:
+        if self._index is None:
+            return tasks
+        import numpy as np
+
+        from cosmos_curate_tpu.dedup.corpus_index import incremental_dedup
+
+        model = self._index.meta.get("model", "")
+        for task in tasks:
+            video = task.video
+            clips = [c for c in video.clips if model in c.embeddings]
+            if not clips or not self._provenance_ok(model):
+                continue
+            ids = [str(c.uuid) for c in clips]
+            vecs = np.stack([c.embeddings[model] for c in clips])
+            result = incremental_dedup(
+                self._index, ids, vecs,
+                eps=self.eps, nprobe=self.nprobe or None,
+            )
+            dup_of = result["duplicate_of"]
+            by_id = {str(c.uuid): c for c in clips}
+            for cid in result["removed"]:
+                clip = by_id[cid]
+                clip.duplicate_of = dup_of.get(cid, "")
+                if not self.score_only:
+                    clip.filtered_by = "dedup"
+            if not self.score_only and result["removed"]:
+                removed_set = set(result["removed"])
+                video.filtered_clips.extend(
+                    c for c in video.clips if str(c.uuid) in removed_set
+                )
+                video.clips = [
+                    c for c in video.clips if str(c.uuid) not in removed_set
+                ]
+            task.stage_perf["dedup_duplicates"] = (
+                task.stage_perf.get("dedup_duplicates", 0) + len(result["removed"])
+            )
+        return tasks

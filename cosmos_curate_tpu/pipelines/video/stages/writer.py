@@ -12,11 +12,20 @@ output layout (docs/curator/reference/VIDEO_PIPELINES.md:56-91):
 
 Writing the resume record **last** is the crash-safety contract: a video is
 only skipped on re-run if all its chunks finished writing.
+
+With ``index_path`` set, each chunk's embeddings are ALSO appended as a
+pending corpus-index fragment (dedup/index_store.py — the reference's
+in-pipeline lance fragment flow) so the end-of-run consolidation step can
+fold the run into the persistent dedup index without re-reading every
+parquet. Fragments carry weights provenance (models/registry.py) and
+random-init embeddings are refused up front — noise must never become
+corpus memory.
 """
 
 from __future__ import annotations
 
 import hashlib
+import time
 from collections import defaultdict
 
 import numpy as np
@@ -48,6 +57,7 @@ def _clip_meta(clip: Clip) -> dict:
         "artificial_text_score": clip.artificial_text_score,
         "semantic_pass": clip.semantic_pass,
         "filtered_by": clip.filtered_by,
+        "duplicate_of": clip.duplicate_of,
         "embedding_models": sorted(clip.embeddings),
         "tracks": clip.tracks,
         "event_captions": clip.event_captions,
@@ -66,10 +76,24 @@ def _clip_meta(clip: Clip) -> dict:
 
 
 class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
-    def __init__(self, output_path: str, *, write_embeddings: bool = True, write_previews: bool = True) -> None:
+    def __init__(
+        self,
+        output_path: str,
+        *,
+        write_embeddings: bool = True,
+        write_previews: bool = True,
+        index_path: str = "",
+    ) -> None:
         self.output_path = output_path.rstrip("/")
         self.write_embeddings = write_embeddings
         self.write_previews = write_previews
+        # corpus-index root for in-pipeline fragment appends ("" disables)
+        self.index_path = index_path.rstrip("/")
+        self._warned_random_models: set[str] = set()
+        # one IndexStore for the run: construction reads meta.json to pin
+        # the backend, which against remote storage is 1-2 round-trips —
+        # not a per-chunk cost (benign race: duplicate instances agree)
+        self._index_store = None
 
     @property
     def resources(self) -> Resources:
@@ -107,6 +131,8 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
                             "embedding": [r[1].astype(np.float32).tolist() for r in rows],
                         },
                     )
+                    if self.index_path:
+                        self._write_index_fragment(chunk_tag, model, rows, task)
             self._write_resume_record(task)
             # Free payloads (kept AND filtered clips): downstream only needs
             # stats/metadata, and filtered clips otherwise pin their mp4 +
@@ -122,6 +148,49 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             task.stage_perf["clips_written"] = stats.num_clips
             task.stats = stats
         return tasks
+
+    def _write_index_fragment(
+        self, chunk_tag: str, model: str, rows: list, task: SplitPipeTask
+    ) -> None:
+        """Append this chunk's embeddings as a pending corpus-index fragment
+        (consolidated into per-cluster shards at end of run). Chunk tags
+        scope fragments to disjoint files, so the stage stays thread-safe.
+        Random-provenance embeddings are refused here — before they can
+        reach the index — unless CURATE_INDEX_ALLOW_RANDOM opts in."""
+        from cosmos_curate_tpu.dedup.index_store import IndexStore, allow_random_provenance
+        from cosmos_curate_tpu.models.registry import weights_provenance
+        from cosmos_curate_tpu.observability.stage_timer import record_index_ops
+
+        provenance = weights_provenance(model)
+        if provenance == "random" and not allow_random_provenance():
+            if model not in self._warned_random_models:
+                # benign race under concurrent batches: worst case is one
+                # duplicate warning, never a poisoned index
+                self._warned_random_models.add(model)
+                logger.warning(
+                    "not indexing %s embeddings: weights provenance is random "
+                    "(stage a checkpoint, or set CURATE_INDEX_ALLOW_RANDOM=1)",
+                    model,
+                )
+            record_index_ops(self.name, skipped_random=len(rows))
+            task.stage_perf["index_skipped_random"] = (
+                task.stage_perf.get("index_skipped_random", 0) + len(rows)
+            )
+            return
+        t0 = time.monotonic()
+        if self._index_store is None:
+            self._index_store = IndexStore(self.index_path)
+        self._index_store.write_pending_fragment(
+            f"{chunk_tag}-{model}",
+            [r[0] for r in rows],
+            np.stack([r[1].astype(np.float32) for r in rows]),
+            model=model,
+            provenance=provenance,
+        )
+        record_index_ops(self.name, adds=len(rows), add_s=time.monotonic() - t0)
+        task.stage_perf["index_fragment_rows"] = (
+            task.stage_perf.get("index_fragment_rows", 0) + len(rows)
+        )
 
     def _write_aux_cameras(self, task: SplitPipeTask, stats: ClipStats) -> None:
         """Secondary cameras land beside the primary under the clip's
@@ -197,6 +266,8 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             stats.num_filtered_by_text += 1
         elif key == "semantic":
             stats.num_filtered_by_semantic += 1
+        elif key == "dedup":
+            stats.num_filtered_by_dedup += 1
 
     def _write_resume_record(self, task: SplitPipeTask) -> None:
         # One record per chunk (chunks of a video may be written by different

@@ -72,6 +72,7 @@ _ADDITIVE = (
     "num_filtered_by_aesthetic",
     "num_filtered_by_text",
     "num_filtered_by_semantic",
+    "num_filtered_by_dedup",
     "num_transcoded",
     "num_with_embeddings",
     "num_with_captions",

@@ -1,0 +1,117 @@
+"""CI smoke for the persistent corpus index: build + add + query + stats
+through the real CLI against a split-shaped output dir, asserting IVF
+recall against exact cosine top-k. Exercised by scripts/run_ci_checks.sh
+(skip with CI_SKIP=index)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+MODEL = "video-embed-tpu"
+DIM = 32
+K = 6
+
+
+def cli(*argv: str) -> subprocess.CompletedProcess:
+    proc = subprocess.run(
+        [sys.executable, "-m", "cosmos_curate_tpu.cli.main", *argv],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, f"{argv}: rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    return proc
+
+
+def write_run(root: Path, ids: list[str], vecs: np.ndarray, chunks: int = 3) -> None:
+    from cosmos_curate_tpu.storage.writers import write_parquet
+
+    per = (len(ids) + chunks - 1) // chunks
+    for c in range(chunks):
+        sl = slice(c * per, (c + 1) * per)
+        if not ids[sl]:
+            continue
+        write_parquet(
+            str(root / "embeddings" / MODEL / f"chunk-{c:05d}.parquet"),
+            {"clip_uuid": ids[sl], "embedding": [v.tolist() for v in vecs[sl]]},
+        )
+
+
+def main() -> int:
+    rng = np.random.default_rng(3)
+    centers = rng.standard_normal((K, DIM)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    corpus = np.concatenate(
+        [c + 0.05 * rng.standard_normal((40, DIM)) for c in centers]
+    ).astype(np.float32)
+    corpus_ids = [f"c{i}" for i in range(len(corpus))]
+
+    tmp = Path(tempfile.mkdtemp(prefix="index_smoke_"))
+    run_a = tmp / "run_a"
+    write_run(run_a, corpus_ids, corpus)
+    index_root = str(run_a / "index")
+
+    out = cli("index", "build", "--input-path", str(run_a), "--k", str(K), "--no-mesh")
+    built = json.loads(out.stdout)
+    assert built["num_vectors"] == len(corpus_ids), built
+    assert built["k"] == K, built
+
+    # second run: near-dupes of the corpus + novel vectors
+    dup_src = [3, 57, 120, 200]
+    novel = rng.standard_normal((4, DIM)).astype(np.float32) * 3
+    run_vecs = np.concatenate([corpus[dup_src] + 1e-4, novel]).astype(np.float32)
+    run_ids = [f"dup{i}" for i in range(len(dup_src))] + [
+        f"new{i}" for i in range(len(novel))
+    ]
+    run_b = tmp / "run_b"
+    write_run(run_b, run_ids, run_vecs, chunks=2)
+
+    out = cli(
+        "index", "query", "--input-path", str(run_b), "--index-path", index_root,
+        "--eps", "0.05", "--no-mesh",
+        "--output-csv", str(tmp / "dedup.csv"),
+    )
+    q = json.loads(out.stdout)
+    assert q["num_removed"] == len(dup_src), q
+    assert set(q["duplicate_of"]) == {f"dup{i}" for i in range(len(dup_src))}, q
+    assert (tmp / "dedup.csv").exists()
+
+    # recall: library query vs exact cosine top-k over the same corpus
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+    from cosmos_curate_tpu.dedup.index_store import normalize_rows
+
+    index = CorpusIndex.open(index_root)
+    queries = (corpus[:60] + 0.01 * rng.standard_normal((60, DIM))).astype(np.float32)
+    qn, cn = normalize_rows(queries), normalize_rows(corpus)
+    exact = np.argsort(-(qn @ cn.T), axis=1)[:, :5]
+    hits = index.query(queries, top_k=5, nprobe=3)
+    recall = sum(
+        len({h for h, _ in hits[i]} & {corpus_ids[j] for j in exact[i]}) / 5
+        for i in range(len(queries))
+    ) / len(queries)
+    assert recall >= 0.95, f"IVF recall {recall} < 0.95"
+
+    out = cli("index", "add", "--input-path", str(run_b), "--index-path", index_root, "--no-mesh")
+    added = json.loads(out.stdout)
+    assert added["added"] == len(run_ids), added
+    assert added["num_vectors"] == len(corpus_ids) + len(run_ids), added
+
+    out = cli("index", "stats", "--index-path", index_root)
+    stats = json.loads(out.stdout)
+    assert stats["clusters_with_data"] >= K - 1, stats
+    print(
+        f"index smoke ok: recall@5 {recall:.3f}, {q['num_removed']} dupes "
+        f"flagged, {stats['num_vectors']} vectors in {stats['clusters_with_data']} clusters"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,8 +2,9 @@
 # The one CI entry point (.github/workflows/ci.yml): every PR must hold
 # the line on (1) the tier-1 CPU suite, (2) a bench smoke, (3) the
 # 8-device multichip dry-run, and (4) the static-analysis gate
-# (curate-lint + shardcheck + tracing/caption smokes). Individual gates
-# can be skipped via CI_SKIP=tier1,bench,multichip,static for local use.
+# (curate-lint + shardcheck + tracing/caption smokes), plus (5) the
+# corpus-index build/add/query smoke. Individual gates can be skipped via
+# CI_SKIP=tier1,bench,multichip,index,static for local use.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -51,6 +52,13 @@ if ! skip multichip; then
   if ! JAX_PLATFORMS=cpu timeout -k 10 1500 python -c \
       "import __graft_entry__ as g; g.dryrun_multichip(8)"; then
     failures+=("dryrun_multichip(8)")
+  fi
+fi
+
+if ! skip index; then
+  echo "== corpus-index smoke (build/add/query/stats CLI + IVF recall) =="
+  if ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/index_smoke.py; then
+    failures+=("corpus-index smoke")
   fi
 fi
 

@@ -1,0 +1,380 @@
+"""Persistent sharded corpus index: store round-trips (parquet AND lance
+backends), IVF recall vs exact cosine top-k, incremental-dedup ≡ batch
+semantic_dedup, consolidation + weights-provenance gating, and the
+`index build|add|query|stats` CLI."""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.dedup.corpus_index import (
+    CorpusIndex,
+    consolidate_index,
+    incremental_dedup,
+    query_matmul,
+)
+from cosmos_curate_tpu.dedup.index_store import IndexStore, normalize_rows
+from cosmos_curate_tpu.dedup.kmeans import semantic_dedup
+
+
+def _clustered_corpus(rng, *, n_clusters=6, per=40, dim=32, spread=0.05):
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    vecs = np.concatenate(
+        [c + spread * rng.standard_normal((per, dim)) for c in centers]
+    ).astype(np.float32)
+    return [f"c{i}" for i in range(len(vecs))], vecs
+
+
+@pytest.fixture
+def fake_lance(monkeypatch):
+    """A lance stand-in with the real call shape (write_dataset/dataset)
+    that actually round-trips tables, so the lance backend is tested
+    end-to-end without the wheel (same approach as test_lance_export)."""
+    import pyarrow as pa
+
+    store: dict[str, object] = {}
+    mod = types.ModuleType("lance")
+
+    def write_dataset(table, uri, mode="create"):
+        uri = str(uri)
+        if mode == "append" and uri in store:
+            table = pa.concat_tables([store[uri], table])
+        store[uri] = table
+        Path(uri).mkdir(parents=True, exist_ok=True)  # datasets are dirs
+
+    def dataset(uri):
+        return SimpleNamespace(to_table=lambda: store[str(uri)])
+
+    mod.write_dataset = write_dataset
+    mod.dataset = dataset
+    mod._store = store
+    monkeypatch.setitem(sys.modules, "lance", mod)
+    return mod
+
+
+class TestIndexStore:
+    def test_pending_roundtrip_parquet(self, tmp_path, rng):
+        store = IndexStore(str(tmp_path / "idx"))
+        assert store.backend == "parquet"
+        vecs = rng.standard_normal((3, 8)).astype(np.float32)
+        store.write_pending_fragment(
+            "t0", ["a", "b", "c"], vecs, model="m", provenance="checkpoint:ab"
+        )
+        ids, got, models, provs = store.read_pending()
+        assert ids == ["a", "b", "c"]
+        assert models == ["m"] * 3 and provs == ["checkpoint:ab"] * 3
+        np.testing.assert_allclose(got, normalize_rows(vecs), atol=1e-6)
+        assert store.clear_pending() == 1
+        assert store.list_pending() == []
+
+    def test_pending_roundtrip_lance(self, tmp_path, rng, fake_lance):
+        store = IndexStore(str(tmp_path / "idx"))
+        assert store.backend == "lance"
+        vecs = rng.standard_normal((2, 8)).astype(np.float32)
+        store.write_pending_fragment("t0", ["a", "b"], vecs, provenance="p")
+        ids, got, _models, provs = store.read_pending()
+        assert ids == ["a", "b"] and provs == ["p", "p"]
+        np.testing.assert_allclose(got, normalize_rows(vecs), atol=1e-6)
+
+    def test_cluster_roundtrip_both_backends(self, tmp_path, rng, fake_lance):
+        for backend in ("parquet", "lance"):
+            store = IndexStore(str(tmp_path / backend), backend=backend)
+            vecs = rng.standard_normal((4, 8)).astype(np.float32)
+            store.append_cluster(2, ["x", "y", "z", "w"], vecs)
+            ids, got = store.read_cluster(2)
+            assert ids == ["x", "y", "z", "w"]
+            np.testing.assert_allclose(got, normalize_rows(vecs), atol=1e-6)
+            assert store.cluster_fragment_counts() == {2: 1}
+
+    def test_meta_pins_backend(self, tmp_path):
+        store = IndexStore(str(tmp_path / "idx"), backend="parquet")
+        store.save_meta({"version": 1})
+        # a later open (even with lance importable) must stay on parquet
+        assert IndexStore(str(tmp_path / "idx")).backend == "parquet"
+
+    def test_lance_unavailable_falls_back(self, tmp_path):
+        store = IndexStore(str(tmp_path / "idx"), backend="lance")
+        assert store.backend == "parquet"
+
+
+class TestCorpusIndex:
+    def test_ivf_recall_vs_exact(self, tmp_path, rng):
+        """IVF query recall >= 0.95 against brute-force exact cosine top-k
+        on a synthetic clustered corpus (the acceptance bar)."""
+        ids, vecs = _clustered_corpus(rng)
+        index = CorpusIndex.build(str(tmp_path / "idx"), ids, vecs, model="m", k=6)
+        queries = (vecs[::4] + 0.01 * rng.standard_normal((len(vecs[::4]), 32))).astype(
+            np.float32
+        )
+        qn, cn = normalize_rows(queries), normalize_rows(vecs)
+        exact = np.argsort(-(qn @ cn.T), axis=1)[:, :5]
+        hits = index.query(queries, top_k=5, nprobe=3)
+        recall = sum(
+            len({h for h, _ in hits[i]} & {ids[j] for j in exact[i]}) / 5
+            for i in range(len(queries))
+        ) / len(queries)
+        assert recall >= 0.95, recall
+
+    @pytest.mark.parametrize("backend", ["parquet", "lance"])
+    def test_add_query_roundtrip(self, tmp_path, rng, backend, request):
+        if backend == "lance":
+            request.getfixturevalue("fake_lance")
+        ids, vecs = _clustered_corpus(rng, n_clusters=4, per=20)
+        root = str(tmp_path / backend)
+        index = CorpusIndex.build(root, ids, vecs, model="m", k=4, backend=backend)
+        assert index.store.backend == backend
+        new_vecs = (vecs[:3] + 1e-5).astype(np.float32)
+        index.add(["n0", "n1", "n2"], new_vecs)
+        # reopen from disk: adds must be durable, not cache artifacts
+        reopened = CorpusIndex.open(root)
+        assert reopened.meta["num_vectors"] == len(ids) + 3
+        hits = reopened.query(new_vecs, top_k=2)
+        for i in range(3):
+            assert f"n{i}" in {h for h, _ in hits[i]}
+
+    def test_query_empty_and_dim_mismatch(self, tmp_path, rng):
+        ids, vecs = _clustered_corpus(rng, n_clusters=2, per=8)
+        index = CorpusIndex.build(str(tmp_path / "idx"), ids, vecs, k=2)
+        assert index.query(np.zeros((0, 32), np.float32)) == []
+        with pytest.raises(ValueError, match="dim"):
+            index.add(["q"], np.zeros((1, 7), np.float32))
+
+    def test_mesh_query_matches_single_device(self, tmp_path, rng):
+        """With a real multi-device mesh (the suite forces 8 CPU devices)
+        the shard_map query path returns the same hits as the single-device
+        path — device parallelism must not change results."""
+        from cosmos_curate_tpu.parallel.mesh import best_effort_mesh
+
+        mesh = best_effort_mesh()
+        if mesh.size <= 1:
+            pytest.skip("needs a multi-device environment")
+        ids, vecs = _clustered_corpus(rng, n_clusters=4, per=20)
+        root = str(tmp_path / "idx")
+        CorpusIndex.build(root, ids, vecs, model="m", k=4)
+        queries = (vecs[:13] + 0.01 * rng.standard_normal((13, 32))).astype(np.float32)
+        plain = CorpusIndex.open(root).query(queries, top_k=3, nprobe=2)
+        meshed = CorpusIndex.open(root, mesh=mesh).query(queries, top_k=3, nprobe=2)
+        for p, m in zip(plain, meshed):
+            assert [h for h, _ in p] == [h for h, _ in m]
+            np.testing.assert_allclose(
+                [s for _, s in p], [s for _, s in m], atol=1e-5
+            )
+
+    def test_query_matmul_shapes_device_free(self):
+        """The shard_map query kernel's contract, traced over an
+        AbstractMesh with zero devices — the same path shardcheck's
+        ivf-query contract exercises."""
+        import jax
+
+        from cosmos_curate_tpu.analysis.shard_check import _abstract_mesh
+
+        amesh = _abstract_mesh({"dcn": 1, "data": 2, "model": 1, "seq": 1})
+        q = jax.ShapeDtypeStruct((16, 8), np.float32)
+        c = jax.ShapeDtypeStruct((40, 8), np.float32)
+        vals, idxs = jax.eval_shape(
+            lambda q, c: query_matmul(amesh, q, c, top_k=3), q, c
+        )
+        assert vals.shape == (16, 3) and idxs.shape == (16, 3)
+
+
+class TestIncrementalDedup:
+    def test_matches_batch_semantic_dedup(self, tmp_path, rng):
+        """incremental-dedup of a new batch against index(corpus) ==
+        batch semantic_dedup over corpus+batch, on well-separated data:
+        same removed set, same duplicate_of mapping."""
+        ids, vecs = _clustered_corpus(rng, n_clusters=4, per=10, spread=0.05)
+        index = CorpusIndex.build(str(tmp_path / "idx"), ids, vecs, k=4)
+        # batch: two near-exact dupes of corpus items, one novel, and an
+        # internal dupe pair (b3 ~ b2)
+        novel = rng.standard_normal((1, 32)).astype(np.float32) * 2
+        batch = np.concatenate(
+            [vecs[[5]] + 1e-6, vecs[[27]] + 1e-6, novel, novel + 1e-6]
+        ).astype(np.float32)
+        batch_ids = ["b0", "b1", "b2", "b3"]
+        eps = 1e-4  # corpus items sit ~5e-3 apart: distinct at this eps
+
+        inc = incremental_dedup(index, batch_ids, batch, eps=eps)
+        full = semantic_dedup(
+            np.concatenate([vecs, batch]), ids + batch_ids, eps=eps, n_clusters=4
+        )
+        assert set(full["removed"]) == set(inc["removed"]) == {"b0", "b1", "b3"}
+        assert inc["duplicate_of"] == full["duplicate_of"] == {
+            "b0": "c5", "b1": "c27", "b3": "b2",
+        }
+        assert set(inc["kept"]) == {"b2"}
+
+    def test_self_indexed_batch_keeps_first(self, tmp_path, rng):
+        """When the index already contains the query batch itself (the
+        in-pipeline writer ran first), keep-first ordering holds: the
+        earlier member of a dupe pair survives."""
+        base = rng.standard_normal((6, 16)).astype(np.float32)
+        vecs = np.concatenate([base, base[[0]] + 1e-6]).astype(np.float32)
+        ids = [f"v{i}" for i in range(7)]  # v6 duplicates v0
+        index = CorpusIndex.build(str(tmp_path / "idx"), ids, vecs, k=2)
+        result = incremental_dedup(index, ids, vecs, eps=1e-4)
+        assert result["removed"] == ["v6"]
+        assert result["duplicate_of"] == {"v6": "v0"}
+        assert len(result["kept"]) == 6
+
+
+class TestConsolidate:
+    def test_pending_trains_then_routes(self, tmp_path, rng):
+        root = str(tmp_path / "idx")
+        store = IndexStore(root)
+        ids, vecs = _clustered_corpus(rng, n_clusters=3, per=12)
+        store.write_pending_fragment(
+            "t0", ids, vecs, model="m", provenance="checkpoint:aa"
+        )
+        out = consolidate_index(root, k=3)
+        assert out["consolidated"] == len(ids) and out["pending_cleared"] == 1
+        index = CorpusIndex.open(root)
+        assert index.meta["model"] == "m" and index.meta["k"] == 3
+        # second consolidation routes against EXISTING centroids
+        store.write_pending_fragment(
+            "t1", ["x0"], vecs[:1] + 1e-6, model="m", provenance="checkpoint:aa"
+        )
+        out2 = consolidate_index(root)
+        assert out2["consolidated"] == 1
+        assert CorpusIndex.open(root).meta["num_vectors"] == len(ids) + 1
+
+    def test_random_provenance_refused(self, tmp_path, rng, monkeypatch):
+        monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+        root = str(tmp_path / "idx")
+        store = IndexStore(root)
+        ids, vecs = _clustered_corpus(rng, n_clusters=2, per=8)
+        store.write_pending_fragment("ok", ids[:8], vecs[:8], model="m", provenance="checkpoint:aa")
+        store.write_pending_fragment("bad", ids[8:], vecs[8:], model="m", provenance="random")
+        out = consolidate_index(root, k=2)
+        assert out["skipped_random"] == len(ids) - 8
+        assert CorpusIndex.open(root).meta["num_vectors"] == 8
+
+    def test_random_provenance_allowed_by_env(self, tmp_path, rng, monkeypatch):
+        monkeypatch.setenv("CURATE_INDEX_ALLOW_RANDOM", "1")
+        root = str(tmp_path / "idx")
+        store = IndexStore(root)
+        ids, vecs = _clustered_corpus(rng, n_clusters=2, per=6)
+        store.write_pending_fragment("t", ids, vecs, model="m", provenance="random")
+        out = consolidate_index(root, k=2)
+        assert out["consolidated"] == len(ids) and out["skipped_random"] == 0
+
+    def test_empty_pending_noop(self, tmp_path):
+        out = consolidate_index(str(tmp_path / "idx"))
+        assert out == {"consolidated": 0, "skipped_random": 0, "pending_cleared": 0}
+
+
+class TestIndexMetrics:
+    def test_record_and_summarize(self):
+        from cosmos_curate_tpu.observability.stage_timer import (
+            index_op_summaries,
+            record_index_ops,
+            reset_index_ops,
+        )
+
+        reset_index_ops()
+        try:
+            record_index_ops("s", adds=3, add_s=0.5)
+            record_index_ops("s", queries=10, query_s=2.0, probes=5, duplicates=2)
+            out = index_op_summaries()["s"]
+            assert out["adds"] == 3 and out["queries"] == 10
+            assert out["probes"] == 5 and out["duplicates"] == 2
+            assert out["probe_fanout_mean"] == 0.5
+            assert out["queries_per_sec"] == 5.0
+        finally:
+            reset_index_ops()
+
+    def test_query_records_aggregates(self, tmp_path, rng):
+        from cosmos_curate_tpu.observability.stage_timer import (
+            index_op_summaries,
+            reset_index_ops,
+        )
+
+        reset_index_ops()
+        try:
+            ids, vecs = _clustered_corpus(rng, n_clusters=2, per=8)
+            index = CorpusIndex.build(
+                str(tmp_path / "idx"), ids, vecs, k=2, metrics_name="unit_index"
+            )
+            index.query(vecs[:4], nprobe=1)
+            agg = index_op_summaries()["unit_index"]
+            assert agg["adds"] == len(ids)
+            assert agg["queries"] == 4 and agg["probes"] >= 1
+        finally:
+            reset_index_ops()
+
+    def test_flight_recorder_carries_index_ops(self):
+        from cosmos_curate_tpu.observability.flight_recorder import runner_stats
+
+        assert "index_ops" in runner_stats(None)
+
+
+class TestIndexCli:
+    def _write_run(self, root: Path, ids, vecs, model="video-embed-tpu"):
+        from cosmos_curate_tpu.storage.writers import write_parquet
+
+        write_parquet(
+            str(root / "embeddings" / model / "chunk-00000.parquet"),
+            {"clip_uuid": ids, "embedding": [v.tolist() for v in vecs]},
+        )
+
+    def test_build_query_stats_roundtrip(self, tmp_path, rng, capsys):
+        from cosmos_curate_tpu.cli.main import main
+
+        ids, vecs = _clustered_corpus(rng, n_clusters=3, per=10)
+        run_a = tmp_path / "run_a"
+        self._write_run(run_a, ids, vecs)
+        assert main(["index", "build", "--input-path", str(run_a), "--k", "3", "--no-mesh"]) == 0
+        built = json.loads(capsys.readouterr().out)
+        assert built["num_vectors"] == len(ids) and built["k"] == 3
+
+        run_b = tmp_path / "run_b"
+        self._write_run(run_b, ["d0", "n0"], np.stack([vecs[4] + 1e-6, rng.standard_normal(32).astype(np.float32) * 3]))
+        assert main([
+            "index", "query", "--input-path", str(run_b),
+            "--index-path", str(run_a / "index"), "--eps", "0.01", "--no-mesh",
+            "--output-csv", str(tmp_path / "dedup.csv"),
+        ]) == 0
+        q = json.loads(capsys.readouterr().out)
+        assert q["num_removed"] == 1 and q["duplicate_of"] == {"d0": "c4"}
+        assert (tmp_path / "dedup.csv").read_text().startswith("clip_uuid,action,duplicate_of")
+
+        assert main(["index", "add", "--input-path", str(run_b), "--index-path", str(run_a / "index"), "--no-mesh"]) == 0
+        added = json.loads(capsys.readouterr().out)
+        assert added["added"] == 2
+
+        assert main(["index", "stats", "--index-path", str(run_a / "index")]) == 0
+        stats = json.loads(capsys.readouterr().out)
+        assert stats["num_vectors"] == len(ids) + 2
+
+    def test_build_clears_pending_without_double_ingest(self, tmp_path, rng, capsys):
+        """`index build` over a run whose writer left pending fragments must
+        not ingest those rows twice (they are the same clips the embeddings
+        parquets hold)."""
+        from cosmos_curate_tpu.cli.main import main
+
+        ids, vecs = _clustered_corpus(rng, n_clusters=2, per=10)
+        run = tmp_path / "run"
+        self._write_run(run, ids, vecs)
+        store = IndexStore(str(run / "index"))
+        store.write_pending_fragment(
+            "frag", ids[:5], vecs[:5], model="video-embed-tpu", provenance="checkpoint:aa"
+        )
+        assert main(["index", "build", "--input-path", str(run), "--k", "2", "--no-mesh"]) == 0
+        built = json.loads(capsys.readouterr().out)
+        assert built["num_vectors"] == len(ids)  # NOT len(ids) + 5
+        assert built["pending_cleared"] == 1
+        assert IndexStore(str(run / "index")).list_pending() == []
+
+    def test_stats_on_missing_index(self, tmp_path, capsys):
+        from cosmos_curate_tpu.cli.main import main
+
+        assert main(["index", "stats", "--index-path", str(tmp_path / "nope")]) == 2
+        out = json.loads(capsys.readouterr().out)
+        assert out["exists"] is False

@@ -45,6 +45,40 @@ class TestKMeans:
         assert centroids.shape[0] == 4
         assert assign.shape == (4,)
 
+    def test_single_device_mesh_degrades_to_identical_results(self, rng):
+        """On a 1-device environment (a CPU box without the suite's forced
+        8-device XLA flag) a mesh must add nothing: kmeans_fit(mesh=...)
+        takes the single-device path and the result is bit-identical — the
+        environment-sensitivity fix asserted directly."""
+        import jax
+        from jax.sharding import Mesh
+
+        from cosmos_curate_tpu.parallel.axes import MESH_AXES
+
+        data, _ = _clustered_data(rng, n_per=16)
+        mesh = Mesh(
+            np.array(jax.devices()[:1]).reshape(1, 1, 1, 1), axis_names=MESH_AXES
+        )
+        assert mesh.size == 1
+        c0, a0 = kmeans_fit(data, 3, iters=10, seed=0)
+        c1, a1 = kmeans_fit(data, 3, iters=10, seed=0, mesh=mesh)
+        np.testing.assert_array_equal(a0, a1)
+        np.testing.assert_array_equal(c0, c1)
+
+    def test_broken_mesh_degrades_cleanly(self, rng):
+        """A mesh the batch cannot ride falls back to single-device (with a
+        warning) instead of crashing the dedup run — identical results."""
+
+        class _BrokenMesh:
+            size = 2  # looks multi-device, fails at shard time
+            axis_names = ()
+
+        data, _ = _clustered_data(rng, n_per=16)
+        c0, a0 = kmeans_fit(data, 3, iters=10, seed=0)
+        c1, a1 = kmeans_fit(data, 3, iters=10, seed=0, mesh=_BrokenMesh())
+        np.testing.assert_array_equal(a0, a1)
+        np.testing.assert_array_equal(c0, c1)
+
 
 class TestSemanticDedup:
     def test_exact_duplicates_removed(self, rng):

@@ -1,0 +1,346 @@
+"""In-pipeline index integration: ClipWriterStage fragment appends with
+provenance gating, the IncrementalDedupStage flow, the run_dedup index
+fast path, and the parallel embeddings loader."""
+
+from __future__ import annotations
+
+import uuid
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video
+from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+from cosmos_curate_tpu.dedup.index_store import IndexStore
+from cosmos_curate_tpu.pipelines.video.stages.dedup_stage import IncrementalDedupStage
+from cosmos_curate_tpu.pipelines.video.stages.writer import ClipWriterStage
+
+MODEL = "video-embed-tpu"
+
+
+def _task(vecs, path="vid.mp4") -> SplitPipeTask:
+    video = Video(path=path)
+    for v in np.asarray(vecs, np.float32):
+        video.clips.append(Clip(uuid=uuid.uuid4(), embeddings={MODEL: v}))
+    return SplitPipeTask(video=video)
+
+
+@pytest.fixture
+def real_provenance(monkeypatch):
+    from cosmos_curate_tpu.models import registry
+
+    monkeypatch.setattr(
+        registry, "weights_provenance", lambda model_id: "checkpoint:feedc0ffee12"
+    )
+
+
+class TestWriterIndexFragments:
+    def test_fragment_written_with_provenance(self, tmp_path, rng, real_provenance):
+        index_root = str(tmp_path / "out" / "index")
+        stage = ClipWriterStage(str(tmp_path / "out"), index_path=index_root)
+        task = _task(rng.standard_normal((3, 16)))
+        stage.process_data([task])
+        ids, vecs, models, provs = IndexStore(index_root).read_pending()
+        assert len(ids) == 3 and vecs.shape == (3, 16)
+        assert models == [MODEL] * 3
+        assert provs == ["checkpoint:feedc0ffee12"] * 3
+        assert task.stage_perf["index_fragment_rows"] == 3
+        # the parquet embeddings output is unaffected
+        assert list((tmp_path / "out" / "embeddings" / MODEL).glob("*.parquet"))
+
+    def test_random_provenance_not_indexed(self, tmp_path, rng, monkeypatch):
+        monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+        # no staged weights for this model id in the test env -> "random"
+        index_root = str(tmp_path / "out" / "index")
+        stage = ClipWriterStage(str(tmp_path / "out"), index_path=index_root)
+        task = _task(rng.standard_normal((2, 16)))
+        stage.process_data([task])
+        assert IndexStore(index_root).list_pending() == []
+        assert task.stage_perf["index_skipped_random"] == 2
+        # embeddings parquet still written: only the INDEX refuses noise
+        assert list((tmp_path / "out" / "embeddings" / MODEL).glob("*.parquet"))
+
+    def test_no_index_path_means_no_fragments(self, tmp_path, rng):
+        stage = ClipWriterStage(str(tmp_path / "out"))
+        stage.process_data([_task(rng.standard_normal((2, 16)))])
+        assert not (tmp_path / "out" / "index").exists()
+
+
+class TestIncrementalDedupStage:
+    def _index(self, tmp_path, rng, n=40, dim=16):
+        base = rng.standard_normal((n, dim)).astype(np.float32)
+        ids = [f"corpus{i}" for i in range(n)]
+        CorpusIndex.build(str(tmp_path / "index"), ids, base, model=MODEL, k=4)
+        return str(tmp_path / "index"), base
+
+    def test_enable_drops_duplicates_before_writer(self, tmp_path, rng, real_provenance):
+        root, base = self._index(tmp_path, rng)
+        stage = IncrementalDedupStage(root, eps=1e-3)
+        stage.setup(None)
+        novel = rng.standard_normal((1, 16)).astype(np.float32) * 2
+        task = _task(np.concatenate([base[[7]] + 1e-6, novel]))
+        dup_uuid = str(task.video.clips[0].uuid)
+        stage.process_data([task])
+        assert [c.filtered_by for c in task.video.filtered_clips] == ["dedup"]
+        assert str(task.video.filtered_clips[0].uuid) == dup_uuid
+        assert task.video.filtered_clips[0].duplicate_of == "corpus7"
+        assert len(task.video.clips) == 1  # the novel clip survives
+        assert task.stage_perf["dedup_duplicates"] == 1
+
+    def test_score_only_flags_without_dropping(self, tmp_path, rng, real_provenance):
+        root, base = self._index(tmp_path, rng)
+        stage = IncrementalDedupStage(root, eps=1e-3, score_only=True)
+        stage.setup(None)
+        task = _task(base[[3]] + 1e-6)
+        stage.process_data([task])
+        assert len(task.video.clips) == 1 and not task.video.filtered_clips
+        clip = task.video.clips[0]
+        assert clip.duplicate_of == "corpus3" and clip.filtered_by == ""
+
+    def test_random_provenance_disables_flagging(self, tmp_path, rng, monkeypatch):
+        monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+        root, base = self._index(tmp_path, rng)
+        stage = IncrementalDedupStage(root, eps=1e-3)
+        stage.setup(None)
+        task = _task(base[[0]] + 1e-6)  # a perfect dupe — but weights are random
+        stage.process_data([task])
+        assert len(task.video.clips) == 1 and not task.video.filtered_clips
+
+    def test_missing_index_passes_through(self, tmp_path, rng):
+        stage = IncrementalDedupStage(str(tmp_path / "absent"))
+        stage.setup(None)
+        task = _task(rng.standard_normal((2, 16)))
+        out = stage.process_data([task])
+        assert out == [task] and len(task.video.clips) == 2
+
+    def test_writer_counts_dedup_filtered(self, tmp_path, rng, real_provenance):
+        """filtered_by='dedup' clips land in metas/filtered and the new
+        num_filtered_by_dedup stat."""
+        root, base = self._index(tmp_path, rng)
+        dedup = IncrementalDedupStage(root, eps=1e-3)
+        dedup.setup(None)
+        writer = ClipWriterStage(str(tmp_path / "out"))
+        task = _task(base[[1]] + 1e-6)
+        dedup.process_data([task])
+        writer.process_data([task])
+        assert task.stats.num_filtered_by_dedup == 1
+        filtered = list((tmp_path / "out" / "metas" / "filtered").glob("*.json"))
+        assert len(filtered) == 1
+
+
+class TestRunDedupFastPath:
+    def _write_run(self, root, ids, vecs):
+        from cosmos_curate_tpu.storage.writers import write_parquet
+
+        # two chunks: exercises the parallel loader's ordering too
+        half = len(ids) // 2
+        for c, sl in enumerate((slice(0, half), slice(half, None))):
+            write_parquet(
+                str(root / "embeddings" / MODEL / f"chunk-{c:05d}.parquet"),
+                {"clip_uuid": ids[sl], "embedding": [v.tolist() for v in vecs[sl]]},
+            )
+
+    def test_queries_index_when_present(self, tmp_path, rng):
+        from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+        corpus = rng.standard_normal((30, 16)).astype(np.float32)
+        run_root = tmp_path / "run"
+        run_ids = ["d0", "n0"]
+        self._write_run(
+            run_root, run_ids,
+            np.stack([corpus[9] + 1e-6, rng.standard_normal(16).astype(np.float32) * 3]),
+        )
+        CorpusIndex.build(
+            str(run_root / "index"), [f"corpus{i}" for i in range(30)], corpus,
+            model=MODEL, k=3,
+        )
+        summary = run_dedup(
+            DedupPipelineArgs(input_path=str(run_root), eps=1e-3, use_mesh=False)
+        )
+        assert summary["method"] == "index_query"
+        assert summary["num_removed"] == 1 and summary["num_kept"] == 1
+        assert (run_root / "dedup" / "summary.json").exists()
+
+    def test_reclusters_without_index(self, tmp_path, rng):
+        from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+        run_root = tmp_path / "run"
+        base = rng.standard_normal((10, 16)).astype(np.float32)
+        ids = [f"v{i}" for i in range(20)]
+        self._write_run(run_root, ids, np.concatenate([base, base + 1e-6]))
+        summary = run_dedup(
+            DedupPipelineArgs(input_path=str(run_root), eps=0.01, use_mesh=False)
+        )
+        assert summary["method"] == "recluster"
+        assert summary["num_removed"] == 10
+
+    def test_model_mismatch_falls_back_to_recluster(self, tmp_path, rng):
+        """An index built from a different embedding model must not dedup
+        this run's vectors — incompatible spaces fall back to re-cluster."""
+        from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+        run_root = tmp_path / "run"
+        base = rng.standard_normal((8, 16)).astype(np.float32)
+        self._write_run(run_root, [f"v{i}" for i in range(8)], base)
+        CorpusIndex.build(
+            str(run_root / "index"), ["c0", "c1"],
+            rng.standard_normal((2, 32)).astype(np.float32),  # other dim too
+            model="clip-vit-b16-tpu", k=1,
+        )
+        summary = run_dedup(
+            DedupPipelineArgs(input_path=str(run_root), use_mesh=False)
+        )
+        assert summary["method"] == "recluster"
+
+    def test_no_index_flag_forces_recluster(self, tmp_path, rng):
+        from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+        run_root = tmp_path / "run"
+        base = rng.standard_normal((8, 16)).astype(np.float32)
+        self._write_run(run_root, [f"v{i}" for i in range(8)], base)
+        CorpusIndex.build(str(run_root / "index"), ["c0"], base[:1], model=MODEL, k=1)
+        summary = run_dedup(
+            DedupPipelineArgs(input_path=str(run_root), use_index=False, use_mesh=False)
+        )
+        assert summary["method"] == "recluster"
+
+
+@pytest.fixture(scope="module")
+def indexed_runs(tmp_path_factory):
+    """Two real split runs: run 1 builds the corpus index in-pipeline
+    (--corpus-index), run 2 re-processes identical content with
+    --incremental-dedup enable and must drop every clip as a duplicate.
+    Random-provenance is explicitly allowed: the tiny test embedder has no
+    staged weights, and this is exactly the escape hatch's use case."""
+    import os
+
+    from cosmos_curate_tpu.core.runner import SequentialRunner
+    from cosmos_curate_tpu.pipelines.video.split import SplitPipelineArgs, run_split
+    from tests.fixtures.media import make_scene_video
+
+    from cosmos_curate_tpu.observability.stage_timer import reset_index_ops
+
+    # index aggregates are process-global: without a reset, earlier tests'
+    # writer-stage adds would fold into this run's report snapshot
+    reset_index_ops()
+    prior = os.environ.get("CURATE_INDEX_ALLOW_RANDOM")
+    os.environ["CURATE_INDEX_ALLOW_RANDOM"] = "1"
+    try:
+        root = tmp_path_factory.mktemp("index_e2e")
+        vids1 = root / "in1"
+        vids1.mkdir()
+        make_scene_video(vids1 / "v0.mp4", scene_len_frames=24, num_scenes=2)
+        make_scene_video(
+            vids1 / "v1.mp4", scene_len_frames=24, num_scenes=2, moving_box=False
+        )
+        common = dict(
+            fixed_stride_len_s=1.0,
+            min_clip_len_s=0.5,
+            extract_fps=(4.0,),
+            extract_resize_hw=(28, 28),  # iv2 tiny img_size
+            embedding_model="iv2-tiny-test",
+            corpus_index=True,
+        )
+        out1 = root / "out1"
+        s1 = run_split(
+            SplitPipelineArgs(
+                input_path=str(vids1), output_path=str(out1), tracing=True, **common
+            ),
+            runner=SequentialRunner(),
+        )
+        # run 2: v0's content again (new filename -> new clip uuids)
+        vids2 = root / "in2"
+        vids2.mkdir()
+        make_scene_video(vids2 / "v0_again.mp4", scene_len_frames=24, num_scenes=2)
+        out2 = root / "out2"
+        s2 = run_split(
+            SplitPipelineArgs(
+                input_path=str(vids2),
+                output_path=str(out2),
+                index_path=str(out1 / "index"),
+                incremental_dedup="enable",
+                dedup_eps=1e-3,
+                **common,
+            ),
+            runner=SequentialRunner(),
+        )
+        yield out1, out2, s1, s2
+    finally:
+        if prior is None:
+            os.environ.pop("CURATE_INDEX_ALLOW_RANDOM", None)
+        else:
+            os.environ["CURATE_INDEX_ALLOW_RANDOM"] = prior
+
+
+class TestSplitCorpusIndexE2E:
+    def test_run1_consolidated_index(self, indexed_runs):
+        out1, _out2, s1, _s2 = indexed_runs
+        assert s1["num_clips"] == 4 and s1["num_with_embeddings"] == 4
+        assert s1["corpus_index"]["consolidated"] == 4
+        index = CorpusIndex.open(str(out1 / "index"))
+        assert index.meta["num_vectors"] == 4
+        assert index.meta["model"] == "internvideo2-tiny-test"
+        assert index.store.list_pending() == []  # consolidation cleared them
+
+    def test_run2_drops_every_duplicate(self, indexed_runs):
+        out1, out2, _s1, s2 = indexed_runs
+        # identical content re-processed against the index: every clip is a
+        # duplicate, dropped BEFORE the writer — no new embeddings parquet
+        assert s2["num_filtered_by_dedup"] == 2
+        assert s2["num_with_embeddings"] == 0
+        assert not (out2 / "embeddings").exists()
+        filtered = list((out2 / "metas" / "filtered").glob("*.json"))
+        assert len(filtered) == 2
+        import json as json_mod
+
+        meta = json_mod.loads(filtered[0].read_text())
+        assert meta["filtered_by"] == "dedup" and meta["duplicate_of"]
+        # run 1's index is untouched by run 2 (duplicates never re-indexed)
+        assert CorpusIndex.open(str(out1 / "index")).meta["num_vectors"] == 4
+
+    def test_run_report_carries_index_ops(self, indexed_runs):
+        """pipeline_index_* aggregates land in the traced run's
+        run_report.json: the writer's fragment adds AND the end-of-run
+        consolidation (which must run BEFORE finalize writes the report)."""
+        import json as json_mod
+
+        out1, _out2, _s1, _s2 = indexed_runs
+        rep = json_mod.loads((out1 / "report" / "run_report.json").read_text())
+        ops = rep["index_ops"]
+        assert ops["ClipWriterStage"]["adds"] == 4
+        assert ops["consolidate"]["adds"] == 4
+
+    def test_run_dedup_takes_index_fast_path(self, indexed_runs):
+        out1, _out2, _s1, _s2 = indexed_runs
+        from cosmos_curate_tpu.pipelines.video.dedup import DedupPipelineArgs, run_dedup
+
+        summary = run_dedup(
+            DedupPipelineArgs(input_path=str(out1), eps=1e-3, use_mesh=False)
+        )
+        assert summary["method"] == "index_query"
+        # the index holds this very run: self-matches must not wipe the run
+        # (keep-first ordering keeps one member of every duplicate group)
+        assert summary["num_kept"] >= 2
+        assert summary["num_kept"] + summary["num_removed"] == 4
+
+
+class TestParallelLoadEmbeddings:
+    def test_order_stable_across_thread_counts(self, tmp_path, rng, monkeypatch):
+        from cosmos_curate_tpu.pipelines.video.dedup import load_embeddings
+        from cosmos_curate_tpu.storage.writers import write_parquet
+
+        vecs = rng.standard_normal((12, 8)).astype(np.float32)
+        ids = [f"v{i}" for i in range(12)]
+        for c in range(4):
+            sl = slice(c * 3, (c + 1) * 3)
+            write_parquet(
+                str(tmp_path / "embeddings" / MODEL / f"chunk-{c:05d}.parquet"),
+                {"clip_uuid": ids[sl], "embedding": [v.tolist() for v in vecs[sl]]},
+            )
+        monkeypatch.setenv("CURATE_WORKER_FETCH_THREADS", "1")
+        ids_serial, vecs_serial, model = load_embeddings(str(tmp_path))
+        monkeypatch.setenv("CURATE_WORKER_FETCH_THREADS", "4")
+        ids_par, vecs_par, _ = load_embeddings(str(tmp_path))
+        assert model == MODEL
+        assert ids_serial == ids_par == ids
+        np.testing.assert_array_equal(vecs_serial, vecs_par)
