@@ -490,6 +490,25 @@ def main() -> int:
         ):
             if f"pipeline_{key}" in caption:
                 record[f"caption_{key}"] = caption[f"pipeline_{key}"]
+        # paged-KV accounting: per-request reservation vs the slot-row
+        # engine's worst-case lane row, and the copy-free prefix sharing
+        # proof (block refs > 0 with zero whole-prefix copy dispatches)
+        for key in (
+            "kv_bytes_per_request",
+            "kv_bytes_per_request_worst_case",
+            "kv_block_size",
+            "kv_blocks_total",
+            "kv_blocks_peak",
+            "prefix_block_refs",
+            "prefix_copy_dispatches",
+            "kv_cow_copies",
+        ):
+            if key in caption:
+                record[f"caption_{key}"] = caption[key]
+        # cross-job continuous batching: two owners sharing one engine must
+        # interleave decode steps (per-owner tokens ride along)
+        if "cross_job" in caption:
+            record["caption_cross_job"] = caption["cross_job"]
         if caption.get("backend") == "tpu":
             record["decode_mfu"] = caption.get("decode_mfu", 0.0)
         elif caption.get("backend") != backend:

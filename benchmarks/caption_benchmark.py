@@ -49,6 +49,12 @@ def main() -> int:
         action="store_true",
         help="skip the pipeline-efficiency measurement",
     )
+    ap.add_argument(
+        "--no-cross-job",
+        action="store_true",
+        help="skip the cross-job continuous-batching measurement (two "
+        "owners submitting concurrently into the shared engine)",
+    )
     args = ap.parse_args()
 
     import numpy as np
@@ -133,6 +139,22 @@ def main() -> int:
         # a token (static slot batches; VERDICT r2 weak #5)
         "decode_slot_utilization": round(engine.decode_slot_utilization, 3),
         "kv_bytes": engine.kv_bytes(),
+        # paged-KV accounting: bytes actually reserved per admitted request
+        # (ceil(len/block_size) blocks) vs what the slot-row engine's
+        # worst-case lane row cost for the SAME admissions — the paging
+        # win; prefix blocks are REFERENCED (prefix_block_refs > 0) with
+        # zero whole-prefix device copies (prefix_copy_dispatches == 0 is
+        # structural; copy-on-write tail duplications ride kv_cow_copies)
+        "kv_block_size": engine.block_size,
+        "kv_blocks_total": engine.kv_blocks_total,
+        "kv_blocks_peak": engine.kv_blocks_used_peak,
+        "kv_bytes_per_request": round(engine.kv_bytes_reserved_per_request, 1),
+        "kv_bytes_per_request_worst_case": round(
+            engine.kv_bytes_worstcase_per_request, 1
+        ),
+        "prefix_block_refs": engine.prefix_block_refs,
+        "prefix_copy_dispatches": engine.prefix_copy_dispatches,
+        "kv_cow_copies": engine.kv_cow_copies,
         # shared-prefix KV cache traffic for the measured pass: hits should
         # be ~requests (cache warm from warmup), and prefill_tokens should
         # be down by prefix_len x requests vs an uncached run
@@ -158,10 +180,60 @@ def main() -> int:
         "peak_flops": chip_peak_flops(),
         "backend": jax.devices()[0].platform,
     }
+    if not args.no_cross_job:
+        record["cross_job"] = _cross_job_interleave(engine, make_request, args)
     if not args.no_pipeline:
         record.update(_pipeline_efficiency(cfg, engine, args))
     print(json.dumps(record))
     return 0
+
+
+def _cross_job_interleave(engine, make_request, args) -> dict:
+    """Cross-job continuous batching: two owners (standing in for two
+    concurrent pipelines sharing one SharedCaptionEngine) submit and drive
+    concurrently; healthy interleave shows decode steps whose active slots
+    span BOTH owners and per-owner token accounting, instead of the jobs
+    serializing."""
+    import threading
+
+    n = max(2, args.requests // 2)
+    steps0 = engine.interleaved_decode_steps
+    tokens0 = dict(engine.owner_decode_tokens)
+    results: dict = {}
+
+    # submit BOTH owners' requests before any drive starts: fair admission
+    # then deterministically seats both owners in the first decode window
+    # (thread start skew must not decide whether the interleave happens —
+    # the static-checks smoke asserts on it)
+    t0 = time.monotonic()
+    for tag in ("job0", "job1"):
+        for i in range(n):
+            req = make_request(f"{tag}-{i}", i)
+            req.owner = tag
+            engine.add_request(req)
+
+    def job(tag: str) -> None:
+        results[tag] = engine.run_until_complete(owner=tag)
+
+    threads = [threading.Thread(target=job, args=(f"job{j}",)) for j in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    owner_tokens = {
+        o: v - tokens0.get(o, 0)
+        for o, v in engine.owner_decode_tokens.items()
+        if o in ("job0", "job1")
+    }
+    out_tokens = sum(r.num_output_tokens for rs in results.values() for r in rs)
+    return {
+        "owners": 2,
+        "requests_per_owner": n,
+        "interleaved_steps": engine.interleaved_decode_steps - steps0,
+        "owner_decode_tokens": owner_tokens,
+        "tokens_per_sec": round(out_tokens / elapsed, 2) if elapsed > 0 else 0.0,
+    }
 
 
 def _pipeline_efficiency(cfg, engine, args) -> dict:
@@ -216,9 +288,13 @@ def _pipeline_efficiency(cfg, engine, args) -> dict:
         cfg=cfg, max_batch=args.batch, max_new_tokens=args.max_new
     )
     # the stage must adopt the ALREADY-BUILT engine (a second engine would
-    # double weight memory on chip): seed the process-wide cache under the
-    # key _CaptionVLM.setup computes
-    cap_mod._ENGINES[(cfg, args.batch, cap_mod._CaptionVLM.MODEL_ID, None)] = engine
+    # double weight memory on chip): seed the process-level registry under
+    # the key _CaptionVLM.setup resolves
+    from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
+
+    SharedCaptionEngine.adopt(
+        engine, cfg=cfg, model_id=cap_mod._CaptionVLM.MODEL_ID
+    )
     stage.model.setup()
     windows = [
         (f"{t_i}-{w_i}", win)

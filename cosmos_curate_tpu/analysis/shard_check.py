@@ -499,6 +499,29 @@ def default_contracts(mesh: dict[str, int]) -> list[ShardContract]:
             pads_batch=True,
         )
     )
+
+    # models/vlm/paged_kv.py — the caption engine's block-table KV gather:
+    # slot rows (tables) shard over the batch axes for data-parallel engine
+    # replicas, the block pool is replicated; the real shard_map call site
+    # is traced abstractly (same [L, NB, bs, Hkv, Dh] pool layout the
+    # engine compiles, tiny extents)
+    from cosmos_curate_tpu.models.vlm.paged_kv import paged_gather
+
+    pool_shape = (2, 9, 4, 2, 8)  # [L, n_blocks, block_size, Hkv, Dh]
+    contracts.append(
+        ShardContract(
+            name="vlm-paged-gather",
+            where="models/vlm/paged_kv.py",
+            inputs=(
+                AbstractInput(pool_shape, "bfloat16", (), name="pool_k"),
+                AbstractInput(pool_shape, "bfloat16", (), name="pool_v"),
+                AbstractInput((8, 2), "int32", (BATCH_AXES,), name="tables"),
+            ),
+            forward=lambda amesh, pk, pv, t: paged_gather(amesh, pk, pv, t),
+            needs_mesh=True,
+            pads_batch=True,
+        )
+    )
     return contracts
 
 

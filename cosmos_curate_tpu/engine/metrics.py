@@ -111,6 +111,37 @@ class EngineMetrics:
             "caption_prefix_tokens_saved_total",
             "prefill tokens skipped via shared-prefix hits", labels,
         )
+        # Paged-KV + cross-job signals (models/vlm/engine.py block pool):
+        # pool occupancy vs capacity is the admission headroom;
+        # prefix_block_refs climbing with cow_copies ~0 means prefixes are
+        # block-aligned and served copy-free; interleaved_steps > 0 means
+        # several owners (stages/jobs) are decoding in ONE batch.
+        self.caption_kv_blocks_used = Gauge(
+            "caption_kv_blocks_used", "KV pool blocks in use", labels
+        )
+        self.caption_kv_blocks_total = Gauge(
+            "caption_kv_blocks_total", "KV pool block capacity", labels
+        )
+        self.caption_prefix_block_refs = Counter(
+            "caption_prefix_block_refs_total",
+            "shared-prefix blocks referenced copy-free by admitted requests",
+            labels,
+        )
+        self.caption_kv_cow = Counter(
+            "caption_kv_cow_copies_total",
+            "copy-on-write duplications of shared prefix tail blocks", labels,
+        )
+        self.caption_interleaved_steps = Counter(
+            "caption_interleaved_steps_total",
+            "decode steps whose active slots spanned 2+ owners", labels,
+        )
+        # per-owner queue/in-flight gauges for the SHARED engine: which
+        # job/stage is occupying or starving the continuous batch
+        self.caption_owner_queue = Gauge(
+            "caption_owner_queue",
+            "caption engine requests per owner by state",
+            ["owner", "state"],
+        )
         # Cross-host object-plane signal (engine/object_channel.py via
         # stage_timer.record_object_plane): bytes moved between nodes, how
         # long consumers waited for them, and whether push-ahead prefetch
@@ -259,6 +290,49 @@ class EngineMetrics:
         self.caption_prefix_saved.labels(stage).inc(
             max(0, int(phases.get("prefix_tokens_saved", 0)))
         )
+        self.caption_prefix_block_refs.labels(stage).inc(
+            max(0, int(phases.get("prefix_block_refs", 0)))
+        )
+        self.caption_kv_cow.labels(stage).inc(
+            max(0, int(phases.get("kv_cow_copies", 0)))
+        )
+        self.caption_interleaved_steps.labels(stage).inc(
+            max(0, int(phases.get("interleaved_steps", 0)))
+        )
+        if "kv_blocks_used" in phases:
+            self.caption_kv_blocks_used.labels(stage).set(
+                max(0, int(phases["kv_blocks_used"]))
+            )
+        if "kv_blocks_total" in phases:
+            self.caption_kv_blocks_total.labels(stage).set(
+                max(0, int(phases["kv_blocks_total"]))
+            )
+
+    def observe_caption_owners(self, owners: dict) -> None:
+        """Set the per-owner queue gauges from ``CaptionEngine.owner_stats``
+        (cross-job continuous batching: who occupies the shared engine).
+        Owners absent from the snapshot have their gauge children REMOVED —
+        owner tags are per-stage-instance, so a long-lived service would
+        otherwise accumulate stale series forever (and a stage that died
+        mid-drive would pin a nonzero ``inflight`` at its last value)."""
+        if not self.enabled:
+            return
+        seen = getattr(self, "_caption_owner_seen", None)
+        if seen is None:
+            seen = self._caption_owner_seen = set()
+        for owner, stats in owners.items():
+            seen.add(str(owner))
+            for state in ("waiting", "ready", "inflight"):
+                self.caption_owner_queue.labels(owner, state).set(
+                    max(0, int(stats.get(state, 0)))
+                )
+        for owner in [o for o in seen if o not in owners]:
+            seen.discard(owner)
+            for state in ("waiting", "ready", "inflight"):
+                try:
+                    self.caption_owner_queue.remove(owner, state)
+                except KeyError:
+                    pass
 
     def observe_index(self, stage: str, deltas: dict) -> None:
         """Fold one corpus-index operation's deltas (the

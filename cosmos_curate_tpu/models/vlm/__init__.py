@@ -1,12 +1,17 @@
 from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, VLM_BASE, VLM_TINY_TEST
 from cosmos_curate_tpu.models.vlm.engine import CaptionEngine, CaptionRequest, SamplingConfig
+from cosmos_curate_tpu.models.vlm.paged_kv import BlockAllocator, PoolExhausted
+from cosmos_curate_tpu.models.vlm.shared_engine import SharedCaptionEngine
 
 __all__ = [
     "VLM",
     "VLMConfig",
     "VLM_BASE",
     "VLM_TINY_TEST",
+    "BlockAllocator",
     "CaptionEngine",
     "CaptionRequest",
+    "PoolExhausted",
     "SamplingConfig",
+    "SharedCaptionEngine",
 ]

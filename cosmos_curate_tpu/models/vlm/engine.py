@@ -5,26 +5,41 @@ Equivalent capability of the reference's vLLM engine driver
 in-flight batching with two-stage caption refinement; async variant
 vllm_async_stage.py). TPU-first re-design:
 
-- **slot-based KV cache**: a static ``[L, max_batch, max_seq, Hkv, Dh]``
-  cache; a request claims a free slot, prefills at a power-of-two bucket
-  length, then joins the batched one-token decode step. All jitted programs
-  have static shapes — XLA compiles O(log max_seq) prefill buckets plus one
-  decode program, nothing per-request.
+- **paged KV cache**: KV memory is ONE block pool ``[L, n_blocks,
+  block_size, Hkv, Dh]`` (models/vlm/paged_kv.py) and every admitted slot
+  holds a block *table* instead of a worst-case-length cache row — a
+  request reserves ``ceil((prompt + max_new + 1) / block_size)`` blocks, so
+  pool occupancy (not slot count) is the admission limit, vLLM
+  PagedAttention-style. Prefill/decode programs gather each slot's blocks
+  into a contiguous lane-length view (the exact shapes the slot-row engine
+  compiled — greedy outputs stay byte-identical), run the unchanged model,
+  and scatter the written blocks back. Lanes survive as decode-batch
+  shapes: a lane bounds the gathered view length and groups slots into one
+  static-shape decode program.
 - **continuous batching**: slots join/leave between decode steps; the decode
   step always runs the full slot batch with an active mask (idle rows write
-  into masked cache cells — dead work, bounded by max_batch, in exchange
-  for zero recompiles).
+  into the reserved garbage block — dead work, bounded by max_batch, in
+  exchange for zero recompiles).
 - **tokens/s** is tracked per engine — THE caption-throughput metric
   (reference docs/curator/design/SPEED_OF_LIGHT.md).
-- **shared-prefix KV cache**: every caption request in a run opens with the
-  same system-prompt/template text (SGLang RadixAttention's core insight,
-  Zheng et al. 2024 — and the caption workload is its best case: the prefix
-  is identical across ALL requests of a (flavor, prompt_variant)). The
-  prefix prefills ONCE into a small K/V block, which is device-copied into
-  each slot's cache rows at admission; per-request prefill then starts at
-  the prefix boundary with absolute rope positions, producing byte-identical
-  greedy output while skipping ``len(prefix) x (requests - 1)`` prefill
-  tokens.
+- **refcounted shared-prefix blocks**: every caption request in a run opens
+  with the same system-prompt/template text (SGLang RadixAttention's core
+  insight, Zheng et al. 2024 — and the caption workload is its best case:
+  the prefix is identical across ALL requests of a (flavor,
+  prompt_variant)). The prefix prefills ONCE into pool blocks that admitted
+  requests REFERENCE through their block tables with a refcount — zero
+  device copies at admission (the round-7 per-slot ``insert_prefix`` copy is
+  gone); copy-on-write duplicates only a partially-filled shared tail
+  block. Per-request prefill starts at the prefix boundary with absolute
+  rope positions, producing byte-identical greedy output while skipping
+  ``len(prefix) x (requests - 1)`` prefill tokens. Evicting a prefix whose
+  blocks are still referenced defers the free to the last referencing slot.
+- **cross-job continuous batching**: requests carry an ``owner`` and the
+  admission loop interleaves owners fairly (least-recently-admitted owner
+  first, per-owner in-flight cap), so several concurrent pipelines/stages
+  sharing one engine (models/vlm/shared_engine.py) decode in ONE batch
+  instead of serializing whole jobs — Orca-style iteration-level
+  scheduling across jobs.
 - **prep/decode overlap** (``async_prep=True``): a background thread runs
   vision encoding + token embedding for waiting requests while the caller's
   ``step()`` loop decodes, so frame prep of request N+1 hides behind decode
@@ -33,6 +48,7 @@ vllm_async_stage.py). TPU-first re-design:
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import OrderedDict, deque
@@ -47,6 +63,13 @@ import numpy as np
 from cosmos_curate_tpu.models.batching import next_pow2
 from cosmos_curate_tpu.models.tokenizer import ByteTokenizer, default_caption_tokenizer
 from cosmos_curate_tpu.models.vlm.model import VLM, VLMConfig, init_cache
+from cosmos_curate_tpu.models.vlm.paged_kv import (
+    BlockAllocator,
+    PoolExhausted,
+    gather_block_views,
+    init_block_pool,
+    scatter_block_views,
+)
 
 # full sampling surface (top_p/min_p/penalties/min_tokens) lives in
 # models/vlm/sampling.py; re-exported here for the existing import paths
@@ -161,12 +184,32 @@ class _Prepared:
 
 @dataclass
 class _PrefixEntry:
-    """Prefilled K/V of one shared text prefix: ``[L, Tp, Hkv, Dh]`` device
-    arrays, device-copied into a slot's cache rows at admission."""
+    """One shared text prefix, prefilled ONCE and resident in pool blocks.
 
-    k: Any
-    v: Any
+    Admitted requests reference ``blocks[:n_full]`` directly through their
+    block tables (refcounted — zero device copies); a partially-filled
+    ``tail_block`` (``length % block_size != 0``) is copy-on-write
+    duplicated at admission, since the referencing slot's own K/V writes
+    would otherwise extend into shared memory."""
+
+    blocks: list[int]  # ceil(length / block_size) pool block ids
+    n_full: int  # length // block_size — the directly-shareable prefix
+    tail_block: int | None  # blocks[-1] when partially filled, else None
     length: int
+
+
+@dataclass
+class _BlockClaim:
+    """The pool blocks one admitted slot holds: ``shared`` prefix blocks it
+    incref'd (freed back to the prefix entry's refcount on release) and
+    ``private`` blocks it owns outright (freed on release)."""
+
+    shared: list[int]
+    private: list[int]
+
+    @property
+    def all_blocks(self) -> list[int]:
+        return self.shared + self.private
 
 
 @dataclass
@@ -196,28 +239,30 @@ class _PendingPrefill:
 
 @dataclass
 class _Lane:
-    """One KV pool: ``n_slots`` cache rows of ``length`` positions each.
+    """One decode-batch shape: ``n_slots`` block tables of ``length``
+    gathered positions each.
 
-    The length-bucketed answer to vLLM's paged KV (reference
-    SPEED_OF_LIGHT.md:116-121): instead of paging — dynamic gather per
-    attention read, hostile to XLA's static-shape compilation — KV memory is
-    bound by ACTUAL request lengths at bucket granularity. Short requests
-    land in short lanes, so the same HBM holds several times more
-    concurrent slots than one worst-case-length pool; decode cost already
-    scales with true lengths (kv_len masking + the Pallas kernel's early
-    exit), so lanes attack the memory axis, which paging exists to fix.
-    Each lane decodes as its own batch (programs are cached per shape)."""
+    With the paged pool, a lane no longer OWNS KV memory — blocks come from
+    the engine-wide pool and occupancy is the admission limit. What a lane
+    still bounds is compiled-program shape: its slots decode as one static
+    ``[n_slots, length]`` batch, and ``length`` caps the gathered view (so
+    short requests ride cheap short-view programs instead of the worst-case
+    gather). ``table`` rows are the slot block tables; free/unused entries
+    point at the reserved garbage block 0."""
 
     length: int
     base: int  # global slot-id offset (lane-local idx + base = public id)
     n_slots: int
-    cache_k: Any = None
-    cache_v: Any = None
+    # [n_slots, length // block_size] int32 pool block ids (host-side; a
+    # snapshot rides into every prefill/decode program call)
+    table: np.ndarray | None = None
     slots: dict = field(default_factory=dict)
     pending: dict = field(default_factory=dict)
     # slot indices claimed by _admit's current grouping pass (released when
     # the group prefill runs)
     reserved: set = field(default_factory=set)
+    # slot idx -> _BlockClaim, held from admission until release
+    claims: dict = field(default_factory=dict)
 
 
 class CaptionEngine:
@@ -235,6 +280,9 @@ class CaptionEngine:
         prefix_cache_size: int = 8,
         min_prefix_len: int = 4,
         admission_linger_s: float = 0.05,
+        block_size: int = 16,
+        kv_pool_blocks: int | None = None,
+        owner_inflight_cap: int | None = None,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
@@ -245,16 +293,53 @@ class CaptionEngine:
         self.model = VLM(cfg)
         self.params = params
         self.waiting: list[CaptionRequest] = []
-        # (length, n_slots) per KV pool; default = one worst-case-length
-        # pool, the round-2 behavior
+        # (length, n_slots) per decode-batch lane; default = one
+        # worst-case-length lane, the round-2 behavior
         spec = kv_lanes or ((cfg.max_seq, max_batch),)
+        # every lane length must tile into whole blocks (the gathered view
+        # must equal the lane length EXACTLY for shape parity with the
+        # slot-row programs): shrink the block size to the largest common
+        # divisor when a lane length doesn't tile
+        bs = max(1, int(block_size))
+        for length, _ in spec:
+            bs = math.gcd(bs, int(length))
+        if bs != block_size:
+            logger.warning(
+                "block_size %d does not divide every KV lane length; using %d",
+                block_size, bs,
+            )
+        self.block_size = bs
         base = 0
         self.lanes: list[_Lane] = []
         for length, n in sorted(spec):
             if length > cfg.max_seq:
                 raise ValueError(f"lane length {length} exceeds max_seq {cfg.max_seq}")
-            self.lanes.append(_Lane(length=length, base=base, n_slots=n))
+            self.lanes.append(
+                _Lane(
+                    length=length,
+                    base=base,
+                    n_slots=n,
+                    table=np.zeros((n, length // bs), np.int32),
+                )
+            )
             base += n
+        self.prefix_cache_size = prefix_cache_size
+        lane_blocks = sum((l.length // bs) * l.n_slots for l in self.lanes)
+        if kv_pool_blocks is None:
+            # pool capacity = the memory the per-lane rows used to pin, plus
+            # headroom for the shared-prefix entries that now live in pool
+            # blocks, plus the reserved garbage block 0
+            prefix_reserve = (
+                prefix_cache_size * max(1, min(256, self.lanes[-1].length) // bs)
+                if enable_prefix_cache
+                else 0
+            )
+            kv_pool_blocks = 1 + lane_blocks + prefix_reserve
+        # a pool smaller than the lane sum could deadlock a full slot load
+        self.kv_pool_blocks = max(int(kv_pool_blocks), 1 + lane_blocks)
+        self._allocator = BlockAllocator(self.kv_pool_blocks)
+        self._pool_k = None
+        self._pool_v = None
         self.completed: list[CaptionResult] = []
         self._decode_tokens = 0
         self._decode_time = 0.0
@@ -287,6 +372,30 @@ class CaptionEngine:
         self._prefix_misses = 0
         self._prefix_evictions = 0
         self._prefix_tokens_saved = 0
+        # paged-KV accounting (all under _stats_lock): cumulative block
+        # reservations per admitted request (the kv_bytes_per_request bench
+        # field), the worst-case tokens the slot-row engine would have
+        # reserved for the same admissions, shared-prefix block references
+        # handed out (the zero-copy successor of insert_prefix dispatches),
+        # and copy-on-write tail duplications
+        self._requests_admitted = 0
+        self._kv_blocks_reserved = 0
+        self._kv_private_blocks = 0
+        self._kv_worstcase_tokens = 0
+        self._prefix_block_refs = 0
+        self._kv_cow_copies = 0
+        self._kv_blocks_used_peak = 0
+        # cross-job fairness: least-recently-admitted owner goes first, and
+        # no owner may hold more than its in-flight share of the slots
+        # (owner_inflight_cap; None = ceil(total slots / active owners))
+        self.owner_inflight_cap = owner_inflight_cap
+        self._owner_last_admit: dict[Any, int] = {}
+        self._owner_last_prep: dict[Any, int] = {}
+        self._admit_seq = 0
+        self._prep_seq = 0
+        self._interleaved_steps = 0
+        self._owner_decode_tokens: dict[Any, int] = {}
+        self._owner_requests: dict[Any, int] = {}
         # async prep: a background thread runs vision encode + embedding for
         # waiting requests while the caller's step() loop decodes — prep of
         # request N+1 overlaps decode of request N (the caption stage's
@@ -329,11 +438,10 @@ class CaptionEngine:
         return {l.base + i: p for l in self.lanes for i, p in l.pending.items()}
 
     def kv_bytes(self) -> int:
-        return sum(
-            l.cache_k.nbytes + l.cache_v.nbytes
-            for l in self.lanes
-            if l.cache_k is not None
-        )
+        """Total device bytes the KV block pool pins."""
+        if self._pool_k is None:
+            return 0
+        return self._pool_k.nbytes + self._pool_v.nbytes
 
     # -- setup ----------------------------------------------------------
     def setup(self, seed: int = 0) -> None:
@@ -355,10 +463,12 @@ class CaptionEngine:
                 cv,
                 method=self.model.init_everything,
             )
-        for lane in self.lanes:
-            lane.cache_k, lane.cache_v = init_cache(cfg, lane.n_slots, length=lane.length)
+        self._pool_k, self._pool_v = init_block_pool(
+            cfg, self.kv_pool_blocks, self.block_size
+        )
 
         model = self.model
+        bs = self.block_size
 
         @jax.jit
         def encode_images(params, frames_u8):
@@ -377,17 +487,19 @@ class CaptionEngine:
         )
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_batch(params, cache_k, cache_v, embeds, slots, write_index, t_valid, rope_pos, ds=None):
-            """Batched prefill (replaces the round-1 one-request-at-a-time
-            admission — the reference leans on vLLM's batched prefill,
-            vllm_interface.py:543). embeds: [N, Tb, D] (bucket- or
-            chunk-padded); slots/write_index/t_valid: [N]; rope_pos:
-            [N, Tb] (or [N, Tb, 3] m-rope). write_index > 0 rows are later
-            chunks of a chunked prefill. Writes every row's cache cells in
-            one program and returns each row's logits at its last valid
-            position: [N, V]."""
-            ck = cache_k[:, slots]  # [L, N, S, Hkv, Dh]
-            cv = cache_v[:, slots]
+        def prefill_batch(params, pool_k, pool_v, tables, embeds, write_index, t_valid, rope_pos, ds=None):
+            """Batched prefill through the block tables (replaces the
+            round-1 one-request-at-a-time admission — the reference leans
+            on vLLM's batched prefill, vllm_interface.py:543). embeds:
+            [N, Tb, D] (bucket- or chunk-padded); tables: [N, nbl] block
+            ids; write_index/t_valid: [N]; rope_pos: [N, Tb] (or [N, Tb, 3]
+            m-rope). write_index > 0 rows are later chunks of a chunked
+            prefill, or shared-prefix suffixes starting past their cached
+            blocks. Gathers each row's blocks into a contiguous view (the
+            slot-row shapes — byte-identical math), writes every row's
+            cells in one program, scatters the blocks back, and returns
+            each row's logits at its last valid position: [N, V]."""
+            ck, cv = gather_block_views(pool_k, pool_v, tables)
             logits, nk, nv = model.apply(
                 params,
                 embeds,
@@ -398,18 +510,20 @@ class CaptionEngine:
                 write_index + t_valid,
                 deepstack=ds,
             )
-            cache_k = cache_k.at[:, slots].set(nk)
-            cache_v = cache_v.at[:, slots].set(nv)
+            pool_k, pool_v = scatter_block_views(pool_k, pool_v, tables, nk, nv)
             last = jnp.take_along_axis(
                 logits, (t_valid - 1)[:, None, None].astype(jnp.int32), axis=1
             )[:, 0]
-            return last, cache_k, cache_v
+            return last, pool_k, pool_v
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, cache_k, cache_v, tokens, positions, rope_positions):
-            """tokens/positions/rope_positions: [max_batch]; one token per
-            slot. positions index the cache; rope_positions are the rotary
-            positions (identical unless m-rope lagged them at prefill).
+        def decode_step(params, pool_k, pool_v, tables, tokens, positions, rope_positions):
+            """tokens/positions/rope_positions: [n_slots]; one token per
+            slot. positions index the gathered view; rope_positions are the
+            rotary positions (identical unless m-rope lagged them at
+            prefill). tables: [n_slots, nbl] — idle rows point at the
+            garbage block, shared prefix blocks scatter back unchanged (the
+            paged_kv module docstring's duplicate-write invariant).
 
             Greedy argmax happens ON DEVICE for the whole batch — per-slot
             host argmaxes were the decode loop's bottleneck (one device
@@ -419,18 +533,20 @@ class CaptionEngine:
             if mrope:
                 # decode is always text: all three components equal
                 rp = jnp.broadcast_to(rp[..., None], (*rp.shape, 3))
-            logits, ck, cv = model.apply(
+            ck, cv = gather_block_views(pool_k, pool_v, tables)
+            logits, nk, nv = model.apply(
                 params,
                 embeds,
-                cache_k,
-                cache_v,
+                ck,
+                cv,
                 rp,
                 positions,
                 positions + 1,
             )
+            pool_k, pool_v = scatter_block_views(pool_k, pool_v, tables, nk, nv)
             step_logits = logits[:, 0]
             greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
-            return greedy, step_logits, ck, cv
+            return greedy, step_logits, pool_k, pool_v
 
         @jax.jit
         def prefix_prefill(params, embeds, rope_pos, t_valid):
@@ -453,19 +569,27 @@ class CaptionEngine:
             return nk[:, 0], nv[:, 0]
 
         @partial(jax.jit, donate_argnums=(0, 1))
-        def insert_prefix(cache_k, cache_v, pk, pv, slot):
-            """Device-copy a cached prefix K/V block into one slot's cache
-            rows [0, Tp) — per-request prefill then starts at cache
-            position Tp. Compiled once per (lane shape, Tp)."""
-            zero = jnp.zeros((), jnp.int32)
-            idx = (zero, slot, zero, zero, zero)
-            ck = jax.lax.dynamic_update_slice(
-                cache_k, pk.astype(cache_k.dtype)[:, None], idx
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cache_v, pv.astype(cache_v.dtype)[:, None], idx
-            )
-            return ck, cv
+        def write_prefix_blocks(pool_k, pool_v, pk, pv, ids):
+            """Store one freshly built prefix K/V ([L, Tp, Hkv, Dh]) into
+            its allocated pool blocks ``ids`` ([nb]) — the ONE device write
+            per prefix build; admitted requests then reference these blocks
+            with zero further copies. Compiled once per Tp (prefixes are
+            per (flavor, prompt_variant), so this runs once per variant)."""
+            pad = ids.shape[0] * bs - pk.shape[1]
+            pk = jnp.pad(pk.astype(pool_k.dtype), ((0, 0), (0, pad), (0, 0), (0, 0)))
+            pv = jnp.pad(pv.astype(pool_v.dtype), ((0, 0), (0, pad), (0, 0), (0, 0)))
+            pool_k = pool_k.at[:, ids].set(pk.reshape(pk.shape[0], -1, bs, *pk.shape[2:]))
+            pool_v = pool_v.at[:, ids].set(pv.reshape(pv.shape[0], -1, bs, *pv.shape[2:]))
+            return pool_k, pool_v
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def copy_blocks(pool_k, pool_v, src, dst):
+            """Copy-on-write: duplicate blocks ``src`` into ``dst`` ([m]
+            each) — used ONLY when a request must extend a partially-filled
+            shared prefix tail block (one block, not the whole prefix)."""
+            pool_k = pool_k.at[:, dst].set(pool_k[:, src])
+            pool_v = pool_v.at[:, dst].set(pool_v[:, src])
+            return pool_k, pool_v
 
         self._host_rng = np.random.default_rng(seed)
         self._encode_images = encode_images
@@ -473,7 +597,8 @@ class CaptionEngine:
         self._prefill_batch = prefill_batch
         self._decode = decode_step
         self._prefix_prefill = prefix_prefill
-        self._insert_prefix = insert_prefix
+        self._write_prefix_blocks = write_prefix_blocks
+        self._copy_blocks = copy_blocks
         self._built = True
         if self.async_prep:
             # requests may already be waiting (queued before setup)
@@ -550,6 +675,9 @@ class CaptionEngine:
                 if not self.has_work(owner):
                     mine = [r for r in self.completed if r.owner == owner]
                     self.completed = [r for r in self.completed if r.owner != owner]
+                    # keep THIS owner's entries: the caller reads its
+                    # per-owner accounting deltas right after this returns
+                    self._prune_owner_state(keep=owner)
                     return mine
                 steppable = (
                     bool(self._ready)
@@ -609,6 +737,115 @@ class CaptionEngine:
     def vision_reuses(self) -> int:
         return self._vision_reuses
 
+    # -- paged-KV occupancy and cross-job accounting --------------------
+    @property
+    def kv_blocks_total(self) -> int:
+        """Allocatable pool blocks (admission limit; garbage block excluded)."""
+        return self._allocator.capacity
+
+    @property
+    def kv_blocks_used(self) -> int:
+        return self._allocator.used_blocks
+
+    @property
+    def kv_blocks_used_peak(self) -> int:
+        """High-water pool occupancy since the last reset_stats()."""
+        return self._kv_blocks_used_peak
+
+    @property
+    def kv_block_bytes(self) -> int:
+        """Device bytes one block pins (K + V across all layers)."""
+        cfg = self.cfg
+        # bf16 pool: 2 bytes/element, x2 for K and V
+        return 2 * 2 * cfg.n_layers * self.block_size * cfg.n_kv_heads * cfg.head_dim
+
+    @property
+    def prefix_block_refs(self) -> int:
+        """Cumulative shared-prefix block references handed to admitted
+        requests — each one is a whole block of prefix K/V served with ZERO
+        device copies (the metric that replaced insert_prefix dispatches)."""
+        return self._prefix_block_refs
+
+    @property
+    def prefix_copy_dispatches(self) -> int:
+        """Whole-prefix device-copy dispatches at admission. Structurally
+        zero since the paged pool: admitted requests REFERENCE prefix
+        blocks through their tables instead of copying them into slot rows
+        (the round-7 jitted insert_prefix path is deleted). Kept as an
+        explicit counter so the bench/smoke contract 'zero prefix
+        device-copy dispatches' is asserted, not assumed."""
+        return 0
+
+    @property
+    def kv_cow_copies(self) -> int:
+        """Copy-on-write duplications of a partially-filled shared prefix
+        tail block (ONE block each — not a prefix copy)."""
+        return self._kv_cow_copies
+
+    @property
+    def requests_admitted(self) -> int:
+        return self._requests_admitted
+
+    @property
+    def kv_bytes_reserved_per_request(self) -> float:
+        """Mean KV bytes reserved per admitted request (shared references
+        counted at full block size — still strictly below the old
+        worst-case row whenever prompt + max_new undershoots the lane)."""
+        if not self._requests_admitted:
+            return 0.0
+        return self._kv_blocks_reserved * self.kv_block_bytes / self._requests_admitted
+
+    @property
+    def kv_bytes_worstcase_per_request(self) -> float:
+        """What the slot-row engine reserved for the same admissions: each
+        routed lane's FULL row, regardless of actual request length."""
+        if not self._requests_admitted:
+            return 0.0
+        token_bytes = self.kv_block_bytes / self.block_size
+        return self._kv_worstcase_tokens * token_bytes / self._requests_admitted
+
+    @property
+    def interleaved_decode_steps(self) -> int:
+        """Steps whose active slots spanned 2+ owners — the cross-job
+        continuous-batching signal (two pipelines decoding in ONE batch)."""
+        return self._interleaved_steps
+
+    @property
+    def owner_decode_tokens(self) -> dict:
+        with self._stats_lock:
+            return dict(self._owner_decode_tokens)
+
+    def owner_stats(self) -> dict:
+        """Per-owner queue/in-flight/served gauges, keyed by str(owner) —
+        the cross-job accounting surface (metrics exporter + run report)."""
+        with self._lock:
+            out: dict[str, dict] = {}
+
+            def bucket(owner):
+                return out.setdefault(
+                    str(owner),
+                    {"waiting": 0, "ready": 0, "inflight": 0,
+                     "decode_tokens": 0, "requests": 0},
+                )
+
+            for r in self.waiting:
+                bucket(r.owner)["waiting"] += 1
+            if self._prep_inflight is not None:
+                bucket(self._prep_inflight.owner)["waiting"] += 1
+            for p in self._ready:
+                bucket(p.request.owner)["ready"] += 1
+            for lane in self.lanes:
+                for s in lane.slots.values():
+                    bucket(s.request.owner)["inflight"] += 1
+                for p in lane.pending.values():
+                    bucket(p.request.owner)["inflight"] += 1
+            with self._stats_lock:
+                for owner, n in self._owner_decode_tokens.items():
+                    bucket(owner)["decode_tokens"] = n
+                for owner, n in self._owner_requests.items():
+                    bucket(owner)["requests"] = n
+            return out
+
     @property
     def phase_seconds(self) -> dict[str, float]:
         """Cumulative per-phase seconds: ``prep`` (host prep incl. the
@@ -641,10 +878,33 @@ class CaptionEngine:
             self._prefix_misses = 0
             self._prefix_evictions = 0
             self._prefix_tokens_saved = 0
+            self._requests_admitted = 0
+            self._kv_blocks_reserved = 0
+            self._kv_private_blocks = 0
+            self._kv_worstcase_tokens = 0
+            self._prefix_block_refs = 0
+            self._kv_cow_copies = 0
+            self._kv_blocks_used_peak = self._allocator.used_blocks
+            self._interleaved_steps = 0
+            self._owner_decode_tokens.clear()
+            self._owner_requests.clear()
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every cached prefix and release the LRU's block references.
+        Blocks still mapped by in-flight slots stay allocated until those
+        slots release (deferred free); after a full drain the pool reads
+        fully free."""
+        with self._lock, self._prefix_lock:
+            for entry in self._prefix_cache.values():
+                self._allocator.decref(entry.blocks)
+            self._prefix_cache.clear()
 
     def shutdown(self) -> None:
-        """Stop the background prep thread (tests; long-lived engines just
-        let the daemon thread die with the process)."""
+        """Stop the background prep thread and release the prefix cache's
+        block references (tests assert the pool is fully free after a
+        drained shutdown; long-lived engines just let the daemon thread die
+        with the process)."""
+        self.clear_prefix_cache()
         with self._work_cv:
             self._prep_stop = True
             self._work_cv.notify_all()
@@ -682,6 +942,14 @@ class CaptionEngine:
             raise RuntimeError("call setup() first")
         with self._work_cv:
             self._admit()
+            # cross-job signal: this step's active slots span 2+ owners —
+            # several jobs are decoding in ONE continuous batch
+            step_owners = {
+                s.request.owner for l in self.lanes for s in l.slots.values()
+            }
+            if len(step_owners) > 1:
+                with self._stats_lock:
+                    self._interleaved_steps += 1
             for lane in self.lanes:
                 if lane.pending:
                     self._prefill_chunk_step(lane)
@@ -722,7 +990,7 @@ class CaptionEngine:
                     self._work_cv.wait(0.1)
                 if self._prep_stop:
                     return
-                req = self.waiting.pop(0)
+                req = self._pop_waiting_fair()
                 self._prep_inflight = req
             prep = self._safe_prepare(req)  # no lock: overlaps decode
             with self._work_cv:
@@ -730,6 +998,68 @@ class CaptionEngine:
                 if prep is not None:
                     self._ready.append(prep)
                 self._work_cv.notify_all()
+
+    # every stage instance mints a fresh owner tag, so a long-lived shared
+    # engine would otherwise accumulate owner-keyed state forever (and mint
+    # unbounded per-owner metric series)
+    _OWNER_STATE_CAP = 256
+
+    def _prune_owner_state(self, keep: Any = None) -> None:
+        """Bound the owner-keyed maps: once past the cap, drop entries for
+        owners with no live work. ``keep`` protects the owner whose drive
+        just completed — its stage reads the accounting deltas right after
+        (pruning it first would hand the stage a zero/negative delta).
+        Lock held by caller."""
+        maps = (
+            self._owner_last_admit,
+            self._owner_last_prep,
+            self._owner_decode_tokens,
+            self._owner_requests,
+        )
+        if all(len(m) <= self._OWNER_STATE_CAP for m in maps):
+            return
+        live = {r.owner for r in self.waiting}
+        live.update(p.request.owner for p in self._ready)
+        if self._prep_inflight is not None:
+            live.add(self._prep_inflight.owner)
+        for lane in self.lanes:
+            live.update(s.request.owner for s in lane.slots.values())
+            live.update(p.request.owner for p in lane.pending.values())
+        live.update(r.owner for r in self.completed)
+        if keep is not None:
+            live.add(keep)
+        with self._stats_lock:
+            for m in maps:
+                if len(m) > self._OWNER_STATE_CAP:
+                    for owner in [o for o in m if o not in live]:
+                        del m[owner]
+
+    @staticmethod
+    def _fair_head(owners_in_order, last_map: dict, inflight: dict, cap: float):
+        """(owner, index) of the next fair pick: FIFO within an owner,
+        least-recently-served owner first, owners at ``cap`` in-flight
+        skipped. ``owners_in_order`` yields each queue item's owner in
+        queue order. Returns None when every queued owner is capped."""
+        heads: "OrderedDict[Any, int]" = OrderedDict()
+        for i, owner in enumerate(owners_in_order):
+            if owner not in heads:
+                heads[owner] = i
+        eligible = [(o, i) for o, i in heads.items() if inflight.get(o, 0) < cap]
+        if not eligible:
+            return None
+        return min(eligible, key=lambda kv: (last_map.get(kv[0], -1), kv[1]))
+
+    def _pop_waiting_fair(self) -> CaptionRequest:
+        """Next waiting request: one pipeline's burst cannot push another
+        pipeline's requests out of the prep pipeline (cross-job fairness
+        starts at prep, since only prepped requests can be admitted).
+        Single-owner queues reduce to plain FIFO. Lock held by caller."""
+        owner, idx = self._fair_head(
+            (r.owner for r in self.waiting), self._owner_last_prep, {}, float("inf")
+        )
+        self._owner_last_prep[owner] = self._prep_seq
+        self._prep_seq += 1
+        return self.waiting.pop(idx)
 
     def _safe_prepare(self, req: CaptionRequest) -> "_Prepared | None":
         t0 = time.monotonic()
@@ -762,15 +1092,56 @@ class CaptionEngine:
             self._linger_until = now + self.admission_linger_s
         return now < self._linger_until
 
-    def _next_prepared(self) -> "_Prepared | None":
-        """Next admission candidate in FIFO order: the ready queue first; in
-        sync mode fall through to inline prep of the waiting queue."""
+    def _owner_cap(self, inflight: dict) -> int:
+        """Per-owner in-flight slot cap: an explicit ``owner_inflight_cap``,
+        or the fair share of the slot budget across owners that currently
+        have work. A single owner gets the whole engine (admission-order
+        parity with the single-job engine)."""
+        if self.owner_inflight_cap is not None:
+            return max(1, self.owner_inflight_cap)
+        owners = set(inflight)
+        owners.update(r.owner for r in self.waiting)
+        owners.update(p.request.owner for p in self._ready)
+        if self._prep_inflight is not None:
+            owners.add(self._prep_inflight.owner)
+        total = sum(l.n_slots for l in self.lanes)
+        if len(owners) <= 1:
+            return total
+        return max(1, -(-total // len(owners)))
+
+    def _next_prepared(self, inflight: dict) -> "_Prepared | None":
+        """Next admission candidate: FIFO within an owner, least-recently-
+        admitted owner first, owners at their in-flight cap skipped — the
+        cross-job interleave. Single-owner queues reduce to plain FIFO. In
+        sync mode fall through to inline prep of the waiting queue (same
+        owner rotation)."""
+        cap = self._owner_cap(inflight)
         if self._ready:
-            return self._ready.popleft()
+            pick = self._fair_head(
+                (p.request.owner for p in self._ready),
+                self._owner_last_admit,
+                inflight,
+                cap,
+            )
+            if pick is None:
+                return None  # every queued owner is at its fair share
+            prep = self._ready[pick[1]]
+            del self._ready[pick[1]]
+            return prep
         if not self.async_prep:
             while self.waiting:
-                req = self.waiting.pop(0)
-                prep = self._safe_prepare(req)
+                pick = self._fair_head(
+                    (r.owner for r in self.waiting),
+                    self._owner_last_prep,
+                    inflight,
+                    cap,
+                )
+                if pick is None:
+                    return None
+                owner, idx = pick
+                self._owner_last_prep[owner] = self._prep_seq
+                self._prep_seq += 1
+                prep = self._safe_prepare(self.waiting.pop(idx))
                 if prep is not None:
                     return prep
         return None
@@ -823,9 +1194,17 @@ class CaptionEngine:
     def _admit(self) -> None:
         if self._should_linger():
             return
+        # per-owner in-flight counts for the fairness cap (updated as this
+        # pass admits, so one pass cannot blow past the cap either)
+        inflight: dict[Any, int] = {}
+        for l in self.lanes:
+            for s in l.slots.values():
+                inflight[s.request.owner] = inflight.get(s.request.owner, 0) + 1
+            for p in l.pending.values():
+                inflight[p.request.owner] = inflight.get(p.request.owner, 0) + 1
         groups: dict[tuple[int, int], list[tuple]] = {}
         while True:
-            prep = self._next_prepared()
+            prep = self._next_prepared(inflight)
             if prep is None:
                 break
             req = prep.request
@@ -866,6 +1245,14 @@ class CaptionEngine:
                     if prep.ds is not None:
                         prep.ds = prep.ds[:, -lane_budget:]
                     prep.t_suffix = lane_budget
+            # The prefix entry must be resident BEFORE placement decisions:
+            # when the pool cannot host it (exhausted with nothing
+            # evictable), fold the prefix back into the host embeds and
+            # admit uncached — recompute beats waiting on cache memory.
+            if prep.base:
+                entry, _ = self._ensure_prefix(prep.prefix_key, count=False)
+                if entry is None:
+                    prep = self._materialize_full(prep)
             # Shared-prefix placement feasibility in THIS lane: a bucketed
             # group prefill writes a [bucket]-length chunk at offset base,
             # which must stay inside the lane. Chunked prefill places
@@ -893,14 +1280,31 @@ class CaptionEngine:
                 and i not in lane.pending
                 and i not in lane.reserved
             )
-            if prep.base:
-                try:
-                    self._insert_prefix_into(lane, slot_idx, prep)
-                except Exception:
-                    logger.exception(
-                        "prefix insert failed for %s; dropping", req.request_id
-                    )
+            try:
+                self._claim_kv(lane, slot_idx, prep, req)
+            except PoolExhausted:
+                if prep.base and not any(l.claims for l in self.lanes):
+                    # nothing in flight will free blocks and eviction
+                    # spares the entry this claim references — the
+                    # request's OWN prefix entry may be hoarding an idle
+                    # pool. Fold the prefix back in and retry uncached: a
+                    # lone worst-case request always fits an empty pool
+                    # (kv_pool_blocks is floored at the lane sum).
+                    self._ready.appendleft(self._materialize_full(prep))
                     continue
+                # occupancy-based admission: the BLOCK POOL, not slot
+                # count, is the limit — wait for in-flight requests to
+                # free blocks (prep kept, not redone)
+                self._ready.appendleft(prep)
+                break
+            except Exception:
+                logger.exception(
+                    "KV block claim failed for %s; dropping", req.request_id
+                )
+                continue
+            inflight[req.owner] = inflight.get(req.owner, 0) + 1
+            self._owner_last_admit[req.owner] = self._admit_seq
+            self._admit_seq += 1
             if chunked:
                 # long prompt: prefill in chunks interleaved with decode
                 lane.pending[slot_idx] = _PendingPrefill(
@@ -939,6 +1343,7 @@ class CaptionEngine:
                     logger.exception(
                         "prefill failed for %s; dropping", items[0][1].request_id
                     )
+                    self._release_claim(lane, items[0][0])
                     continue
                 # isolate the offender: retry each request as its own group
                 logger.exception(
@@ -952,6 +1357,7 @@ class CaptionEngine:
                         logger.exception(
                             "prefill failed for %s; dropping", item[1].request_id
                         )
+                        self._release_claim(lane, item[0])
 
     def _prepare(self, req: CaptionRequest, allow_prefix: bool = True) -> _Prepared:
         """Vision encode + token embed for one request.
@@ -1130,11 +1536,17 @@ class CaptionEngine:
             ds=ds,
         )
 
-    def _ensure_prefix(self, key: tuple, count: bool = True) -> tuple[_PrefixEntry, bool]:
-        """(entry, was_hit) for one shared text prefix, building and
-        LRU-inserting the K/V block on first use. Runs under the prefix
-        lock only — the build touches no lane state, so the prep thread
-        can build a prefix while the decode loop holds the engine lock.
+    def _ensure_prefix(
+        self, key: tuple, count: bool = True
+    ) -> "tuple[_PrefixEntry | None, bool]":
+        """(entry, was_hit) for one shared text prefix, prefilling it into
+        POOL BLOCKS on first use and LRU-inserting the entry. The scratch
+        prefill compute runs without the engine lock (it touches no pool
+        state, so the prep thread can build a prefix while the decode loop
+        runs); only the final block allocation + pool write takes the
+        engine lock — lock order is always engine lock -> prefix lock.
+        Returns (None, False) when the pool cannot host the entry even
+        after evicting idle prefixes: callers serve the prefix uncached.
         ``count=False`` skips the hit counter (the admission-time re-lookup
         must not double-count the prep-time hit); rebuild misses always
         count — an eviction-rebuild is real recompute."""
@@ -1146,52 +1558,189 @@ class CaptionEngine:
                     with self._stats_lock:
                         self._prefix_hits += 1
                 return entry, True
-            with self._stats_lock:
-                self._prefix_misses += 1
-            tp = len(key)
-            sp = next_pow2(tp)
-            emb = np.zeros((1, sp, self.cfg.dim), np.float32)
-            emb[0, :tp] = np.asarray(
-                self._embed_tokens(self.params, jnp.asarray(key, jnp.int32)[None])[0],
-                np.float32,
-            )
-            pos = np.zeros((1, sp), np.int32)
-            pos[0, :tp] = np.arange(tp, dtype=np.int32)
-            if self.cfg.mrope_section is not None:
-                # text prefix: all three m-rope components equal
-                pos = np.broadcast_to(pos[..., None], (1, sp, 3))
-            t0 = time.monotonic()
-            k, v = self._prefix_prefill(
-                self.params,
-                jnp.asarray(emb),
-                jnp.asarray(pos),
-                jnp.asarray(tp, jnp.int32),
-            )
-            k, v = k[:, :tp], v[:, :tp]
-            jax.block_until_ready(v)
-            with self._stats_lock:
-                self._prefill_time += time.monotonic() - t0
-                self._prefill_tokens += tp
-            entry = _PrefixEntry(k=k, v=v, length=tp)
-            self._prefix_cache[key] = entry
-            while len(self._prefix_cache) > self.prefix_cache_size:
-                self._prefix_cache.popitem(last=False)
-                with self._stats_lock:
-                    self._prefix_evictions += 1
-            return entry, False
-
-    def _insert_prefix_into(self, lane: _Lane, slot_idx: int, prep: _Prepared) -> None:
-        """Device-copy the shared prefix K/V into the slot's cache rows
-        [0, base). Re-ensures the entry — it may have been evicted between
-        prep and admission under a small cache with many variants."""
-        entry, _hit = self._ensure_prefix(prep.prefix_key, count=False)
-        lane.cache_k, lane.cache_v = self._insert_prefix(
-            lane.cache_k,
-            lane.cache_v,
-            entry.k,
-            entry.v,
-            jnp.asarray(slot_idx, jnp.int32),
+        if not self.enable_prefix_cache:
+            return None, False
+        with self._stats_lock:
+            self._prefix_misses += 1
+        tp = len(key)
+        sp = next_pow2(tp)
+        emb = np.zeros((1, sp, self.cfg.dim), np.float32)
+        emb[0, :tp] = np.asarray(
+            self._embed_tokens(self.params, jnp.asarray(key, jnp.int32)[None])[0],
+            np.float32,
         )
+        pos = np.zeros((1, sp), np.int32)
+        pos[0, :tp] = np.arange(tp, dtype=np.int32)
+        if self.cfg.mrope_section is not None:
+            # text prefix: all three m-rope components equal
+            pos = np.broadcast_to(pos[..., None], (1, sp, 3))
+        t0 = time.monotonic()
+        k, v = self._prefix_prefill(
+            self.params,
+            jnp.asarray(emb),
+            jnp.asarray(pos),
+            jnp.asarray(tp, jnp.int32),
+        )
+        k, v = k[:, :tp], v[:, :tp]
+        jax.block_until_ready(v)
+        with self._stats_lock:
+            self._prefill_time += time.monotonic() - t0
+            self._prefill_tokens += tp
+        bs = self.block_size
+        nb = -(-tp // bs)
+        with self._lock:
+            with self._prefix_lock:
+                raced = self._prefix_cache.get(key)
+                if raced is not None:  # a concurrent build won: adopt it
+                    self._prefix_cache.move_to_end(key)
+                    with self._stats_lock:
+                        # the outcome is a HIT (the winner's build is
+                        # served); reclassify the miss counted up front so
+                        # hit-rate stats stay exact under concurrency
+                        self._prefix_misses -= 1
+                        self._prefix_hits += 1
+                    return raced, True
+                if not self._allocator.can_alloc(nb):
+                    self._evict_prefixes_for(nb)
+                if not self._allocator.can_alloc(nb):
+                    logger.warning(
+                        "prefix cache: pool exhausted; serving %d-token "
+                        "prefix uncached", tp,
+                    )
+                    return None, False
+                ids = self._allocator.alloc(nb)
+                self._pool_k, self._pool_v = self._write_prefix_blocks(
+                    self._pool_k,
+                    self._pool_v,
+                    k,
+                    v,
+                    jnp.asarray(ids, jnp.int32),
+                )
+                entry = _PrefixEntry(
+                    blocks=ids,
+                    n_full=tp // bs,
+                    tail_block=ids[-1] if tp % bs else None,
+                    length=tp,
+                )
+                self._prefix_cache[key] = entry
+                while len(self._prefix_cache) > self.prefix_cache_size:
+                    _k2, evicted = self._prefix_cache.popitem(last=False)
+                    # referenced blocks defer their free to the last slot
+                    self._allocator.decref(evicted.blocks)
+                    with self._stats_lock:
+                        self._prefix_evictions += 1
+                return entry, False
+
+    def _evict_prefixes_for(self, n_blocks: int, exclude: tuple | None = None) -> None:
+        """Evict idle LRU prefixes until ``n_blocks`` are allocatable (or
+        the cache is empty — referenced blocks free only when their last
+        slot releases). ``exclude`` protects the entry a claim in progress
+        is about to reference. Engine + prefix locks held by caller."""
+        for key in list(self._prefix_cache):
+            if self._allocator.can_alloc(n_blocks):
+                return
+            if key == exclude:
+                continue
+            evicted = self._prefix_cache.pop(key)
+            self._allocator.decref(evicted.blocks)
+            with self._stats_lock:
+                self._prefix_evictions += 1
+
+    def _claim_kv(
+        self, lane: _Lane, slot_idx: int, prep: _Prepared, req: CaptionRequest
+    ) -> _BlockClaim:
+        """Reserve a request's KV blocks and build its block-table row.
+
+        Shared-prefix full blocks are REFERENCED (incref — zero device
+        copies, the successor of the deleted insert_prefix path); a
+        partially-filled shared tail block is copy-on-write duplicated into
+        the request's first private block; the rest of
+        ``ceil(need / block_size)`` blocks are fresh private allocations.
+        Raises PoolExhausted when the pool cannot supply the private blocks
+        (admission backpressure, not an error). Engine lock held by
+        caller."""
+        bs = self.block_size
+        need = min(prep.total + req.sampling.max_new_tokens + 1, lane.length)
+        view_blocks = -(-need // bs)
+        shared: list[int] = []
+        cow_src: int | None = None
+        if prep.base:
+            with self._prefix_lock:
+                entry = self._prefix_cache.get(prep.prefix_key)
+            if entry is None:
+                # _admit ensured the entry earlier THIS iteration and holds
+                # the engine lock inserts/evictions need — it cannot vanish
+                raise RuntimeError(f"prefix entry vanished for {req.request_id}")
+            shared = list(entry.blocks[: entry.n_full])
+            cow_src = entry.tail_block
+        private_needed = view_blocks - len(shared)
+        if not self._allocator.can_alloc(private_needed):
+            if not any(l.claims for l in self.lanes):
+                # nothing in flight will ever free blocks — the pool is
+                # held by idle prefix entries. Evict them (sparing the one
+                # this claim references) instead of deadlocking admission.
+                with self._prefix_lock:
+                    self._evict_prefixes_for(
+                        private_needed,
+                        exclude=prep.prefix_key if prep.base else None,
+                    )
+            if not self._allocator.can_alloc(private_needed):
+                raise PoolExhausted(
+                    f"{private_needed} KV blocks needed, "
+                    f"{self._allocator.free_blocks} free of {self._allocator.capacity}"
+                )
+        self._allocator.incref(shared)
+        private = self._allocator.alloc(private_needed)
+        try:
+            if cow_src is not None:
+                # the suffix extends INTO the partially-filled shared tail
+                # block: copy-on-write one block — the only device copy on
+                # the whole admission path
+                self._pool_k, self._pool_v = self._copy_blocks(
+                    self._pool_k,
+                    self._pool_v,
+                    jnp.asarray([cow_src], jnp.int32),
+                    jnp.asarray([private[0]], jnp.int32),
+                )
+        except BaseException:
+            # a failed CoW dispatch must hand the references back, or the
+            # shared pool shrinks permanently on every transient error
+            self._allocator.decref(shared + private)
+            raise
+        row = lane.table[slot_idx]
+        row[:] = 0
+        row[: len(shared)] = shared
+        row[len(shared) : view_blocks] = private
+        claim = _BlockClaim(shared=shared, private=private)
+        lane.claims[slot_idx] = claim
+        with self._stats_lock:
+            self._requests_admitted += 1
+            self._kv_blocks_reserved += view_blocks
+            self._kv_private_blocks += len(private)
+            self._kv_worstcase_tokens += lane.length
+            self._prefix_block_refs += len(shared)
+            if cow_src is not None:
+                self._kv_cow_copies += 1
+            self._kv_blocks_used_peak = max(
+                self._kv_blocks_used_peak, self._allocator.used_blocks
+            )
+            self._owner_requests[req.owner] = (
+                self._owner_requests.get(req.owner, 0) + 1
+            )
+        return claim
+
+    def _release_claim(self, lane: _Lane, slot_idx: int) -> None:
+        """Return a slot's block references to the pool. Private blocks
+        free immediately; shared prefix blocks free only when the LAST
+        reference (including the LRU's own) drops — an evicted-but-still-
+        referenced prefix frees here, deferred. Engine lock held by
+        caller."""
+        claim = lane.claims.pop(slot_idx, None)
+        if claim is None:
+            return
+        self._allocator.decref(claim.all_blocks)
+        lane.table[slot_idx, :] = 0
+        self._work_cv.notify_all()  # pool-blocked admissions may now fit
 
     def fit_max_new_tokens(
         self,
@@ -1255,9 +1804,12 @@ class CaptionEngine:
         """One batched prefill for all requests sharing a length bucket.
 
         The row count is padded to a power of two by duplicating row 0
-        (same slot + same content → the duplicate scatter writes identical
+        (same table + same content → the duplicate scatter writes identical
         values), so compiled program count stays O(log max_batch x
-        log max_seq)."""
+        log max_seq). Bucket padding may write past a row's reserved
+        blocks ([base, base + bucket) can overshoot need): those positions
+        map to garbage-block table entries, whose contents are never read
+        unmasked."""
         n = len(items)
         n_pad = next_pow2(n)  # bounded by next_pow2(lane.n_slots)
         dim = items[0][2].shape[-1]
@@ -1292,12 +1844,13 @@ class CaptionEngine:
             if ds_buf is not None:
                 ds_buf[:, j] = ds_buf[:, 0]
         t0 = time.monotonic()
-        logits, lane.cache_k, lane.cache_v = self._prefill_batch(
+        tables = lane.table[slots_arr]  # [n_pad, nbl]; padding rows = row 0
+        logits, self._pool_k, self._pool_v = self._prefill_batch(
             self.params,
-            lane.cache_k,
-            lane.cache_v,
+            self._pool_k,
+            self._pool_v,
+            jnp.asarray(tables),
             jnp.asarray(embeds),
-            jnp.asarray(slots_arr),
             jnp.asarray(bases),
             jnp.asarray(t_valids),
             jnp.asarray(rope_buf),
@@ -1414,12 +1967,13 @@ class CaptionEngine:
             if ds_buf is not None:
                 ds_buf[:, j] = ds_buf[:, 0]
         t0 = time.monotonic()
-        logits, lane.cache_k, lane.cache_v = self._prefill_batch(
+        tables = lane.table[slots_arr]  # [n_pad, nbl]; padding rows = row 0
+        logits, self._pool_k, self._pool_v = self._prefill_batch(
             self.params,
-            lane.cache_k,
-            lane.cache_v,
+            self._pool_k,
+            self._pool_v,
+            jnp.asarray(tables),
             jnp.asarray(embeds),
-            jnp.asarray(slots_arr),
             jnp.asarray(write_idx),
             jnp.asarray(chunk_valid),
             jnp.asarray(rope_buf),
@@ -1448,12 +2002,12 @@ class CaptionEngine:
         rope_positions = np.zeros(lane.n_slots, np.int32)
         # The decode program scatters K/V for EVERY row (static shapes, no
         # write mask), so idle rows' write positions must be harmless.
-        # Fully-free rows hold no valid data — position 0 is fine — but a
-        # row mid-chunked-prefill holds real prompt K/V: point its write at
-        # base + progress, a cell the NEXT chunk overwrites anyway (the
-        # shifted final chunk covers [t_valid - C, t_valid), which contains
-        # it), so the pad-token garbage can never survive into attention
-        # reads.
+        # Fully-free rows carry an all-garbage block table — their write
+        # lands in the reserved garbage block — but a row mid-chunked-
+        # prefill holds real prompt K/V: point its write at base +
+        # progress, a cell the NEXT chunk overwrites anyway (the shifted
+        # final chunk covers [t_valid - C, t_valid), which contains it), so
+        # the pad-token garbage can never survive into attention reads.
         for i, p in lane.pending.items():
             positions[i] = p.base + p.progress
         for i, slot in lane.slots.items():
@@ -1461,10 +2015,11 @@ class CaptionEngine:
             positions[i] = slot.position
             rope_positions[i] = slot.rope_position
         t0 = time.monotonic()
-        greedy, logits, lane.cache_k, lane.cache_v = self._decode(
+        greedy, logits, self._pool_k, self._pool_v = self._decode(
             self.params,
-            lane.cache_k,
-            lane.cache_v,
+            self._pool_k,
+            self._pool_v,
+            jnp.asarray(lane.table),
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(rope_positions),
@@ -1474,6 +2029,11 @@ class CaptionEngine:
             self._decode_time += time.monotonic() - t0
             self._decode_tokens += len(lane.slots)
             self._decode_rows += lane.n_slots
+            for slot in lane.slots.values():
+                owner = slot.request.owner
+                self._owner_decode_tokens[owner] = (
+                    self._owner_decode_tokens.get(owner, 0) + 1
+                )
         # the device argmax suffices only for pure-greedy rows with no
         # penalties and min_tokens already satisfied
         needs_logits = any(
@@ -1529,6 +2089,7 @@ class CaptionEngine:
         if not done:
             return
         del lane.slots[slot_idx]
+        self._release_claim(lane, slot_idx)
         out_ids = [t for t in slot.generated if t != self.tokenizer.eos_id]
         text = stop_text if stop_text is not None else self.tokenizer.decode(out_ids)
         if stop_text is None and req.sampling.stop:

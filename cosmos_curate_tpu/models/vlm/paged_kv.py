@@ -1,0 +1,170 @@
+"""Paged KV-cache primitives for the caption engine.
+
+vLLM's PagedAttention block-table design (Kwon et al. 2023 — PAPERS.md)
+re-shaped for XLA's static-shape compilation: KV memory is ONE block pool
+``[L, n_blocks, block_size, Hkv, Dh]`` and every slot owns a block *table*
+instead of a worst-case-length cache row, so a request's KV footprint is
+``ceil(len / block_size)`` blocks. Rather than a dynamic per-read gather
+inside the attention kernel (hostile to XLA), the engine's prefill/decode
+programs gather each slot's blocks into a contiguous ``[lane_length]`` view
+— the exact shapes the slot-row engine compiled, so greedy outputs stay
+byte-identical — run the unchanged model, and scatter the written blocks
+back.
+
+Why duplicate scatter indices are safe: shared-prefix blocks appear in MANY
+slots' tables at once (that is the point — zero device copies at
+admission). The scatter that writes views back therefore writes the same
+block several times, and XLA leaves the winning order undefined. The
+engine's invariant makes every such write identical: a slot's own K/V
+writes always start at the prefix boundary (copy-on-write gives it a
+private copy of any partially-filled shared tail block first), so shared
+blocks are only ever written back with their unchanged gathered contents.
+Block 0 is a reserved garbage block: free table entries point at it and
+the decode program's unconditional writes for idle rows land there — its
+contents are never read unmasked.
+
+The allocator is host-side and refcounted: the shared-prefix LRU holds one
+reference per block it caches, every admitted slot holds one per shared
+block it maps, and a block returns to the free list only when the last
+reference drops — evicting a prefix whose blocks are still mapped by
+in-flight slots defers the free instead of corrupting them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class PoolExhausted(RuntimeError):
+    """The block pool cannot supply the requested allocation right now.
+
+    Admission treats this as backpressure (the request waits for in-flight
+    slots to free their blocks), not as an error."""
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over pool block ids.
+
+    Block 0 is the reserved garbage block (never handed out): free table
+    entries point at it so the static-shape decode program has a harmless
+    write target for idle rows. All mutation runs under the engine lock —
+    the allocator itself is deliberately lock-free.
+    """
+
+    def __init__(self, n_blocks: int) -> None:
+        if n_blocks < 2:
+            raise ValueError(f"block pool needs >= 2 blocks, got {n_blocks}")
+        self.n_blocks = n_blocks
+        self._refs = [0] * n_blocks
+        # LIFO free list: recently freed blocks are re-used first (their
+        # pool pages are the warmest)
+        self._free = list(range(n_blocks - 1, 0, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the garbage block is not)."""
+        return self.n_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """n fresh blocks with refcount 1; raises PoolExhausted when the
+        free list cannot supply them (callers requeue and wait)."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} KV blocks, {len(self._free)} free of {self.capacity}"
+            )
+        ids = [self._free.pop() for _ in range(n)]
+        for b in ids:
+            self._refs[b] = 1
+        return ids
+
+    def incref(self, ids) -> None:
+        for b in ids:
+            if self._refs[b] <= 0:
+                raise ValueError(f"incref on free block {b}")
+            self._refs[b] += 1
+
+    def decref(self, ids) -> list[int]:
+        """Drop one reference per id; blocks reaching zero return to the
+        free list. Returns the freed ids."""
+        freed: list[int] = []
+        for b in ids:
+            r = self._refs[b]
+            if r <= 0:
+                raise ValueError(f"decref on free block {b}")
+            self._refs[b] = r - 1
+            if r == 1:
+                self._free.append(b)
+                freed.append(b)
+        return freed
+
+    def ref(self, block_id: int) -> int:
+        return self._refs[block_id]
+
+
+def init_block_pool(cfg, n_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    """The K and V block pools: ``[L, n_blocks, block_size, Hkv, Dh]``."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def gather_block_views(pool_k, pool_v, tables):
+    """Per-slot contiguous KV views through the block tables.
+
+    pool_k/v: ``[L, NB, bs, Hkv, Dh]``; tables: ``[N, nbl]`` int32 block
+    ids. Returns ``[L, N, nbl * bs, Hkv, Dh]`` views — the same shape the
+    slot-row engine's cache rows had, so the model and its compiled
+    programs are unchanged."""
+    l = pool_k.shape[0]
+    bs = pool_k.shape[2]
+    n, nbl = tables.shape
+    vk = pool_k[:, tables].reshape(l, n, nbl * bs, *pool_k.shape[3:])
+    vv = pool_v[:, tables].reshape(l, n, nbl * bs, *pool_v.shape[3:])
+    return vk, vv
+
+
+def scatter_block_views(pool_k, pool_v, tables, view_k, view_v):
+    """Write updated per-slot views back into the pool blocks.
+
+    Duplicate table entries (shared prefix blocks, garbage padding) write
+    identical values by the engine's copy-on-write invariant — see the
+    module docstring — so the scatter's undefined duplicate-write order
+    cannot change pool contents."""
+    l = pool_k.shape[0]
+    bs = pool_k.shape[2]
+    n, nbl = tables.shape
+    bk = view_k.reshape(l, n, nbl, bs, *view_k.shape[3:])
+    bv = view_v.reshape(l, n, nbl, bs, *view_v.shape[3:])
+    return pool_k.at[:, tables].set(bk), pool_v.at[:, tables].set(bv)
+
+
+def paged_gather(mesh, pool_k, pool_v, tables):
+    """Data-parallel block-table gather: slot rows (tables) shard over the
+    mesh's batch axes while the pool is replicated — the fan-out shape for
+    data-parallel engine replicas served from one pool snapshot. Accepts an
+    ``AbstractMesh`` too, so shardcheck's ``vlm-paged-gather`` contract
+    traces this exact call site device-free (analysis/shard_check.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from cosmos_curate_tpu.parallel.axes import BATCH_AXES
+    from cosmos_curate_tpu.parallel.sharding import shard_map
+
+    axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    tspec = P(axes) if axes else P(None)
+    vspec = P(None, axes) if axes else P(None, None)
+    return shard_map(
+        gather_block_views,
+        mesh=mesh,
+        in_specs=(P(), P(), tspec),
+        out_specs=(vspec, vspec),
+    )(pool_k, pool_v, tables)

@@ -568,6 +568,22 @@ def render_report(report: dict) -> str:
                 f"idle_frac {agg.get('idle_frac', 0.0):.3f}  "
                 f"prefix_hits {agg.get('prefix_cache_hits', 0)}"
             )
+            if agg.get("kv_blocks_total"):
+                lines.append(
+                    f"  {'':<40} kv_blocks {agg.get('kv_blocks_peak', 0)}/"
+                    f"{agg.get('kv_blocks_total', 0)} peak  "
+                    f"prefix_block_refs {agg.get('prefix_block_refs', 0)}  "
+                    f"cow {agg.get('kv_cow_copies', 0)}  "
+                    f"interleaved_steps {agg.get('interleaved_steps', 0)}"
+                )
+            # per-owner accounting: which job/stage consumed the shared
+            # engine (cross-job continuous batching receipt)
+            for owner, sub in sorted((agg.get("owners") or {}).items()):
+                lines.append(
+                    f"    owner {owner:<36} requests {sub.get('requests', 0):6d}  "
+                    f"decode_tokens {sub.get('decode_tokens', 0):8d}  "
+                    f"drives {sub.get('drives', 0)}"
+                )
     dead = report.get("dead_lettered", 0)
     if dead:
         lines.append(

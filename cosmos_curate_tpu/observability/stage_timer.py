@@ -367,13 +367,24 @@ _CAPTION_PHASE_KEYS = (
 _CAPTION_COUNT_KEYS = (
     "requests", "prefill_tokens", "prefix_cache_hits", "prefix_cache_misses",
     "prefix_tokens_saved", "vision_encodes", "vision_reuses",
+    # paged-KV + cross-job deltas (models/vlm/engine.py): shared prefix
+    # BLOCK references served copy-free, copy-on-write tail duplications,
+    # and decode steps whose active slots spanned 2+ owners
+    "prefix_block_refs", "kv_cow_copies", "interleaved_steps",
+    "decode_tokens",
 )
+# absolute occupancy gauges riding each drive record: totals overwrite,
+# peaks take the max across drives
+_CAPTION_GAUGE_KEYS = ("kv_blocks_total", "kv_blocks_used")
+_CAPTION_PEAK_KEYS = ("kv_blocks_peak",)
 
 
 def _new_caption() -> dict:
     agg = {k: 0.0 for k in _CAPTION_PHASE_KEYS}
     agg.update({k: 0 for k in _CAPTION_COUNT_KEYS})
+    agg.update({k: 0 for k in _CAPTION_GAUGE_KEYS + _CAPTION_PEAK_KEYS})
     agg["drives"] = 0
+    agg["owners"] = {}
     return agg
 
 
@@ -381,7 +392,9 @@ def record_caption_phases(name: str, phases: dict) -> None:
     """Fold one engine drive's phase/cache deltas into the stage's
     aggregate and forward them to the engine's metrics exporter (no-op when
     absent). ``idle_s`` is wall minus device phases (prefill + decode):
-    the engine-stall signal the prep/decode overlap exists to shrink."""
+    the engine-stall signal the prep/decode overlap exists to shrink. A
+    drive carrying an ``owner`` tag also folds into the per-owner
+    sub-aggregate — the run report's cross-job accounting."""
     with _CAPTION_LOCK:
         agg = _CAPTION.setdefault(name, _new_caption())
         agg["drives"] += 1
@@ -389,6 +402,20 @@ def record_caption_phases(name: str, phases: dict) -> None:
             agg[k] += float(phases.get(k, 0.0))
         for k in _CAPTION_COUNT_KEYS:
             agg[k] += int(phases.get(k, 0))
+        for k in _CAPTION_GAUGE_KEYS:
+            if k in phases:
+                agg[k] = int(phases[k])
+        for k in _CAPTION_PEAK_KEYS:
+            if k in phases:
+                agg[k] = max(agg[k], int(phases[k]))
+        owner = phases.get("owner")
+        if owner:
+            sub = agg["owners"].setdefault(
+                str(owner), {"drives": 0, "requests": 0, "decode_tokens": 0}
+            )
+            sub["drives"] += 1
+            sub["requests"] += int(phases.get("requests", 0))
+            sub["decode_tokens"] += int(phases.get("decode_tokens", 0))
     try:
         from cosmos_curate_tpu.engine.metrics import get_metrics
 
@@ -401,16 +428,22 @@ def caption_phase_summaries() -> dict[str, dict]:
     """name -> caption phase aggregate. ``idle_frac`` is engine idle over
     wall for the stage's drives: ≈0 means the engine was prefilling or
     decoding for the whole window (prep fully hidden); large values mean
-    the stage starved the engine between batches."""
+    the stage starved the engine between batches. ``owners`` carries the
+    per-owner sub-aggregates (cross-job accounting)."""
     out: dict[str, dict] = {}
     with _CAPTION_LOCK:
-        items = {k: dict(v) for k, v in _CAPTION.items()}
+        items = {
+            k: {**v, "owners": {o: dict(s) for o, s in v["owners"].items()}}
+            for k, v in _CAPTION.items()
+        }
     for name, agg in items.items():
         wall = agg["wall_s"]
         out[name] = {
             **{k: round(agg[k], 4) for k in _CAPTION_PHASE_KEYS},
             **{k: agg[k] for k in _CAPTION_COUNT_KEYS},
+            **{k: agg[k] for k in _CAPTION_GAUGE_KEYS + _CAPTION_PEAK_KEYS},
             "drives": agg["drives"],
+            "owners": agg["owners"],
             "idle_frac": round(agg["idle_s"] / wall, 4) if wall > 0 else 0.0,
         }
     return out

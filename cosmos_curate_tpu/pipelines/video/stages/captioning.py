@@ -12,6 +12,7 @@ pool) and streams every window of every clip through continuous batching.
 
 from __future__ import annotations
 
+import itertools
 import time
 
 import numpy as np
@@ -88,11 +89,20 @@ class CaptionPrepStage(Stage[SplitPipeTask, SplitPipeTask]):
         return tasks
 
 
-# One engine per (config, batch, lanes) per process: several caption-family
-# stages (captioning, enhancement, semantic filter, per-event) in one
-# pipeline must share weights + KV cache instead of loading the VLM
-# repeatedly.
-_ENGINES: dict[tuple, CaptionEngine] = {}
+# Engines are process-level and keyed by (model, dtype, mesh) — see
+# models/vlm/shared_engine.py: every caption-family stage (captioning,
+# enhancement, semantic filter, per-event) AND every concurrent pipeline in
+# the process submits into ONE engine per served model, whose admission
+# interleaves their requests (cross-job continuous batching). Each stage
+# instance is one engine OWNER: requests carry the stage's unique owner
+# tag, so completions route back to the right drive and per-owner fairness
+# + accounting have a stable identity.
+_OWNER_SEQ = itertools.count()
+
+
+def _owner_tag(name: str) -> str:
+    """A unique, human-readable engine-owner tag for one stage instance."""
+    return f"{name}#{next(_OWNER_SEQ)}"
 
 
 class _CaptionVLM(ModelInterface):
@@ -210,34 +220,28 @@ class _CaptionVLM(ModelInterface):
         return list(hit[0]), list(hit[1])
 
     def setup(self) -> None:
-        # model_id is part of the key: the same architecture under two
-        # weight ids must NOT share one engine (the second would silently
-        # caption with the first checkpoint's weights)
-        key = (self.cfg, self.max_batch, self.model_id, self.kv_lanes)
-        engine = _ENGINES.get(key)
-        if engine is None:
-            # build the tokenizer BEFORE the engine: a missing staged
-            # tokenizer must fail setup, not first inference
-            tokenizer = self.tokenizer
-            engine = CaptionEngine(
-                self.cfg,
-                max_batch=self.max_batch,
-                tokenizer=tokenizer,
-                kv_lanes=self.kv_lanes,
-                # production engines prep in the background so vision
-                # encoding of window N+1 overlaps decode of window N
-                async_prep=True,
-            )
-            engine.setup()
+        from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
 
+        # build the tokenizer BEFORE the engine: a missing staged
+        # tokenizer must fail setup, not first inference
+        tokenizer = self.tokenizer
+
+        def loader(engine: CaptionEngine):
             def init(seed: int):
                 return engine.params
 
-            engine.params = registry.load_params(
+            return registry.load_params(
                 self.model_id, init, require=self.require_weights
             )
-            _ENGINES[key] = engine
-        self.engine = engine
+
+        self.engine = SharedCaptionEngine.get(
+            self.cfg,
+            model_id=self.model_id,
+            max_batch=self.max_batch,
+            kv_lanes=self.kv_lanes,
+            tokenizer=tokenizer,
+            loader=loader,
+        )
 
 
 def resolve_caption_model(
@@ -286,6 +290,10 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.prompt_text = get_caption_prompt(prompt_variant)
         self.max_new_tokens = max_new_tokens
         self.refine = refine
+        # this stage's engine-owner identity: requests are tagged with it,
+        # completions route back by it, and the shared engine's cross-job
+        # fairness + per-owner accounting key on it
+        self.owner = _owner_tag(f"caption-{prompt_variant}")
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         # a small-context flavor must clamp generation, not refuse requests
         # (half the context stays available for vision + prompt)
@@ -342,13 +350,19 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         if not windows:
             return tasks
         with traced_span("caption.engine", stage=self.name) as span:
-            results = engine.run_until_complete()
+            results = engine.run_until_complete(owner=self.owner)
             wall = time.monotonic() - t_start
             phases = self._phase_delta(engine, phases0, stats0, wall)
             phases["requests"] = len(results)
             for k, v in phases.items():
                 span.set_attribute(f"caption.{k}", round(v, 4) if isinstance(v, float) else v)
         stage_timer.record_caption_phases(self.name, phases)
+        try:
+            from cosmos_curate_tpu.engine.metrics import get_metrics
+
+            get_metrics().observe_caption_owners(engine.owner_stats())
+        except Exception:  # metrics must never take down the caption path
+            pass
         for res in results:
             win = windows.get(res.request_id)
             if win is None:
@@ -370,10 +384,11 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
             task.stage_perf["caption_tokens_per_s"] = engine.tokens_per_second
             task.stage_perf["caption_prefix_cache_hits"] = phases["prefix_cache_hits"]
             task.stage_perf["caption_engine_idle_s"] = round(phases["idle_s"], 4)
+            task.stage_perf["caption_kv_blocks_used"] = engine.kv_blocks_used
+            task.stage_perf["caption_prefix_block_refs"] = phases["prefix_block_refs"]
         return tasks
 
-    @staticmethod
-    def _engine_counts(engine: CaptionEngine) -> dict:
+    def _engine_counts(self, engine: CaptionEngine) -> dict:
         return {
             "requests": 0,
             "prefill_tokens": engine.prefill_tokens,
@@ -382,6 +397,15 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
             "prefix_tokens_saved": engine.prefix_tokens_saved,
             "vision_encodes": engine.vision_encodes,
             "vision_reuses": engine.vision_reuses,
+            # paged-KV + cross-job signals (engine-wide counters; per-drive
+            # deltas like the rest)
+            "prefix_block_refs": engine.prefix_block_refs,
+            "kv_cow_copies": engine.kv_cow_copies,
+            "interleaved_steps": engine.interleaved_decode_steps,
+            # per-OWNER, not engine-wide: under a shared engine another
+            # job's tokens decode inside this drive's window, and the run
+            # report's owner table must not claim them for this stage
+            "decode_tokens": engine.owner_decode_tokens.get(self.owner, 0),
         }
 
     def _phase_delta(
@@ -403,6 +427,12 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
             **counts,
             "wall_s": wall,
             "idle_s": max(0.0, wall - busy),
+            # occupancy gauges (absolute, not deltas) + the owner identity
+            # for per-owner accounting in the run report
+            "owner": self.owner,
+            "kv_blocks_total": engine.kv_blocks_total,
+            "kv_blocks_peak": engine.kv_blocks_used_peak,
+            "kv_blocks_used": engine.kv_blocks_used,
         }
 
     def _make_request(self, rid: str, win: Window) -> CaptionRequest:
@@ -442,4 +472,5 @@ class CaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
             frame_fps=win.frame_fps,
             sampling=sampling,
             on_complete=on_complete,
+            owner=self.owner,
         )

@@ -27,11 +27,13 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         model_flavor: str | None = None,
     ) -> None:
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            _owner_tag,
             resolve_caption_model,
         )
 
         self.prompt_variant = prompt_variant
         self.max_new_tokens = max_new_tokens
+        self.owner = _owner_tag("enhance-caption")
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         if self.max_new_tokens >= self._model.cfg.max_seq // 2:
             self.max_new_tokens = self._model.cfg.max_seq // 2
@@ -71,10 +73,11 @@ class EnhanceCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                             prefix_ids=pre,
                             prompt_ids=ids,
                             sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
+                            owner=self.owner,
                         )
                     )
         if windows:
-            for res in engine.run_until_complete():
+            for res in engine.run_until_complete(owner=self.owner):
                 win = windows.get(res.request_id)
                 if win is not None:
                     win.enhanced_caption[self.prompt_variant] = res.text

@@ -67,9 +67,11 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
         model_flavor: str | None = None,
     ) -> None:
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            _owner_tag,
             resolve_caption_model,
         )
 
+        self.owner = _owner_tag("per-event-caption")
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
         self.max_new_tokens = max_new_tokens
         self.frames_per_event = frames_per_event
@@ -124,11 +126,12 @@ class PerEventCaptionStage(Stage[SplitPipeTask, SplitPipeTask]):
                             prompt_ids=ids,
                             frames=crops,
                             sampling=SamplingConfig(max_new_tokens=self.max_new_tokens),
+                            owner=self.owner,
                         )
                     )
         if not targets:
             return tasks
-        for res in engine.run_until_complete():
+        for res in engine.run_until_complete(owner=self.owner):
             hit = targets.get(res.request_id)
             if hit is None:
                 continue

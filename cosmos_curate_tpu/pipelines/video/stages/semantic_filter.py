@@ -56,9 +56,11 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
         self.num_frames = num_frames
         self.extraction = extraction
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (
+            _owner_tag,
             resolve_caption_model,
         )
 
+        self.owner = _owner_tag("semantic-filter")
         self._model = resolve_caption_model(cfg, model_flavor, max_batch)
 
     @property
@@ -97,11 +99,15 @@ class SemanticFilterStage(Stage[SplitPipeTask, SplitPipeTask]):
                         frames=frames[idx],
                         frame_fps=self.num_frames / max(clip.duration_s, 1e-6),
                         sampling=SamplingConfig(max_new_tokens=8),
+                        owner=self.owner,
                     )
                 )
         if not targets:
             return tasks
-        verdicts = {r.request_id: parse_yes_no(r.text) for r in engine.run_until_complete()}
+        verdicts = {
+            r.request_id: parse_yes_no(r.text)
+            for r in engine.run_until_complete(owner=self.owner)
+        }
         for task in tasks:
             kept = []
             for clip in task.video.clips:

@@ -75,10 +75,14 @@ render_report(data)  # must not raise
 print(f"tracing smoke ok: {data['span_count']} spans, one connected trace")
 PY
 
-echo "== caption-bench smoke: tiny engine, 2 requests -> efficiency + prefix-cache hits =="
+echo "== caption-bench smoke: tiny engine, 2 requests -> efficiency + paged prefix sharing =="
 # Tiny end-to-end caption serving check: the benchmark must compute
-# pipeline efficiency AND the shared-prefix KV cache must actually fire
-# (every request after the warmup's first shares the instruction prefix).
+# pipeline efficiency, the shared-prefix cache must actually fire (every
+# request after the warmup's first shares the instruction prefix), and the
+# paged KV pool must serve those prefixes COPY-FREE: block references > 0,
+# ZERO whole-prefix device-copy dispatches (the deleted insert_prefix
+# path), per-request KV reservation strictly below the slot-row worst
+# case, and two concurrent owners interleaving decode steps.
 JAX_PLATFORMS=cpu python - <<'PY'
 import json, subprocess, sys
 
@@ -95,10 +99,18 @@ assert rec["caption_pipeline_efficiency"] > 0, rec
 assert rec["prefix_cache_hits"] > 0, rec
 assert rec["prefill_tokens"] > 0 and rec["prefix_tokens_saved"] > 0, rec
 assert "caption_phases" in rec and rec["caption_phases"]["decode_s"] > 0, rec
+assert rec["prefix_block_refs"] > 0, rec
+assert rec["prefix_copy_dispatches"] == 0, rec
+assert rec["kv_bytes_per_request"] < rec["kv_bytes_per_request_worst_case"], rec
+cj = rec["cross_job"]
+assert cj["interleaved_steps"] > 0, cj
+assert all(v > 0 for v in cj["owner_decode_tokens"].values()), cj
 print(
     f"caption smoke ok: efficiency {rec['caption_pipeline_efficiency']}, "
-    f"{rec['prefix_cache_hits']} prefix hits, "
-    f"{rec['prefix_tokens_saved']} prefill tokens saved"
+    f"{rec['prefix_block_refs']} prefix block refs (0 prefix copies), "
+    f"kv {rec['kv_bytes_per_request']:.0f}B/req vs "
+    f"{rec['kv_bytes_per_request_worst_case']:.0f}B worst-case, "
+    f"{cj['interleaved_steps']} interleaved cross-job steps"
 )
 PY
 

@@ -188,7 +188,9 @@ class TestEvictionAndVariants:
         cached.reset_stats()
         size0 = cached.prefix_cache_size
         cached.prefix_cache_size = 1
-        cached._prefix_cache.clear()
+        # the public clear: raw dict.clear() would leak the entries' block
+        # references in the paged pool's allocator
+        cached.clear_prefix_cache()
         try:
             got = {}
             for r in seq():  # serialized so the LRU actually alternates

@@ -426,14 +426,12 @@ class TestHFChatFlavorWiring:
 
     def test_tiny_hf_chat_flavor_captions_end_to_end(self, tmp_path, monkeypatch):
         from cosmos_curate_tpu.models.tokenizer import HFVocabTokenizer
-        from cosmos_curate_tpu.pipelines.video.stages.captioning import (
-            CaptionStage,
-            _ENGINES,
-        )
+        from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
+        from cosmos_curate_tpu.pipelines.video.stages.captioning import CaptionStage
 
         monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(tmp_path))
         _write_gpt2_tokenizer_files(tmp_path / "caption-vlm-tpu")
-        _ENGINES.clear()
+        SharedCaptionEngine.reset()
         stage = CaptionStage(
             model_flavor="qwen-chat-tiny-test", max_batch=2, max_new_tokens=6
         )
@@ -456,10 +454,11 @@ class TestHFChatFlavorWiring:
         assert req.prefix_ids[-1] == 503
         assert req.prompt_ids[0] == 504
         engine.add_request(req)
-        results = engine.run_until_complete()
+        # stage-built requests carry the stage's owner tag: drain as it
+        results = engine.run_until_complete(owner=stage.owner)
         assert len(results) == 1
         assert results[0].request_id == "w0"
-        _ENGINES.clear()
+        SharedCaptionEngine.reset()
 
     def test_text_only_chat_has_no_vision_markers(self, tmp_path, monkeypatch):
         from cosmos_curate_tpu.pipelines.video.stages.captioning import (

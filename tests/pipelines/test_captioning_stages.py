@@ -124,9 +124,9 @@ def test_flavored_stage_runs_laned_with_high_utilization(
     d = tmp_path_factory.mktemp("lane")
     monkeypatch.setenv("CURATE_MODEL_WEIGHTS_DIR", str(d / "w"))
     _write_gpt2_tokenizer_files(d / "w" / "caption-vlm-tpu")
-    from cosmos_curate_tpu.pipelines.video.stages.captioning import _ENGINES
+    from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
 
-    _ENGINES.clear()
+    SharedCaptionEngine.reset()
     vids = d / "in"
     vids.mkdir()
     make_scene_video(vids / "v0.mp4", scene_len_frames=48, num_scenes=1)
@@ -157,4 +157,82 @@ def test_flavored_stage_runs_laned_with_high_utilization(
     # early steps run partially-filled batches — dead rows traded for wall
     # time. Lane-packing itself is asserted by TestUtilizationAwareRouting.
     assert engine.decode_slot_utilization >= 0.15, engine.decode_slot_utilization
-    _ENGINES.clear()
+    SharedCaptionEngine.reset()
+
+
+def test_two_caption_owners_share_engine_and_interleave(tmp_path):
+    """Cross-job continuous batching (acceptance): two concurrent
+    CaptionStage owners share ONE SharedCaptionEngine, their requests
+    interleave in the same decode-step window (both owners hold active
+    slots simultaneously), results route back to the right owner, and the
+    run report carries per-owner accounting."""
+    import threading
+
+    import numpy as np
+
+    from cosmos_curate_tpu.data.model import Clip, SplitPipeTask, Video, VideoMetadata, Window
+    from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
+    from cosmos_curate_tpu.observability import stage_timer
+    from cosmos_curate_tpu.observability.flight_recorder import write_run_report
+
+    SharedCaptionEngine.reset()
+    stage_timer.reset_caption_phases()
+
+    def make_tasks(tag: str, n: int):
+        tasks = []
+        for i in range(n):
+            clip = Clip(span=(0.0, 1.0))
+            win = Window(start_frame=0, end_frame=8)
+            win.frames = np.random.default_rng(i + (1000 if tag == "a" else 2000)).integers(
+                0, 255, (2, 32, 32, 3), np.uint8
+            )
+            clip.windows = [win]
+            video = Video(
+                path=f"{tag}-{i}.mp4",
+                metadata=VideoMetadata(width=32, height=32, fps=8.0, num_frames=8, duration_s=1.0),
+                clips=[clip],
+            )
+            tasks.append(SplitPipeTask(video=video))
+        return tasks
+
+    stage_a = CaptionStage(cfg=VLM_TINY_TEST, max_batch=4, max_new_tokens=8)
+    stage_b = CaptionStage(cfg=VLM_TINY_TEST, max_batch=4, max_new_tokens=8)
+    stage_a.model.setup()
+    stage_b.model.setup()
+    # ONE engine for both stages: the registry keys on (model, dtype, mesh)
+    assert stage_a.model.engine is stage_b.model.engine
+    assert stage_a.owner != stage_b.owner
+    engine = stage_a.model.engine
+    try:
+        done = {}
+
+        def drive(stage, tasks, key):
+            done[key] = stage.process_data(tasks)
+
+        threads = [
+            threading.Thread(target=drive, args=(stage_a, make_tasks("a", 3), "a")),
+            threading.Thread(target=drive, args=(stage_b, make_tasks("b", 3), "b")),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # every window captioned, no cross-owner stealing
+        for key in ("a", "b"):
+            for task in done[key]:
+                for clip in task.video.clips:
+                    assert clip.windows[0].caption.get("default"), (key, task.video.path)
+        # THE interleave assertion: decode steps existed whose active slots
+        # spanned both owners
+        assert engine.interleaved_decode_steps > 0
+        tokens = engine.owner_decode_tokens
+        assert tokens.get(stage_a.owner, 0) > 0 and tokens.get(stage_b.owner, 0) > 0
+        # per-owner accounting reaches run_report.json
+        report = write_run_report(str(tmp_path))
+        owners = report["caption_phases"]["CaptionStage"]["owners"]
+        assert owners[stage_a.owner]["requests"] == 3
+        assert owners[stage_b.owner]["requests"] == 3
+        assert owners[stage_a.owner]["decode_tokens"] > 0
+    finally:
+        SharedCaptionEngine.reset()
+        stage_timer.reset_caption_phases()
