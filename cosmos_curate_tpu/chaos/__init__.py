@@ -23,6 +23,8 @@ from cosmos_curate_tpu.chaos.harness import (
     SITE_OBJECT_CHANNEL_SERVE,
     SITE_REMOTE_PLANE_RECV,
     SITE_REMOTE_PLANE_SEND,
+    SITE_SERVICE_JOB_CRASH,
+    SITE_SERVICE_JOURNAL_WRITE,
     SITE_STORAGE_REQUEST,
     SITE_WORKER_CRASH,
     SITE_WORKER_HANG,
@@ -44,6 +46,8 @@ __all__ = [
     "SITE_OBJECT_CHANNEL_SERVE",
     "SITE_REMOTE_PLANE_RECV",
     "SITE_REMOTE_PLANE_SEND",
+    "SITE_SERVICE_JOB_CRASH",
+    "SITE_SERVICE_JOURNAL_WRITE",
     "SITE_STORAGE_REQUEST",
     "SITE_WORKER_CRASH",
     "SITE_WORKER_HANG",

@@ -46,6 +46,13 @@ SITE_OBJECT_CHANNEL_SERVE = "object_channel.serve"
 SITE_REMOTE_PLANE_SEND = "remote_plane.send"
 SITE_REMOTE_PLANE_RECV = "remote_plane.recv"
 SITE_STORAGE_REQUEST = "storage.request"
+# Service-layer sites (service/app.py + service/job_queue.py): a job
+# subprocess dying at startup (crash kind rides CURATE_CHAOS into the
+# child; pair with FaultRule.worker_re against the stamped
+# CURATE_WORKER_ID=job-<id>-a<attempt> to fault only the first attempt),
+# and the durable journal's append path failing mid-write.
+SITE_SERVICE_JOB_CRASH = "service.job.crash"
+SITE_SERVICE_JOURNAL_WRITE = "service.journal.write"
 
 ALL_SITES = (
     SITE_WORKER_CRASH,
@@ -55,6 +62,8 @@ ALL_SITES = (
     SITE_REMOTE_PLANE_SEND,
     SITE_REMOTE_PLANE_RECV,
     SITE_STORAGE_REQUEST,
+    SITE_SERVICE_JOB_CRASH,
+    SITE_SERVICE_JOURNAL_WRITE,
 )
 
 _KINDS = ("crash", "hang", "error", "delay")

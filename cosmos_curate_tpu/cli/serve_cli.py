@@ -1,4 +1,10 @@
-"""`cosmos-curate-tpu serve` — run the job service."""
+"""`cosmos-curate-tpu serve` — run the durable multi-tenant job service.
+
+See docs/SERVICE.md for the API, tenancy/quota model, journal layout and
+drain semantics. The defaults match :class:`ServiceConfig` /
+:class:`QuotaConfig`; every admission knob is exposed so a deployment can
+size quotas to its box without code changes.
+"""
 
 from __future__ import annotations
 
@@ -9,12 +15,63 @@ def register(sub: argparse._SubParsersAction) -> None:
     serve = sub.add_parser("serve", help="run the HTTP job service")
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=8080)
-    serve.add_argument("--work-root", default="/tmp/curate_service")
+    serve.add_argument(
+        "--work-root", default="/tmp/curate_service",
+        help="job work dirs + the crash-safe journal live here; restart "
+        "against the same root to resume interrupted jobs",
+    )
+    serve.add_argument(
+        "--max-concurrent", type=int, default=2,
+        help="dispatcher cap (additionally clamped by host CPU/memory)",
+    )
+    serve.add_argument("--max-running-per-tenant", type=int, default=2)
+    serve.add_argument("--max-queued-per-tenant", type=int, default=8)
+    serve.add_argument("--max-queued-total", type=int, default=64)
+    serve.add_argument(
+        "--cpus-per-job", type=float, default=1.0,
+        help="host-budget cost estimate per job (0 disables the CPU clamp)",
+    )
+    serve.add_argument(
+        "--memory-gb-per-job", type=float, default=0.0,
+        help="host-budget memory cost per job (0 disables the memory clamp)",
+    )
+    serve.add_argument(
+        "--max-attempts", type=int, default=3,
+        help="per-job retry budget before dead_lettered (request may lower it)",
+    )
+    serve.add_argument(
+        "--drain-s", type=float, default=30.0,
+        help="SIGTERM grace: running jobs get this long to finish before "
+        "being checkpointed as interrupted for the next boot",
+    )
+    serve.add_argument(
+        "--term-grace-s", type=float, default=5.0,
+        help="terminate endpoint: SIGTERM → SIGKILL escalation window",
+    )
+    serve.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose service_*/pipeline_* prometheus metrics on this port",
+    )
     serve.set_defaults(func=_cmd_serve)
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
-    from cosmos_curate_tpu.service.app import serve
+    from cosmos_curate_tpu.service.admission import QuotaConfig
+    from cosmos_curate_tpu.service.app import ServiceConfig, serve
 
-    serve(host=args.host, port=args.port, work_root=args.work_root)
+    config = ServiceConfig(
+        quota=QuotaConfig(
+            max_concurrent_jobs=args.max_concurrent,
+            max_running_per_tenant=args.max_running_per_tenant,
+            max_queued_per_tenant=args.max_queued_per_tenant,
+            max_queued_total=args.max_queued_total,
+            cpus_per_job=args.cpus_per_job,
+            memory_gb_per_job=args.memory_gb_per_job,
+        ),
+        max_attempts=args.max_attempts,
+        drain_s=args.drain_s,
+        term_grace_s=args.term_grace_s,
+        metrics_port=args.metrics_port,
+    )
+    serve(host=args.host, port=args.port, work_root=args.work_root, config=config)
     return 0

@@ -216,6 +216,33 @@ class EngineMetrics:
         self.node_cpus_used = Gauge(
             "pipeline_node_cpus_used", "CPU units in use per node", node_labels
         )
+        # Job-service lifecycle (service/app.py): transitions per tenant,
+        # current per-state counts, queue wait, and sheds. shed_total rising
+        # under `tenant_queue_full` is a noisy tenant hitting its quota
+        # (working as intended); rising under `queue_full` means the whole
+        # service is over capacity — scale out or raise the dispatcher cap.
+        self.service_transitions = Counter(
+            "service_jobs_total", "job state transitions", ["tenant", "state"]
+        )
+        # NB: "service_jobs" itself is taken — prometheus_client registers
+        # a Counter's base name (service_jobs_total → service_jobs)
+        self.service_jobs_state = Gauge(
+            "service_jobs_current", "current jobs per state", ["state"]
+        )
+        self.service_queue_depth = Gauge(
+            "service_queue_depth", "queued jobs per lane", ["lane"]
+        )
+        self.service_queue_wait = Counter(
+            "service_queue_wait_seconds_total",
+            "summed pending->running wait", ["lane"],
+        )
+        self.service_dispatches = Counter(
+            "service_dispatches_total",
+            "jobs dispatched (divide queue_wait by this for mean wait)", ["lane"],
+        )
+        self.service_shed = Counter(
+            "service_shed_total", "admissions shed with 429", ["tenant", "reason"]
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -402,3 +429,29 @@ class EngineMetrics:
     def set_store_bytes(self, used: int) -> None:
         if self.enabled:
             self.store_bytes.set(used)
+
+    def observe_service_transition(self, tenant: str, state: str) -> None:
+        if self.enabled:
+            self.service_transitions.labels(tenant, state).inc()
+
+    def set_service_states(self, counts: dict) -> None:
+        """``counts``: state -> current job count (all known states, so a
+        state that empties out reads 0 instead of its stale last value)."""
+        if not self.enabled:
+            return
+        for state, n in counts.items():
+            self.service_jobs_state.labels(state).set(int(n))
+
+    def set_service_queue_depth(self, lane: str, depth: int) -> None:
+        if self.enabled:
+            self.service_queue_depth.labels(lane).set(int(depth))
+
+    def observe_service_dispatch(self, lane: str, wait_s: float) -> None:
+        if not self.enabled:
+            return
+        self.service_dispatches.labels(lane).inc()
+        self.service_queue_wait.labels(lane).inc(max(0.0, wait_s))
+
+    def observe_service_shed(self, tenant: str, reason: str) -> None:
+        if self.enabled:
+            self.service_shed.labels(tenant, reason).inc()

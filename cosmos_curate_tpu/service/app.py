@@ -1,77 +1,98 @@
-"""Job service: pipelines behind an async HTTP API.
+"""Durable multi-tenant job service: crash-safe queue, admission, resume.
 
-Equivalent capability of the reference's NVCF service wrapper
-(cosmos_curate/core/cf/nvcf_main.py:548-600 — FastAPI app with /health,
-/v1/logs, /v1/progress, invoke/terminate, a one-pipeline-at-a-time lock
-middleware:373, and request/progress/done files:102-223). Built on aiohttp
-(fastapi is not in this image; the HTTP surface is identical):
+The reference gets its service shape from NVCF (cosmos_curate/core/cf/
+nvcf_main.py — FastAPI wrapper, one-pipeline-at-a-time lock, in-memory job
+dict), which forgets every queued and running job on restart. This service
+is built for heavy multi-tenant traffic instead (aiohttp; fastapi is not
+in this image):
 
-  GET  /health                liveness + current job state
-  POST /v1/invoke             {"pipeline": "split"|"dedup"|"shard", "args": {...}}
-  GET  /v1/progress/{job_id}  job state + summary when done
-  GET  /v1/logs/{job_id}      captured job log tail
-  POST /v1/terminate/{job_id} best-effort cancel
+  GET  /health                  liveness + state counts + queue depths
+  GET  /v1/jobs                 list jobs (?tenant=&state= filters)
+  POST /v1/invoke               {"pipeline": ..., "args": {...},
+                                 "tenant": "t", "priority": "interactive"}
+  GET  /v1/progress/{job_id}    state, attempts, summary + run_report link
+  GET  /v1/logs/{job_id}        bounded log tail (seeks, never slurps)
+  POST /v1/terminate/{job_id}   kill the job's whole process group
+  POST /v1/requeue/{job_id}     dead_lettered/failed/terminated → pending
+  GET  /v1/models               staged-weights registry status
 
-One pipeline runs at a time (the lock); jobs execute in a subprocess so a
-crashing pipeline never takes the service down, and termination is a clean
-process kill.
+Durability: every state transition is journaled append-only under
+``work_root`` (service/job_queue.py). A ``kill -9``'d service replays the
+journal on boot, marks running jobs ``interrupted``, and re-enqueues them;
+the re-run reuses the same args/output_path, so input-discovery resume
+records skip already-completed videos. Admission (service/admission.py)
+replaces the single-job lock with interactive/batch priority lanes,
+per-tenant quotas, and load shedding (429 + Retry-After, never an
+unbounded queue); a dispatcher runs up to N concurrent jobs gated by the
+host's NodeBudget. Failures retry with full-jitter backoff up to
+``max_attempts``, then land ``dead_lettered`` (requeueable). SIGTERM
+drains gracefully: stop admitting, let running jobs finish within
+``drain_s``, checkpoint the rest as ``interrupted`` for the next boot.
+
+Jobs execute in their own *session* (``start_new_session=True``) so a
+crashing pipeline never takes the service down and terminate kills the
+entire worker tree, not just the direct child. Chaos sites
+``service.job.crash`` (child start) and ``service.journal.write`` (journal
+append) plug the whole thing into the fault-injection harness.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
+import re
+import signal
 import subprocess
 import sys
 import time
-import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
+from typing import Callable
 
 from aiohttp import web
 
+from cosmos_curate_tpu.service.admission import (
+    AdmissionController,
+    QuotaConfig,
+)
+from cosmos_curate_tpu.service.job_queue import (
+    JOB_STATES,
+    LANES,
+    JobJournal,
+    JobRecord,
+    JournalWriteError,
+    recover_records,
+)
+from cosmos_curate_tpu.storage.retry import backoff_s
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 _PIPELINES = {"split", "dedup", "shard"}
+_LOG_TAIL_MAX_BYTES = 1 << 20  # hard ceiling per /v1/logs read, multi-GB safe
+_TENANT_RE = re.compile(r"[A-Za-z0-9._:-]{1,64}")
 
 
-@dataclass
-class Job:
-    job_id: str
-    pipeline: str
-    args: dict
-    work_dir: Path
-    proc: subprocess.Popen | None = None
-    state: str = "pending"  # pending | running | done | failed | terminated
-    started_s: float = field(default_factory=time.time)
-    finished_s: float | None = None
-
-    @property
-    def log_path(self) -> Path:
-        return self.work_dir / "job.log"
-
-    @property
-    def summary_path(self) -> Path:
-        return self.work_dir / "summary.json"
+@dataclass(frozen=True)
+class ServiceConfig:
+    quota: QuotaConfig = field(default_factory=QuotaConfig)
+    max_attempts: int = 3
+    drain_s: float = 30.0  # SIGTERM: grace for running jobs to finish
+    term_grace_s: float = 5.0  # terminate: SIGTERM → SIGKILL escalation
+    retry_base_s: float = 0.5  # full-jitter backoff between attempts
+    retry_cap_s: float = 30.0
+    metrics_port: int | None = None
+    # terminal-record GC: a long-lived service must not hold every job it
+    # ever ran in memory/journal forever. Records in a terminal state are
+    # evicted (journal tombstone + drop) after retain_terminal_s, and the
+    # newest max_terminal_records are kept regardless of backlog size.
+    retain_terminal_s: float = 86400.0
+    max_terminal_records: int = 5000
 
 
-class ServiceState:
-    def __init__(self, work_root: str) -> None:
-        self.work_root = Path(work_root)
-        self.work_root.mkdir(parents=True, exist_ok=True)
-        self.jobs: dict[str, Job] = {}
-        # Single-event-loop invariant: invoke() has no await between the
-        # active_job() check and job registration, so no lock is needed;
-        # adding an await there requires adding one.
-        self.watchers: set[asyncio.Task] = set()  # strong refs (GC guard)
-
-    def active_job(self) -> Job | None:
-        for job in self.jobs.values():
-            if job.state in ("pending", "running"):
-                return job
-        return None
+# ---------------------------------------------------------------------------
+# job subprocess
 
 
 def _runner_code(
@@ -86,7 +107,11 @@ def _runner_code(
     """Child-process program: optional presigned-zip ingest (reference
     nvcf_main.py handle_presigned_urls — credential-less I/O: inputs arrive
     as a GET-able zip, results leave as a PUT-able zip), run the pipeline,
-    write summary.json, optional zip+upload of the output directory."""
+    write summary.json, optional zip+upload of the output directory.
+
+    The chaos preamble arms ``CURATE_CHAOS`` (handed through job_env) and
+    fires ``service.job.crash`` — a crash-kind rule kills the job child
+    before any work, exercising the retry/dead-letter path end to end."""
     payload = json.dumps(
         {
             "pipeline": pipeline,
@@ -100,6 +125,9 @@ def _runner_code(
     )
     return (
         "import json, sys\n"
+        "from cosmos_curate_tpu import chaos as _chaos\n"
+        "_chaos.install_from_env()\n"
+        "_chaos.fire('service.job.crash')\n"
         f"spec = json.loads({payload!r})\n"
         "args = spec['args']\n"
         "if spec['input_zip_url']:\n"
@@ -128,36 +156,471 @@ def _runner_code(
     )
 
 
-async def _watch_job(state: ServiceState, job: Job) -> None:
-    loop = asyncio.get_running_loop()
-    rc = await loop.run_in_executor(None, job.proc.wait)
-    job.finished_s = time.time()
-    if job.state == "terminated":
+def _default_runner_cmd(record: JobRecord, work_dir: Path) -> list[str]:
+    return [
+        sys.executable,
+        "-c",
+        _runner_code(
+            record.pipeline,
+            record.args,
+            str(work_dir / "summary.json"),
+            work_dir=str(work_dir),
+            input_zip_url=record.input_zip_url,
+            output_zip_url=record.output_zip_url,
+            output_zip_multipart=record.output_zip_multipart,
+        ),
+    ]
+
+
+def job_env(record: JobRecord | None = None) -> dict[str, str]:
+    """The job subprocess environment: a full copy of the ambient env —
+    which by construction carries the cross-process contracts
+    ``CURATE_CHAOS`` (armed fault plans fire inside job children) and
+    ``CURATE_DLQ_DIR`` (the job's engine dead-letters where the operator
+    configured); tests/service pin that guarantee down in the child — plus
+    two additions the ambient env cannot provide:
+
+    - ``CURATE_TRACING`` / ``CURATE_TRACEPARENT``: when the service itself
+      is tracing, its *current span* (not just an inherited env var)
+      becomes the job's process parent, so one trace spans
+      submit → job → pipeline workers
+    - ``CURATE_WORKER_ID=job-<id>-a<attempt>``: chaos rules target a
+      specific attempt (``worker_re="-a1$"`` faults only the first try),
+      and crash recovery uses it to identify orphaned job processes
+    """
+    env = dict(os.environ)
+    from cosmos_curate_tpu.observability.tracing import (
+        TRACEPARENT_ENV,
+        format_traceparent,
+        tracing_enabled,
+    )
+
+    if tracing_enabled() or os.environ.get("CURATE_TRACING") == "1":
+        env["CURATE_TRACING"] = "1"
+        tp = format_traceparent() or os.environ.get(TRACEPARENT_ENV, "")
+        if tp:
+            env[TRACEPARENT_ENV] = tp
+    if record is not None:
+        env["CURATE_WORKER_ID"] = f"job-{record.job_id}-a{record.attempts}"
+    return env
+
+
+def tail_lines(path: Path, n: int, *, max_bytes: int = _LOG_TAIL_MAX_BYTES) -> list[str]:
+    """Last ``n`` lines of ``path`` without reading the whole file: seek to
+    the end and walk backwards in blocks until enough newlines (or the
+    ``max_bytes`` cap) — a multi-GB job log costs one bounded read."""
+    if not path.exists() or n <= 0:
+        return []
+    block = 64 * 1024
+    chunks: list[bytes] = []
+    newlines = 0
+    read = 0
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        pos = f.tell()
+        while pos > 0 and newlines <= n and read < max_bytes:
+            step = min(block, pos, max_bytes - read)
+            pos -= step
+            f.seek(pos)
+            chunk = f.read(step)
+            chunks.append(chunk)
+            newlines += chunk.count(b"\n")
+            read += step
+    text = b"".join(reversed(chunks)).decode("utf-8", errors="replace")
+    return text.splitlines()[-n:]
+
+
+# ---------------------------------------------------------------------------
+# service state
+
+
+class ServiceState:
+    def __init__(
+        self,
+        work_root: str,
+        config: ServiceConfig,
+        *,
+        runner_cmd: Callable[[JobRecord, Path], list[str]] | None = None,
+    ) -> None:
+        self.work_root = Path(work_root)
+        self.work_root.mkdir(parents=True, exist_ok=True)
+        self.config = config
+        self.journal = JobJournal(self.work_root / "journal.ndjson")
+        self.admission = AdmissionController(config.quota)
+        self.runner_cmd = runner_cmd or _default_runner_cmd
+        self.jobs: dict[str, JobRecord] = {}
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.draining = False
+        self.stopping = False  # dispatcher exit flag (cooperative, not cancel)
+        self.watchers: set[asyncio.Task] = set()  # strong refs (GC guard)
+        self.wake: asyncio.Event | None = None  # created on the app's loop
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        self.metrics = get_metrics(config.metrics_port)
+        self._recover()
+
+    # ---- durability ----------------------------------------------------
+
+    def _recover(self) -> None:
+        """Boot-time journal replay: re-enqueue pending/interrupted jobs,
+        compact the journal back to one line per job."""
+        records, requeue_ids = recover_records(self.journal)
+        self.jobs = records
+        now = time.time()
+        for job_id in requeue_ids:
+            rec = self.jobs[job_id]
+            was = rec.state
+            rec.state = "pending"
+            rec.enqueued_s = now
+            self.admission.requeue(rec)
+            self.record_transition(rec, f"recovered-{was}")
+            logger.info("job %s recovered from journal (%s → pending)", job_id, was)
+        self.journal.compact(self.jobs)
+        self._export_states()
+
+    def record_transition(self, rec: JobRecord, event: str, *, required: bool = False) -> None:
+        """Journal + metrics for one transition. ``required=True`` (the
+        submit ack) propagates a journal failure to the caller; otherwise
+        durability degrades to in-memory with a loud log — resume records
+        make the resulting re-run idempotent."""
+        try:
+            self.journal.append(rec, event)
+        except JournalWriteError:
+            if required:
+                raise
+            logger.exception(
+                "journal append failed for job %s (%s); state held in memory only",
+                rec.job_id, event,
+            )
+        self.metrics.observe_service_transition(rec.tenant, rec.state)
+        self._export_states()
+
+    def _export_states(self) -> None:
+        counts = {s: 0 for s in JOB_STATES}
+        for rec in self.jobs.values():
+            counts[rec.state] = counts.get(rec.state, 0) + 1
+        self.metrics.set_service_states(counts)
+        for lane in LANES:
+            self.metrics.set_service_queue_depth(lane, self.admission.lane_depth(lane))
+
+    # ---- paths ---------------------------------------------------------
+
+    def work_dir(self, job_id: str) -> Path:
+        return self.work_root / "jobs" / job_id
+
+    def log_path(self, job_id: str) -> Path:
+        return self.work_dir(job_id) / "job.log"
+
+    def summary_path(self, job_id: str) -> Path:
+        return self.work_dir(job_id) / "summary.json"
+
+    def report_path(self, rec: JobRecord) -> Path:
+        """The job's flight-recorder receipt (observability/flight_recorder.py
+        writes ``<output>/report/run_report.json`` at finalize)."""
+        out = str(rec.args.get("output_path") or self.work_dir(rec.job_id) / "output")
+        return Path(out) / "report" / "run_report.json"
+
+    # ---- queries -------------------------------------------------------
+
+    def running_records(self) -> list[JobRecord]:
+        return [r for r in self.jobs.values() if r.state == "running"]
+
+    def gc_terminal(self) -> None:
+        """Evict old terminal records (dispatcher tick). Each eviction is a
+        journal tombstone, so a restart doesn't resurrect them; a journal
+        outage just defers the eviction to a later tick."""
+        from cosmos_curate_tpu.service.job_queue import TERMINAL_STATES
+
+        now = time.time()
+        terminal = sorted(
+            (
+                r for r in self.jobs.values()
+                if r.state in TERMINAL_STATES and r.finished_s
+            ),
+            key=lambda r: r.finished_s,
+        )
+        expired = [
+            r for r in terminal
+            if now - r.finished_s > self.config.retain_terminal_s
+        ]
+        overflow = len(terminal) - len(expired) - self.config.max_terminal_records
+        if overflow > 0:
+            keep = [r for r in terminal if now - r.finished_s <= self.config.retain_terminal_s]
+            expired.extend(keep[:overflow])  # oldest first
+        for rec in expired:
+            try:
+                self.journal.append(rec, "evicted")
+            except JournalWriteError:
+                continue  # keep the record; retry next tick
+            del self.jobs[rec.job_id]
+        if expired:
+            self._export_states()
+
+    def state_counts(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for rec in self.jobs.values():
+            counts[rec.state] = counts.get(rec.state, 0) + 1
+        return counts
+
+    def kick(self) -> None:
+        if self.wake is not None:
+            self.wake.set()
+
+
+# ---------------------------------------------------------------------------
+# dispatch + supervision
+
+
+def _launch(state: ServiceState, rec: JobRecord) -> None:
+    """Spawn one attempt of ``rec`` in its own session. A spawn failure is
+    terminal ``failed`` (the command never started — retrying a bad spec
+    only burns attempts)."""
+    rec.attempts += 1
+    work_dir = state.work_dir(rec.job_id)
+    work_dir.mkdir(parents=True, exist_ok=True)
+    wait_s = max(0.0, time.time() - rec.enqueued_s)
+    log_f = open(state.log_path(rec.job_id), "ab")
+    try:
+        proc = subprocess.Popen(
+            state.runner_cmd(rec, work_dir),
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            cwd=str(Path(__file__).resolve().parents[2]),
+            env=job_env(rec),
+            start_new_session=True,  # session leader: killpg reaps the tree
+        )
+    except Exception as e:
+        rec.state = "failed"
+        rec.error = f"spawn failed: {e}"
+        rec.finished_s = time.time()
+        state.record_transition(rec, "spawn-failed")
+        logger.exception("job %s spawn failed", rec.job_id)
         return
-    job.state = "done" if rc == 0 and job.summary_path.exists() else "failed"
-    logger.info("job %s finished: %s (rc=%s)", job.job_id, job.state, rc)
+    finally:
+        log_f.close()  # child holds its own fd; parent must not leak one per job
+    rec.state = "running"
+    rec.pid = proc.pid
+    if rec.started_s is None:
+        rec.started_s = time.time()
+    state.procs[rec.job_id] = proc
+    state.record_transition(rec, "running")
+    state.metrics.observe_service_dispatch(rec.priority, wait_s)
+    task = asyncio.create_task(_watch_job(state, rec, proc))
+    state.watchers.add(task)  # event loop holds only weak refs
+    task.add_done_callback(state.watchers.discard)
+    logger.info(
+        "job %s dispatched (tenant=%s lane=%s attempt %d/%d pid=%d, waited %.2fs)",
+        rec.job_id, rec.tenant, rec.priority, rec.attempts, rec.max_attempts,
+        proc.pid, wait_s,
+    )
 
 
-def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
-    state = ServiceState(work_root)
+async def _watch_job(state: ServiceState, rec: JobRecord, proc: subprocess.Popen) -> None:
+    loop = asyncio.get_running_loop()
+    rc = await loop.run_in_executor(None, proc.wait)
+    state.procs.pop(rec.job_id, None)
+    rec.pid = None
+    if rec.state in ("terminated", "interrupted"):
+        # terminate() / drain checkpoint already journaled the state; the
+        # exit just confirms the kill landed
+        rec.finished_s = rec.finished_s or time.time()
+        state.kick()
+        return
+    if rc == 0 and state.summary_path(rec.job_id).exists():
+        rec.state = "done"
+        rec.finished_s = time.time()
+        rec.error = ""
+        state.record_transition(rec, "done")
+        logger.info("job %s done (attempt %d)", rec.job_id, rec.attempts)
+        state.kick()
+        return
+    tail = tail_lines(state.log_path(rec.job_id), 5)
+    rec.error = f"exit code {rc}" + (f": {tail[-1][:500]}" if tail else "")
+    if rec.attempts >= rec.max_attempts:
+        rec.state = "dead_lettered"
+        rec.finished_s = time.time()
+        state.record_transition(rec, "dead-lettered")
+        logger.error(
+            "job %s dead-lettered after %d attempts (%s)",
+            rec.job_id, rec.attempts, rec.error,
+        )
+        state.kick()
+        return
+    # transient failure: full-jitter backoff, then back into the lane. The
+    # record flips to pending BEFORE the sleep — a backing-off job must not
+    # hold a dispatch slot (or its tenant's running cap) while no process
+    # exists, and a crash during the sleep replays it as plain pending.
+    delay = backoff_s(
+        rec.attempts - 1, base=state.config.retry_base_s, cap=state.config.retry_cap_s
+    )
+    logger.warning(
+        "job %s attempt %d/%d failed (%s); retrying in %.2fs",
+        rec.job_id, rec.attempts, rec.max_attempts, rec.error, delay,
+    )
+    rec.state = "pending"
+    state.record_transition(rec, "retry")
+    state.kick()  # freed capacity is usable during the backoff
+    if not state.draining:
+        await asyncio.sleep(delay)
+    if rec.state == "terminated":
+        # the operator terminated the job during the backoff sleep; honor
+        # the kill, don't resurrect
+        rec.finished_s = rec.finished_s or time.time()
+        state.kick()
+        return
+    if state.draining:
+        # journaled pending: the next boot's replay re-enqueues it
+        state.kick()
+        return
+    # enqueued_s stamps AFTER the backoff: queue-wait must measure time
+    # spent waiting for capacity, not the deliberate retry delay
+    rec.enqueued_s = time.time()
+    state.admission.requeue(rec)
+    state.kick()
+
+
+async def _dispatch_loop(app: web.Application) -> None:
+    """The scheduler: drain admission lanes into subprocesses whenever
+    capacity frees up. Woken by submit/finish/retry; 0.5 s tick as a
+    backstop."""
+    state: ServiceState = app["state"]
+    state.wake = asyncio.Event()
+    # exits via state.stopping, NOT task cancellation: py3.10's wait_for can
+    # swallow a CancelledError that races its timeout expiry (bpo-42130),
+    # which left a cancelled dispatcher looping forever and shutdown hung
+    while not state.stopping:
+        state.wake.clear()
+        if not state.draining:
+            while True:
+                rec = state.admission.pop_next(state.running_records())
+                if rec is None:
+                    break
+                _launch(state, rec)
+            state.gc_terminal()
+            state._export_states()
+        try:
+            await asyncio.wait_for(state.wake.wait(), timeout=0.5)
+        except asyncio.TimeoutError:
+            pass
+
+
+def _killpg(pid: int, sig: int) -> None:
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+async def _escalate_kill(proc: subprocess.Popen, grace_s: float) -> None:
+    """SIGTERM was sent to the job's process group; if the group leader is
+    still alive after ``grace_s``, SIGKILL the whole group. Worker
+    subprocesses of a terminated job must not outlive it."""
+    loop = asyncio.get_running_loop()
+    try:
+        await asyncio.wait_for(loop.run_in_executor(None, proc.wait), grace_s)
+    except asyncio.TimeoutError:
+        _killpg(proc.pid, signal.SIGKILL)
+
+
+async def drain_app(app: web.Application, drain_s: float | None = None) -> None:
+    """Graceful SIGTERM drain: stop admitting (invoke → 503), let running
+    jobs finish within ``drain_s``, checkpoint survivors as ``interrupted``
+    (journaled → next boot resumes them), leave queued jobs journaled
+    ``pending``. After this returns every job is terminal or journaled for
+    the next boot — nothing is silently forgotten."""
+    state: ServiceState = app["state"]
+    state.draining = True
+    deadline = time.monotonic() + (state.config.drain_s if drain_s is None else drain_s)
+    while state.procs and time.monotonic() < deadline:
+        await asyncio.sleep(0.1)
+    survivors = list(state.procs.items())
+    for job_id, proc in survivors:
+        rec = state.jobs[job_id]
+        if rec.state == "running":
+            # a proc in a non-running state is a terminated job mid-kill:
+            # kill it with the rest but keep the operator's verdict — the
+            # next boot must NOT resurrect it as interrupted
+            rec.state = "interrupted"
+            rec.pid = None
+            state.record_transition(rec, "drain-checkpoint")
+            logger.info("drain: job %s checkpointed as interrupted", job_id)
+        _killpg(proc.pid, signal.SIGTERM)
+    if survivors:
+        grace = min(2.0, state.config.term_grace_s)
+        loop = asyncio.get_running_loop()
+        for _, proc in survivors:
+            try:
+                await asyncio.wait_for(loop.run_in_executor(None, proc.wait), grace)
+            except asyncio.TimeoutError:
+                _killpg(proc.pid, signal.SIGKILL)
+    state.kick()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+
+
+def build_app(
+    work_root: str = "/tmp/curate_service",
+    config: ServiceConfig | None = None,
+    *,
+    runner_cmd: Callable[[JobRecord, Path], list[str]] | None = None,
+) -> web.Application:
+    cfg = config or ServiceConfig()
+    state = ServiceState(work_root, cfg, runner_cmd=runner_cmd)
     app = web.Application()
     app["state"] = state
 
     async def health(request: web.Request) -> web.Response:
-        active = state.active_job()
+        running = state.running_records()
         return web.json_response(
             {
-                "status": "ok",
-                "active_job": active.job_id if active else None,
+                "status": "draining" if state.draining else "ok",
+                "active_job": running[0].job_id if running else None,
                 "num_jobs": len(state.jobs),
+                "states": state.state_counts(),
+                "queued": {lane: state.admission.lane_depth(lane) for lane in LANES},
+                "max_concurrent": state.admission.effective_max_running(),
             }
         )
 
+    async def list_jobs(request: web.Request) -> web.Response:
+        tenant = request.query.get("tenant", "")
+        want_state = request.query.get("state", "")
+        out = []
+        for rec in state.jobs.values():
+            if tenant and rec.tenant != tenant:
+                continue
+            if want_state and rec.state != want_state:
+                continue
+            out.append(
+                {
+                    "job_id": rec.job_id,
+                    "pipeline": rec.pipeline,
+                    "tenant": rec.tenant,
+                    "priority": rec.priority,
+                    "state": rec.state,
+                    "attempts": rec.attempts,
+                    "pid": rec.pid,
+                }
+            )
+        return web.json_response({"jobs": out})
+
     async def invoke(request: web.Request) -> web.Response:
+        if state.draining:
+            return web.json_response(
+                {"error": "service is draining"},
+                status=503,
+                headers={"Retry-After": "30"},
+            )
         try:
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON body"}, status=400)
+        if not isinstance(body, dict):
+            # valid JSON but not an object ([1,2], "split", 3): .get below
+            # would 500, not 400
+            return web.json_response({"error": "body must be a JSON object"}, status=400)
         pipeline = body.get("pipeline")
         args = body.get("args", {})
         if pipeline not in _PIPELINES:
@@ -166,11 +629,24 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
             )
         if not isinstance(args, dict):
             return web.json_response({"error": "args must be an object"}, status=400)
-        if state.active_job() is not None:
+        tenant = body.get("tenant", "default")
+        priority = body.get("priority", "batch")
+        if not isinstance(tenant, str) or not _TENANT_RE.fullmatch(tenant):
+            # bounded charset+length: the tenant string becomes a journal
+            # field, a work-dir-adjacent id, and a prometheus label
             return web.json_response(
-                {"error": "a pipeline is already running", "active_job": state.active_job().job_id},
-                status=409,
+                {"error": "tenant must match [A-Za-z0-9._:-]{1,64}"}, status=400
             )
+        if priority not in LANES:
+            return web.json_response(
+                {"error": f"priority must be one of {list(LANES)}"}, status=400
+            )
+        try:
+            max_attempts = int(body.get("max_attempts", cfg.max_attempts))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "max_attempts must be an int"}, status=400)
+        if max_attempts < 1:
+            return web.json_response({"error": "max_attempts must be >= 1"}, status=400)
         input_zip_url = body.get("input_zip_url", "")
         output_zip_url = body.get("output_zip_url", "")
         # multi-GB outputs go through presigned multipart (per-part retry,
@@ -194,78 +670,159 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
                 {"error": "output_zip_url requires a local output_path (or none)"},
                 status=400,
             )
-        job_id = uuid.uuid4().hex[:12]
-        work_dir = state.work_root / job_id
-        work_dir.mkdir(parents=True)
-        job = Job(job_id=job_id, pipeline=pipeline, args=args, work_dir=work_dir)
-        log_f = open(job.log_path, "wb")
-        try:
-            job.proc = subprocess.Popen(
-                [
-                    sys.executable,
-                    "-c",
-                    _runner_code(
-                        pipeline,
-                        args,
-                        str(job.summary_path),
-                        work_dir=str(work_dir),
-                        input_zip_url=input_zip_url,
-                        output_zip_url=output_zip_url,
-                        output_zip_multipart=output_zip_multipart,
-                    ),
-                ],
-                stdout=log_f,
-                stderr=subprocess.STDOUT,
-                cwd=str(Path(__file__).resolve().parents[2]),
+        rec = JobRecord.new(
+            pipeline,
+            args,
+            tenant=tenant,
+            priority=priority,
+            max_attempts=max_attempts,
+            input_zip_url=input_zip_url,
+            output_zip_url=output_zip_url,
+            output_zip_multipart=output_zip_multipart,
+        )
+        decision = state.admission.admit(rec)
+        if not decision.admitted:
+            if not decision.retry_after_s:  # malformed, not over-capacity
+                return web.json_response({"error": decision.reason}, status=400)
+            # never-admitted tenants (tenant_limit, or queue_full before
+            # first admission) must not mint new metric label series
+            shed_label = tenant if state.admission.is_known_tenant(tenant) else "_other"
+            state.metrics.observe_service_shed(shed_label, decision.reason)
+            logger.warning(
+                "shed %s job from tenant %s: %s (retry after %.1fs)",
+                priority, tenant, decision.reason, decision.retry_after_s,
             )
-        except Exception as e:
-            job.state = "failed"
-            state.jobs[job_id] = job
-            return web.json_response({"error": str(e), "job_id": job_id}, status=500)
-        finally:
-            log_f.close()  # child holds its own fd; parent must not leak one per job
-        job.state = "running"
-        state.jobs[job_id] = job
-        task = asyncio.create_task(_watch_job(state, job))
-        state.watchers.add(task)  # event loop holds only weak refs
-        task.add_done_callback(state.watchers.discard)
-        return web.json_response({"job_id": job_id, "state": job.state})
+            return web.json_response(
+                {
+                    "error": "over quota, retry later",
+                    "reason": decision.reason,
+                    "retry_after_s": decision.retry_after_s,
+                },
+                status=429,
+                headers={"Retry-After": str(int(decision.retry_after_s) or 1)},
+            )
+        try:
+            # durability gate: the ack implies the journal has the job
+            state.record_transition(rec, "submit", required=True)
+        except JournalWriteError as e:
+            state.admission.remove(rec.job_id)
+            logger.error("refusing job: %s", e)
+            return web.json_response(
+                {"error": f"journal unavailable: {e}"}, status=503
+            )
+        state.jobs[rec.job_id] = rec
+        state.kick()
+        return web.json_response(
+            {
+                "job_id": rec.job_id,
+                "state": rec.state,
+                "tenant": rec.tenant,
+                "priority": rec.priority,
+            }
+        )
 
-    def _get_job(request: web.Request) -> Job | None:
+    def _get_job(request: web.Request) -> JobRecord | None:
         return state.jobs.get(request.match_info["job_id"])
 
     async def progress(request: web.Request) -> web.Response:
-        job = _get_job(request)
-        if job is None:
+        rec = _get_job(request)
+        if rec is None:
             return web.json_response({"error": "unknown job"}, status=404)
         out = {
-            "job_id": job.job_id,
-            "pipeline": job.pipeline,
-            "state": job.state,
-            "elapsed_s": (job.finished_s or time.time()) - job.started_s,
+            "job_id": rec.job_id,
+            "pipeline": rec.pipeline,
+            "tenant": rec.tenant,
+            "priority": rec.priority,
+            "state": rec.state,
+            "attempts": rec.attempts,
+            "max_attempts": rec.max_attempts,
+            "elapsed_s": (rec.finished_s or time.time()) - rec.submitted_s,
         }
-        if job.state == "done":
-            out["summary"] = json.loads(job.summary_path.read_text())
+        if rec.error:
+            out["error"] = rec.error
+        if rec.state == "done":
+            out["summary"] = json.loads(state.summary_path(rec.job_id).read_text())
+        report = state.report_path(rec)
+        if report.exists():
+            # the tenant-facing receipt: trace ids, critical path, per-stage
+            # times (render with `cosmos-curate-tpu report`)
+            out["report"] = str(report)
         return web.json_response(out)
 
     async def logs(request: web.Request) -> web.Response:
-        job = _get_job(request)
-        if job is None:
+        rec = _get_job(request)
+        if rec is None:
             return web.json_response({"error": "unknown job"}, status=404)
-        tail = int(request.query.get("tail", "200"))
-        lines: list[str] = []
-        if job.log_path.exists():
-            lines = job.log_path.read_text(errors="replace").splitlines()[-tail:]
-        return web.json_response({"job_id": job.job_id, "lines": lines})
+        try:
+            tail = int(request.query.get("tail", "200"))
+        except ValueError:
+            return web.json_response({"error": "tail must be an int"}, status=400)
+        lines = tail_lines(state.log_path(rec.job_id), tail)
+        return web.json_response({"job_id": rec.job_id, "lines": lines})
 
     async def terminate(request: web.Request) -> web.Response:
-        job = _get_job(request)
-        if job is None:
+        rec = _get_job(request)
+        if rec is None:
             return web.json_response({"error": "unknown job"}, status=404)
-        if job.proc is not None and job.proc.poll() is None:
-            job.state = "terminated"
-            job.proc.terminate()
-        return web.json_response({"job_id": job.job_id, "state": job.state})
+        if rec.state == "pending":
+            state.admission.remove(rec.job_id)
+            rec.state = "terminated"
+            rec.finished_s = time.time()
+            state.record_transition(rec, "terminated-queued")
+        elif rec.state == "running":
+            rec.state = "terminated"
+            rec.finished_s = time.time()
+            state.record_transition(rec, "terminated")
+            proc = state.procs.get(rec.job_id)
+            if proc is not None and proc.poll() is None:
+                # the whole process group: pipeline worker subprocesses must
+                # not outlive a terminated job. SIGTERM first, SIGKILL after
+                # the grace window.
+                _killpg(proc.pid, signal.SIGTERM)
+                task = asyncio.create_task(
+                    _escalate_kill(proc, cfg.term_grace_s)
+                )
+                state.watchers.add(task)
+                task.add_done_callback(state.watchers.discard)
+        return web.json_response({"job_id": rec.job_id, "state": rec.state})
+
+    async def requeue(request: web.Request) -> web.Response:
+        rec = _get_job(request)
+        if rec is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        if state.draining:
+            return web.json_response({"error": "service is draining"}, status=503)
+        if rec.state not in ("dead_lettered", "failed", "terminated"):
+            return web.json_response(
+                {"error": f"cannot requeue a {rec.state} job"}, status=409
+            )
+        if rec.job_id in state.procs:
+            # a terminated job whose SIGTERM→SIGKILL escalation is still in
+            # flight: re-admitting now would run two copies against one
+            # work_dir and let the old exit corrupt the new attempt's state
+            return web.json_response(
+                {"error": "job process is still exiting; retry shortly"},
+                status=409,
+            )
+        snapshot = (rec.state, rec.attempts, rec.error, rec.finished_s, rec.enqueued_s)
+        rec.attempts = 0
+        rec.error = ""
+        rec.state = "pending"
+        rec.finished_s = None
+        rec.enqueued_s = time.time()
+        decision = state.admission.admit(rec)
+        if not decision.admitted:
+            # shed: the record must be exactly as it was before the request
+            rec.state, rec.attempts, rec.error, rec.finished_s, rec.enqueued_s = snapshot
+            state.metrics.observe_service_shed(rec.tenant, decision.reason)
+            return web.json_response(
+                {"error": "over quota, retry later", "reason": decision.reason},
+                status=429,
+                headers={"Retry-After": str(int(decision.retry_after_s) or 1)},
+            )
+        state.record_transition(rec, "requeued")
+        state.kick()
+        return web.json_response({"job_id": rec.job_id, "state": rec.state})
 
     async def models(request: web.Request) -> web.Response:
         """Weights-registry status (reference nvcf_model_manager equivalent:
@@ -282,14 +839,58 @@ def build_app(work_root: str = "/tmp/curate_service") -> web.Application:
             }
         return web.json_response({"weights_root": str(registry.weights_root()), "models": out})
 
+    async def _start_dispatcher(app: web.Application) -> None:
+        app["dispatcher"] = asyncio.create_task(_dispatch_loop(app))
+
+    async def _stop_dispatcher(app: web.Application) -> None:
+        state.stopping = True
+        state.kick()
+        task = app.get("dispatcher")
+        if task is not None:
+            try:
+                await asyncio.wait_for(task, 5.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                task.cancel()  # backstop; the flag should have sufficed
+        for watcher in list(state.watchers):
+            watcher.cancel()
+
+    app.on_startup.append(_start_dispatcher)
+    app.on_cleanup.append(_stop_dispatcher)
+
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
+    app.router.add_get("/v1/jobs", list_jobs)
     app.router.add_post("/v1/invoke", invoke)
     app.router.add_get("/v1/progress/{job_id}", progress)
     app.router.add_get("/v1/logs/{job_id}", logs)
     app.router.add_post("/v1/terminate/{job_id}", terminate)
+    app.router.add_post("/v1/requeue/{job_id}", requeue)
     return app
 
 
-def serve(host: str = "0.0.0.0", port: int = 8080, work_root: str = "/tmp/curate_service") -> None:
-    web.run_app(build_app(work_root), host=host, port=port)
+def serve(
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    work_root: str = "/tmp/curate_service",
+    config: ServiceConfig | None = None,
+) -> None:
+    """Run the service until SIGTERM/SIGINT, then drain gracefully."""
+    cfg = config or ServiceConfig()
+
+    async def _main() -> None:
+        app = build_app(work_root=work_root, config=cfg)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        logger.info("job service on %s:%d (work_root=%s)", host, port, work_root)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logger.info("signal received: draining (up to %.0fs)", cfg.drain_s)
+        await drain_app(app)
+        await runner.cleanup()
+
+    asyncio.run(_main())

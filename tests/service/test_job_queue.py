@@ -1,0 +1,150 @@
+"""Unit tests: the durable job journal (append/replay/compact/recovery)."""
+
+import json
+
+import pytest
+
+from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.service.job_queue import (
+    JobJournal,
+    JobRecord,
+    JournalWriteError,
+    recover_records,
+)
+
+
+@pytest.fixture
+def journal(tmp_path):
+    return JobJournal(tmp_path / "journal.ndjson")
+
+
+def _rec(**kw):
+    kw.setdefault("pipeline", "split")
+    kw.setdefault("args", {"input_path": "/in", "output_path": "/out"})
+    return JobRecord.new(**kw)
+
+
+class TestJournal:
+    def test_append_replay_roundtrip(self, journal):
+        rec = _rec(tenant="acme", priority="interactive", max_attempts=5)
+        journal.append(rec, "submit")
+        got = journal.replay()
+        assert set(got) == {rec.job_id}
+        back = got[rec.job_id]
+        assert back.tenant == "acme"
+        assert back.priority == "interactive"
+        assert back.max_attempts == 5
+        assert back.args == rec.args
+
+    def test_last_snapshot_wins(self, journal):
+        rec = _rec()
+        journal.append(rec, "submit")
+        rec.state = "running"
+        rec.attempts = 1
+        rec.pid = 4242
+        journal.append(rec, "running")
+        rec.state = "done"
+        rec.pid = None
+        journal.append(rec, "done")
+        back = journal.replay()[rec.job_id]
+        assert back.state == "done"
+        assert back.attempts == 1
+
+    def test_torn_tail_line_discarded(self, journal):
+        a, b = _rec(), _rec()
+        journal.append(a, "submit")
+        journal.append(b, "submit")
+        with open(journal.path, "a") as f:
+            f.write('{"ts": 1, "event": "running", "record": {"job_id"')  # no newline, torn
+        got = journal.replay()
+        assert set(got) == {a.job_id, b.job_id}
+
+    def test_corrupt_middle_line_skipped(self, journal):
+        a = _rec()
+        journal.append(a, "submit")
+        with open(journal.path, "a") as f:
+            f.write("not json at all\n")
+        b = _rec()
+        journal.append(b, "submit")
+        assert set(journal.replay()) == {a.job_id, b.job_id}
+
+    def test_unknown_record_fields_ignored(self, journal):
+        # forward compat: an older service must replay a newer journal
+        rec = _rec()
+        doc = {"ts": 1.0, "event": "submit", "record": {**rec.to_dict(), "new_field": 1}}
+        journal.path.write_text(json.dumps(doc) + "\n")
+        assert set(journal.replay()) == {rec.job_id}
+
+    def test_compact_one_line_per_job(self, journal):
+        rec = _rec()
+        for event in ("submit", "running", "retry", "running", "done"):
+            journal.append(rec, event)
+        records = journal.replay()
+        journal.compact(records)
+        lines = journal.path.read_text().splitlines()
+        assert len(lines) == 1
+        assert journal.replay()[rec.job_id].job_id == rec.job_id
+
+    def test_missing_journal_is_empty(self, journal):
+        assert journal.replay() == {}
+
+    def test_evicted_tombstone_drops_record(self, journal):
+        keep, gone = _rec(), _rec()
+        journal.append(keep, "submit")
+        gone.state = "done"
+        journal.append(gone, "done")
+        journal.append(gone, "evicted")
+        assert set(journal.replay()) == {keep.job_id}
+
+
+class TestChaosSite:
+    def test_journal_write_fault_raises(self, journal):
+        plan = chaos.FaultPlan(
+            rules=(chaos.FaultRule(site=chaos.SITE_SERVICE_JOURNAL_WRITE, kind="error"),)
+        )
+        chaos.install(plan)
+        try:
+            with pytest.raises(JournalWriteError):
+                journal.append(_rec(), "submit")
+        finally:
+            chaos.uninstall()
+        # nothing durable was acked
+        assert journal.replay() == {}
+
+
+class TestRecovery:
+    def test_running_marked_interrupted_and_requeued(self, journal):
+        rec = _rec()
+        rec.state = "running"
+        rec.attempts = 1
+        rec.pid = None
+        journal.append(rec, "running")
+        records, requeue = recover_records(journal)
+        assert records[rec.job_id].state == "interrupted"
+        assert requeue == [rec.job_id]
+        # attempts preserved: a service crash is not the job's fault but
+        # the budget history must survive
+        assert records[rec.job_id].attempts == 1
+
+    def test_pending_requeued_terminal_kept(self, journal):
+        pend, done, dead = _rec(), _rec(), _rec()
+        journal.append(pend, "submit")
+        done.state = "done"
+        journal.append(done, "done")
+        dead.state = "dead_lettered"
+        journal.append(dead, "dead-lettered")
+        records, requeue = recover_records(journal)
+        assert requeue == [pend.job_id]
+        assert records[done.job_id].state == "done"
+        assert records[dead.job_id].state == "dead_lettered"
+
+    def test_stale_pid_not_killed(self, journal):
+        # pid 1 exists but is not a session-leader job child; recovery must
+        # not signal it (the _pgid_is_own_session guard)
+        rec = _rec()
+        rec.state = "running"
+        rec.pid = 1
+        journal.append(rec, "running")
+        records, requeue = recover_records(journal)  # would raise/kill if unguarded
+        assert records[rec.job_id].state == "interrupted"
+        assert requeue == [rec.job_id]
