@@ -19,6 +19,8 @@ chaos test.
 
 from cosmos_curate_tpu.chaos.harness import (
     CHAOS_ENV,
+    SITE_AGENT_KILL,
+    SITE_AGENT_PARTITION,
     SITE_OBJECT_CHANNEL_FETCH,
     SITE_OBJECT_CHANNEL_SERVE,
     SITE_REMOTE_PLANE_RECV,
@@ -42,6 +44,8 @@ from cosmos_curate_tpu.chaos.harness import (
 
 __all__ = [
     "CHAOS_ENV",
+    "SITE_AGENT_KILL",
+    "SITE_AGENT_PARTITION",
     "SITE_OBJECT_CHANNEL_FETCH",
     "SITE_OBJECT_CHANNEL_SERVE",
     "SITE_REMOTE_PLANE_RECV",

@@ -53,6 +53,18 @@ SITE_STORAGE_REQUEST = "storage.request"
 # and the durable journal's append path failing mid-write.
 SITE_SERVICE_JOB_CRASH = "service.job.crash"
 SITE_SERVICE_JOURNAL_WRITE = "service.journal.write"
+# Node-loss sites (engine/remote_agent.py): agent.kill fires in the recv
+# loop AND right after a successful result relay (kind=crash: os._exit, a
+# whole-node SIGKILL — the post-result site dies at the most hostile
+# instant, with outputs the driver already references); agent.partition
+# fires on every frame in both directions (kind=hang with delay_s: frames
+# stall, heartbeats miss, the driver's failure detector declares the node
+# dead; when the sleep ends the agent's next send fails against the
+# quarantined socket and it reconnects as a fresh node). Pin to one agent
+# of a fleet via FaultRule.worker_re against CURATE_WORKER_ID stamped into
+# that agent's environment.
+SITE_AGENT_KILL = "agent.kill"
+SITE_AGENT_PARTITION = "agent.partition"
 
 ALL_SITES = (
     SITE_WORKER_CRASH,
@@ -64,6 +76,8 @@ ALL_SITES = (
     SITE_STORAGE_REQUEST,
     SITE_SERVICE_JOB_CRASH,
     SITE_SERVICE_JOURNAL_WRITE,
+    SITE_AGENT_KILL,
+    SITE_AGENT_PARTITION,
 )
 
 _KINDS = ("crash", "hang", "error", "delay")

@@ -66,10 +66,13 @@ def _cmd_list(args: argparse.Namespace) -> int:
         m = e.meta
         requeued = " [requeued]" if m.get("requeued_at") else ""
         trace = f" trace={m['trace_id']}" if m.get("trace_id") else ""
+        # owner-loss drops name the dead node: "node died past budget"
+        # reads differently from "batch is poison"
+        lost = f" lost_node={m['lost_node']}" if m.get("lost_node") else ""
         print(
             f"{e.entry_id}: stage={m.get('stage')} tasks={m.get('num_tasks')} "
             f"attempts={m.get('attempts')} worker_deaths={m.get('worker_deaths')} "
-            f"reason={m.get('reason', '')!r}{trace}{requeued}"
+            f"reason={m.get('reason', '')!r}{lost}{trace}{requeued}"
         )
     return 0
 
@@ -83,6 +86,14 @@ def _cmd_show(args: argparse.Namespace) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     print(json.dumps(entry.meta, indent=2))
+    if entry.meta.get("lineage"):
+        # the producer chain reconstruction walked before giving up
+        print("lineage chain (reconstruction gave up here):")
+        for hop in entry.meta["lineage"]:
+            print(
+                f"  {hop.get('ref')} <- {hop.get('produced_by_stage')} "
+                f"(inputs: {', '.join(hop.get('inputs', [])) or '-'})"
+            )
     try:
         tasks = entry.load_tasks()
     except Exception as e:  # payloads can outlive their class definitions

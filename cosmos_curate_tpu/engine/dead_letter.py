@@ -107,6 +107,9 @@ class DeadLetterQueue:
         error: str = "",
         payload_errors: list[str] | None = None,
         trace_id: str = "",
+        lost_node: str = "",
+        node_deaths: int = 0,
+        lineage: list | None = None,
     ) -> Path | None:
         """Persist one dead batch; returns its directory (None = disabled
         or failed — the caller's drop proceeds regardless).
@@ -114,7 +117,13 @@ class DeadLetterQueue:
         ``trace_id`` links the entry back to its distributed trace; when
         omitted, the recorder captures the current context's trace id (the
         runners record drops from inside their run span), so `dlq show`
-        can answer "which trace dropped this batch"."""
+        can answer "which trace dropped this batch".
+
+        ``lost_node`` + ``lineage`` stamp owner-loss drops: the node whose
+        death orphaned the batch's inputs and the producer chain lineage
+        reconstruction walked before giving up — so operators can tell
+        "node died past budget" (requeue and move on) from "batch is
+        poison" (investigate the payload)."""
         if not self.enabled:
             return None
         import cloudpickle
@@ -148,6 +157,12 @@ class DeadLetterQueue:
                 # some payloads could not be materialized (e.g. their owner
                 # node died): the entry is partial, and says so
                 meta["payload_errors"] = payload_errors
+            if lost_node:
+                meta["lost_node"] = lost_node
+            if node_deaths:
+                meta["node_deaths"] = node_deaths
+            if lineage:
+                meta["lineage"] = lineage
             (entry / "meta.json").write_text(json.dumps(meta, indent=2))
         except Exception:
             logger.exception(

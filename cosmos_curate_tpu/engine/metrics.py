@@ -216,6 +216,26 @@ class EngineMetrics:
         self.node_cpus_used = Gauge(
             "pipeline_node_cpus_used", "CPU units in use per node", node_labels
         )
+        # Node-loss fault tolerance (engine/runner.py + remote_plane.py):
+        # declared node deaths (heartbeat deadline or link loss), objects
+        # re-materialized through lineage reconstruction, and the wall time
+        # those re-runs took. Healthy node churn reads as deaths > 0 with
+        # reconstructed > 0 and ZERO dead-lettered batches; deaths with no
+        # reconstruction means lineage had already expired (or the budget
+        # is too tight) and work is dropping instead of recomputing.
+        self.node_deaths = Counter(
+            "pipeline_node_deaths_total",
+            "agents declared dead (heartbeat deadline or link loss)",
+            node_labels,
+        )
+        self.objects_reconstructed = Counter(
+            "pipeline_objects_reconstructed_total",
+            "lost objects re-materialized via lineage re-execution", labels,
+        )
+        self.reconstruction_seconds = Counter(
+            "pipeline_reconstruction_seconds_total",
+            "wall seconds spent re-executing producer batches", [],
+        )
         # Job-service lifecycle (service/app.py): transitions per tenant,
         # current per-state counts, queue wait, and sheds. shed_total rising
         # under `tenant_queue_full` is a noisy tenant hitting its quota
@@ -410,6 +430,16 @@ class EngineMetrics:
         if self.enabled:
             self.node_workers.labels(node).set(workers)
             self.node_cpus_used.labels(node).set(cpus_used)
+
+    def observe_node_death(self, node: str) -> None:
+        if self.enabled:
+            self.node_deaths.labels(node).inc()
+
+    def observe_reconstruction(self, stage: str, objects: int, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self.objects_reconstructed.labels(stage).inc(max(0, int(objects)))
+        self.reconstruction_seconds.inc(max(0.0, float(seconds)))
 
     def set_overlap_frac(self, frac: float) -> None:
         if self.enabled:

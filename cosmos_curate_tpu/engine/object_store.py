@@ -293,6 +293,13 @@ class StoreBudget:
         with self._cv:
             return self._used < self.capacity or not self._live
 
+    def tracks(self, ref: ObjectRef) -> bool:
+        """Whether ``ref`` is currently in the ledger (accounted, not yet
+        released) — reconstruction uses this to release exactly the refs
+        it accounted at adoption and no others."""
+        with self._cv:
+            return ref.shm_name in self._live
+
     def release(self, ref: ObjectRef) -> None:
         with self._cv:
             size = self._live.pop(ref.shm_name, 0)

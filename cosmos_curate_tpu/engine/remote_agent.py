@@ -28,8 +28,11 @@ import socket
 import threading
 import time
 
+from cosmos_curate_tpu import chaos
 from cosmos_curate_tpu.engine import object_channel, object_store
 from cosmos_curate_tpu.engine.remote_plane import (
+    DEFAULT_HEARTBEAT_S,
+    HEARTBEAT_S_ENV,
     AgentReady,
     AgentResult,
     AgentStats,
@@ -151,6 +154,13 @@ class NodeAgent:
         self._op_lock = threading.Lock()
         self._op_prev: dict | None = None
         self._last_op_flush = 0.0
+        # heartbeat cadence: the watchdog ships an AgentStats frame — empty
+        # deltas included — at least this often, so the driver's failure
+        # detector (remote_plane.check_heartbeats) can declare a silent
+        # agent dead deterministically. Must match the driver's knob.
+        self._heartbeat_s = float(
+            os.environ.get(HEARTBEAT_S_ENV, str(DEFAULT_HEARTBEAT_S))
+        )
 
     def run(self, *, connect_timeout_s: float = 60.0, reconnect: bool = True) -> int:
         """Serve the driver until it says Bye.
@@ -207,6 +217,12 @@ class NodeAgent:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.5)
+        # the 10s DIAL timeout must not become a RECV deadline: an agent
+        # the driver leaves idle (no StartWorker yet, quiet pipeline) would
+        # time out mid-session and reconnect-churn every 10 seconds. Frames
+        # block indefinitely; driver death surfaces as EOF/RST, and the
+        # driver's own failure detector covers the reverse direction.
+        sock.settimeout(None)
         self.sock = sock
         # mutual-nonce handshake: both sides contribute fresh randomness
         # to the session id, so no recorded session replays (either
@@ -217,6 +233,9 @@ class NodeAgent:
                 self.node_id, self.num_cpus,
                 object_port=self.object_server.port,
                 memory_gb=_host_memory_gb(),
+                # pid lets the driver tell a same-process reconnect
+                # (segments survived) from a bounced agent (they did not)
+                pid=os.getpid(),
             ),
         )
         self.driver_object_addr = (self.addr[0], ack.driver_object_port)
@@ -241,6 +260,13 @@ class NodeAgent:
         said_bye = False
         try:
             while True:
+                # chaos network partition (kind=hang): inbound frames stall
+                # here, outbound ones in _send — heartbeats miss, and the
+                # driver's failure detector declares this node dead. A
+                # single falsy check while disarmed. (agent.kill fires in
+                # _relay_results, right after a result lands at the driver —
+                # the instant a death actually orphans referenced outputs.)
+                chaos.fire(chaos.SITE_AGENT_PARTITION)
                 msg = self.chan.recv()
                 if isinstance(msg, Bye):
                     said_bye = True
@@ -283,6 +309,9 @@ class NodeAgent:
         return said_bye
 
     def _send(self, msg) -> None:
+        # kind=hang here stalls outbound frames (results, heartbeats) —
+        # one half of the agent.partition site; no-op while disarmed
+        chaos.fire(chaos.SITE_AGENT_PARTITION)
         # SecureChannel serializes sends internally (per-frame sequence)
         self.chan.send(msg)
 
@@ -376,13 +405,24 @@ class NodeAgent:
                 node=self.node_id,
             ):
                 refs, fetched = self._resolve_specs(msg.refs)
-        except Exception:
+        except Exception as e:
             import traceback
 
+            # classify: a fetch that died on the object channel (owner
+            # unreachable or hung, segment gone with its node) is an INPUT
+            # LOSS — the driver reconstructs via lineage instead of burning
+            # the batch's user-code retry budget on a vanished ref. NOT a
+            # blanket OSError: a local disk-full/fd-exhaustion writing the
+            # fetched segment is this node's problem, not an owner loss.
+            input_loss = isinstance(
+                e, (ConnectionError, FileNotFoundError, TimeoutError)
+            )
             try:
                 self._send(
                     AgentResult(
-                        msg.worker_key, msg.batch_id, error=traceback.format_exc()
+                        msg.worker_key, msg.batch_id,
+                        error=traceback.format_exc(),
+                        input_loss=input_loss,
                     )
                 )
             except OSError:
@@ -625,10 +665,16 @@ class NodeAgent:
                 pass
 
     def _flush_op_stats(
-        self, *, min_interval_s: float = 1.0, force: bool = False
+        self, *, min_interval_s: float = 1.0, force: bool = False,
+        heartbeat: bool = False,
     ) -> None:
         """Ship object-plane DELTAS to the driver, throttled (relay thread
-        after results, watchdog on cadence, teardown forced)."""
+        after results, watchdog on cadence, teardown forced).
+
+        ``heartbeat=True`` (the watchdog's cadence call) sends the frame
+        even when the delta is empty: the driver's failure detector keys
+        agent liveness on frame arrival, and an idle-but-healthy agent must
+        not read as a dead one."""
         from cosmos_curate_tpu.observability.stage_timer import (
             object_plane_snapshot_delta,
         )
@@ -639,7 +685,7 @@ class NodeAgent:
                 return
             self._last_op_flush = now
             self._op_prev, delta = object_plane_snapshot_delta(self._op_prev)
-        if delta:
+        if delta or heartbeat:
             try:
                 self._send(AgentStats(object_plane=delta))
             except OSError:
@@ -685,6 +731,11 @@ class NodeAgent:
                     # piggyback transfer stats on result traffic so even a
                     # run shorter than the watchdog cadence reports
                     self._flush_op_stats()
+                    # chaos: the most hostile node-death instant — the
+                    # result (and its output descriptors) just reached the
+                    # driver, so downstream batches WILL reference segments
+                    # that die with this process. kind=crash (os._exit).
+                    chaos.fire(chaos.SITE_AGENT_KILL)
             except OSError:
                 return
 
@@ -695,13 +746,18 @@ class NodeAgent:
         per-batch deadlines (SubmitBatch.timeout_s): a worker whose batch
         outlives its deadline is presumed hung, killed, and reported
         through the same WorkerDied path as a real death."""
+        tick = min(1.0, self._heartbeat_s / 2) if self._heartbeat_s > 0 else 1.0
         while not stop.is_set():
-            time.sleep(1.0)
+            time.sleep(tick)
             now = time.monotonic()
             # relay object-plane deltas so the driver's per-node counters
             # and run report cover this node's transfers even while no
-            # results flow (e.g. a long prefetch burst before dispatch)
-            self._flush_op_stats(min_interval_s=3.0)
+            # results flow — AND serve as the liveness heartbeat the
+            # driver's failure detector deadlines against (empty deltas
+            # still send a frame)
+            self._flush_op_stats(
+                min_interval_s=max(0.2, self._heartbeat_s), heartbeat=True
+            )
             with self._lock:
                 expired = [k for k, d in self.deadlines.items() if now >= d]
             for key, batch_id in expired:

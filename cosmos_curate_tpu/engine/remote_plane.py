@@ -50,6 +50,15 @@ TOKEN_ENV = "CURATE_ENGINE_TOKEN"
 DRIVER_PORT_ENV = "CURATE_ENGINE_DRIVER_PORT"
 WAIT_NODES_ENV = "CURATE_ENGINE_WAIT_NODES"
 WAIT_S_ENV = "CURATE_ENGINE_WAIT_S"
+# driver-side failure detector: an agent that misses HEARTBEAT_MISSES
+# consecutive heartbeat windows (the agent's watchdog ships AgentStats —
+# possibly empty — every HEARTBEAT_S) is declared dead deterministically,
+# instead of whenever a TCP send happens to fail. 0 disables the deadline
+# (link errors still mark agents dead, as before).
+HEARTBEAT_S_ENV = "CURATE_AGENT_HEARTBEAT_S"
+HEARTBEAT_MISSES_ENV = "CURATE_AGENT_HEARTBEAT_MISSES"
+DEFAULT_HEARTBEAT_S = 3.0
+DEFAULT_HEARTBEAT_MISSES = 5
 
 _MAGIC = b"CRPL"
 
@@ -67,6 +76,12 @@ class Hello:
     # host RAM in GiB for the per-node planner's memory fit check
     # (0 = unknown: the planner then fits on CPUs alone)
     memory_gb: float = 0.0
+    # agent process pid (0 = unknown/old agent): on a reconnect the driver
+    # uses it to tell a same-process link blip (segments survived — re-point
+    # their locations) from a BOUNCED agent process (its stale-segment
+    # janitor reclaimed the old pid's segments — leave locations on the
+    # dead link so consumers reconstruct instead of fetching ghosts)
+    pid: int = 0
 
 
 @dataclass
@@ -163,6 +178,11 @@ class AgentResult:
     error: str | None = None
     process_time_s: float = 0.0
     deserialize_time_s: float = 0.0
+    # the error is an INPUT LOSS (object-channel fetch failed: owner dead
+    # or segment gone), not a user-code exception — the driver routes it to
+    # lineage reconstruction / the node-death budget instead of burning the
+    # batch's num_run_attempts
+    input_loss: bool = False
 
 
 @dataclass
@@ -494,6 +514,13 @@ class AgentLink:
     # autoscaler's per-worker resources.cpus
     worker_costs: dict = field(default_factory=dict)
     dead_workers: set = field(default_factory=set)
+    # failure-detector state: agent process pid (Hello), when the last
+    # frame arrived (any frame counts — results ARE liveness), and whether
+    # this link's death was already surfaced as a node event (one event
+    # per link, however many paths notice the death)
+    pid: int = 0
+    last_seen: float = field(default_factory=time.monotonic)
+    death_recorded: bool = False
 
     @property
     def cpus_used(self) -> float:
@@ -538,6 +565,15 @@ class RemoteWorkerManager:
         # segment names) and flush when that node rejoins — a transient blip
         # must not leak the agent's segments for the rest of the run
         self._pending_releases: dict[str, list] = {}
+        # failure detector: per-agent heartbeat deadline (the agent's
+        # watchdog ships an AgentStats frame — empty deltas included —
+        # every heartbeat_s; see remote_agent._watchdog). Newly-declared
+        # deaths queue here for the runner's live-replan poll.
+        self.heartbeat_s = float(os.environ.get(HEARTBEAT_S_ENV, str(DEFAULT_HEARTBEAT_S)))
+        self.heartbeat_misses = max(
+            1, int(os.environ.get(HEARTBEAT_MISSES_ENV, str(DEFAULT_HEARTBEAT_MISSES)))
+        )
+        self._node_deaths: list[dict] = []
         self.run_id = os.urandom(16)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         # a restarted driver must rebind the well-known port: SO_REUSEADDR
@@ -645,14 +681,34 @@ class RemoteWorkerManager:
             hello.node_id, hello.num_cpus, sock, self.token, chan=chan,
             memory_gb=getattr(hello, "memory_gb", 0.0),
             object_addr=(addr[0], hello.object_port),
+            pid=getattr(hello, "pid", 0),
         )
+        # Hello dedup: links are keyed by node_id — an agent that bounced
+        # (or healed from a partition) before the driver noticed must not
+        # appear as TWO NodeBudgets or leave the stale link reachable from
+        # the sender loop. The stale link is declared dead (quarantined,
+        # one node event) and dropped from the registry; the reconnecting
+        # agent joins as a FRESH node.
         with self._lock:
+            stale_links = [a for a in self.agents if a.node_id == hello.node_id]
+        for old in stale_links:
+            self.note_agent_dead(old, reason="superseded by a reconnecting agent")
+        with self._lock:
+            self.agents = [a for a in self.agents if a.node_id != hello.node_id]
             self.agents.append(link)
-            # a REJOIN after a link blip: the node kept its segments (same
-            # run_id), so re-point their location entries at the live link
-            # and flush releases that arrived during the outage
+            # REJOIN of the SAME agent process (link blip): the node kept
+            # its segments (same run_id, same pid), so re-point their
+            # location entries at the live link. A BOUNCED process (new
+            # pid) reclaimed the old pid's segments at startup — its
+            # entries stay on the dead link, so consumers see a dead owner
+            # and reconstruct instead of fetching ghosts.
             for name, old in list(self._locations.items()):
-                if old.node_id == hello.node_id and not old.alive:
+                if (
+                    old.node_id == hello.node_id
+                    and old is not link
+                    and link.pid
+                    and old.pid == link.pid
+                ):
                     self._locations[name] = link
             stale = self._pending_releases.pop(hello.node_id, [])
         if stale:
@@ -666,6 +722,7 @@ class RemoteWorkerManager:
         try:
             while True:
                 msg = chan.recv()
+                link.last_seen = time.monotonic()  # any frame is a heartbeat
                 self._on_agent_msg(link, msg)
         except (ConnectionError, OSError):
             link.alive = False
@@ -699,6 +756,7 @@ class RemoteWorkerManager:
                         error=msg.error,
                         process_time_s=msg.process_time_s,
                         worker_id=msg.worker_key,
+                        input_loss=getattr(msg, "input_loss", False),
                     )
                 )
                 return
@@ -756,6 +814,84 @@ class RemoteWorkerManager:
                 for a in self.agents
                 if a.alive
             ]
+
+    # -- failure detector ----------------------------------------------
+    def note_agent_dead(self, link: AgentLink, *, reason: str = "declared dead") -> bool:
+        """Declare one agent dead: quarantine the link (socket closed, so a
+        hung/partitioned recv thread unblocks and a late frame from the old
+        session can never resurrect stale state), record ONE node event for
+        the runner's live-replan poll, and let the normal dead-worker reap
+        fail its in-flight SubmitBatches as worker deaths (``_RemoteProc.
+        is_alive`` keys on ``link.alive``). Idempotent per link; returns
+        True the first time."""
+        with self._lock:
+            if link.death_recorded:
+                link.alive = False
+                return False
+            link.death_recorded = True
+            link.alive = False
+            self._node_deaths.append(
+                {
+                    "node": link.node_id,
+                    "reason": reason,
+                    "at": time.time(),
+                    "workers_lost": len(link.worker_costs),
+                }
+            )
+        logger.warning("node %s declared dead: %s", link.node_id, reason)
+        try:
+            if link.sock is not None:
+                link.sock.close()
+        except OSError:
+            pass
+        return True
+
+    def check_heartbeats(self, now: float | None = None) -> None:
+        """Sweep the registry: links past their heartbeat deadline are
+        declared dead; links some send/recv path already marked ``alive =
+        False`` get their (single) node event recorded here. Cheap — a
+        float compare per agent — so the runner calls it every loop tick."""
+        now = time.monotonic() if now is None else now
+        deadline = self.heartbeat_s * self.heartbeat_misses
+        with self._lock:
+            links = list(self.agents)
+        for link in links:
+            if not link.alive:
+                if not link.death_recorded:
+                    self.note_agent_dead(link, reason="link lost")
+                continue
+            if self.heartbeat_s > 0 and now - link.last_seen > deadline:
+                self.note_agent_dead(
+                    link,
+                    reason=(
+                        f"missed {self.heartbeat_misses} heartbeats "
+                        f"(silent {now - link.last_seen:.1f}s > {deadline:.1f}s)"
+                    ),
+                )
+
+    def poll_node_deaths(self) -> list[dict]:
+        """Sweep heartbeats, then drain newly-recorded node-death events
+        (the runner replans immediately on a non-empty result)."""
+        self.check_heartbeats()
+        with self._lock:
+            out, self._node_deaths = self._node_deaths, []
+        return out
+
+    def owner_dead(self, ref) -> bool:
+        """True when the segment's owning agent is registered but dead —
+        the signal that a failed fetch is a NODE loss (reconstruct via
+        lineage) rather than a transient error (retry)."""
+        with self._lock:
+            link = self._locations.get(ref.shm_name)
+        return link is not None and not link.alive
+
+    def node_of(self, name: str) -> str:
+        """The node id registered as owning segment ``name`` (dead links
+        included — DLQ entries stamp the LOST node); '' when unknown or
+        driver-owned."""
+        with self._lock:
+            link = self._locations.get(name)
+        return link.node_id if link is not None else ""
 
     def place_for(self, node_id: str, cpu_cost: float) -> "AgentLink | None":
         """Planner-directed placement: ``node_id == ''`` places locally;

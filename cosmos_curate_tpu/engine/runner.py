@@ -46,11 +46,15 @@ class _Batch:
     stage_idx: int
     refs: list[object_store.ObjectRef]
     attempts: int = 0
-    # worker/node deaths are infrastructure failures, budgeted separately
-    # from user-code exceptions (the reference's num_run_attempts_python
-    # counts exceptions only, stage_interface.py:197; Ray reschedules on
-    # actor death). A cap still bounds poison batches that kill workers.
+    # worker deaths are infrastructure failures, budgeted separately from
+    # user-code exceptions (the reference's num_run_attempts_python counts
+    # exceptions only, stage_interface.py:197; Ray reschedules on actor
+    # death). A cap still bounds poison batches that kill workers.
     worker_deaths: int = 0
+    # whole-NODE deaths budget separately again: one flaky node churning
+    # through a run must not exhaust a batch's poison-batch guard — losing
+    # a node is the cluster's fault, never the batch's
+    node_deaths: int = 0
     # set at dispatch: which worker holds the batch, and (when the stage
     # declares batch_timeout_s) the monotonic instant after which that
     # worker is presumed hung and killed
@@ -58,10 +62,13 @@ class _Batch:
     deadline: float | None = None
 
 
-# A batch survives this many worker/node deaths before being dropped
+# A batch survives this many worker deaths before being dropped
 # (poison-batch guard: e.g. an input that OOM-kills every worker that
 # touches it must not respawn workers forever).
 MAX_WORKER_DEATHS_PER_BATCH = 3
+# ... and this many whole-node deaths (separate budget: node churn is
+# infrastructure weather, not evidence the batch is poison)
+MAX_NODE_DEATHS_PER_BATCH = 3
 
 # driver-side prefetch-ahead: how many agent-owned segments may stream
 # toward the driver concurrently while their consumer batch is still queued
@@ -109,6 +116,31 @@ class StreamingRunner(RunnerInterface):
         self._prefetch_inflight: set[str] = set()
         # (target_node, shm_name) push-ahead requests already issued
         self._pushed: set[tuple[str, str]] = set()
+        # -- node-loss fault tolerance (cross-host runs only) ----------
+        # lineage tracker (engine/lineage.py): per live intermediate ref,
+        # the (stage, input_refs) that produced it — None on single-host
+        # runs, where no node can die out from under the store
+        self._tracker = None
+        self._stage_names: list[str] = []
+        # recon batch_id (negative, never colliding with the dispatch
+        # counter) -> LineageRecord being re-executed; start times feed
+        # pipeline_reconstruction_seconds_total
+        self._recon: dict[int, object] = {}
+        self._recon_started: dict[int, float] = {}
+        self._recon_seq = 0
+        self._recon_spent = 0
+        self._recon_depth = 0
+        self._recon_budget = 0
+        # batches parked off every queue while their lost inputs
+        # re-materialize: batch_id -> (stage_idx, batch, missing names)
+        self._lost_waiters: dict[int, tuple[int, _Batch, set]] = {}
+        # lost name -> regenerated ref nobody was waiting for yet (an
+        # in-flight batch dispatched before the swap adopts it on failure)
+        self._renamed: dict[str, object_store.ObjectRef] = {}
+        # run receipts for the flight recorder's node_events section
+        self.node_events: list[dict] = []
+        self.objects_reconstructed = 0
+        self.reconstruction_seconds = 0.0
 
     # ------------------------------------------------------------------
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
@@ -208,11 +240,38 @@ class StreamingRunner(RunnerInterface):
             for i, s in enumerate(stage_specs)
         ]
         self._remote_mgr = remote_mgr
+        # node-loss lineage (cross-host only): the tracker wraps the
+        # location-aware deleter — a release settles the ref's lineage and
+        # may DEFER the physical delete while a live record still needs the
+        # ref as a reconstruction input (one extra generation of segments
+        # resident; ledger accounting is never deferred)
+        self._tracker = None
+        if remote_mgr is not None:
+            from cosmos_curate_tpu.engine.lineage import (
+                DEFAULT_RECONSTRUCT_BUDGET,
+                DEFAULT_RECONSTRUCT_DEPTH,
+                RECONSTRUCT_BUDGET_ENV,
+                RECONSTRUCT_DEPTH_ENV,
+                LineageTracker,
+            )
+
+            self._tracker = LineageTracker(remote_mgr.release_data)
+            self._recon_depth = int(
+                os.environ.get(RECONSTRUCT_DEPTH_ENV, DEFAULT_RECONSTRUCT_DEPTH)
+            )
+            self._recon_budget = int(
+                os.environ.get(RECONSTRUCT_BUDGET_ENV, DEFAULT_RECONSTRUCT_BUDGET)
+            )
         store = object_store.StoreBudget(
             capacity_bytes=int(_host_memory_bytes() * cfg.streaming.object_store_fraction),
             # location-aware deletion: agent-owned segments release at their
-            # owner over the control link, local ones unlink here
-            deleter=remote_mgr.release_data if remote_mgr is not None else None,
+            # owner over the control link, local ones unlink here (lineage
+            # tracker in front when cross-host reconstruction is live)
+            deleter=(
+                self._tracker
+                if self._tracker is not None
+                else (remote_mgr.release_data if remote_mgr is not None else None)
+            ),
         )
         # network transfers NEVER run on the orchestration loop (the same
         # property _RemoteInQ documents for sends): localizing agent-owned
@@ -240,6 +299,17 @@ class StreamingRunner(RunnerInterface):
         self._prefetch_inflight.clear()
         self._pushed.clear()
         self._pref_node = None
+        # node-loss state is run-scoped too
+        self._stage_names = [s.name for s in stage_specs]
+        self._recon = {}
+        self._recon_started = {}
+        self._recon_seq = 0
+        self._recon_spent = 0
+        self._lost_waiters = {}
+        self._renamed = {}
+        self.node_events = []
+        self.objects_reconstructed = 0
+        self.reconstruction_seconds = 0.0
         # (stage_state, batch, Future[list-of-values]): final-stage batches
         # whose remote outputs are streaming in; inputs stay held until the
         # future lands (failure re-executes the batch)
@@ -318,9 +388,9 @@ class StreamingRunner(RunnerInterface):
                         # inputs are local now: dispatch with priority
                         stx.retry_queue.appendleft(lb)
                     else:
-                        _retry_or_drop(
-                            stx, lb, store, f"localizing inputs failed: {err}",
-                            dead_letter=self._dead_letter,
+                        self._on_lost_or_failed_inputs(
+                            states, stx, lb, store,
+                            f"localizing inputs failed: {err}",
                         )
                 # 1c. drain finished prefetch-aheads into the local cache
                 while True:
@@ -353,9 +423,32 @@ class StreamingRunner(RunnerInterface):
                     raise RuntimeError(
                         "stage worker setup failed:\n" + "\n".join(pending_setup_errors)
                     )
-                # 2. detect dead workers; reap draining ones (non-blocking).
+                # 2. failure detector + live replan: sweep per-agent
+                # heartbeat deadlines; a newly-declared node death replans
+                # placement IMMEDIATELY (not next autoscale tick), so
+                # orphaned queued batches re-route via the locality router
+                # while the reap below requeues the dead node's in-flight
+                # work. Then detect dead workers; reap draining ones.
                 # 2a first kills workers whose batch blew its deadline, so
                 # the very next reap pass requeues the batch.
+                if remote_mgr is not None:
+                    dead_events = remote_mgr.poll_node_deaths()
+                    if dead_events:
+                        progressed = True
+                        for ev in dead_events:
+                            self.metrics.observe_node_death(ev["node"])
+                            # stale push-ahead dedup for the dead node: a
+                            # rejoining agent starts with an empty prefetch
+                            # cache, so suppressed re-pushes would be misses
+                            self._pushed = {
+                                k for k in self._pushed if k[0] != ev["node"]
+                            }
+                        self.node_events.extend(dead_events)
+                        self._apply_allocation(
+                            states, budget, cfg,
+                            remote_mgr=remote_mgr, local_node=node,
+                        )
+                        last_autoscale = time.monotonic()
                 progressed |= self._expire_hung_batches(states, batches)
                 progressed |= self._reap_dead_workers(states, batches, store)
                 for st in states:
@@ -442,7 +535,12 @@ class StreamingRunner(RunnerInterface):
                         )
                         batches[batch.batch_id] = batch
                         st.pool.submit(w, batch.batch_id, batch.refs)
-                        st.dispatched += 1
+                        if batch.batch_id >= 0:
+                            # reconstruction re-runs (negative ids) settle
+                            # into waiters, never into completed/errored —
+                            # counting them would break the invariant that
+                            # completed + errored covers every dispatch
+                            st.dispatched += 1
                         progressed = True
                 # 4. autoscale. The per-node path re-derives its NodeBudget
                 # list from the live agents each replan, so a dead agent's
@@ -481,18 +579,28 @@ class StreamingRunner(RunnerInterface):
                 # (its outputs died with their owner)
                 if self._final_fetches:
                     pending = []
-                    for stx, fb, fut in self._final_fetches:
+                    for stx, fb, f_refs, fut in self._final_fetches:
                         if not fut.done():
-                            pending.append((stx, fb, fut))
+                            pending.append((stx, fb, f_refs, fut))
                             continue
                         progressed = True
                         try:
                             outputs.extend(fut.result())
                         except Exception as e:
+                            # outputs that died WITH their node charge the
+                            # node-death budget (and stamp the lost node),
+                            # not the poison-batch guard
+                            lost = [
+                                r.shm_name
+                                for r in f_refs
+                                if remote_mgr is not None and remote_mgr.owner_dead(r)
+                            ]
                             _retry_or_drop(
                                 stx, fb, store,
                                 f"final outputs lost with their owner: {e}",
                                 dead_letter=self._dead_letter,
+                                node_death=bool(lost),
+                                lost_node=self._lost_node(lost),
                             )
                             continue
                         stx.completed += 1  # settled: count the logical batch
@@ -504,6 +612,7 @@ class StreamingRunner(RunnerInterface):
                     and not batches
                     and not localizing
                     and not self._final_fetches
+                    and not self._lost_waiters
                     and all(not st.in_queue and not st.retry_queue for st in states)
                 ):
                     break
@@ -569,10 +678,19 @@ class StreamingRunner(RunnerInterface):
             for batch in localizing.values():
                 for r in batch.refs:
                     store.release(r)
-            for _stx, fb, _fut in self._final_fetches:  # inputs held for fetch
+            for _stx, fb, _refs, _fut in self._final_fetches:  # inputs held for fetch
                 for r in fb.refs:
                     store.release(r)
             self._final_fetches = []
+            # batches parked for reconstruction and regenerated-but-
+            # unadopted outputs are in no queue — walk them too
+            for _sidx, wb, _missing in self._lost_waiters.values():
+                for r in wb.refs:
+                    store.release(r)
+            self._lost_waiters.clear()
+            for ref in self._renamed.values():
+                store.release(ref)
+            self._renamed.clear()
             for st in states:
                 for r in st.in_queue:
                     store.release(r)
@@ -580,6 +698,11 @@ class StreamingRunner(RunnerInterface):
                     for r in batch.refs:
                         store.release(r)
                 st.pool.shutdown()
+            if self._tracker is not None:
+                # physically delete every still-deferred lineage input
+                # BEFORE the manager shutdown below closes the control
+                # links its ReleaseObjects frames ride on
+                self._tracker.drain()
             if prewarm is not None:
                 prewarm.shutdown()
             if remote_mgr is not None:
@@ -807,7 +930,34 @@ class StreamingRunner(RunnerInterface):
         if w is not None:
             w.busy_batch = None
             w.batches_done += 1
+        if batch.batch_id in self._recon:
+            # a reconstruction re-run: its outputs replace lost refs
+            # positionally instead of feeding the next stage's queue
+            self._handle_recon_result(states, batch, msg, store)
+            return
         if msg.error is not None:
+            if self._remote_mgr is not None:
+                if any(self._remote_mgr.owner_dead(r) for r in batch.refs):
+                    # the batch failed FETCHING inputs whose owner died,
+                    # not running user code: reconstruct via lineage (or
+                    # charge the node-death budget) instead of burning
+                    # retries
+                    self._on_lost_or_failed_inputs(
+                        states, st, batch, store,
+                        f"inputs lost to a dead node: {_tail(msg.error, 400)}",
+                    )
+                    return
+                if getattr(msg, "input_loss", False):
+                    # fetch infrastructure failed without a provably-dead
+                    # owner (transient drop, racing release): infra budget,
+                    # never the user-code retry budget — and never a
+                    # misleading "dead node" reason
+                    _retry_or_drop(
+                        st, batch, store,
+                        f"input fetch failed: {_tail(msg.error, 400)}",
+                        dead_letter=self._dead_letter,
+                    )
+                    return
             self.metrics.observe_error(st.spec.name)
             batch.attempts += 1
             if batch.attempts < max(1, st.spec.num_run_attempts):
@@ -870,6 +1020,11 @@ class StreamingRunner(RunnerInterface):
                 outputs.append(object_store.get(r))
             object_store.delete(r)
         if forward:
+            if self._tracker is not None:
+                # lineage: these outputs are re-derivable from this batch's
+                # inputs at this stage; the inputs' physical delete (below)
+                # defers until every output releases
+                self._tracker.record(batch.stage_idx, list(batch.refs), forward)
             # push-ahead: start moving these outputs toward the node the
             # planner chose for the NEXT stage while this loop keeps
             # orchestrating — by dispatch time the bytes are (mostly) there
@@ -883,6 +1038,7 @@ class StreamingRunner(RunnerInterface):
                 (
                     st,
                     batch,
+                    final_remote,
                     self._fetch_pool.submit(
                         contextvars.copy_context().run,
                         self._fetch_final_values, final_remote, self._remote_mgr,
@@ -940,10 +1096,15 @@ class StreamingRunner(RunnerInterface):
             progressed = True
         return progressed
 
-    def _dead_letter(self, stx, batch: _Batch, *, reason: str, error: str = "") -> None:
+    def _dead_letter(
+        self, stx, batch: _Batch, *, reason: str, error: str = "",
+        lost_node: str = "", lineage=None,
+    ) -> None:
         """Persist a permanently-dropped batch's payloads + metadata to the
         DLQ. Must run BEFORE the batch's refs are released. Never raises —
-        DLQ failure degrades to the old log-only drop."""
+        DLQ failure degrades to the old log-only drop. Owner-loss drops
+        stamp ``lost_node`` and the lineage chain reconstruction gave up
+        on, so `dlq show` can separate node churn from poison batches."""
         dlq = self.dlq
         if dlq is None or not dlq.enabled:
             return
@@ -967,8 +1128,315 @@ class StreamingRunner(RunnerInterface):
             reason=reason,
             error=error,
             payload_errors=errs or None,
+            lost_node=lost_node,
+            node_deaths=batch.node_deaths,
+            lineage=lineage,
         ):
             stx.dead_lettered += 1
+
+    # -- lineage-based reconstruction ----------------------------------
+    def _adopt_renamed(self, batch: _Batch, store) -> int:
+        """Swap inputs an earlier reconstruction already regenerated: the
+        lost name retires from the ledger, the regenerated ref takes its
+        place, and the batch can dispatch without another re-run."""
+        n = 0
+        for j, r in enumerate(batch.refs):
+            new = self._renamed.pop(r.shm_name, None)
+            if new is None:
+                continue
+            store.release(r)
+            batch.refs[j] = new
+            n += 1
+        return n
+
+    def _on_lost_or_failed_inputs(
+        self, states, stx, batch: _Batch, store, reason: str
+    ) -> None:
+        """Disposition for a batch whose input fetch failed: reconstruct
+        lost inputs via lineage when possible; otherwise charge the
+        node-death budget (owner provably dead) or the generic infra
+        budget (transient fetch failure), dead-lettering with the lost
+        node + lineage chain when the budget is gone."""
+        mgr = self._remote_mgr
+        if mgr is not None and self._tracker is not None:
+            self._adopt_renamed(batch, store)
+            missing = {r.shm_name for r in batch.refs if mgr.owner_dead(r)}
+            if missing:
+                if self._schedule_reconstruction(states, batch, missing, store):
+                    logger.warning(
+                        "stage %s batch %d: reconstructing %d lost input(s) "
+                        "via lineage (%s)",
+                        stx.spec.name, batch.batch_id, len(missing), reason,
+                    )
+                    return
+                _retry_or_drop(
+                    stx, batch, store, reason,
+                    dead_letter=self._dead_letter, node_death=True,
+                    lost_node=self._lost_node(missing),
+                    lineage=self._chain_for(missing),
+                )
+                return
+        _retry_or_drop(stx, batch, store, reason, dead_letter=self._dead_letter)
+
+    def _schedule_reconstruction(
+        self, states, batch: _Batch, missing: set, store, depth: int = 0
+    ) -> bool:
+        """Re-enqueue the producing batch of every name in ``missing`` at
+        its stage (deduped per record; recursively when the producer's own
+        inputs died too, up to CURATE_RECONSTRUCT_DEPTH, charging the
+        per-run CURATE_RECONSTRUCT_BUDGET); ``batch`` parks off every
+        queue and re-enters dispatch once its inputs re-materialize.
+        Returns False when lineage/depth/budget cannot cover the loss —
+        the caller then drops the batch with the chain in its DLQ entry.
+
+        Plan-then-commit: the WHOLE transitive producer set is validated
+        (lineage present, depth, budget) before any record is marked
+        in-flight or any batch enqueued — a partial registration would
+        leave records claiming an in-flight re-run that never dispatches,
+        parking later waiters forever."""
+        tracker = self._tracker
+        if tracker is None:
+            return False
+        # plan: walk the lineage breadth-first, collecting every record
+        # that must re-run and which of ITS inputs are lost too
+        to_run: dict[int, tuple] = {}  # id(rec) -> (rec, producer_missing)
+        frontier = set(missing)
+        d = depth
+        while frontier:
+            if d > self._recon_depth:
+                return False
+            next_frontier: set = set()
+            for name in frontier:
+                rec = tracker.producer(name)
+                if rec is None:
+                    return False  # lineage gone (outputs released): no path back
+                if id(rec) in to_run or rec.inflight_batch is not None:
+                    continue  # already planned / already re-running
+                producer_missing = {
+                    r.shm_name
+                    for r in rec.input_refs
+                    if r.shm_name not in self._renamed
+                    and self._remote_mgr.owner_dead(r)
+                }
+                to_run[id(rec)] = (rec, producer_missing)
+                next_frontier |= producer_missing
+            frontier = next_frontier
+            d += 1
+        if self._recon_spent + len(to_run) > self._recon_budget:
+            logger.error(
+                "reconstruction budget exhausted (%d/%d producer re-runs): "
+                "giving up on batch %d",
+                self._recon_spent, self._recon_budget, batch.batch_id,
+            )
+            return False
+        # commit: every record gets its re-run batch; batches whose own
+        # inputs are lost park as waiters (they dispatch when the deeper
+        # regeneration swaps in), the rest enter dispatch immediately
+        self._recon_spent += len(to_run)
+        for rec, producer_missing in to_run.values():
+            self._recon_seq -= 1  # negative ids: never collide with dispatch
+            rb = _Batch(self._recon_seq, rec.stage_idx, list(rec.input_refs))
+            rec.inflight_batch = rb.batch_id
+            self._recon[rb.batch_id] = rec
+            self._recon_started[rb.batch_id] = time.monotonic()
+            self._adopt_renamed(rb, store)
+            if producer_missing:
+                self._park_waiter(rb, producer_missing)
+            else:
+                states[rec.stage_idx].retry_queue.appendleft(rb)
+        self._park_waiter(batch, missing)
+        return True
+
+    def _park_waiter(self, batch: _Batch, missing: set) -> None:
+        batch.deadline = None
+        parked = self._lost_waiters.get(batch.batch_id)
+        if parked is not None:
+            parked[2].update(missing)
+        else:
+            self._lost_waiters[batch.batch_id] = (batch.stage_idx, batch, set(missing))
+
+    def _handle_recon_result(self, states, batch: _Batch, msg, store) -> None:
+        """Settle a reconstruction re-run: regenerated outputs replace the
+        lost refs positionally (reference semantics — same items out, new
+        segment names) in every parked waiter; waiters whose missing set
+        empties re-enter dispatch. Unclaimed regenerations park in the
+        rename map (an in-flight batch dispatched before the node died
+        adopts them when its own fetch fails)."""
+        rec = self._recon.get(batch.batch_id)
+        st = states[batch.stage_idx]
+        if msg.error is not None:
+            self.metrics.observe_error(st.spec.name)
+            if self._remote_mgr is not None:
+                self._adopt_renamed(batch, store)
+                deeper = {
+                    r.shm_name for r in batch.refs if self._remote_mgr.owner_dead(r)
+                }
+                if deeper and self._schedule_reconstruction(
+                    states, batch, deeper, store
+                ):
+                    return
+            batch.attempts += 1
+            if batch.attempts < max(1, st.spec.num_run_attempts) + 1:
+                st.retry_queue.appendleft(batch)
+                return
+            self._fail_reconstruction(
+                states, rec, batch, store,
+                f"re-execution failed: {_tail(msg.error, 400)}",
+            )
+            return
+        self._recon.pop(batch.batch_id, None)
+        started = self._recon_started.pop(batch.batch_id, None)
+        dur = time.monotonic() - started if started is not None else 0.0
+        rec.inflight_batch = None
+        new_outs = list(msg.out_refs)
+        # re-record lineage FIRST, from the inputs that ACTUALLY produced
+        # these outputs (renamed adoptions included): the new record's
+        # holds must exist before any old ref releases below — retiring
+        # the old record otherwise physically deletes the held inputs,
+        # and a SECOND node loss would drop data instead of reconstructing
+        positional = new_outs[: len(rec.out_names)]
+        if positional and self._tracker is not None:
+            self._tracker.record(rec.stage_idx, list(batch.refs), positional)
+        adopted = 0
+        for i, old in enumerate(rec.out_names):
+            new_ref = new_outs[i] if i < len(new_outs) else None
+            waiter = self._waiter_for(old)
+            if waiter is not None:
+                wid, sidx, wb, miss = waiter
+                if new_ref is None:
+                    # the re-run returned fewer outputs than the original
+                    # (stage not reference-stable): this waiter is lost
+                    del self._lost_waiters[wid]
+                    self._fail_waiter(
+                        states, sidx, wb, store,
+                        f"reconstruction produced no output for {old}",
+                    )
+                    continue
+                for j, r in enumerate(wb.refs):
+                    if r.shm_name == old:
+                        store.release(r)  # retire the lost ref
+                        wb.refs[j] = new_ref
+                store.account(new_ref)
+                adopted += 1
+                miss.discard(old)
+                if not miss:
+                    del self._lost_waiters[wid]
+                    states[sidx].retry_queue.appendleft(wb)
+                continue
+            if new_ref is None:
+                continue
+            if old in rec.live:
+                # the old name is still referenced somewhere (queued input,
+                # in-flight batch): park the regeneration for adoption
+                self._renamed[old] = new_ref
+                store.account(new_ref)
+                adopted += 1
+            else:
+                # nobody references this output anymore: retire its fresh
+                # lineage entry, then free the bytes
+                if self._tracker is None or self._tracker.release(new_ref):
+                    self._free_ref(new_ref)
+        for extra in new_outs[len(rec.out_names):]:
+            self._free_ref(extra)
+        # inputs this recon batch ADOPTED from earlier reconstructions were
+        # ledger-accounted at adoption, and recon batches settle here (never
+        # through the normal completion path that releases inputs) — release
+        # them now or they pin StoreBudget.used for the rest of the run
+        for r in batch.refs:
+            if store.tracks(r):
+                store.release(r)
+        self.objects_reconstructed += adopted
+        self.reconstruction_seconds += dur
+        if adopted:
+            self.metrics.observe_reconstruction(st.spec.name, adopted, dur)
+            logger.info(
+                "reconstructed %d object(s) at stage %s in %.2fs",
+                adopted, st.spec.name, dur,
+            )
+
+    def _retry_recon_or_fail(self, states, batch: _Batch, store, reason: str) -> None:
+        """Infra-failure disposition for a reconstruction batch: requeue
+        under the node-death budget (never the DLQ — its payloads belong
+        to the waiters), cascading the give-up to every waiter."""
+        batch.node_deaths += 1
+        if batch.node_deaths <= MAX_NODE_DEATHS_PER_BATCH:
+            states[batch.stage_idx].retry_queue.appendleft(batch)
+            return
+        self._fail_reconstruction(
+            states, self._recon.get(batch.batch_id), batch, store, reason
+        )
+
+    def _waiter_for(self, name: str):
+        for wid, (sidx, wb, miss) in self._lost_waiters.items():
+            if name in miss:
+                return wid, sidx, wb, miss
+        return None
+
+    def _fail_waiter(
+        self, states, sidx: int, wb: _Batch, store, reason: str,
+        lost_node: str = "", chain=None,
+    ) -> None:
+        if wb.batch_id in self._recon:
+            # a recon batch was itself waiting on a deeper reconstruction:
+            # cascade the failure to everything waiting on ITS outputs
+            self._fail_reconstruction(states, self._recon[wb.batch_id], wb, store, reason)
+            return
+        stx = states[sidx]
+        stx.errored_batches += 1
+        logger.error(
+            "batch %d dropped: %s (%d tasks lost)",
+            wb.batch_id, reason, len(wb.refs),
+        )
+        self._dead_letter(stx, wb, reason=reason, lost_node=lost_node, lineage=chain)
+        for r in wb.refs:
+            store.release(r)
+
+    def _fail_reconstruction(self, states, rec, batch: _Batch, store, reason: str) -> None:
+        """A reconstruction re-run is permanently gone: every batch waiting
+        on this record's outputs drops to the DLQ with the lost node and
+        the lineage chain reconstruction gave up on."""
+        self._recon.pop(batch.batch_id, None)
+        self._recon_started.pop(batch.batch_id, None)
+        if rec is not None:
+            rec.inflight_batch = None
+        # adopted-then-failed recon inputs were ledger-accounted: release
+        # them here, exactly as the success path does
+        for r in batch.refs:
+            if store.tracks(r):
+                store.release(r)
+        names = set(rec.out_names) if rec is not None else set()
+        lost_node = self._lost_node(names)
+        for wid, (sidx, wb, miss) in list(self._lost_waiters.items()):
+            hit = miss & names
+            if not hit:
+                continue
+            del self._lost_waiters[wid]
+            self._fail_waiter(
+                states, sidx, wb, store,
+                f"reconstruction gave up: {reason}",
+                lost_node=lost_node, chain=self._chain_for(hit),
+            )
+
+    def _lost_node(self, names) -> str:
+        """The dead node that owned the first resolvable lost name (DLQ
+        ``lost_node`` stamp — operators distinguish 'node died past budget'
+        from 'batch is poison')."""
+        mgr = self._remote_mgr
+        if mgr is None:
+            return ""
+        for n in names:
+            node = mgr.node_of(n)
+            if node:
+                return node
+        return ""
+
+    def _chain_for(self, names) -> list | None:
+        if self._tracker is None:
+            return None
+        chain: list = []
+        for n in list(names)[:4]:  # bounded: DLQ meta, not a full dump
+            chain.extend(self._tracker.chain(n, self._stage_names))
+        return chain or None
 
     def _reap_dead_workers(self, states, batches, store) -> bool:
         progressed = False
@@ -999,11 +1467,29 @@ class StreamingRunner(RunnerInterface):
                             )
                     if w.busy_batch is not None and w.busy_batch in batches:
                         batch = batches.pop(w.busy_batch)
-                        _retry_or_drop(
-                            st, batch, store,
-                            f"worker {w.worker_id} died processing it (poison batch?)",
-                            dead_letter=self._dead_letter,
-                        )
+                        # a worker lost WITH its whole node is node churn,
+                        # charged against the separate node-death budget —
+                        # one flaky node must not exhaust the poison-batch
+                        # guard for every batch that was in flight on it
+                        node_death = agent is not None and not agent.alive
+                        if batch.batch_id in self._recon:
+                            self._retry_recon_or_fail(
+                                states, batch, store,
+                                f"worker {w.worker_id} died re-running it",
+                            )
+                        elif node_death:
+                            _retry_or_drop(
+                                st, batch, store,
+                                f"its node {agent.node_id} died mid-batch",
+                                dead_letter=self._dead_letter,
+                                node_death=True, lost_node=agent.node_id,
+                            )
+                        else:
+                            _retry_or_drop(
+                                st, batch, store,
+                                f"worker {w.worker_id} died processing it (poison batch?)",
+                                dead_letter=self._dead_letter,
+                            )
                     # replace on the dead worker's node (plan-consistent);
                     # place_for falls back to least-loaded when that whole
                     # node died with it
@@ -1096,27 +1582,38 @@ class StreamingRunner(RunnerInterface):
         return discover_tpu_chips(cfg, stage_specs)
 
 
-def _retry_or_drop(stx, batch: _Batch, store, reason: str, *, dead_letter=None) -> None:
+def _retry_or_drop(
+    stx, batch: _Batch, store, reason: str, *,
+    dead_letter=None, node_death=False, lost_node="", lineage=None,
+) -> None:
     """Infra-failure disposition shared by the localize, final-fetch and
-    reaper paths: budget the failure against the batch's worker-death cap;
-    requeue under budget, else drop LOUDLY — persisting the batch to the
-    dead-letter queue first (``dead_letter`` is the runner's recorder) so
-    the drop is recoverable, then release the refs."""
-    batch.worker_deaths += 1
-    if batch.worker_deaths <= MAX_WORKER_DEATHS_PER_BATCH:
+    reaper paths: budget the failure against the batch's worker-death cap —
+    or, with ``node_death=True``, the SEPARATE node-death cap, so one flaky
+    node can't exhaust the poison-batch guard; requeue under budget, else
+    drop LOUDLY — persisting the batch to the dead-letter queue first
+    (``dead_letter`` is the runner's recorder; ``lost_node``/``lineage``
+    stamp owner-loss drops so operators can tell 'node died past budget'
+    from 'batch is poison'), then release the refs."""
+    if node_death:
+        batch.node_deaths += 1
+        count, cap, kind = batch.node_deaths, MAX_NODE_DEATHS_PER_BATCH, "node deaths"
+    else:
+        batch.worker_deaths += 1
+        count, cap, kind = batch.worker_deaths, MAX_WORKER_DEATHS_PER_BATCH, "infra failures"
+    if count <= cap:
         logger.warning(
-            "batch %d: %s; re-running (%d/%d infra failures)",
-            batch.batch_id, reason, batch.worker_deaths, MAX_WORKER_DEATHS_PER_BATCH,
+            "batch %d: %s; re-running (%d/%d %s)",
+            batch.batch_id, reason, count, cap, kind,
         )
         stx.retry_queue.append(batch)
         return
     logger.error(
-        "batch %d dropped after %d infra failures (%s): %d tasks lost",
-        batch.batch_id, batch.worker_deaths, reason, len(batch.refs),
+        "batch %d dropped after %d %s (%s): %d tasks lost",
+        batch.batch_id, count, kind, reason, len(batch.refs),
     )
     stx.errored_batches += 1
     if dead_letter is not None:
-        dead_letter(stx, batch, reason=reason)
+        dead_letter(stx, batch, reason=reason, lost_node=lost_node, lineage=lineage)
     for r in batch.refs:
         store.release(r)
 

@@ -63,6 +63,11 @@ class ResultMsg:
     process_time_s: float = 0.0
     deserialize_time_s: float = 0.0
     worker_id: str = ""
+    # the error is a lost-input condition (object-channel fetch from a dead
+    # owner), not user code failing — the runner routes it to lineage
+    # reconstruction instead of the num_run_attempts budget. Only the
+    # remote path (remote_plane.AgentResult relay) ever sets it.
+    input_loss: bool = False
 
 
 @dataclass
@@ -120,11 +125,19 @@ def worker_main(in_q, out_q, env: dict[str, str]) -> None:
         max_workers=n_fetch, thread_name_prefix=f"{worker_id}-fetch"
     )
 
+    parent_pid = os.getppid()
+
     def fetcher() -> None:
         while not stop.is_set():
             try:
                 msg = in_q.get(timeout=0.2)
             except queue.Empty:
+                if os.getppid() != parent_pid:
+                    # orphaned: the coordinator (driver or node agent) died
+                    # without cleanup — a SIGKILLed node's workers must not
+                    # idle forever as leaked processes
+                    fetched.put((ShutdownMsg(), None, None, 0.0))
+                    return
                 continue
             if isinstance(msg, ShutdownMsg):
                 fetched.put((msg, None, None, 0.0))  # type: ignore[arg-type]

@@ -252,6 +252,19 @@ def runner_stats(runner: Any) -> dict:
     node_plan = getattr(runner, "node_plan", None)
     if node_plan:
         stats["node_plan"] = node_plan
+    # node-loss receipts: declared deaths + what lineage reconstruction
+    # recomputed (engine/runner.py) — the robustness counterpart of the
+    # object_plane section
+    node_events = getattr(runner, "node_events", None)
+    reconstructed = int(getattr(runner, "objects_reconstructed", 0) or 0)
+    if node_events or reconstructed:
+        stats["node_events"] = {
+            "deaths": list(node_events or []),
+            "objects_reconstructed": reconstructed,
+            "reconstruction_seconds": round(
+                float(getattr(runner, "reconstruction_seconds", 0.0) or 0.0), 4
+            ),
+        }
     wall = getattr(runner, "pipeline_wall_s", 0.0)
     if wall:
         stats["wall_s"] = round(float(wall), 4)
@@ -348,6 +361,21 @@ def load_node_stats(output_path: str) -> dict | None:
                 if isinstance(v, (int, float)):
                     into[k] = into.get(k, 0) + v
         merged["dead_lettered"] += int(stats.get("dead_lettered", 0) or 0)
+        # node-loss receipts concatenate (deaths) / sum (reconstruction):
+        # every rank's driver sees only the agents IT lost
+        ne = stats.get("node_events")
+        if ne:
+            into = merged.setdefault(
+                "node_events",
+                {"deaths": [], "objects_reconstructed": 0, "reconstruction_seconds": 0.0},
+            )
+            into["deaths"].extend(ne.get("deaths") or [])
+            into["objects_reconstructed"] += int(ne.get("objects_reconstructed", 0) or 0)
+            into["reconstruction_seconds"] = round(
+                into["reconstruction_seconds"]
+                + float(ne.get("reconstruction_seconds", 0.0) or 0.0),
+                4,
+            )
         if stats.get("dlq_run_dir"):
             dlq_dirs.append(stats["dlq_run_dir"])
         if stats.get("wall_s"):
@@ -405,6 +433,8 @@ def build_run_report(
     report["object_plane"] = stats["object_plane"]
     if stats.get("node_plan"):
         report["node_plan"] = stats["node_plan"]
+    if stats.get("node_events"):
+        report["node_events"] = stats["node_events"]
     # precedence: live runner accounting > prior/sidecar accounting (it
     # includes setup time spans don't book to the stage) > span-derived
     report["stage_times"] = (
@@ -428,8 +458,8 @@ def build_run_report(
         # fallbacks that would always win this not-set check)
         for key in (
             "dispatch", "stage_flow", "caption_phases", "index_ops",
-            "object_plane", "node_plan", "stage_counts", "dead_lettered",
-            "dlq_run_dir",
+            "object_plane", "node_plan", "node_events", "stage_counts",
+            "dead_lettered", "dlq_run_dir",
         ):
             if not report.get(key) and prior.get(key):
                 report[key] = prior[key]
@@ -546,6 +576,19 @@ def render_report(report: dict) -> str:
                 f"{nid or 'driver'}={n}" for nid, n in sorted(counts.items())
             )
             lines.append(f"  {stage:<40} {placed}")
+    events = report.get("node_events") or {}
+    if events:
+        deaths = events.get("deaths") or []
+        lines.append(
+            f"node events: {len(deaths)} death(s), "
+            f"{events.get('objects_reconstructed', 0)} object(s) reconstructed "
+            f"in {events.get('reconstruction_seconds', 0.0):.2f}s"
+        )
+        for ev in deaths:
+            lines.append(
+                f"  {ev.get('node', '?'):<24} {ev.get('reason', '?')} "
+                f"({ev.get('workers_lost', 0)} worker(s) lost)"
+            )
     index_ops = report.get("index_ops") or {}
     if index_ops:
         lines.append("corpus index:")

@@ -43,8 +43,8 @@ def video_record_id(path: str) -> str:
     return hashlib.sha256(path.encode()).hexdigest()[:24]
 
 
-def _clip_meta(clip: Clip) -> dict:
-    return {
+def _clip_meta(clip: Clip, provenance: dict | None = None) -> dict:
+    meta = {
         "uuid": str(clip.uuid),
         "source_video": clip.source_video,
         "span_start": clip.span[0],
@@ -73,6 +73,14 @@ def _clip_meta(clip: Clip) -> dict:
         ],
         "errors": clip.errors,
     }
+    if provenance:
+        # per-model weights provenance (models/registry.weights_provenance):
+        # "checkpoint:<sha256-12>" or "random" — noise is traceable on every
+        # clip record, not just refused at the corpus index
+        meta["weights_provenance"] = {
+            m: provenance[m] for m in sorted(clip.embeddings) if m in provenance
+        }
+    return meta
 
 
 class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
@@ -90,6 +98,10 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
         # corpus-index root for in-pipeline fragment appends ("" disables)
         self.index_path = index_path.rstrip("/")
         self._warned_random_models: set[str] = set()
+        # model -> weights_provenance, memoized per stage instance: the
+        # registry hashes a checkpoint once per (path, mtime) but still
+        # stats the filesystem per call — not a per-clip cost
+        self._provenance_memo: dict[str, str] = {}
         # one IndexStore for the run: construction reads meta.json to pin
         # the backend, which against remote storage is 1-2 round-trips —
         # not a per-chunk cost (benign race: duplicate instances agree)
@@ -120,7 +132,10 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             for clip in video.filtered_clips:
                 stats.num_clips += 1
                 self._count_filtered(clip, stats)
-                write_json(f"{self.output_path}/metas/filtered/{clip.uuid}.json", _clip_meta(clip))
+                write_json(
+                    f"{self.output_path}/metas/filtered/{clip.uuid}.json",
+                    _clip_meta(clip, self._model_provenance(clip)),
+                )
             if self.write_embeddings:
                 chunk_tag = f"{video_record_id(video.path)}-{video.clip_chunk_index:05d}"
                 for model, rows in embedding_rows.items():
@@ -146,8 +161,29 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
                     w.release_payloads()
                     w.t5_embedding = None  # persisted above
             task.stage_perf["clips_written"] = stats.num_clips
+            if self._provenance_memo:
+                # rides the task back to run_split: build_summary unions
+                # these into summary.json's weights_provenance map
+                task.stage_perf["weights_provenance"] = dict(self._provenance_memo)
             task.stats = stats
         return tasks
+
+    def _model_provenance(self, clip: Clip) -> dict:
+        """Weights provenance per embedding model on ``clip``, memoized —
+        stamped into every clip meta (and, via stage_perf, summary.json) so
+        a random-weights run is traceable end-to-end, not just refused at
+        the corpus index (ROADMAP item 3b)."""
+        from cosmos_curate_tpu.models.registry import weights_provenance
+
+        out: dict[str, str] = {}
+        for model in clip.embeddings:
+            if model not in self._provenance_memo:
+                try:
+                    self._provenance_memo[model] = weights_provenance(model)
+                except Exception:  # provenance must never fail a write
+                    self._provenance_memo[model] = "unknown"
+            out[model] = self._provenance_memo[model]
+        return out
 
     def _write_index_fragment(
         self, chunk_tag: str, model: str, rows: list, task: SplitPipeTask
@@ -253,7 +289,10 @@ class ClipWriterStage(Stage[SplitPipeTask, SplitPipeTask]):
             sink = io_mod.BytesIO()
             np_mod.savez(sink, **t5)
             write_bytes(f"{self.output_path}/t5_embeddings/{clip.uuid}.npz", sink.getvalue())
-        write_json(f"{self.output_path}/metas/v0/{clip.uuid}.json", _clip_meta(clip))
+        write_json(
+            f"{self.output_path}/metas/v0/{clip.uuid}.json",
+            _clip_meta(clip, self._model_provenance(clip)),
+        )
 
     @staticmethod
     def _count_filtered(clip: Clip, stats: ClipStats) -> None:

@@ -28,9 +28,13 @@ def build_summary(
     total_video_duration_s = 0.0
     num_errors = 0
     videos: set[str] = set()
+    provenance: dict[str, str] = {}
     for t in tasks:
         if t.stats is not None:
             stats.combine(t.stats)
+        # per-model weights provenance stamped by the writer: noise is
+        # traceable at the run level, not just per clip meta (ROADMAP 3b)
+        provenance.update(getattr(t, "stage_perf", {}).get("weights_provenance") or {})
         if t.video.path not in videos:
             videos.add(t.video.path)
             total_video_duration_s += t.video.metadata.duration_s
@@ -52,6 +56,8 @@ def build_summary(
         "num_errors": num_errors,
         **asdict(stats),
     }
+    if provenance:
+        summary["weights_provenance"] = provenance
     if extra:
         summary.update(extra)
     return summary
@@ -107,6 +113,8 @@ def merge_node_summaries(output_path: str) -> dict | None:
         for k in _ADDITIVE:
             if k in s:
                 merged[k] = merged.get(k, 0) + s[k]
+        if s.get("weights_provenance"):
+            merged.setdefault("weights_provenance", {}).update(s["weights_provenance"])
         merged["pipeline_run_time_s"] = max(
             merged.get("pipeline_run_time_s", 0.0), s.get("pipeline_run_time_s", 0.0)
         )

@@ -4,9 +4,11 @@
 # 8-device multichip dry-run, and (4) the static-analysis gate
 # (curate-lint + shardcheck + tracing/caption smokes), plus (5) the
 # corpus-index build/add/query smoke, plus (6) the durable-service gate
-# (crash-safe queue + kill -9 resume soak). Individual gates can be
-# skipped via CI_SKIP=tier1,bench,multichip,index,service,static for
-# local use.
+# (crash-safe queue + kill -9 resume soak), plus (7) the node-loss gate
+# (failure detector + lineage reconstruction units; the agent-killing e2e
+# + soak run nightly). Individual gates can be skipped via
+# CI_SKIP=tier1,bench,multichip,index,service,nodeloss,static for local
+# use.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -68,6 +70,16 @@ if ! skip service; then
   echo "== durable-service checks (crash-safe queue, kill -9 resume soak) =="
   if ! bash scripts/run_service_checks.sh; then
     failures+=("service checks")
+  fi
+fi
+
+if ! skip nodeloss; then
+  echo "== node-loss checks (failure detector + lineage reconstruction units) =="
+  # the fast half of scripts/run_nodeloss_checks.sh; the agent-killing
+  # e2e suite + loopback soak run on the nightly schedule
+  if ! JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest \
+      tests/engine/test_node_loss.py -q -p no:randomly -m 'not slow'; then
+    failures+=("node-loss units")
   fi
 fi
 

@@ -1,0 +1,657 @@
+"""Node-loss fault tolerance (ISSUE 13): failure detector deadlines,
+heartbeat-rejoin without budget double-count, lineage-based reconstruction
+(depth > 1), reconstruction-budget exhaustion → DLQ with ``lost_node``,
+and partition-then-heal.
+
+Fast units exercise the detector and the runner's reconstruction machinery
+with fabricated links/records (no subprocesses); the ``slow`` e2e tests
+spawn real loopback agents and kill/partition one mid-run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from collections import deque
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.engine import object_store
+from cosmos_curate_tpu.engine.lineage import LineageTracker
+from cosmos_curate_tpu.engine.object_store import ObjectRef, StoreBudget
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ref(name: str, size: int = 64) -> ObjectRef:
+    return ObjectRef(name, size, 0)
+
+
+# ---------------------------------------------------------------------------
+class TestLineageTracker:
+    def test_held_inputs_defer_physical_delete(self):
+        deleted: list[str] = []
+        t = LineageTracker(lambda r: deleted.append(r.shm_name))
+        seed, out = _ref("cur1-seed"), _ref("cur1-out")
+        t.record(0, [seed], [out])
+        # the producing batch's input releases at completion: the physical
+        # delete must DEFER while the output is live
+        t(seed)
+        assert deleted == []
+        assert t.is_held("cur1-seed")
+        # releasing the (only) output settles the record and flushes the
+        # deferred input delete (cascade runs before the output's own
+        # delete returns to the caller)
+        t(out)
+        assert sorted(deleted) == ["cur1-out", "cur1-seed"]
+        assert t.producer("cur1-out") is None
+        assert not t.is_held("cur1-seed")
+
+    def test_multiple_outputs_hold_until_last_release(self):
+        deleted: list[str] = []
+        t = LineageTracker(lambda r: deleted.append(r.shm_name))
+        seed = _ref("cur1-s")
+        o1, o2 = _ref("cur1-o1"), _ref("cur1-o2")
+        t.record(0, [seed], [o1, o2])
+        t(seed)
+        t(o1)
+        assert "cur1-s" not in deleted  # o2 still live
+        t(o2)
+        assert "cur1-s" in deleted
+
+    def test_chain_walks_producers(self):
+        t = LineageTracker(lambda r: None)
+        seed, mid, out = _ref("cur1-seed"), _ref("cur1-mid"), _ref("cur1-out")
+        t.record(0, [seed], [mid])
+        t.record(1, [mid], [out])
+        chain = t.chain("cur1-out", ["StageA", "StageB"])
+        assert [h["produced_by_stage"] for h in chain] == ["StageB", "StageA"]
+        assert chain[0]["inputs"] == ["cur1-mid"]
+
+    def test_drain_flushes_deferred(self):
+        deleted: list[str] = []
+        t = LineageTracker(lambda r: deleted.append(r.shm_name))
+        seed, out = _ref("cur1-seed"), _ref("cur1-out")
+        t.record(0, [seed], [out])
+        t(seed)  # deferred
+        assert t.drain() == 1
+        assert deleted == ["cur1-seed"]
+
+
+# ---------------------------------------------------------------------------
+class TestFailureDetector:
+    def _mgr(self, monkeypatch, hb="0.2", misses="2"):
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import RemoteWorkerManager
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "t")
+        monkeypatch.setenv("CURATE_AGENT_HEARTBEAT_S", hb)
+        monkeypatch.setenv("CURATE_AGENT_HEARTBEAT_MISSES", misses)
+        return RemoteWorkerManager(_free_port(), queue.Queue(), local_cpu_budget=1.0)
+
+    def test_heartbeat_deadline_declares_death(self, monkeypatch):
+        from cosmos_curate_tpu.engine.remote_plane import AgentLink, _RemoteProc
+
+        mgr = self._mgr(monkeypatch)
+        try:
+            link = AgentLink("n1", 4.0, sock=None, token=b"t")
+            link.worker_costs["w1"] = 1.0
+            mgr.agents.append(link)
+            proc = _RemoteProc(link, "w1")
+            assert mgr.poll_node_deaths() == []  # fresh heartbeat: alive
+            link.last_seen = time.monotonic() - 5.0  # silent past the deadline
+            events = mgr.poll_node_deaths()
+            assert len(events) == 1 and events[0]["node"] == "n1"
+            assert "heartbeat" in events[0]["reason"]
+            assert events[0]["workers_lost"] == 1
+            # quarantine: in-flight SubmitBatches fail through the reap seam
+            assert not link.alive and not proc.is_alive()
+            # ONE event per link, however often the sweep runs
+            assert mgr.poll_node_deaths() == []
+            # capacity leaves the plan (no double-counted NodeBudget)
+            assert mgr.node_budgets() == []
+        finally:
+            mgr.shutdown()
+
+    def test_link_loss_records_single_event(self, monkeypatch):
+        from cosmos_curate_tpu.engine.remote_plane import AgentLink
+
+        mgr = self._mgr(monkeypatch, hb="0")  # deadline disabled
+        try:
+            link = AgentLink("n2", 2.0, sock=None, token=b"t")
+            mgr.agents.append(link)
+            link.alive = False  # a send path noticed the drop
+            events = mgr.poll_node_deaths()
+            assert len(events) == 1 and events[0]["reason"] == "link lost"
+            assert mgr.poll_node_deaths() == []
+        finally:
+            mgr.shutdown()
+
+    def test_owner_dead_and_node_of(self, monkeypatch):
+        from cosmos_curate_tpu.engine.remote_plane import AgentLink
+
+        mgr = self._mgr(monkeypatch)
+        try:
+            link = AgentLink("n3", 2.0, sock=None, token=b"t")
+            mgr.agents.append(link)
+            mgr._locations["cur1-abc"] = link
+            ref = _ref("cur1-abc")
+            assert not mgr.owner_dead(ref)
+            mgr.note_agent_dead(link, reason="test")
+            assert mgr.owner_dead(ref)
+            assert mgr.node_of("cur1-abc") == "n3"
+            assert mgr.node_of("cur1-unknown") == ""
+        finally:
+            mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+class TestHelloRejoin:
+    def _join(self, port: int, node_id: str, pid: int):
+        from cosmos_curate_tpu.engine.remote_plane import Hello, connect_channel
+
+        sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+        chan, ack = connect_channel(
+            sock, b"rejoin-secret", Hello(node_id, 2.0, pid=pid)
+        )
+        return sock, chan
+
+    def test_bounced_agent_supersedes_without_double_budget(self, monkeypatch):
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import RemoteWorkerManager
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "rejoin-secret")
+        port = _free_port()
+        mgr = RemoteWorkerManager(port, queue.Queue(), local_cpu_budget=1.0)
+        socks = []
+        try:
+            s1, _ = self._join(port, "n1", pid=111)
+            socks.append(s1)
+            time.sleep(0.2)
+            old = next(a for a in mgr.agents if a.node_id == "n1")
+            mgr._locations["cur1-seg"] = old
+            # the agent BOUNCES (new pid) before the driver notices
+            s2, _ = self._join(port, "n1", pid=222)
+            socks.append(s2)
+            time.sleep(0.3)
+            live = [a for a in mgr.agents if a.node_id == "n1"]
+            assert len(live) == 1 and live[0].alive and live[0].pid == 222
+            # exactly ONE NodeBudget — no double count
+            assert [b[0] for b in mgr.node_budgets()] == ["n1"]
+            # the old link died (one recorded event), and its segments did
+            # NOT re-point: the bounced process reclaimed them, so the
+            # owner reads dead and consumers reconstruct
+            assert old.death_recorded and not old.alive
+            assert mgr.owner_dead(_ref("cur1-seg"))
+            assert len([e for e in mgr.poll_node_deaths() if e["node"] == "n1"]) == 1
+        finally:
+            for s in socks:
+                s.close()
+            mgr.shutdown()
+
+    def test_same_process_rejoin_repoints_segments(self, monkeypatch):
+        import queue
+
+        from cosmos_curate_tpu.engine.remote_plane import RemoteWorkerManager
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "rejoin-secret")
+        port = _free_port()
+        mgr = RemoteWorkerManager(port, queue.Queue(), local_cpu_budget=1.0)
+        socks = []
+        try:
+            s1, _ = self._join(port, "n1", pid=333)
+            socks.append(s1)
+            time.sleep(0.2)
+            old = next(a for a in mgr.agents if a.node_id == "n1")
+            mgr._locations["cur1-keep"] = old
+            # link blip: SAME process dials again — segments survived
+            s2, _ = self._join(port, "n1", pid=333)
+            socks.append(s2)
+            time.sleep(0.3)
+            live = [a for a in mgr.agents if a.node_id == "n1"]
+            assert len(live) == 1 and live[0].alive
+            assert not mgr.owner_dead(_ref("cur1-keep"))
+            assert mgr._locations["cur1-keep"] is live[0]
+        finally:
+            for s in socks:
+                s.close()
+            mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+class _FakeSpec:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.num_run_attempts = 1
+        self.batch_timeout_s = None
+
+
+class _FakeState:
+    def __init__(self, name: str) -> None:
+        self.spec = _FakeSpec(name)
+        self.retry_queue = deque()
+        self.errored_batches = 0
+        self.dead_lettered = 0
+
+
+class _FakeMgr:
+    """Stands in for RemoteWorkerManager in runner-level units: ownership
+    is a name->node map, death is a set of node ids."""
+
+    def __init__(self) -> None:
+        self.locations: dict[str, str] = {}
+        self.dead: set[str] = set()
+        self.released: list[str] = []
+
+    def owner_dead(self, ref) -> bool:
+        node = self.locations.get(ref.shm_name)
+        return node is not None and node in self.dead
+
+    def node_of(self, name: str) -> str:
+        return self.locations.get(name, "")
+
+    def owner_node(self, ref) -> str:
+        return self.locations.get(ref.shm_name, "")
+
+    def release_data(self, ref) -> None:
+        self.released.append(ref.shm_name)
+
+    def fetch_value_if_remote(self, ref):
+        return f"task:{ref.shm_name}"
+
+
+def _recon_runner(tmp_path=None):
+    from cosmos_curate_tpu.engine.runner import StreamingRunner
+
+    runner = StreamingRunner()
+    mgr = _FakeMgr()
+    runner._remote_mgr = mgr
+    runner._tracker = LineageTracker(mgr.release_data)
+    runner._recon_depth = 4
+    runner._recon_budget = 16
+    runner._stage_names = ["StageA", "StageB", "StageC"]
+    states = [_FakeState(n) for n in runner._stage_names]
+    store = StoreBudget(capacity_bytes=1 << 20, deleter=runner._tracker)
+    return runner, mgr, states, store
+
+
+class TestReconstruction:
+    def test_depth_two_reenqueue_and_adoption(self):
+        """B's output is lost AND B's own input died with the same node:
+        reconstruction walks two generations (re-run A, then B), swapping
+        regenerated refs into each waiter positionally."""
+        from cosmos_curate_tpu.engine.runner import _Batch
+        from cosmos_curate_tpu.engine.worker import ResultMsg
+
+        runner, mgr, states, store = _recon_runner()
+        seed = _ref("cur1-seed")
+        a_out = _ref("cur1-aout")
+        b_out = _ref("cur1-bout")
+        mgr.locations.update({"cur1-aout": "nodeB", "cur1-bout": "nodeB"})
+        # history: stage0 [seed]->[a_out], stage1 [a_out]->[b_out]
+        runner._tracker.record(0, [seed], [a_out])
+        store.account(a_out)
+        runner._tracker.record(1, [a_out], [b_out])
+        store.release(a_out)  # consumer (stage1 batch) finished
+        store.account(b_out)
+        # downstream batch holds b_out when nodeB dies
+        mgr.dead.add("nodeB")
+        waiter = _Batch(7, 2, [b_out])
+        runner._on_lost_or_failed_inputs(
+            states, states[2], waiter, store, "fetch failed: owner dead"
+        )
+        # depth-2: ONLY the stage0 recon batch is dispatchable (its seed is
+        # driver-owned); the stage1 recon batch parks on a_out
+        assert len(states[0].retry_queue) == 1
+        assert len(states[1].retry_queue) == 0
+        rb0 = states[0].retry_queue.popleft()
+        assert [r.shm_name for r in rb0.refs] == ["cur1-seed"]
+        assert rb0.batch_id < 0  # recon ids never collide with dispatch ids
+        assert len(runner._lost_waiters) == 2  # waiter + stage1 recon batch
+
+        # stage0 re-runs -> regenerated a_out swaps into the stage1 recon
+        # batch, which becomes dispatchable
+        new_a = _ref("cur1-newa")
+        runner._handle_recon_result(
+            states, rb0, ResultMsg(rb0.batch_id, out_refs=[new_a]), store
+        )
+        assert len(states[1].retry_queue) == 1
+        rb1 = states[1].retry_queue.popleft()
+        assert [r.shm_name for r in rb1.refs] == ["cur1-newa"]
+        # stage1 re-runs -> regenerated b_out swaps into the original waiter
+        new_b = _ref("cur1-newb")
+        runner._handle_recon_result(
+            states, rb1, ResultMsg(rb1.batch_id, out_refs=[new_b]), store
+        )
+        assert len(states[2].retry_queue) == 1
+        back = states[2].retry_queue.popleft()
+        assert back is waiter and [r.shm_name for r in back.refs] == ["cur1-newb"]
+        assert not runner._lost_waiters
+        assert runner.objects_reconstructed == 2
+        # regenerated outputs are re-derivable again (second node loss),
+        # from the inputs that ACTUALLY produced them
+        new_rec = runner._tracker.producer("cur1-newb")
+        assert new_rec is not None
+        assert [r.shm_name for r in new_rec.input_refs] == ["cur1-newa"]
+        # ledger hygiene: the adopted intermediate released at recon settle
+        # (recon batches never pass the normal completion path), while the
+        # waiter's adopted input stays accounted until IT completes
+        assert not store.tracks(new_a)
+        assert store.tracks(new_b)
+
+    def test_failed_scheduling_rolls_back_cleanly(self):
+        """Plan-then-commit: when the transitive producer walk fails (deep
+        lineage expired), NOTHING is registered — no record left claiming
+        an in-flight re-run, no parked waiter, no spent budget — so the
+        batch can retry or drop instead of wedging the run."""
+        from cosmos_curate_tpu.engine.runner import _Batch
+
+        runner, mgr, states, store = _recon_runner()
+        a_out, b_out = _ref("cur1-aout"), _ref("cur1-bout")
+        mgr.locations.update({"cur1-aout": "nodeB", "cur1-bout": "nodeB"})
+        # b_out's producer is known, but ITS input a_out has NO lineage
+        # (its record already expired) — depth-2 walk must fail whole
+        runner._tracker.record(1, [a_out], [b_out])
+        store.account(b_out)
+        mgr.dead.add("nodeB")
+        batch = _Batch(11, 2, [b_out])
+        assert not runner._schedule_reconstruction(
+            states, batch, {"cur1-bout"}, store
+        )
+        assert not runner._recon and not runner._lost_waiters
+        assert runner._recon_spent == 0
+        rec = runner._tracker.producer("cur1-bout")
+        assert rec is not None and rec.inflight_batch is None
+        assert all(not st.retry_queue for st in states)
+
+    def test_unclaimed_regeneration_parks_for_adoption(self):
+        """A regenerated output nobody was waiting for (its consumer was
+        in flight when the node died) parks in the rename map and swaps in
+        when that consumer fails."""
+        from cosmos_curate_tpu.engine.runner import _Batch
+        from cosmos_curate_tpu.engine.worker import ResultMsg
+
+        runner, mgr, states, store = _recon_runner()
+        seed, o1, o2 = _ref("cur1-seed"), _ref("cur1-o1"), _ref("cur1-o2")
+        mgr.locations.update({"cur1-o1": "nodeB", "cur1-o2": "nodeB"})
+        runner._tracker.record(0, [seed], [o1, o2])
+        store.account(o1)
+        store.account(o2)
+        mgr.dead.add("nodeB")
+        # only o1's holder failed so far; o2's is still in flight
+        w1 = _Batch(3, 1, [o1])
+        runner._on_lost_or_failed_inputs(states, states[1], w1, store, "lost")
+        rb = states[0].retry_queue.popleft()
+        n1, n2 = _ref("cur1-n1"), _ref("cur1-n2")
+        runner._handle_recon_result(
+            states, rb, ResultMsg(rb.batch_id, out_refs=[n1, n2]), store
+        )
+        assert "cur1-o2" in runner._renamed  # parked for the in-flight holder
+        w2 = _Batch(4, 1, [o2])
+        assert runner._adopt_renamed(w2, store) == 1
+        assert [r.shm_name for r in w2.refs] == ["cur1-n2"]
+        assert not runner._renamed
+
+    def test_budget_exhaustion_dead_letters_with_lost_node(self, tmp_path, monkeypatch):
+        """Past CURATE_RECONSTRUCT_BUDGET the batch drops through the
+        node-death budget into the DLQ, stamped with the lost node and the
+        lineage chain reconstruction gave up on."""
+        from cosmos_curate_tpu.engine.dead_letter import DeadLetterQueue, list_entries
+        from cosmos_curate_tpu.engine.runner import (
+            MAX_NODE_DEATHS_PER_BATCH,
+            _Batch,
+        )
+
+        runner, mgr, states, store = _recon_runner()
+        runner._recon_budget = 0  # nothing may reconstruct
+        runner.dlq = DeadLetterQueue(str(tmp_path))
+        seed, out = _ref("cur1-seed"), _ref("cur1-lost")
+        mgr.locations["cur1-lost"] = "nodeB"
+        runner._tracker.record(0, [seed], [out])
+        store.account(out)
+        mgr.dead.add("nodeB")
+        batch = _Batch(9, 1, [out])
+        for _ in range(MAX_NODE_DEATHS_PER_BATCH + 1):
+            runner._on_lost_or_failed_inputs(
+                states, states[1], batch, store, "owner dead"
+            )
+            if states[1].retry_queue:
+                assert states[1].retry_queue.popleft() is batch
+        assert batch.node_deaths == MAX_NODE_DEATHS_PER_BATCH + 1
+        assert states[1].errored_batches == 1
+        entries = list_entries(str(tmp_path))
+        assert len(entries) == 1
+        meta = entries[0].meta
+        assert meta["lost_node"] == "nodeB"
+        assert meta["node_deaths"] == MAX_NODE_DEATHS_PER_BATCH + 1
+        assert meta["lineage"][0]["produced_by_stage"] == "StageA"
+
+    def test_dlq_cli_renders_lost_node(self, tmp_path, capsys, monkeypatch):
+        import argparse
+
+        from cosmos_curate_tpu.cli.dlq_cli import _cmd_list, _cmd_show
+        from cosmos_curate_tpu.engine.dead_letter import DeadLetterQueue
+
+        dlq = DeadLetterQueue(str(tmp_path))
+        entry = dlq.record(
+            stage_name="StageB", batch_id=5, tasks=["t"], attempts=0,
+            worker_deaths=0, reason="node died past budget",
+            lost_node="node-b", node_deaths=4,
+            lineage=[{"ref": "cur1-x", "produced_by_stage": "StageA", "inputs": []}],
+        )
+        assert entry is not None
+        _cmd_list(argparse.Namespace(dlq_dir=str(tmp_path), run_id=None, as_json=False))
+        out = capsys.readouterr().out
+        assert "lost_node=node-b" in out
+        _cmd_show(argparse.Namespace(entry=entry.name, dlq_dir=str(tmp_path)))
+        out = capsys.readouterr().out
+        assert "lineage chain" in out and "StageA" in out
+
+
+# ---------------------------------------------------------------------------
+class TestAgentHeartbeat:
+    def test_empty_delta_still_sends_heartbeat_frame(self, monkeypatch):
+        from cosmos_curate_tpu.engine.remote_agent import NodeAgent
+        from cosmos_curate_tpu.engine.remote_plane import AgentStats
+        from cosmos_curate_tpu.observability.stage_timer import reset_object_plane
+
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "t")
+        # earlier tests in the process may have recorded object-plane
+        # traffic; the first flush deltas against zero, so reset first
+        reset_object_plane()
+        agent = NodeAgent("127.0.0.1:1", node_id="hb-test", num_cpus=1.0)
+        try:
+            sent: list = []
+            agent.chan = type("Chan", (), {"send": lambda _self, m: sent.append(m)})()
+            agent._flush_op_stats(min_interval_s=0.0, heartbeat=True)
+            assert len(sent) == 1 and isinstance(sent[0], AgentStats)
+            assert sent[0].object_plane == {}  # idle agent: empty delta, real frame
+            # a non-heartbeat flush with nothing to say stays silent
+            agent._flush_op_stats(min_interval_s=0.0)
+            assert len(sent) == 1
+        finally:
+            agent.object_server.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: real loopback agents, one killed / partitioned mid-run
+
+
+def _spawn_agent(port: int, node_id: str, cpus: float, extra_env: dict | None = None):
+    env = {
+        **os.environ,
+        "CURATE_ENGINE_TOKEN": "nodeloss-secret",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(Path(__file__).resolve().parents[2]),
+        **(extra_env or {}),
+    }
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "cosmos_curate_tpu.engine.remote_agent",
+            "--driver", f"127.0.0.1:{port}",
+            "--node-id", node_id,
+            "--num-cpus", str(cpus),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+class TestNodeLossE2E:
+    def _base_env(self, monkeypatch, port: int, wait_nodes: int) -> None:
+        monkeypatch.setenv("CURATE_ENGINE_TOKEN", "nodeloss-secret")
+        monkeypatch.setenv("CURATE_ENGINE_DRIVER_PORT", str(port))
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_NODES", str(wait_nodes))
+        monkeypatch.setenv("CURATE_ENGINE_WAIT_S", "60")
+        monkeypatch.setenv("CURATE_PREWARM", "0")
+        monkeypatch.setenv("CURATE_AGENT_HEARTBEAT_S", "0.5")
+        monkeypatch.setenv("CURATE_AGENT_HEARTBEAT_MISSES", "3")
+
+    def test_agent_kill_midrun_reconstructs(self, monkeypatch, tmp_path):
+        """One of two agents SIGKILLs itself right after relaying its first
+        result (the most hostile instant: the driver already references its
+        outputs). The run must complete with exactly-once results, > 0
+        objects reconstructed, and ZERO dead-letters."""
+        from cosmos_curate_tpu import chaos
+        from cosmos_curate_tpu.core.pipeline import (
+            PipelineConfig,
+            PipelineSpec,
+            StreamingSpec,
+        )
+        from cosmos_curate_tpu.core.stage import StageSpec
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+        from tests.engine.test_cross_host_routing import _StageA, _StageB, _HopTask
+
+        port = _free_port()
+        self._base_env(monkeypatch, port, wait_nodes=2)
+        monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+        # arm agent.kill ONLY in the doomed agent (worker_re keys on the
+        # CURATE_WORKER_ID stamped into its environment)
+        plan = chaos.FaultPlan(
+            rules=(
+                chaos.FaultRule(
+                    site=chaos.SITE_AGENT_KILL, kind="crash", count=1,
+                    worker_re="^doomed-agent$",
+                ),
+            ),
+            seed=13,
+        ).to_json()
+        doomed = _spawn_agent(
+            port, "doomed", 3.0,
+            {"CURATE_CHAOS": plan, "CURATE_WORKER_ID": "doomed-agent"},
+        )
+        survivor = _spawn_agent(port, "survivor", 3.0)
+        try:
+            runner = StreamingRunner(poll_interval_s=0.01)
+            n_tasks = 48
+            spec = PipelineSpec(
+                input_data=[_HopTask(i) for i in range(n_tasks)],
+                stages=[
+                    StageSpec(_StageA(), num_workers=2),
+                    StageSpec(_StageB(), num_workers=2),
+                ],
+                config=PipelineConfig(
+                    num_cpus=0.1,  # CPU stages must live on the agents
+                    return_last_stage_outputs=True,
+                    streaming=StreamingSpec(autoscale_interval_s=0.5),
+                ),
+            )
+            out = runner.run(spec)
+            assert out is not None and len(out) == n_tasks
+            # exactly-once results despite the node death
+            assert sorted(t.value for t in out) == [
+                (i + 1) * 3 for i in range(n_tasks)
+            ]
+            assert doomed.poll() is not None, "chaos kill never fired"
+            # the death was DECLARED (event recorded), lost intermediates
+            # were reconstructed, and nothing dead-lettered
+            assert any(e["node"] == "doomed" for e in runner.node_events), (
+                runner.node_events
+            )
+            assert runner.objects_reconstructed > 0
+            assert all(
+                c["dead_lettered"] == 0 for c in runner.stage_counts.values()
+            ), runner.stage_counts
+        finally:
+            for p in (doomed, survivor):
+                p.terminate()
+            for p in (doomed, survivor):
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def test_partition_then_heal_rejoins_fresh(self, monkeypatch, tmp_path):
+        """A partitioned agent (frames stall both ways) is declared dead on
+        the heartbeat deadline; the run completes on the driver; when the
+        partition heals the agent reconnects as a FRESH node (superseded
+        link, no double NodeBudget)."""
+        from cosmos_curate_tpu import chaos
+        from cosmos_curate_tpu.core.pipeline import (
+            PipelineConfig,
+            PipelineSpec,
+            StreamingSpec,
+        )
+        from cosmos_curate_tpu.core.stage import StageSpec
+        from cosmos_curate_tpu.engine.runner import StreamingRunner
+        from tests.engine.test_cross_host_routing import _StageA, _HopTask
+
+        port = _free_port()
+        self._base_env(monkeypatch, port, wait_nodes=1)
+        plan = chaos.FaultPlan(
+            rules=(
+                chaos.FaultRule(
+                    site=chaos.SITE_AGENT_PARTITION, kind="hang",
+                    delay_s=3.0, count=2, worker_re="^flaky-agent$",
+                ),
+            ),
+            seed=7,
+        ).to_json()
+        flaky = _spawn_agent(
+            port, "flaky", 2.0,
+            {"CURATE_CHAOS": plan, "CURATE_WORKER_ID": "flaky-agent"},
+        )
+        try:
+            runner = StreamingRunner(poll_interval_s=0.01)
+            n_tasks = 40
+            spec = PipelineSpec(
+                input_data=[_HopTask(i) for i in range(n_tasks)],
+                stages=[StageSpec(_StageA(), num_workers=2)],
+                config=PipelineConfig(
+                    # the driver has real capacity: work completes locally
+                    # while the agent is partitioned
+                    num_cpus=2.0,
+                    return_last_stage_outputs=True,
+                    streaming=StreamingSpec(autoscale_interval_s=0.5),
+                ),
+            )
+            out = runner.run(spec)
+            assert out is not None and len(out) == n_tasks
+            assert sorted(t.value for t in out) == [i + 1 for i in range(n_tasks)]
+            # the partition was DECLARED as a death (not silently tolerated)
+            assert any(
+                e["node"] == "flaky" and "heartbeat" in e["reason"]
+                for e in runner.node_events
+            ), runner.node_events
+        finally:
+            flaky.terminate()
+            try:
+                flaky.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                flaky.kill()

@@ -438,10 +438,14 @@ class TestReplayProtection:
             recv_frame(s2, token)  # fresh ack (different nonce)
             send_frame(s2, token, sid_a + sid_d1, SecureChannel.A2D, 1, AgentReady("w0"))
             time.sleep(0.3)
-            # the replayed frame was NOT processed and the phantom is dead
+            # the replayed frame was NOT processed and the phantom is dead.
+            # Hello dedup keys links by node_id: the phantom SUPERSEDED the
+            # recorded session's link, so exactly one "victim" link remains
+            # — and its replayed frame killed it
             assert results_q.qsize() == 1
-            replayed = [a for a in mgr.agents if a.node_id == "victim"][1]
-            assert not replayed.alive
+            victims = [a for a in mgr.agents if a.node_id == "victim"]
+            assert len(victims) == 1, "links must be keyed by node_id"
+            assert not victims[0].alive
             s1.close()
             s2.close()
         finally:

@@ -93,3 +93,53 @@ class TestOutputLayout:
         out, _ = split_run
         expected = {"clips", "metas", "previews", "processed_videos", "summary.json"}
         assert {p.name for p in out.iterdir()} <= expected
+
+
+class TestWeightsProvenanceStamp:
+    """ROADMAP item 3b, one notch further: weights provenance rides every
+    clip meta and summary.json, so noise is traceable end-to-end — not
+    just refused at the corpus index."""
+
+    def test_clip_meta_carries_per_model_provenance(self):
+        import numpy as np
+
+        from cosmos_curate_tpu.data.model import Clip
+        from cosmos_curate_tpu.pipelines.video.stages.writer import _clip_meta
+
+        clip = Clip(embeddings={"iv2": np.zeros(4, dtype=np.float32)})
+        meta = _clip_meta(clip, {"iv2": "checkpoint:abc123def456", "other": "random"})
+        # only the models that embedded THIS clip are stamped
+        assert meta["weights_provenance"] == {"iv2": "checkpoint:abc123def456"}
+        assert "weights_provenance" not in _clip_meta(clip)  # nothing known
+
+    def test_summary_unions_writer_provenance(self):
+        from types import SimpleNamespace
+
+        from cosmos_curate_tpu.utils.summary import build_summary
+
+        def task(perf):
+            return SimpleNamespace(
+                stats=None,
+                stage_perf=perf,
+                video=SimpleNamespace(
+                    path="v.mp4",
+                    metadata=SimpleNamespace(duration_s=1.0),
+                    clips=[], filtered_clips=[], errors=[],
+                ),
+            )
+
+        summary = build_summary(
+            [
+                task({"weights_provenance": {"iv2": "checkpoint:aa"}}),
+                task({"weights_provenance": {"clip": "random"}}),
+                task({}),
+            ],
+            pipeline_run_time_s=1.0,
+        )
+        assert summary["weights_provenance"] == {
+            "iv2": "checkpoint:aa", "clip": "random",
+        }
+        # absent entirely when no writer stamped provenance
+        assert "weights_provenance" not in build_summary(
+            [task({})], pipeline_run_time_s=1.0
+        )
