@@ -451,6 +451,57 @@ def main() -> int:
             f"add {record['index_add_clips_per_sec']} clips/s, "
             f"query {record['index_queries_per_sec']} q/s"
         )
+        # Search-serving bench (dedup/index_server.py): the /v1/search hot
+        # path over the SAME 20x corpus — single-vector requests through the
+        # micro-batching server, cold (fresh server, no warmup: every probe
+        # faults shards in from storage) vs warm (warmed cache + resident
+        # probe union). p50/p99 are the SLO headline; search_qps drives 8
+        # concurrent clients so micro-batching across requests is measured,
+        # not serial round-trips.
+        from concurrent.futures import ThreadPoolExecutor
+
+        from cosmos_curate_tpu.dedup.index_server import IndexServer
+
+        def _latencies(server, qs):
+            out = []
+            for v in qs:
+                t = time.monotonic()
+                server.search(v, top_k=5)
+                out.append((time.monotonic() - t) * 1e3)
+            return out
+
+        n_lat = min(64, len(run_vecs))
+        cold_srv = IndexServer(str(tmp / "bench_index"), warmup=False,
+                               metrics_name="bench_search_cold")
+        try:
+            cold = _latencies(cold_srv, run_vecs[:n_lat])
+        finally:
+            cold_srv.close()
+        warm_srv = IndexServer(str(tmp / "bench_index"), metrics_name="bench_search")
+        try:
+            _latencies(warm_srv, run_vecs[:n_lat])  # fill the probe union
+            warm = _latencies(warm_srv, run_vecs[:n_lat])
+            qps_n = max(128, 2 * len(run_vecs))
+            t0 = time.monotonic()
+            with ThreadPoolExecutor(8) as pool:
+                list(pool.map(
+                    lambda i: warm_srv.search(run_vecs[i % len(run_vecs)], top_k=5),
+                    range(qps_n),
+                ))
+            qps_wall = time.monotonic() - t0
+        finally:
+            warm_srv.close()
+        record["search_qps"] = round(qps_n / qps_wall, 1) if qps_wall > 0 else 0.0
+        record["search_latency_p50_ms"] = round(float(np.percentile(warm, 50)), 3)
+        record["search_latency_p99_ms"] = round(float(np.percentile(warm, 99)), 3)
+        record["search_latency_cold_p50_ms"] = round(float(np.percentile(cold, 50)), 3)
+        record["search_latency_cold_p99_ms"] = round(float(np.percentile(cold, 99)), 3)
+        log(
+            f"bench: search — warm p50 {record['search_latency_p50_ms']}ms "
+            f"p99 {record['search_latency_p99_ms']}ms (cold p50 "
+            f"{record['search_latency_cold_p50_ms']}ms), "
+            f"{record['search_qps']} qps over 8 concurrent clients"
+        )
     except Exception as e:  # noqa: BLE001
         log(f"bench: index bench failed ({e}); clips/s still valid")
 

@@ -12,6 +12,18 @@ The operational surface over dedup/corpus_index.py:
                                              dedup_summary format `local
                                              shard --dedup-csv` consumes
     index stats  --index-path <root>         meta + shard/pending counts
+    index consolidate --index-path <root>    fold pending fragments into the
+                                             index (the multi-node path:
+                                             after `merge-summaries`, no
+                                             full `index build` needed)
+    index compact --index-path <root>        one compaction pass: fold
+                                             pending, rebalance skew,
+                                             refresh centroids, publish a
+                                             new manifest generation
+    index serve  --index-path <root>         standalone HTTP search server
+                                             (POST /v1/search; the job
+                                             service mounts the same route
+                                             via `serve --index-path`)
 
 ``--index-path`` defaults to ``<input>/index`` — the same root
 ``local split --corpus-index`` writes in-pipeline fragments to.
@@ -71,6 +83,59 @@ def register(sub: argparse._SubParsersAction) -> None:
     stats = isub.add_parser("stats", help="index metadata + shard/pending counts")
     stats.add_argument("--index-path", required=True)
     stats.set_defaults(func=_cmd_stats)
+
+    consolidate = isub.add_parser(
+        "consolidate",
+        help="fold pending fragments into the index (multi-node helper: "
+        "run after merging split outputs — trains centroids only if the "
+        "index does not exist yet)",
+    )
+    consolidate.add_argument("--index-path", required=True)
+    consolidate.add_argument("--k", type=int, default=0, help="clusters (0 = sqrt(N))")
+    consolidate.add_argument("--iters", type=int, default=20)
+    consolidate.add_argument("--no-mesh", action="store_true")
+    consolidate.set_defaults(func=_cmd_consolidate)
+
+    compact = isub.add_parser(
+        "compact",
+        help="one compaction pass: fold pending, rebalance skewed clusters, "
+        "refresh centroids, publish a new manifest generation",
+    )
+    compact.add_argument("--index-path", required=True)
+    compact.add_argument("--rebalance-factor", type=float, default=4.0,
+                         help="split clusters larger than this × mean rows")
+    compact.add_argument("--no-rebalance", action="store_true")
+    compact.add_argument("--no-fold-pending", action="store_true")
+    compact.add_argument("--no-refresh-centroids", action="store_true")
+    compact.add_argument("--force", action="store_true",
+                         help="publish a generation even when nothing changed")
+    compact.add_argument(
+        "--gc", action="store_true",
+        help="delete fragments superseded generations reference (safe only "
+        "with no live index-server readers; a running server GCs on its own "
+        "as old generations drain)",
+    )
+    compact.add_argument("--no-mesh", action="store_true")
+    compact.set_defaults(func=_cmd_compact)
+
+    srv = isub.add_parser(
+        "serve",
+        help="standalone HTTP search server over the index (POST /v1/search)",
+    )
+    srv.add_argument("--index-path", required=True)
+    srv.add_argument("--host", default="0.0.0.0")
+    srv.add_argument("--port", type=int, default=8081)
+    srv.add_argument("--text-model", default="clip-text-b-tpu",
+                     help="CLIP text tower for text-to-clip queries")
+    srv.add_argument("--cache-mb", type=int, default=0,
+                     help="warm shard cache budget in MB (0 = env/default)")
+    srv.add_argument("--no-warmup", action="store_true")
+    srv.add_argument("--max-inflight", type=int, default=8)
+    srv.add_argument("--max-waiting", type=int, default=32)
+    srv.add_argument("--compact-interval-s", type=float, default=0.0,
+                     help="background compaction cadence (0 disables)")
+    srv.add_argument("--metrics-port", type=int, default=None)
+    srv.set_defaults(func=_cmd_serve)
 
     index.set_defaults(func=lambda args: (index.print_help(), 2)[1])
 
@@ -174,6 +239,64 @@ def _cmd_query(args: argparse.Namespace) -> int:
             },
             indent=2,
         )
+    )
+    return 0
+
+
+def _cmd_consolidate(args: argparse.Namespace) -> int:
+    """The multi-node "index remainders" path: merged split outputs carry
+    every node's pending fragments under one index root (chunk-scoped tags
+    never collide); this folds them against the existing centroids — or
+    trains centroids from them when the index is brand new — without the
+    full `index build` re-read of the run's embeddings parquet."""
+    from cosmos_curate_tpu.dedup.corpus_index import consolidate_index
+
+    result = consolidate_index(
+        args.index_path.rstrip("/"),
+        k=args.k or None, iters=args.iters, mesh=_mesh(args),
+        metrics_name="index_cli",
+    )
+    print(json.dumps({"index_path": args.index_path.rstrip("/"), **result}, indent=2))
+    return 0
+
+
+def _cmd_compact(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.dedup.compaction import compact_index
+
+    report = compact_index(
+        args.index_path.rstrip("/"),
+        mesh=_mesh(args),
+        fold_pending=not args.no_fold_pending,
+        rebalance=not args.no_rebalance,
+        rebalance_factor=args.rebalance_factor,
+        refresh_centroids=not args.no_refresh_centroids,
+        force=args.force,
+        gc=args.gc,
+        metrics_name="index_cli",
+    )
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from cosmos_curate_tpu.service.search import SearchConfig, serve_index
+
+    if args.metrics_port is not None:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics(args.metrics_port)
+    serve_index(
+        host=args.host,
+        port=args.port,
+        cfg=SearchConfig(
+            index_path=args.index_path.rstrip("/"),
+            max_inflight=args.max_inflight,
+            max_waiting=args.max_waiting,
+            text_model=args.text_model,
+            cache_bytes=(args.cache_mb << 20) or None,
+            warmup=not args.no_warmup,
+            compact_interval_s=args.compact_interval_s,
+        ),
     )
     return 0
 

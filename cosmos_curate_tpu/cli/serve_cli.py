@@ -52,6 +52,33 @@ def register(sub: argparse._SubParsersAction) -> None:
         "--metrics-port", type=int, default=None,
         help="expose service_*/pipeline_* prometheus metrics on this port",
     )
+    serve.add_argument(
+        "--index-path", default="",
+        help="corpus index root: enables POST /v1/search (index-server "
+        "read path with its own admission lane — see docs/SERVICE.md)",
+    )
+    serve.add_argument(
+        "--search-max-inflight", type=int, default=8,
+        help="search admission lane: requests actively served",
+    )
+    serve.add_argument(
+        "--search-max-waiting", type=int, default=32,
+        help="search admission lane: queued beyond inflight before 429",
+    )
+    serve.add_argument(
+        "--search-text-model", default="clip-text-b-tpu",
+        help="CLIP text tower for text-to-clip queries (provenance-gated)",
+    )
+    serve.add_argument(
+        "--search-cache-mb", type=int, default=0,
+        help="warm shard cache byte budget in MB (0 = "
+        "CURATE_INDEX_CACHE_BYTES or the 256 MB default)",
+    )
+    serve.add_argument(
+        "--compact-interval-s", type=float, default=0.0,
+        help="background index compaction cadence (0 disables; readers "
+        "adopt new generations between requests)",
+    )
     serve.set_defaults(func=_cmd_serve)
 
 
@@ -73,5 +100,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         term_grace_s=args.term_grace_s,
         metrics_port=args.metrics_port,
     )
-    serve(host=args.host, port=args.port, work_root=args.work_root, config=config)
+    search_config = None
+    if args.index_path:
+        from cosmos_curate_tpu.service.search import SearchConfig
+
+        search_config = SearchConfig(
+            index_path=args.index_path,
+            max_inflight=args.search_max_inflight,
+            max_waiting=args.search_max_waiting,
+            text_model=args.search_text_model,
+            cache_bytes=(args.search_cache_mb << 20) or None,
+            compact_interval_s=args.compact_interval_s,
+        )
+    serve(
+        host=args.host, port=args.port, work_root=args.work_root, config=config,
+        search_config=search_config,
+    )
     return 0

@@ -1,0 +1,448 @@
+"""Background index compaction: fold pending fragments, rebalance skewed
+clusters, refresh stale centroids, publish a new manifest generation.
+
+The write half of the index-server read path (dedup/index_server.py).
+Ingest keeps the index append-only — ``ClipWriterStage`` writes
+``pending/`` fragments and ``consolidate_index`` routes them — which over
+time skews clusters (hot content piles into few lists) and stales
+centroids (the mean drifts away from the stored vector). Compaction fixes
+both WITHOUT stopping reads:
+
+1. **Fold pending** (duplicate-free): the pending fragment set is
+   snapshotted at entry; rows are provenance- and model-gated exactly like
+   ``consolidate_index``, deduplicated against the indexed ids AND within
+   the fold (a re-run of a crashed fold cannot double-ingest), routed to
+   the current centroids, and appended as cluster fragments.
+2. **Rebalance skew**: clusters holding more than ``rebalance_factor`` ×
+   the mean row count are split in two by a local k-means
+   (``kmeans_fit(members, 2)``), bounding worst-case probe cost.
+3. **Refresh centroids**: every cluster's centroid is recomputed as the
+   normalized mean of its members; the manifest pins the refreshed set as
+   ``centroids-<gen>.npy`` (live ``centroids.npy``/``meta.json`` are
+   updated too, so batch readers and future ``add`` routing see it).
+4. **Publish atomically**: a new ``manifests/gen-<N>.json`` referencing
+   the exact post-compaction fragment set, then the ``MANIFEST.json``
+   pointer flip. Readers adopt between requests; nothing is published
+   unless something actually changed (fold, split, or centroid drift
+   above ``drift_tol``).
+
+Fragments referenced only by superseded generations are **not** deleted
+at publish — in-flight snapshot readers still hold them. They are listed
+in the new manifest's ``superseded`` field and reclaimed by
+:func:`gc_superseded` (the server's drain callback) or :func:`gc_index`
+(the ``index compact --gc`` full sweep). Until GC runs, live (manifest-
+less) readers may see a row in both its old and new fragment — benign:
+``score_shards`` deduplicates hits by clip id.
+
+Single-writer contract: one compactor per index root at a time (the
+in-service :class:`CompactionThread`, or the CLI while no service runs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from cosmos_curate_tpu.dedup.corpus_index import _record_index_ops
+from cosmos_curate_tpu.dedup.index_store import (
+    IndexStore,
+    allow_random_provenance,
+    normalize_rows,
+)
+from cosmos_curate_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_REBALANCE_FACTOR = 4.0
+DEFAULT_MIN_SPLIT_ROWS = 16
+DEFAULT_DRIFT_TOL = 1e-3
+
+
+def compact_index(
+    root: str,
+    *,
+    mesh=None,
+    fold_pending: bool = True,
+    rebalance: bool = True,
+    rebalance_factor: float = DEFAULT_REBALANCE_FACTOR,
+    min_split_rows: int = DEFAULT_MIN_SPLIT_ROWS,
+    refresh_centroids: bool = True,
+    drift_tol: float = DEFAULT_DRIFT_TOL,
+    force: bool = False,
+    gc: bool = False,
+    metrics_name: str = "compaction",
+) -> dict:
+    """One compaction pass over the index at ``root``. Returns a report;
+    ``report["published"]`` is False when nothing needed doing (no pending
+    rows, no skew, centroid drift under ``drift_tol``, and not ``force``).
+    """
+    t0 = time.monotonic()
+    store = IndexStore(root)
+    if not store.exists():
+        raise FileNotFoundError(f"no corpus index at {root} (run `index build` first)")
+    base_gen = store.current_generation()
+    base_manifest = store.read_manifest(base_gen)
+    centroids = np.asarray(store.load_centroids(base_manifest.get("centroids") or None), np.float32)
+    meta = dict(base_manifest.get("meta") or store.load_meta())
+    report = {
+        "index_path": store.root,
+        "base_generation": base_gen,
+        "published": False,
+        "generation": base_gen,
+        "folded": 0,
+        "absorbed": 0,  # live post-publish `add` fragments pulled into the manifest
+        "skipped_random": 0,
+        "model_dropped": 0,
+        "duplicates_dropped": 0,
+        "clusters_split": 0,
+        "rows_moved": 0,
+        "centroid_drift": 0.0,
+        "pending_cleared": 0,
+        "gc_deleted": 0,
+    }
+
+    # -- load the pinned cluster contents (compaction is the one pass that
+    # legitimately reads the whole index — it is the maintenance walk)
+    clusters: dict[int, tuple[list[str], np.ndarray]] = {}
+    for cid_s, info in (base_manifest.get("clusters") or {}).items():
+        ids, vecs = store.read_fragments(list(info.get("fragments") or []))
+        if ids:
+            clusters[int(cid_s)] = (ids, vecs)
+    indexed_ids = {u for ids, _v in clusters.values() for u in ids}
+    changed: set[int] = set()  # clusters whose fragment set must be rewritten
+
+    # Absorb live fragments the base manifest does NOT pin: rows appended
+    # by ``CorpusIndex.add`` / `index consolidate` AFTER the base
+    # generation was published land directly under clusters/ and would
+    # otherwise never enter any future manifest (and a later GC would
+    # delete them). Superseded leftovers of older generations surface here
+    # too — their rows are already in ``indexed_ids`` and dedup away, so
+    # absorbing is always safe.
+    if base_gen > 0:
+        pinned_frags = {
+            f
+            for info in (base_manifest.get("clusters") or {}).values()
+            for f in (info.get("fragments") or [])
+        }
+        for cid in sorted(store.cluster_fragment_counts()):
+            extras = [
+                rel
+                for rel, _sz in store.fragment_info(f"clusters/{store.cluster_dir(cid)}")
+                if rel not in pinned_frags
+            ]
+            if not extras:
+                continue
+            e_ids, e_vecs = store.read_fragments(extras)
+            novel = []
+            for i, u in enumerate(e_ids):
+                if u not in indexed_ids:
+                    novel.append(i)
+                    indexed_ids.add(u)
+            if not novel:
+                continue
+            old_ids, old_vecs = clusters.get(
+                cid, ([], np.zeros((0, e_vecs.shape[1]), np.float32))
+            )
+            clusters[cid] = (
+                list(old_ids) + [e_ids[i] for i in novel],
+                np.concatenate([old_vecs, e_vecs[novel]]) if len(old_ids) else e_vecs[novel],
+            )
+            changed.add(cid)
+            report["absorbed"] += len(novel)
+
+    # -- 1. fold pending (duplicate-free) ------------------------------------
+    pending_paths = store.list_pending() if fold_pending else []
+    pending_rel = [store._relpath(p) for p in pending_paths]
+    if pending_paths:
+        p_ids, p_vecs, p_models, p_provs = store.read_pending()
+        keep = list(range(len(p_ids)))
+        if not allow_random_provenance():
+            refused = [i for i in keep if p_provs[i] == "random"]
+            report["skipped_random"] = len(refused)
+            keep = [i for i in keep if p_provs[i] != "random"]
+        model = meta.get("model") or next((m for m in p_models if m), "")
+        if model:
+            dropped = [i for i in keep if p_models[i] not in (model, "")]
+            if dropped:
+                logger.warning(
+                    "compaction: dropping %d pending rows from other embedding "
+                    "models (index model: %s)", len(dropped), model,
+                )
+                report["model_dropped"] = len(dropped)
+            keep = [i for i in keep if p_models[i] in (model, "")]
+        seen_fold: set[str] = set()
+        fold_rows: list[int] = []
+        for i in keep:
+            if p_ids[i] in indexed_ids or p_ids[i] in seen_fold:
+                report["duplicates_dropped"] += 1
+                continue
+            seen_fold.add(p_ids[i])
+            fold_rows.append(i)
+        if fold_rows:
+            f_ids = [p_ids[i] for i in fold_rows]
+            f_vecs = normalize_rows(p_vecs[fold_rows])
+            assign = np.argmax(f_vecs @ centroids.T, axis=1)
+            for cid in np.unique(assign):
+                members = np.flatnonzero(assign == cid)
+                old_ids, old_vecs = clusters.get(int(cid), ([], np.zeros((0, f_vecs.shape[1]), np.float32)))
+                clusters[int(cid)] = (
+                    list(old_ids) + [f_ids[m] for m in members],
+                    np.concatenate([old_vecs, f_vecs[members]]) if len(old_ids) else f_vecs[members],
+                )
+                changed.add(int(cid))
+            indexed_ids.update(f_ids)
+            report["folded"] = len(fold_rows)
+
+    # -- 2. rebalance skewed clusters ----------------------------------------
+    new_centroids: dict[int, np.ndarray] = {}
+    if rebalance and clusters:
+        sizes = {cid: len(ids) for cid, (ids, _v) in clusters.items()}
+        mean_rows = sum(sizes.values()) / max(1, len(sizes))
+        next_cid = max(max(clusters), centroids.shape[0] - 1) + 1
+        for cid in sorted(clusters):
+            ids, vecs = clusters[cid]
+            if len(ids) < max(min_split_rows, int(rebalance_factor * mean_rows)):
+                continue
+            from cosmos_curate_tpu.dedup.kmeans import kmeans_fit
+
+            subc, sub_assign = kmeans_fit(vecs, 2, iters=10, seed=cid, mesh=mesh)
+            a = np.flatnonzero(sub_assign == 0)
+            b = np.flatnonzero(sub_assign == 1)
+            if len(a) == 0 or len(b) == 0:
+                continue  # degenerate split: all rows are one point
+            clusters[cid] = ([ids[m] for m in a], vecs[a])
+            clusters[next_cid] = ([ids[m] for m in b], vecs[b])
+            new_centroids[cid] = subc[0]
+            new_centroids[next_cid] = subc[1]
+            changed.add(cid)
+            changed.add(next_cid)
+            report["clusters_split"] += 1
+            report["rows_moved"] += len(b)
+            logger.info(
+                "compaction: split cluster %d (%d rows) -> %d + %d",
+                cid, len(ids), len(a), len(b),
+            )
+            next_cid += 1
+
+    # -- 3. refresh centroids ------------------------------------------------
+    k_new = max(max(clusters) + 1 if clusters else 1, centroids.shape[0])
+    refreshed = np.zeros((k_new, centroids.shape[1]), np.float32)
+    refreshed[: centroids.shape[0]] = centroids
+    drift = 0.0
+    for cid, (ids, vecs) in clusters.items():
+        if cid in new_centroids:
+            refreshed[cid] = new_centroids[cid]
+            continue
+        if refresh_centroids and len(ids):
+            fresh = normalize_rows(vecs.mean(axis=0, keepdims=True))[0]
+            if cid < centroids.shape[0]:
+                drift = max(drift, float(1.0 - fresh @ centroids[cid]))
+            refreshed[cid] = fresh
+    report["centroid_drift"] = round(drift, 6)
+
+    if not (
+        force
+        or report["folded"]
+        or report["absorbed"]
+        or report["clusters_split"]
+        or (refresh_centroids and drift > drift_tol)
+    ):
+        # nothing changed in the index — no new generation. Pending
+        # fragments whose rows were ALL consumed anyway (duplicates of
+        # indexed ids, or refused random-provenance rows — logged above)
+        # still clear, or every later pass would re-read them forever.
+        consumed = (
+            report["duplicates_dropped"] + report["skipped_random"]
+            + report["model_dropped"]
+        )
+        if pending_rel and consumed > 0:
+            report["pending_cleared"] = store.delete_fragments(pending_rel)
+        return report
+
+    # -- 4. write fragments + publish the generation -------------------------
+    gen = max([base_gen] + store.list_manifests()) + 1
+    manifest_clusters: dict[str, dict] = {}
+    base_clusters = base_manifest.get("clusters") or {}
+    for cid in sorted(clusters):
+        ids, vecs = clusters[cid]
+        if not ids:
+            continue
+        if cid in changed or str(cid) not in base_clusters:
+            # consolidate to ONE fragment per touched cluster (that is the
+            # "compaction": many append fragments fold into one read)
+            path = store.append_cluster(cid, ids, vecs)
+            frags = [store._relpath(path)]
+            nbytes = sum(sz for rel, sz in store.fragment_info(
+                f"clusters/{store.cluster_dir(cid)}"
+            ) if rel in frags)
+        else:
+            info = base_clusters[str(cid)]
+            frags = list(info.get("fragments") or [])
+            nbytes = int(info.get("bytes", 0))
+        manifest_clusters[str(cid)] = {
+            "fragments": frags,
+            "rows": len(ids),
+            "bytes": nbytes,
+        }
+    cent_rel = store.save_centroids(refreshed, generation=gen)
+    store.save_centroids(refreshed)  # live copy: batch readers + add routing
+    num_vectors = sum(int(c["rows"]) for c in manifest_clusters.values())
+    meta.update({"k": int(refreshed.shape[0]), "num_vectors": num_vectors})
+    store.save_meta(meta)
+    meta = store.load_meta()  # re-read: save_meta stamps backend
+    new_frag_set = {
+        f for c in manifest_clusters.values() for f in c["fragments"]
+    }
+    superseded = sorted(
+        {
+            f
+            for c in base_clusters.values()
+            for f in (c.get("fragments") or [])
+            if f not in new_frag_set
+        }
+    )
+    manifest = {
+        "generation": gen,
+        "centroids": cent_rel,
+        "meta": meta,
+        "clusters": manifest_clusters,
+        "superseded": superseded,
+        "base_generation": base_gen,
+    }
+    store.publish_manifest(manifest)
+    report["published"] = True
+    report["generation"] = gen
+    # pending cleared ONLY for the fragments this pass read — fragments the
+    # writer appended meanwhile stay for the next pass
+    if pending_rel:
+        report["pending_cleared"] = store.delete_fragments(pending_rel)
+    if gc:
+        report["gc_deleted"] = gc_index(store)
+    wall = time.monotonic() - t0
+    _record_index_ops(metrics_name, adds=report["folded"], add_s=wall)
+    _record_compaction(metrics_name, gen, wall)
+    logger.info(
+        "compaction published generation %d: folded %d, split %d cluster(s), "
+        "drift %.4f, %d vectors (%.2fs)",
+        gen, report["folded"], report["clusters_split"], drift, num_vectors, wall,
+    )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# garbage collection
+
+
+def gc_superseded(store: IndexStore, old_manifest: dict, current_manifest: dict) -> int:
+    """Drain-time GC (index_server snapshot release): delete fragments the
+    superseded manifest referenced that the current one does not."""
+    keep = {
+        f
+        for c in (current_manifest.get("clusters") or {}).values()
+        for f in (c.get("fragments") or [])
+    }
+    victims = [
+        f
+        for c in (old_manifest.get("clusters") or {}).values()
+        for f in (c.get("fragments") or [])
+        if f not in keep
+    ]
+    n = store.delete_fragments(victims)
+    old_gen = int(old_manifest.get("generation", 0))
+    if old_gen > 0:
+        store.delete_manifest(old_gen)
+    if n:
+        logger.info("gc: reclaimed %d fragment(s) of generation %d", n, old_gen)
+    return n
+
+
+def gc_index(store: IndexStore) -> int:
+    """Full sweep (``index compact --gc``; safe only with no snapshot
+    readers): delete every cluster fragment the CURRENT manifest does not
+    reference, plus superseded manifest files."""
+    current_gen = store.current_generation()
+    if current_gen <= 0:
+        return 0  # live view: everything on disk IS the index
+    manifest = store.read_manifest(current_gen)
+    keep = {
+        f
+        for c in (manifest.get("clusters") or {}).values()
+        for f in (c.get("fragments") or [])
+    }
+    victims: list[str] = []
+    for cid in store.cluster_fragment_counts():
+        for rel, _sz in store.fragment_info(f"clusters/{store.cluster_dir(cid)}"):
+            if rel not in keep:
+                victims.append(rel)
+    n = store.delete_fragments(victims)
+    for gen in store.list_manifests():
+        if gen < current_gen:
+            store.delete_manifest(gen)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# background thread
+
+
+class CompactionThread(threading.Thread):
+    """In-service compactor: one pass every ``interval_s``, publishing only
+    when something changed. The paired :class:`~cosmos_curate_tpu.dedup.
+    index_server.IndexServer` adopts new generations between batches; its
+    drain callback (``gc_drained=True``) reclaims superseded fragments."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        interval_s: float = 30.0,
+        mesh=None,
+        metrics_name: str = "compaction",
+        **compact_kw,
+    ) -> None:
+        super().__init__(name="index-compactor", daemon=True)
+        self.root = root
+        self.interval_s = interval_s
+        self.mesh = mesh
+        self.metrics_name = metrics_name
+        self.compact_kw = compact_kw
+        self._stop_event = threading.Event()
+        self.passes = 0
+        self.last_report: dict | None = None
+
+    def run(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            self.run_once()
+
+    def run_once(self) -> dict | None:
+        try:
+            self.last_report = compact_index(
+                self.root, mesh=self.mesh, metrics_name=self.metrics_name,
+                **self.compact_kw,
+            )
+            self.passes += 1
+            return self.last_report
+        except Exception:
+            logger.exception("compaction pass failed; index unchanged")
+            return None
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop_event.set()
+        self.join(timeout=timeout)
+
+
+def _record_compaction(name: str, generation: int, wall_s: float) -> None:
+    try:
+        from cosmos_curate_tpu.observability.stage_timer import record_search
+
+        record_search(name, compactions=1, compaction_s=wall_s, generation=generation)
+    except Exception:
+        logger.debug("compaction metrics recording failed", exc_info=True)
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_compaction(name, generation)
+    except Exception:
+        logger.debug("compaction counter update failed", exc_info=True)

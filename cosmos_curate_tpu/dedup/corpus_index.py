@@ -30,6 +30,7 @@ add/query records ``pipeline_index_*`` metrics through
 from __future__ import annotations
 
 import functools
+import threading
 import time
 
 import jax
@@ -43,12 +44,18 @@ logger = get_logger(__name__)
 
 DEFAULT_NPROBE = 8
 DEFAULT_TOP_K = 8
-# Loaded cluster shards cached per index instance (id list + matrix); the
-# cap bounds host memory on wide probe patterns. Must comfortably exceed
-# the typical probe UNION (≈ min(Q·nprobe, K)) or every query batch
+# Loaded cluster shards cached per index instance (id list + matrix).
+# Eviction is BYTE-budgeted: an entry-count cap treats a 4 GB skew cluster
+# and a 2 MB one as equal citizens, so one fat cluster used to evict the
+# whole probe union (or, worse, N fat clusters fit "under" the cap and
+# blew host memory). The entry cap survives as a secondary bound for
+# pathological many-tiny-shard layouts. The budget must comfortably exceed
+# the typical probe UNION (≈ min(Q·nprobe, K) shards) or every query batch
 # re-reads its shards from storage — cache thrash, not caching.
 CLUSTER_CACHE_ENTRIES_ENV = "CURATE_INDEX_CACHE_SHARDS"
 DEFAULT_CLUSTER_CACHE_ENTRIES = 512
+CLUSTER_CACHE_BYTES_ENV = "CURATE_INDEX_CACHE_BYTES"
+DEFAULT_CLUSTER_CACHE_BYTES = 256 << 20
 
 
 def _cluster_cache_entries() -> int:
@@ -57,6 +64,156 @@ def _cluster_cache_entries() -> int:
     return max(
         1, int(os.environ.get(CLUSTER_CACHE_ENTRIES_ENV, "") or DEFAULT_CLUSTER_CACHE_ENTRIES)
     )
+
+
+def cluster_cache_bytes() -> int:
+    import os
+
+    return max(
+        1, int(os.environ.get(CLUSTER_CACHE_BYTES_ENV, "") or DEFAULT_CLUSTER_CACHE_BYTES)
+    )
+
+
+def shard_nbytes(ids: list[str], mat: np.ndarray) -> int:
+    """Host-memory estimate for one loaded shard: the matrix plus a rough
+    per-id string overhead (python str + list slot)."""
+    return int(mat.nbytes) + 64 * len(ids)
+
+
+class ShardCache:
+    """Byte-budgeted LRU over loaded cluster shards, keyed by
+    ``(generation, cluster_id)`` — THE shard cache, shared by the batch
+    path (:class:`CorpusIndex`, generation 0 = the live view) and the
+    serving read path (dedup/index_server.py snapshots).
+
+    Entry count is irrelevant to what a cache costs — a skewed corpus has
+    4 GB clusters next to 2 MB ones — so admission and eviction are sized
+    by :func:`shard_nbytes` (budget: ctor arg, else the
+    ``CURATE_INDEX_CACHE_BYTES`` env read per access so tests/operators
+    can retune live). ``pinned`` keys (the in-flight batch's probe union)
+    are never evicted mid-batch; a shard larger than the whole budget is
+    refused at admission. ``max_entries`` (int or callable) survives as a
+    secondary bound for pathological many-tiny-shard layouts.
+    ``drop_generation`` purges a superseded snapshot's shards the moment
+    its refcount drains; ``invalidate`` drops one stale entry after an
+    in-place append.
+
+    Thread-safe; hit/miss/evicted byte totals flow to
+    ``stage_timer.record_search`` under ``metrics_name``.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int | None = None,
+        *,
+        metrics_name: str = "index_server",
+        max_entries=None,
+    ) -> None:
+        self._budget_fixed = int(budget_bytes) if budget_bytes else None
+        self._max_entries = max_entries
+        self.metrics_name = metrics_name
+        self._lock = threading.Lock()
+        # insertion-ordered: oldest first = LRU victim order
+        self._entries: dict[tuple[int, int], tuple[list[str], np.ndarray, int]] = {}
+        self.bytes = 0
+        self.hit_bytes = 0
+        self.miss_bytes = 0
+        self.evicted_bytes = 0
+
+    @property
+    def budget(self) -> int:
+        return self._budget_fixed or cluster_cache_bytes()
+
+    def _entry_cap(self) -> int | None:
+        cap = self._max_entries
+        return cap() if callable(cap) else cap
+
+    def get(
+        self,
+        generation: int,
+        cid: int,
+        loader,
+        pinned: frozenset[tuple[int, int]] = frozenset(),
+    ) -> tuple[list[str], np.ndarray]:
+        key = (generation, cid)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries[key] = self._entries.pop(key)  # LRU touch
+                self.hit_bytes += entry[2]
+                _record_search_bytes(self.metrics_name, cache_hit_bytes=entry[2])
+                return entry[0], entry[1]
+        ids, mat = loader()
+        nbytes = shard_nbytes(ids, mat)
+        budget = self.budget
+        cap = self._entry_cap()
+        with self._lock:
+            self.miss_bytes += nbytes
+            _record_search_bytes(self.metrics_name, cache_miss_bytes=nbytes)
+            if nbytes > budget:
+                return ids, mat  # admission by bytes: never cache the uncacheable
+            evicted = 0
+            for victim in [k for k in self._entries if k not in pinned]:
+                if self.bytes + nbytes <= budget and (
+                    cap is None or len(self._entries) < cap
+                ):
+                    break
+                _vids, _vmat, vbytes = self._entries.pop(victim)
+                self.bytes -= vbytes
+                evicted += vbytes
+            if evicted:
+                self.evicted_bytes += evicted
+                _record_search_bytes(self.metrics_name, cache_evicted_bytes=evicted)
+            if (
+                self.bytes + nbytes <= budget
+                and (cap is None or len(self._entries) < cap)
+                and key not in self._entries
+            ):
+                self._entries[key] = (ids, mat, nbytes)
+                self.bytes += nbytes
+        return ids, mat
+
+    def invalidate(self, generation: int, cid: int) -> None:
+        """Drop one entry (its backing shard grew; reload on demand)."""
+        with self._lock:
+            entry = self._entries.pop((generation, cid), None)
+            if entry is not None:
+                self.bytes -= entry[2]
+
+    def drop_generation(self, generation: int) -> int:
+        """Purge every shard of a drained generation; returns bytes freed."""
+        with self._lock:
+            victims = [k for k in self._entries if k[0] == generation]
+            freed = 0
+            for key in victims:
+                freed += self._entries.pop(key)[2]
+            self.bytes -= freed
+        if freed:
+            logger.info(
+                "shard cache: drained generation %d (%d shards, %.1f MB)",
+                generation, len(victims), freed / 2**20,
+            )
+        return freed
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget,
+                "resident_bytes": self.bytes,
+                "resident_shards": len(self._entries),
+                "hit_bytes": self.hit_bytes,
+                "miss_bytes": self.miss_bytes,
+                "evicted_bytes": self.evicted_bytes,
+            }
+
+
+def _record_search_bytes(name: str, **deltas) -> None:
+    try:
+        from cosmos_curate_tpu.observability.stage_timer import record_search
+
+        record_search(name, **deltas)
+    except Exception:  # metrics must never take down the read path
+        logger.debug("search cache metrics recording failed", exc_info=True)
 
 
 def query_matmul(mesh, queries, corpus, *, top_k: int):
@@ -91,6 +248,102 @@ def _topk_single(q, c, k: int):
     return jax.lax.top_k(q @ c.T, k)
 
 
+class DeviceTopK:
+    """One scoring matmul on the device plane: shard_map over the mesh's
+    batch axes when a multi-device mesh is attached, plain jit otherwise.
+    Holds the per-``top_k`` jit cache so the compiled-shape universe is
+    shared across callers (CorpusIndex batch queries AND the index-server
+    snapshot reader ride the same programs)."""
+
+    def __init__(self, mesh=None) -> None:
+        self.mesh = mesh if mesh is not None and getattr(mesh, "size", 1) > 1 else None
+        self._mesh_jit: dict[int, object] = {}
+
+    def __call__(self, q: np.ndarray, corpus: np.ndarray, k: int):
+        """Host (vals, idxs) of the per-row top-k of ``q @ corpus.T``."""
+        if self.mesh is not None:
+            from cosmos_curate_tpu.parallel.sharding import shard_batch, unshard_batch
+
+            fn = self._mesh_jit.get(k)
+            if fn is None:
+                fn = jax.jit(functools.partial(query_matmul, self.mesh, top_k=k))
+                self._mesh_jit[k] = fn
+            placed, pad = shard_batch(self.mesh, q)
+            vals, idxs = fn(placed, corpus)
+            return unshard_batch(jax.device_get((vals, idxs)), pad)
+        return jax.device_get(_topk_single(q, corpus, k))
+
+
+def route_queries(
+    q: np.ndarray, centroids: np.ndarray, nprobe: int
+) -> dict[int, list[int]]:
+    """The routing matmul: cluster id -> query row indices that probe it
+    (each query takes its top-``nprobe`` centroids). ``nprobe`` clamps to
+    [1, K] — a negative value must not argpartition its way into probing
+    the whole corpus."""
+    cent_sims = q @ centroids.T  # [Q, K]
+    nprobe = max(1, min(nprobe, centroids.shape[0]))
+    probed = np.argpartition(cent_sims, -nprobe, axis=1)[:, -nprobe:]
+    by_cluster: dict[int, list[int]] = {}
+    for qi in range(len(q)):
+        for cid in probed[qi]:
+            by_cluster.setdefault(int(cid), []).append(qi)
+    return by_cluster
+
+
+def score_shards(
+    q: np.ndarray,
+    by_cluster: dict[int, list[int]],
+    loaded: list[tuple[int, list[str], np.ndarray]],
+    top_k: int,
+    device_topk: DeviceTopK,
+) -> list[list[tuple[str, float]]]:
+    """One matmul per probed shard over the pow2-padded subset of queries
+    that probed it; candidates merge on the host as arrays (per-element
+    python dict folding was the query path's second bottleneck after shard
+    loads). Shared by the batch path (CorpusIndex) and the snapshot reader
+    (dedup/index_server.py)."""
+    n = len(q)
+    per_q_vals: list[list[np.ndarray]] = [[] for _ in range(n)]
+    per_q_ids: list[list[np.ndarray]] = [[] for _ in range(n)]
+    for cid, cids, mat in loaded:
+        qidx = by_cluster[cid]
+        sub = q[qidx]
+        # pow2 pad: bounds the compiled-shape universe to {pow2 <= Q}
+        # per shard size instead of one compile per ragged subset
+        target = next_pow2(len(qidx))
+        if target > len(qidx):
+            sub = np.concatenate(
+                [sub, np.zeros((target - len(qidx), sub.shape[1]), np.float32)]
+            )
+        kk = min(top_k, len(cids))
+        vals, idxs = device_topk(sub, mat, kk)
+        vals, idxs = vals[: len(qidx)], idxs[: len(qidx)]
+        hit_ids = np.asarray(cids, object)[idxs]  # [m, kk] of id strings
+        for row, qi in enumerate(qidx):
+            per_q_vals[qi].append(vals[row])
+            per_q_ids[qi].append(hit_ids[row])
+    results: list[list[tuple[str, float]]] = []
+    for qi in range(n):
+        if not per_q_vals[qi]:
+            results.append([])
+            continue
+        v = np.concatenate(per_q_vals[qi])
+        ids_q = np.concatenate(per_q_ids[qi])
+        row: list[tuple[str, float]] = []
+        seen: set[str] = set()  # an id can surface from several shards
+        for j in np.argsort(-v):
+            hid = ids_q[j]
+            if hid in seen:
+                continue
+            seen.add(hid)
+            row.append((str(hid), float(v[j])))
+            if len(row) == top_k:
+                break
+        results.append(row)
+    return results
+
+
 class CorpusIndex:
     """One opened index: centroids + meta in memory, cluster shards loaded
     (and cached) on demand. Construction is cheap; ``build`` / ``open`` are
@@ -108,10 +361,14 @@ class CorpusIndex:
         self.store = store
         self.meta = meta
         self.centroids = np.asarray(centroids, np.float32)
-        self.mesh = mesh if mesh is not None and getattr(mesh, "size", 1) > 1 else None
+        self._topk = DeviceTopK(mesh)
+        self.mesh = self._topk.mesh
         self.metrics_name = metrics_name
-        self._cluster_cache: dict[int, tuple[list[str], np.ndarray]] = {}
-        self._mesh_jit: dict[int, object] = {}
+        # the shared byte-budgeted LRU at generation 0 (the live view);
+        # the legacy entry cap rides along as the secondary bound
+        self.cache = ShardCache(
+            metrics_name=metrics_name, max_entries=_cluster_cache_entries
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -198,7 +455,7 @@ class CorpusIndex:
             self.store.append_cluster(
                 int(cid), [ids[m] for m in members], normed[members]
             )
-            self._cluster_cache.pop(int(cid), None)  # shard grew; reload on demand
+            self.cache.invalidate(0, int(cid))  # shard grew; reload on demand
         self.meta["num_vectors"] = int(self.meta.get("num_vectors", 0)) + len(ids)
         self.store.save_meta(self.meta)
         _record_index_ops(self.metrics_name, adds=len(ids), add_s=time.monotonic() - t0)
@@ -206,31 +463,15 @@ class CorpusIndex:
 
     # -- queries -------------------------------------------------------------
 
-    def _load_cluster(self, cid: int) -> tuple[list[str], np.ndarray]:
-        cached = self._cluster_cache.get(cid)
-        if cached is not None:
-            return cached
-        ids, vecs = self.store.read_cluster(cid)
-        if len(self._cluster_cache) >= _cluster_cache_entries():
-            self._cluster_cache.pop(next(iter(self._cluster_cache)))
-        self._cluster_cache[cid] = (ids, vecs)
-        return ids, vecs
-
-    def _device_topk(self, q: np.ndarray, corpus: np.ndarray, k: int):
-        """One scoring matmul on the device plane: shard_map over the mesh's
-        batch axes when a multi-device mesh is attached, plain jit otherwise.
-        Returns host (vals, idxs)."""
-        if self.mesh is not None:
-            from cosmos_curate_tpu.parallel.sharding import shard_batch, unshard_batch
-
-            fn = self._mesh_jit.get(k)
-            if fn is None:
-                fn = jax.jit(functools.partial(query_matmul, self.mesh, top_k=k))
-                self._mesh_jit[k] = fn
-            placed, pad = shard_batch(self.mesh, q)
-            vals, idxs = fn(placed, corpus)
-            return unshard_batch(jax.device_get((vals, idxs)), pad)
-        return jax.device_get(_topk_single(q, corpus, k))
+    def _load_cluster(
+        self, cid: int, pinned: frozenset[tuple[int, int]] = frozenset()
+    ) -> tuple[list[str], np.ndarray]:
+        """Load one cluster shard through the shared byte-budgeted LRU
+        (generation 0 = the live view). ``pinned`` keys (the current
+        batch's probe union) are never evicted — loading shard k of a wide
+        probe pattern must not push out shard k-1 that the SAME batch just
+        paid to load."""
+        return self.cache.get(0, cid, lambda: self.store.read_cluster(cid), pinned)
 
     def query(
         self,
@@ -250,17 +491,13 @@ class CorpusIndex:
             return []
         t0 = time.monotonic()
         q = np.asarray(vecs, np.float32) if normalized else normalize_rows(vecs)
-        k_clusters = self.centroids.shape[0]
-        nprobe = min(nprobe or int(self.meta.get("nprobe_default", DEFAULT_NPROBE)), k_clusters)
-        cent_sims = q @ self.centroids.T  # [Q, K] — the routing matmul
-        probed = np.argpartition(cent_sims, -nprobe, axis=1)[:, -nprobe:]
-        by_cluster: dict[int, list[int]] = {}
-        for qi in range(n):
-            for cid in probed[qi]:
-                by_cluster.setdefault(int(cid), []).append(qi)
+        nprobe = nprobe or int(self.meta.get("nprobe_default", DEFAULT_NPROBE))
+        by_cluster = route_queries(q, self.centroids, nprobe)
+        # the probe union stays cached batch-long
+        pinned = frozenset((0, cid) for cid in by_cluster)
         loaded = []
         for cid in sorted(by_cluster):
-            cids, mat = self._load_cluster(cid)
+            cids, mat = self._load_cluster(cid, pinned)
             if cids:
                 loaded.append((cid, cids, mat))
         # per-QUERY probe count (Σ over queries of non-empty probed shards,
@@ -270,58 +507,11 @@ class CorpusIndex:
         if not loaded:
             results: list[list[tuple[str, float]]] = [[] for _ in range(n)]
         else:
-            results = self._query_per_shard(q, by_cluster, loaded, top_k)
+            results = score_shards(q, by_cluster, loaded, top_k, self._topk)
         _record_index_ops(
             self.metrics_name,
             queries=n, probes=probes, query_s=time.monotonic() - t0,
         )
-        return results
-
-    def _query_per_shard(
-        self, q: np.ndarray, by_cluster: dict, loaded: list, top_k: int
-    ) -> list[list[tuple[str, float]]]:
-        """One matmul per probed shard over the pow2-padded subset of
-        queries that probed it; candidates merge on the host as arrays
-        (per-element python dict folding was the query path's second
-        bottleneck after shard loads)."""
-        n = len(q)
-        per_q_vals: list[list[np.ndarray]] = [[] for _ in range(n)]
-        per_q_ids: list[list[np.ndarray]] = [[] for _ in range(n)]
-        for cid, cids, mat in loaded:
-            qidx = by_cluster[cid]
-            sub = q[qidx]
-            # pow2 pad: bounds the compiled-shape universe to {pow2 <= Q}
-            # per shard size instead of one compile per ragged subset
-            target = next_pow2(len(qidx))
-            if target > len(qidx):
-                sub = np.concatenate(
-                    [sub, np.zeros((target - len(qidx), sub.shape[1]), np.float32)]
-                )
-            kk = min(top_k, len(cids))
-            vals, idxs = self._device_topk(sub, mat, kk)
-            vals, idxs = vals[: len(qidx)], idxs[: len(qidx)]
-            hit_ids = np.asarray(cids, object)[idxs]  # [m, kk] of id strings
-            for row, qi in enumerate(qidx):
-                per_q_vals[qi].append(vals[row])
-                per_q_ids[qi].append(hit_ids[row])
-        results: list[list[tuple[str, float]]] = []
-        for qi in range(n):
-            if not per_q_vals[qi]:
-                results.append([])
-                continue
-            v = np.concatenate(per_q_vals[qi])
-            ids_q = np.concatenate(per_q_ids[qi])
-            row: list[tuple[str, float]] = []
-            seen: set[str] = set()  # an id can surface from several shards
-            for j in np.argsort(-v):
-                hid = ids_q[j]
-                if hid in seen:
-                    continue
-                seen.add(hid)
-                row.append((str(hid), float(v[j])))
-                if len(row) == top_k:
-                    break
-            results.append(row)
         return results
 
     def stats(self) -> dict:

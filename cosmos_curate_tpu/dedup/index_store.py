@@ -8,6 +8,9 @@ storage/lance_export.py docstring):
     <root>/centroids.npy               [K, D] float32 L2-normalized centroids
     <root>/pending/<tag>.(parquet|lance)    in-pipeline fragment appends
     <root>/clusters/c<cid>/<frag>.(parquet|lance)   per-cluster vector shards
+    <root>/manifests/gen-<NNNNNN>.json      immutable snapshot manifests
+    <root>/MANIFEST.json               pointer: the current published generation
+    <root>/centroids-<NNNNNN>.npy      per-generation centroids (compaction)
 
 ``ClipWriterStage`` appends *pending* fragments during a run (cheap,
 append-only, no coordination); the end-of-run consolidation step routes
@@ -23,6 +26,17 @@ dedup/kmeans.py) with a ``provenance`` column per row — "random" rows
 (embeddings from unstaged random-init weights, models/registry.py
 ``weights_provenance``) are refused at consolidation so they can never
 poison the corpus.
+
+**Manifests** make reads snapshot-isolated for the serving path
+(dedup/index_server.py): a manifest pins the exact fragment set (and
+centroids file) of one *generation*; readers open a generation and never
+see fragments published after it. Publication is two writes — the
+immutable ``manifests/gen-<N>.json`` first, then the tiny
+``MANIFEST.json`` pointer (atomic rename on local roots) — so a reader
+observes either the old or the new generation, never a half-published
+one. Background compaction (dedup/compaction.py) is the only writer of
+manifests; fragments referenced by a superseded manifest are deleted only
+after every reader has dropped that generation.
 """
 
 from __future__ import annotations
@@ -151,11 +165,106 @@ class IndexStore:
     def save_meta(self, meta: dict) -> None:
         write_json(self.meta_path, {**meta, "backend": self.backend})
 
-    def load_centroids(self) -> np.ndarray:
-        return np.load(io.BytesIO(read_bytes(self.centroids_path)))
+    def load_centroids(self, rel: str | None = None) -> np.ndarray:
+        """Centroids for ``rel`` (a manifest's pinned centroids file,
+        relative to the root) or the live ``centroids.npy``."""
+        path = f"{self.root}/{rel}" if rel else self.centroids_path
+        return np.load(io.BytesIO(read_bytes(path)))
 
-    def save_centroids(self, centroids: np.ndarray) -> None:
-        write_npy(self.centroids_path, np.asarray(centroids, np.float32))
+    def save_centroids(self, centroids: np.ndarray, *, generation: int | None = None) -> str:
+        """Write centroids; a ``generation`` writes an immutable per-gen
+        file (``centroids-<N>.npy``) so published manifests never see their
+        centroids mutate underneath them. Returns the root-relative path."""
+        rel = f"centroids-{generation:06d}.npy" if generation else "centroids.npy"
+        write_npy(f"{self.root}/{rel}", np.asarray(centroids, np.float32))
+        return rel
+
+    # -- manifests (snapshot-isolated read generations) ----------------------
+
+    @property
+    def manifest_pointer_path(self) -> str:
+        return f"{self.root}/MANIFEST.json"
+
+    def manifest_path(self, generation: int) -> str:
+        return f"{self.root}/manifests/gen-{generation:06d}.json"
+
+    def current_generation(self) -> int:
+        """The published generation, or 0 when no manifest exists yet
+        (generation 0 = the live, unpinned view)."""
+        client = get_storage_client(self.root)
+        if not client.exists(self.manifest_pointer_path):
+            return 0
+        try:
+            return int(json.loads(client.read_bytes(self.manifest_pointer_path))["generation"])
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            raise RuntimeError(f"unreadable manifest pointer at {self.root}: {e}") from e
+
+    def read_manifest(self, generation: int | None = None) -> dict:
+        """The manifest of ``generation`` (default: current). Generation 0
+        (or no published manifest) synthesizes a live manifest from the
+        current fragment listing — old indexes keep working unmanaged."""
+        gen = self.current_generation() if generation is None else generation
+        if gen <= 0:
+            return self.build_live_manifest()
+        client = get_storage_client(self.root)
+        try:
+            return json.loads(client.read_bytes(self.manifest_path(gen)))
+        except (OSError, ValueError) as e:
+            raise RuntimeError(
+                f"unreadable manifest gen {gen} at {self.root}: {e}"
+            ) from e
+
+    def build_live_manifest(self) -> dict:
+        """Generation-0 view: the CURRENT fragment listing, shaped like a
+        published manifest (per-cluster root-relative fragment paths +
+        bytes, live centroids/meta). Not isolated — concurrent writers are
+        visible — which is exactly why compaction publishes real ones."""
+        clusters: dict[str, dict] = {}
+        for cid in self.cluster_fragment_counts():
+            frags = self.fragment_info(f"clusters/{self.cluster_dir(cid)}")
+            clusters[str(cid)] = {
+                "fragments": [rel for rel, _sz in frags],
+                "bytes": int(sum(sz for _rel, sz in frags)),
+                "rows": 0,  # unknown without reading; compaction fills it
+            }
+        return {
+            "generation": 0,
+            "centroids": "centroids.npy",
+            "meta": self.load_meta(),
+            "clusters": clusters,
+        }
+
+    def publish_manifest(self, manifest: dict) -> int:
+        """Write the immutable generation file, then flip the pointer. The
+        pointer write is an atomic rename on local roots; on remote roots
+        it is a single small PUT (last-writer-wins — compaction is the
+        single manifest writer by contract)."""
+        gen = int(manifest["generation"])
+        if gen <= 0:
+            raise ValueError("published generations start at 1")
+        write_json(self.manifest_path(gen), manifest)
+        # LocalStorageClient.write_bytes is tmp+rename (atomic on POSIX);
+        # remote backends PUT one small object — either way a reader sees
+        # the old pointer or the new one, never a torn file
+        write_bytes(self.manifest_pointer_path, json.dumps({"generation": gen}).encode())
+        return gen
+
+    def list_manifests(self) -> list[int]:
+        base = f"{self.root}/manifests"
+        client = get_storage_client(base)
+        gens = []
+        for info in client.list_files(base, suffixes=(".json",)):
+            name = info.path.rsplit("/", 1)[-1]
+            if name.startswith("gen-") and name[4:-5].isdigit():
+                gens.append(int(name[4:-5]))
+        return sorted(gens)
+
+    def delete_manifest(self, generation: int) -> None:
+        client = get_storage_client(self.root)
+        try:
+            client.delete(self.manifest_path(generation))
+        except OSError:
+            logger.debug("manifest gen %d already gone", generation)
 
     # -- fragment IO ---------------------------------------------------------
 
@@ -218,6 +327,56 @@ class IndexStore:
 
     def _delete_fragment(self, path: str) -> None:
         get_storage_client(path).delete(path)
+
+    def _relpath(self, path: str) -> str:
+        """Root-relative form of a fragment path (manifests store relative
+        paths so an index directory is relocatable)."""
+        path = str(path)
+        prefix = f"{self.root}/"
+        return path[len(prefix):] if path.startswith(prefix) else path
+
+    def fragment_info(self, subdir: str) -> list[tuple[str, int]]:
+        """(root-relative path, size bytes) per fragment under ``subdir``.
+        Lance datasets are directories; their size is the sum of their
+        files (best-effort — sizing feeds cache budgets, not correctness)."""
+        out: list[tuple[str, int]] = []
+        for path in self._list_fragments(subdir):
+            if self.backend == "lance":
+                p = Path(path)
+                size = sum(f.stat().st_size for f in p.rglob("*") if f.is_file()) if p.is_dir() else 0
+            else:
+                client = get_storage_client(path)
+                size = 0
+                for info in client.list_files(path, suffixes=(".parquet",)):
+                    size += int(getattr(info, "size", 0) or 0)
+            out.append((self._relpath(path), size))
+        return out
+
+    def read_fragments(self, rel_paths: list[str]) -> tuple[list[str], np.ndarray]:
+        """Read a pinned fragment set (manifest entries, root-relative) as
+        one (ids, [N, D]) pair — the snapshot-isolated read path. A
+        fragment deleted after its manifest was superseded raises, which is
+        why GC waits for readers to drop the generation."""
+        ids: list[str] = []
+        chunks: list[np.ndarray] = []
+        for rel in rel_paths:
+            i, v, _m, _p = self._read_rows(f"{self.root}/{rel}")
+            ids.extend(i)
+            chunks.append(v)
+        vecs = np.concatenate(chunks) if chunks else np.zeros((0, 0), np.float32)
+        return ids, vecs
+
+    def delete_fragments(self, rel_paths: list[str]) -> int:
+        """Delete superseded fragments (compaction GC). Missing files are
+        fine — a crashed earlier GC may have removed some already."""
+        n = 0
+        for rel in rel_paths:
+            try:
+                self._delete_fragment(f"{self.root}/{rel}")
+                n += 1
+            except (OSError, FileNotFoundError):
+                logger.debug("fragment already gone: %s", rel)
+        return n
 
     # -- pending fragments (in-pipeline appends) -----------------------------
 
@@ -284,6 +443,16 @@ class IndexStore:
             ids.extend(i)
             chunks.append(v)
         vecs = np.concatenate(chunks) if chunks else np.zeros((0, 0), np.float32)
+        if len(set(ids)) != len(ids):
+            # the LIVE view can see a row twice between a compaction publish
+            # and GC (the consolidated fragment AND its superseded source).
+            # Dedup by id: duplicate rows would eat per-shard top-k slots in
+            # the query path (manifest readers pin exact sets and never hit
+            # this).
+            seen: set[str] = set()
+            keep = [i for i, u in enumerate(ids) if not (u in seen or seen.add(u))]
+            ids = [ids[i] for i in keep]
+            vecs = vecs[keep]
         return ids, vecs
 
     def cluster_fragment_counts(self) -> dict[int, int]:

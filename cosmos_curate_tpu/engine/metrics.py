@@ -35,7 +35,7 @@ class EngineMetrics:
     def __init__(self, port: int | None = None) -> None:
         self.enabled = False
         try:
-            from prometheus_client import Counter, Gauge
+            from prometheus_client import Counter, Gauge, Histogram
         except ImportError:
             return
         labels = ["stage"]
@@ -205,6 +205,50 @@ class EngineMetrics:
         self.index_skipped_random = Counter(
             "pipeline_index_skipped_random_total",
             "vectors refused for random-weight provenance", labels,
+        )
+        # Index-server read path (dedup/index_server.py + /v1/search): the
+        # latency SLO histogram (p50/p99 from the buckets), warm-shard-cache
+        # byte traffic (hit ratio by BYTES — a fat shard miss hurts more
+        # than a tiny one), compaction generations, and search sheds.
+        # Healthy serving reads as p99 inside the interactive bucket range,
+        # hit bytes >> miss bytes after warmup, and the generation gauge
+        # ticking up while latency stays flat (compaction never stalls
+        # reads — that is what the snapshots are for).
+        self.search_latency = Histogram(
+            "search_latency_seconds",
+            "similarity-search request latency (submit to results)",
+            labels + ["mode"],
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.search_requests = Counter(
+            "search_requests_total", "similarity-search requests served",
+            labels + ["mode"],
+        )
+        self.search_shed = Counter(
+            "search_shed_total",
+            "search requests shed with 429 (admission lane over capacity)",
+            labels + ["reason"],
+        )
+        self.index_cache_hit_bytes = Counter(
+            "index_cache_hit_bytes_total",
+            "shard bytes served from the warm cache", labels,
+        )
+        self.index_cache_miss_bytes = Counter(
+            "index_cache_miss_bytes_total",
+            "shard bytes faulted in from storage", labels,
+        )
+        self.index_cache_evicted_bytes = Counter(
+            "index_cache_evicted_bytes_total",
+            "shard bytes evicted under the byte budget", labels,
+        )
+        self.index_compactions = Counter(
+            "index_compactions_total", "compaction passes that published", labels,
+        )
+        self.index_generation = Gauge(
+            "index_generation",
+            "manifest generation (published by compaction / served by the "
+            "index server)", labels,
         )
         # Per-node flow (engine/runner.py metrics tick): workers placed on
         # and CPU units used per connected node — the per-node counterpart
@@ -396,6 +440,39 @@ class EngineMetrics:
             (self.index_skipped_random, "skipped_random"),
         ):
             counter.labels(stage).inc(max(0.0, float(deltas.get(key, 0))))
+
+    def observe_search(
+        self, name: str, mode: str, latency_s: float | None, deltas: dict
+    ) -> None:
+        """Fold one search-serving delta set (stage_timer.SEARCH_KEYS
+        schema) into the ``search_*`` / ``index_cache_*`` series."""
+        if not self.enabled:
+            return
+        if latency_s is not None:
+            self.search_latency.labels(name, mode).observe(max(0.0, float(latency_s)))
+            self.search_requests.labels(name, mode).inc()
+        for counter, key in (
+            (self.index_cache_hit_bytes, "cache_hit_bytes"),
+            (self.index_cache_miss_bytes, "cache_miss_bytes"),
+            (self.index_cache_evicted_bytes, "cache_evicted_bytes"),
+        ):
+            v = float(deltas.get(key, 0))
+            if v > 0:
+                counter.labels(name).inc(v)
+
+    def observe_search_shed(self, name: str, reason: str) -> None:
+        if self.enabled:
+            self.search_shed.labels(name, reason).inc()
+
+    def observe_compaction(self, name: str, generation: int) -> None:
+        if not self.enabled:
+            return
+        self.index_compactions.labels(name).inc()
+        self.index_generation.labels(name).set(int(generation))
+
+    def set_index_generation(self, name: str, generation: int) -> None:
+        if self.enabled:
+            self.index_generation.labels(name).set(int(generation))
 
     def observe_object_plane(self, node: str, deltas: dict) -> None:
         """Fold one object-plane delta set (stage_timer.OBJECT_PLANE_KEYS

@@ -104,6 +104,7 @@ class CLIPTextEmbeddings(ModelInterface):
         self._apply = None
         self._params = None
         self._pipeline = None
+        self._tokenizer = None
 
     @property
     def model_id_names(self) -> list[str]:
@@ -136,6 +137,153 @@ class CLIPTextEmbeddings(ModelInterface):
         if self._pipeline is None:
             raise RuntimeError("call setup() first")
         return self._pipeline.run(self._params, np.asarray(ids, np.int32))
+
+    @property
+    def provenance(self) -> str:
+        """Weights provenance of this tower RIGHT NOW (``"random"`` until a
+        converted checkpoint is staged) — the gate the index server checks
+        before serving text-to-clip queries."""
+        return registry.weights_provenance(self.variant)
+
+    def encode_texts(self, texts: list[str]) -> np.ndarray:
+        """Tokenized query path (text-to-clip search): strings -> L2-
+        normalized float32 [N, P]. Tokenizes with the staged CLIP BPE when
+        the checkpoint ships ``vocab.json``/``merges.txt``, else the
+        hermetic fallback (see :func:`clip_text_tokenizer`); sequences pad
+        to a shared pow2 length ≤ ``max_len`` so the compiled-shape
+        universe stays bounded."""
+        from cosmos_curate_tpu.models.batching import next_pow2
+
+        if not texts:
+            return np.zeros((0, self.cfg.projection_dim), np.float32)
+        if self._tokenizer is None:
+            self._tokenizer = clip_text_tokenizer(self.variant, self.cfg)
+        rows = [self._tokenizer.encode(t, max_len=self.cfg.max_len) for t in texts]
+        width = min(self.cfg.max_len, next_pow2(max(len(r) for r in rows)))
+        ids = np.zeros((len(rows), width), np.int32)  # pad id 0 < EOT: argmax pooling safe
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r[:width]
+        return self.encode_ids(ids)
+
+
+# ---------------------------------------------------------------------------
+# query tokenization (text-to-clip search)
+
+
+class CLIPTokenizer:
+    """CLIP's BPE (the HF ``vocab.json`` + ``merges.txt`` format the text
+    checkpoints ship): lowercased input, GPT-2 byte alphabet, ``</w>``
+    end-of-word marker, ``<|startoftext|>``/``<|endoftext|>`` wrapping. The
+    EOT id is the vocabulary maximum, which is what makes the encoder's
+    ``argmax`` pooling (CLIPTextEncoder) find it."""
+
+    def __init__(
+        self,
+        vocab: dict[str, int],
+        merges: list[tuple[str, str]],
+        *,
+        sot: str = "<|startoftext|>",
+        eot: str = "<|endoftext|>",
+    ) -> None:
+        import regex
+
+        self.vocab = vocab
+        self.ranks = {m: i for i, m in enumerate(merges)}
+        self.sot_id = vocab[sot]
+        self.eot_id = vocab[eot]
+        from cosmos_curate_tpu.models.tokenizer import _gpt2_byte_encoder
+
+        self._byte_enc = _gpt2_byte_encoder()
+        # CLIP's pre-tokenizer split (open_clip simple_tokenizer), \p classes
+        # need the `regex` module (already a repo dependency)
+        self._splitter = regex.compile(
+            r"'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+",
+            regex.IGNORECASE,
+        )
+        self._cache: dict[str, list[int]] = {}
+
+    @classmethod
+    def from_files(cls, vocab_json, merges_txt) -> "CLIPTokenizer":
+        import json as _json
+        from pathlib import Path
+
+        vocab = _json.loads(Path(vocab_json).read_text())
+        merges: list[tuple[str, str]] = []
+        for line in Path(merges_txt).read_text().splitlines():
+            if not line or line.startswith("#version"):
+                continue
+            left, _, right = line.partition(" ")
+            merges.append((left, right))
+        return cls(vocab, merges)
+
+    def _bpe(self, word: str) -> list[int]:
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        chars = "".join(self._byte_enc[b] for b in word.encode("utf-8"))
+        if not chars:
+            return []
+        parts = list(chars[:-1]) + [chars[-1] + "</w>"]
+        while len(parts) > 1:
+            best, best_rank = -1, None
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best_rank is None:
+                break
+            parts[best: best + 2] = [parts[best] + parts[best + 1]]
+        out = [self.vocab[p] for p in parts if p in self.vocab]
+        if len(self._cache) < 16384:
+            self._cache[word] = out
+        return out
+
+    def encode(self, text: str, *, max_len: int = 77) -> list[int]:
+        """[SOT] + BPE tokens + [EOT], truncated so EOT always survives
+        (the pooled feature is read at the EOT position)."""
+        ids = [self.sot_id]
+        for piece in self._splitter.findall(" ".join(text.lower().split())):
+            ids.extend(self._bpe(piece))
+        ids = ids[: max_len - 1]
+        ids.append(self.eot_id)
+        return ids
+
+
+class FallbackClipTokenizer:
+    """Hermetic stand-in when no tokenizer files are staged (tiny-test
+    configs, architecture-only runs): bytes fold into the body id range,
+    SOT/EOT take the two top ids so EOT stays the sequence argmax. Stable
+    and reversible enough for shape/latency tests — NOT a semantic
+    tokenizer, which is why text search is provenance-gated anyway."""
+
+    def __init__(self, vocab_size: int) -> None:
+        if vocab_size < 4:
+            raise ValueError("vocab too small for SOT/EOT + body ids")
+        self.sot_id = vocab_size - 2
+        self.eot_id = vocab_size - 1
+        self._body = vocab_size - 3  # ids 1..vocab-3; 0 stays the pad id
+
+    def encode(self, text: str, *, max_len: int = 77) -> list[int]:
+        ids = [self.sot_id]
+        ids.extend(1 + (b % self._body) for b in text.lower().encode("utf-8"))
+        ids = ids[: max_len - 1]
+        ids.append(self.eot_id)
+        return ids
+
+
+def clip_text_tokenizer(variant: str, cfg: CLIPTextConfig):
+    """The query tokenizer for ``variant``: the checkpoint's staged CLIP
+    BPE (``vocab.json`` + ``merges.txt``, pulled alongside the weights)
+    when present, else the hermetic fallback sized to the config vocab."""
+    try:
+        registry.maybe_pull_tokenizer_files(variant)
+    except Exception:  # offline/unstaged: the fallback below covers it
+        pass
+    vocab = registry.find_model_file(variant, "vocab.json")
+    merges = registry.find_model_file(variant, "merges.txt")
+    if vocab is not None and merges is not None:
+        return CLIPTokenizer.from_files(vocab, merges)
+    return FallbackClipTokenizer(cfg.vocab)
 
 
 registry.register_model("clip-text-b-tpu", "CLIP text tower, ViT-B width (Flax)")

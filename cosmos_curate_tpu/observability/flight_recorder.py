@@ -232,6 +232,7 @@ def runner_stats(runner: Any) -> dict:
         caption_phase_summaries,
         dispatch_summaries,
         index_op_summaries,
+        search_summaries,
         object_plane_summaries,
         stage_flow_summaries,
     )
@@ -243,6 +244,9 @@ def runner_stats(runner: Any) -> dict:
         # corpus-index traffic (adds/queries/probe fan-out per recorder
         # name) — the pipeline_index_* counters' end-of-run snapshot
         "index_ops": index_op_summaries(),
+        # index-server read path: request counts, latency p50/p99, warm
+        # shard-cache byte traffic, compaction generations
+        "search": search_summaries(),
         # cross-host transfers per node (driver's own + relayed agent
         # deltas); the engine runner also snapshots this as
         # ``runner.object_plane`` at finalize
@@ -325,6 +329,7 @@ def load_node_stats(output_path: str) -> dict | None:
         return None
     merged: dict[str, Any] = {
         "dispatch": {}, "stage_flow": {}, "caption_phases": {}, "index_ops": {},
+        "search": {},
         "object_plane": {}, "stage_times": {}, "stage_counts": {},
         "dead_lettered": 0,
     }
@@ -340,7 +345,7 @@ def load_node_stats(output_path: str) -> dict | None:
             continue
         found = True
         rank = stats.get("node_rank", "?")
-        for key in ("dispatch", "stage_flow", "caption_phases", "index_ops"):
+        for key in ("dispatch", "stage_flow", "caption_phases", "index_ops", "search"):
             for name, agg in (stats.get(key) or {}).items():
                 merged[key][f"n{rank}/{name}"] = agg
         # object-plane aggregates are already keyed per node: sum numeric
@@ -430,6 +435,7 @@ def build_run_report(
     report["stage_flow"] = stats["stage_flow"]
     report["caption_phases"] = stats["caption_phases"]
     report["index_ops"] = stats["index_ops"]
+    report["search"] = stats.get("search") or {}
     report["object_plane"] = stats["object_plane"]
     if stats.get("node_plan"):
         report["node_plan"] = stats["node_plan"]
@@ -457,7 +463,7 @@ def build_run_report(
         # stage_times/wall_s are handled above (they have span-derived
         # fallbacks that would always win this not-set check)
         for key in (
-            "dispatch", "stage_flow", "caption_phases", "index_ops",
+            "dispatch", "stage_flow", "caption_phases", "index_ops", "search",
             "object_plane", "node_plan", "node_events", "stage_counts",
             "dead_lettered", "dlq_run_dir",
         ):
@@ -599,6 +605,18 @@ def render_report(report: dict) -> str:
                 f"dupes {agg.get('duplicates', 0):6d}  "
                 f"probe_fanout {agg.get('probe_fanout_mean', 0.0):.2f}  "
                 f"query {agg.get('query_s', 0.0):.2f}s"
+            )
+    search = report.get("search") or {}
+    if search:
+        lines.append("search serving:")
+        for name, agg in sorted(search.items()):
+            lines.append(
+                f"  {name:<40} req {agg.get('searches', 0):7d}  "
+                f"p50 {agg.get('latency_p50_ms', 0.0):7.1f}ms  "
+                f"p99 {agg.get('latency_p99_ms', 0.0):7.1f}ms  "
+                f"qps {agg.get('qps', 0.0):8.1f}  "
+                f"cache_hit {agg.get('cache_hit_ratio', 0.0):.2f}  "
+                f"gen {agg.get('generation', 0)}"
             )
     caption = report.get("caption_phases") or {}
     if caption:

@@ -524,6 +524,94 @@ def reset_index_ops() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Search-serving aggregates (dedup/index_server.py + service /v1/search):
+# request counts, latency percentiles (bounded reservoir), warm-shard-cache
+# byte traffic, and compaction generations. The SLO surface of the
+# index-server read path: p50/p99 land in run_report.json and BENCH rows;
+# the ``search_latency_seconds`` prometheus histogram carries the stream.
+_SEARCH_LOCK = threading.Lock()
+_SEARCH: dict[str, dict] = {}
+_SEARCH_LATENCY_CAP = 4096
+
+SEARCH_KEYS = (
+    "searches", "queries", "search_s", "batches", "batched_requests",
+    "cache_hit_bytes", "cache_miss_bytes", "cache_evicted_bytes",
+    "compactions", "compaction_s", "generations_adopted", "shed",
+)
+
+
+def _new_search_agg() -> dict:
+    return {**{k: 0.0 for k in SEARCH_KEYS}, "generation": 0, "latencies": []}
+
+
+def record_search(
+    name: str, *, latency_s: float | None = None, mode: str = "clip",
+    generation: int | None = None, **deltas: float,
+) -> None:
+    """Fold search-serving deltas (any subset of SEARCH_KEYS) into
+    ``name``'s aggregate; ``latency_s`` lands in a bounded reservoir
+    (random replacement once full, so percentiles stay an unbiased sample
+    of the whole run, not the first N requests). Forwards to the
+    ``search_*`` prometheus series (no-op without an exporter)."""
+    with _SEARCH_LOCK:
+        agg = _SEARCH.setdefault(name, _new_search_agg())
+        for k, v in deltas.items():
+            if k in SEARCH_KEYS:
+                agg[k] += float(v)
+        if generation is not None:
+            agg["generation"] = max(agg["generation"], int(generation))
+        if latency_s is not None:
+            res = agg["latencies"]
+            if len(res) < _SEARCH_LATENCY_CAP:
+                res.append(float(latency_s))
+            else:
+                import random
+
+                res[random.randrange(_SEARCH_LATENCY_CAP)] = float(latency_s)
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_search(name, mode, latency_s, deltas)
+    except Exception:  # metrics must never take down the read path
+        pass
+
+
+def search_summaries() -> dict[str, dict]:
+    """name -> search aggregate with the SLO headline: ``latency_p50_ms``
+    / ``latency_p99_ms`` over the reservoir, ``qps`` (requests over summed
+    serving-loop BUSY seconds — ``search_s`` is recorded per micro-batch,
+    so many concurrent requests amortize one batch's wall and qps exceeds
+    1/latency), and ``cache_hit_ratio`` by bytes (hot path served from
+    resident shards)."""
+    import numpy as _np
+
+    out: dict[str, dict] = {}
+    with _SEARCH_LOCK:
+        items = {
+            k: {**v, "latencies": list(v["latencies"])} for k, v in _SEARCH.items()
+        }
+    for name, agg in items.items():
+        lat = agg.pop("latencies")
+        hit = agg["cache_hit_bytes"]
+        touched = hit + agg["cache_miss_bytes"]
+        out[name] = {
+            **{k: (round(agg[k], 4) if k.endswith("_s") else int(agg[k])) for k in SEARCH_KEYS},
+            "generation": int(agg["generation"]),
+            "latency_p50_ms": round(float(_np.percentile(lat, 50)) * 1e3, 3) if lat else 0.0,
+            "latency_p99_ms": round(float(_np.percentile(lat, 99)) * 1e3, 3) if lat else 0.0,
+            "qps": round(agg["searches"] / agg["search_s"], 2) if agg["search_s"] > 0 else 0.0,
+            "cache_hit_ratio": round(hit / touched, 4) if touched > 0 else 0.0,
+            "node": node_id(),
+        }
+    return out
+
+
+def reset_search() -> None:
+    with _SEARCH_LOCK:
+        _SEARCH.clear()
+
+
+# ---------------------------------------------------------------------------
 # Object-plane transfer aggregates (engine/object_channel.py consumers): how
 # many bytes crossed hosts, how long consumers WAITED for them, and whether
 # push-ahead prefetch hid the transfer behind compute. Bounded per-process

@@ -530,8 +530,10 @@ def _consolidate_corpus_index(args: SplitPipelineArgs) -> dict:
     """Fold the writer's pending index fragments into per-cluster shards
     (training centroids on the first run). Single-node only: concurrent
     per-node consolidations would race on centroids/meta — multi-node runs
-    leave pending fragments for `cosmos-curate-tpu index build` after
-    merge. Failures never fail the run."""
+    leave pending fragments for `cosmos-curate-tpu index consolidate`
+    after merge (chunk-scoped tags never collide across nodes, so the
+    merged pending set folds in one pass; no full `index build` re-read).
+    Failures never fail the run."""
     try:
         from cosmos_curate_tpu.dedup.corpus_index import consolidate_index
 

@@ -15,6 +15,11 @@ in this image):
   POST /v1/terminate/{job_id}   kill the job's whole process group
   POST /v1/requeue/{job_id}     dead_lettered/failed/terminated → pending
   GET  /v1/models               staged-weights registry status
+  POST /v1/search               similarity search over the corpus index
+                                (service/search.py; needs --index-path;
+                                 its own admission lane, sheds independently
+                                 of the job queue)
+  GET  /v1/search/stats         index-server generation/cache/lane stats
 
 Durability: every state transition is journaled append-only under
 ``work_root`` (service/job_queue.py). A ``kill -9``'d service replays the
@@ -565,24 +570,36 @@ def build_app(
     config: ServiceConfig | None = None,
     *,
     runner_cmd: Callable[[JobRecord, Path], list[str]] | None = None,
+    search_config=None,
 ) -> web.Application:
     cfg = config or ServiceConfig()
     state = ServiceState(work_root, cfg, runner_cmd=runner_cmd)
     app = web.Application()
     app["state"] = state
+    search_state = None
+    if search_config is not None and getattr(search_config, "index_path", ""):
+        # retrieval rides next to the job API, but with its OWN admission
+        # lane (service/search.py): searches shed on their own quota,
+        # independent of the job queue
+        from cosmos_curate_tpu.service.search import SearchState, register_search_routes
+
+        search_state = SearchState(search_config)
+        app["search"] = search_state
+        register_search_routes(app, search_state)
 
     async def health(request: web.Request) -> web.Response:
         running = state.running_records()
-        return web.json_response(
-            {
-                "status": "draining" if state.draining else "ok",
-                "active_job": running[0].job_id if running else None,
-                "num_jobs": len(state.jobs),
-                "states": state.state_counts(),
-                "queued": {lane: state.admission.lane_depth(lane) for lane in LANES},
-                "max_concurrent": state.admission.effective_max_running(),
-            }
-        )
+        out = {
+            "status": "draining" if state.draining else "ok",
+            "active_job": running[0].job_id if running else None,
+            "num_jobs": len(state.jobs),
+            "states": state.state_counts(),
+            "queued": {lane: state.admission.lane_depth(lane) for lane in LANES},
+            "max_concurrent": state.admission.effective_max_running(),
+        }
+        if search_state is not None:
+            out["search"] = search_state.stats()
+        return web.json_response(out)
 
     async def list_jobs(request: web.Request) -> web.Response:
         tenant = request.query.get("tenant", "")
@@ -873,12 +890,13 @@ def serve(
     port: int = 8080,
     work_root: str = "/tmp/curate_service",
     config: ServiceConfig | None = None,
+    search_config=None,
 ) -> None:
     """Run the service until SIGTERM/SIGINT, then drain gracefully."""
     cfg = config or ServiceConfig()
 
     async def _main() -> None:
-        app = build_app(work_root=work_root, config=cfg)
+        app = build_app(work_root=work_root, config=cfg, search_config=search_config)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, host, port)

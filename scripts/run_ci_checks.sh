@@ -6,9 +6,11 @@
 # corpus-index build/add/query smoke, plus (6) the durable-service gate
 # (crash-safe queue + kill -9 resume soak), plus (7) the node-loss gate
 # (failure detector + lineage reconstruction units; the agent-killing e2e
-# + soak run nightly). Individual gates can be skipped via
-# CI_SKIP=tier1,bench,multichip,index,service,nodeloss,static for local
-# use.
+# + soak run nightly), plus (8) the search-serving gate (index server over
+# HTTP: recall + generation-consistent results under concurrent
+# compaction). Individual gates can be skipped via
+# CI_SKIP=tier1,bench,multichip,index,service,nodeloss,search,static for
+# local use.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -70,6 +72,13 @@ if ! skip service; then
   echo "== durable-service checks (crash-safe queue, kill -9 resume soak) =="
   if ! bash scripts/run_service_checks.sh; then
     failures+=("service checks")
+  fi
+fi
+
+if ! skip search; then
+  echo "== search smoke (index server over HTTP: recall + concurrent compaction) =="
+  if ! JAX_PLATFORMS=cpu timeout -k 10 600 python scripts/search_smoke.py; then
+    failures+=("search smoke")
   fi
 fi
 

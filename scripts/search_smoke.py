@@ -1,0 +1,198 @@
+"""CI smoke for the index-server read path: build a tiny index, start the
+REAL HTTP search server (`index serve`), run clip-, uuid- and text-queries
+over the wire, assert IVF recall >= 0.95 vs exact cosine top-k, then fold
+pending fragments with a CONCURRENT `index compact` while hammering the
+server — every response must be generation-consistent and results for
+already-indexed content must not change. Exercised by
+scripts/run_ci_checks.sh (skip with CI_SKIP=search)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DIM = 16  # matches clip-text-tiny-test's projection_dim (text-query path)
+K = 6
+
+
+def post(port: int, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/search",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def wait_healthy(port: int, proc: subprocess.Popen, timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"server exited rc={proc.returncode}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=2
+            ) as resp:
+                if json.loads(resp.read()).get("status") == "ok":
+                    return
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError("server never became healthy")
+
+
+def main() -> int:
+    rng = np.random.default_rng(7)
+    centers = rng.standard_normal((K, DIM)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    corpus = np.concatenate(
+        [c + 0.05 * rng.standard_normal((40, DIM)) for c in centers]
+    ).astype(np.float32)
+    ids = [f"c{i}" for i in range(len(corpus))]
+
+    from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+    from cosmos_curate_tpu.dedup.index_store import IndexStore, normalize_rows
+
+    tmp = Path(tempfile.mkdtemp(prefix="search_smoke_"))
+    root = str(tmp / "idx")
+    CorpusIndex.build(root, ids, corpus, model="m", k=K)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # no CLIP checkpoint in CI: the text path runs the random-init tiny
+        # tower (provenance gate explicitly opted out; production refuses)
+        "CURATE_INDEX_ALLOW_RANDOM": "1",
+    }
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "cosmos_curate_tpu.cli.main", "index", "serve",
+            "--index-path", root, "--port", str(port),
+            "--text-model", "clip-text-tiny-test",
+        ],
+        cwd=str(REPO), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        wait_healthy(port, proc)
+
+        # -- clip-to-clip recall over the wire
+        queries = (corpus[::4] + 0.01 * rng.standard_normal((len(corpus[::4]), DIM))).astype(np.float32)
+        qn, cn = normalize_rows(queries), normalize_rows(corpus)
+        exact = np.argsort(-(qn @ cn.T), axis=1)[:, :5]
+        hits = [
+            post(port, {"embedding": [float(v) for v in q], "top_k": 5, "nprobe": 3})
+            for q in queries
+        ]
+        recall = sum(
+            len({r["clip_uuid"] for r in hits[i]["results"]} & {ids[j] for j in exact[i]}) / 5
+            for i in range(len(queries))
+        ) / len(queries)
+        assert recall >= 0.95, f"IVF recall over HTTP {recall} < 0.95"
+        gens = {h["generation"] for h in hits}
+        assert gens == {0}, gens
+
+        # -- uuid + text modes
+        by_uuid = post(port, {"clip_uuid": "c5", "top_k": 3})
+        assert by_uuid["results"][0]["clip_uuid"] == "c5", by_uuid
+        by_text = post(port, {"text": "a red car driving at night", "top_k": 4})
+        assert by_text["mode"] == "text" and len(by_text["results"]) == 4, by_text
+
+        # -- concurrent compaction changes no results
+        baseline = [
+            post(port, {"embedding": [float(v) for v in q], "top_k": 5})["results"]
+            for q in queries[:8]
+        ]
+        new = (rng.standard_normal((12, DIM)) * 3).astype(np.float32)
+        IndexStore(root).write_pending_fragment(
+            "smoke", [f"n{i}" for i in range(12)], new,
+            model="m", provenance="checkpoint:smoke",
+        )
+        stop = threading.Event()
+        observed: list[tuple[int, int, list[str]]] = []
+        errors: list[BaseException] = []
+
+        def hammer() -> None:
+            i = 0
+            while not stop.is_set():
+                qi = i % 8
+                try:
+                    r = post(port, {"embedding": [float(v) for v in queries[qi]], "top_k": 5})
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                observed.append((qi, r["generation"], [x["clip_uuid"] for x in r["results"]]))
+                i += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        compact = subprocess.run(
+            [sys.executable, "-m", "cosmos_curate_tpu.cli.main", "index", "compact",
+             "--index-path", root, "--no-mesh"],
+            cwd=str(REPO), env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert compact.returncode == 0, compact.stderr[-2000:]
+        report = json.loads(compact.stdout)
+        assert report["published"] and report["folded"] == 12, report
+        # keep hammering until the server adopts (adopt interval 1 s)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if post(port, {"clip_uuid": "c0", "top_k": 1})["generation"] == report["generation"]:
+                break
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:1]
+        gens = {g for _qi, g, _r in observed}
+        assert gens <= {0, report["generation"]}, gens
+        for qi, _gen, result_ids in observed:
+            want = [x["clip_uuid"] for x in baseline[qi]]
+            assert result_ids == want, (qi, result_ids, want)
+        # the folded vectors are servable post-adoption
+        folded = post(port, {"embedding": [float(v) for v in new[0]], "top_k": 1})
+        assert folded["results"][0]["clip_uuid"] == "n0", folded
+        assert folded["generation"] == report["generation"], folded
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/search/stats", timeout=5
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert stats["generation"] == report["generation"], stats
+        assert stats["cache"]["hit_bytes"] > 0, stats
+        print(
+            f"search smoke ok: recall@5 {recall:.3f} over HTTP, "
+            f"{len(observed)} queries concurrent with compaction "
+            f"(generations {sorted(gens)}), folded 12 vectors into "
+            f"generation {report['generation']}, cache hit bytes "
+            f"{stats['cache']['hit_bytes']}"
+        )
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

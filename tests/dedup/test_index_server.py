@@ -1,0 +1,442 @@
+"""Index-server read path: manifest generations, byte-budgeted warm shard
+cache, snapshot-isolated micro-batched search, background compaction
+(duplicate-free pending fold, skew rebalance, centroid refresh) — and the
+acceptance bar: recall ≥ 0.95 preserved across a compaction that runs
+concurrently with queries, every response generation-consistent."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.dedup.compaction import compact_index, gc_index
+from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex, shard_nbytes
+from cosmos_curate_tpu.dedup.index_server import (
+    IndexServer,
+    ProvenanceError,
+    ShardCache,
+)
+from cosmos_curate_tpu.dedup.index_store import IndexStore, normalize_rows
+
+DIM = 16
+K = 6
+
+
+def _corpus(rng, *, n_clusters=K, per=40, dim=DIM, spread=0.05):
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    vecs = np.concatenate(
+        [c + spread * rng.standard_normal((per, dim)) for c in centers]
+    ).astype(np.float32)
+    return [f"c{i}" for i in range(len(vecs))], vecs
+
+
+def _build(tmp_path, rng, **corpus_kw):
+    ids, vecs = _corpus(rng, **corpus_kw)
+    root = str(tmp_path / "idx")
+    CorpusIndex.build(root, ids, vecs, model="m", k=K)
+    return root, ids, vecs
+
+
+def _recall(hits, queries, ids, vecs, k=5):
+    qn, cn = normalize_rows(queries), normalize_rows(vecs)
+    exact = np.argsort(-(qn @ cn.T), axis=1)[:, :k]
+    return sum(
+        len({h for h, _ in hits[i][:k]} & {ids[j] for j in exact[i]}) / k
+        for i in range(len(queries))
+    ) / len(queries)
+
+
+# ---------------------------------------------------------------------------
+# store: manifests
+
+
+class TestManifests:
+    def test_publish_and_read(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        assert store.current_generation() == 0
+        live = store.build_live_manifest()
+        assert live["generation"] == 0 and len(live["clusters"]) >= K - 1
+        manifest = {**live, "generation": 1}
+        assert store.publish_manifest(manifest) == 1
+        assert store.current_generation() == 1
+        got = store.read_manifest()
+        assert got["generation"] == 1
+        assert got["clusters"].keys() == live["clusters"].keys()
+        assert store.list_manifests() == [1]
+
+    def test_read_fragments_pins_exact_set(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        manifest = store.build_live_manifest()
+        cid, info = next(iter(manifest["clusters"].items()))
+        got_ids, got_vecs = store.read_fragments(info["fragments"])
+        direct_ids, direct_vecs = store.read_cluster(int(cid))
+        assert got_ids == direct_ids
+        np.testing.assert_allclose(got_vecs, direct_vecs)
+        # appending AFTER the manifest was built is invisible to the pin
+        store.append_cluster(int(cid), ["zzz"], rng.standard_normal((1, DIM)).astype(np.float32))
+        again_ids, _ = store.read_fragments(info["fragments"])
+        assert again_ids == got_ids
+
+    def test_publish_rejects_gen_zero(self, tmp_path, rng):
+        root, _ids, _vecs = _build(tmp_path, rng)
+        with pytest.raises(ValueError):
+            IndexStore(root).publish_manifest({"generation": 0, "clusters": {}})
+
+
+# ---------------------------------------------------------------------------
+# warm shard cache
+
+
+class TestShardCache:
+    def _shard(self, rng, rows, dim=DIM):
+        ids = [f"s{i}" for i in range(rows)]
+        mat = rng.standard_normal((rows, dim)).astype(np.float32)
+        return ids, mat
+
+    def test_byte_budget_eviction(self, rng):
+        ids, mat = self._shard(rng, 32)
+        per = shard_nbytes(ids, mat)
+        cache = ShardCache(int(per * 2.5))
+        loads = []
+
+        def loader(tag):
+            def _l():
+                loads.append(tag)
+                return ids, mat
+
+            return _l
+
+        for cid in range(4):
+            cache.get(1, cid, loader(cid))
+        # budget fits 2 shards: the first two evicted, LRU order
+        assert cache.stats()["resident_shards"] == 2
+        assert cache.stats()["resident_bytes"] <= cache.budget
+        cache.get(1, 3, loader(3))
+        assert loads == [0, 1, 2, 3]  # shard 3 was a hit
+        cache.get(1, 0, loader(0))
+        assert loads == [0, 1, 2, 3, 0]  # shard 0 was evicted → reload
+
+    def test_one_fat_shard_cannot_evict_pinned_probe_union(self, rng):
+        small_ids, small_mat = self._shard(rng, 8)
+        fat_ids, fat_mat = self._shard(rng, 512)
+        cache = ShardCache(shard_nbytes(small_ids, small_mat) * 3)
+        pinned = frozenset({(1, 0), (1, 1)})
+        cache.get(1, 0, lambda: (small_ids, small_mat), pinned)
+        cache.get(1, 1, lambda: (small_ids, small_mat), pinned)
+        # the fat shard exceeds the whole budget: admission refuses it and
+        # the pinned probe union survives untouched
+        cache.get(1, 2, lambda: (fat_ids, fat_mat), pinned)
+        stats = cache.stats()
+        assert stats["resident_shards"] == 2
+        assert stats["miss_bytes"] > stats["hit_bytes"]
+
+    def test_drop_generation(self, rng):
+        ids, mat = self._shard(rng, 8)
+        cache = ShardCache(1 << 30)
+        cache.get(1, 0, lambda: (ids, mat))
+        cache.get(2, 0, lambda: (ids, mat))
+        freed = cache.drop_generation(1)
+        assert freed > 0
+        assert cache.stats()["resident_shards"] == 1
+        # gen-2 entry still a hit
+        hits_before = cache.stats()["hit_bytes"]
+        cache.get(2, 0, lambda: (_ for _ in ()).throw(AssertionError("reload")))
+        assert cache.stats()["hit_bytes"] > hits_before
+
+
+class TestCorpusIndexByteBudget:
+    def test_fat_cluster_does_not_evict_probe_union(self, tmp_path, rng, monkeypatch):
+        """The serving-path sizing fix: with a byte budget, a query whose
+        probe union fits stays cached even when one fat cluster would have
+        rolled an entry-count cache."""
+        root, ids, vecs = _build(tmp_path, rng)
+        index = CorpusIndex.open(root)
+        sample_ids, sample = index.store.read_cluster(
+            int(next(iter(index.store.cluster_fragment_counts())))
+        )
+        budget = shard_nbytes(sample_ids, sample) * 3
+        monkeypatch.setenv("CURATE_INDEX_CACHE_BYTES", str(budget))
+        index.query(vecs[:4], top_k=3, nprobe=2)
+        stats = index.cache.stats()
+        assert stats["resident_bytes"] <= budget
+        assert stats["resident_shards"] >= 1
+
+    def test_entry_cap_still_bounds(self, tmp_path, rng, monkeypatch):
+        root, ids, vecs = _build(tmp_path, rng)
+        monkeypatch.setenv("CURATE_INDEX_CACHE_SHARDS", "2")
+        index = CorpusIndex.open(root)
+        index.query(vecs[:8], top_k=3, nprobe=4)
+        assert index.cache.stats()["resident_shards"] <= 2
+
+
+# ---------------------------------------------------------------------------
+# the server
+
+
+class TestIndexServer:
+    def test_recall_and_microbatching(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        srv = IndexServer(root, batch_window_s=0.005)
+        try:
+            queries = (vecs[::5] + 0.01 * rng.standard_normal((len(vecs[::5]), DIM))).astype(np.float32)
+            results = [None] * len(queries)
+
+            def one(i):
+                hits, gen = srv.search(queries[i], top_k=5)
+                results[i] = (hits[0], gen)
+
+            threads = [threading.Thread(target=one, args=(i,)) for i in range(len(queries))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            hits = [r[0] for r in results]
+            assert {g for _h, g in results} == {0}  # one consistent generation
+            assert _recall(hits, queries, ids, vecs) >= 0.95
+        finally:
+            srv.close()
+
+    def test_warmup_loads_hottest_clusters(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        srv = IndexServer(root)
+        try:
+            assert srv.warmed_bytes > 0
+            stats = srv.stats()
+            assert stats["cache"]["resident_shards"] >= 1
+            assert stats["cache"]["resident_bytes"] <= stats["cache"]["budget_bytes"]
+            # a warm query over indexed vectors touches no storage
+            miss_before = srv.cache.stats()["miss_bytes"]
+            srv.search(vecs[0], top_k=3)
+            assert srv.cache.stats()["miss_bytes"] == miss_before
+        finally:
+            srv.close()
+
+    def test_uuid_search(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        srv = IndexServer(root)
+        try:
+            hits, _gen = srv.search(clip_uuid="c7", top_k=3)
+            assert hits[0][0][0] == "c7"  # the clip itself is its own top hit
+            assert hits[0][0][1] == pytest.approx(1.0, abs=1e-4)
+            with pytest.raises(KeyError):
+                srv.search(clip_uuid="not-indexed")
+        finally:
+            srv.close()
+
+    def test_text_search_provenance_gated(self, tmp_path, rng, monkeypatch):
+        root, ids, vecs = _build(tmp_path, rng)
+        srv = IndexServer(root, text_model="clip-text-tiny-test")
+        try:
+            monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+            with pytest.raises(ProvenanceError):
+                srv.search(text="a red car")
+            monkeypatch.setenv("CURATE_INDEX_ALLOW_RANDOM", "1")
+            hits, _gen = srv.search(text="a red car", top_k=4)
+            assert len(hits[0]) == 4
+        finally:
+            srv.close()
+
+    def test_dim_mismatch_rejected(self, tmp_path, rng):
+        root, _ids, _vecs = _build(tmp_path, rng)
+        srv = IndexServer(root)
+        try:
+            with pytest.raises(ValueError):
+                srv.search(np.zeros(DIM + 1, np.float32))
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# compaction
+
+
+class TestCompaction:
+    def test_fold_pending_duplicate_free(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        new = rng.standard_normal((8, DIM)).astype(np.float32)
+        new_ids = [f"n{i}" for i in range(8)]
+        store.write_pending_fragment("t0", new_ids, new, model="m", provenance="checkpoint:ab")
+        # the same rows twice (a crashed fold re-run): folded exactly once
+        store.write_pending_fragment("t1", new_ids, new, model="m", provenance="checkpoint:ab")
+        report = compact_index(root)
+        assert report["published"] and report["generation"] == 1
+        assert report["folded"] == 8 and report["duplicates_dropped"] == 8
+        assert report["pending_cleared"] == 2
+        index = CorpusIndex.open(root)
+        assert index.meta["num_vectors"] == len(ids) + 8
+        hits = index.query(new, top_k=1)
+        assert [h[0][0] for h in hits] == new_ids
+        # a second pass over already-folded content publishes nothing
+        report2 = compact_index(root)
+        assert not report2["published"]
+        assert CorpusIndex.open(root).meta["num_vectors"] == len(ids) + 8
+
+    def test_duplicates_only_pending_clears_without_publish(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        compact_index(root, force=True)  # establish gen 1
+        store.write_pending_fragment("t0", ids[:4], vecs[:4], model="m", provenance="checkpoint:ab")
+        report = compact_index(root)
+        assert not report["published"]
+        assert report["duplicates_dropped"] == 4
+        assert report["pending_cleared"] == 1
+        assert store.list_pending() == []
+
+    def test_random_provenance_refused(self, tmp_path, rng, monkeypatch):
+        monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+        root, ids, _vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        store.write_pending_fragment(
+            "t0", ["r0", "r1"], rng.standard_normal((2, DIM)).astype(np.float32),
+            model="m", provenance="random",
+        )
+        report = compact_index(root)
+        assert report["skipped_random"] == 2 and report["folded"] == 0
+        assert not report["published"]
+        assert store.list_pending() == []  # refused rows don't linger
+
+    def test_rebalance_splits_fat_cluster(self, tmp_path, rng):
+        # one cluster holds ~10x the mean → compaction must split it
+        centers = rng.standard_normal((3, DIM)).astype(np.float32)
+        centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+        sizes = (200, 10, 10)
+        vecs = np.concatenate([
+            c + 0.03 * rng.standard_normal((n, DIM))
+            for c, n in zip(centers, sizes)
+        ]).astype(np.float32)
+        ids = [f"v{i}" for i in range(len(vecs))]
+        root = str(tmp_path / "skew")
+        CorpusIndex.build(root, ids, vecs, model="m", k=3)
+        report = compact_index(root, rebalance_factor=1.5, min_split_rows=32)
+        assert report["published"]
+        assert report["clusters_split"] >= 1
+        assert report["rows_moved"] > 0
+        index = CorpusIndex.open(root)
+        assert index.centroids.shape[0] > 3  # k grew
+        queries = vecs[::7] + 0.01 * rng.standard_normal((len(vecs[::7]), DIM)).astype(np.float32)
+        hits = index.query(queries.astype(np.float32), top_k=5, nprobe=3)
+        assert _recall(hits, queries.astype(np.float32), ids, vecs) >= 0.95
+
+    def test_absorbs_post_publish_add_fragments(self, tmp_path, rng):
+        """Rows appended via CorpusIndex.add AFTER a generation was
+        published (the `index consolidate` path) must enter the next
+        manifest — and survive a full GC sweep."""
+        root, ids, vecs = _build(tmp_path, rng)
+        compact_index(root, force=True)  # gen 1 exists
+        index = CorpusIndex.open(root)
+        added = rng.standard_normal((4, DIM)).astype(np.float32)
+        index.add([f"a{i}" for i in range(4)], added)
+        report = compact_index(root)
+        assert report["published"] and report["absorbed"] == 4
+        store = IndexStore(root)
+        manifest = store.read_manifest()
+        pinned_ids = set()
+        for info in manifest["clusters"].values():
+            pinned_ids.update(store.read_fragments(info["fragments"])[0])
+        assert {f"a{i}" for i in range(4)} <= pinned_ids
+        gc_index(store)  # the sweep must not destroy the absorbed rows
+        hits = CorpusIndex.open(root).query(added, top_k=1)
+        assert [h[0][0] for h in hits] == [f"a{i}" for i in range(4)]
+
+    def test_negative_nprobe_clamps(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        index = CorpusIndex.open(root)
+        hits = index.query(vecs[:2], top_k=3, nprobe=-1)
+        assert all(len(h) == 3 for h in hits)  # clamped to 1 probe, not K-1
+
+    def test_close_drains_pending_requests(self, tmp_path, rng):
+        root, _ids, vecs = _build(tmp_path, rng)
+        srv = IndexServer(root, warmup=False)
+        srv.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.search(vecs[0])
+
+    def test_gc_reclaims_superseded_fragments(self, tmp_path, rng):
+        root, ids, vecs = _build(tmp_path, rng)
+        store = IndexStore(root)
+        store.write_pending_fragment(
+            "t0", ["n0"], rng.standard_normal((1, DIM)).astype(np.float32),
+            model="m", provenance="checkpoint:ab",
+        )
+        report = compact_index(root, gc=False)
+        assert report["published"]
+        # superseded fragments still on disk (snapshot readers may hold them)
+        manifest = store.read_manifest()
+        assert manifest["superseded"]
+        n = gc_index(store)
+        assert n == len(manifest["superseded"])
+        # post-GC: the live listing equals the manifest's pinned set...
+        live = store.build_live_manifest()
+        live_frags = {f for c in live["clusters"].values() for f in c["fragments"]}
+        pinned = {f for c in manifest["clusters"].values() for f in c["fragments"]}
+        assert live_frags == pinned
+        # ...and batch-reader recall is intact
+        index = CorpusIndex.open(root)
+        hits = index.query(vecs[:8], top_k=5, nprobe=3)
+        assert _recall(hits, vecs[:8], ids, vecs) >= 0.95
+
+    def test_compaction_concurrent_with_queries_snapshot_isolated(self, tmp_path, rng):
+        """The acceptance bar: queries hammering the server while compaction
+        folds pending + publishes return generation-consistent results, the
+        result set never changes for already-indexed content, and recall
+        holds ≥ 0.95 before AND after adoption."""
+        root, ids, vecs = _build(tmp_path, rng, per=60)
+        store = IndexStore(root)
+        queries = (vecs[::6] + 0.01 * rng.standard_normal((len(vecs[::6]), DIM))).astype(np.float32)
+        srv = IndexServer(root, batch_window_s=0.001, adopt_interval_s=0.0)
+        try:
+            baseline = [srv.search(q, top_k=5)[0][0] for q in queries]
+            new = rng.standard_normal((16, DIM)).astype(np.float32) * 3  # far from corpus
+            store.write_pending_fragment(
+                "t0", [f"n{i}" for i in range(16)], new, model="m",
+                provenance="checkpoint:ab",
+            )
+            stop = threading.Event()
+            observed: list[tuple[int, int, list]] = []
+            errors: list[BaseException] = []
+
+            def hammer(tid):
+                i = 0
+                while not stop.is_set():
+                    qi = (tid * 7 + i) % len(queries)
+                    try:
+                        hits, gen = srv.search(queries[qi], top_k=5)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+                    observed.append((qi, gen, [h for h, _s in hits[0]]))
+                    i += 1
+
+            threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+            for t in threads:
+                t.start()
+            report = compact_index(root)
+            # keep querying until the server adopts the new generation
+            deadline = 200
+            while srv.generation < report["generation"] and deadline:
+                srv.search(queries[0], top_k=5)
+                deadline -= 1
+            stop.set()
+            for t in threads:
+                t.join()
+            assert not errors
+            assert report["published"] and report["folded"] == 16
+            gens = {g for _qi, g, _h in observed}
+            assert gens <= {0, report["generation"]}  # never a half-published state
+            assert srv.generation == report["generation"]
+            # already-indexed content answers identically in BOTH generations
+            for qi, _gen, hit_ids in observed:
+                assert hit_ids == [h for h, _s in baseline[qi]]
+            after = [srv.search(q, top_k=5)[0][0] for q in queries]
+            assert _recall(after, queries, ids, vecs) >= 0.95
+            # and the folded vectors are findable post-adoption
+            hits, gen = srv.search(new[0], top_k=1)
+            assert gen == report["generation"] and hits[0][0][0] == "n0"
+        finally:
+            srv.close()

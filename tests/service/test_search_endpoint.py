@@ -1,0 +1,229 @@
+"""/v1/search over a real aiohttp test server: clip/uuid/text modes, the
+search admission lane (sheds independently of the job queue), provenance
+gating, and the standalone `index serve` app."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from cosmos_curate_tpu.dedup.corpus_index import CorpusIndex
+from cosmos_curate_tpu.service.app import build_app
+from cosmos_curate_tpu.service.search import SearchConfig, SearchLane, build_search_app
+
+DIM = 16
+K = 4
+
+
+@pytest.fixture
+def index_root(tmp_path, rng):
+    centers = rng.standard_normal((K, DIM)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    vecs = np.concatenate(
+        [c + 0.05 * rng.standard_normal((20, DIM)) for c in centers]
+    ).astype(np.float32)
+    ids = [f"c{i}" for i in range(len(vecs))]
+    root = str(tmp_path / "idx")
+    CorpusIndex.build(root, ids, vecs, model="m", k=K)
+    return root, ids, vecs
+
+
+def _make_client(app):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    loop = asyncio.new_event_loop()
+
+    async def make():
+        return TestClient(TestServer(app))
+
+    c = loop.run_until_complete(make())
+    loop.run_until_complete(c.start_server())
+    return c, loop
+
+
+def _close(client_loop):
+    client, loop = client_loop
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def _req(client_loop, method, path, **kw):
+    client, loop = client_loop
+
+    async def go():
+        resp = await client.request(method, path, **kw)
+        return resp.status, await resp.json(), resp.headers
+
+    return loop.run_until_complete(go())
+
+
+@pytest.fixture
+def client(tmp_path, index_root):
+    root, _ids, _vecs = index_root
+    app = build_app(
+        work_root=str(tmp_path / "service"),
+        search_config=SearchConfig(
+            index_path=root, text_model="clip-text-tiny-test", batch_window_s=0.001
+        ),
+    )
+    cl = _make_client(app)
+    yield cl
+    _close(cl)
+
+
+class TestSearchEndpoint:
+    def test_clip_search(self, client, index_root):
+        _root, ids, vecs = index_root
+        status, body, _h = _req(
+            client, "POST", "/v1/search",
+            json={"embedding": [float(v) for v in vecs[3]], "top_k": 5},
+        )
+        assert status == 200
+        assert body["mode"] == "clip"
+        assert body["generation"] == 0
+        assert body["results"][0]["clip_uuid"] == "c3"
+        assert body["results"][0]["score"] == pytest.approx(1.0, abs=1e-4)
+        assert len(body["results"]) == 5
+        assert body["latency_ms"] > 0
+
+    def test_uuid_search_and_404(self, client):
+        status, body, _h = _req(
+            client, "POST", "/v1/search", json={"clip_uuid": "c7", "top_k": 3}
+        )
+        assert status == 200
+        assert body["mode"] == "uuid"
+        assert body["results"][0]["clip_uuid"] == "c7"
+        status, body, _h = _req(
+            client, "POST", "/v1/search", json={"clip_uuid": "nope"}
+        )
+        assert status == 404
+
+    def test_text_search_provenance_gate(self, client, monkeypatch):
+        monkeypatch.delenv("CURATE_INDEX_ALLOW_RANDOM", raising=False)
+        status, body, _h = _req(
+            client, "POST", "/v1/search", json={"text": "a red car"}
+        )
+        assert status == 403
+        assert "random" in body["error"]
+        monkeypatch.setenv("CURATE_INDEX_ALLOW_RANDOM", "1")
+        status, body, _h = _req(
+            client, "POST", "/v1/search", json={"text": "a red car", "top_k": 4}
+        )
+        assert status == 200
+        assert body["mode"] == "text" and len(body["results"]) == 4
+
+    def test_validation(self, client):
+        for bad in (
+            {},  # no mode
+            {"embedding": [1.0], "text": "x"},  # two modes
+            {"embedding": "nope"},
+            {"embedding": []},
+            {"text": "   "},
+            {"clip_uuid": 7},
+            {"embedding": [1.0] * DIM, "top_k": 0},
+            {"embedding": [1.0] * DIM, "top_k": "x"},
+            {"embedding": [1.0] * DIM, "nprobe": -1},
+            {"embedding": [1.0] * DIM, "nprobe": 100000},
+        ):
+            status, _b, _h = _req(client, "POST", "/v1/search", json=bad)
+            assert status == 400, bad
+        # wrong dim → 400 from the server-side check
+        status, body, _h = _req(
+            client, "POST", "/v1/search", json={"embedding": [1.0] * (DIM + 1)}
+        )
+        assert status == 400
+        status, _b, _h = _req(client, "POST", "/v1/search", data=b"not json")
+        assert status == 400
+
+    def test_health_carries_search_section(self, client):
+        status, body, _h = _req(client, "GET", "/health")
+        assert status == 200
+        assert body["search"]["enabled"] is True
+        assert body["search"]["generation"] == 0
+        assert body["search"]["num_vectors"] == 80
+        status, body, _h = _req(client, "GET", "/v1/search/stats")
+        assert status == 200
+        assert body["cache"]["budget_bytes"] > 0
+
+    def test_search_lane_sheds_independently(self, tmp_path, index_root):
+        """Lane at zero capacity: search sheds 429 + Retry-After while job
+        submission still works — independent admission."""
+        root, _ids, vecs = index_root
+        app = build_app(
+            work_root=str(tmp_path / "svc2"),
+            search_config=SearchConfig(
+                index_path=root, max_inflight=0, max_waiting=0,
+            ),
+        )
+        cl = _make_client(app)
+        try:
+            status, body, headers = _req(
+                cl, "POST", "/v1/search", json={"embedding": [float(v) for v in vecs[0]]}
+            )
+            assert status == 429
+            assert "Retry-After" in headers
+            assert body["retry_after_s"] > 0
+            # the job lanes are untouched by the search shed
+            status, body, _h = _req(
+                cl, "POST", "/v1/invoke",
+                json={"pipeline": "split", "args": {}, "tenant": "t1"},
+            )
+            assert status == 200
+            _req(cl, "POST", f"/v1/terminate/{body['job_id']}")
+        finally:
+            _close(cl)
+
+    def test_no_index_configured(self, tmp_path):
+        app = build_app(work_root=str(tmp_path / "svc3"))
+        client, loop = cl = _make_client(app)
+        try:
+            # without search_config the route is absent entirely
+
+            async def go():
+                resp = await client.request("POST", "/v1/search", json={"text": "x"})
+                return resp.status
+
+            assert loop.run_until_complete(go()) == 404
+        finally:
+            _close(cl)
+
+    def test_missing_index_dir_gives_503(self, tmp_path):
+        app = build_app(
+            work_root=str(tmp_path / "svc4"),
+            search_config=SearchConfig(index_path=str(tmp_path / "no-such-index")),
+        )
+        cl = _make_client(app)
+        try:
+            status, body, _h = _req(cl, "POST", "/v1/search", json={"text": "x"})
+            assert status == 503
+        finally:
+            _close(cl)
+
+
+class TestStandaloneSearchApp:
+    def test_index_serve_app(self, index_root):
+        root, _ids, vecs = index_root
+        app = build_search_app(SearchConfig(index_path=root))
+        cl = _make_client(app)
+        try:
+            status, body, _h = _req(cl, "GET", "/health")
+            assert status == 200 and body["status"] == "ok"
+            status, body, _h = _req(
+                cl, "POST", "/v1/search",
+                json={"embedding": [float(v) for v in vecs[10]], "top_k": 3},
+            )
+            assert status == 200
+            assert body["results"][0]["clip_uuid"] == "c10"
+        finally:
+            _close(cl)
+
+
+class TestSearchLaneUnit:
+    def test_acquire_release_and_retry_after(self):
+        lane = SearchLane(SearchConfig(max_inflight=2, max_waiting=1, retry_after_s=2.0))
+        assert lane.try_acquire() and lane.try_acquire() and lane.try_acquire()
+        assert not lane.try_acquire()  # 2 inflight + 1 waiting = full
+        assert lane.shed_total == 1
+        assert lane.retry_after_s() >= 2.0
+        lane.release()
+        assert lane.try_acquire()
