@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
     from cosmos_curate_tpu.cli import report_cli
 
     report_cli.register(sub)
+    from cosmos_curate_tpu.cli import top_cli
+
+    top_cli.register(sub)
     from cosmos_curate_tpu.cli import index_cli
 
     index_cli.register(sub)

@@ -10,6 +10,12 @@ device-dispatch / flow time breakdowns.
 ``run_report.json``). ``--rebuild`` regenerates the report from the run's
 collected trace artifacts — useful after copying a run directory around or
 when the run predates the recorder.
+
+A LIVE run has no ``run_report.json`` yet — instead of failing, the CLI
+falls back to the run's live ops snapshot
+(``<out>/report/live/status.json``) under a clear ``RUN IN PROGRESS``
+banner; ``--follow`` keeps refreshing that view and renders the final
+flight-recorder report the moment finalize writes it.
 """
 
 from __future__ import annotations
@@ -17,13 +23,15 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def register(sub: argparse._SubParsersAction) -> None:
     rep = sub.add_parser(
         "report",
         help="render a run's flight-recorder report (critical path, "
-        "per-stage time, trace connectivity)",
+        "per-stage time, trace connectivity); live runs render their "
+        "in-flight snapshot instead",
     )
     rep.add_argument("run", help="pipeline output root (or a run_report.json path)")
     rep.add_argument("--json", action="store_true", dest="as_json", help="raw JSON")
@@ -32,7 +40,35 @@ def register(sub: argparse._SubParsersAction) -> None:
         action="store_true",
         help="regenerate the report from the run's trace artifacts first",
     )
+    rep.add_argument(
+        "--follow",
+        action="store_true",
+        help="refresh the live view until the final report lands, then "
+        "render it",
+    )
+    rep.add_argument(
+        "--interval", type=float, default=2.0, help="--follow refresh seconds"
+    )
     rep.set_defaults(func=_cmd_report)
+
+
+def _render_live(root: str, as_json: bool) -> bool:
+    """Render the live snapshot under a RUN IN PROGRESS banner; False when
+    there is no snapshot to show."""
+    from cosmos_curate_tpu.observability.live_status import read_status, render_status
+
+    snap = read_status(root)
+    if snap is None:
+        return False
+    if as_json:
+        print(json.dumps(snap))
+        return True
+    state = str(snap.get("state", "running")).upper()
+    banner = "RUN IN PROGRESS" if state == "RUNNING" else f"RUN {state}"
+    print("=" * 22, banner, "=" * 22)
+    print("(no run_report.json yet — rendering the live ops snapshot)")
+    print(render_status(snap))
+    return True
 
 
 def _cmd_report(args: argparse.Namespace) -> int:
@@ -54,6 +90,32 @@ def _cmd_report(args: argparse.Namespace) -> int:
     else:
         path = report_path(run)
         root = run
+    if args.follow and root is not None:
+        # live loop: render the in-flight snapshot until finalize writes
+        # the real report (then fall through and render that) — or until
+        # the snapshot goes terminal on an UNTRACED run, which never
+        # writes run_report.json (the final live frame is the exit)
+        from cosmos_curate_tpu.observability.live_status import read_status
+
+        while load_report(path) is None:
+            if not args.as_json:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            snap = read_status(root)
+            if not _render_live(root, args.as_json):
+                print(f"waiting for a live snapshot under {root} ...")
+            sys.stdout.flush()
+            if snap is not None and snap.get("state") != "running":
+                # run over. Traced runs write the report a few seconds
+                # AFTER the terminal snapshot (artifact collection runs in
+                # between) — grace-poll before concluding this run is
+                # untraced and the live frame is final.
+                deadline = time.monotonic() + max(2.0, 3 * args.interval)
+                while load_report(path) is None:
+                    if time.monotonic() >= deadline:
+                        return 0  # untraced: no report is ever coming
+                    time.sleep(0.2)
+                break  # report landed: fall through and render it
+            time.sleep(max(0.2, args.interval))
     existing: dict | None = None
     try:
         existing = load_report(path, strict=True)
@@ -61,6 +123,17 @@ def _cmd_report(args: argparse.Namespace) -> int:
         print(f"error: {e}", file=sys.stderr)
         if not args.rebuild:
             return 2
+    if existing is None and not args.rebuild and root is not None:
+        # a LIVE run has no report yet: show the in-flight view with a
+        # clear banner instead of failing on the missing artifact.
+        # Finished runs fall through to the rebuild-from-traces path — a
+        # terminal snapshot is strictly poorer than a rebuilt report.
+        from cosmos_curate_tpu.observability.live_status import read_status
+
+        snap = read_status(root)
+        if snap is not None and snap.get("state") == "running":
+            _render_live(root, args.as_json)
+            return 0
     if args.rebuild or existing is None:
         if root is None:
             print(

@@ -53,6 +53,27 @@ def register(sub: argparse._SubParsersAction) -> None:
         help="expose service_*/pipeline_* prometheus metrics on this port",
     )
     serve.add_argument(
+        "--slo-queue-wait-s", type=float, default=0.0,
+        help="per-tenant SLO: max pending->running wait before a breach "
+        "(0 disables; breaches land in service_slo_breaches_total and "
+        "GET /v1/slo)",
+    )
+    serve.add_argument(
+        "--slo-run-duration-s", type=float, default=0.0,
+        help="per-tenant SLO: max run duration for a successful job (0 "
+        "disables)",
+    )
+    serve.add_argument(
+        "--slo-success-rate", type=float, default=0.0,
+        help="per-tenant SLO: min done-fraction over the rolling outcome "
+        "window, in (0, 1] (0 disables)",
+    )
+    serve.add_argument(
+        "--slo-window", type=int, default=100,
+        help="rolling terminal-outcome window per tenant for the "
+        "success-rate SLO",
+    )
+    serve.add_argument(
         "--index-path", default="",
         help="corpus index root: enables POST /v1/search (index-server "
         "read path with its own admission lane — see docs/SERVICE.md)",
@@ -85,8 +106,15 @@ def register(sub: argparse._SubParsersAction) -> None:
 def _cmd_serve(args: argparse.Namespace) -> int:
     from cosmos_curate_tpu.service.admission import QuotaConfig
     from cosmos_curate_tpu.service.app import ServiceConfig, serve
+    from cosmos_curate_tpu.service.slo import SloConfig
 
     config = ServiceConfig(
+        slo=SloConfig(
+            queue_wait_s=args.slo_queue_wait_s,
+            run_duration_s=args.slo_run_duration_s,
+            success_rate=args.slo_success_rate,
+            window=args.slo_window,
+        ),
         quota=QuotaConfig(
             max_concurrent_jobs=args.max_concurrent,
             max_running_per_tenant=args.max_running_per_tenant,

@@ -175,6 +175,10 @@ class _StageRuntime:
         self.finalized = False
         self.next_worker_idx = 0
         self.next_batch_id = 0
+        # in-flight batches for the live ops plane: batch_id -> {started,
+        # worker, attempt} (guarded by self.lock). A hung process_data is
+        # visible here the whole time it hangs — the stuck_batch signal.
+        self.inflight: dict[int, dict] = {}
         # accounting (guarded by self.lock)
         self.busy_s = 0.0
         self.samples: deque = deque(maxlen=256)  # (t_end, batch_seconds)
@@ -312,6 +316,13 @@ class PipelinedRunner(RunnerInterface):
         budget = self._budget(node)
         self._apply_allocation(runtimes, self._plan(runtimes, budget), cfg)
 
+        # live ops plane: snapshots + stall detection, on when run_split
+        # (or an operator) exported CURATE_LIVE_STATUS_DIR; zero overhead
+        # otherwise. Published from THIS loop — never the worker threads.
+        from cosmos_curate_tpu.observability.live_status import LiveStatusPublisher
+
+        publisher = LiveStatusPublisher.from_env(runner="pipelined")
+
         last_replan = time.monotonic()
         try:
             while not self._abort.is_set():
@@ -330,6 +341,10 @@ class PipelinedRunner(RunnerInterface):
                     self._apply_allocation(runtimes, self._plan(runtimes, budget), cfg)
                     self._export_flow(runtimes)
                     last_replan = now
+                if publisher is not None:
+                    publisher.maybe_publish(
+                        lambda: self._build_live_snapshot(runtimes)
+                    )
                 time.sleep(self.poll_interval_s)
         finally:
             # ANY exit path — normal, abort, or a foreign exception like
@@ -365,6 +380,11 @@ class PipelinedRunner(RunnerInterface):
             self.pipeline_wall_s = time.monotonic() - t_start
             self._export_flow(runtimes)  # final gauge tick (short runs too)
             self._record_run_stats(runtimes)
+            if publisher is not None:
+                try:
+                    publisher.finalize(self._build_live_snapshot(runtimes))
+                except Exception:
+                    logger.exception("final live-status publish failed")
 
         if self._abort_exc is not None:
             raise self._abort_exc
@@ -389,19 +409,29 @@ class PipelinedRunner(RunnerInterface):
                 rt.dispatched += 1
                 batch_id = rt.next_batch_id
                 rt.next_batch_id += 1
-            result = self._run_batch(rt, batch, batch_id, attempts)
+            result = self._run_batch(rt, batch, batch_id, attempts, w.meta.worker_id)
             if result is _ABORTED:
                 return
             if result:
                 rt.emit(result)
 
-    def _run_batch(self, rt: _StageRuntime, batch: list, batch_id: int, attempts: int):
+    def _run_batch(
+        self, rt: _StageRuntime, batch: list, batch_id: int, attempts: int,
+        worker_id: str = "",
+    ):
         from cosmos_curate_tpu.observability.stage_timer import record_stage_busy
         from cosmos_curate_tpu.observability.tracing import traced_span
 
         stage = rt.stage
         for attempt in range(attempts):
             t0 = time.monotonic()
+            with rt.lock:
+                # live-status visibility: registered BEFORE the chaos sites
+                # and process_data, so a hang shows as an aging in-flight
+                # batch from its first stuck second
+                rt.inflight[batch_id] = {
+                    "started": t0, "worker": worker_id, "attempt": attempt + 1,
+                }
             try:
                 chaos.fire(chaos.SITE_WORKER_CRASH)  # kind=crash: os._exit
                 chaos.fire(chaos.SITE_WORKER_HANG)  # kind=hang: stuck batch
@@ -452,6 +482,9 @@ class PipelinedRunner(RunnerInterface):
                 )
                 self._dead_letter(rt, batch_id, batch, attempts)
                 return []
+            finally:
+                with rt.lock:
+                    rt.inflight.pop(batch_id, None)
         return []  # unreachable; attempts >= 1
 
     def _ensure_setup(self, rt: _StageRuntime, w: _Worker) -> bool:
@@ -610,6 +643,60 @@ class PipelinedRunner(RunnerInterface):
                 busy_frac=frac,
                 workers=workers,
             )
+
+    def _build_live_snapshot(self, runtimes: list[_StageRuntime]) -> dict:
+        """One live-status snapshot (observability/live_status.py) from
+        state the runner already keeps — counters, the throughput sample
+        window, and the in-flight registry. Bounded and lock-brief."""
+        from cosmos_curate_tpu.observability.live_status import (
+            MAX_INFLIGHT_PER_STAGE,
+        )
+
+        now = time.monotonic()
+        stages: dict[str, dict] = {}
+        for rt in runtimes:
+            workers = len(rt.live_workers())
+            with rt.lock:
+                inflight = sorted(
+                    rt.inflight.items(), key=lambda kv: kv[1]["started"]
+                )[:MAX_INFLIGHT_PER_STAGE]
+                durs = sorted(d for (_t, d) in rt.samples)
+                busy = rt.busy_s
+                counts = (rt.dispatched, rt.completed, rt.errored, rt.dead_lettered)
+            # busy fraction over the window since the last replan tick —
+            # read-only against the tick state _export_flow owns
+            dt = now - rt.tick_t
+            frac = (
+                min(1.0, (busy - rt.tick_busy_s) / (dt * max(1, workers)))
+                if dt > 0
+                else 0.0
+            )
+            stages[rt.stage.name] = {
+                "queue_depth": len(rt.in_q),
+                "busy_frac": round(frac, 4),
+                "workers": workers,
+                "dispatched": counts[0],
+                "completed": counts[1],
+                "errored": counts[2],
+                "dead_lettered": counts[3],
+                "finished": rt.finalized,
+                "p50_s": round(durs[len(durs) // 2], 4) if durs else 0.0,
+                "p99_s": (
+                    round(durs[min(len(durs) - 1, int(len(durs) * 0.99))], 4)
+                    if durs
+                    else 0.0
+                ),
+                "inflight": [
+                    {
+                        "batch_id": bid,
+                        "age_s": round(now - info["started"], 3),
+                        "attempt": info.get("attempt", 1),
+                        "worker": info.get("worker", ""),
+                    }
+                    for bid, info in inflight
+                ],
+            }
+        return {"stages": stages}
 
     def _record_run_stats(self, runtimes: list[_StageRuntime]) -> None:
         self.stage_counts = {}

@@ -49,6 +49,7 @@ class SequentialRunner(RunnerInterface):
         self.dead_lettered = 0
 
     def run(self, spec: PipelineSpec) -> list[PipelineTask] | None:
+        from cosmos_curate_tpu.observability.live_status import LiveStatusPublisher
         from cosmos_curate_tpu.observability.tracing import traced_span
 
         # fresh run-scoped DLQ state (run_id is fixed at DLQ construction,
@@ -57,11 +58,28 @@ class SequentialRunner(RunnerInterface):
         self.dead_lettered = 0
         node = NodeInfo(node_id="local")
         tasks: list[PipelineTask] = list(spec.input_data)
-        with traced_span(
-            "pipeline.run", runner="sequential", stages=len(spec.stages)
-        ):
-            for stage_spec in spec.stages:
-                tasks = self._run_stage(stage_spec, node, tasks)
+        # live ops plane: snapshots publish between batches (this runner is
+        # single-threaded, so a hung batch shows as a STALE snapshot whose
+        # last entry is the in-flight batch — `top` flags the staleness)
+        self._publisher = LiveStatusPublisher.from_env(runner="sequential")
+        self._live_stages: dict[str, dict] = {
+            s.stage.name: {"queue_depth": 0, "workers": 0, "completed": 0,
+                           "errored": 0, "dead_lettered": 0, "busy_frac": 0.0,
+                           "inflight": []}
+            for s in spec.stages
+        }
+        try:
+            with traced_span(
+                "pipeline.run", runner="sequential", stages=len(spec.stages)
+            ):
+                for stage_spec in spec.stages:
+                    tasks = self._run_stage(stage_spec, node, tasks)
+        finally:
+            if self._publisher is not None:
+                try:
+                    self._publisher.finalize({"stages": dict(self._live_stages)})
+                except Exception:
+                    logger.exception("final live-status publish failed")
         return tasks if spec.config.return_last_stage_outputs else None
 
     def _run_stage(self, stage_spec, node, tasks: list) -> list:
@@ -81,9 +99,26 @@ class SequentialRunner(RunnerInterface):
                 stage.setup_on_node(node, meta)
                 stage.setup(meta)
             bs = max(1, stage.batch_size)
+            live = getattr(self, "_live_stages", {}).get(stage.name)
             try:
                 for i in range(0, len(tasks), bs):
                     batch = tasks[i : i + bs]
+                    # per-BATCH baseline: dead_lettered is a run-global
+                    # counter, so a drop in an earlier stage must not
+                    # misclassify this stage's next success
+                    dl_before = self.dead_lettered
+                    if live is not None and self._publisher is not None:
+                        live.update(
+                            queue_depth=max(0, len(tasks) - i - len(batch)),
+                            workers=1, busy_frac=1.0,
+                            inflight=[{
+                                "batch_id": i // bs, "age_s": 0.0, "attempt": 1,
+                                "worker": f"{stage.name}-seq-0",
+                            }],
+                        )
+                        self._publisher.maybe_publish(
+                            lambda: {"stages": dict(self._live_stages)}
+                        )
                     for attempt in range(max(1, stage_spec.num_run_attempts)):
                         try:
                             chaos.fire(chaos.SITE_WORKER_CRASH)  # kind=crash: os._exit
@@ -102,6 +137,17 @@ class SequentialRunner(RunnerInterface):
                                 )
                                 self._dead_letter(stage.name, i, batch, attempt + 1)
                                 result = None
+                    if live is not None:
+                        live["inflight"] = []
+                        live["busy_frac"] = 0.0
+                        # a dropped batch bumped the run-global DLQ counter
+                        # inside _dead_letter; everything else completed (a
+                        # legit None result is a no-output success)
+                        if self.dead_lettered > dl_before:
+                            live["errored"] += 1
+                            live["dead_lettered"] += self.dead_lettered - dl_before
+                        else:
+                            live["completed"] += 1
                     if result is None:
                         continue
                     if not isinstance(result, list):

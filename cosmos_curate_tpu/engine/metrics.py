@@ -307,6 +307,21 @@ class EngineMetrics:
         self.service_shed = Counter(
             "service_shed_total", "admissions shed with 429", ["tenant", "reason"]
         )
+        # Live ops plane (observability/anomaly.py + service SLOs): detector
+        # verdicts per stage and kind, and per-tenant SLO breaches. A flat
+        # zero anomaly rate on a healthy fleet is the baseline; any nonzero
+        # stuck_batch/starved_stage rate is an operator page, and
+        # slo_breaches rising for one tenant with flat queue depth means
+        # that tenant's target is mis-sized, not the service.
+        self.anomalies_total = Counter(
+            "pipeline_anomalies_total",
+            "stall/anomaly detector verdicts", labels + ["kind"],
+        )
+        self.slo_breaches = Counter(
+            "service_slo_breaches_total",
+            "per-tenant SLO breaches (queue_wait, run_duration, success_rate)",
+            ["tenant", "kind"],
+        )
         self._server_started = False
         self.enabled = True
         if port is not None:
@@ -562,3 +577,11 @@ class EngineMetrics:
     def observe_service_shed(self, tenant: str, reason: str) -> None:
         if self.enabled:
             self.service_shed.labels(tenant, reason).inc()
+
+    def observe_anomaly(self, stage: str, kind: str) -> None:
+        if self.enabled:
+            self.anomalies_total.labels(stage, kind).inc()
+
+    def observe_slo_breach(self, tenant: str, kind: str) -> None:
+        if self.enabled:
+            self.slo_breaches.labels(tenant, kind).inc()

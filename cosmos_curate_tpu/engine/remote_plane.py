@@ -815,6 +815,22 @@ class RemoteWorkerManager:
                 if a.alive
             ]
 
+    def heartbeat_ages(self, now: float | None = None) -> dict:
+        """node_id -> heartbeat freshness for every registered link — the
+        live-status snapshot's node-health section (the anomaly detector
+        flags ``heartbeat_degraded`` from these ages BEFORE the failure
+        detector's declare-dead deadline fires)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {
+                a.node_id: {
+                    "heartbeat_age_s": round(max(0.0, now - a.last_seen), 3),
+                    "alive": bool(a.alive),
+                    "workers": len(a.worker_costs),
+                }
+                for a in self.agents
+            }
+
     # -- failure detector ----------------------------------------------
     def note_agent_dead(self, link: AgentLink, *, reason: str = "declared dead") -> bool:
         """Declare one agent dead: quarantine the link (socket closed, so a

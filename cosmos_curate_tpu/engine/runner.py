@@ -60,6 +60,10 @@ class _Batch:
     # worker is presumed hung and killed
     worker_id: str = ""
     deadline: float | None = None
+    # monotonic dispatch instant — the live ops plane reports in-flight
+    # batch AGES from it, and the stall detector compares those ages
+    # against the stage's recent batch-duration percentiles
+    dispatched_at: float | None = None
 
 
 # A batch survives this many worker deaths before being dropped
@@ -317,6 +321,15 @@ class StreamingRunner(RunnerInterface):
         # Segments created by this run (and its workers) carry this pid.
         os.environ.setdefault("CURATE_STORE_OWNER", str(os.getpid()))
 
+        # live ops plane: periodic atomic status snapshots + stall
+        # detection when CURATE_LIVE_STATUS_DIR is exported (run_split
+        # derives it from the output root). Per-stage batch-duration
+        # windows feed the detector's p99 baseline.
+        from cosmos_curate_tpu.observability.live_status import LiveStatusPublisher
+
+        publisher = LiveStatusPublisher.from_env(runner="streaming")
+        self._stage_durs: list[deque] = [deque(maxlen=128) for _ in states]
+
         # Inputs are seeded lazily inside the loop, gated on store headroom
         # and the first stage's queue bound — a huge input list must not
         # fill /dev/shm upfront.
@@ -530,8 +543,9 @@ class StreamingRunner(RunnerInterface):
                             continue
                         batch.worker_id = w.worker_id
                         timeout = st.spec.batch_timeout_s
+                        batch.dispatched_at = time.monotonic()
                         batch.deadline = (
-                            time.monotonic() + timeout if timeout else None
+                            batch.dispatched_at + timeout if timeout else None
                         )
                         batches[batch.batch_id] = batch
                         st.pool.submit(w, batch.batch_id, batch.refs)
@@ -574,6 +588,12 @@ class StreamingRunner(RunnerInterface):
                     pending = st.pool.num_workers() - ready
                     self.metrics.set_pool_state(st.spec.name, ready, pending, len(st.in_queue))
                 self.metrics.set_store_bytes(store.used)
+                if publisher is not None:
+                    publisher.maybe_publish(
+                        lambda: self._build_live_snapshot(
+                            states, batches, store, remote_mgr
+                        )
+                    )
                 # 5b. settle finished final-output fetches: success frees
                 # the batch's held inputs; failure re-executes the batch
                 # (its outputs died with their owner)
@@ -645,6 +665,15 @@ class StreamingRunner(RunnerInterface):
                 )
             return outputs if cfg.return_last_stage_outputs else None
         finally:
+            if publisher is not None:
+                # terminal snapshot (state=finished) so readers can tell
+                # 'runner exited' from 'publisher wedged'
+                try:
+                    publisher.finalize(
+                        self._build_live_snapshot(states, batches, store, remote_mgr)
+                    )
+                except Exception:
+                    logger.exception("final live-status publish failed")
             # quiesce the fetch pool FIRST: a still-running _localize_batch
             # mutates batch.refs and releases refs itself — walking
             # `localizing` concurrently would double-release
@@ -725,6 +754,71 @@ class StreamingRunner(RunnerInterface):
                 tracing.end_span(span)
 
     # ------------------------------------------------------------------
+    def _build_live_snapshot(self, states, batches, store, remote_mgr) -> dict:
+        """One live-status snapshot (observability/live_status.py) from the
+        orchestration loop's own state: per-stage queues and worker
+        occupancy, every in-flight batch with its age and retry/death
+        budgets, store occupancy, and per-node heartbeat ages."""
+        from cosmos_curate_tpu.observability.live_status import (
+            MAX_INFLIGHT_PER_STAGE,
+        )
+
+        now = time.monotonic()
+        by_stage: dict[int, list] = {}
+        for b in batches.values():
+            by_stage.setdefault(b.stage_idx, []).append(b)
+        stages: dict[str, dict] = {}
+        durs_all = getattr(self, "_stage_durs", [])
+        for i, st in enumerate(states):
+            workers = list(st.pool.workers.values())
+            busy = sum(1 for w in workers if w.busy_batch is not None)
+            inflight = sorted(
+                by_stage.get(i, ()), key=lambda b: b.dispatched_at or now
+            )[:MAX_INFLIGHT_PER_STAGE]
+            durs = sorted(durs_all[i]) if i < len(durs_all) else []
+            stages[st.spec.name] = {
+                "queue_depth": len(st.in_queue),
+                "retry_queue": len(st.retry_queue),
+                "busy_frac": round(busy / max(1, len(workers)), 4),
+                "workers": len(workers),
+                "dispatched": st.dispatched,
+                "completed": st.completed,
+                "errored": st.errored_batches,
+                "dead_lettered": st.dead_lettered,
+                "p50_s": round(durs[len(durs) // 2], 4) if durs else 0.0,
+                "p99_s": (
+                    round(durs[min(len(durs) - 1, int(len(durs) * 0.99))], 4)
+                    if durs
+                    else 0.0
+                ),
+                "inflight": [
+                    {
+                        "batch_id": b.batch_id,
+                        "age_s": round(now - (b.dispatched_at or now), 3),
+                        "attempt": b.attempts + 1,
+                        "worker_deaths": b.worker_deaths,
+                        "node_deaths": b.node_deaths,
+                        "worker": b.worker_id,
+                        "deadline_in_s": (
+                            round(b.deadline - now, 3)
+                            if b.deadline is not None
+                            else None
+                        ),
+                    }
+                    for b in inflight
+                ],
+            }
+        snap: dict = {"stages": stages, "store_bytes": store.used}
+        if remote_mgr is not None:
+            snap["nodes"] = remote_mgr.heartbeat_ages()
+            if self._recon or self._lost_waiters or self.objects_reconstructed:
+                snap["reconstruction"] = {
+                    "objects_reconstructed": self.objects_reconstructed,
+                    "re_runs_inflight": len(self._recon),
+                    "parked_waiters": len(self._lost_waiters),
+                }
+        return snap
+
     @staticmethod
     def _worker_node(w) -> str:
         """'' for locally placed workers, else the agent's node id (the
@@ -989,6 +1083,9 @@ class StreamingRunner(RunnerInterface):
             msg.process_time_s,
             node_id=self._worker_node(w) if w is not None else "",
         )
+        if batch.stage_idx < len(getattr(self, "_stage_durs", ())):
+            # live-status percentile window (bounded deque, loop thread only)
+            self._stage_durs[batch.stage_idx].append(msg.process_time_s)
         self.stage_times[st.spec.name] = (
             self.stage_times.get(st.spec.name, 0.0) + msg.process_time_s
         )

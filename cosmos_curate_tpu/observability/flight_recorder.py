@@ -229,6 +229,7 @@ def runner_stats(runner: Any) -> dict:
     source: runner accounting plus this process's in-memory dispatch/flow
     aggregates. ``runner=None`` yields the aggregate-only skeleton."""
     from cosmos_curate_tpu.observability.stage_timer import (
+        anomaly_summaries,
         caption_phase_summaries,
         dispatch_summaries,
         index_op_summaries,
@@ -251,6 +252,9 @@ def runner_stats(runner: Any) -> dict:
         # deltas); the engine runner also snapshots this as
         # ``runner.object_plane`` at finalize
         "object_plane": object_plane_summaries(),
+        # stall/anomaly detector verdicts (observability/anomaly.py):
+        # per-(stage, kind) counts + the bounded recent-events tail
+        "anomalies": anomaly_summaries(),
         "stage_times": dict(getattr(runner, "stage_times", None) or {}),
     }
     node_plan = getattr(runner, "node_plan", None)
@@ -366,6 +370,17 @@ def load_node_stats(output_path: str) -> dict | None:
                 if isinstance(v, (int, float)):
                     into[k] = into.get(k, 0) + v
         merged["dead_lettered"] += int(stats.get("dead_lettered", 0) or 0)
+        # anomaly verdicts: counts sum across nodes, the recent tail
+        # concatenates (bounded — it was bounded per node already)
+        anom = stats.get("anomalies")
+        if anom:
+            into = merged.setdefault(
+                "anomalies", {"total": 0, "counts": {}, "recent": []}
+            )
+            into["total"] += int(anom.get("total", 0) or 0)
+            for k, v in (anom.get("counts") or {}).items():
+                into["counts"][k] = into["counts"].get(k, 0) + int(v)
+            into["recent"] = (into["recent"] + list(anom.get("recent") or []))[-64:]
         # node-loss receipts concatenate (deaths) / sum (reconstruction):
         # every rank's driver sees only the agents IT lost
         ne = stats.get("node_events")
@@ -437,6 +452,7 @@ def build_run_report(
     report["index_ops"] = stats["index_ops"]
     report["search"] = stats.get("search") or {}
     report["object_plane"] = stats["object_plane"]
+    report["anomalies"] = stats.get("anomalies") or {}
     if stats.get("node_plan"):
         report["node_plan"] = stats["node_plan"]
     if stats.get("node_events"):
@@ -464,8 +480,8 @@ def build_run_report(
         # fallbacks that would always win this not-set check)
         for key in (
             "dispatch", "stage_flow", "caption_phases", "index_ops", "search",
-            "object_plane", "node_plan", "node_events", "stage_counts",
-            "dead_lettered", "dlq_run_dir",
+            "object_plane", "anomalies", "node_plan", "node_events",
+            "stage_counts", "dead_lettered", "dlq_run_dir",
         ):
             if not report.get(key) and prior.get(key):
                 report[key] = prior[key]
@@ -645,6 +661,19 @@ def render_report(report: dict) -> str:
                     f"decode_tokens {sub.get('decode_tokens', 0):8d}  "
                     f"drives {sub.get('drives', 0)}"
                 )
+    anomalies = report.get("anomalies") or {}
+    if anomalies.get("total"):
+        lines.append(
+            f"anomalies: {anomalies['total']} "
+            f"(stall/anomaly detector — see docs/OBSERVABILITY.md)"
+        )
+        for key, n in sorted(anomalies.get("counts", {}).items()):
+            lines.append(f"  {key:<40} {n}")
+        for ev in (anomalies.get("recent") or [])[-5:]:
+            lines.append(
+                f"    {ev.get('kind', '?')} @ {ev.get('stage', '?')}: "
+                f"{ev.get('detail', '')}"
+            )
     dead = report.get("dead_lettered", 0)
     if dead:
         lines.append(

@@ -612,6 +612,61 @@ def reset_search() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Anomaly aggregates (observability/anomaly.py): the stall/anomaly
+# detector's verdicts, folded per (stage, kind) with a bounded tail of
+# recent structured events. Same contract as the rest of this module —
+# bounded aggregates, never a log; the ``pipeline_anomalies_total``
+# counters carry the stream and the flight recorder snapshots the summary
+# into run_report.json's ``anomalies`` section.
+_ANOMALY_LOCK = threading.Lock()
+_ANOMALY_COUNTS: dict[tuple[str, str], int] = {}
+_ANOMALY_RECENT: "deque" = None  # created lazily (collections import below)
+_ANOMALY_RECENT_CAP = 64
+
+
+def record_anomaly(event: dict) -> None:
+    """Fold one detector verdict (``{"kind", "stage", ...}``) into the
+    per-(stage, kind) counts + the bounded recent-events tail, and forward
+    it to the ``pipeline_anomalies_total`` counter (no-op without an
+    exporter)."""
+    global _ANOMALY_RECENT
+    kind = str(event.get("kind") or "unknown")
+    stage = str(event.get("stage") or "_run")
+    with _ANOMALY_LOCK:
+        if _ANOMALY_RECENT is None:
+            from collections import deque as _deque
+
+            _ANOMALY_RECENT = _deque(maxlen=_ANOMALY_RECENT_CAP)
+        _ANOMALY_COUNTS[(stage, kind)] = _ANOMALY_COUNTS.get((stage, kind), 0) + 1
+        _ANOMALY_RECENT.append(dict(event))
+    try:
+        from cosmos_curate_tpu.engine.metrics import get_metrics
+
+        get_metrics().observe_anomaly(stage, kind)
+    except Exception:  # metrics must never take down the watchdog
+        pass
+
+
+def anomaly_summaries() -> dict:
+    """``{"total", "counts": {"<stage>/<kind>": n}, "recent": [...]}`` —
+    what the flight recorder writes as run_report.json's ``anomalies``
+    section and live snapshots embed as detector verdicts."""
+    with _ANOMALY_LOCK:
+        counts = {f"{s}/{k}": n for (s, k), n in _ANOMALY_COUNTS.items()}
+        recent = list(_ANOMALY_RECENT or ())
+    if not counts:
+        return {}
+    return {"total": sum(counts.values()), "counts": counts, "recent": recent}
+
+
+def reset_anomalies() -> None:
+    with _ANOMALY_LOCK:
+        _ANOMALY_COUNTS.clear()
+        if _ANOMALY_RECENT is not None:
+            _ANOMALY_RECENT.clear()
+
+
+# ---------------------------------------------------------------------------
 # Object-plane transfer aggregates (engine/object_channel.py consumers): how
 # many bytes crossed hosts, how long consumers WAITED for them, and whether
 # push-ahead prefetch hid the transfer behind compute. Bounded per-process

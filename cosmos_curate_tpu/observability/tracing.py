@@ -69,9 +69,18 @@ class TracedSpan:
     start_s: float
     attributes: dict[str, Any] = field(default_factory=dict)
     end_s: float | None = None
+    # timestamped point events attached to this span (W3C span events):
+    # the anomaly detector stamps its verdicts here so a stuck batch shows
+    # up INSIDE the pipeline.run span instead of as a detached fragment
+    events: list = field(default_factory=list)
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        self.events.append(
+            {"name": name, "ts": time.time(), "attributes": dict(attributes)}
+        )
 
     @property
     def duration_s(self) -> float:
@@ -112,6 +121,8 @@ class _NdjsonBackend:
             "attributes": span.attributes,
             "pid": os.getpid(),
         }
+        if span.events:
+            record["events"] = span.events
         with self._lock:
             self._lines.append(json.dumps(record))
             if len(self._lines) >= self.FLUSH_EVERY:
@@ -528,8 +539,28 @@ class _NoopSpan(TracedSpan):
     def set_attribute(self, key: str, value: Any) -> None:
         pass  # shared module-global: must not accumulate state
 
+    def add_event(self, name: str, **attributes: Any) -> None:
+        pass
+
 
 _NOOP_SPAN = _NoopSpan("noop", "0", "0", None, 0.0)
+
+
+def add_span_event(name: str, **attributes: Any) -> bool:
+    """Attach a timestamped event to the innermost active span (the live
+    ops plane's anomaly verdicts ride the ambient pipeline.run span this
+    way). With no active span but tracing on, an instant zero-duration span
+    is exported instead, so the event still lands in the trace. Returns
+    False (and does nothing) when tracing is off."""
+    if not _enabled or _suppress.get():
+        return False
+    stack = _stack.get()
+    if stack:
+        stack[-1].add_event(name, **attributes)
+        return True
+    span = start_span(name, **attributes)
+    end_span(span)
+    return True
 
 
 def traced(fn: Callable | None = None, *, name: str | None = None):

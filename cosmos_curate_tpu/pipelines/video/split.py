@@ -311,6 +311,14 @@ def run_split(
         if not alive:
             logger.warning("health gate: TPU unhealthy — running this job on CPU")
             _os.environ["JAX_PLATFORMS"] = "cpu"
+    # live ops plane: export the snapshot dir derived from the output root
+    # BEFORE resolving the runner, so every runner (and the workers it
+    # spawns) publishes <output>/report/live/status.json — the live
+    # counterpart of run_report.json (`top`, `report --follow`, and the
+    # service's /v1/jobs/<id>/status all read it)
+    from cosmos_curate_tpu.observability.live_status import export_live_status_dir
+
+    export_live_status_dir(args.output_path)
     if runner is None:
         # resolve the default HERE, not inside run_pipeline: the finalize
         # path hands the flight recorder the instance that actually ran,

@@ -6,11 +6,18 @@ dict), which forgets every queued and running job on restart. This service
 is built for heavy multi-tenant traffic instead (aiohttp; fastapi is not
 in this image):
 
-  GET  /health                  liveness + state counts + queue depths
+  GET  /health                  liveness + READINESS (dispatcher running,
+                                journal writable, queue depths per lane,
+                                index generation when serving search)
   GET  /v1/jobs                 list jobs (?tenant=&state= filters)
   POST /v1/invoke               {"pipeline": ..., "args": {...},
                                  "tenant": "t", "priority": "interactive"}
   GET  /v1/progress/{job_id}    state, attempts, summary + run_report link
+  GET  /v1/jobs/{job_id}/status the job child's latest LIVE snapshot
+                                (per-stage queue/busy/in-flight batches)
+                                + stall-detector verdicts
+  GET  /v1/slo                  per-tenant queue-wait / run-duration /
+                                success-rate vs configured targets
   GET  /v1/logs/{job_id}        bounded log tail (seeks, never slurps)
   POST /v1/terminate/{job_id}   kill the job's whole process group
   POST /v1/requeue/{job_id}     dead_lettered/failed/terminated → pending
@@ -64,11 +71,13 @@ from cosmos_curate_tpu.service.admission import (
 from cosmos_curate_tpu.service.job_queue import (
     JOB_STATES,
     LANES,
+    TERMINAL_STATES,
     JobJournal,
     JobRecord,
     JournalWriteError,
     recover_records,
 )
+from cosmos_curate_tpu.service.slo import SloConfig, SloTracker
 from cosmos_curate_tpu.storage.retry import backoff_s
 from cosmos_curate_tpu.utils.logging import get_logger
 
@@ -94,6 +103,14 @@ class ServiceConfig:
     # newest max_terminal_records are kept regardless of backlog size.
     retain_terminal_s: float = 86400.0
     max_terminal_records: int = 5000
+    # per-tenant SLO targets (service/slo.py; `serve --slo-*` knobs).
+    # Breaches increment service_slo_breaches_total{tenant,kind}, journal
+    # against the job, and surface in GET /v1/slo.
+    slo: SloConfig = field(default_factory=SloConfig)
+    # live ops: how often the dispatcher re-reads a running job's live
+    # snapshot to journal its anomaly verdicts + fold them into the
+    # service's pipeline_anomalies_total (job children have no exporter)
+    anomaly_scan_interval_s: float = 3.0
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +276,15 @@ class ServiceState:
         self.stopping = False  # dispatcher exit flag (cooperative, not cancel)
         self.watchers: set[asyncio.Task] = set()  # strong refs (GC guard)
         self.wake: asyncio.Event | None = None  # created on the app's loop
+        self.slo = SloTracker(config.slo)
+        # readiness: flips False on a journal append failure, True on the
+        # next success — /health's journal_writable field
+        self.journal_ok = True
+        self.dispatcher_running = False
+        # live-ops anomaly relay: job_id -> anomaly_count already journaled
+        # (job children detect; the service journals + exports for them)
+        self._anomaly_seen: dict[str, int] = {}
+        self._anomaly_scan_at = 0.0
         from cosmos_curate_tpu.engine.metrics import get_metrics
 
         self.metrics = get_metrics(config.metrics_port)
@@ -290,7 +316,9 @@ class ServiceState:
         make the resulting re-run idempotent."""
         try:
             self.journal.append(rec, event)
+            self.journal_ok = True
         except JournalWriteError:
+            self.journal_ok = False
             if required:
                 raise
             logger.exception(
@@ -298,6 +326,18 @@ class ServiceState:
                 rec.job_id, event,
             )
         self.metrics.observe_service_transition(rec.tenant, rec.state)
+        if rec.state in TERMINAL_STATES and event not in ("evicted",):
+            # SLO accounting: run duration + success window on every
+            # terminal entry (a requeued job that dies again is a new
+            # outcome — correct: the tenant experienced both)
+            duration = (
+                (rec.finished_s - rec.started_s)
+                if rec.finished_s and rec.started_s
+                else None
+            )
+            self._note_slo_breaches(
+                rec, self.slo.observe_terminal(rec.tenant, rec.state, duration)
+            )
         self._export_states()
 
     def _export_states(self) -> None:
@@ -307,6 +347,76 @@ class ServiceState:
         self.metrics.set_service_states(counts)
         for lane in LANES:
             self.metrics.set_service_queue_depth(lane, self.admission.lane_depth(lane))
+
+    def _note_slo_breaches(self, rec: JobRecord, kinds: list[str]) -> None:
+        """Metrics + a journal receipt per breached SLO kind. Raw journal
+        append (never record_transition — the record's state did not
+        change, and a breach must not re-fire the terminal SLO hook);
+        replay ignores unknown events, so durability semantics hold."""
+        for kind in kinds:
+            self.metrics.observe_slo_breach(rec.tenant, kind)
+            logger.warning(
+                "SLO breach (%s) for tenant %s on job %s",
+                kind, rec.tenant, rec.job_id,
+            )
+            try:
+                self.journal.append(rec, f"slo-breach:{kind}")
+            except JournalWriteError:
+                self.journal_ok = False
+
+    # ---- live ops ------------------------------------------------------
+
+    def output_root(self, rec: JobRecord) -> Path:
+        """The job's pipeline output root (where run_report.json and the
+        live status snapshot land)."""
+        return Path(
+            str(rec.args.get("output_path") or self.work_dir(rec.job_id) / "output")
+        )
+
+    def job_live_status(self, rec: JobRecord) -> dict | None:
+        """The job child's latest live snapshot (None before the first
+        publish / for pipelines that don't publish)."""
+        from cosmos_curate_tpu.observability.live_status import read_status
+
+        return read_status(str(self.output_root(rec)))
+
+    def scan_job_anomalies(self, now: float | None = None) -> int:
+        """Dispatcher-tick relay: read each running job's live snapshot and
+        journal (+ export) anomaly verdicts the job child detected — the
+        child has no journal and no metrics exporter, the service has both.
+        Rate-limited; returns how many NEW anomalies were relayed."""
+        now = time.time() if now is None else now
+        if now - self._anomaly_scan_at < self.config.anomaly_scan_interval_s:
+            return 0
+        self._anomaly_scan_at = now
+        relayed = 0
+        for rec in self.running_records():
+            snap = self.job_live_status(rec)
+            if not snap:
+                continue
+            total = int(snap.get("anomaly_count") or 0)
+            seen = self._anomaly_seen.get(rec.job_id, 0)
+            if total <= seen:
+                continue
+            # the snapshot carries a bounded tail of recent events; relay
+            # the newest (total - seen), or the whole tail if more
+            # happened than the tail kept
+            tail = [ev for ev in (snap.get("anomalies") or []) if isinstance(ev, dict)]
+            for ev in tail[-min(total - seen, len(tail)) :] if tail else ():
+                self.metrics.observe_anomaly(
+                    str(ev.get("stage") or "_run"), str(ev.get("kind") or "unknown")
+                )
+                try:
+                    self.journal.append(rec, f"anomaly:{ev.get('kind')}")
+                except JournalWriteError:
+                    self.journal_ok = False
+                relayed += 1
+            self._anomaly_seen[rec.job_id] = total
+        # forget jobs that left the running set (bounded growth)
+        running = {r.job_id for r in self.running_records()}
+        for job_id in [j for j in self._anomaly_seen if j not in running]:
+            del self._anomaly_seen[job_id]
+        return relayed
 
     # ---- paths ---------------------------------------------------------
 
@@ -410,6 +520,10 @@ def _launch(state: ServiceState, rec: JobRecord) -> None:
     state.procs[rec.job_id] = proc
     state.record_transition(rec, "running")
     state.metrics.observe_service_dispatch(rec.priority, wait_s)
+    state._note_slo_breaches(rec, state.slo.observe_dispatch(rec.tenant, wait_s))
+    # fresh attempt = fresh detector: its anomaly_count restarts at 0, so
+    # a stale high-water mark from a prior attempt would suppress relay
+    state._anomaly_seen.pop(rec.job_id, None)
     task = asyncio.create_task(_watch_job(state, rec, proc))
     state.watchers.add(task)  # event loop holds only weak refs
     task.add_done_callback(state.watchers.discard)
@@ -493,20 +607,30 @@ async def _dispatch_loop(app: web.Application) -> None:
     # exits via state.stopping, NOT task cancellation: py3.10's wait_for can
     # swallow a CancelledError that races its timeout expiry (bpo-42130),
     # which left a cancelled dispatcher looping forever and shutdown hung
-    while not state.stopping:
-        state.wake.clear()
-        if not state.draining:
-            while True:
-                rec = state.admission.pop_next(state.running_records())
-                if rec is None:
-                    break
-                _launch(state, rec)
-            state.gc_terminal()
-            state._export_states()
-        try:
-            await asyncio.wait_for(state.wake.wait(), timeout=0.5)
-        except asyncio.TimeoutError:
-            pass
+    state.dispatcher_running = True
+    try:
+        while not state.stopping:
+            state.wake.clear()
+            if not state.draining:
+                while True:
+                    rec = state.admission.pop_next(state.running_records())
+                    if rec is None:
+                        break
+                    _launch(state, rec)
+                state.gc_terminal()
+                state._export_states()
+            try:
+                # live-ops relay rides the dispatcher tick: journal + export
+                # anomaly verdicts running job children published
+                state.scan_job_anomalies()
+            except Exception:
+                logger.exception("anomaly scan failed (dispatcher unaffected)")
+            try:
+                await asyncio.wait_for(state.wake.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass
+    finally:
+        state.dispatcher_running = False
 
 
 def _killpg(pid: int, sig: int) -> None:
@@ -588,17 +712,38 @@ def build_app(
         register_search_routes(app, search_state)
 
     async def health(request: web.Request) -> web.Response:
+        """Liveness AND readiness in one payload: k8s-style probes read
+        ``ready`` (dispatcher running + journal writable + not draining),
+        `top` reads the same fields — one source for both."""
         running = state.running_records()
+        # cheap journal probe between appends: the parent dir must remain
+        # writable or the next submit will 503 — surface it here first
+        journal_writable = state.journal_ok and os.access(
+            state.journal.path.parent, os.W_OK
+        )
+        dispatcher_running = state.dispatcher_running
         out = {
             "status": "draining" if state.draining else "ok",
+            "ready": bool(
+                dispatcher_running and journal_writable and not state.draining
+            ),
+            "dispatcher_running": dispatcher_running,
+            "journal_writable": journal_writable,
             "active_job": running[0].job_id if running else None,
+            "running_jobs": [r.job_id for r in running],
             "num_jobs": len(state.jobs),
             "states": state.state_counts(),
             "queued": {lane: state.admission.lane_depth(lane) for lane in LANES},
             "max_concurrent": state.admission.effective_max_running(),
+            "slo_enabled": state.config.slo.enabled,
         }
         if search_state is not None:
             out["search"] = search_state.stats()
+            # index-server generation, hoisted for readiness probes that
+            # gate on "serving search at generation >= N"
+            gen = out["search"].get("generation")
+            if gen is not None:
+                out["index_generation"] = gen
         return web.json_response(out)
 
     async def list_jobs(request: web.Request) -> web.Response:
@@ -766,6 +911,59 @@ def build_app(
             out["report"] = str(report)
         return web.json_response(out)
 
+    async def job_status(request: web.Request) -> web.Response:
+        """Live in-flight introspection for one job: the child's latest
+        atomically-swapped snapshot (per-stage queue/busy/in-flight data)
+        plus the stall detector's verdicts — /v1/progress tells you the
+        job's lifecycle state, THIS tells you whether it is actually
+        moving."""
+        from cosmos_curate_tpu.observability.live_status import snapshot_age_s
+
+        rec = _get_job(request)
+        if rec is None:
+            return web.json_response({"error": "unknown job"}, status=404)
+        snap = state.job_live_status(rec)
+        out = {
+            "job_id": rec.job_id,
+            "state": rec.state,
+            "tenant": rec.tenant,
+            "attempts": rec.attempts,
+            "live": snap is not None,
+            "output_path": str(state.output_root(rec)),
+        }
+        if snap is None:
+            out["detail"] = (
+                "no live snapshot yet (job not started, pipeline predates "
+                "live status, or output root is remote)"
+            )
+        else:
+            out["snapshot"] = snap
+            out["snapshot_age_s"] = round(snapshot_age_s(snap), 3)
+            out["anomalies"] = snap.get("anomalies") or []
+            out["anomaly_count"] = int(snap.get("anomaly_count") or 0)
+            out["stale"] = bool(
+                rec.state == "running"
+                and snap.get("state") == "running"
+                and out["snapshot_age_s"] > 30.0
+            )
+        return web.json_response(out)
+
+    async def slo(request: web.Request) -> web.Response:
+        """Per-tenant SLO standing: observed queue-wait / run-duration /
+        success-rate against the configured targets, with breach counts
+        (the counter view is service_slo_breaches_total{tenant,kind})."""
+        report = state.slo.report()
+        # live context: what each tenant has queued/running right now
+        occupancy: dict[str, dict] = {}
+        for rec in state.jobs.values():
+            occ = occupancy.setdefault(rec.tenant, {"queued": 0, "running": 0})
+            if rec.state == "pending":
+                occ["queued"] += 1
+            elif rec.state == "running":
+                occ["running"] += 1
+        report["occupancy"] = occupancy
+        return web.json_response(report)
+
     async def logs(request: web.Request) -> web.Response:
         rec = _get_job(request)
         if rec is None:
@@ -879,6 +1077,8 @@ def build_app(
     app.router.add_get("/v1/jobs", list_jobs)
     app.router.add_post("/v1/invoke", invoke)
     app.router.add_get("/v1/progress/{job_id}", progress)
+    app.router.add_get("/v1/jobs/{job_id}/status", job_status)
+    app.router.add_get("/v1/slo", slo)
     app.router.add_get("/v1/logs/{job_id}", logs)
     app.router.add_post("/v1/terminate/{job_id}", terminate)
     app.router.add_post("/v1/requeue/{job_id}", requeue)

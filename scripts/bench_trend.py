@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Bench trajectory gate: fail CI on a warm clips/s regression.
+
+The repo accumulates one ``BENCH_r<NN>.json`` per round (a driver-captured
+record whose ``tail`` holds bench.py's stdout, including the final NDJSON
+metric row), but nothing ever ENFORCED the trajectory — a PR could halve
+warm throughput and every gate would stay green. This script compares the
+newest round's ``clips_per_sec_split_annotate`` (the warm-pass headline
+since PR 4) against the previous round and exits nonzero when it dropped
+by more than the threshold (default 20%, ``--threshold`` /
+``BENCH_TREND_THRESHOLD``).
+
+Guard rails, because round records are messy field data:
+
+- fewer than two parseable rows → pass with a notice (nothing to compare);
+- backend changes (cpu ↔ tpu) are never compared — a TPU row against a CPU
+  row is a hardware delta, not a regression;
+- ``--json <file>`` compares a freshly produced bench NDJSON row (e.g.
+  CI's /tmp/_bench.json) against the newest committed round instead of
+  round-vs-round.
+
+Usage::
+
+    python scripts/bench_trend.py                 # newest vs previous round
+    python scripts/bench_trend.py --json /tmp/_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from pathlib import Path
+
+METRIC = "clips_per_sec_split_annotate"
+ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def extract_row(path: Path) -> dict | None:
+    """The final metric row from one BENCH round record (or a raw bench
+    NDJSON file). Unparseable files return None — the gate skips them."""
+    try:
+        text = path.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return None
+    rows: list[dict] = []
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "tail" in doc:
+            text = doc["tail"]
+        elif isinstance(doc, dict) and doc.get("metric") == METRIC:
+            return doc
+    except ValueError:
+        pass  # raw NDJSON (bench.py stdout): scan the lines below
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith('{"metric"'):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == METRIC and isinstance(rec.get("value"), (int, float)):
+            rows.append(rec)
+    return rows[-1] if rows else None
+
+
+def round_rows(repo: Path) -> list[tuple[int, Path, dict]]:
+    """(round, path, row) for every parseable committed round, ascending."""
+    out = []
+    for p in repo.glob("BENCH_r*.json"):
+        m = ROUND_RE.match(p.name)
+        if not m:
+            continue
+        row = extract_row(p)
+        if row is not None:
+            out.append((int(m.group(1)), p, row))
+    return sorted(out)
+
+
+def compare(prev: dict, new: dict, threshold: float) -> tuple[bool, str]:
+    """(ok, message). ok=True also covers the skip cases."""
+    pb, nb = prev.get("backend", "tpu"), new.get("backend", "tpu")
+    if pb != nb:
+        return True, f"skip: backend changed {pb} -> {nb} (not comparable)"
+    pv, nv = float(prev["value"]), float(new["value"])
+    if pv <= 0:
+        return True, f"skip: previous value {pv} not positive"
+    delta = (nv - pv) / pv
+    msg = (
+        f"{METRIC}: {pv:.3f} -> {nv:.3f} clips/s "
+        f"({delta:+.1%}, threshold -{threshold:.0%}, backend={nb})"
+    )
+    return delta >= -threshold, msg
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo", default=str(Path(__file__).resolve().parents[1]),
+        help="repo root holding BENCH_r*.json",
+    )
+    ap.add_argument(
+        "--json", default="",
+        help="fresh bench NDJSON to compare against the newest round "
+        "(instead of round-vs-round)",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=float(os.environ.get("BENCH_TREND_THRESHOLD", "0.2")),
+        help="max tolerated fractional drop (0.2 = 20%%)",
+    )
+    args = ap.parse_args(argv)
+    repo = Path(args.repo)
+    rounds = round_rows(repo)
+    if args.json:
+        new = extract_row(Path(args.json))
+        if new is None:
+            print(f"bench-trend FAIL: no {METRIC} row in {args.json}")
+            return 1
+        if not rounds:
+            print("bench-trend: no committed rounds to compare against; pass")
+            return 0
+        prev = rounds[-1][2]
+        label = f"{rounds[-1][1].name} vs {args.json}"
+    else:
+        if len(rounds) < 2:
+            print(
+                f"bench-trend: {len(rounds)} parseable round(s); nothing to "
+                "compare, pass"
+            )
+            return 0
+        prev, new = rounds[-2][2], rounds[-1][2]
+        label = f"{rounds[-2][1].name} vs {rounds[-1][1].name}"
+    ok, msg = compare(prev, new, args.threshold)
+    print(f"bench-trend [{label}] {msg}")
+    if not ok:
+        print("bench-trend FAIL: warm throughput regressed past the threshold")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,4 +36,12 @@ echo "== chaos end-to-end + soak (spawns real worker pools) =="
 JAX_PLATFORMS=cpu python -m pytest \
   tests/engine/test_chaos_faults.py -q -p no:randomly -m ''
 
+echo "== live-ops closed loop (hang -> stuck_batch anomaly BEFORE the deadline kill) =="
+# the anomaly detector watching a chaos worker.batch.hang must emit
+# stuck_batch while the batch is still hung — proving detection beats the
+# batch_timeout_s kill (fast detector units ride tier-1 in
+# tests/observability/test_anomaly.py)
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/observability/test_anomaly_chaos.py -q -p no:randomly -m ''
+
 echo "chaos checks passed"

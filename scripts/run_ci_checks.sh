@@ -8,9 +8,10 @@
 # (failure detector + lineage reconstruction units; the agent-killing e2e
 # + soak run nightly), plus (8) the search-serving gate (index server over
 # HTTP: recall + generation-consistent results under concurrent
-# compaction). Individual gates can be skipped via
-# CI_SKIP=tier1,bench,multichip,index,service,nodeloss,search,static for
-# local use.
+# compaction), plus (9) the bench trend gate (>20% warm clips/s regression
+# between committed BENCH rounds fails). Individual gates can be skipped via
+# CI_SKIP=tier1,bench,trend,multichip,index,service,nodeloss,search,static
+# for local use.
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -50,6 +51,16 @@ rec = json.loads(open("/tmp/_bench.json").read().strip().splitlines()[-1])
 assert rec["metric"] == "clips_per_sec_split_annotate" and rec["value"] > 0, rec
 print(f"bench smoke: {rec['value']} clips/s (backend={rec.get('backend', 'tpu')})")
 PY
+  fi
+fi
+
+if ! skip trend; then
+  echo "== bench trend gate (>20% warm clips/s regression fails) =="
+  # round-vs-round over the committed BENCH_r*.json trajectory; when the
+  # bench smoke above produced a fresh row it is NOT used here (smoke runs
+  # at 2 videos — not comparable to full rounds)
+  if ! python scripts/bench_trend.py; then
+    failures+=("bench trend")
   fi
 fi
 

@@ -83,6 +83,9 @@ def _start_service(port: int, work_root: Path) -> subprocess.Popen:
             "--cpus-per-job", "0",  # deterministic concurrency on a 1-core CI box
             "--max-queued-per-tenant", "2",
             "--drain-s", "30",
+            # live-ops acceptance: a sub-millisecond queue-wait target means
+            # every dispatch breaches — /v1/slo must show it per tenant
+            "--slo-queue-wait-s", "0.0001",
         ],
         cwd=str(REPO),
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
@@ -180,6 +183,55 @@ def main() -> int:
         print(f"   shed ok: 429 reason={doc['reason']} Retry-After={headers['Retry-After']}")
         for sid in shed_ids:  # keep the run about tenants a+b
             _req(port, "POST", f"/v1/terminate/{sid}")
+
+        print("== live ops: /v1/jobs/<id>/status serves an in-flight snapshot")
+        # the real split job child publishes <out>/report/live/status.json;
+        # the service serves it live — well-formed, state=running, with
+        # nonzero per-stage queue/busy/in-flight data
+        live_proved = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            status, doc, _ = _req(port, "GET", f"/v1/jobs/{job_a}/status")
+            assert status == 200, (status, doc)
+            snap = doc.get("snapshot")
+            if doc.get("live") and snap and snap.get("state") == "running":
+                stages = snap.get("stages") or {}
+                if stages and any(
+                    s.get("queue_depth", 0) > 0
+                    or s.get("inflight")
+                    or s.get("busy_frac", 0) > 0
+                    for s in stages.values()
+                ):
+                    live_proved = True
+                    busy = {
+                        n: (s.get("queue_depth", 0), len(s.get("inflight") or []))
+                        for n, s in stages.items()
+                    }
+                    print(
+                        f"   live snapshot ok: seq={snap.get('seq')} "
+                        f"{len(stages)} stages, queue/inflight={busy}"
+                    )
+                    break
+            if _records(out_a):
+                break  # job already finishing; don't spin forever
+            time.sleep(0.2)
+        assert live_proved, "no live snapshot with per-stage data was ever served"
+
+        print("== live ops: readiness payload + per-tenant SLO standing")
+        _, health, _ = _req(port, "GET", "/health")
+        assert health["ready"] is True, health
+        assert health["dispatcher_running"] and health["journal_writable"], health
+        _, slo_doc, _ = _req(port, "GET", "/v1/slo")
+        assert slo_doc["enabled"] is True, slo_doc
+        a_slo = slo_doc["tenants"].get("tenant-a")
+        assert a_slo and a_slo["queue_wait"]["breaches"] >= 1, (
+            f"tenant-a never breached the 0.1 ms queue-wait target: {slo_doc}"
+        )
+        print(
+            f"   slo ok: tenant-a queue_wait breaches="
+            f"{a_slo['queue_wait']['breaches']} "
+            f"(mean {a_slo['queue_wait']['mean_s']}s)"
+        )
 
         print("== wait for partial progress on tenant-a, then kill -9 the service")
         deadline = time.monotonic() + 300

@@ -1,0 +1,66 @@
+"""`cosmos-curate-tpu top` + `report` live-fallback CLI tests (one-frame
+mode against a snapshot on disk; the service view is covered through the
+endpoints in tests/service/test_status_slo.py)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from cosmos_curate_tpu.cli.main import main
+
+
+def _write_snapshot(tmp_path, state="running"):
+    live = tmp_path / "report" / "live"
+    live.mkdir(parents=True, exist_ok=True)
+    (live / "status.json").write_text(
+        json.dumps(
+            {
+                "version": 1, "ts": time.time(), "seq": 4, "state": state,
+                "runner": "pipelined", "wall_s": 7.5, "pid": 42,
+                "node": "driver",
+                "stages": {
+                    "Embed": {
+                        "queue_depth": 3, "busy_frac": 0.8, "workers": 1,
+                        "completed": 9, "errored": 0, "dead_lettered": 0,
+                        "inflight": [{"batch_id": 10, "age_s": 1.0}],
+                    }
+                },
+                "anomalies": [], "anomaly_count": 0,
+            }
+        )
+    )
+
+
+def test_top_once_renders_table(tmp_path, capsys):
+    _write_snapshot(tmp_path)
+    assert main(["top", str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "RUNNING" in out and "Embed" in out and "anomalies: none" in out
+
+
+def test_top_once_without_snapshot_exits_2(tmp_path, capsys):
+    assert main(["top", str(tmp_path), "--once"]) == 2
+    assert "no live snapshot" in capsys.readouterr().out
+
+
+def test_top_json_frame(tmp_path, capsys):
+    _write_snapshot(tmp_path)
+    assert main(["top", str(tmp_path), "--once", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["stages"]["Embed"]["completed"] == 9
+
+
+def test_report_live_fallback_banner(tmp_path, capsys):
+    # no run_report.json yet + a running snapshot => RUN IN PROGRESS view,
+    # exit 0 (the old behavior was a hard error)
+    _write_snapshot(tmp_path)
+    assert main(["report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "RUN IN PROGRESS" in out and "Embed" in out
+
+
+def test_report_finished_run_still_errors_without_traces(tmp_path, capsys):
+    # a FINISHED snapshot must not mask the no-report/no-spans error path
+    _write_snapshot(tmp_path, state="finished")
+    assert main(["report", str(tmp_path)]) == 2

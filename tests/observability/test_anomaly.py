@@ -1,0 +1,243 @@
+"""Unit tests for the stall/anomaly detector (observability/anomaly.py):
+every anomaly kind from synthetic snapshot sequences, onset-once
+semantics, and the emit fan-out into the stage_timer aggregate +
+pipeline_anomalies_total counter."""
+
+from __future__ import annotations
+
+import pytest
+
+from cosmos_curate_tpu.observability import stage_timer
+from cosmos_curate_tpu.observability.anomaly import AnomalyConfig, AnomalyDetector
+
+
+def snap(ts, stages=None, **extra):
+    return {"ts": ts, "stages": stages or {}, **extra}
+
+
+def stage(**kw):
+    base = {
+        "queue_depth": 0, "busy_frac": 0.5, "workers": 1, "dispatched": 1,
+        "completed": 0, "errored": 0, "dead_lettered": 0, "inflight": [],
+        "p50_s": 0.1, "p99_s": 0.2,
+    }
+    base.update(kw)
+    return base
+
+
+@pytest.fixture(autouse=True)
+def _clean_aggregates():
+    stage_timer.reset_anomalies()
+    yield
+    stage_timer.reset_anomalies()
+
+
+def detector(**cfg) -> AnomalyDetector:
+    # persistence=1 isolates each check's own condition; flap suppression
+    # has its own tests below
+    cfg.setdefault("persistence", 1)
+    return AnomalyDetector(AnomalyConfig(**cfg), emit=False)
+
+
+class TestStuckBatch:
+    def test_flags_batch_past_p99_factor(self):
+        det = detector(stuck_min_age_s=1.0, stuck_factor=5.0)
+        st = stage(p99_s=0.5, inflight=[{"batch_id": 7, "age_s": 3.0, "worker": "w0"}])
+        out = det.observe(snap(100.0, {"S": st}))
+        assert [e["kind"] for e in out] == ["stuck_batch"]
+        ev = out[0]
+        assert ev["stage"] == "S" and ev["batch_id"] == 7
+        assert ev["threshold_s"] == pytest.approx(2.5)
+
+    def test_respects_min_age_on_cold_stage(self):
+        # no p99 yet (cold start): only the min-age floor applies
+        det = detector(stuck_min_age_s=10.0, stuck_factor=5.0)
+        st = stage(p99_s=0.0, inflight=[{"batch_id": 0, "age_s": 8.0}])
+        assert det.observe(snap(100.0, {"S": st})) == []
+        st2 = stage(p99_s=0.0, inflight=[{"batch_id": 0, "age_s": 11.0}])
+        assert [e["kind"] for e in det.observe(snap(103.0, {"S": st2}))] == [
+            "stuck_batch"
+        ]
+
+    def test_onset_once_then_rearm_after_resolve(self):
+        det = detector(stuck_min_age_s=1.0, stuck_factor=5.0)
+        stuck = stage(inflight=[{"batch_id": 1, "age_s": 9.0}])
+        assert len(det.observe(snap(1.0, {"S": stuck}))) == 1
+        # still stuck: no re-emission
+        stuck2 = stage(inflight=[{"batch_id": 1, "age_s": 12.0}])
+        assert det.observe(snap(3.0, {"S": stuck2})) == []
+        # resolved, then a NEW batch gets stuck: fresh onset
+        assert det.observe(snap(5.0, {"S": stage()})) == []
+        stuck3 = stage(inflight=[{"batch_id": 2, "age_s": 9.0}])
+        assert len(det.observe(snap(7.0, {"S": stuck3}))) == 1
+
+
+class TestStarvedStage:
+    def test_idle_stage_behind_full_upstream(self):
+        det = detector(starved_busy_frac=0.05, starved_queue_depth=8)
+        stages = {
+            "A": stage(queue_depth=20, busy_frac=0.0, workers=1),
+            "B": stage(queue_depth=0, busy_frac=0.0, workers=2),
+        }
+        out = det.observe(snap(1.0, stages))
+        kinds = {e["kind"] for e in out}
+        assert "starved_stage" in kinds
+        ev = next(e for e in out if e["kind"] == "starved_stage")
+        assert ev["stage"] == "B" and ev["upstream"] == "A"
+
+    def test_busy_or_queued_stage_is_not_starved(self):
+        det = detector()
+        stages = {
+            "A": stage(queue_depth=20),
+            "B": stage(queue_depth=3, busy_frac=0.0),  # has input queued
+            "C": stage(busy_frac=0.8),  # busy
+        }
+        assert not [
+            e for e in det.observe(snap(1.0, stages)) if e["kind"] == "starved_stage"
+        ]
+
+    def test_first_stage_and_unstarted_stage_exempt(self):
+        det = detector()
+        stages = {
+            "A": stage(queue_depth=50, busy_frac=0.0),  # first stage: exempt
+            "B": stage(busy_frac=0.0, workers=0),  # not started yet
+        }
+        assert not [
+            e for e in det.observe(snap(1.0, stages)) if e["kind"] == "starved_stage"
+        ]
+
+    def test_warmup_without_prior_flow_exempt(self):
+        # the stage never dispatched a batch: the first upstream batch is
+        # still cooking — warmup, not starvation
+        det = detector()
+        stages = {
+            "A": stage(queue_depth=50, busy_frac=1.0),
+            "B": stage(queue_depth=0, busy_frac=0.0, workers=1, dispatched=0),
+        }
+        assert not [
+            e for e in det.observe(snap(1.0, stages)) if e["kind"] == "starved_stage"
+        ]
+
+
+class TestDispatchGapSpike:
+    def test_spike_over_window_delta(self):
+        det = detector(gap_frac_threshold=0.5, gap_min_dispatches=4)
+        s1 = snap(1.0, {"S": stage()}, dispatch={
+            "embed": {"dispatches": 100, "gap_s": 1.0, "compute_s": 99.0}
+        })
+        assert det.observe(s1) == []  # first snapshot: no delta yet
+        # cumulative gap_frac is still tiny, but the WINDOW is 90% gap
+        s2 = snap(3.0, {"S": stage()}, dispatch={
+            "embed": {"dispatches": 110, "gap_s": 10.0, "compute_s": 100.0}
+        })
+        out = det.observe(s2)
+        assert [e["kind"] for e in out] == ["dispatch_gap_spike"]
+        assert out[0]["stage"] == "embed"
+        assert out[0]["window_gap_frac"] > 0.8
+
+    def test_too_few_dispatches_ignored(self):
+        det = detector(gap_min_dispatches=8)
+        det.observe(snap(1.0, {}, dispatch={
+            "embed": {"dispatches": 10, "gap_s": 0.0, "compute_s": 1.0}
+        }))
+        out = det.observe(snap(2.0, {}, dispatch={
+            "embed": {"dispatches": 12, "gap_s": 50.0, "compute_s": 0.1}
+        }))
+        assert out == []
+
+
+class TestHeartbeatDegraded:
+    def test_silent_node_flags(self):
+        det = detector(heartbeat_degraded_s=10.0)
+        out = det.observe(
+            snap(1.0, {}, nodes={
+                "node-a": {"heartbeat_age_s": 2.0, "alive": True},
+                "node-b": {"heartbeat_age_s": 14.0, "alive": True},
+            })
+        )
+        assert [e["kind"] for e in out] == ["heartbeat_degraded"]
+        assert out[0]["node"] == "node-b"
+
+
+class TestThroughputDeclining:
+    def test_shrinking_rate_flags(self):
+        det = detector(trend_window=4, trend_drop_frac=0.5, trend_min_rate=0.5)
+        # completed climbs 10/snapshot (rate 10/s), then stalls
+        for i, total in enumerate([0, 10, 20]):
+            assert det.observe(
+                snap(float(i), {"S": stage(completed=total)})
+            ) == []
+        out = det.observe(snap(3.0, {"S": stage(completed=21)}))
+        assert [e["kind"] for e in out] == ["throughput_declining"]
+        assert out[0]["peak_rate"] == pytest.approx(10.0)
+
+    def test_idle_run_is_not_a_decline(self):
+        det = detector(trend_window=3, trend_min_rate=5.0)
+        for i, total in enumerate([0, 1, 1, 1, 1]):
+            assert det.observe(snap(float(i), {"S": stage(completed=total)})) == []
+
+    def test_one_empty_tick_does_not_flicker(self):
+        """A batchy pipeline completing nothing for ONE snapshot must not
+        page: a single-tick dip never holds through the persistence
+        requirement (the production default)."""
+        det = detector(
+            trend_window=4, trend_drop_frac=0.3, trend_min_rate=0.5,
+            persistence=2,
+        )
+        # 10/s steady, with every other tick completing nothing
+        for i, total in enumerate([0, 20, 20, 40, 40, 60, 60, 80]):
+            assert det.observe(snap(float(i), {"S": stage(completed=total)})) == []
+
+
+class TestPersistence:
+    def test_starved_needs_consecutive_snapshots(self):
+        det = detector(persistence=2, starved_queue_depth=8)
+        stages = {
+            "A": stage(queue_depth=20, busy_frac=0.9),
+            "B": stage(queue_depth=0, busy_frac=0.0, workers=2),
+        }
+        # first observation (pipeline warmup shape): suppressed
+        assert det.observe(snap(1.0, stages)) == []
+        # second consecutive: onset
+        out = det.observe(snap(3.0, stages))
+        assert [e["kind"] for e in out] == ["starved_stage"]
+        # still holding: no re-emission
+        assert det.observe(snap(5.0, stages)) == []
+
+    def test_flap_resets_the_counter(self):
+        det = detector(persistence=2)
+        starved = {
+            "A": stage(queue_depth=20),
+            "B": stage(queue_depth=0, busy_frac=0.0, workers=1),
+        }
+        healthy = {"A": stage(queue_depth=20), "B": stage(busy_frac=0.9)}
+        for _ in range(3):  # starved / healthy alternation never onsets
+            assert det.observe(snap(1.0, starved)) == []
+            assert det.observe(snap(2.0, healthy)) == []
+
+
+class TestEmitFanout:
+    def test_emit_lands_in_stage_timer_aggregate(self):
+        det = AnomalyDetector(
+            AnomalyConfig(stuck_min_age_s=1.0), emit=True
+        )
+        st = stage(inflight=[{"batch_id": 3, "age_s": 50.0}])
+        det.observe(snap(1.0, {"S": st}))
+        agg = stage_timer.anomaly_summaries()
+        assert agg["total"] == 1
+        assert agg["counts"] == {"S/stuck_batch": 1}
+        assert agg["recent"][0]["batch_id"] == 3
+        assert det.emitted and det.emitted[0]["kind"] == "stuck_batch"
+
+    def test_emitted_tail_bounded_but_total_monotonic(self, monkeypatch):
+        # the tail keeps the NEWEST events (old roll off) while the total
+        # keeps climbing — snapshot readers key new-anomaly deltas on it
+        monkeypatch.setattr(AnomalyDetector, "_EMITTED_CAP", 5)
+        det = detector(stuck_min_age_s=1.0)
+        for i in range(20):
+            st = stage(inflight=[{"batch_id": i, "age_s": 50.0}])
+            det.observe(snap(float(i), {"S": st}))
+            det.observe(snap(float(i) + 0.5, {"S": stage()}))  # resolve
+        assert len(det.emitted) == 5
+        assert det.emitted_total == 20
+        assert [e["batch_id"] for e in det.emitted] == [15, 16, 17, 18, 19]

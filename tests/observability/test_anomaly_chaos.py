@@ -1,0 +1,108 @@
+"""Closed-loop proof that live stall detection beats the deadline kill.
+
+A chaos ``worker.batch.hang`` injection wedges a real spawned engine
+worker; the StreamingRunner's live ops plane must emit a ``stuck_batch``
+anomaly — into the stage_timer aggregate, the live snapshot, and the trace
+— while the batch is STILL hung, i.e. before ``batch_timeout_s`` SIGKILLs
+the worker. scripts/run_chaos_checks.sh runs this file explicitly (@slow:
+real worker pools, like tests/engine/test_chaos_faults.py)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import pytest
+
+from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, StreamingSpec, run_pipeline
+from cosmos_curate_tpu.core.stage import Resources, Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+from cosmos_curate_tpu.engine.runner import StreamingRunner
+from cosmos_curate_tpu.observability import stage_timer
+from cosmos_curate_tpu.observability.live_status import read_status
+
+
+@dataclass
+class Item(PipelineTask):
+    value: int = 0
+
+
+class BumpStage(Stage):
+    @property
+    def resources(self):
+        return Resources(cpus=0.25)
+
+    def process_data(self, tasks):
+        return [Item(value=t.value + 1) for t in tasks]
+
+
+BATCH_TIMEOUT_S = 6.0
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch, tmp_path):
+    chaos.uninstall()
+    stage_timer.reset_anomalies()
+    monkeypatch.setenv("CURATE_DLQ_DIR", str(tmp_path / "dlq"))
+    yield
+    chaos.uninstall()
+    stage_timer.reset_anomalies()
+
+
+@pytest.mark.slow
+def test_hang_yields_stuck_batch_anomaly_before_deadline_kill(
+    tmp_path, monkeypatch
+):
+    # p0 wedges for 60 s; the batch deadline kills it at 6 s; the detector
+    # (stuck floor 1 s, snapshots every 0.2 s) must flag it well before.
+    live_dir = tmp_path / "out" / "report" / "live"
+    monkeypatch.setenv("CURATE_LIVE_STATUS_DIR", str(live_dir))
+    monkeypatch.setenv("CURATE_LIVE_STATUS_INTERVAL_S", "0.2")
+    monkeypatch.setenv("CURATE_ANOMALY_STUCK_MIN_AGE_S", "1.0")
+    chaos.install(
+        chaos.FaultPlan(
+            rules=(
+                chaos.FaultRule(
+                    site=chaos.SITE_WORKER_HANG, kind="hang",
+                    delay_s=60.0, worker_re="-p0$",
+                ),
+            )
+        ),
+        export_env=True,
+    )
+    runner = StreamingRunner()
+    t0 = time.monotonic()
+    out = run_pipeline(
+        [Item(value=i) for i in range(3)],
+        [StageSpec(BumpStage(), num_workers=1, batch_timeout_s=BATCH_TIMEOUT_S)],
+        config=PipelineConfig(
+            streaming=StreamingSpec(
+                autoscale_interval_s=3600.0, max_queued_lower_bound=4
+            )
+        ),
+        runner=runner,
+    )
+    elapsed = time.monotonic() - t0
+    # the run recovered through the normal deadline-kill path
+    assert sorted(t.value for t in out) == [1, 2, 3]
+    assert elapsed < 45.0
+    assert runner.stage_counts["BumpStage"]["completed"] == 3
+
+    # the detector flagged the hang — and it did so while the batch was
+    # younger than the deadline: detection beat the timeout kill
+    agg = stage_timer.anomaly_summaries()
+    assert agg, "no anomalies recorded for a 6s hang"
+    assert agg["counts"].get("BumpStage/stuck_batch", 0) >= 1
+    stuck = [e for e in agg["recent"] if e["kind"] == "stuck_batch"]
+    assert stuck
+    assert all(e["age_s"] < BATCH_TIMEOUT_S for e in stuck), (
+        f"stuck_batch emitted only after the deadline: {stuck}"
+    )
+
+    # the verdict also rode the live snapshot (what /v1/jobs/<id>/status
+    # and `top` would have served mid-hang)
+    final = read_status(str(live_dir))
+    assert final is not None and final["state"] == "finished"
+    assert final["anomaly_count"] >= 1
+    assert any(e["kind"] == "stuck_batch" for e in final["anomalies"])

@@ -1,0 +1,245 @@
+"""Live-status snapshot tests (observability/live_status.py): atomic swap
+under a concurrent reader (no torn JSON, ever), rate limiting, schema
+augmentation, env wiring, rendering, and the runner integration — a real
+PipelinedRunner run publishes well-formed snapshots with nonzero per-stage
+data while it runs."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cosmos_curate_tpu.observability import live_status
+from cosmos_curate_tpu.observability.anomaly import AnomalyConfig, AnomalyDetector
+from cosmos_curate_tpu.observability.live_status import (
+    LIVE_STATUS_DIR_ENV,
+    LiveStatusPublisher,
+    export_live_status_dir,
+    read_status,
+    render_status,
+    status_path,
+)
+
+
+def make_publisher(tmp_path, **kw):
+    kw.setdefault("interval_s", 0.0)
+    kw.setdefault("detector", AnomalyDetector(AnomalyConfig(), emit=False))
+    return LiveStatusPublisher(str(tmp_path / "live"), runner="test", **kw)
+
+
+class TestAtomicity:
+    def test_no_torn_json_under_concurrent_reader(self, tmp_path):
+        """A writer swapping snapshots as fast as it can while a reader
+        re-reads the file: every read parses and carries the full schema —
+        the atomic-rename contract."""
+        pub = make_publisher(tmp_path)
+        stop = threading.Event()
+        errors: list = []
+        reads = [0]
+
+        def reader():
+            while not stop.is_set():
+                snap = read_status(str(pub.path))
+                if snap is None:
+                    continue  # racing the very first publish
+                try:
+                    assert "seq" in snap and "ts" in snap and "stages" in snap
+                    # the payload survives intact (never half a JSON doc)
+                    assert snap["stages"]["S"]["queue_depth"] == snap["seq"]
+                    reads[0] += 1
+                except Exception as e:  # pragma: no cover - failure path
+                    errors.append(e)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        try:
+            for i in range(200):
+                pub.publish({"stages": {"S": {"queue_depth": i + 1}}})
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert not errors
+        assert reads[0] > 0  # the reader actually observed snapshots
+
+    def test_reader_tolerates_absence_and_garbage(self, tmp_path):
+        assert read_status(str(tmp_path)) is None
+        p = tmp_path / "report" / "live"
+        p.mkdir(parents=True)
+        (p / "status.json").write_text("{not json")
+        assert read_status(str(tmp_path)) is None
+
+
+class TestPublisher:
+    def test_rate_limit_and_seq(self, tmp_path):
+        pub = make_publisher(tmp_path, interval_s=3600.0)
+        calls = [0]
+
+        def build():
+            calls[0] += 1
+            return {"stages": {}}
+
+        assert pub.maybe_publish(build) is not None
+        assert pub.maybe_publish(build) is None  # inside the interval
+        assert calls[0] == 1
+        snap = read_status(str(pub.path))
+        assert snap["seq"] == 1 and snap["state"] == "running"
+
+    def test_finalize_marks_finished(self, tmp_path):
+        pub = make_publisher(tmp_path)
+        pub.publish({"stages": {}})
+        pub.finalize({"stages": {}})
+        snap = read_status(str(pub.path))
+        assert snap["state"] == "finished" and snap["seq"] == 2
+
+    def test_snapshot_carries_aggregates_and_anomalies(self, tmp_path):
+        from cosmos_curate_tpu.observability.stage_timer import (
+            DispatchRecord,
+            record_dispatch,
+            reset_dispatch_stats,
+        )
+
+        reset_dispatch_stats()
+        try:
+            record_dispatch(
+                "embed", DispatchRecord(0.1, 0.2, 0.0, 0.0, rows=4, padded_rows=4)
+            )
+            det = AnomalyDetector(AnomalyConfig(stuck_min_age_s=1.0), emit=False)
+            pub = make_publisher(tmp_path, detector=det)
+            snap = pub.publish(
+                {"stages": {"S": {"inflight": [{"batch_id": 1, "age_s": 60.0}]}}}
+            )
+            assert snap["dispatch"]["embed"]["dispatches"] == 1
+            assert snap["anomaly_count"] == 1
+            assert snap["anomalies"][0]["kind"] == "stuck_batch"
+            # the file and the returned dict agree
+            assert read_status(str(pub.path))["anomaly_count"] == 1
+        finally:
+            reset_dispatch_stats()
+
+    def test_publish_failure_never_raises(self, tmp_path):
+        pub = make_publisher(tmp_path)
+        (tmp_path / "live").write_text("a file where the dir should be")
+        pub.publish({"stages": {}})  # must not raise
+
+
+class TestEnvWiring:
+    def test_export_derives_and_overwrites(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(LIVE_STATUS_DIR_ENV, raising=False)
+        d1 = export_live_status_dir(str(tmp_path / "run1"))
+        assert d1 == str(tmp_path / "run1" / "report" / "live")
+        assert os.environ[LIVE_STATUS_DIR_ENV] == d1
+        # a second run in the same process gets ITS dir, not run1's
+        d2 = export_live_status_dir(str(tmp_path / "run2"))
+        assert d2 == str(tmp_path / "run2" / "report" / "live")
+        assert LiveStatusPublisher.from_env(runner="x").dir == live_status.Path(d2)
+
+    def test_remote_root_and_kill_switch_disable(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(LIVE_STATUS_DIR_ENV, raising=False)
+        assert export_live_status_dir("s3://bucket/run") is None
+        assert LiveStatusPublisher.from_env() is None
+        monkeypatch.setenv("CURATE_LIVE_STATUS", "0")
+        assert export_live_status_dir(str(tmp_path)) is None
+        assert LiveStatusPublisher.from_env() is None
+
+    def test_status_path_matches_export(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(LIVE_STATUS_DIR_ENV, raising=False)
+        out = str(tmp_path / "out")
+        d = export_live_status_dir(out)
+        pub = LiveStatusPublisher.from_env()
+        assert str(pub.path) == status_path(out)
+        assert d in status_path(out)
+
+
+class TestRender:
+    def test_render_contains_stage_table_and_anomalies(self):
+        snap = {
+            "ts": time.time(), "seq": 3, "state": "running", "runner": "pipelined",
+            "wall_s": 12.5, "pid": 1, "node": "driver",
+            "stages": {
+                "Download": {
+                    "queue_depth": 4, "busy_frac": 0.9, "workers": 2,
+                    "completed": 10, "errored": 1, "dead_lettered": 0,
+                    "inflight": [{"batch_id": 11, "age_s": 2.5}],
+                },
+            },
+            "nodes": {"agent-1": {"heartbeat_age_s": 1.2, "alive": True}},
+            "anomalies": [
+                {"ts": time.time(), "kind": "stuck_batch", "stage": "Download",
+                 "detail": "batch 11 in flight 90s"},
+            ],
+            "anomaly_count": 1,
+        }
+        text = render_status(snap)
+        assert "RUNNING" in text
+        assert "Download" in text and "2.5s" in text
+        assert "stuck_batch" in text and "heartbeat" in text
+
+    def test_render_flags_stale_snapshot(self):
+        snap = {"ts": time.time() - 120, "state": "running", "stages": {}}
+        assert "stale" in render_status(snap)
+
+
+@pytest.mark.slow
+class TestRunnerIntegration:
+    def test_pipelined_runner_publishes_live_snapshots(self, tmp_path, monkeypatch):
+        """A real PipelinedRunner run with the env exported publishes
+        running snapshots with nonzero queue/busy data, then a terminal
+        one."""
+        from cosmos_curate_tpu.core.pipeline import PipelineConfig, PipelineSpec
+        from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+        from cosmos_curate_tpu.core.stage import Stage, StageSpec
+        from cosmos_curate_tpu.core.tasks import PipelineTask
+
+        class SlowStage(Stage):
+            thread_safe = True
+
+            def process_data(self, tasks):
+                time.sleep(0.05)
+                return tasks
+
+        live_dir = tmp_path / "out" / "report" / "live"
+        monkeypatch.setenv(LIVE_STATUS_DIR_ENV, str(live_dir))
+        monkeypatch.setenv("CURATE_LIVE_STATUS_INTERVAL_S", "0.05")
+        seen: list[dict] = []
+        stop = threading.Event()
+
+        def watcher():
+            while not stop.is_set():
+                snap = read_status(str(live_dir))
+                if snap is not None and (not seen or seen[-1]["seq"] != snap["seq"]):
+                    seen.append(snap)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        try:
+            runner = PipelinedRunner(poll_interval_s=0.01)
+            out = runner.run(
+                PipelineSpec(
+                    input_data=[PipelineTask() for _ in range(24)],
+                    stages=[StageSpec(SlowStage())],
+                    config=PipelineConfig(num_cpus=2.0),
+                )
+            )
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert out is not None and len(out) == 24
+        final = read_status(str(live_dir))
+        assert final["state"] == "finished"
+        assert final["stages"]["SlowStage"]["completed"] > 0
+        assert final["runner"] == "pipelined"
+        # at least one mid-run snapshot showed live in-flight/queue data
+        running = [s for s in seen if s["state"] == "running"]
+        assert running, "no running snapshot was ever published"
+        assert any(
+            s["stages"]["SlowStage"]["queue_depth"] > 0
+            or s["stages"]["SlowStage"]["inflight"]
+            or s["stages"]["SlowStage"]["busy_frac"] > 0
+            for s in running
+        )

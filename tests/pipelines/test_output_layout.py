@@ -91,8 +91,24 @@ class TestOutputLayout:
         artifacts must be added to the layout doc + this golden, not
         scattered."""
         out, _ = split_run
-        expected = {"clips", "metas", "previews", "processed_videos", "summary.json"}
+        # report/ is the run's observability home: run_report.json on
+        # traced runs, live/status.json (the live ops snapshot) on every
+        # local run — see docs/OBSERVABILITY.md
+        expected = {
+            "clips", "metas", "previews", "processed_videos", "summary.json",
+            "report",
+        }
         assert {p.name for p in out.iterdir()} <= expected
+
+    def test_live_status_snapshot_under_report(self, split_run):
+        """Every local run leaves its terminal live snapshot at
+        report/live/status.json (docs/OBSERVABILITY.md "Live operations");
+        report/ holds nothing else on an untraced run."""
+        out, _ = split_run
+        snap = json.loads((out / "report" / "live" / "status.json").read_text())
+        assert snap["state"] == "finished"
+        assert snap["stages"], "terminal snapshot carries per-stage data"
+        assert {p.name for p in (out / "report").iterdir()} <= {"live"}
 
 
 class TestWeightsProvenanceStamp:

@@ -1,0 +1,415 @@
+"""Live ops endpoints + per-tenant SLOs (service/app.py + service/slo.py):
+/health readiness payload, /v1/jobs/<id>/status serving a REAL job child's
+live snapshot mid-run, /v1/slo with tenants breaching their targets, and
+the dispatcher's anomaly relay into the journal."""
+
+import asyncio
+import json
+import sys
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from cosmos_curate_tpu.service.admission import QuotaConfig
+from cosmos_curate_tpu.service.app import ServiceConfig, build_app
+from cosmos_curate_tpu.service.slo import SloConfig, SloTracker
+
+
+def _cfg(slo=None, **quota_kw):
+    quota_kw.setdefault("cpus_per_job", 0.0)
+    fields = {f for f in QuotaConfig.__dataclass_fields__}
+    q = {k: v for k, v in quota_kw.items() if k in fields}
+    rest = {k: v for k, v in quota_kw.items() if k not in fields}
+    return ServiceConfig(
+        quota=QuotaConfig(**q),
+        retry_base_s=0.05,
+        retry_cap_s=0.1,
+        slo=slo or SloConfig(),
+        anomaly_scan_interval_s=0.1,
+        **rest,
+    )
+
+
+class Service:
+    """One app + its own event loop, with sync helpers (the
+    test_durable_service.py harness, trimmed to what these tests use)."""
+
+    def __init__(self, work_root, config=None, runner_cmd=None):
+        self.app = build_app(
+            work_root=str(work_root), config=config or _cfg(), runner_cmd=runner_cmd
+        )
+        self.state = self.app["state"]
+        self.loop = asyncio.new_event_loop()
+
+        async def make():
+            client = TestClient(TestServer(self.app))
+            await client.start_server()
+            return client
+
+        self.client = self.loop.run_until_complete(make())
+
+    def req(self, method, path, **kw):
+        async def go():
+            resp = await self.client.request(method, path, **kw)
+            return resp.status, await resp.json()
+
+        return self.loop.run_until_complete(go())
+
+    def submit(self, **body):
+        body.setdefault("pipeline", "split")
+        body.setdefault("args", {})
+        status, doc = self.req("POST", "/v1/invoke", json=body)
+        assert status == 200, doc
+        return doc["job_id"]
+
+    def wait(self, pred, timeout=20.0, msg="condition"):
+        async def go():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < timeout:
+                if pred():
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        assert self.loop.run_until_complete(go()), f"timeout waiting for {msg}"
+
+    def wait_state(self, job_id, *states, timeout=20.0):
+        self.wait(
+            lambda: self.state.jobs[job_id].state in states,
+            timeout=timeout,
+            msg=f"job {job_id} -> {states} (now {self.state.jobs[job_id].state})",
+        )
+
+    def wait_http(self, method, path, accept, timeout=20.0, msg="http condition"):
+        """Poll an endpoint from INSIDE the loop (a sync req() inside a
+        wait() predicate would nest run_until_complete). Returns the first
+        accepted (status, doc)."""
+
+        async def go():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < timeout:
+                resp = await self.client.request(method, path)
+                doc = await resp.json()
+                if accept(resp.status, doc):
+                    return resp.status, doc
+                await asyncio.sleep(0.05)
+            return None
+
+        out = self.loop.run_until_complete(go())
+        assert out is not None, f"timeout waiting for {msg}"
+        return out
+
+    def close(self):
+        self.loop.run_until_complete(self.client.close())
+        self.loop.close()
+
+
+def sleep_job(duration_s, rc=0):
+    def cmd(rec, work_dir):
+        code = (
+            "import json, sys, time\n"
+            f"time.sleep({duration_s})\n"
+            f"rc = {rc}\n"
+            "if rc == 0:\n"
+            "    json.dump({'ok': True}, open(sys.argv[1], 'w'))\n"
+            "sys.exit(rc)\n"
+        )
+        return [sys.executable, "-c", code, str(work_dir / "summary.json")]
+
+    return cmd
+
+
+# a REAL pipeline job: PipelinedRunner over a slow 2-stage spec with live
+# status exported to the job's output root — exactly what run_split wires
+# up, minus the video corpus
+_LIVE_JOB = """
+import json, os, sys, time
+out, summary = sys.argv[1], sys.argv[2]
+os.environ["CURATE_LIVE_STATUS_INTERVAL_S"] = "0.05"
+from cosmos_curate_tpu.observability.live_status import export_live_status_dir
+export_live_status_dir(out)
+from cosmos_curate_tpu.core.pipeline import PipelineConfig, PipelineSpec
+from cosmos_curate_tpu.core.pipelined_runner import PipelinedRunner
+from cosmos_curate_tpu.core.stage import Stage, StageSpec
+from cosmos_curate_tpu.core.tasks import PipelineTask
+
+class SlowA(Stage):
+    thread_safe = True
+    def process_data(self, tasks):
+        time.sleep(0.08)
+        return tasks
+
+class SlowB(SlowA):
+    pass
+
+runner = PipelinedRunner(poll_interval_s=0.01)
+runner.run(PipelineSpec(
+    input_data=[PipelineTask() for _ in range(30)],
+    stages=[StageSpec(SlowA()), StageSpec(SlowB())],
+    config=PipelineConfig(num_cpus=2.0),
+))
+json.dump({"ok": True}, open(summary, "w"))
+"""
+
+
+def live_job(output_dir):
+    def cmd(rec, work_dir):
+        return [
+            sys.executable, "-c", _LIVE_JOB,
+            str(output_dir), str(work_dir / "summary.json"),
+        ]
+
+    return cmd
+
+
+class TestHealthReadiness:
+    def test_ready_payload(self, tmp_path):
+        svc = Service(tmp_path / "svc")
+        try:
+            status, doc = svc.req("GET", "/health")
+            assert status == 200
+            svc.wait(lambda: svc.state.dispatcher_running, msg="dispatcher up")
+            status, doc = svc.req("GET", "/health")
+            assert doc["ready"] is True
+            assert doc["dispatcher_running"] is True
+            assert doc["journal_writable"] is True
+            assert set(doc["queued"]) == {"interactive", "batch"}
+            assert doc["running_jobs"] == []
+            assert doc["slo_enabled"] is False
+        finally:
+            svc.close()
+
+    def test_journal_failure_flips_ready(self, tmp_path):
+        svc = Service(tmp_path / "svc")
+        try:
+            svc.wait(lambda: svc.state.dispatcher_running, msg="dispatcher up")
+            svc.state.journal_ok = False
+            _, doc = svc.req("GET", "/health")
+            assert doc["ready"] is False and doc["journal_writable"] is False
+        finally:
+            svc.close()
+
+
+class TestJobStatusEndpoint:
+    def test_unknown_job_404(self, tmp_path):
+        svc = Service(tmp_path / "svc")
+        try:
+            status, _ = svc.req("GET", "/v1/jobs/nope/status")
+            assert status == 404
+        finally:
+            svc.close()
+
+    def test_no_snapshot_yet(self, tmp_path):
+        svc = Service(tmp_path / "svc", runner_cmd=sleep_job(0.5))
+        try:
+            job_id = svc.submit(args={"output_path": str(tmp_path / "out")})
+            svc.wait_state(job_id, "running", "done")
+            status, doc = svc.req("GET", f"/v1/jobs/{job_id}/status")
+            assert status == 200
+            assert doc["live"] is False and "detail" in doc
+        finally:
+            svc.close()
+
+    def test_live_snapshot_served_mid_run(self, tmp_path):
+        """The acceptance proof at unit scale: while a real pipelined job
+        runs, /v1/jobs/<id>/status serves a well-formed snapshot with
+        nonzero per-stage queue/busy/in-flight data."""
+        out = tmp_path / "out"
+        svc = Service(tmp_path / "svc", runner_cmd=live_job(out))
+        try:
+            job_id = svc.submit(args={"output_path": str(out)})
+            svc.wait_state(job_id, "running")
+
+            def accept(status, doc):
+                if status != 200 or not doc.get("live"):
+                    return False
+                snap = doc["snapshot"]
+                stages = snap.get("stages") or {}
+                if snap.get("state") != "running" or len(stages) != 2:
+                    return False
+                return any(
+                    s.get("queue_depth", 0) > 0
+                    or s.get("inflight")
+                    or s.get("busy_frac", 0) > 0
+                    for s in stages.values()
+                )
+
+            _, seen = svc.wait_http(
+                "GET", f"/v1/jobs/{job_id}/status", accept,
+                msg="live snapshot with per-stage data",
+            )
+            assert seen["snapshot_age_s"] < 10.0
+            assert seen["stale"] is False
+            assert "SlowA" in seen["snapshot"]["stages"]
+            svc.wait_state(job_id, "done", timeout=60.0)
+            # after the run the terminal snapshot is served
+            _, doc = svc.req("GET", f"/v1/jobs/{job_id}/status")
+            assert doc["snapshot"]["state"] == "finished"
+        finally:
+            svc.close()
+
+
+class TestSloEndpoint:
+    def test_queue_wait_breach_counts_and_reports(self, tmp_path):
+        """max_concurrent=1 + a slow job ahead forces a queue wait past the
+        5 ms target: the waiting tenant breaches, /v1/slo reports it, the
+        metric and journal record it."""
+        cfg = _cfg(
+            slo=SloConfig(queue_wait_s=0.005),
+            max_concurrent_jobs=1,
+            max_running_per_tenant=1,
+        )
+        svc = Service(tmp_path / "svc", config=cfg, runner_cmd=sleep_job(0.4))
+        try:
+            first = svc.submit(tenant="slow-co")
+            second = svc.submit(tenant="slow-co")
+            svc.wait_state(first, "done", timeout=30.0)
+            svc.wait_state(second, "done", timeout=30.0)
+            status, doc = svc.req("GET", "/v1/slo")
+            assert status == 200
+            assert doc["enabled"] is True
+            assert doc["targets"]["queue_wait_s"] == 0.005
+            t = doc["tenants"]["slow-co"]
+            assert t["queue_wait"]["breaches"] >= 1
+            assert t["queue_wait"]["max_s"] > 0.005
+            assert t["breaches_total"] >= 1
+            # the breach left a journal receipt
+            journal = (tmp_path / "svc" / "journal.ndjson").read_text()
+            assert "slo-breach:queue_wait" in journal
+            if svc.state.metrics.enabled:
+                val = svc.state.metrics.slo_breaches.labels(
+                    "slow-co", "queue_wait"
+                )._value.get()
+                assert val >= 1
+        finally:
+            svc.close()
+
+    def test_run_duration_and_success_rate_breaches(self, tmp_path):
+        cfg = _cfg(slo=SloConfig(run_duration_s=0.01, success_rate=0.9, window=10))
+        svc = Service(tmp_path / "svc", config=cfg, runner_cmd=sleep_job(0.2))
+        try:
+            ok = svc.submit(tenant="acme")
+            svc.wait_state(ok, "done", timeout=30.0)
+            # a successful-but-slow job breaches run_duration only
+            _, doc = svc.req("GET", "/v1/slo")
+            t = doc["tenants"]["acme"]
+            assert t["run_duration"]["breaches"] == 1
+            assert t["success_rate"]["breaches"] == 0
+            # now 5 dead-lettered jobs sink the success rate below 0.9
+            svc.state.runner_cmd = sleep_job(0.01, rc=3)
+            for _ in range(5):
+                jid = svc.submit(tenant="acme", max_attempts=1)
+                svc.wait_state(jid, "dead_lettered", timeout=30.0)
+            _, doc = svc.req("GET", "/v1/slo")
+            t = doc["tenants"]["acme"]
+            assert t["success_rate"]["breaches"] >= 1
+            assert t["success_rate"]["rate"] < 0.9
+        finally:
+            svc.close()
+
+    def test_slo_disabled_never_breaches(self, tmp_path):
+        svc = Service(tmp_path / "svc", runner_cmd=sleep_job(0.01))
+        try:
+            jid = svc.submit(tenant="t")
+            svc.wait_state(jid, "done")
+            _, doc = svc.req("GET", "/v1/slo")
+            assert doc["enabled"] is False
+            assert doc["tenants"]["t"]["breaches_total"] == 0
+            assert doc["occupancy"]["t"] == {"queued": 0, "running": 0}
+        finally:
+            svc.close()
+
+
+class TestAnomalyRelay:
+    def test_dispatcher_journals_child_anomalies(self, tmp_path):
+        """A running job whose snapshot carries anomaly verdicts: the
+        dispatcher relays them into the journal (+ service metrics) —
+        the child has neither."""
+        out = tmp_path / "out"
+        live = out / "report" / "live"
+
+        def anomaly_job(rec, work_dir):
+            code = (
+                "import json, os, sys, time\n"
+                "live = sys.argv[1]\n"
+                "os.makedirs(live, exist_ok=True)\n"
+                "snap = {'ts': time.time(), 'seq': 1, 'state': 'running',\n"
+                "        'stages': {}, 'anomaly_count': 2, 'anomalies': [\n"
+                "    {'ts': time.time(), 'kind': 'stuck_batch', 'stage': 'S',\n"
+                "     'detail': 'batch 0 in flight 99s'},\n"
+                "    {'ts': time.time(), 'kind': 'starved_stage', 'stage': 'T',\n"
+                "     'detail': 'idle behind full upstream'},\n"
+                "]}\n"
+                "tmp = os.path.join(live, '.status.json.tmp')\n"
+                "open(tmp, 'w').write(json.dumps(snap))\n"
+                "os.replace(tmp, os.path.join(live, 'status.json'))\n"
+                "time.sleep(1.5)\n"
+                "json.dump({'ok': True}, open(sys.argv[2], 'w'))\n"
+            )
+            return [sys.executable, "-c", code, str(live), str(work_dir / "summary.json")]
+
+        svc = Service(tmp_path / "svc", runner_cmd=anomaly_job)
+        try:
+            job_id = svc.submit(args={"output_path": str(out)})
+            svc.wait(
+                lambda: svc.state._anomaly_seen.get(job_id, 0) >= 2,
+                msg="anomaly relay",
+            )
+            journal = (tmp_path / "svc" / "journal.ndjson").read_text()
+            assert "anomaly:stuck_batch" in journal
+            assert "anomaly:starved_stage" in journal
+            svc.wait_state(job_id, "done", timeout=30.0)
+            # relay state is pruned once the job leaves the running set
+            svc.wait(
+                lambda: job_id not in svc.state._anomaly_seen,
+                msg="relay state pruned",
+            )
+            # the status endpoint serves the same verdicts
+            _, doc = svc.req("GET", f"/v1/jobs/{job_id}/status")
+            assert doc["anomaly_count"] == 2
+            assert {e["kind"] for e in doc["anomalies"]} == {
+                "stuck_batch", "starved_stage",
+            }
+        finally:
+            svc.close()
+
+
+class TestSloTrackerUnits:
+    def test_queue_wait_breach(self):
+        tr = SloTracker(SloConfig(queue_wait_s=1.0))
+        assert tr.observe_dispatch("t", 0.5) == []
+        assert tr.observe_dispatch("t", 2.0) == ["queue_wait"]
+        rep = tr.report()["tenants"]["t"]
+        assert rep["queue_wait"]["breaches"] == 1
+        assert rep["queue_wait"]["max_s"] == 2.0
+
+    def test_duration_judged_on_success_only(self):
+        tr = SloTracker(SloConfig(run_duration_s=1.0))
+        assert tr.observe_terminal("t", "done", 5.0) == ["run_duration"]
+        # a fast failure and a slow termination never judge duration
+        assert tr.observe_terminal("t", "dead_lettered", 9.0) == []
+        assert tr.observe_terminal("t", "terminated", 9.0) == []
+
+    def test_success_rate_needs_min_window(self):
+        tr = SloTracker(SloConfig(success_rate=0.9))
+        for _ in range(4):
+            assert tr.observe_terminal("t", "failed", 0.1) == []
+        assert tr.observe_terminal("t", "failed", 0.1) == ["success_rate"]
+        rep = tr.report()["tenants"]["t"]
+        assert rep["success_rate"]["rate"] == 0.0
+        assert rep["success_rate"]["window"] == 5
+
+    def test_terminated_excluded_from_success_window(self):
+        tr = SloTracker(SloConfig(success_rate=0.5))
+        for _ in range(10):
+            tr.observe_terminal("t", "terminated", None)
+        rep = tr.report()["tenants"]["t"]
+        assert rep["success_rate"]["window"] == 0
+        assert rep["success_rate"]["breaches"] == 0
+
+    def test_disabled_config_never_breaches(self):
+        tr = SloTracker(SloConfig())
+        assert tr.observe_dispatch("t", 999.0) == []
+        assert tr.observe_terminal("t", "failed", 999.0) == []
+        assert tr.report()["enabled"] is False
