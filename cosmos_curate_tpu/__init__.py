@@ -14,3 +14,14 @@ slices) instead of NCCL; video decode/encode stays CPU-side.
 """
 
 __version__ = "0.1.0"
+
+# Opt-in runtime lock sanitizer (the dynamic twin of `lint --concurrency`):
+# CURATE_LOCKCHECK=1 proxies every repo-created threading.Lock/RLock to
+# record acquisition order, inversions, and blocking-under-lock, dumping
+# lockcheck_report.json at exit. No-op (and zero overhead) otherwise.
+import os as _os
+
+if _os.environ.get("CURATE_LOCKCHECK", "") in ("1", "true", "yes"):
+    from cosmos_curate_tpu.analysis import lock_runtime as _lock_runtime
+
+    _lock_runtime.maybe_install_from_env()

@@ -37,6 +37,23 @@ class Finding:
     def render(self) -> str:
         return f"{self.file}:{self.line} {self.rule} {self.message}"
 
+    def to_json(self) -> str:
+        """One NDJSON line (the ``lint --json`` machine interface, shared
+        by every pillar — AST rules, graph, shardcheck, concurrency — so
+        CI annotators never parse the human rendering)."""
+        import json
+
+        return json.dumps(
+            {
+                "rule": self.rule,
+                "file": self.file,
+                "line": self.line,
+                "severity": self.severity.value,
+                "message": self.message,
+            },
+            sort_keys=True,
+        )
+
 
 # -- suppression comments ---------------------------------------------------
 

@@ -1,0 +1,454 @@
+"""Runtime lock sanitizer — the dynamic twin of ``concurrency_check.py``.
+
+Opt-in (``CURATE_LOCKCHECK=1`` before the first ``import
+cosmos_curate_tpu``) because it proxies every ``threading.Lock`` /
+``threading.RLock`` the repo creates. When enabled it records, per thread:
+
+- the set of proxied locks currently held (an ordered stack);
+- every observed acquisition-order edge ``held -> newly-acquired``;
+- **order inversions**: acquiring B while holding A after some thread has
+  already acquired A while holding B — the live counterpart of the static
+  checker's ``lock-order`` cycles;
+- **blocking under lock**: ``time.sleep`` / ``os.fsync`` executed while
+  any proxied lock is held (the live counterpart of ``lock-blocking``);
+- per-lock max hold time, acquisition count, and peak waiters.
+
+Locks are named by their creation site (repo-relative ``file:line``),
+which joins onto the static pass through
+``LockRegistry.by_site()`` — see :func:`cross_validate`. Locks created
+outside the repo tree (stdlib ``queue.Queue`` internals, third-party
+code) get real locks, not proxies: the sanitizer watches *our* locks
+only, so overhead stays proportional to repo lock traffic.
+
+The proxies implement ``_release_save`` / ``_acquire_restore`` /
+``_is_owned``, so a ``threading.Condition`` built on a proxied lock
+(``Condition(self._lock)``, or a bare ``Condition()`` whose implicit
+RLock resolves through the patched constructor) keeps the held-set
+consistent across ``wait()``.
+
+Knobs:
+
+- ``CURATE_LOCKCHECK=1``         — install at package import.
+- ``CURATE_LOCKCHECK_REPORT=p``  — dump a JSON report to ``p`` at exit
+  (default ``lockcheck_report.json`` in the CWD). When ``p`` is an
+  existing directory, each process writes ``lockcheck-<pid>.json``
+  inside it — the soak scripts point every spawned process at one
+  directory and sweep it afterwards.
+
+Report schema (``lockcheck_report.json``)::
+
+    {"clean": bool,                  # no inversions and no blocking events
+     "locks": {name: {"acquisitions": n, "max_hold_s": s, "reentrant": b}},
+     "edges": [[src, dst], ...],     # observed order edges (site names)
+     "inversions": [{"held": a, "acquiring": b, "prior_edge": [b, a],
+                     "thread": t, "stack": [...]}],
+     "blocking": [{"call": c, "held": [...], "thread": t, "stack": [...]}]}
+
+Programmatic use (tests, soaks)::
+
+    rec = install()           # idempotent; returns the active recorder
+    ... exercise code ...
+    report = rec.report()
+    uninstall()               # restore the real constructors
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Any
+
+ENV_FLAG = "CURATE_LOCKCHECK"
+ENV_REPORT = "CURATE_LOCKCHECK_REPORT"
+DEFAULT_REPORT = "lockcheck_report.json"
+
+# Bound the evidence lists so a pathological soak can't balloon the report:
+# the first occurrences carry all the diagnostic value.
+_MAX_EVENTS = 200
+_STACK_DEPTH = 6
+
+# Real constructors, captured at import so proxies and the recorder itself
+# never recurse through the patch.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_SLEEP = time.sleep
+_REAL_FSYNC = os.fsync
+
+
+def _repo_root() -> Path:
+    from cosmos_curate_tpu.analysis.common import find_pyproject
+
+    pyproject = find_pyproject()
+    return pyproject.parent if pyproject else Path.cwd()
+
+
+def _short_stack() -> list[str]:
+    """Innermost repo frames as ``file:line fn`` — enough to find the site
+    without shipping whole tracebacks into the report."""
+    out = []
+    for fr in traceback.extract_stack()[:-2][-_STACK_DEPTH:]:
+        out.append(f"{fr.filename}:{fr.lineno} {fr.name}")
+    return out
+
+
+class LockOrderError(AssertionError):
+    """Raised on inversion when the recorder runs in strict mode (tests)."""
+
+
+class _Recorder:
+    """Process-global observation store. All mutation happens under a real
+    (unproxied) lock; the per-thread held stack is thread-local so reads on
+    the acquire hot path are lock-free."""
+
+    def __init__(self, repo_root: Path, strict: bool = False) -> None:
+        self.repo_root = repo_root.resolve()
+        self.strict = strict
+        self._mu = _REAL_LOCK()
+        self._tls = threading.local()
+        # name -> {"acquisitions", "max_hold_s", "reentrant"}
+        self.locks: dict[str, dict[str, Any]] = {}
+        # observed order edges (src site, dst site) -> first-seen stack
+        self.edges: dict[tuple[str, str], list[str]] = {}
+        self.inversions: list[dict[str, Any]] = []
+        self.blocking: list[dict[str, Any]] = []
+
+    # -- per-thread held stack ---------------------------------------------
+
+    def held(self) -> list["_ProxyBase"]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def held_names(self) -> list[str]:
+        seen: list[str] = []
+        for p in self.held():
+            if p.name not in seen:
+                seen.append(p.name)
+        return seen
+
+    # -- event recording ----------------------------------------------------
+
+    def register(self, proxy: "_ProxyBase") -> None:
+        with self._mu:
+            self.locks.setdefault(
+                proxy.name,
+                {"acquisitions": 0, "max_hold_s": 0.0, "reentrant": proxy.reentrant},
+            )
+
+    def note_acquired(self, proxy: "_ProxyBase", held: list["_ProxyBase"]) -> None:
+        """Called after a successful non-reentrant acquire, with ``held``
+        the stack *before* this acquisition."""
+        inversion = None
+        with self._mu:
+            stats = self.locks.setdefault(
+                proxy.name,
+                {"acquisitions": 0, "max_hold_s": 0.0, "reentrant": proxy.reentrant},
+            )
+            stats["acquisitions"] += 1
+            for h in held:
+                if h.name == proxy.name:
+                    continue
+                edge = (h.name, proxy.name)
+                if edge not in self.edges:
+                    self.edges[edge] = _short_stack()
+                if (proxy.name, h.name) in self.edges and len(
+                    self.inversions
+                ) < _MAX_EVENTS:
+                    inversion = {
+                        "held": h.name,
+                        "acquiring": proxy.name,
+                        "prior_edge": [proxy.name, h.name],
+                        "thread": threading.current_thread().name,
+                        "stack": _short_stack(),
+                    }
+                    self.inversions.append(inversion)
+        if inversion is not None and self.strict:
+            raise LockOrderError(
+                f"lock-order inversion: acquiring {proxy.name} while holding "
+                f"{inversion['held']} — the opposite order was already observed"
+            )
+
+    def note_released(self, proxy: "_ProxyBase", held_s: float) -> None:
+        with self._mu:
+            stats = self.locks.get(proxy.name)
+            if stats is not None and held_s > stats["max_hold_s"]:
+                stats["max_hold_s"] = held_s
+
+    def note_blocking(self, call: str) -> None:
+        names = self.held_names()
+        if not names:
+            return
+        with self._mu:
+            if len(self.blocking) < _MAX_EVENTS:
+                self.blocking.append(
+                    {
+                        "call": call,
+                        "held": names,
+                        "thread": threading.current_thread().name,
+                        "stack": _short_stack(),
+                    }
+                )
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self) -> dict[str, Any]:
+        with self._mu:
+            return {
+                "clean": not self.inversions and not self.blocking,
+                "locks": {k: dict(v) for k, v in self.locks.items()},
+                "edges": sorted([src, dst] for src, dst in self.edges),
+                "inversions": list(self.inversions),
+                "blocking": list(self.blocking),
+            }
+
+    def dump(self, path: str | Path | None = None) -> Path:
+        out = Path(path or os.environ.get(ENV_REPORT, DEFAULT_REPORT))
+        if out.is_dir():
+            # directory target: per-process file, so a soak's driver and
+            # worker processes (which inherit ENV_REPORT) don't clobber
+            # each other's reports
+            out = out / f"lockcheck-{os.getpid()}.json"
+        tmp = out.with_name(out.name + f".{os.getpid()}.tmp")
+        tmp.write_text(json.dumps(self.report(), indent=2, sort_keys=True))
+        tmp.replace(out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# lock proxies
+
+
+class _ProxyBase:
+    """Shared acquire/release bookkeeping. Subclasses bind the inner lock
+    kind; the recorder only ever sees ``name`` / ``reentrant``."""
+
+    reentrant = False
+
+    def __init__(self, inner: Any, name: str, rec: _Recorder) -> None:
+        self._inner = inner
+        self.name = name
+        self._rec = rec
+        self._t0 = 0.0
+        rec.register(self)
+
+    # Depth of *this* lock on the current thread's stack (RLock re-entry).
+    def _depth(self) -> int:
+        return sum(1 for p in self._rec.held() if p is self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = self._rec.held()
+        first = self._depth() == 0
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if first:
+                self._rec.note_acquired(self, list(held))
+                self._t0 = time.monotonic()
+            held.append(self)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        held = self._rec.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        if self._depth() == 0 and self._t0:
+            self._rec.note_released(self, time.monotonic() - self._t0)
+            self._t0 = 0.0
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<{type(self).__name__} {self.name} wrapping {self._inner!r}>"
+
+
+class _LockProxy(_ProxyBase):
+    reentrant = False
+
+
+class _RLockProxy(_ProxyBase):
+    reentrant = True
+
+    # Condition integration: threading.Condition grabs these three methods
+    # off its lock when present. Delegating while keeping the held stack
+    # consistent is what lets ``cv.wait()`` hand the lock to another thread
+    # without the sanitizer thinking it is still held here.
+
+    def _release_save(self) -> Any:
+        depth = self._depth()
+        state = self._inner._release_save()
+        held = self._rec.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+        if self._t0:
+            self._rec.note_released(self, time.monotonic() - self._t0)
+            self._t0 = 0.0
+        return (state, depth)
+
+    def _acquire_restore(self, saved: Any) -> None:
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        held = self._rec.held()
+        self._rec.note_acquired(self, list(held))
+        self._t0 = time.monotonic()
+        held.extend([self] * depth)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def locked(self) -> bool:  # RLock on some versions lacks .locked()
+        try:
+            return self._inner.locked()
+        except AttributeError:  # pragma: no cover - py<3.12
+            return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------------------
+# installation
+
+
+_active: _Recorder | None = None
+
+
+def _creation_site(rec: _Recorder) -> tuple[str, int] | None:
+    """Repo-relative (file, line) of the frame calling ``Lock()`` —
+    skipping threading.py itself so ``Condition()``'s implicit RLock is
+    attributed to the Condition call site. None -> non-repo code."""
+    threading_file = threading.__file__
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == threading_file:
+        f = f.f_back
+    if f is None:
+        return None
+    fname = f.f_code.co_filename
+    try:
+        rel = Path(fname).resolve().relative_to(rec.repo_root).as_posix()
+    except ValueError:
+        return None
+    return rel, f.f_lineno
+
+
+def _make_factory(real_ctor: Any, proxy_cls: type, rec: _Recorder) -> Any:
+    @functools.wraps(real_ctor)
+    def factory(*args: Any, **kwargs: Any) -> Any:
+        inner = real_ctor(*args, **kwargs)
+        site = _creation_site(rec)
+        if site is None:
+            return inner  # non-repo lock: stay out of the way
+        return proxy_cls(inner, f"{site[0]}:{site[1]}", rec)
+
+    return factory
+
+
+def _patched_sleep(rec: _Recorder, secs: float) -> None:
+    rec.note_blocking("time.sleep")
+    _REAL_SLEEP(secs)
+
+
+def _patched_fsync(rec: _Recorder, fd: int) -> None:
+    rec.note_blocking("os.fsync")
+    _REAL_FSYNC(fd)
+
+
+def install(strict: bool = False, repo_root: Path | None = None) -> _Recorder:
+    """Patch the lock constructors and blocking syscall wrappers.
+    Idempotent: a second call returns the active recorder unchanged."""
+    global _active
+    if _active is not None:
+        return _active
+    rec = _Recorder(repo_root or _repo_root(), strict=strict)
+    threading.Lock = _make_factory(_REAL_LOCK, _LockProxy, rec)
+    threading.RLock = _make_factory(_REAL_RLOCK, _RLockProxy, rec)
+    time.sleep = functools.partial(_patched_sleep, rec)
+    os.fsync = functools.partial(_patched_fsync, rec)
+    _active = rec
+    return rec
+
+
+def uninstall() -> _Recorder | None:
+    """Restore the real constructors; returns the recorder (with all its
+    observations) for inspection, or None if nothing was installed."""
+    global _active
+    rec, _active = _active, None
+    if rec is not None:
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        time.sleep = _REAL_SLEEP
+        os.fsync = _REAL_FSYNC
+    return rec
+
+
+def active() -> _Recorder | None:
+    return _active
+
+
+def maybe_install_from_env() -> _Recorder | None:
+    """The ``cosmos_curate_tpu/__init__`` hook: install + register the
+    exit-time report dump iff ``CURATE_LOCKCHECK=1``."""
+    if os.environ.get(ENV_FLAG, "") not in ("1", "true", "yes"):
+        return None
+    rec = install()
+
+    @atexit.register
+    def _dump() -> None:  # pragma: no cover - exercised by soaks
+        try:
+            rec.dump()
+        except OSError:
+            pass
+
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# static/dynamic cross-validation
+
+
+def cross_validate(report: dict[str, Any], analysis: Any) -> list[str]:
+    """Compare a runtime report against a static ``RepoAnalysis``.
+
+    Returns human-readable gap notes: an *observed* order edge whose both
+    endpoints are statically-registered locks but which the static graph
+    lacks means the AST pass missed a real nesting (e.g. through a code
+    path it cannot follow) — worth a look, not necessarily a bug.
+    """
+    by_site = analysis.registry.by_site()
+
+    def to_key(name: str) -> str | None:
+        file, _, line = name.rpartition(":")
+        try:
+            return by_site.get((file, int(line)))
+        except ValueError:
+            return None
+
+    static_edges = {
+        (analysis.registry.root(a), analysis.registry.root(b))
+        for a, b in analysis.edge_set()
+    }
+    gaps: list[str] = []
+    for src, dst in report.get("edges", []):
+        ks, kd = to_key(src), to_key(dst)
+        if ks is None or kd is None:
+            continue
+        ks, kd = analysis.registry.root(ks), analysis.registry.root(kd)
+        if ks != kd and (ks, kd) not in static_edges:
+            gaps.append(
+                f"observed order edge {ks} -> {kd} (runtime {src} -> {dst}) "
+                "is missing from the static graph"
+            )
+    return gaps

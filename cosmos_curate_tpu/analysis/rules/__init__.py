@@ -46,9 +46,11 @@ def all_rules() -> list[Rule]:
     )
     from cosmos_curate_tpu.analysis.rules.silent_swallow import SilentSwallowRule
     from cosmos_curate_tpu.analysis.rules.sync_readback import SyncReadbackRule
+    from cosmos_curate_tpu.analysis.rules.thread_lifecycle import ThreadLifecycleRule
 
     return [
         LockDisciplineRule(),
+        ThreadLifecycleRule(),
         MinPythonRule(),
         JitTransferRule(),
         SilentSwallowRule(),

@@ -7,6 +7,8 @@ Usage:
     cosmos-curate-tpu lint --rules min-python    # subset of rules
     cosmos-curate-tpu lint --shard-check         # + sharding/shape contracts
     cosmos-curate-tpu lint --shard-check --mesh data=2,seq=2 --hbm-gb 16
+    cosmos-curate-tpu lint --concurrency         # + whole-repo lock analysis
+    cosmos-curate-tpu lint --json                # NDJSON findings (CI)
     cosmos-curate-tpu lint --list-rules
 
 ``--shard-check`` adds the device-free shardcheck pass
@@ -14,6 +16,16 @@ Usage:
 eval_shape'd against the declared mesh (default from ``[tool.curate-lint]``
 ``shard-mesh``) with zero device allocation — run it under
 ``JAX_PLATFORMS=cpu`` anywhere.
+
+``--concurrency`` adds the whole-repo concurrency verifier
+(analysis/concurrency_check.py): lock registry + acquisition-order graph
+(cycle = potential deadlock), blocking-calls-under-lock, and
+guarded-by/holds-lock contract checking. Its dynamic twin is the
+``CURATE_LOCKCHECK=1`` runtime sanitizer (analysis/lock_runtime.py).
+
+``--json`` switches findings to machine-readable NDJSON (one object per
+line: rule/file/line/severity/message) across every pillar, for
+``run_ci_checks.sh`` and the GitHub workflow's PR annotations.
 
 Exit status: 0 clean, 1 error findings, 2 usage error. Warnings print but
 do not fail the gate. Findings print as ``file:line rule-id message``; see
@@ -73,6 +85,20 @@ def register(sub: "argparse._SubParsersAction") -> None:
         "estimate (default from [tool.curate-lint] shard-hbm-gb; 0 skips)",
     )
     lint.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="also run the whole-repo concurrency verifier: lock-order "
+        "graph (deadlock cycles), blocking-under-lock, guarded-by / "
+        "holds-lock contracts",
+    )
+    lint.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="emit findings as NDJSON (rule/file/line/severity/message), "
+        "one object per line, across all pillars",
+    )
+    lint.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue and exit"
     )
     lint.set_defaults(func=_cmd_lint)
@@ -88,6 +114,9 @@ def _cmd_lint(args: argparse.Namespace) -> int:
             print(f"{rule.rule_id:32s} {rule.description}")
         print(f"{'(pass) shard-check':32s} device-free sharding/shape contracts "
               "(--shard-check; rule ids shard-*)")
+        print(f"{'(pass) concurrency':32s} whole-repo lock-order graph, "
+              "blocking-under-lock, guarded-by contracts (--concurrency; "
+              "rule ids lock-order, lock-blocking, unguarded-shared)")
         return 0
     rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules else None
     try:
@@ -108,8 +137,16 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    if args.concurrency:
+        from cosmos_curate_tpu.analysis.concurrency_check import run_concurrency_check
+
+        try:
+            findings.extend(run_concurrency_check(args.paths))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     for f in findings:
-        print(f.render())
+        print(f.to_json() if args.as_json else f.render())
     errors = [f for f in findings if f.severity is Severity.ERROR]
     n_files = len(args.paths)
     if errors:

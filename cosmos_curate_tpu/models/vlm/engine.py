@@ -354,6 +354,11 @@ class CaptionEngine:
         # vision / prefix-build counters) and the step thread (prefill /
         # decode counters) would otherwise lose updates racing on the same
         # attributes — and prefill_tokens is the acceptance metric.
+        #
+        # CANONICAL LOCK ORDER (checked by `lint --concurrency`):
+        #   _lock (== _work_cv)  ->  _prefix_lock  ->  _stats_lock
+        # _stats_lock is innermost and leaf-only: never acquire any other
+        # engine lock while holding it.
         self._stats_lock = threading.Lock()
         self._prep_time = 0.0
         self._vision_time = 0.0
@@ -366,7 +371,10 @@ class CaptionEngine:
         self.enable_prefix_cache = enable_prefix_cache
         self.prefix_cache_size = prefix_cache_size
         self.min_prefix_len = min_prefix_len
-        self._prefix_cache: "OrderedDict[tuple, _PrefixEntry]" = OrderedDict()
+        self._prefix_cache: "OrderedDict[tuple, _PrefixEntry]" = OrderedDict()  # guarded-by: _prefix_lock
+        # Middle of the canonical order: taken AFTER _lock (engine mutation)
+        # and BEFORE _stats_lock, never the other way around — see the order
+        # note at _stats_lock above.
         self._prefix_lock = threading.Lock()
         self._prefix_hits = 0
         self._prefix_misses = 0
@@ -421,6 +429,9 @@ class CaptionEngine:
         # cache buffers — concurrent steps would be use-after-donate. This
         # lock serializes all engine mutation; completions are owner-tagged
         # so one stage's run cannot steal another stage's results.
+        # OUTERMOST in the canonical order (_lock -> _prefix_lock ->
+        # _stats_lock): always acquired first, via `with self._lock` or its
+        # condition alias `with self._work_cv`.
         self._lock = threading.RLock()
         # signaled when prep lands a ready request / a follow-up is queued;
         # run_until_complete waits on it instead of spinning when the only
@@ -1004,6 +1015,7 @@ class CaptionEngine:
     # unbounded per-owner metric series)
     _OWNER_STATE_CAP = 256
 
+    # holds-lock: _lock
     def _prune_owner_state(self, keep: Any = None) -> None:
         """Bound the owner-keyed maps: once past the cap, drop entries for
         owners with no live work. ``keep`` protects the owner whose drive
@@ -1109,6 +1121,7 @@ class CaptionEngine:
             return total
         return max(1, -(-total // len(owners)))
 
+    # holds-lock: _lock
     def _next_prepared(self, inflight: dict) -> "_Prepared | None":
         """Next admission candidate: FIFO within an owner, least-recently-
         admitted owner first, owners at their in-flight cap skipped — the
@@ -1191,6 +1204,7 @@ class CaptionEngine:
             n += self._vision_token_count(req.frames.shape[0])
         return min(n, self._max_len - req.sampling.max_new_tokens - 1)
 
+    # holds-lock: _lock
     def _admit(self) -> None:
         if self._should_linger():
             return
@@ -1631,6 +1645,7 @@ class CaptionEngine:
                         self._prefix_evictions += 1
                 return entry, False
 
+    # holds-lock: _lock, _prefix_lock
     def _evict_prefixes_for(self, n_blocks: int, exclude: tuple | None = None) -> None:
         """Evict idle LRU prefixes until ``n_blocks`` are allocatable (or
         the cache is empty — referenced blocks free only when their last
@@ -1646,6 +1661,7 @@ class CaptionEngine:
             with self._stats_lock:
                 self._prefix_evictions += 1
 
+    # holds-lock: _lock
     def _claim_kv(
         self, lane: _Lane, slot_idx: int, prep: _Prepared, req: CaptionRequest
     ) -> _BlockClaim:
@@ -1800,6 +1816,7 @@ class CaptionEngine:
             f"for any vision tokens within budget {budget}"
         )
 
+    # holds-lock: _lock
     def _prefill_group(self, lane: _Lane, bucket: int, items: list) -> None:
         """One batched prefill for all requests sharing a length bucket.
 
@@ -1917,6 +1934,7 @@ class CaptionEngine:
         lane.slots[slot_idx] = slot
         self._maybe_finish(lane, slot_idx, slot)
 
+    # holds-lock: _lock
     def _prefill_chunk_step(self, lane: _Lane) -> None:
         """Advance every pending chunked prefill by one chunk (one batched
         program call); rows finishing their prompt enter the decode batch."""
@@ -1996,6 +2014,7 @@ class CaptionEngine:
             self._prefill_time += time.monotonic() - t0
             self._prefill_tokens += new_tokens
 
+    # holds-lock: _lock
     def _decode_once(self, lane: _Lane) -> None:
         tokens = np.full(lane.n_slots, self.tokenizer.pad_id, np.int32)
         positions = np.zeros(lane.n_slots, np.int32)

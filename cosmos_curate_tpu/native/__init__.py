@@ -83,6 +83,11 @@ class _Binding:
                         "-o", str(tmp), str(self.src), *self.libs,
                     ]
                     try:
+                        # blocking under _lock is the POINT of this
+                        # build-once lock: every concurrent load() must
+                        # wait for the single compile, not race a second
+                        # one (120 s cap bounds the stall)
+                        # curate-lint: disable=lock-blocking
                         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
                         tmp.replace(so)
                     finally:

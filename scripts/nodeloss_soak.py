@@ -96,6 +96,27 @@ def _run_split(out: Path, vids: Path, port: int, agents: list) -> tuple[dict, ob
     return summary, runner
 
 
+def _lockcheck_verdict(tmp: Path) -> str:
+    """With CURATE_LOCKCHECK=1: the driver's in-process recorder plus every
+    agent report dumped into the lockcheck dir must be inversion-free —
+    the dynamic counterpart of the `lint --concurrency` gate, exercised
+    under real node death."""
+    from cosmos_curate_tpu.analysis import lock_runtime
+
+    rec = lock_runtime.active()
+    if rec is None:
+        return "lockcheck: off"
+    reports = [rec.report()]
+    # agents dump lockcheck-<pid>.json at exit; the SIGKILLed agent
+    # never gets the chance — best-effort by design
+    for p in sorted((tmp / "lockcheck").glob("lockcheck-*.json")):
+        reports.append(json.loads(p.read_text()))
+    inversions = [i for r in reports for i in r["inversions"]]
+    assert not inversions, f"lock-order inversions under node loss: {inversions}"
+    locks = sum(len(r["locks"]) for r in reports)
+    return f"lockcheck ok: {len(reports)} report(s), {locks} lock site(s), 0 inversions"
+
+
 def main() -> int:
     tmp = Path(tempfile.mkdtemp(prefix="nodeloss_soak_"))
     os.environ.update(
@@ -109,6 +130,11 @@ def main() -> int:
             "CURATE_DLQ_DIR": str(tmp / "dlq"),
         }
     )
+    if os.environ.get("CURATE_LOCKCHECK"):
+        # spawned agents inherit the flag; give every process one report
+        # dir so the sweep in _lockcheck_verdict sees them all
+        (tmp / "lockcheck").mkdir()
+        os.environ["CURATE_LOCKCHECK_REPORT"] = str(tmp / "lockcheck")
 
     import bench  # corpus generator (deterministic; small override here)
 
@@ -194,6 +220,7 @@ def main() -> int:
             f"1 connected trace; report: {out2 / 'report' / 'run_report.json'}",
             flush=True,
         )
+        print(f"soak {_lockcheck_verdict(tmp)}", flush=True)
     finally:
         for a in agents:
             a.terminate()

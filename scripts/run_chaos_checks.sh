@@ -32,9 +32,33 @@ JAX_PLATFORMS=cpu python -m pytest \
   -q -p no:randomly
 
 echo "== chaos end-to-end + soak (spawns real worker pools) =="
-# -m '' overrides the default marker filter so the @slow suites run here
-JAX_PLATFORMS=cpu python -m pytest \
+# -m '' overrides the default marker filter so the @slow suites run here.
+# CURATE_LOCKCHECK=1 arms the runtime lock sanitizer (the dynamic twin of
+# `lint --concurrency`): every repo-created Lock/RLock is proxied, and the
+# driver + every spawned worker dumps a lockcheck-<pid>.json into the
+# report dir at exit. The sweep below fails the gate on any observed
+# lock-order inversion.
+LOCKCHECK_DIR="$(mktemp -d /tmp/chaos_lockcheck.XXXXXX)"
+CURATE_LOCKCHECK=1 CURATE_LOCKCHECK_REPORT="$LOCKCHECK_DIR" \
+  JAX_PLATFORMS=cpu python -m pytest \
   tests/engine/test_chaos_faults.py -q -p no:randomly -m ''
+
+echo "== lockcheck sweep: soak must be inversion-free =="
+LOCKCHECK_DIR="$LOCKCHECK_DIR" JAX_PLATFORMS=cpu python - <<'PY'
+import json, os
+from pathlib import Path
+
+reports = sorted(Path(os.environ["LOCKCHECK_DIR"]).glob("lockcheck-*.json"))
+assert reports, "sanitizer-enabled soak produced no lockcheck reports"
+inversions = []
+for p in reports:
+    data = json.loads(p.read_text())
+    inversions.extend(data["inversions"])
+assert not inversions, f"lock-order inversions under chaos: {inversions}"
+locks = sum(len(json.loads(p.read_text())["locks"]) for p in reports)
+print(f"lockcheck ok: {len(reports)} report(s), {locks} lock site(s), 0 inversions")
+PY
+rm -rf "$LOCKCHECK_DIR"
 
 echo "== live-ops closed loop (hang -> stuck_batch anomaly BEFORE the deadline kill) =="
 # the anomaly detector watching a chaos worker.batch.hang must emit

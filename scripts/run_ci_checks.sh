@@ -9,8 +9,11 @@
 # + soak run nightly), plus (8) the search-serving gate (index server over
 # HTTP: recall + generation-consistent results under concurrent
 # compaction), plus (9) the bench trend gate (>20% warm clips/s regression
-# between committed BENCH rounds fails). Individual gates can be skipped via
-# CI_SKIP=tier1,bench,trend,multichip,index,service,nodeloss,search,static
+# between committed BENCH rounds fails), plus (10) the concurrency gate
+# (whole-repo lock-order/blocking-under-lock verifier must stay clean, and
+# its seeded-fixture + runtime-sanitizer suites must pass). Individual
+# gates can be skipped via
+# CI_SKIP=tier1,bench,trend,multichip,index,service,nodeloss,search,static,concurrency
 # for local use.
 set -uo pipefail
 
@@ -107,6 +110,22 @@ if ! skip static; then
   echo "== static checks (lint + shardcheck + smokes) =="
   if ! bash scripts/run_static_checks.sh; then
     failures+=("static checks")
+  fi
+fi
+
+if ! skip concurrency; then
+  echo "== concurrency gate (lock-order graph clean + verifier/sanitizer suites) =="
+  # the whole-repo pass on its own (static gate bundles it too, but this
+  # keeps CI_SKIP=static from silently dropping deadlock coverage), then
+  # the seeded-fixture and runtime-sanitizer suites
+  if ! JAX_PLATFORMS=cpu timeout -k 10 300 python -m cosmos_curate_tpu.cli.main \
+      lint --concurrency cosmos_curate_tpu; then
+    failures+=("concurrency lint")
+  fi
+  if ! JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest \
+      tests/analysis/test_concurrency_check.py tests/analysis/test_lock_runtime.py \
+      -q -p no:randomly; then
+    failures+=("concurrency suites")
   fi
 fi
 

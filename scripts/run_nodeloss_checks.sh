@@ -23,7 +23,10 @@ JAX_PLATFORMS=cpu python -m pytest \
 
 echo "== loopback soak: split pipeline, one of two agents SIGKILLed mid-run =="
 # a real script file, not a heredoc: the driver's local workers are
-# spawned processes that re-import __main__, and '<stdin>' has no path
-JAX_PLATFORMS=cpu python scripts/nodeloss_soak.py
+# spawned processes that re-import __main__, and '<stdin>' has no path.
+# CURATE_LOCKCHECK=1 arms the runtime lock sanitizer in the driver and
+# every agent; the soak itself asserts the reports are inversion-free
+# (_lockcheck_verdict in scripts/nodeloss_soak.py).
+CURATE_LOCKCHECK=1 JAX_PLATFORMS=cpu python scripts/nodeloss_soak.py
 
 echo "node-loss checks passed"

@@ -38,7 +38,7 @@ class TestLockDiscipline:
                     self._items = []
 
                 def start(self):
-                    threading.Thread(target=self._loop).start()
+                    threading.Thread(target=self._loop, daemon=True).start()
 
                 def _loop(self):
                     with self._lock:
@@ -62,7 +62,7 @@ class TestLockDiscipline:
                     self.workers = {}
 
                 def serve(self):
-                    threading.Thread(target=self._watchdog).start()
+                    threading.Thread(target=self._watchdog, daemon=True).start()
                     self.workers["k"] = 1
 
                 def _watchdog(self):
@@ -84,7 +84,7 @@ class TestLockDiscipline:
                     self._items = []
 
                 def start(self):
-                    threading.Thread(target=self._loop).start()
+                    threading.Thread(target=self._loop, daemon=True).start()
 
                 def _loop(self):
                     with self._lock:
@@ -110,7 +110,7 @@ class TestLockDiscipline:
                     self._items = []
 
                 def start(self):
-                    threading.Thread(target=self._loop).start()
+                    threading.Thread(target=self._loop, daemon=True).start()
 
                 def _loop(self):
                     self._stop.clear()  # Event: thread-safe by design
@@ -132,7 +132,7 @@ class TestLockDiscipline:
 
                 def accept_loop(self):
                     while True:
-                        threading.Thread(target=self._serve_one).start()
+                        threading.Thread(target=self._serve_one, daemon=True).start()
 
                 def _serve_one(self):
                     self.served += 1
@@ -152,7 +152,7 @@ class TestLockDiscipline:
                     self.workers = {}
 
                 def serve(self):
-                    threading.Thread(target=self._w).start()
+                    threading.Thread(target=self._w, daemon=True).start()
                     self.workers["k"] = 1
 
                 def _w(self):

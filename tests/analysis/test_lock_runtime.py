@@ -1,0 +1,238 @@
+"""Runtime lock sanitizer: live inversion detection in a two-thread
+fixture, blocking-under-lock events, Condition integration, the report
+artifact schema, static/dynamic cross-validation, and the no-op guarantee
+when disabled."""
+
+import json
+import os
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from cosmos_curate_tpu.analysis import lock_runtime as lr
+
+# Under CURATE_LOCKCHECK=1 the sanitizer is already installed process-wide;
+# these tests own install/uninstall and would tear down the env-requested
+# instrumentation, so they only run in a clean process.
+pytestmark = pytest.mark.skipif(
+    lr.active() is not None,
+    reason="lock sanitizer already installed via CURATE_LOCKCHECK",
+)
+
+
+@pytest.fixture
+def recorder():
+    """Install the sanitizer for one test; always restore the real
+    constructors, even on assertion failure."""
+    rec = lr.install()
+    try:
+        yield rec
+    finally:
+        lr.uninstall()
+
+
+def _run_threads(*targets):
+    threads = [threading.Thread(target=t, daemon=True) for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+
+
+class TestInversionDetection:
+    def test_two_thread_ab_ba_inversion_detected(self, recorder):
+        a = threading.Lock()
+        b = threading.Lock()
+        assert isinstance(a, lr._LockProxy) and isinstance(b, lr._LockProxy)
+
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        def rev():
+            with b:
+                with a:
+                    pass
+
+        # sequential on purpose: the sanitizer flags the ORDER, it does not
+        # need (or want) an actual deadlock to fire
+        _run_threads(fwd)
+        _run_threads(rev)
+
+        report = recorder.report()
+        assert not report["clean"]
+        assert len(report["inversions"]) == 1
+        inv = report["inversions"][0]
+        assert inv["held"] == b.name and inv["acquiring"] == a.name
+        assert [a.name, b.name] in report["edges"]
+        assert [b.name, a.name] in report["edges"]
+
+    def test_consistent_order_is_clean(self, recorder):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def one():
+            with a:
+                with b:
+                    pass
+
+        _run_threads(one, one)
+        report = recorder.report()
+        assert report["clean"]
+        assert report["inversions"] == []
+
+    def test_strict_mode_raises(self):
+        rec = lr.install(strict=True)
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with pytest.raises(lr.LockOrderError):
+                with b:
+                    with a:
+                        pass
+        finally:
+            lr.uninstall()
+
+    def test_rlock_reentry_is_not_an_edge(self, recorder):
+        rl = threading.RLock()
+        assert isinstance(rl, lr._RLockProxy)
+        with rl:
+            with rl:
+                pass
+        report = recorder.report()
+        assert report["clean"]
+        assert report["edges"] == []
+        assert report["locks"][rl.name]["acquisitions"] == 1
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock_recorded(self, recorder):
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0.01)
+        report = recorder.report()
+        assert not report["clean"]
+        (event,) = report["blocking"]
+        assert event["call"] == "time.sleep"
+        assert event["held"] == [lk.name]
+
+    def test_sleep_without_lock_not_recorded(self, recorder):
+        time.sleep(0.01)
+        assert recorder.report()["blocking"] == []
+
+
+class TestConditionIntegration:
+    def test_wait_releases_and_restores_the_held_set(self, recorder):
+        lock = threading.RLock()
+        cv = threading.Condition(lock)
+        woke = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                woke.append(threading.current_thread().name)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not cv._waiters and time.monotonic() < deadline:
+            time.sleep(0.005)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=5)
+        assert woke, "waiter never woke: held-set handoff broke Condition"
+        report = recorder.report()
+        assert report["clean"]
+        # main thread's held stack is empty again
+        assert recorder.held_names() == []
+
+
+class TestReportArtifact:
+    def test_dump_schema(self, recorder, tmp_path):
+        lk = threading.Lock()
+        with lk:
+            pass
+        out = recorder.dump(tmp_path / "lockcheck_report.json")
+        data = json.loads(out.read_text())
+        assert set(data) == {"clean", "locks", "edges", "inversions", "blocking"}
+        assert data["clean"] is True
+        stats = data["locks"][lk.name]
+        assert set(stats) == {"acquisitions", "max_hold_s", "reentrant"}
+        assert stats["acquisitions"] == 1 and stats["reentrant"] is False
+
+    def test_lock_names_are_repo_relative_sites(self, recorder):
+        lk = threading.Lock()
+        file, _, line = lk.name.rpartition(":")
+        assert file == "tests/analysis/test_lock_runtime.py"
+        assert line.isdigit()
+
+
+class TestCrossValidate:
+    def test_observed_edge_missing_from_static_graph_is_a_gap(self, tmp_path):
+        from cosmos_curate_tpu.analysis.common import LintConfig
+        from cosmos_curate_tpu.analysis.concurrency_check import analyze
+
+        f = tmp_path / "mod.py"
+        f.write_text(
+            textwrap.dedent(
+                """
+                import threading
+
+                class Svc:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+
+                    def one(self):
+                        with self._a:
+                            with self._b:
+                                pass
+                """
+            )
+        )
+        analysis = analyze([str(f)], LintConfig())
+        decls = analysis.registry.decls
+        site = {k: f"{d.file}:{d.line}" for k, d in decls.items()}
+        ok = {"edges": [[site["Svc._a"], site["Svc._b"]]]}
+        assert lr.cross_validate(ok, analysis) == []
+        # the runtime saw the REVERSE order: static graph has a gap
+        rev = {"edges": [[site["Svc._b"], site["Svc._a"]]]}
+        gaps = lr.cross_validate(rev, analysis)
+        assert len(gaps) == 1 and "Svc._b -> Svc._a" in gaps[0]
+        # edges touching non-registered (non-repo) locks are ignored
+        noise = {"edges": [["somewhere/else.py:1", site["Svc._a"]]]}
+        assert lr.cross_validate(noise, analysis) == []
+
+
+class TestDisabledNoOp:
+    def test_constructors_untouched_without_install(self):
+        assert lr.active() is None
+        assert threading.Lock is lr._REAL_LOCK
+        assert threading.RLock is lr._REAL_RLOCK
+        assert time.sleep is lr._REAL_SLEEP
+        assert os.fsync is lr._REAL_FSYNC
+
+    def test_maybe_install_requires_env(self, monkeypatch):
+        monkeypatch.delenv(lr.ENV_FLAG, raising=False)
+        assert lr.maybe_install_from_env() is None
+        assert lr.active() is None
+
+    def test_uninstall_restores_and_keeps_observations(self):
+        rec = lr.install()
+        lk = threading.Lock()
+        with lk:
+            pass
+        got = lr.uninstall()
+        assert got is rec
+        assert threading.Lock is lr._REAL_LOCK
+        assert rec.report()["locks"][lk.name]["acquisitions"] == 1
+        # a pre-existing proxy still works after uninstall
+        with lk:
+            pass
