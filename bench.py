@@ -565,6 +565,11 @@ def main() -> int:
         elif caption.get("backend") != backend:
             # a cross-backend caption number must be machine-detectable
             record["caption_backend"] = caption.get("backend")
+    # the BENCH_r*.json tail row is a durable surface: scripts/bench_trend.py
+    # validates rounds against the bench-row golden before comparing them
+    from cosmos_curate_tpu.utils import schema_stamp
+
+    schema_stamp.stamp(record, "bench-row")
     print(json.dumps(record))
     return 0
 

@@ -36,6 +36,7 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from cosmos_curate_tpu.analysis.rules.ad_hoc_backoff import AdHocBackoffRule
+    from cosmos_curate_tpu.analysis.rules.blocking_in_async import BlockingInAsyncRule
     from cosmos_curate_tpu.analysis.rules.device_count import HardcodedDeviceCountRule
     from cosmos_curate_tpu.analysis.rules.jit_transfer import JitTransferRule
     from cosmos_curate_tpu.analysis.rules.lock_discipline import LockDisciplineRule
@@ -51,6 +52,7 @@ def all_rules() -> list[Rule]:
     return [
         LockDisciplineRule(),
         ThreadLifecycleRule(),
+        BlockingInAsyncRule(),
         MinPythonRule(),
         JitTransferRule(),
         SilentSwallowRule(),

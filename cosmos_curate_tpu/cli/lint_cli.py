@@ -92,6 +92,20 @@ def register(sub: "argparse._SubParsersAction") -> None:
         "holds-lock contracts",
     )
     lint.add_argument(
+        "--schema",
+        action="store_true",
+        help="also run the schema & wire-compat verifier: protocol frames "
+        "and durable JSON formats diffed against analysis/schemas/ goldens; "
+        "drift without a version bump (or without a migration shim for "
+        "breaking durable drift) fails the gate",
+    )
+    lint.add_argument(
+        "--update",
+        action="store_true",
+        help="with --schema: regenerate the golden snapshots under "
+        "analysis/schemas/ from the current code instead of diffing",
+    )
+    lint.add_argument(
         "--json",
         action="store_true",
         dest="as_json",
@@ -117,6 +131,8 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         print(f"{'(pass) concurrency':32s} whole-repo lock-order graph, "
               "blocking-under-lock, guarded-by contracts (--concurrency; "
               "rule ids lock-order, lock-blocking, unguarded-shared)")
+        print(f"{'(pass) schema':32s} protocol-frame + durable-format "
+              "golden-schema diff (--schema [--update]; rule ids schema-*)")
         return 0
     rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules else None
     try:
@@ -145,6 +161,19 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    if args.update and not args.schema:
+        print("error: --update requires --schema", file=sys.stderr)
+        return 2
+    if args.schema:
+        from cosmos_curate_tpu.analysis.schema_check import run_schema_check
+
+        findings.extend(run_schema_check(update=args.update))
+        if args.update:
+            print(
+                "curate-lint: schema goldens regenerated under "
+                "cosmos_curate_tpu/analysis/schemas/ — review and commit them",
+                file=sys.stderr,
+            )
     for f in findings:
         print(f.to_json() if args.as_json else f.render())
     errors = [f for f in findings if f.severity is Severity.ERROR]

@@ -56,6 +56,7 @@ from cosmos_curate_tpu.storage.client import (
     write_bytes,
 )
 from cosmos_curate_tpu.storage.writers import write_json, write_npy, write_parquet
+from cosmos_curate_tpu.utils import schema_stamp
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -208,7 +209,14 @@ class IndexStore:
             return self.build_live_manifest()
         client = get_storage_client(self.root)
         try:
-            return json.loads(client.read_bytes(self.manifest_path(gen)))
+            # manifests from a pre-stamp build (v1) migrate through the shim
+            # chain; a manifest published by a NEWER build than this reader
+            # raises SchemaVersionError — serving against a layout this
+            # build cannot interpret is worse than failing the open
+            return schema_stamp.upgrade(
+                json.loads(client.read_bytes(self.manifest_path(gen))),
+                "index-manifest",
+            )
         except (OSError, ValueError) as e:
             raise RuntimeError(
                 f"unreadable manifest gen {gen} at {self.root}: {e}"
@@ -227,12 +235,15 @@ class IndexStore:
                 "bytes": int(sum(sz for _rel, sz in frags)),
                 "rows": 0,  # unknown without reading; compaction fills it
             }
-        return {
-            "generation": 0,
-            "centroids": "centroids.npy",
-            "meta": self.load_meta(),
-            "clusters": clusters,
-        }
+        return schema_stamp.stamp(
+            {
+                "generation": 0,
+                "centroids": "centroids.npy",
+                "meta": self.load_meta(),
+                "clusters": clusters,
+            },
+            "index-manifest",
+        )
 
     def publish_manifest(self, manifest: dict) -> int:
         """Write the immutable generation file, then flip the pointer. The
@@ -242,11 +253,16 @@ class IndexStore:
         gen = int(manifest["generation"])
         if gen <= 0:
             raise ValueError("published generations start at 1")
-        write_json(self.manifest_path(gen), manifest)
+        write_json(self.manifest_path(gen), schema_stamp.stamp(dict(manifest), "index-manifest"))
         # LocalStorageClient.write_bytes is tmp+rename (atomic on POSIX);
         # remote backends PUT one small object — either way a reader sees
         # the old pointer or the new one, never a torn file
-        write_bytes(self.manifest_pointer_path, json.dumps({"generation": gen}).encode())
+        write_bytes(
+            self.manifest_pointer_path,
+            json.dumps(
+                schema_stamp.stamp({"generation": gen}, "index-manifest")
+            ).encode(),
+        )
         return gen
 
     def list_manifests(self) -> list[int]:

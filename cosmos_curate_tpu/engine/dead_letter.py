@@ -33,6 +33,7 @@ import uuid
 from dataclasses import dataclass
 from pathlib import Path
 
+from cosmos_curate_tpu.utils import schema_stamp
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -163,6 +164,7 @@ class DeadLetterQueue:
                 meta["node_deaths"] = node_deaths
             if lineage:
                 meta["lineage"] = lineage
+            schema_stamp.stamp(meta, "dlq-meta")
             (entry / "meta.json").write_text(json.dumps(meta, indent=2))
         except Exception:
             logger.exception(
@@ -230,7 +232,12 @@ def list_entries(root: str | None = None, *, run_id: str | None = None) -> list[
         for entry in sorted(p for p in run.iterdir() if p.is_dir()):
             meta_path = entry / "meta.json"
             try:
-                meta = json.loads(meta_path.read_text())
+                # pre-stamp (v1) entries migrate through the shim chain;
+                # entries written by a NEWER build read as-is (strict=False)
+                # — listing is display-only, unknown fields are harmless
+                meta = schema_stamp.upgrade(
+                    json.loads(meta_path.read_text()), "dlq-meta", strict=False
+                )
             except (OSError, ValueError):
                 meta = {"stage": "?", "batch_id": -1, "error_tail": "unreadable meta.json"}
             out.append(DlqEntry(path=entry, meta=meta))

@@ -185,11 +185,14 @@ def _open_get(
     try:
         # the traceparent rides the request so the OWNER's serve span joins
         # this fetch's trace (the caller's fetch span is ambient here).
-        # Untraced requests keep the legacy 3-tuple: a peer still running
-        # the pre-traceparent server rejects 4-tuples outright, so tracing
-        # off must stay wire-identical across version skew. Tracing ON
-        # requires same-version peers (documented in docs/OBSERVABILITY.md);
-        # a silent 3-tuple fallback here would mask real connection errors
+        # Untraced requests keep the legacy 3-tuple. Version skew is no
+        # longer this tuple's problem: every peer on the plane passed the
+        # PROTOCOL_VERSION handshake (remote_plane.Hello/HelloAck), so a
+        # mixed-version fleet is rejected at connect rather than reaching
+        # this request — the old "tracing requires same-version peers"
+        # caveat is now enforced, not documented. The tuple's shape is a
+        # registered contract surface (`lint --schema`): changing its arity
+        # or element types requires a PROTOCOL_VERSION bump.
         tp = format_traceparent()
         req = ("get", name, nonce, tp) if tp else ("get", name, nonce)
         send_msg(sock, req, token)

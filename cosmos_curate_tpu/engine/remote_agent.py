@@ -39,6 +39,7 @@ from cosmos_curate_tpu.engine.remote_plane import (
     Bye,
     Hello,
     PrefetchObjects,
+    ProtocolSkewError,
     ReleaseObjects,
     StartWorker,
     StopWorker,
@@ -212,32 +213,51 @@ class NodeAgent:
         while True:  # the driver may come up after the agents (srun races)
             try:
                 sock = socket.create_connection(self.addr, timeout=10)
-                break
             except OSError:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.5)
-        # the 10s DIAL timeout must not become a RECV deadline: an agent
-        # the driver leaves idle (no StartWorker yet, quiet pipeline) would
-        # time out mid-session and reconnect-churn every 10 seconds. Frames
-        # block indefinitely; driver death surfaces as EOF/RST, and the
-        # driver's own failure detector covers the reverse direction.
-        sock.settimeout(None)
-        self.sock = sock
-        # mutual-nonce handshake: both sides contribute fresh randomness
-        # to the session id, so no recorded session replays (either
-        # direction) into this one (see SecureChannel/connect_channel)
-        self.chan, ack = connect_channel(
-            sock, self.token,
-            Hello(
-                self.node_id, self.num_cpus,
-                object_port=self.object_server.port,
-                memory_gb=_host_memory_gb(),
-                # pid lets the driver tell a same-process reconnect
-                # (segments survived) from a bounced agent (they did not)
-                pid=os.getpid(),
-            ),
-        )
+                continue
+            # the 10s DIAL timeout must not become a RECV deadline: an agent
+            # the driver leaves idle (no StartWorker yet, quiet pipeline)
+            # would time out mid-session and reconnect-churn every 10
+            # seconds. Frames block indefinitely; driver death surfaces as
+            # EOF/RST, and the driver's own failure detector covers the
+            # reverse direction.
+            sock.settimeout(None)
+            # mutual-nonce handshake: both sides contribute fresh randomness
+            # to the session id, so no recorded session replays (either
+            # direction) into this one (see SecureChannel/connect_channel).
+            # The handshake retries inside the dial loop: a DYING driver can
+            # accept the dial and drop it before acking (its accept loop
+            # races shutdown), which must read as "driver not up yet", not
+            # "driver unreachable, exit" — the successor driver is seconds
+            # away. Version skew is the exception: a skewed driver answers
+            # the same way every time, so fail fast with its clear error.
+            try:
+                self.chan, ack = connect_channel(
+                    sock, self.token,
+                    Hello(
+                        self.node_id, self.num_cpus,
+                        object_port=self.object_server.port,
+                        memory_gb=_host_memory_gb(),
+                        # pid lets the driver tell a same-process reconnect
+                        # (segments survived) from a bounced agent (they
+                        # did not)
+                        pid=os.getpid(),
+                    ),
+                )
+            except ProtocolSkewError:
+                sock.close()
+                raise
+            except (ConnectionError, OSError):
+                sock.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
+                continue
+            self.sock = sock
+            break
         self.driver_object_addr = (self.addr[0], ack.driver_object_port)
         # output segments from a PREVIOUS run are unreferenced dead weight;
         # a transient link blip within the SAME run must keep them — the

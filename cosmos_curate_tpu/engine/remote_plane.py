@@ -62,6 +62,54 @@ DEFAULT_HEARTBEAT_MISSES = 5
 
 _MAGIC = b"CRPL"
 
+# Control-plane protocol version. Frames are unversioned cloudpickle'd
+# dataclasses, so two builds whose frame schemas drifted would not fail
+# cleanly — they would MISDECODE each other mid-run (missing attributes
+# surfacing as AttributeErrors deep in the orchestration loop, or worse,
+# defaults silently papering over renamed fields). Instead the version
+# rides the Hello/HelloAck handshake and mismatched peers are rejected at
+# CONNECT time with an error that names both versions. Bump this whenever
+# any frame in this module (or the object-channel request tuple) changes
+# shape — `lint --schema` diffs the frame schemas against
+# analysis/schemas/remote-plane.json and fails the gate when the shape
+# drifted without a bump here.
+#
+# v1: the unversioned plane (pre-handshake-check builds send no version
+#     and read as v0/v1 — rejected).
+# v2: Hello/HelloAck carry protocol_version; object-channel GET requests
+#     may carry a traceparent 4th element (peers are handshake-matched, so
+#     the old "tracing requires same-version peers" caveat is enforced
+#     rather than documented).
+PROTOCOL_VERSION = 2
+
+
+def skew_error(peer_version: int, *, peer: str) -> str:
+    """The one message both rejection paths log/raise: names both versions
+    and the fix, because 'connection closed' during a rolling upgrade is a
+    debugging session while this string is a shrug-and-upgrade."""
+    return (
+        f"protocol version skew: {peer} speaks v{peer_version}, this process "
+        f"speaks v{PROTOCOL_VERSION}; refusing at handshake (mixed-version "
+        "engine planes misdecode frames mid-run — upgrade the older side)"
+    )
+
+
+class ProtocolSkewError(ConnectionError):
+    """Handshake rejected for a VERSION mismatch. Distinct from transient
+    ConnectionErrors so the agent's reconnect loop fails fast (redialing a
+    skewed driver every 0.5 s until the window expires helps nobody) while
+    still flowing through every existing ConnectionError handler."""
+
+
+def frame_version(frame: object) -> int:
+    """The protocol version a handshake frame ACTUALLY carries. Must read
+    the instance dict, never getattr: unpickling restores only the
+    sender's fields, and on a missing attribute getattr falls back to the
+    receiver's CLASS default — which is the receiver's own version, so a
+    pre-versioning peer would masquerade as current. ``vars()`` makes the
+    missing field read as 0 (pre-versioning) as intended."""
+    return int(vars(frame).get("protocol_version", 0))
+
 
 # -- messages ---------------------------------------------------------------
 
@@ -82,6 +130,10 @@ class Hello:
     # janitor reclaimed the old pid's segments — leave locations on the
     # dead link so consumers reconstruct instead of fetching ghosts)
     pid: int = 0
+    # handshake version gate: a peer built before versioning restores with
+    # the attribute missing entirely (pickle state dicts carry only the
+    # sender's fields), so the driver reads it as 0 and rejects cleanly
+    protocol_version: int = PROTOCOL_VERSION
 
 
 @dataclass
@@ -217,6 +269,21 @@ class HelloAck:
     # references them) from a driver restart (new run — the old outputs are
     # unreferenced dead weight)
     run_id: bytes = b""
+    # the driver's protocol version: the agent verifies it in
+    # connect_channel and refuses a skewed driver with a clear error
+    protocol_version: int = PROTOCOL_VERSION
+
+
+# Every dataclass that rides the control socket. This tuple IS the wire
+# contract surface `lint --schema` snapshots (analysis/schema_check.py):
+# add a frame here when you add one, and bump PROTOCOL_VERSION whenever
+# any listed frame changes shape. Driver-local bookkeeping dataclasses
+# (AgentLink) are deliberately absent — they never cross a process.
+WIRE_FRAMES: tuple[type, ...] = (
+    Hello, HelloAck, StartWorker, StopWorker, RefSpec, SubmitBatch,
+    ReleaseObjects, PrefetchObjects, AgentStats, AgentReady, AgentResult,
+    WorkerDied, Bye,
+)
 
 
 # -- framing ----------------------------------------------------------------
@@ -441,6 +508,12 @@ def connect_channel(
     ack = cloudpickle.loads(payload)
     if not isinstance(ack, HelloAck) or ack.agent_sid != agent_sid:
         raise ConnectionError("bad handshake ack from driver")
+    # version gate BEFORE any post-handshake frame: a skewed driver must
+    # fail here, at connect, with a message naming both versions — never
+    # as a misdecoded StartWorker three frames later
+    ack_version = frame_version(ack)
+    if ack_version != PROTOCOL_VERSION:
+        raise ProtocolSkewError(skew_error(ack_version, peer="driver"))
     chan = SecureChannel(
         sock,
         token,
@@ -660,6 +733,19 @@ class RemoteWorkerManager:
                 sock, addr = self._server.accept()
             except OSError:
                 return
+            if self._closed:
+                # close() does not wake a thread already blocked in accept()
+                # — the kernel listener stays alive until the NEXT dial, and
+                # that dial is returned here after shutdown began. Serving
+                # it would park the agent on a dead driver's socket (it
+                # blocks in recv instead of redialing the successor), so
+                # drop it: the agent's connect loop retries and reaches the
+                # live driver.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(
                 target=self._serve_agent, args=(sock, addr), daemon=True
             ).start()
@@ -675,6 +761,17 @@ class RemoteWorkerManager:
             sock.close()
             return
         if not isinstance(hello, Hello):
+            sock.close()
+            return
+        hello_version = frame_version(hello)
+        if hello_version != PROTOCOL_VERSION:
+            # reject at connect: the HelloAck already carried the driver's
+            # version (sent in accept_channel), so the agent's own gate in
+            # connect_channel raises the same clear error on its side
+            logger.warning(
+                "rejected agent %s from %s: %s",
+                hello.node_id, addr, skew_error(hello_version, peer="agent"),
+            )
             sock.close()
             return
         link = AgentLink(

@@ -30,6 +30,7 @@ import time
 from typing import Any
 
 from cosmos_curate_tpu.storage.client import get_storage_client, write_bytes
+from cosmos_curate_tpu.utils import schema_stamp
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -310,6 +311,7 @@ def write_node_stats(
     if extra:
         stats.update(extra)
     stats["node_rank"] = rank
+    schema_stamp.stamp(stats, "node-stats")
     path = f"{output_path.rstrip('/')}/report/node-stats-{rank}.json"
     with suppress_tracing():
         write_bytes(path, json.dumps(stats, indent=1).encode())
@@ -432,8 +434,12 @@ def build_run_report(
     spans = collect_spans(output_path)
     trace_ids = sorted({s.get("trace_id", "") for s in spans if s.get("trace_id")})
     pids = sorted({s.get("pid") for s in spans if s.get("pid") is not None})
-    report: dict[str, Any] = {
-        "version": 1,
+    # "version" is the legacy alias of the schema stamp (pre-stamp readers
+    # grep for it); both come from the one published number in
+    # utils/schema_stamp.SCHEMA_VERSIONS — never hand-write either.
+    report: dict[str, Any] = schema_stamp.stamp({}, "run-report")
+    report["version"] = schema_stamp.SCHEMA_VERSIONS["run-report"]
+    report.update({
         "generated_at": time.time(),
         "output_path": output_path,
         "span_count": len(spans),
@@ -444,7 +450,7 @@ def build_run_report(
         "processes": len(pids),
         "critical_path": _critical_path(spans),
         "spans_by_name": _by_name(spans),
-    }
+    })
     stats = runner_stats(runner)
     report["dispatch"] = stats["dispatch"]
     report["stage_flow"] = stats["stage_flow"]

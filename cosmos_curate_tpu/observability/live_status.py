@@ -34,6 +34,7 @@ import time
 from pathlib import Path
 from typing import Any, Callable
 
+from cosmos_curate_tpu.utils import schema_stamp
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -163,7 +164,10 @@ class LiveStatusPublisher:
     def publish(self, snapshot: dict, *, final: bool = False) -> dict:
         """Augment, detect, and atomically swap one snapshot."""
         self.seq += 1
-        snapshot.setdefault("version", 1)
+        # schema_version is the canonical stamp ("version" stays as the
+        # legacy alias pre-stamp readers like `top` polled for)
+        schema_stamp.stamp(snapshot, "live-status")
+        snapshot.setdefault("version", schema_stamp.SCHEMA_VERSIONS["live-status"])
         snapshot.setdefault("ts", time.time())
         snapshot["seq"] = self.seq
         snapshot["pid"] = os.getpid()

@@ -51,6 +51,7 @@ append) plug the whole thing into the fault-injection harness.
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import os
 import re
@@ -58,6 +59,7 @@ import signal
 import subprocess
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
@@ -285,6 +287,14 @@ class ServiceState:
         # (job children detect; the service journals + exports for them)
         self._anomaly_seen: dict[str, int] = {}
         self._anomaly_scan_at = 0.0
+        # journaling executor: ONE thread so appends stay ordered without a
+        # lock, and the fsync never runs on the event loop (an fsync on the
+        # loop stalls every in-flight request — interactive-lane latency
+        # paying for batch-job journaling). Sync callers (_recover at boot,
+        # tests) still call record_transition directly.
+        self._journal_exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="journal"
+        )
         from cosmos_curate_tpu.engine.metrics import get_metrics
 
         self.metrics = get_metrics(config.metrics_port)
@@ -340,9 +350,29 @@ class ServiceState:
             )
         self._export_states()
 
+    async def on_journal_thread(self, fn: Callable, *args, **kwargs):
+        """Run a journaling (fsync-bearing) callable on the single-thread
+        journal executor. Appends stay ordered (one thread) and the event
+        loop never blocks on the disk."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._journal_exec, functools.partial(fn, *args, **kwargs)
+        )
+
+    async def record_transition_async(
+        self, rec: JobRecord, event: str, *, required: bool = False
+    ) -> None:
+        """:meth:`record_transition` off the event loop — what every
+        coroutine must use (the blocking-in-async lint rule enforces it)."""
+        await self.on_journal_thread(
+            self.record_transition, rec, event, required=required
+        )
+
     def _export_states(self) -> None:
         counts = {s: 0 for s in JOB_STATES}
-        for rec in self.jobs.values():
+        # list(): this runs on the journal thread too, concurrent with
+        # loop-side inserts/evictions of self.jobs
+        for rec in list(self.jobs.values()):
             counts[rec.state] = counts.get(rec.state, 0) + 1
         self.metrics.set_service_states(counts)
         for lane in LANES:
@@ -414,7 +444,7 @@ class ServiceState:
             self._anomaly_seen[rec.job_id] = total
         # forget jobs that left the running set (bounded growth)
         running = {r.job_id for r in self.running_records()}
-        for job_id in [j for j in self._anomaly_seen if j not in running]:
+        for job_id in [j for j in list(self._anomaly_seen) if j not in running]:
             del self._anomaly_seen[job_id]
         return relayed
 
@@ -438,7 +468,8 @@ class ServiceState:
     # ---- queries -------------------------------------------------------
 
     def running_records(self) -> list[JobRecord]:
-        return [r for r in self.jobs.values() if r.state == "running"]
+        # list(): called from both the loop and the journal thread
+        return [r for r in list(self.jobs.values()) if r.state == "running"]
 
     def gc_terminal(self) -> None:
         """Evict old terminal records (dispatcher tick). Each eviction is a
@@ -449,7 +480,7 @@ class ServiceState:
         now = time.time()
         terminal = sorted(
             (
-                r for r in self.jobs.values()
+                r for r in list(self.jobs.values())
                 if r.state in TERMINAL_STATES and r.finished_s
             ),
             key=lambda r: r.finished_s,
@@ -473,7 +504,7 @@ class ServiceState:
 
     def state_counts(self) -> dict[str, int]:
         counts: dict[str, int] = {}
-        for rec in self.jobs.values():
+        for rec in list(self.jobs.values()):
             counts[rec.state] = counts.get(rec.state, 0) + 1
         return counts
 
@@ -486,17 +517,13 @@ class ServiceState:
 # dispatch + supervision
 
 
-def _launch(state: ServiceState, rec: JobRecord) -> None:
-    """Spawn one attempt of ``rec`` in its own session. A spawn failure is
-    terminal ``failed`` (the command never started — retrying a bad spec
-    only burns attempts)."""
-    rec.attempts += 1
-    work_dir = state.work_dir(rec.job_id)
+def _spawn_proc(state: ServiceState, rec: JobRecord, work_dir: Path) -> subprocess.Popen:
+    """Blocking half of a launch (log open + fork/exec): runs on an
+    executor thread, never on the event loop."""
     work_dir.mkdir(parents=True, exist_ok=True)
-    wait_s = max(0.0, time.time() - rec.enqueued_s)
     log_f = open(state.log_path(rec.job_id), "ab")
     try:
-        proc = subprocess.Popen(
+        return subprocess.Popen(
             state.runner_cmd(rec, work_dir),
             stdout=log_f,
             stderr=subprocess.STDOUT,
@@ -504,23 +531,51 @@ def _launch(state: ServiceState, rec: JobRecord) -> None:
             env=job_env(rec),
             start_new_session=True,  # session leader: killpg reaps the tree
         )
+    finally:
+        log_f.close()  # child holds its own fd; parent must not leak one per job
+
+
+async def _launch(state: ServiceState, rec: JobRecord) -> None:
+    """Spawn one attempt of ``rec`` in its own session. A spawn failure is
+    terminal ``failed`` (the command never started — retrying a bad spec
+    only burns attempts). The fork/exec and the journal appends run on
+    executor threads; every ``await`` is an interleave point, so the
+    terminated-while-launching race is re-checked after the spawn."""
+    rec.attempts += 1
+    work_dir = state.work_dir(rec.job_id)
+    wait_s = max(0.0, time.time() - rec.enqueued_s)
+    loop = asyncio.get_running_loop()
+    try:
+        proc = await loop.run_in_executor(
+            None, functools.partial(_spawn_proc, state, rec, work_dir)
+        )
     except Exception as e:
         rec.state = "failed"
         rec.error = f"spawn failed: {e}"
         rec.finished_s = time.time()
-        state.record_transition(rec, "spawn-failed")
+        await state.record_transition_async(rec, "spawn-failed")
         logger.exception("job %s spawn failed", rec.job_id)
         return
-    finally:
-        log_f.close()  # child holds its own fd; parent must not leak one per job
+    if rec.state == "terminated":
+        # terminate() landed while the fork/exec was in flight: honor the
+        # operator's verdict — kill the fresh group; the watcher reaps it
+        # without resurrecting (terminate already journaled the state)
+        state.procs[rec.job_id] = proc
+        _killpg(proc.pid, signal.SIGTERM)
+        task = asyncio.create_task(_watch_job(state, rec, proc))
+        state.watchers.add(task)
+        task.add_done_callback(state.watchers.discard)
+        return
     rec.state = "running"
     rec.pid = proc.pid
     if rec.started_s is None:
         rec.started_s = time.time()
     state.procs[rec.job_id] = proc
-    state.record_transition(rec, "running")
+    await state.record_transition_async(rec, "running")
     state.metrics.observe_service_dispatch(rec.priority, wait_s)
-    state._note_slo_breaches(rec, state.slo.observe_dispatch(rec.tenant, wait_s))
+    await state.on_journal_thread(
+        state._note_slo_breaches, rec, state.slo.observe_dispatch(rec.tenant, wait_s)
+    )
     # fresh attempt = fresh detector: its anomaly_count restarts at 0, so
     # a stale high-water mark from a prior attempt would suppress relay
     state._anomaly_seen.pop(rec.job_id, None)
@@ -549,7 +604,7 @@ async def _watch_job(state: ServiceState, rec: JobRecord, proc: subprocess.Popen
         rec.state = "done"
         rec.finished_s = time.time()
         rec.error = ""
-        state.record_transition(rec, "done")
+        await state.record_transition_async(rec, "done")
         logger.info("job %s done (attempt %d)", rec.job_id, rec.attempts)
         state.kick()
         return
@@ -558,7 +613,7 @@ async def _watch_job(state: ServiceState, rec: JobRecord, proc: subprocess.Popen
     if rec.attempts >= rec.max_attempts:
         rec.state = "dead_lettered"
         rec.finished_s = time.time()
-        state.record_transition(rec, "dead-lettered")
+        await state.record_transition_async(rec, "dead-lettered")
         logger.error(
             "job %s dead-lettered after %d attempts (%s)",
             rec.job_id, rec.attempts, rec.error,
@@ -577,7 +632,7 @@ async def _watch_job(state: ServiceState, rec: JobRecord, proc: subprocess.Popen
         rec.job_id, rec.attempts, rec.max_attempts, rec.error, delay,
     )
     rec.state = "pending"
-    state.record_transition(rec, "retry")
+    await state.record_transition_async(rec, "retry")
     state.kick()  # freed capacity is usable during the backoff
     if not state.draining:
         await asyncio.sleep(delay)
@@ -616,13 +671,21 @@ async def _dispatch_loop(app: web.Application) -> None:
                     rec = state.admission.pop_next(state.running_records())
                     if rec is None:
                         break
-                    _launch(state, rec)
-                state.gc_terminal()
+                    if rec.job_id not in state.jobs:
+                        # submit ack (journal append) still in flight on the
+                        # executor — invoke() inserts into state.jobs only
+                        # after the fsync lands. Not dispatchable yet; put it
+                        # back and let the next tick retry.
+                        state.admission.requeue(rec)
+                        break
+                    await _launch(state, rec)
+                await state.on_journal_thread(state.gc_terminal)
                 state._export_states()
             try:
                 # live-ops relay rides the dispatcher tick: journal + export
-                # anomaly verdicts running job children published
-                state.scan_job_anomalies()
+                # anomaly verdicts running job children published (reads
+                # snapshots + appends, so it runs on the journal thread)
+                await state.on_journal_thread(state.scan_job_anomalies)
             except Exception:
                 logger.exception("anomaly scan failed (dispatcher unaffected)")
             try:
@@ -671,7 +734,7 @@ async def drain_app(app: web.Application, drain_s: float | None = None) -> None:
             # next boot must NOT resurrect it as interrupted
             rec.state = "interrupted"
             rec.pid = None
-            state.record_transition(rec, "drain-checkpoint")
+            await state.record_transition_async(rec, "drain-checkpoint")
             logger.info("drain: job %s checkpointed as interrupted", job_id)
         _killpg(proc.pid, signal.SIGTERM)
     if survivors:
@@ -864,8 +927,11 @@ def build_app(
                 headers={"Retry-After": str(int(decision.retry_after_s) or 1)},
             )
         try:
-            # durability gate: the ack implies the journal has the job
-            state.record_transition(rec, "submit", required=True)
+            # durability gate: the ack implies the journal has the job. The
+            # fsync happens on the journal thread; the dispatcher skips
+            # admitted-but-not-yet-acked records (not in state.jobs) so the
+            # await below cannot race a launch.
+            await state.record_transition_async(rec, "submit", required=True)
         except JournalWriteError as e:
             state.admission.remove(rec.job_id)
             logger.error("refusing job: %s", e)
@@ -983,11 +1049,11 @@ def build_app(
             state.admission.remove(rec.job_id)
             rec.state = "terminated"
             rec.finished_s = time.time()
-            state.record_transition(rec, "terminated-queued")
+            await state.record_transition_async(rec, "terminated-queued")
         elif rec.state == "running":
             rec.state = "terminated"
             rec.finished_s = time.time()
-            state.record_transition(rec, "terminated")
+            await state.record_transition_async(rec, "terminated")
             proc = state.procs.get(rec.job_id)
             if proc is not None and proc.poll() is None:
                 # the whole process group: pipeline worker subprocesses must
@@ -1035,7 +1101,7 @@ def build_app(
                 status=429,
                 headers={"Retry-After": str(int(decision.retry_after_s) or 1)},
             )
-        state.record_transition(rec, "requeued")
+        await state.record_transition_async(rec, "requeued")
         state.kick()
         return web.json_response({"job_id": rec.job_id, "state": rec.state})
 
@@ -1068,6 +1134,9 @@ def build_app(
                 task.cancel()  # backstop; the flag should have sufficed
         for watcher in list(state.watchers):
             watcher.cancel()
+        # after the dispatcher and watchers stop, nothing schedules journal
+        # work; drain the queued appends before the process exits
+        state._journal_exec.shutdown(wait=True)
 
     app.on_startup.append(_start_dispatcher)
     app.on_cleanup.append(_stop_dispatcher)

@@ -13,14 +13,22 @@ skip every video the dead run already completed.
 
 Journal layout (``<work_root>/journal.ndjson``)::
 
-    {"ts": ..., "event": "submit",  "record": {...full JobRecord...}}
-    {"ts": ..., "event": "running", "record": {...}}
+    {"schema_version": 2, "ts": ..., "event": "submit", "record": {...full JobRecord...}}
+    {"schema_version": 2, "ts": ..., "event": "running", "record": {...}}
     ...
 
 Each line is a full snapshot of the record at that transition: replay is
 "last line per job_id wins", which tolerates a torn final line (a crash
 mid-append) by discarding it. On startup the replayed state is compacted
 back to one line per job so the journal stays O(jobs), not O(transitions).
+
+Every line is stamped with the ``job-journal`` schema version
+(utils/schema_stamp.py): replay carries version-N−1 lines forward through
+the registered migration shims — a service restarted onto a new build
+mid-deploy replays the old build's journal with zero lost or duplicated
+jobs — and refuses (line-by-line, loudly) anything newer than this build
+publishes. The line shape itself is a ``lint --schema`` contract surface:
+drifting it without a bump (and, for breaking drift, a shim) fails CI.
 
 Lifecycle::
 
@@ -52,6 +60,7 @@ from dataclasses import dataclass, field, asdict
 from pathlib import Path
 
 from cosmos_curate_tpu import chaos
+from cosmos_curate_tpu.utils import schema_stamp
 from cosmos_curate_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -119,11 +128,11 @@ class JobJournal:
 
     Appends flush+fsync before returning: once a submission is acked, a
     ``kill -9`` one instruction later still replays it. The fsync runs on
-    the caller's thread (the service event loop) by design — transitions
-    are a handful per job lifecycle against jobs that run seconds to
-    hours, so the durability-before-ack contract is worth the occasional
-    milliseconds of loop stall; revisit with an executor offload if the
-    service ever fronts thousands of tiny jobs. Failures raise
+    the CALLER's thread — the service keeps it off its event loop by
+    routing every coroutine-side append through a single-thread journal
+    executor (``ServiceState.record_transition_async``; the
+    ``blocking-in-async`` lint rule enforces this), which also serializes
+    appends without a lock. Failures raise
     :class:`JournalWriteError` — the caller decides whether that refuses a
     submission (yes) or degrades a mid-run transition to in-memory-only
     (also yes, with a loud log: losing one transition downgrades a resumed
@@ -136,7 +145,10 @@ class JobJournal:
 
     def append(self, record: JobRecord, event: str) -> None:
         line = json.dumps(
-            {"ts": time.time(), "event": event, "record": record.to_dict()}
+            schema_stamp.stamp(
+                {"ts": time.time(), "event": event, "record": record.to_dict()},
+                "job-journal",
+            )
         )
         try:
             # InjectedFault is a ConnectionError: an armed
@@ -167,6 +179,13 @@ class JobJournal:
                 continue
             try:
                 doc = json.loads(line)
+                # version-N−1 lines (including historical unstamped v1) flow
+                # through the shim chain; newer-than-this-build lines pass
+                # as-is (strict=False) because from_dict drops unknown
+                # fields — best-effort beats wedging a rollback's startup.
+                # A missing shim raises SchemaVersionError (a ValueError),
+                # landing in the corrupt-line path below: skipped loudly.
+                doc = schema_stamp.upgrade(doc, "job-journal", strict=False)
                 rec = JobRecord.from_dict(doc["record"])
             except (ValueError, KeyError, TypeError) as e:
                 if i == len(lines) - 1:
@@ -192,7 +211,14 @@ class JobJournal:
                 for rec in records.values():
                     f.write(
                         json.dumps(
-                            {"ts": time.time(), "event": "compact", "record": rec.to_dict()}
+                            schema_stamp.stamp(
+                                {
+                                    "ts": time.time(),
+                                    "event": "compact",
+                                    "record": rec.to_dict(),
+                                },
+                                "job-journal",
+                            )
                         )
                         + "\n"
                     )

@@ -19,6 +19,15 @@ Guard rails, because round records are messy field data:
   CI's /tmp/_bench.json) against the newest committed round instead of
   round-vs-round.
 
+Rows are also validated against the checked-in BENCH golden schema
+(``cosmos_curate_tpu/analysis/schemas/bench-row.json`` — the same snapshot
+``lint --schema`` diffs bench.py against). Fresh ``--json`` rows validate
+STRICTLY (every required field present, concrete types match): a fresh row
+that drifted from the golden means bench.py and the golden disagree and
+the trend data would rot. Committed rounds validate leniently — only the
+fields this gate consumes (``metric``/``value``/``backend``) — because old
+rounds legitimately predate schema versioning.
+
 Usage::
 
     python scripts/bench_trend.py                 # newest vs previous round
@@ -36,6 +45,58 @@ from pathlib import Path
 
 METRIC = "clips_per_sec_split_annotate"
 ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+GOLDEN_REL = Path("cosmos_curate_tpu/analysis/schemas/bench-row.json")
+
+# golden type name -> Python types a JSON value may decode to (bool is an
+# int subclass, so int/float must exclude it explicitly)
+_TYPE_OK = {
+    "str": lambda v: isinstance(v, str),
+    "int": lambda v: isinstance(v, int) and not isinstance(v, bool),
+    "float": lambda v: isinstance(v, (int, float)) and not isinstance(v, bool),
+    "bool": lambda v: isinstance(v, bool),
+    "list": lambda v: isinstance(v, list),
+    "tuple": lambda v: isinstance(v, list),
+    "dict": lambda v: isinstance(v, dict),
+}
+
+
+def load_golden_fields(repo: Path) -> dict | None:
+    """The BENCH row's golden field table, or None when the golden is
+    missing/unreadable (bootstrap repos: validation skips with a notice)."""
+    try:
+        doc = json.loads((repo / GOLDEN_REL).read_text())
+        return doc["schemas"]["row"]["fields"]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def validate_row(row: dict, fields: dict, *, strict: bool) -> list[str]:
+    """Problems with ``row`` against the golden field table. Strict mode
+    (fresh rows) checks required-field presence, concrete types, and —
+    unless the golden declares a ``<dynamic>`` key — unknown fields.
+    Lenient mode (historical committed rounds) checks only the fields the
+    trend gate consumes."""
+    consumed = ("metric", "value", "backend")
+    problems: list[str] = []
+    for name, spec in sorted(fields.items()):
+        if name == "<dynamic>":
+            continue
+        if not strict and name not in consumed:
+            continue
+        if name not in row:
+            if strict and spec.get("required"):
+                problems.append(f"missing required field {name!r}")
+            continue
+        check = _TYPE_OK.get(spec.get("type", "any"))
+        if check is not None and not check(row[name]):
+            problems.append(
+                f"field {name!r} is {type(row[name]).__name__}, "
+                f"golden says {spec['type']}"
+            )
+    if strict and "<dynamic>" not in fields:
+        for name in sorted(set(row) - set(fields)):
+            problems.append(f"unknown field {name!r} (not in the golden)")
+    return problems
 
 
 def extract_row(path: Path) -> dict | None:
@@ -116,11 +177,30 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     repo = Path(args.repo)
     rounds = round_rows(repo)
+    golden = load_golden_fields(repo)
+    if golden is None:
+        print(f"bench-trend: no golden at {GOLDEN_REL}; schema check skipped")
+    else:
+        for _, p, row in rounds:
+            for prob in validate_row(row, golden, strict=False):
+                print(f"bench-trend warning [{p.name}]: {prob}")
     if args.json:
         new = extract_row(Path(args.json))
         if new is None:
             print(f"bench-trend FAIL: no {METRIC} row in {args.json}")
             return 1
+        if golden is not None:
+            problems = validate_row(new, golden, strict=True)
+            if problems:
+                for prob in problems:
+                    print(f"bench-trend FAIL [{args.json}]: {prob}")
+                print(
+                    "bench-trend FAIL: fresh row drifted from the BENCH "
+                    "golden schema (bench.py and "
+                    f"{GOLDEN_REL.name} disagree — run "
+                    "`cosmos-curate-tpu lint --schema`)"
+                )
+                return 1
         if not rounds:
             print("bench-trend: no committed rounds to compare against; pass")
             return 0

@@ -11,9 +11,12 @@
 # compaction), plus (9) the bench trend gate (>20% warm clips/s regression
 # between committed BENCH rounds fails), plus (10) the concurrency gate
 # (whole-repo lock-order/blocking-under-lock verifier must stay clean, and
-# its seeded-fixture + runtime-sanitizer suites must pass). Individual
+# its seeded-fixture + runtime-sanitizer suites must pass), plus (11) the
+# schema gate (protocol frames + durable JSON formats must match the
+# analysis/schemas/ goldens — drift needs a version bump, breaking durable
+# drift a migration shim; the skew-fuzz suites must pass). Individual
 # gates can be skipped via
-# CI_SKIP=tier1,bench,trend,multichip,index,service,nodeloss,search,static,concurrency
+# CI_SKIP=tier1,bench,trend,multichip,index,service,nodeloss,search,static,concurrency,schema
 # for local use.
 set -uo pipefail
 
@@ -126,6 +129,21 @@ if ! skip concurrency; then
       tests/analysis/test_concurrency_check.py tests/analysis/test_lock_runtime.py \
       -q -p no:randomly; then
     failures+=("concurrency suites")
+  fi
+fi
+
+if ! skip schema; then
+  echo "== schema gate (wire/durable contract surfaces vs checked-in goldens) =="
+  # drift without a bump (or a breaking durable bump without a migration
+  # shim) fails; fix is a version bump + `lint --schema --update` + commit
+  if ! JAX_PLATFORMS=cpu timeout -k 10 300 python -m cosmos_curate_tpu.cli.main \
+      lint --schema cosmos_curate_tpu; then
+    failures+=("schema lint")
+  fi
+  if ! JAX_PLATFORMS=cpu timeout -k 10 600 python -m pytest \
+      tests/analysis/test_schema_check.py tests/engine/test_protocol_skew.py \
+      tests/service/test_schema_versioning.py -q -p no:randomly; then
+    failures+=("schema suites (seeded drift + skew fuzz)")
   fi
 fi
 

@@ -6,7 +6,7 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== curate-lint: AST rules + shardcheck + concurrency over cosmos_curate_tpu/ =="
+echo "== curate-lint: AST rules + shardcheck + concurrency + schema over cosmos_curate_tpu/ =="
 # `cosmos-curate-tpu lint` when the console script is installed; module
 # invocation otherwise (dev checkouts without `pip install -e .`).
 # --shard-check is device-free (jax.eval_shape over an AbstractMesh), so
@@ -14,10 +14,13 @@ echo "== curate-lint: AST rules + shardcheck + concurrency over cosmos_curate_tp
 # --concurrency adds the whole-repo lock-order graph / blocking-under-lock
 # / guarded-by pass (analysis/concurrency_check.py) — the repo must stay
 # concurrency-clean.
+# --schema diffs the wire/durable contract surfaces against the
+# analysis/schemas/ goldens (analysis/schema_check.py) — drift without a
+# version bump, or a breaking durable bump without a migration shim, fails.
 if command -v cosmos-curate-tpu >/dev/null 2>&1; then
-  JAX_PLATFORMS=cpu cosmos-curate-tpu lint --shard-check --concurrency cosmos_curate_tpu
+  JAX_PLATFORMS=cpu cosmos-curate-tpu lint --shard-check --concurrency --schema cosmos_curate_tpu
 else
-  JAX_PLATFORMS=cpu python -m cosmos_curate_tpu.cli.main lint --shard-check --concurrency cosmos_curate_tpu
+  JAX_PLATFORMS=cpu python -m cosmos_curate_tpu.cli.main lint --shard-check --concurrency --schema cosmos_curate_tpu
 fi
 
 echo "== analysis test suite =="

@@ -1,0 +1,190 @@
+"""blocking-in-async rule: sync blocking calls reachable from coroutines.
+
+Includes a regression fixture shaped exactly like the finding that
+motivated the rule: service/app.py's async handlers journaling through a
+sync wrapper whose ``journal.append`` fsyncs on the event loop.
+"""
+
+import textwrap
+from pathlib import Path
+
+from cosmos_curate_tpu.analysis.ast_lint import lint_file
+from cosmos_curate_tpu.analysis.common import LintConfig
+from cosmos_curate_tpu.analysis.rules import all_rules
+
+
+def _lint(tmp_path: Path, code: str, *, rel: str = "cosmos_curate_tpu/service/snippet.py"):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(code))
+    rules = [r for r in all_rules() if r.rule_id == "blocking-in-async"]
+    return lint_file(f, LintConfig(), rules, root=tmp_path)
+
+
+def test_direct_blocking_calls_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import os, time, subprocess
+
+        async def handler(fd):
+            os.fsync(fd)
+            time.sleep(1.0)
+            subprocess.run(["true"])
+        """,
+    )
+    assert [f.rule for f in findings] == ["blocking-in-async"] * 3
+    assert "os.fsync()" in findings[0].message
+    assert "asyncio.sleep" in findings[1].message
+
+
+def test_journal_append_contract_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        async def handler(self):
+            self.journal.append(rec, "submit")
+        """,
+    )
+    assert len(findings) == 1
+    assert "fsyncs by contract" in findings[0].message
+
+
+def test_sync_wrapper_chain_flagged_with_via_chain(tmp_path):
+    """The app.py shape: async handler -> sync method -> journal.append.
+    The finding names the chain so the fix target is obvious."""
+    findings = _lint(
+        tmp_path,
+        """
+        class State:
+            def record_transition(self, rec, event):
+                self.journal.append(rec, event)
+
+        async def invoke(state, rec):
+            state.record_transition(rec, "submit")
+        """,
+    )
+    assert len(findings) == 1
+    assert "record_transition() → " in findings[0].message
+    assert "async def invoke" in findings[0].message
+
+
+def test_transitive_chain_through_two_sync_hops(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+
+        def inner(fd):
+            os.fsync(fd)
+
+        def outer(fd):
+            inner(fd)
+
+        async def handler(fd):
+            outer(fd)
+        """,
+    )
+    assert len(findings) == 1
+    assert "outer() → inner() → os.fsync()" in findings[0].message
+
+
+def test_run_in_executor_offload_passes(tmp_path):
+    """The fix idiom: awaited executor offloads (including a lambda
+    wrapper) do not block the loop and must not be flagged."""
+    findings = _lint(
+        tmp_path,
+        """
+        import asyncio, functools, os
+
+        class State:
+            def record_transition(self, rec, event):
+                self.journal.append(rec, event)
+
+            async def record_transition_async(self, rec, event):
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, functools.partial(self.record_transition, rec, event)
+                )
+
+        async def invoke(state, rec):
+            await state.record_transition_async(rec, "submit")
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: os.fsync(3)
+            )
+        """,
+    )
+    assert findings == []
+
+
+def test_sync_functions_alone_not_flagged(tmp_path):
+    """Blocking in plain sync code is fine (that is what threads are for);
+    the rule only fires on reachability from a coroutine."""
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+
+        def journal_append(fd):
+            os.fsync(fd)
+
+        def caller(fd):
+            journal_append(fd)
+        """,
+    )
+    assert findings == []
+
+
+def test_nested_def_inside_async_not_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+
+        async def handler(fd):
+            def for_executor():
+                os.fsync(fd)
+            return for_executor
+        """,
+    )
+    assert findings == []
+
+
+def test_queue_get_blocking_flagged_nonblocking_passes(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        async def pump(results_q):
+            results_q.get()
+            results_q.get(block=False)
+        """,
+    )
+    assert len(findings) == 1
+    assert "results_q.get()" in findings[0].message
+
+
+def test_tests_directory_exempt(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import os
+
+        async def helper(fd):
+            os.fsync(fd)
+        """,
+        rel="tests/helpers/snippet.py",
+    )
+    assert findings == []
+
+
+def test_suppression_comment(tmp_path):
+    findings = _lint(
+        tmp_path,
+        """
+        import time
+
+        async def backstop():
+            time.sleep(0.01)  # curate-lint: disable=blocking-in-async
+        """,
+    )
+    assert findings == []

@@ -1,0 +1,187 @@
+"""Schema & wire-compat verifier: seeded drift + repo self-check.
+
+The seeded-drift tests are the pillar's acceptance proof: each drift
+class (additive without a bump, removal, type change, breaking bump
+without a migration shim) is fed to :func:`classify_drift` as a synthetic
+golden/current pair and must produce exactly its finding — while the
+legitimate evolutions (no drift, additive WITH a bump plus shim) pass.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+from cosmos_curate_tpu.analysis.common import Severity
+from cosmos_curate_tpu.analysis.schema_check import (
+    SURFACES,
+    Surface,
+    classify_drift,
+    extract_surface,
+    load_golden,
+    run_schema_check,
+)
+
+
+def _surface(kind: str = "durable") -> Surface:
+    return Surface("test-surface", kind, "some/file.py", lambda: 1, dict)
+
+
+def _snap(version: int, fields: dict) -> dict:
+    return {
+        "surface": "test-surface",
+        "kind": "durable",
+        "version": version,
+        "schemas": {"doc": {"fields": fields}},
+    }
+
+
+_F = {"required": True, "type": "str"}
+_OPT = {"required": False, "type": "int"}
+_NO_SHIM = lambda name, v: False  # noqa: E731
+_SHIMMED = lambda name, v: True  # noqa: E731
+
+
+class TestSeededDrift:
+    def test_identical_schemas_pass(self):
+        snap = _snap(1, {"a": _F})
+        assert classify_drift(_surface(), snap, copy.deepcopy(snap)) == []
+
+    def test_missing_golden(self):
+        (finding,) = classify_drift(_surface(), None, _snap(1, {"a": _F}))
+        assert finding.rule == "schema-missing-golden"
+        assert "--update" in finding.message
+
+    def test_additive_without_bump_caught(self):
+        gold = _snap(1, {"a": _F})
+        cur = _snap(1, {"a": _F, "b": _OPT})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-additive-no-bump"
+        assert "doc.b added" in finding.message
+        assert finding.severity is Severity.ERROR
+
+    def test_additive_with_bump_passes_as_stale_golden(self):
+        """The legitimate evolution: add a field AND bump the version. The
+        only finding is the re-snapshot reminder (a warning, not a gate
+        failure)."""
+        gold = _snap(1, {"a": _F})
+        cur = _snap(2, {"a": _F, "b": _OPT})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-stale-golden"
+        assert finding.severity is Severity.WARNING
+
+    def test_removal_without_bump_caught(self):
+        gold = _snap(1, {"a": _F, "b": _OPT})
+        cur = _snap(1, {"a": _F})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-breaking-no-bump"
+        assert "doc.b removed" in finding.message
+
+    def test_type_change_without_bump_caught(self):
+        gold = _snap(1, {"a": _F})
+        cur = _snap(1, {"a": {"required": True, "type": "int"}})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-breaking-no-bump"
+        assert "type str -> int" in finding.message
+
+    def test_required_flip_is_breaking(self):
+        gold = _snap(1, {"a": _F})
+        cur = _snap(1, {"a": {"required": False, "type": "str"}})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-breaking-no-bump"
+
+    def test_breaking_bump_without_shim_needs_migration(self):
+        """Durable surfaces: a bump acknowledges the break but old records
+        still exist on disk — the gate holds out for a registered shim."""
+        gold = _snap(1, {"a": _F, "b": _OPT})
+        cur = _snap(2, {"a": _F})
+        (finding,) = classify_drift(
+            _surface(), gold, cur, has_migration=_NO_SHIM
+        )
+        assert finding.rule == "schema-missing-migration"
+        assert "MIGRATIONS" in finding.message
+
+    def test_breaking_bump_with_shim_passes_as_stale_golden(self):
+        gold = _snap(1, {"a": _F, "b": _OPT})
+        cur = _snap(2, {"a": _F})
+        (finding,) = classify_drift(
+            _surface(), gold, cur, has_migration=_SHIMMED
+        )
+        assert finding.rule == "schema-stale-golden"
+        assert finding.severity is Severity.WARNING
+
+    def test_breaking_bump_on_wire_surface_needs_no_shim(self):
+        """Wire frames never persist: the handshake rejects old peers, so
+        a bump alone is the complete fix."""
+        gold = _snap(1, {"a": _F, "b": _OPT})
+        cur = _snap(2, {"a": _F})
+        (finding,) = classify_drift(
+            _surface(kind="wire"), gold, cur, has_migration=_NO_SHIM
+        )
+        assert finding.rule == "schema-stale-golden"
+
+    def test_version_backwards_caught(self):
+        gold = _snap(3, {"a": _F})
+        cur = _snap(2, {"a": _F})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-version-backwards"
+
+    def test_bump_without_change_is_stale_golden(self):
+        gold = _snap(1, {"a": _F})
+        cur = _snap(2, {"a": _F})
+        (finding,) = classify_drift(_surface(), gold, cur)
+        assert finding.rule == "schema-stale-golden"
+
+
+class TestRepoGoldens:
+    def test_checked_in_goldens_match_code(self):
+        """The repo's own gate: extraction over the live code diffs clean
+        against analysis/schemas/. A failure here means someone changed a
+        contract surface without `lint --schema --update` (or without the
+        version bump the findings name)."""
+        findings = [
+            f for f in run_schema_check() if f.severity is Severity.ERROR
+        ]
+        assert findings == [], [f.render() for f in findings]
+
+    def test_every_surface_extracts_fields(self):
+        """Extraction must never silently degrade to an empty schema — an
+        empty golden would let every future drift through unseen."""
+        for surface in SURFACES:
+            snap = extract_surface(surface)
+            assert snap["schemas"], surface.name
+            for name, schema in snap["schemas"].items():
+                if name == "Bye":
+                    continue  # the one legitimately fieldless wire frame
+                assert schema["fields"], f"{surface.name}:{name}"
+
+    def test_goldens_are_valid_snapshots(self):
+        for surface in SURFACES:
+            gold = load_golden(surface)
+            assert gold is not None, surface.name
+            assert gold["surface"] == surface.name
+            assert gold["kind"] == surface.kind
+            assert int(gold["version"]) == surface.version()
+
+    def test_journal_golden_covers_the_envelope(self):
+        """Spot-check one durable surface end to end: the journal line's
+        envelope fields (the contract replay depends on) are in the golden."""
+        (journal,) = [s for s in SURFACES if s.name == "job-journal"]
+        gold = load_golden(journal)
+        envelope = gold["schemas"]["envelope"]["fields"]
+        for key in ("ts", "event", "record", "schema_version"):
+            assert key in envelope, key
+
+    def test_seeded_drift_against_real_golden(self, monkeypatch):
+        """End-to-end seeding: mutate a REAL golden in memory and run the
+        classifier — proving the checked-in snapshots are drift-sensitive,
+        not vacuous."""
+        (journal,) = [s for s in SURFACES if s.name == "job-journal"]
+        gold = load_golden(journal)
+        cur = extract_surface(journal)
+        # removal seeded into the code side
+        broken = json.loads(json.dumps(cur))
+        del broken["schemas"]["JobRecord"]["fields"]["job_id"]
+        (finding,) = classify_drift(journal, gold, broken)
+        assert finding.rule == "schema-breaking-no-bump"
+        assert "job_id removed" in finding.message

@@ -257,6 +257,11 @@ class TestAgentDeadlineWatchdog:
             with agent._lock:
                 agent.workers["w1"] = (in_q, _AliveProc())
             agent._handle(SubmitBatch("w1", 9, [], timeout_s=30.0))
+            # _handle hands the batch to the resolve pool; the ProcessMsg
+            # reaches the worker queue only AFTER the deadline insert, so a
+            # blocking get is the synchronization point (asserting right
+            # after _handle raced the pool thread and flaked on slow boxes)
+            assert in_q.get(timeout=5.0).batch_id == 9
             assert ("w1", 9) in agent.deadlines
             assert agent.deadlines[("w1", 9)] > time.monotonic() + 25.0
             # result relay clears it
@@ -264,6 +269,7 @@ class TestAgentDeadlineWatchdog:
             assert ("w1", 9) not in agent.deadlines
             # no-timeout batches never arm the watchdog
             agent._handle(SubmitBatch("w1", 10, [], timeout_s=0.0))
+            assert in_q.get(timeout=5.0).batch_id == 10
             assert ("w1", 10) not in agent.deadlines
         finally:
             agent.object_server.close()

@@ -232,14 +232,19 @@ class TestRemotePlane:
                     assert got == 1, f"session {session}: agent did not (re)join"
                 finally:
                     # closing WITHOUT Bye simulates a driver crash: sockets
-                    # drop, the agent must reconnect for the next session
+                    # drop, the agent must reconnect for the next session.
+                    # The LISTENER must die first — a real crash closes all
+                    # fds atomically, but closing agent socks first opens a
+                    # window where the agent's reconnect dial lands back in
+                    # THIS dying driver's accept queue and then blocks on a
+                    # zombie connection instead of reaching the next session
+                    mgr._closed = True
+                    mgr._server.close()
                     for a in mgr.agents:
                         try:
                             a.sock.close()
                         except OSError:
                             pass
-                    mgr._closed = True
-                    mgr._server.close()
         finally:
             agent.terminate()
             try:
