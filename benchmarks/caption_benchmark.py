@@ -55,6 +55,13 @@ def main() -> int:
         help="skip the cross-job continuous-batching measurement (two "
         "owners submitting concurrently into the shared engine)",
     )
+    ap.add_argument(
+        "--paged-attention",
+        choices=("auto", "kernel", "gather"),
+        default="auto",
+        help="attention program family: paged (kernel reads the KV pool "
+        "through the block table) vs the legacy gather-view programs",
+    )
     args = ap.parse_args()
 
     import numpy as np
@@ -78,7 +85,13 @@ def main() -> int:
         lanes = ((short, max(2, args.batch // 2)), (cfg.max_seq, max(2, args.batch // 2)))
     # async_prep mirrors the production stage: vision encode of request N+1
     # overlaps decode of request N
-    engine = CaptionEngine(cfg, max_batch=args.batch, kv_lanes=lanes, async_prep=True)
+    engine = CaptionEngine(
+        cfg,
+        max_batch=args.batch,
+        kv_lanes=lanes,
+        async_prep=True,
+        paged_attention=args.paged_attention,
+    )
     engine.setup()
     tok = engine.tokenizer
     prompt_ids = tok.encode(get_caption_prompt("default"))
@@ -146,6 +159,17 @@ def main() -> int:
         # zero whole-prefix device copies (prefix_copy_dispatches == 0 is
         # structural; copy-on-write tail duplications ride kv_cow_copies)
         "kv_block_size": engine.block_size,
+        # requested divisor BEFORE the lane-length gcd fallback — when the
+        # two differ, this row is not block-size-comparable to rows that
+        # asked for the same size over different lanes
+        "kv_block_size_requested": engine.block_size_requested,
+        # paged-attention path accounting: which program family served the
+        # run, decode steps that read the pool through the block table, and
+        # the gathered-view bytes those steps never materialized
+        "paged_attention": engine.paged_attention,
+        "paged_kernel_steps": engine.paged_kernel_steps,
+        "kv_gather_bytes_avoided": engine.kv_gather_bytes_avoided,
+        "decode_attention_s": round(engine.decode_attention_s, 3),
         "kv_blocks_total": engine.kv_blocks_total,
         "kv_blocks_peak": engine.kv_blocks_used_peak,
         "kv_bytes_per_request": round(engine.kv_bytes_reserved_per_request, 1),

@@ -522,6 +522,73 @@ def default_contracts(mesh: dict[str, int]) -> list[ShardContract]:
             pads_batch=True,
         )
     )
+
+    # ops/paged_attention.py — head-parallel paged attention: queries, the
+    # KV block pools, and the output shard their Hkv dimension over the
+    # model axis (tensor parallelism over KV heads); block tables and
+    # per-row lengths replicate. The real shard_map call site is traced
+    # abstractly on the XLA reference path (use_kernel=False keeps the
+    # trace device-free).
+    from cosmos_curate_tpu.models.vlm.paged_kv import paged_head_update
+    from cosmos_curate_tpu.ops.paged_attention import paged_head_attention
+
+    from cosmos_curate_tpu.parallel.axes import MODEL
+
+    contracts.append(
+        ShardContract(
+            name="vlm-paged-head-attention",
+            where="ops/paged_attention.py",
+            inputs=(
+                AbstractInput(
+                    (8, 1, 2, 4, 8), "bfloat16",
+                    (None, None, MODEL, None, None), name="q",
+                ),
+                AbstractInput(
+                    pool_shape, "bfloat16", (None, None, None, MODEL, None),
+                    name="pool_k",
+                ),
+                AbstractInput(
+                    pool_shape, "bfloat16", (None, None, None, MODEL, None),
+                    name="pool_v",
+                ),
+                AbstractInput((8, 2), "int32", (), name="tables"),
+                AbstractInput((8,), "int32", (), name="write_index"),
+                AbstractInput((8,), "int32", (), name="kv_len"),
+            ),
+            forward=lambda amesh, q, pk, pv, t, wi, kl: paged_head_attention(
+                amesh, q, pk, pv, t, wi, kl, use_kernel=False
+            ),
+            needs_mesh=True,
+        )
+    )
+
+    # models/vlm/paged_kv.py — the matching head-parallel pool scatter: each
+    # model-axis shard writes a chunk's K/V into its own head plane through
+    # the replicated block table.
+    contracts.append(
+        ShardContract(
+            name="vlm-paged-head-scatter",
+            where="models/vlm/paged_kv.py",
+            inputs=(
+                AbstractInput(
+                    pool_shape, "bfloat16", (None, None, None, MODEL, None),
+                    name="pool_k",
+                ),
+                AbstractInput(
+                    pool_shape, "bfloat16", (None, None, None, MODEL, None),
+                    name="pool_v",
+                ),
+                AbstractInput((8, 1, 2, 8), "bfloat16", (None, None, MODEL, None), name="k"),
+                AbstractInput((8, 1, 2, 8), "bfloat16", (None, None, MODEL, None), name="v"),
+                AbstractInput((8, 2), "int32", (), name="tables"),
+                AbstractInput((8,), "int32", (), name="write_index"),
+            ),
+            forward=lambda amesh, pk, pv, k, v, t, wi: paged_head_update(
+                amesh, pk, pv, k, v, t, wi, layer_index=1
+            ),
+            needs_mesh=True,
+        )
+    )
     return contracts
 
 

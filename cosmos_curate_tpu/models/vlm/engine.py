@@ -49,6 +49,7 @@ vllm_async_stage.py). TPU-first re-design:
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -283,6 +284,8 @@ class CaptionEngine:
         block_size: int = 16,
         kv_pool_blocks: int | None = None,
         owner_inflight_cap: int | None = None,
+        paged_attention: str = "auto",
+        mesh: Any = None,
     ) -> None:
         self.cfg = cfg
         self.max_batch = max_batch
@@ -290,7 +293,25 @@ class CaptionEngine:
         # interleaved with decode steps
         self.prefill_chunk = min(prefill_chunk, cfg.max_seq)
         self.tokenizer = tokenizer or default_caption_tokenizer()
-        self.model = VLM(cfg)
+        # paged-attention path selection. "auto"/"kernel" run the paged
+        # programs (attention reads the pool through the block table —
+        # ops/paged_attention.py picks Pallas on TPU, the byte-parity XLA
+        # reference elsewhere); "gather" keeps the legacy
+        # gather-view/scatter-back programs as fallback and parity
+        # reference. CURATE_PAGED_ATTENTION overrides the constructor.
+        env_mode = os.environ.get("CURATE_PAGED_ATTENTION")
+        mode = env_mode if env_mode is not None else paged_attention
+        if mode not in ("auto", "kernel", "gather"):
+            raise ValueError(
+                f"paged_attention must be auto|kernel|gather, got {mode!r}"
+            )
+        self.paged_attention = mode
+        self._use_paged = mode != "gather"
+        # optional device mesh: threads into the model so the paged path
+        # runs head-parallel over parallel/axes.MODEL when the mesh names
+        # that axis (KV pool + heads sharded, block tables replicated)
+        self.mesh = mesh
+        self.model = VLM(cfg, mesh=mesh)
         self.params = params
         self.waiting: list[CaptionRequest] = []
         # (length, n_slots) per decode-batch lane; default = one
@@ -308,6 +329,10 @@ class CaptionEngine:
                 "block_size %d does not divide every KV lane length; using %d",
                 block_size, bs,
             )
+        # both sides of the fallback are surfaced (stats() / bench row) so
+        # bench comparisons across block sizes aren't apples-to-oranges
+        # when the gcd silently shrank the divisor
+        self.block_size_requested = int(block_size)
         self.block_size = bs
         base = 0
         self.lanes: list[_Lane] = []
@@ -393,6 +418,16 @@ class CaptionEngine:
         self._prefix_block_refs = 0
         self._kv_cow_copies = 0
         self._kv_blocks_used_peak = 0
+        # paged-attention accounting (under _stats_lock): decode steps
+        # served by the paged programs (no gathered working set — the
+        # structural assertion that the per-step copy is gone), bytes of
+        # contiguous KV view the gather programs would have materialized
+        # and scattered back for the same calls, and the tight wall time of
+        # the decode program call + sync (same site both paths, so
+        # kernel-vs-gather step time is directly comparable)
+        self._paged_kernel_steps = 0
+        self._kv_gather_bytes_avoided = 0
+        self._decode_attn_time = 0.0
         # cross-job fairness: least-recently-admitted owner goes first, and
         # no owner may hold more than its in-flight share of the slots
         # (owner_inflight_cap; None = ceil(total slots / active owners))
@@ -559,6 +594,57 @@ class CaptionEngine:
             greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
             return greedy, step_logits, pool_k, pool_v
 
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_batch_paged(
+            params, pool_k, pool_v, tables, embeds, write_index, t_valid, rope_pos, ds=None
+        ):
+            """prefill_batch without the working set: the model's paged
+            forward scatters each row's chunk through its block table and
+            attends straight out of the pool (ops/paged_attention.py) — no
+            gather_block_views, no scatter_block_views. Same arguments,
+            same returns, bit-equal logits on the reference path."""
+            logits, pool_k, pool_v = model.apply(
+                params,
+                embeds,
+                pool_k,
+                pool_v,
+                rope_pos,
+                write_index,
+                write_index + t_valid,
+                tables,
+                deepstack=ds,
+                method=model.paged_forward,
+            )
+            last = jnp.take_along_axis(
+                logits, (t_valid - 1)[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
+            return last, pool_k, pool_v
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_step_paged(params, pool_k, pool_v, tables, tokens, positions, rope_positions):
+            """decode_step without the working set — see prefill_batch_paged.
+            The per-step O(context) gathered copy and its scatter-back are
+            gone; each row writes exactly ONE pool cell."""
+            embeds = model.apply(params, tokens[:, None], method=model.embed_tokens)
+            rp = rope_positions[:, None]
+            if mrope:
+                # decode is always text: all three components equal
+                rp = jnp.broadcast_to(rp[..., None], (*rp.shape, 3))
+            logits, pool_k, pool_v = model.apply(
+                params,
+                embeds,
+                pool_k,
+                pool_v,
+                rp,
+                positions,
+                positions + 1,
+                tables,
+                method=model.paged_forward,
+            )
+            step_logits = logits[:, 0]
+            greedy = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+            return greedy, step_logits, pool_k, pool_v
+
         @jax.jit
         def prefix_prefill(params, embeds, rope_pos, t_valid):
             """Prefill ONE text prefix into a scratch cache and return its
@@ -605,8 +691,8 @@ class CaptionEngine:
         self._host_rng = np.random.default_rng(seed)
         self._encode_images = encode_images
         self._embed_tokens = embed_tokens
-        self._prefill_batch = prefill_batch
-        self._decode = decode_step
+        self._prefill_batch = prefill_batch_paged if self._use_paged else prefill_batch
+        self._decode = decode_step_paged if self._use_paged else decode_step
         self._prefix_prefill = prefix_prefill
         self._write_prefix_blocks = write_prefix_blocks
         self._copy_blocks = copy_blocks
@@ -793,6 +879,74 @@ class CaptionEngine:
         tail block (ONE block each — not a prefix copy)."""
         return self._kv_cow_copies
 
+    # -- paged-attention accounting --------------------------------------
+    def _gather_view_bytes(self, rows: int, length: int) -> int:
+        """Bytes of contiguous KV working set the gather programs would
+        materialize for one program call over ``rows`` block tables of
+        ``length`` gathered positions (K + V, all layers)."""
+        cfg = self.cfg
+        itemsize = 2 if self._pool_k is None else self._pool_k.dtype.itemsize
+        return 2 * cfg.n_layers * rows * length * cfg.n_kv_heads * cfg.head_dim * itemsize
+
+    @property
+    def paged_kernel_steps(self) -> int:
+        """Decode steps served by the paged-attention programs — attention
+        read the pool through the block table; NO contiguous working-set
+        copy was built or scattered back. Structurally zero under
+        ``paged_attention="gather"``; > 0 is the smoke contract that the
+        kernel path was actually taken."""
+        return self._paged_kernel_steps
+
+    @property
+    def kv_gather_bytes_avoided(self) -> int:
+        """Cumulative bytes of per-call contiguous KV working set the
+        gather programs would have materialized (and scattered back) for
+        the prefill/decode calls the paged path served instead."""
+        return self._kv_gather_bytes_avoided
+
+    @property
+    def decode_attention_s(self) -> float:
+        """Tight wall time of decode program calls + host sync, identical
+        measurement site for the paged and gather paths — the
+        kernel-vs-gather comparison the bench caption_attention section
+        reports. (Also contained in phase decode_s, which this mirrors at
+        the program-call granularity.)"""
+        return self._decode_attn_time
+
+    @property
+    def mesh_geometry(self) -> tuple:
+        """Hashable (axis, extent) view of the serving mesh (empty when
+        unsharded) — part of the SharedCaptionEngine key so differently
+        sharded engines never collide."""
+        if self.mesh is None:
+            return ()
+        return tuple(
+            (str(name), int(self.mesh.shape[name])) for name in self.mesh.axis_names
+        )
+
+    def stats(self) -> dict:
+        """One-call snapshot of the serving counters (bench row / smoke
+        surface). Includes both sides of the block-size fallback: the
+        constructor-requested size and the gcd-shrunk divisor actually
+        used, so cross-run bench comparisons can detect a silent shrink."""
+        with self._stats_lock:
+            return {
+                "paged_attention": self.paged_attention,
+                "mesh_geometry": self.mesh_geometry,
+                "kv_block_size": self.block_size,
+                "kv_block_size_requested": self.block_size_requested,
+                "paged_kernel_steps": self._paged_kernel_steps,
+                "kv_gather_bytes_avoided": self._kv_gather_bytes_avoided,
+                "decode_attention_s": self._decode_attn_time,
+                "decode_tokens": self._decode_tokens,
+                "decode_s": self._decode_time,
+                "prefill_tokens": self._prefill_tokens,
+                "prefill_s": self._prefill_time,
+                "kv_blocks_total": self._allocator.capacity,
+                "kv_blocks_used": self._allocator.used_blocks,
+                "kv_blocks_used_peak": self._kv_blocks_used_peak,
+            }
+
     @property
     def requests_admitted(self) -> int:
         return self._requests_admitted
@@ -895,6 +1049,9 @@ class CaptionEngine:
             self._kv_worstcase_tokens = 0
             self._prefix_block_refs = 0
             self._kv_cow_copies = 0
+            self._paged_kernel_steps = 0
+            self._kv_gather_bytes_avoided = 0
+            self._decode_attn_time = 0.0
             self._kv_blocks_used_peak = self._allocator.used_blocks
             self._interleaved_steps = 0
             self._owner_decode_tokens.clear()
@@ -1877,6 +2034,10 @@ class CaptionEngine:
         with self._stats_lock:
             self._prefill_time += time.monotonic() - t0
             self._prefill_tokens += int(sum(it[3] for it in items))
+            if self._use_paged:
+                self._kv_gather_bytes_avoided += self._gather_view_bytes(
+                    len(tables), lane.length
+                )
         for j, (slot_idx, req, _emb, t_valid, _rope, next_rope, _ds, base) in enumerate(
             items
         ):
@@ -2013,6 +2174,10 @@ class CaptionEngine:
         with self._stats_lock:
             self._prefill_time += time.monotonic() - t0
             self._prefill_tokens += new_tokens
+            if self._use_paged:
+                self._kv_gather_bytes_avoided += self._gather_view_bytes(
+                    len(tables), lane.length
+                )
 
     # holds-lock: _lock
     def _decode_once(self, lane: _Lane) -> None:
@@ -2044,10 +2209,17 @@ class CaptionEngine:
             jnp.asarray(rope_positions),
         )
         greedy_np = np.asarray(greedy)  # ONE host sync for the whole batch
+        dt = time.monotonic() - t0  # program call + sync: same site, both paths
         with self._stats_lock:
-            self._decode_time += time.monotonic() - t0
+            self._decode_time += dt
+            self._decode_attn_time += dt
             self._decode_tokens += len(lane.slots)
             self._decode_rows += lane.n_slots
+            if self._use_paged:
+                self._paged_kernel_steps += 1
+                self._kv_gather_bytes_avoided += self._gather_view_bytes(
+                    lane.n_slots, lane.length
+                )
             for slot in lane.slots.values():
                 owner = slot.request.owner
                 self._owner_decode_tokens[owner] = (

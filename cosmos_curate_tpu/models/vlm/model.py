@@ -585,9 +585,15 @@ class MoEFFN(nn.Module):
 class DecoderLayer(nn.Module):
     cfg: VLMConfig
     dtype: jnp.dtype = jnp.bfloat16
+    # optional device mesh: when set and it names the model axis, the paged
+    # path runs head-parallel (shard_map over Hkv) — see paged_head_attention
+    mesh: object = None
 
     @nn.compact
-    def __call__(self, x, cache_k, cache_v, positions, write_index, kv_len):
+    def __call__(
+        self, x, cache_k, cache_v, positions, write_index, kv_len,
+        block_tables=None, layer_index=0,
+    ):
         """One decoder layer with slot KV cache.
 
         x: [B, T, D]; cache_k/v: [B, S, Hkv, Dh]; positions: [B, T] rope
@@ -596,10 +602,16 @@ class DecoderLayer(nn.Module):
         positions); write_index: [B] offset where this chunk's K/V land;
         kv_len: [B] valid cache length AFTER writing (= write_index + T for
         active rows). Returns (y, new_cache_k, new_cache_v).
+
+        Paged mode (``block_tables`` set): cache_k/v are the FULL block
+        pools ``[L, NB, bs, Hkv, Dh]`` and block_tables is ``[B, nbl]``.
+        K/V scatter through the table and attention reads the pool in place
+        (ops/paged_attention.py) — no contiguous working-set view exists.
+        Returns the updated pools in place of cache rows.
         """
         cfg = self.cfg
         b, t, _ = x.shape
-        s = cache_k.shape[1]
+        s = cache_k.shape[1] if block_tables is None else None
         h, hk, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
         y = RMSNorm(eps=cfg.rms_eps, name="ln1")(x)
@@ -615,46 +627,85 @@ class DecoderLayer(nn.Module):
         k = apply_rope(k, positions, cfg.rope_theta, cfg.mrope_section, cfg.mrope_interleaved)
         v = v.reshape(b, t, hk, dh)
 
-        # scatter this chunk into the cache at each row's write_index
-        def write_row(cache, chunk, idx):
-            return jax.lax.dynamic_update_slice(cache, chunk, (idx, 0, 0))
-
-        new_k = jax.vmap(write_row)(cache_k, k.astype(cache_k.dtype), write_index)
-        new_v = jax.vmap(write_row)(cache_v, v.astype(cache_v.dtype), write_index)
-
-        # GQA attention of q against the whole (masked) cache. Heads stay
-        # grouped ([B, T, Hkv, G, Dh] vs the KV's [B, S, Hkv, Dh]) — no
-        # jnp.repeat materialization, so HBM traffic is the true KV size
-        # (the decode step is KV-bandwidth-bound; for 12/2 GQA a repeat
-        # would read 6x the bytes).
         group = h // hk
-        if t == 1 and _use_flash_decode(s):
-            from cosmos_curate_tpu.ops.decode_attention import decode_attention
-
-            out = decode_attention(
-                q[:, 0].reshape(b, hk, group, dh), new_k, new_v, kv_len
+        if block_tables is not None:
+            # paged path: scatter this chunk's K/V through the block table
+            # (the same full-window write the gather path's scatter-back
+            # performs — positions past t_valid land in-table and carry
+            # identical garbage both ways), then attend straight out of the
+            # pool. No gathered view, no scatter-back.
+            from cosmos_curate_tpu.models.vlm.paged_kv import paged_head_update
+            from cosmos_curate_tpu.ops.paged_attention import (
+                paged_attention,
+                paged_head_attention,
             )
-            attn = out.astype(self.dtype)[:, None]  # [B, 1, Hkv, G, Dh]
-        elif t > 1 and _use_flash_prefill(s):
-            from cosmos_curate_tpu.ops.prefill_attention import prefill_attention
+            from cosmos_curate_tpu.parallel.axes import MODEL
 
-            attn = prefill_attention(
-                q.reshape(b, t, hk, group, dh), new_k, new_v, write_index, kv_len
-            ).astype(self.dtype)
+            head_parallel = self.mesh is not None and MODEL in self.mesh.axis_names
+            if head_parallel:
+                new_k, new_v = paged_head_update(
+                    self.mesh, cache_k, cache_v, k, v, block_tables, write_index,
+                    layer_index=layer_index,
+                )
+            else:
+                bs_blk = cache_k.shape[2]
+                pos = write_index[:, None] + jnp.arange(t)[None, :]  # [B, T]
+                blk = jnp.take_along_axis(block_tables, pos // bs_blk, axis=1)
+                off = pos % bs_blk
+                new_k = cache_k.at[layer_index, blk, off].set(k.astype(cache_k.dtype))
+                new_v = cache_v.at[layer_index, blk, off].set(v.astype(cache_v.dtype))
+            qk = q.reshape(b, t, hk, group, dh)
+            if head_parallel:
+                attn = paged_head_attention(
+                    self.mesh, qk, new_k, new_v, block_tables, write_index, kv_len,
+                    layer_index=layer_index,
+                )
+            else:
+                attn = paged_attention(
+                    qk, new_k, new_v, block_tables, write_index, kv_len,
+                    layer_index=layer_index,
+                )
+            attn = attn.astype(self.dtype)
         else:
-            qg = (q * (dh**-0.5)).reshape(b, t, hk, group, dh)
-            logits = jnp.einsum(
-                "btkgd,bskd->bkgts", qg.astype(jnp.float32), new_k.astype(jnp.float32)
-            )
-            k_pos = jnp.arange(s)[None, None, None, None, :]  # cache slot index
-            # causality is over cache order (write_index + chunk offset) —
-            # under m-rope the rope positions are NOT monotone in it
-            q_seq = write_index[:, None] + jnp.arange(t)[None, :]  # [B, T]
-            causal = k_pos <= q_seq[:, None, None, :, None]
-            written = k_pos < kv_len[:, None, None, None, None]
-            logits = jnp.where(causal & written, logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            attn = jnp.einsum("bkgts,bskd->btkgd", probs.astype(self.dtype), new_v)
+            # scatter this chunk into the cache at each row's write_index
+            def write_row(cache, chunk, idx):
+                return jax.lax.dynamic_update_slice(cache, chunk, (idx, 0, 0))
+
+            new_k = jax.vmap(write_row)(cache_k, k.astype(cache_k.dtype), write_index)
+            new_v = jax.vmap(write_row)(cache_v, v.astype(cache_v.dtype), write_index)
+
+            # GQA attention of q against the whole (masked) cache. Heads stay
+            # grouped ([B, T, Hkv, G, Dh] vs the KV's [B, S, Hkv, Dh]) — no
+            # jnp.repeat materialization, so HBM traffic is the true KV size
+            # (the decode step is KV-bandwidth-bound; for 12/2 GQA a repeat
+            # would read 6x the bytes).
+            if t == 1 and _use_flash_decode(s):
+                from cosmos_curate_tpu.ops.decode_attention import decode_attention
+
+                out = decode_attention(
+                    q[:, 0].reshape(b, hk, group, dh), new_k, new_v, kv_len
+                )
+                attn = out.astype(self.dtype)[:, None]  # [B, 1, Hkv, G, Dh]
+            elif t > 1 and _use_flash_prefill(s):
+                from cosmos_curate_tpu.ops.prefill_attention import prefill_attention
+
+                attn = prefill_attention(
+                    q.reshape(b, t, hk, group, dh), new_k, new_v, write_index, kv_len
+                ).astype(self.dtype)
+            else:
+                qg = (q * (dh**-0.5)).reshape(b, t, hk, group, dh)
+                logits = jnp.einsum(
+                    "btkgd,bskd->bkgts", qg.astype(jnp.float32), new_k.astype(jnp.float32)
+                )
+                k_pos = jnp.arange(s)[None, None, None, None, :]  # cache slot index
+                # causality is over cache order (write_index + chunk offset) —
+                # under m-rope the rope positions are NOT monotone in it
+                q_seq = write_index[:, None] + jnp.arange(t)[None, :]  # [B, T]
+                causal = k_pos <= q_seq[:, None, None, :, None]
+                written = k_pos < kv_len[:, None, None, None, None]
+                logits = jnp.where(causal & written, logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                attn = jnp.einsum("bkgts,bskd->btkgd", probs.astype(self.dtype), new_v)
         attn = attn.reshape(b, t, h * dh)
         x = x + dense(cfg.dim, "in", name="o", use_bias=False, dtype=self.dtype)(attn)
 
@@ -672,6 +723,9 @@ class DecoderLayer(nn.Module):
 class VLM(nn.Module):
     cfg: VLMConfig
     dtype: jnp.dtype = jnp.bfloat16
+    # optional device mesh threaded to every DecoderLayer: enables the
+    # head-parallel paged-attention path (tensor parallelism over Hkv)
+    mesh: object = None
 
     def setup(self) -> None:
         cfg = self.cfg
@@ -682,7 +736,10 @@ class VLM(nn.Module):
             param_dtype=jnp.float32,
             embedding_init=nn.with_partitioning(nn.initializers.normal(0.02), (None, MODEL_AXIS)),
         )
-        self.layers = [DecoderLayer(cfg, dtype=self.dtype, name=f"layer_{i}") for i in range(cfg.n_layers)]
+        self.layers = [
+            DecoderLayer(cfg, dtype=self.dtype, mesh=self.mesh, name=f"layer_{i}")
+            for i in range(cfg.n_layers)
+        ]
         self.ln_f = RMSNorm(eps=cfg.rms_eps, name="ln_f")
         self.lm_head = (
             None
@@ -783,6 +840,36 @@ class VLM(nn.Module):
         else:
             logits = self.embed.attend(x.astype(jnp.float32))
         return logits, jnp.stack(new_ks), jnp.stack(new_vs)
+
+    def paged_forward(
+        self, embeds, pool_k, pool_v, positions, write_index, kv_len, block_tables,
+        deepstack=None,
+    ):
+        """Forward straight against the paged KV pool — no working-set view.
+
+        embeds: [B, T, D]; pool_k/pool_v: the FULL block pools
+        ``[L, NB, bs, Hkv, Dh]`` threaded through every layer (each layer
+        scatters its chunk through ``block_tables`` [B, nbl] and attends in
+        place via ops/paged_attention.py); write_index/kv_len as in
+        ``__call__``. Returns (logits [B, T, vocab], pool_k, pool_v) — the
+        updated pools, never a ``jnp.stack`` of per-layer copies, so XLA
+        donation keeps the scatters in-place.
+        """
+        x = embeds.astype(self.dtype)
+        n_ds = 0 if deepstack is None else deepstack.shape[0]
+        for i, layer in enumerate(self.layers):
+            x, pool_k, pool_v = layer(
+                x, pool_k, pool_v, positions, write_index, kv_len,
+                block_tables=block_tables, layer_index=i,
+            )
+            if i < n_ds:
+                x = x + deepstack[i].astype(x.dtype)
+        x = self.ln_f(x)
+        if self.lm_head is not None:  # untied checkpoints (Qwen2.5-VL-7B)
+            logits = self.lm_head(x.astype(jnp.float32))
+        else:
+            logits = self.embed.attend(x.astype(jnp.float32))
+        return logits, pool_k, pool_v
 
 
 def init_cache(cfg: VLMConfig, batch: int, dtype=jnp.bfloat16, length: int | None = None):

@@ -148,6 +148,46 @@ def scatter_block_views(pool_k, pool_v, tables, view_k, view_v):
     return pool_k.at[:, tables].set(bk), pool_v.at[:, tables].set(bv)
 
 
+def paged_head_update(mesh, pool_k, pool_v, k, v, tables, write_index, *, layer_index=0):
+    """Head-parallel scatter of a chunk's K/V into the pool over the model
+    mesh axis: the pools and the chunk shard on their ``Hkv`` dimension
+    (each shard writes its own head plane), block tables and positions
+    replicate. The positional math is identical to the unsharded layer
+    scatter, so an extent-1 model axis is bit-equal to it. Accepts an
+    ``AbstractMesh`` so shardcheck's ``vlm-paged-head-scatter`` contract
+    traces this call site device-free (analysis/shard_check.py).
+
+    pool_k/v: ``[L, NB, bs, Hkv, Dh]``; k/v: ``[B, T, Hkv, Dh]`` (the
+    chunk, rope already applied); tables: ``[B, nbl]``; write_index:
+    ``[B]``. Returns the updated pools."""
+    import jax.numpy as _jnp
+    from jax.sharding import PartitionSpec as P
+
+    from cosmos_curate_tpu.parallel.axes import MODEL
+    from cosmos_curate_tpu.parallel.sharding import shard_map
+
+    axis = MODEL if MODEL in mesh.axis_names else None
+    pspec = P(None, None, None, axis, None)
+    kspec = P(None, None, axis, None)
+
+    def _update(pk, pv, k_, v_, tbl, wi):
+        bs = pk.shape[2]
+        t = k_.shape[1]
+        pos = wi[:, None] + _jnp.arange(t)[None, :]  # [B, T]
+        blk = _jnp.take_along_axis(tbl, pos // bs, axis=1)
+        off = pos % bs
+        npk = pk.at[layer_index, blk, off].set(k_.astype(pk.dtype))
+        npv = pv.at[layer_index, blk, off].set(v_.astype(pv.dtype))
+        return npk, npv
+
+    return shard_map(
+        _update,
+        mesh=mesh,
+        in_specs=(pspec, pspec, kspec, kspec, P(None, None), P(None)),
+        out_specs=(pspec, pspec),
+    )(pool_k, pool_v, k, v, tables, write_index)
+
+
 def paged_gather(mesh, pool_k, pool_v, tables):
     """Data-parallel block-table gather: slot rows (tables) shard over the
     mesh's batch axes while the pool is replicated — the fan-out shape for

@@ -38,13 +38,16 @@ class EngineKey:
     """What must match for two callers to share one engine: the served
     checkpoint (model_id — the same architecture under two weight ids must
     NOT share, the second would caption with the first's weights), the
-    architecture (cfg), the compute dtype, and the device mesh the engine
-    was built on."""
+    architecture (cfg), the compute dtype, the device mesh the engine was
+    built on, and the SHARDING geometry over that mesh (two engines sharding
+    the KV pool over different model-axis extents compile different
+    programs and must never collide on one registry slot)."""
 
     model_id: str
     cfg: VLMConfig
     dtype: str
     mesh: tuple
+    geometry: tuple = ()
 
 
 class SharedCaptionEngine:
@@ -63,9 +66,21 @@ class SharedCaptionEngine:
 
         return tuple((d.platform, int(d.id)) for d in jax.devices())
 
+    @staticmethod
+    def _mesh_geometry(mesh) -> tuple:
+        """Hashable (axis, extent) tuple for a serving mesh (empty when
+        unsharded) — matches CaptionEngine.mesh_geometry."""
+        if mesh is None:
+            return ()
+        return tuple((str(n), int(mesh.shape[n])) for n in mesh.axis_names)
+
     @classmethod
-    def key_for(cls, cfg: VLMConfig, model_id: str, dtype: str = "bfloat16") -> EngineKey:
-        return EngineKey(model_id, cfg, dtype, cls._mesh_fingerprint())
+    def key_for(
+        cls, cfg: VLMConfig, model_id: str, dtype: str = "bfloat16", mesh: Any = None
+    ) -> EngineKey:
+        return EngineKey(
+            model_id, cfg, dtype, cls._mesh_fingerprint(), cls._mesh_geometry(mesh)
+        )
 
     @classmethod
     def get(
@@ -79,13 +94,16 @@ class SharedCaptionEngine:
         dtype: str = "bfloat16",
         async_prep: bool = True,
         loader: "Callable[[CaptionEngine], Any] | None" = None,
+        mesh: Any = None,
     ) -> CaptionEngine:
-        """The shared engine for (model, dtype, mesh), building + setting it
-        up on first use. ``loader`` (called once, with the fresh engine)
-        returns the params to serve — weight loading stays the caller's
-        policy (require_weights etc.) without the registry re-running it
-        per stage."""
-        key = cls.key_for(cfg, model_id, dtype)
+        """The shared engine for (model, dtype, mesh, sharding geometry),
+        building + setting it up on first use. ``loader`` (called once,
+        with the fresh engine) returns the params to serve — weight loading
+        stays the caller's policy (require_weights etc.) without the
+        registry re-running it per stage. ``mesh`` selects the head-parallel
+        paged-attention geometry and is part of the key: differently
+        sharded engines never share."""
+        key = cls.key_for(cfg, model_id, dtype, mesh=mesh)
 
         def existing() -> "CaptionEngine | None":
             engine = cls._engines.get(key)
@@ -131,6 +149,7 @@ class SharedCaptionEngine:
                 # production engines prep in the background so vision
                 # encoding of request N+1 overlaps decode of request N
                 async_prep=async_prep,
+                mesh=mesh,
             )
             engine.setup()
             if loader is not None:
@@ -147,9 +166,10 @@ class SharedCaptionEngine:
     ) -> None:
         """Register an externally built engine (benchmarks seed their warm
         engine so the CaptionStage pass shares it instead of doubling
-        weight memory)."""
+        weight memory). The engine's own mesh decides the geometry slot."""
         with cls._lock:
-            cls._engines[cls.key_for(cfg, model_id, dtype)] = engine
+            key = cls.key_for(cfg, model_id, dtype, mesh=getattr(engine, "mesh", None))
+            cls._engines[key] = engine
 
     @classmethod
     def stats(cls) -> dict:

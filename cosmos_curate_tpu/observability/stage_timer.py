@@ -371,6 +371,9 @@ _CAPTION_COUNT_KEYS = (
     # BLOCK references served copy-free, copy-on-write tail duplications,
     # and decode steps whose active slots spanned 2+ owners
     "prefix_block_refs", "kv_cow_copies", "interleaved_steps",
+    # paged-attention deltas (ops/paged_attention.py): decode steps served
+    # without a gathered working set + the view bytes never materialized
+    "paged_kernel_steps", "kv_gather_bytes_avoided",
     "decode_tokens",
 )
 # absolute occupancy gauges riding each drive record: totals overwrite,

@@ -1,3 +1,4 @@
 from cosmos_curate_tpu.ops.flash_attention import flash_attention
+from cosmos_curate_tpu.ops.paged_attention import paged_attention, paged_head_attention
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_attention", "paged_head_attention"]

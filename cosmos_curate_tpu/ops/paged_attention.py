@@ -1,0 +1,415 @@
+"""Paged GQA attention that reads the KV block pool through the block table.
+
+vLLM's PagedAttention kernel (Kwon et al., SOSP 2023 — PAPERS.md) computes
+attention directly against non-contiguous KV blocks: the kernel walks the
+slot's block table and streams each physical block through on-chip memory.
+PR 11 gave the caption engine the paged *pool* but kept gather-based
+programs — every prefill chunk and every decode step materialized a
+contiguous ``[L, n_slots, lane_length]`` copy of the whole KV working set
+and scattered it back. This op deletes that copy:
+
+- **table-driven BlockSpecs**: the block table is scalar-prefetched, so the
+  Pallas index map resolves grid step ``j`` to physical pool block
+  ``table[b, j]`` — the kernel reads pool pages in place, nothing is
+  gathered;
+- **logical positions from the table index**: table entry ``j`` covers
+  logical positions ``[j*bs, (j+1)*bs)`` regardless of where the block
+  lives in the pool, so masking is identical to the contiguous kernels;
+- **early exit**: blocks at/after the row's valid length (and, for prefill,
+  beyond the chunk's last causal position) are skipped with ``pl.when`` —
+  fragmented tables cost nothing extra.
+
+Off-TPU the default is NOT interpret-mode Pallas but a ``jax.lax``
+reference that mirrors ``DecoderLayer``'s XLA attention lines exactly
+(same einsums, same mask construction, same fp32 softmax), so the engine's
+byte-identical parity contract (tests/models/test_paged_kv.py) holds on
+CPU: the reference gathers per-layer blocks for the einsum but never
+scatters a view back. ``CURATE_PAGED_KERNEL=1|0`` forces the Pallas /
+reference path regardless of platform (interpret mode fills in off-TPU).
+
+``paged_head_attention`` wraps the op in a ``shard_map`` over the model
+mesh axis: KV pool and queries shard over heads, block tables and lengths
+replicate — the tensor-parallel form traced by shardcheck's
+``vlm-paged-head-attention`` contract (analysis/shard_check.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def use_paged_kernel() -> bool:
+    """Platform/env gate for the Pallas path (mirrors ``_flash_gate``):
+    ``CURATE_PAGED_KERNEL=1`` forces the kernel, ``=0`` forces the XLA
+    reference, otherwise the kernel runs on real TPUs only."""
+    env = os.environ.get("CURATE_PAGED_KERNEL")
+    if env is not None:
+        return env == "1"
+    return jax.devices()[0].platform == "tpu"
+
+
+def _paged_reference(q, pool_k, pool_v, tables, write_index, kv_len, *, layer_index, sm_scale):
+    """Byte-parity XLA path: gathers the slot's blocks for the einsum (no
+    scatter-back) and then replays DecoderLayer's reference attention lines
+    verbatim — same primitive sequence on the same shapes/values, so CPU
+    outputs are bit-equal to the gather programs."""
+    b, t, hk, g, d = q.shape
+    nbl = tables.shape[1]
+    bs = pool_k.shape[2]
+    s = nbl * bs
+    new_k = pool_k[layer_index][tables].reshape(b, s, hk, d)
+    new_v = pool_v[layer_index][tables].reshape(b, s, hk, d)
+    qg = q * sm_scale
+    logits = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), new_k.astype(jnp.float32)
+    )
+    k_pos = jnp.arange(s)[None, None, None, None, :]
+    q_seq = write_index[:, None] + jnp.arange(t)[None, :]
+    causal = k_pos <= q_seq[:, None, None, :, None]
+    written = k_pos < kv_len[:, None, None, None, None]
+    logits = jnp.where(causal & written, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bkgts,bskd->btkgd", probs.astype(q.dtype), new_v)
+
+
+def _paged_decode_kernel(
+    kvlen_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, sm_scale, bs, g_pad
+):
+    b = pl.program_id(0)
+    ji = pl.program_id(2)
+    num_j = pl.num_programs(2)
+
+    @pl.when(ji == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kv_len = kvlen_ref[b]
+    # table entry ji covers LOGICAL positions [ji*bs, (ji+1)*bs) — the
+    # physical pool block was picked by the BlockSpec index map
+    k_start = ji * bs
+
+    @pl.when(k_start < kv_len)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [g_pad, d]
+        k = k_ref[0, 0, :, 0, :].astype(jnp.float32)  # [bs, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [g_pad, bs]
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (g_pad, bs), 1)
+        s = jnp.where(k_pos < kv_len, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = l_ref[:, :1] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0, :, 0, :].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_new
+
+    @pl.when(ji == num_j - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _paged_prefill_kernel(
+    write_ref,
+    kvlen_ref,
+    tbl_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    sm_scale,
+    block_q,
+    bs,
+    g,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ji = pl.program_id(3)
+    num_j = pl.num_programs(3)
+
+    @pl.when(ji == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    write = write_ref[b]
+    kv_len = kvlen_ref[b]
+    k_start = ji * bs
+    rows = block_q * g
+    last_pos = write + qi * block_q + block_q - 1
+
+    @pl.when((k_start <= last_pos) & (k_start < kv_len))
+    def _step():
+        q = q_ref[0, :, 0].astype(jnp.float32).reshape(rows, q_ref.shape[-1])
+        q = q * sm_scale
+        k = k_ref[0, 0, :, 0, :].astype(jnp.float32)  # [bs, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rows, bs]
+        t_local = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // g
+        q_pos = write + qi * block_q + t_local
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        ok = (k_pos <= q_pos) & (k_pos < kv_len)
+        s = jnp.where(ok, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, :1] = l_ref[:, :1] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0, :, 0, :].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, :1] = m_new
+
+    @pl.when(ji == num_j - 1)
+    def _finish():
+        out = acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, :, 0] = out.reshape(block_q, g, o_ref.shape[-1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("layer_index", "sm_scale", "interpret")
+)
+def _paged_decode(q, pool_k, pool_v, tables, kv_len, *, layer_index, sm_scale, interpret):
+    """q: [B, Hkv, G, D]; pools: [L, NB, bs, Hkv, D]; tables: [B, nbl]."""
+    b, hk, g, d = q.shape
+    nbl = tables.shape[1]
+    bs = pool_k.shape[2]
+    g_pad = max(8, g)  # sublane minimum
+    if g_pad != g:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, g_pad - g), (0, 0)))
+
+    grid = (b, hk, nbl)
+    kernel = functools.partial(_paged_decode_kernel, sm_scale=sm_scale, bs=bs, g_pad=g_pad)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            # the table ref arrives as a trailing index-map arg: grid step
+            # ji reads physical pool block tbl[b, ji] in place
+            in_specs=[
+                pl.BlockSpec((1, 1, g_pad, d), lambda b_, h, ji, kvlen, tbl: (b_, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, bs, 1, d),
+                    lambda b_, h, ji, kvlen, tbl: (layer_index, tbl[b_, ji], 0, h, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bs, 1, d),
+                    lambda b_, h, ji, kvlen, tbl: (layer_index, tbl[b_, ji], 0, h, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g_pad, d), lambda b_, h, ji, kvlen, tbl: (b_, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g_pad, d), jnp.float32),
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+                pltpu.VMEM((g_pad, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hk, g_pad, d), q.dtype),
+        interpret=interpret,
+    )(kv_len.astype(jnp.int32), tables.astype(jnp.int32), q, pool_k, pool_v)
+    return out[:, :, :g]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("layer_index", "sm_scale", "block_q", "interpret")
+)
+def _paged_prefill(
+    q, pool_k, pool_v, tables, write_index, kv_len, *, layer_index, sm_scale, block_q, interpret
+):
+    """q: [B, T, Hkv, G, D]; pools: [L, NB, bs, Hkv, D]; tables: [B, nbl]."""
+    b, t, hk, g, d = q.shape
+    t_orig = t
+    nbl = tables.shape[1]
+    bs = pool_k.shape[2]
+    block_q = min(block_q, t)
+    if t % block_q:
+        pad = block_q - t % block_q
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0), (0, 0)))
+        t += pad
+
+    grid = (b, hk, t // block_q, nbl)
+    kernel = functools.partial(
+        _paged_prefill_kernel, sm_scale=sm_scale, block_q=block_q, bs=bs, g=g
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q, 1, g, d),
+                    lambda b_, h, qi, ji, write, kvlen, tbl: (b_, qi, h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bs, 1, d),
+                    lambda b_, h, qi, ji, write, kvlen, tbl: (
+                        layer_index,
+                        tbl[b_, ji],
+                        0,
+                        h,
+                        0,
+                    ),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bs, 1, d),
+                    lambda b_, h, qi, ji, write, kvlen, tbl: (
+                        layer_index,
+                        tbl[b_, ji],
+                        0,
+                        h,
+                        0,
+                    ),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, 1, g, d),
+                lambda b_, h, qi, ji, write, kvlen, tbl: (b_, qi, h, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q * g, d), jnp.float32),
+                pltpu.VMEM((block_q * g, 128), jnp.float32),
+                pltpu.VMEM((block_q * g, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, t, hk, g, d), q.dtype),
+        interpret=interpret,
+    )(
+        write_index.astype(jnp.int32),
+        kv_len.astype(jnp.int32),
+        tables.astype(jnp.int32),
+        q,
+        pool_k,
+        pool_v,
+    )
+    return out[:, :t_orig]
+
+
+def paged_attention(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    write_index: jax.Array,
+    kv_len: jax.Array,
+    *,
+    layer_index: int = 0,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    use_kernel: bool | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attention straight out of the paged KV pool, no gathered working set.
+
+    q: ``[B, T, Hkv, G, D]`` UNSCALED grouped queries (this op applies
+    ``sm_scale`` so the reference path matches DecoderLayer bitwise);
+    pool_k/pool_v: the full block pools ``[L, NB, bs, Hkv, D]`` with the
+    chunk's K/V already written through the table; tables: ``[B, nbl]``
+    logical-to-physical block ids; write_index/kv_len: ``[B]``. Serves both
+    decode (T=1) and chunked prefill (T>1). Returns ``[B, T, Hkv, G, D]``.
+
+    ``use_kernel=None`` resolves via :func:`use_paged_kernel` (env override,
+    else TPU-only); the off-kernel path is the byte-parity XLA reference.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_kernel is None:
+        use_kernel = use_paged_kernel()
+    if not use_kernel:
+        return _paged_reference(
+            q, pool_k, pool_v, tables, write_index, kv_len,
+            layer_index=layer_index, sm_scale=sm_scale,
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if q.shape[1] == 1:
+        out = _paged_decode(
+            q[:, 0], pool_k, pool_v, tables, kv_len,
+            layer_index=layer_index, sm_scale=sm_scale, interpret=interpret,
+        )
+        return out[:, None]
+    return _paged_prefill(
+        q, pool_k, pool_v, tables, write_index, kv_len,
+        layer_index=layer_index, sm_scale=sm_scale, block_q=block_q, interpret=interpret,
+    )
+
+
+def paged_head_attention(
+    mesh,
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    write_index: jax.Array,
+    kv_len: jax.Array,
+    *,
+    layer_index: int = 0,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    use_kernel: bool | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Head-parallel paged attention over the model mesh axis.
+
+    Queries, KV pools, and the output shard on their ``Hkv`` dimension over
+    ``parallel/axes.MODEL``; block tables and lengths replicate (every shard
+    walks the same table against its own head plane — attention is
+    embarrassingly parallel over KV heads). Accepts an ``AbstractMesh`` so
+    shardcheck's ``vlm-paged-head-attention`` contract traces this call
+    site device-free. On a mesh without the model axis (or extent 1) the
+    computation is identical to :func:`paged_attention` bit-for-bit.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from cosmos_curate_tpu.parallel.axes import MODEL
+    from cosmos_curate_tpu.parallel.sharding import shard_map
+
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    axis = MODEL if MODEL in mesh.axis_names else None
+    qspec = P(None, None, axis, None, None)  # [B, T, Hkv, G, D]
+    pspec = P(None, None, None, axis, None)  # [L, NB, bs, Hkv, D]
+    fn = functools.partial(
+        paged_attention,
+        layer_index=layer_index,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        use_kernel=use_kernel,
+        interpret=interpret,
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qspec, pspec, pspec, P(None, None), P(None), P(None)),
+        out_specs=qspec,
+    )(q, pool_k, pool_v, tables, write_index, kv_len)

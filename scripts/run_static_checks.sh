@@ -88,14 +88,17 @@ echo "== caption-bench smoke: tiny engine, 2 requests -> efficiency + paged pref
 # paged KV pool must serve those prefixes COPY-FREE: block references > 0,
 # ZERO whole-prefix device-copy dispatches (the deleted insert_prefix
 # path), per-request KV reservation strictly below the slot-row worst
-# case, and two concurrent owners interleaving decode steps.
+# case, and two concurrent owners interleaving decode steps. Under
+# paged_attention=kernel the paged programs must actually have run
+# (paged_kernel_steps > 0 is the structural no-gathered-working-set proof).
 JAX_PLATFORMS=cpu python - <<'PY'
 import json, subprocess, sys
 
 proc = subprocess.run(
     [sys.executable, "-m", "benchmarks.caption_benchmark",
      "--config", "tiny", "--requests", "2", "--max-new", "8",
-     "--batch", "2", "--frames", "2", "--uniform"],
+     "--batch", "2", "--frames", "2", "--uniform",
+     "--paged-attention", "kernel"],
     capture_output=True, text=True, timeout=1200,
 )
 assert proc.returncode == 0, proc.stderr[-2000:]
@@ -108,6 +111,10 @@ assert "caption_phases" in rec and rec["caption_phases"]["decode_s"] > 0, rec
 assert rec["prefix_block_refs"] > 0, rec
 assert rec["prefix_copy_dispatches"] == 0, rec
 assert rec["kv_bytes_per_request"] < rec["kv_bytes_per_request_worst_case"], rec
+assert rec["paged_attention"] == "kernel", rec
+assert rec["paged_kernel_steps"] > 0, rec
+assert rec["kv_gather_bytes_avoided"] > 0, rec
+assert rec["kv_block_size_requested"] == rec["kv_block_size"], rec
 cj = rec["cross_job"]
 assert cj["interleaved_steps"] > 0, cj
 assert all(v > 0 for v in cj["owner_decode_tokens"].values()), cj
@@ -116,8 +123,44 @@ print(
     f"{rec['prefix_block_refs']} prefix block refs (0 prefix copies), "
     f"kv {rec['kv_bytes_per_request']:.0f}B/req vs "
     f"{rec['kv_bytes_per_request_worst_case']:.0f}B worst-case, "
-    f"{cj['interleaved_steps']} interleaved cross-job steps"
+    f"{cj['interleaved_steps']} interleaved cross-job steps, "
+    f"{rec['paged_kernel_steps']} paged decode steps "
+    f"({rec['kv_gather_bytes_avoided']}B gathered-view copies avoided)"
 )
+PY
+
+echo "== paged-attention parity smoke: kernel vs gather, same prompts =="
+# The paged programs (attention reads the KV pool through the block table)
+# and the legacy gather-view programs must caption IDENTICALLY on the same
+# prompts — greedy byte parity is the contract that lets auto-mode flip
+# between them per platform.
+JAX_PLATFORMS=cpu python - <<'PY'
+from cosmos_curate_tpu.models.vlm import (
+    CaptionEngine, CaptionRequest, SamplingConfig, VLM_TINY_TEST,
+)
+
+def drive(mode, params=None):
+    eng = CaptionEngine(
+        VLM_TINY_TEST, max_batch=2, kv_lanes=((64, 1), (128, 1)),
+        prefill_chunk=16, paged_attention=mode,
+    )
+    eng.setup()
+    if params is not None:
+        eng.params = params
+    tok = eng.tokenizer
+    for i, text in enumerate(("a quiet street at dusk", "close-up of rain " * 6)):
+        eng.add_request(CaptionRequest(
+            request_id=f"r{i}", prompt_ids=tok.encode(text),
+            sampling=SamplingConfig(max_new_tokens=12),
+        ))
+    out = {r.request_id: r.text for r in eng.run_until_complete()}
+    return out, eng
+
+kernel_out, kernel_eng = drive("kernel")
+gather_out, gather_eng = drive("gather", kernel_eng.params)
+assert kernel_out == gather_out, (kernel_out, gather_out)
+assert kernel_eng.paged_kernel_steps > 0 and gather_eng.paged_kernel_steps == 0
+print(f"paged parity smoke ok: {len(kernel_out)} prompts bit-equal across paths")
 PY
 
 echo "static checks passed"

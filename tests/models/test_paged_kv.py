@@ -356,6 +356,151 @@ class TestRefcountedPrefixBlocks:
         )
 
 
+class TestPagedAttentionModes:
+    """The paged programs (ops/paged_attention.py, reference path on CPU)
+    vs the legacy gather-view programs: byte-identical outputs AND pool
+    contents, with the working-set counters proving which path ran."""
+
+    GNARLY = dict(max_batch=4, kv_lanes=((64, 2), (128, 2)), prefill_chunk=16)
+
+    @staticmethod
+    def _mode_engine(mode, params=None, **kw):
+        eng = CaptionEngine(VLM_TINY_TEST, paged_attention=mode, **kw)
+        eng.setup()
+        if params is not None:
+            eng.params = params
+        return eng
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            CaptionEngine(VLM_TINY_TEST, paged_attention="bogus")
+
+    def test_env_override_beats_constructor(self, monkeypatch):
+        monkeypatch.setenv("CURATE_PAGED_ATTENTION", "gather")
+        eng = CaptionEngine(VLM_TINY_TEST, paged_attention="kernel")
+        assert eng.paged_attention == "gather"
+        monkeypatch.setenv("CURATE_PAGED_ATTENTION", "nonsense")
+        with pytest.raises(ValueError):
+            CaptionEngine(VLM_TINY_TEST)
+
+    def test_stats_surface_block_size_fallback_and_mode(self):
+        # 24 does not divide 64/128 lanes: gcd fallback shrinks it to 8 —
+        # stats must show BOTH sides so bench rows aren't apples-to-oranges
+        eng = self._mode_engine("auto", **self.GNARLY, block_size=24)
+        stats = eng.stats()
+        assert stats["kv_block_size_requested"] == 24
+        assert stats["kv_block_size"] == 8 == eng.block_size
+        assert stats["paged_attention"] == "auto"
+        assert stats["mesh_geometry"] == ()
+        for key in ("paged_kernel_steps", "kv_gather_bytes_avoided", "decode_attention_s"):
+            assert key in stats
+
+    def test_kernel_vs_gather_bit_equal_across_lane_buckets(self):
+        """Same prompts through both program families, spanning both lane
+        buckets and chunked prefill: greedy texts AND every written pool
+        cell must match bitwise (block 0 is the garbage block — idle rows
+        park writes there and the two families park different garbage)."""
+        kernel = self._mode_engine("kernel", **self.GNARLY)
+        gather = self._mode_engine("gather", kernel.params, **self.GNARLY)
+
+        def reqs():
+            return [
+                _req("short", text="hi", max_new=4),  # 64 lane
+                _req("long", text="w " * 30, max_new=6),  # 128 lane
+                _req("mid", text="clip number 9", max_new=6),
+            ]
+
+        got_k = _drain(kernel, reqs())
+        got_g = _drain(gather, reqs())
+        assert got_k == got_g
+        np.testing.assert_array_equal(
+            np.asarray(kernel._pool_k)[:, 1:], np.asarray(gather._pool_k)[:, 1:]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(kernel._pool_v)[:, 1:], np.asarray(gather._pool_v)[:, 1:]
+        )
+        # structural proof the gathered working set was eliminated vs kept
+        assert kernel.paged_kernel_steps > 0
+        assert kernel.kv_gather_bytes_avoided > 0
+        assert gather.paged_kernel_steps == 0
+        assert gather.kv_gather_bytes_avoided == 0
+
+    def test_parity_with_fragmented_block_table(self):
+        """Blocks deliberately NON-CONTIGUOUS in the pool — the layout the
+        gather path never distinguishes but the table-walking op must: punch
+        holes in the allocator so the request's table interleaves recycled
+        and fresh blocks, then demand byte parity with the slot-row
+        reference."""
+        eng = CaptionEngine(
+            VLM_TINY_TEST,
+            max_batch=2,
+            kv_lanes=((128, 2),),
+            enable_prefix_cache=False,
+            block_size=16,
+        )
+        eng.setup()
+        held = eng._allocator.alloc(6)
+        eng._allocator.decref(held[::2])  # free every other -> holes
+        eng.add_request(_req("frag", text="scatter me around", max_new=6, frames=0))
+        eng.step()
+        claim = next(iter(eng.lanes[0].claims.values()))
+        blocks = claim.all_blocks
+        assert blocks != sorted(blocks) or any(
+            b - a != 1 for a, b in zip(blocks, blocks[1:])
+        ), f"table {blocks} is contiguous; fragmentation precondition failed"
+        got = {r.request_id: r.text for r in eng.run_until_complete()}
+        want = slot_row_reference(
+            eng, _req("frag", text="scatter me around", max_new=6, frames=0), 128
+        )
+        assert got["frag"] == want
+        eng._allocator.decref(held[1::2])
+
+
+class TestSharedEngineMeshGeometry:
+    """EngineKey includes the sharding geometry: engines built over
+    different model-axis extents compile different programs and must not
+    collide on one registry slot."""
+
+    def test_two_geometries_two_engines_same_geometry_shared(self):
+        from jax.sharding import Mesh
+
+        from cosmos_curate_tpu.models.vlm import SharedCaptionEngine
+
+        SharedCaptionEngine.reset()
+        try:
+            mesh2 = Mesh(np.array(jax.devices()[:2]), axis_names=("model",))
+            kw = dict(model_id="tiny-geom", tokenizer=TOK, max_batch=2)
+            unsharded = SharedCaptionEngine.get(VLM_TINY_TEST, **kw)
+            sharded = SharedCaptionEngine.get(VLM_TINY_TEST, mesh=mesh2, **kw)
+            assert sharded is not unsharded
+            assert sharded.mesh_geometry == (("model", 2),)
+            assert unsharded.mesh_geometry == ()
+            assert SharedCaptionEngine.get(VLM_TINY_TEST, mesh=mesh2, **kw) is sharded
+            assert SharedCaptionEngine.get(VLM_TINY_TEST, **kw) is unsharded
+        finally:
+            SharedCaptionEngine.reset()
+
+    def test_head_parallel_engine_matches_unsharded_text(self):
+        """Extent-2 model axis over the tiny config's 2 KV heads: the
+        head-parallel paged path must caption identically to the unsharded
+        engine (attention is embarrassingly parallel over head planes)."""
+        from jax.sharding import Mesh
+
+        base = CaptionEngine(VLM_TINY_TEST, max_batch=2)
+        base.setup()
+        sharded = CaptionEngine(
+            VLM_TINY_TEST,
+            max_batch=2,
+            mesh=Mesh(np.array(jax.devices()[:2]), axis_names=("model",)),
+        )
+        sharded.setup()
+        sharded.params = base.params
+        reqs = lambda: [_req(f"m{i}", text=f"scene {i}", max_new=4) for i in range(2)]
+        got_base = _drain(base, reqs())
+        got_sharded = _drain(sharded, reqs())
+        assert got_sharded == got_base
+
+
 class TestCrossJobInterleave:
     def test_two_owners_active_in_same_step_window(self):
         """Two owners submitting concurrently must INTERLEAVE: decode steps

@@ -1,0 +1,230 @@
+"""Paged-attention op: table-driven kernel + byte-parity XLA reference.
+
+The reference path (``use_kernel=False``) is the engine's CPU serving path
+and must agree with a dense contiguous-cache oracle; the Pallas kernel
+(interpreter mode off-TPU) must agree with the reference to float
+tolerance. Block tables here are deliberately FRAGMENTED — logical order
+never matches pool order — because in-place table walks are the whole
+point of the op.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cosmos_curate_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_head_attention,
+    use_paged_kernel,
+)
+
+
+def _dense_reference(q, k_cache, v_cache, write_index, kv_len, sm_scale):
+    """Grouped causal attention against CONTIGUOUS caches — independent of
+    the pool/table plumbing under test. q: [B,T,Hk,G,D]; caches [B,S,Hk,D]."""
+    b, t, hk, g, d = q.shape
+    s = k_cache.shape[1]
+    logits = jnp.einsum(
+        "btkgd,bskd->bkgts",
+        q.astype(jnp.float32) * sm_scale,
+        k_cache.astype(jnp.float32),
+    )
+    k_pos = jnp.arange(s)[None, None, None, None, :]
+    q_seq = write_index[:, None] + jnp.arange(t)[None, :]
+    causal = k_pos <= q_seq[:, None, None, :, None]
+    written = k_pos < kv_len[:, None, None, None, None]
+    logits = jnp.where(causal & written, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bkgts,bskd->btkgd", probs, v_cache.astype(jnp.float32))
+
+
+def _fragmented_case(rng, *, b, t, hk, g, d, nbl, bs, n_blocks, dtype=jnp.float32):
+    """A pool where each row's table is a shuffled, interleaved slice of the
+    physical blocks (block 0 reserved as garbage, engine convention), plus
+    the logical contiguous caches those tables describe."""
+    l = 2  # two layers so layer_index != 0 is exercised
+    layer = 1
+    pool_k = jnp.asarray(rng.standard_normal((l, n_blocks, bs, hk, d)), dtype)
+    pool_v = jnp.asarray(rng.standard_normal((l, n_blocks, bs, hk, d)), dtype)
+    ids = rng.permutation(np.arange(1, n_blocks))[: b * nbl]
+    tables = jnp.asarray(ids.reshape(b, nbl), jnp.int32)
+    q = jnp.asarray(rng.standard_normal((b, t, hk, g, d)), dtype)
+    k_cache = np.asarray(pool_k)[layer][np.asarray(tables)].reshape(b, nbl * bs, hk, d)
+    v_cache = np.asarray(pool_v)[layer][np.asarray(tables)].reshape(b, nbl * bs, hk, d)
+    return q, pool_k, pool_v, tables, layer, jnp.asarray(k_cache), jnp.asarray(v_cache)
+
+
+class TestReferencePath:
+    @pytest.mark.parametrize("b,hk,g,d,nbl,bs", [(2, 2, 4, 16, 4, 16), (3, 1, 2, 32, 2, 8)])
+    def test_decode_matches_dense_oracle(self, b, hk, g, d, nbl, bs):
+        rng = np.random.default_rng(0)
+        q, pk, pv, tables, layer, kc, vc = _fragmented_case(
+            rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=b * nbl + 3
+        )
+        kv_len = jnp.asarray(rng.integers(1, nbl * bs + 1, b), jnp.int32)
+        write = kv_len - 1
+        sm = d**-0.5
+        got = paged_attention(
+            q, pk, pv, tables, write, kv_len, layer_index=layer, use_kernel=False
+        )
+        want = _dense_reference(q, kc, vc, write, kv_len, sm)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+    def test_prefill_chunk_matches_dense_oracle(self):
+        """A chunk written mid-context (write_index > 0) attends to cached
+        prefix positions plus its own causal window."""
+        rng = np.random.default_rng(1)
+        b, t, hk, g, d, nbl, bs = 2, 12, 2, 3, 16, 4, 16
+        q, pk, pv, tables, layer, kc, vc = _fragmented_case(
+            rng, b=b, t=t, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=b * nbl + 2
+        )
+        write = jnp.asarray([0, 17], jnp.int32)  # one fresh row, one mid-context
+        kv_len = write + t
+        got = paged_attention(
+            q, pk, pv, tables, write, kv_len, layer_index=layer, use_kernel=False
+        )
+        want = _dense_reference(q, kc, vc, write, kv_len, d**-0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+    def test_unmapped_pool_blocks_do_not_leak(self):
+        """Garbage in pool blocks OUTSIDE the tables must not reach the
+        output — the op reads only through the table."""
+        rng = np.random.default_rng(2)
+        b, hk, g, d, nbl, bs = 1, 1, 2, 16, 2, 8
+        n_blocks = b * nbl + 4
+        q, pk, pv, tables, layer, kc, vc = _fragmented_case(
+            rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=n_blocks
+        )
+        mapped = set(np.asarray(tables).ravel().tolist())
+        unmapped = [i for i in range(n_blocks) if i not in mapped]
+        pk = pk.at[:, jnp.asarray(unmapped)].set(1e20)
+        pv = pv.at[:, jnp.asarray(unmapped)].set(-1e20)
+        kv_len = jnp.asarray([nbl * bs], jnp.int32)
+        got = np.asarray(
+            paged_attention(
+                q, pk, pv, tables, kv_len - 1, kv_len, layer_index=layer, use_kernel=False
+            )
+        )
+        assert np.isfinite(got).all()
+        want = _dense_reference(q, kc, vc, kv_len - 1, kv_len, d**-0.5)
+        np.testing.assert_allclose(got, np.asarray(want), atol=2e-5, rtol=1e-4)
+
+
+class TestInterpretKernel:
+    """The Pallas kernels in interpreter mode vs the reference path."""
+
+    @pytest.mark.parametrize("b,hk,g,d,nbl,bs", [(2, 2, 4, 16, 4, 16), (1, 2, 6, 32, 3, 8)])
+    def test_decode_kernel_matches_reference(self, b, hk, g, d, nbl, bs):
+        rng = np.random.default_rng(3)
+        q, pk, pv, tables, layer, _, _ = _fragmented_case(
+            rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=b * nbl + 2
+        )
+        kv_len = jnp.asarray(rng.integers(1, nbl * bs + 1, b), jnp.int32)
+        write = kv_len - 1
+        got = paged_attention(
+            q, pk, pv, tables, write, kv_len,
+            layer_index=layer, use_kernel=True, interpret=True,
+        )
+        want = paged_attention(
+            q, pk, pv, tables, write, kv_len, layer_index=layer, use_kernel=False
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+    def test_prefill_kernel_matches_reference_offset_and_ragged_t(self):
+        """write_index > 0 plus a chunk length that does not tile block_q:
+        the pad rows must not disturb the valid window."""
+        rng = np.random.default_rng(4)
+        b, t, hk, g, d, nbl, bs = 2, 13, 2, 3, 16, 4, 16
+        q, pk, pv, tables, layer, _, _ = _fragmented_case(
+            rng, b=b, t=t, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=b * nbl + 2
+        )
+        write = jnp.asarray([0, 23], jnp.int32)
+        kv_len = write + t
+        got = paged_attention(
+            q, pk, pv, tables, write, kv_len,
+            layer_index=layer, use_kernel=True, interpret=True, block_q=8,
+        )
+        want = paged_attention(
+            q, pk, pv, tables, write, kv_len, layer_index=layer, use_kernel=False
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4)
+
+    def test_bf16_kernel_within_online_softmax_tolerance(self):
+        """bf16 online softmax (kernel) vs dense softmax (reference) differ
+        by a couple of ulps at magnitude ~1 — the engine's byte contract
+        lives on the reference path, the kernel only owes float agreement."""
+        rng = np.random.default_rng(5)
+        b, hk, g, d, nbl, bs = 2, 2, 4, 16, 4, 16
+        q, pk, pv, tables, layer, _, _ = _fragmented_case(
+            rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs,
+            n_blocks=b * nbl + 2, dtype=jnp.bfloat16,
+        )
+        kv_len = jnp.asarray([nbl * bs, 17], jnp.int32)
+        got = paged_attention(
+            q, pk, pv, tables, kv_len - 1, kv_len,
+            layer_index=layer, use_kernel=True, interpret=True,
+        )
+        want = paged_attention(
+            q, pk, pv, tables, kv_len - 1, kv_len, layer_index=layer, use_kernel=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
+        )
+
+
+class TestHeadParallel:
+    def test_sharded_heads_bit_equal_to_single_device(self, cpu_mesh):
+        """shard_map over the model axis (Hkv sharded, tables replicated)
+        must be BIT-equal to the unsharded op: head planes never interact
+        in attention, so sharding cannot change a single float."""
+        rng = np.random.default_rng(6)
+        b, hk, g, d, nbl, bs = 2, 4, 2, 16, 3, 8  # hk divides model axis (4)
+        q, pk, pv, tables, layer, _, _ = _fragmented_case(
+            rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs, n_blocks=b * nbl + 2
+        )
+        kv_len = jnp.asarray([nbl * bs, 11], jnp.int32)
+        sharded = paged_head_attention(
+            cpu_mesh, q, pk, pv, tables, kv_len - 1, kv_len,
+            layer_index=layer, use_kernel=False,
+        )
+        single = paged_attention(
+            q, pk, pv, tables, kv_len - 1, kv_len, layer_index=layer, use_kernel=False
+        )
+        assert np.array_equal(np.asarray(sharded), np.asarray(single))
+
+
+def test_env_gate(monkeypatch):
+    monkeypatch.setenv("CURATE_PAGED_KERNEL", "1")
+    assert use_paged_kernel()
+    monkeypatch.setenv("CURATE_PAGED_KERNEL", "0")
+    assert not use_paged_kernel()
+    monkeypatch.delenv("CURATE_PAGED_KERNEL")
+    assert use_paged_kernel() == (jax.devices()[0].platform == "tpu")
+
+
+@pytest.mark.tpu
+def test_kernel_numerics_on_chip():
+    """ROADMAP 4b first rung: the COMPILED kernel (not interpreter) vs the
+    gather-equivalent reference, on real hardware. Self-skips off-TPU so
+    default CPU runs stay green without deselection."""
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("requires TPU hardware")
+    rng = np.random.default_rng(7)
+    b, hk, g, d, nbl, bs = 4, 4, 8, 128, 8, 16
+    q, pk, pv, tables, layer, _, _ = _fragmented_case(
+        rng, b=b, t=1, hk=hk, g=g, d=d, nbl=nbl, bs=bs,
+        n_blocks=b * nbl + 4, dtype=jnp.bfloat16,
+    )
+    kv_len = jnp.asarray(rng.integers(1, nbl * bs + 1, b), jnp.int32)
+    got = paged_attention(
+        q, pk, pv, tables, kv_len - 1, kv_len,
+        layer_index=layer, use_kernel=True, interpret=False,
+    )
+    want = paged_attention(
+        q, pk, pv, tables, kv_len - 1, kv_len, layer_index=layer, use_kernel=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
+    )
